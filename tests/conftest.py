@@ -1,0 +1,16 @@
+"""Test harness config: force an 8-device virtual CPU mesh BEFORE jax import.
+
+Mirrors the reference's mocktikv strategy (SURVEY.md §4): all distributed
+behavior is exercised hermetically on one host — here, multi-chip sharding
+runs on 8 virtual CPU devices via XLA's host-platform device count.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

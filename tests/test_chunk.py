@@ -1,0 +1,64 @@
+"""Chunk/Column tests. Ref model: util/chunk/chunk_test.go."""
+
+import decimal
+
+import numpy as np
+import pytest
+
+from tidb_tpu import sqltypes as st
+from tidb_tpu.chunk import Chunk, Column, dict_encode
+
+
+def test_column_from_values_int():
+    ft = st.new_int_field()
+    c = Column.from_values(ft, [1, None, 3])
+    assert len(c) == 3
+    assert c.get(0) == 1
+    assert c.get(1) is None
+    assert c.get(2) == 3
+    assert c.data.dtype == np.int64
+
+
+def test_column_decimal_roundtrip():
+    ft = st.new_decimal_field(frac=2)
+    c = Column.from_values(ft, [decimal.Decimal("12.34"), None, 5])
+    assert c.get(0) == decimal.Decimal("12.34")
+    assert c.get(1) is None
+    assert c.get(2) == decimal.Decimal("5")
+    assert c.data[0] == 1234
+
+
+def test_chunk_rows_filter_take():
+    fts = [st.new_int_field(), st.new_double_field(), st.new_string_field()]
+    rows = [(1, 1.5, "a"), (2, None, "b"), (3, 3.5, "c")]
+    ch = Chunk.from_rows(fts, rows)
+    assert ch.num_rows == 3
+    assert ch.row(1) == (2, None, "b")
+    f = ch.filter(np.array([True, False, True]))
+    assert f.to_pylist() == [(1, 1.5, "a"), (3, 3.5, "c")]
+    t = ch.take(np.array([2, 0]))
+    assert t.row(0) == (3, 3.5, "c")
+
+
+def test_chunk_concat_slice():
+    fts = [st.new_int_field()]
+    a = Chunk.from_rows(fts, [(1,), (2,)])
+    b = Chunk.from_rows(fts, [(3,)])
+    c = a.concat(b)
+    assert c.to_pylist() == [(1,), (2,), (3,)]
+    assert c.slice(1, 3).to_pylist() == [(2,), (3,)]
+
+
+def test_dict_encode():
+    ft = st.new_string_field()
+    c = Column.from_values(ft, ["x", "y", None, "x"])
+    codes, values = dict_encode(c)
+    assert values == ["x", "y"]
+    assert codes.tolist() == [0, 1, -1, 0]
+
+
+def test_datetime_repr():
+    us = st.parse_datetime("1998-09-02")
+    assert st.format_datetime(us, st.TypeCode.DATE) == "1998-09-02"
+    us2 = st.parse_datetime("2024-02-29 12:30:45")
+    assert st.format_datetime(us2) == "2024-02-29 12:30:45"

@@ -1,0 +1,42 @@
+"""tidb_tpu — a TPU-native distributed HTAP SQL framework.
+
+A ground-up rebuild of the capabilities of TiDB (reference: /root/reference,
+Go, ~192k LoC) designed TPU-first:
+
+* Control plane (SQL -> plan -> schema -> txn protocol) is host Python/C++,
+  structurally mirroring the reference's session/planner/kv layers.
+* Data plane (scan/filter/project/join/aggregate/sort over columns) is
+  JAX/XLA: jit kernels per operator, shard_map over a `jax.sharding.Mesh`
+  for multi-chip group-by/join with psum/all_gather merges.
+* Storage is a Percolator-style MVCC transactional KV store partitioned
+  into regions, with an in-process mock cluster (the reference's mocktikv
+  move) providing hermetic multi-"node" testing on one host.
+
+Layer map (cf. SURVEY.md §1):
+
+    session/    Session API: Execute, txn lifecycle          (ref: session.go)
+    parser/     SQL -> AST                                   (ref: parser/, ast/)
+    plan/       logical/physical planner, copTask model      (ref: plan/)
+    executor/   volcano-over-chunks executors                (ref: executor/)
+    expression/ expr trees, numpy + jax evaluation           (ref: expression/)
+    ops/        TPU kernels: filter/agg/join/sort            (ref: executor/ hot ops)
+    parallel/   device mesh, sharded kernels                 (new, TPU-native)
+    kv/         engine-neutral txn KV contract               (ref: kv/)
+    store/      distributed client: regions, 2PC, cop fanout (ref: store/tikv/)
+    mockstore/  in-process MVCC cluster + coprocessor        (ref: store/tikv/mocktikv/)
+    table/      row <-> KV mapping                           (ref: table/, tablecodec/)
+    meta/       schema metadata on KV                        (ref: meta/, structure/)
+    schema/     model + infoschema                           (ref: model/, infoschema/)
+    codec/      memcomparable datum codec                    (ref: util/codec/)
+    chunk/      Arrow-layout columnar batches                (ref: util/chunk/)
+    sqltypes/   field types, eval types, decimal             (ref: types/)
+"""
+
+__version__ = "0.1.0"
+
+# The device data plane is built on int64 lanes (scaled decimals, epoch-micros
+# datetimes, memcomparable-ordered keys). JAX defaults to 32-bit; without x64
+# the compute silently truncates — so the framework requires it globally.
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)

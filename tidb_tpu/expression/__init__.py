@@ -1,0 +1,10 @@
+from tidb_tpu.expression.core import (
+    Expression, ColumnRef, Constant, ScalarFunc, Op,
+    col, const, func, and_all,
+)
+from tidb_tpu.expression.agg import AggFunc, AggDesc
+
+__all__ = [
+    "Expression", "ColumnRef", "Constant", "ScalarFunc", "Op",
+    "col", "const", "func", "and_all", "AggFunc", "AggDesc",
+]

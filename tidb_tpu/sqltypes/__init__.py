@@ -1,0 +1,263 @@
+"""SQL type system: field types, eval types, numpy/JAX dtype mapping.
+
+Reference: /root/reference/types/ (FieldType types/field_type.go, EvalType
+types/eval_type.go, Datum types/datum.go:57-65, MyDecimal types/mydecimal.go,
+Time types/time.go).
+
+TPU-first design departures from the reference:
+
+* No tagged-union Datum in the hot path. Columns are numpy arrays with a
+  validity bitmap (Arrow convention); a light `Datum`-like Python value is
+  used only on the row-at-a-time control plane (codec, membuffer, DDL).
+* DECIMAL is a scaled int64 on the compute path ("decimal-as-scaled-int",
+  SURVEY.md §7 stage 1): value = unscaled // 10**frac. Exact arithmetic
+  beyond int64 range falls back to the host path (python decimal).
+* DATETIME/DATE/TIMESTAMP are int64 microseconds since unix epoch;
+  DURATION is int64 microseconds. All fixed-width -> device-transferable.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import decimal as _pydec
+from dataclasses import dataclass, field, replace
+from enum import IntEnum
+
+import numpy as np
+
+__all__ = [
+    "TypeCode", "EvalType", "FieldType", "Flag",
+    "new_int_field", "new_uint_field", "new_double_field",
+    "new_decimal_field", "new_string_field", "new_datetime_field",
+    "new_date_field",
+    "np_dtype_for", "eval_type_of",
+    "decimal_to_scaled", "scaled_to_decimal",
+    "datetime_to_micros", "micros_to_datetime", "date_to_micros",
+    "parse_datetime", "format_datetime",
+    "NULL",
+]
+
+
+class TypeCode(IntEnum):
+    """MySQL column type codes (subset). Ref: mysql/type.go."""
+
+    NULL = 6
+    TINY = 1
+    SHORT = 2
+    LONG = 3
+    LONGLONG = 8
+    INT24 = 9
+    FLOAT = 4
+    DOUBLE = 5
+    NEWDECIMAL = 246
+    VARCHAR = 15
+    STRING = 254
+    VARSTRING = 253
+    BLOB = 252
+    DATE = 10
+    DATETIME = 12
+    TIMESTAMP = 7
+    DURATION = 11
+    YEAR = 13
+    BIT = 16
+    ENUM = 247
+    SET = 248
+    JSON = 245
+
+
+class Flag(IntEnum):
+    """Column flags (subset of mysql/const.go flag bits)."""
+
+    NOT_NULL = 1
+    PRI_KEY = 2
+    UNIQUE_KEY = 4
+    MULTIPLE_KEY = 8
+    UNSIGNED = 32
+    BINARY = 128
+    AUTO_INCREMENT = 512
+
+
+class EvalType(IntEnum):
+    """Evaluation type classes. Ref: types/eval_type.go."""
+
+    INT = 0
+    REAL = 1
+    DECIMAL = 2
+    STRING = 3
+    DATETIME = 4
+    DURATION = 5
+    JSON = 6
+
+
+_INT_TYPES = {TypeCode.TINY, TypeCode.SHORT, TypeCode.LONG, TypeCode.LONGLONG,
+              TypeCode.INT24, TypeCode.YEAR, TypeCode.BIT}
+_REAL_TYPES = {TypeCode.FLOAT, TypeCode.DOUBLE}
+_STRING_TYPES = {TypeCode.VARCHAR, TypeCode.STRING, TypeCode.VARSTRING,
+                 TypeCode.BLOB, TypeCode.ENUM, TypeCode.SET}
+_TIME_TYPES = {TypeCode.DATE, TypeCode.DATETIME, TypeCode.TIMESTAMP}
+
+
+NULL = None  # SQL NULL is Python None throughout the row-wise host code
+
+
+@dataclass(frozen=True)
+class FieldType:
+    """Column type descriptor. Ref: types/field_type.go FieldType."""
+
+    tp: TypeCode
+    flags: int = 0
+    flen: int = -1       # display length / max bytes for strings
+    frac: int = -1       # decimal digits after the point (NEWDECIMAL, DURATION)
+    charset: str = "utf8"
+    elems: tuple = ()    # ENUM/SET members
+
+    @property
+    def is_unsigned(self) -> bool:
+        return bool(self.flags & Flag.UNSIGNED)
+
+    @property
+    def not_null(self) -> bool:
+        return bool(self.flags & Flag.NOT_NULL)
+
+    @property
+    def eval_type(self) -> EvalType:
+        return eval_type_of(self.tp)
+
+    def with_flags(self, extra: int) -> "FieldType":
+        return replace(self, flags=self.flags | extra)
+
+    def np_dtype(self):
+        return np_dtype_for(self.tp)
+
+    @property
+    def fixed_width(self) -> bool:
+        """True if values are a fixed-width numeric representation
+        (device-transferable without dictionary encoding)."""
+        return self.eval_type != EvalType.STRING and self.tp != TypeCode.JSON
+
+
+def eval_type_of(tp: TypeCode) -> EvalType:
+    if tp in _INT_TYPES:
+        return EvalType.INT
+    if tp in _REAL_TYPES:
+        return EvalType.REAL
+    if tp == TypeCode.NEWDECIMAL:
+        return EvalType.DECIMAL
+    if tp in _TIME_TYPES:
+        return EvalType.DATETIME
+    if tp == TypeCode.DURATION:
+        return EvalType.DURATION
+    if tp == TypeCode.JSON:
+        return EvalType.JSON
+    return EvalType.STRING
+
+
+def np_dtype_for(tp: TypeCode):
+    """Fixed storage dtype per type (ref: util/chunk/chunk.go:81-97 chooses
+    fixed widths per MySQL type; we use 8-byte lanes uniformly so columns map
+    directly onto TPU-friendly int64/float64/float32 arrays)."""
+    et = eval_type_of(tp)
+    if et in (EvalType.INT, EvalType.DECIMAL, EvalType.DATETIME, EvalType.DURATION):
+        return np.dtype(np.int64)
+    if et == EvalType.REAL:
+        return np.dtype(np.float64)
+    return np.dtype(object)  # varlen: held host-side / dictionary-encoded
+
+
+# ---------------------------------------------------------------------------
+# Constructors
+
+def new_int_field(flags: int = 0) -> FieldType:
+    return FieldType(TypeCode.LONGLONG, flags=flags, flen=20)
+
+
+def new_uint_field(flags: int = 0) -> FieldType:
+    return FieldType(TypeCode.LONGLONG, flags=flags | Flag.UNSIGNED, flen=20)
+
+
+def new_double_field(flags: int = 0) -> FieldType:
+    return FieldType(TypeCode.DOUBLE, flags=flags, flen=22)
+
+
+def new_decimal_field(flen: int = 15, frac: int = 2, flags: int = 0) -> FieldType:
+    return FieldType(TypeCode.NEWDECIMAL, flags=flags, flen=flen, frac=frac)
+
+
+def new_string_field(flen: int = 255, flags: int = 0) -> FieldType:
+    return FieldType(TypeCode.VARCHAR, flags=flags, flen=flen)
+
+
+def new_datetime_field(flags: int = 0) -> FieldType:
+    return FieldType(TypeCode.DATETIME, flags=flags, flen=19)
+
+
+def new_date_field(flags: int = 0) -> FieldType:
+    return FieldType(TypeCode.DATE, flags=flags, flen=10)
+
+
+# ---------------------------------------------------------------------------
+# Decimal <-> scaled int64
+
+def decimal_to_scaled(v, frac: int) -> int:
+    """Encode a decimal value as unscaled int64 with `frac` fractional digits.
+
+    Replaces the reference's MyDecimal 9-digit-word representation
+    (types/mydecimal.go) with a single int64 lane for the device path.
+    Raises OverflowError outside int64 — callers fall back to host decimal.
+    """
+    if isinstance(v, float):
+        d = _pydec.Decimal(repr(v))
+    elif isinstance(v, _pydec.Decimal):
+        d = v
+    else:
+        d = _pydec.Decimal(str(v))
+    try:
+        q = d.scaleb(frac).quantize(_pydec.Decimal(1), rounding=_pydec.ROUND_HALF_UP)
+    except _pydec.InvalidOperation as e:
+        raise OverflowError(f"decimal {v} does not fit scaled int64 frac={frac}") from e
+    i = int(q)
+    if not (-(1 << 63) <= i < (1 << 63)):
+        raise OverflowError(f"decimal {v} does not fit scaled int64 frac={frac}")
+    return i
+
+
+def scaled_to_decimal(i: int, frac: int) -> _pydec.Decimal:
+    return _pydec.Decimal(int(i)).scaleb(-frac)
+
+
+# ---------------------------------------------------------------------------
+# Time <-> int64 microseconds (ref: types/time.go packs into a custom uint64;
+# we use unix-epoch micros so device arithmetic is plain int64 ops)
+
+_EPOCH = _dt.datetime(1970, 1, 1)
+
+
+def datetime_to_micros(dt: _dt.datetime) -> int:
+    # exact integer arithmetic — total_seconds() is float64 and corrupts µs
+    return (dt - _EPOCH) // _dt.timedelta(microseconds=1)
+
+
+def date_to_micros(d: _dt.date) -> int:
+    return (d - _EPOCH.date()).days * 86_400_000_000
+
+
+def micros_to_datetime(us: int) -> _dt.datetime:
+    return _EPOCH + _dt.timedelta(microseconds=int(us))
+
+
+def parse_datetime(s: str) -> int:
+    """Parse 'YYYY-MM-DD[ HH:MM:SS[.ffffff]]' to epoch micros."""
+    s = s.strip()
+    for fmt in ("%Y-%m-%d %H:%M:%S.%f", "%Y-%m-%d %H:%M:%S", "%Y-%m-%d"):
+        try:
+            return datetime_to_micros(_dt.datetime.strptime(s, fmt))
+        except ValueError:
+            continue
+    raise ValueError(f"invalid datetime literal: {s!r}")
+
+
+def format_datetime(us: int, tp: TypeCode = TypeCode.DATETIME) -> str:
+    dt = micros_to_datetime(us)
+    if tp == TypeCode.DATE:
+        return dt.strftime("%Y-%m-%d")
+    return dt.strftime("%Y-%m-%d %H:%M:%S")
