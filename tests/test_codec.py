@@ -1,0 +1,112 @@
+"""Codec order-preservation and roundtrip properties.
+Ref model: util/codec/*_test.go property tables."""
+
+import random
+
+import pytest
+
+from tidb_tpu import codec, tablecodec
+
+
+def test_int_roundtrip_and_order():
+    vals = [-(1 << 63), -12345, -1, 0, 1, 42, (1 << 63) - 1]
+    encs = [codec.encode_int(v) for v in vals]
+    assert encs == sorted(encs)
+    for v, e in zip(vals, encs):
+        assert codec.decode_int(e)[0] == v
+
+
+def test_float_order():
+    vals = [float("-inf"), -1e300, -2.5, -0.0, 0.0, 1e-300, 3.14, 1e300,
+            float("inf")]
+    encs = [codec.encode_float(v) for v in vals]
+    assert encs == sorted(encs)
+    for v, e in zip(vals, encs):
+        assert codec.decode_float(e)[0] == v
+
+
+def test_bytes_roundtrip_order():
+    rng = random.Random(42)
+    vals = [b"", b"a", b"ab", b"abcdefgh", b"abcdefghi", b"abcdefgh" * 3,
+            bytes(rng.randrange(256) for _ in range(17))]
+    for v in vals:
+        enc = codec.encode_bytes(v)
+        dec, off = codec.decode_bytes(enc)
+        assert dec == v and off == len(enc)
+    svals = sorted(vals)
+    sencs = sorted(codec.encode_bytes(v) for v in vals)
+    assert [codec.decode_bytes(e)[0] for e in sencs] == svals
+
+
+def test_bytes_prefix_order():
+    # "abc" < "abcd" must hold through encoding (the stuffing subtlety)
+    assert codec.encode_bytes(b"abc") < codec.encode_bytes(b"abcd")
+    assert codec.encode_bytes(b"abcdefgh") < codec.encode_bytes(b"abcdefgh\x00")
+
+
+def test_mixed_key_order():
+    rows = [(1, "apple"), (1, "banana"), (2, "a"), (10, ""), (10, "z")]
+    encs = [codec.encode_key(list(r)) for r in rows]
+    assert encs == sorted(encs)
+    for r, e in zip(rows, encs):
+        dec = codec.decode_key(e)
+        assert dec[0] == r[0] and dec[1].decode() == r[1]
+
+
+def test_null_sorts_first_max_sorts_last():
+    e_null = codec.encode_datum(None)
+    e_int = codec.encode_datum(-(1 << 63))
+    assert e_null < e_int
+    assert codec.key_max() > codec.encode_datum((1 << 63) - 1)
+
+
+def test_desc_encoding_reverses_order():
+    vals = [1, 5, 100]
+    encs = [codec.encode_datum(v, desc=True) for v in vals]
+    assert encs == sorted(encs, reverse=True)
+    for v, e in zip(vals, encs):
+        assert codec.decode_one(e, 0, desc=True)[0] == v
+
+
+def test_desc_bytes():
+    vals = [b"a", b"ab", b"b"]
+    encs = [codec.encode_datum(v, desc=True) for v in vals]
+    assert encs == sorted(encs, reverse=True)
+    for v, e in zip(vals, encs):
+        assert codec.decode_one(e, 0, desc=True)[0] == v
+
+
+def test_decimal_datum():
+    enc = codec.encode_datum((2, 1234))
+    assert codec.decode_one(enc)[0] == (2, 1234)
+    # order within same frac
+    assert codec.encode_datum((2, -500)) < codec.encode_datum((2, 1234))
+
+
+def test_record_key_roundtrip_order():
+    k1 = tablecodec.record_key(1, 5)
+    k2 = tablecodec.record_key(1, 100)
+    k3 = tablecodec.record_key(2, 0)
+    assert k1 < k2 < k3
+    assert tablecodec.decode_record_key(k2) == (1, 100)
+    lo, hi = tablecodec.table_prefix_range(1)
+    assert lo < k1 < k2 < hi < k3
+
+
+def test_index_key_roundtrip():
+    k = tablecodec.index_key(7, 2, [42, "xy"], handle=9)
+    tid, iid, rest = tablecodec.decode_index_key(k)
+    assert (tid, iid) == (7, 2)
+    vals = codec.decode_key(rest)
+    assert vals[0] == 42 and vals[1] == b"xy" and vals[2] == 9
+
+
+def test_row_value_roundtrip():
+    row = tablecodec.encode_row([1, 2, 3, 4], [10, "hello", 2.5, None])
+    d = tablecodec.decode_row(row)
+    assert d[1] == 10 and d[2] == b"hello" and d[3] == 2.5 and d[4] is None
+
+
+def test_key_next():
+    k = codec.encode_key([5])
+    assert codec.encode_key([5]) < codec.key_next(k) < codec.encode_key([6])
