@@ -14,3 +14,10 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The axon TPU plugin (sitecustomize) force-sets jax_platforms="axon,cpu" in
+# the CONFIG, overriding the env var — so tests would try to reach the real
+# chip (and hang if the tunnel is down). Pin the config itself to cpu.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -110,3 +110,42 @@ def test_row_value_roundtrip():
 def test_key_next():
     k = codec.encode_key([5])
     assert codec.encode_key([5]) < codec.key_next(k) < codec.encode_key([6])
+
+
+def test_fuzz_composite_key_order():
+    """2000 random (int, bytes, float) keys: encoded order == value order."""
+    rng = random.Random(7)
+
+    def rand_key():
+        i = rng.randrange(-100, 100)
+        s = bytes(rng.randrange(97, 123) for _ in range(rng.randrange(0, 12)))
+        f = rng.uniform(-1000, 1000)
+        return (i, s, f)
+
+    keys = [rand_key() for _ in range(2000)]
+    encs = [codec.encode_key(list(k)) for k in keys]
+    assert [k for _, k in sorted(zip(encs, keys))] == sorted(keys)
+    for k, e in zip(keys, encs):
+        assert tuple(codec.decode_key(e)) == k
+
+
+def test_null_desc_sorts_last():
+    e_null = codec.encode_datum(None, desc=True)
+    e_big = codec.encode_datum(1 << 62, desc=True)
+    e_small = codec.encode_datum(-5, desc=True)
+    assert e_big < e_small < e_null  # desc: big first, NULL last
+    assert codec.decode_one(e_null, 0, desc=True)[0] is None
+    assert e_null < codec.key_max()
+
+
+def test_uint_upper_half():
+    big = (1 << 63) + 7
+    e = codec.encode_datum(big)
+    assert codec.decode_one(e)[0] == big
+    assert codec.encode_datum((1 << 63) - 1) < e  # int64 max < uint upper half
+
+
+def test_prefix_next_all_ff_raises():
+    with pytest.raises(ValueError):
+        codec.prefix_next(b"\xff\xff")
+    assert codec.prefix_next(b"ab\xff") == b"ac"

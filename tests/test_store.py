@@ -1,0 +1,330 @@
+"""Storage layer tests: MVCC semantics, 2PC, region retries, lock resolution.
+
+Ref models: store/tikv/2pc_test.go, isolation_test.go, lock_test.go,
+scan_test.go, region_cache_test.go, 2pc_fail_test.go (failpoints).
+"""
+
+import threading
+
+import pytest
+
+from tidb_tpu.kv import (IsolationLevel, KeyLockedError, KVError, Mutation,
+                         MutationOp, TxnAbortedError, UndeterminedError,
+                         WriteConflictError)
+from tidb_tpu.mockstore import MVCCStore, TimeoutError_
+from tidb_tpu.store import new_mock_storage
+from tidb_tpu.store.backoff import Backoffer
+
+
+def fastbo(ms=5000):
+    return Backoffer(ms, sleep_fn=lambda s: None)
+
+
+@pytest.fixture
+def storage():
+    s = new_mock_storage()
+    s.async_commit_secondaries = False  # deterministic tests
+    # no real sleeps in tests
+    yield s
+    s.close()
+
+
+# -- raw MVCC engine ---------------------------------------------------------
+
+class TestMVCC:
+    def put(self, store, key, val, ts, commit_ts):
+        store.prewrite([Mutation(MutationOp.PUT, key, val)], key, ts)
+        store.commit([key], ts, commit_ts)
+
+    def test_snapshot_versions(self):
+        s = MVCCStore()
+        self.put(s, b"k", b"v1", 10, 11)
+        self.put(s, b"k", b"v2", 20, 21)
+        assert s.get(b"k", 15) == b"v1"
+        assert s.get(b"k", 21) == b"v2"
+        assert s.get(b"k", 5) is None
+
+    def test_delete_visibility(self):
+        s = MVCCStore()
+        self.put(s, b"k", b"v", 10, 11)
+        s.prewrite([Mutation(MutationOp.DELETE, b"k")], b"k", 20)
+        s.commit([b"k"], 20, 21)
+        assert s.get(b"k", 15) == b"v"
+        assert s.get(b"k", 25) is None
+
+    def test_lock_blocks_si_read_not_rc(self):
+        s = MVCCStore()
+        self.put(s, b"k", b"v", 10, 11)
+        s.prewrite([Mutation(MutationOp.PUT, b"k", b"new")], b"k", 20)
+        with pytest.raises(KeyLockedError):
+            s.get(b"k", 25)
+        assert s.get(b"k", 25, IsolationLevel.RC) == b"v"
+        # reads below the lock ts are not blocked
+        assert s.get(b"k", 15) == b"v"
+
+    def test_write_conflict(self):
+        s = MVCCStore()
+        self.put(s, b"k", b"v", 10, 30)
+        with pytest.raises(WriteConflictError):
+            s.prewrite([Mutation(MutationOp.PUT, b"k", b"x")], b"k", 20)
+
+    def test_rollback_then_prewrite_aborts(self):
+        s = MVCCStore()
+        s.rollback([b"k"], 20)
+        with pytest.raises(TxnAbortedError):
+            s.prewrite([Mutation(MutationOp.PUT, b"k", b"x")], b"k", 20)
+
+    def test_commit_after_rollback_fails(self):
+        s = MVCCStore()
+        s.prewrite([Mutation(MutationOp.PUT, b"k", b"x")], b"k", 20)
+        s.rollback([b"k"], 20)
+        with pytest.raises(TxnAbortedError):
+            s.commit([b"k"], 20, 21)
+
+    def test_commit_idempotent(self):
+        s = MVCCStore()
+        self.put(s, b"k", b"v", 10, 11)
+        s.commit([b"k"], 10, 11)  # no error
+
+    def test_cleanup_expired_rolls_back(self):
+        s = MVCCStore()
+        s.prewrite([Mutation(MutationOp.PUT, b"k", b"x")], b"k", 20,
+                   ttl_ms=100)
+        # current_ts far in the future (physical ms domain)
+        far = (1 << 40) << 18
+        assert s.cleanup(b"k", 20, far) == 0
+        with pytest.raises(TxnAbortedError):
+            s.commit([b"k"], 20, 21)
+
+    def test_cleanup_alive_lock_raises(self):
+        s = MVCCStore()
+        ts = (1000 << 18)
+        s.prewrite([Mutation(MutationOp.PUT, b"k", b"x")], b"k", ts,
+                   ttl_ms=10_000_000)
+        with pytest.raises(KeyLockedError):
+            s.cleanup(b"k", ts, ts + 1)
+
+    def test_cleanup_committed_returns_commit_ts(self):
+        s = MVCCStore()
+        self.put(s, b"k", b"v", 10, 11)
+        assert s.cleanup(b"k", 10, 99 << 18) == 11
+
+    def test_resolve_lock_commit_and_rollback(self):
+        s = MVCCStore()
+        s.prewrite([Mutation(MutationOp.PUT, b"a", b"1"),
+                    Mutation(MutationOp.PUT, b"b", b"2")], b"a", 20)
+        s.resolve_lock(b"", b"", 20, 25)
+        assert s.get(b"a", 30) == b"1"
+        assert s.get(b"b", 30) == b"2"
+
+    def test_scan_skips_deleted(self):
+        s = MVCCStore()
+        for i, k in enumerate([b"a", b"b", b"c"]):
+            self.put(s, k, b"v" + k, 10 + i * 10, 11 + i * 10)
+        s.prewrite([Mutation(MutationOp.DELETE, b"b")], b"b", 50)
+        s.commit([b"b"], 50, 51)
+        assert [k for k, _ in s.scan(b"", b"", 0, 100)] == [b"a", b"c"]
+        assert [k for k, _ in s.scan(b"a", b"c", 0, 100)] == [b"a"]
+
+    def test_gc_prunes_old_versions(self):
+        s = MVCCStore()
+        for i in range(5):
+            self.put(s, b"k", b"v%d" % i, 10 + i * 10, 11 + i * 10)
+        pruned = s.gc(45)
+        assert pruned == 3  # 11, 21, 31 pruned; 41 is newest <= safepoint
+        assert s.get(b"k", 100) == b"v4"
+        assert s.get(b"k", 45) == b"v3"  # newest visible at safepoint survives
+
+
+# -- txn through storage (unionstore + 2PC) ----------------------------------
+
+class TestTxn:
+    def test_basic_commit_and_read(self, storage):
+        txn = storage.begin()
+        txn.set(b"ta", b"1")
+        txn.set(b"tb", b"2")
+        txn.commit()
+        txn2 = storage.begin()
+        assert txn2.get(b"ta") == b"1"
+        assert txn2.get(b"tb") == b"2"
+
+    def test_read_own_writes_and_tombstone(self, storage):
+        t1 = storage.begin()
+        t1.set(b"k", b"v")
+        t1.commit()
+        t = storage.begin()
+        assert t.get(b"k") == b"v"
+        t.delete(b"k")
+        assert t.get(b"k") is None
+        t.set(b"k", b"v2")
+        assert t.get(b"k") == b"v2"
+        t.rollback()
+        assert storage.begin().get(b"k") == b"v"
+
+    def test_snapshot_isolation(self, storage):
+        t0 = storage.begin()
+        t0.set(b"k", b"old")
+        t0.commit()
+        reader = storage.begin()
+        writer = storage.begin()
+        writer.set(b"k", b"new")
+        writer.commit()
+        assert reader.get(b"k") == b"old"          # SI: pre-commit view
+        assert storage.begin().get(b"k") == b"new"
+
+    def test_write_conflict_surfaces(self, storage):
+        t0 = storage.begin()
+        t0.set(b"k", b"0")
+        t0.commit()
+        t1 = storage.begin()
+        t2 = storage.begin()
+        t1.set(b"k", b"1")
+        t2.set(b"k", b"2")
+        t2.commit()
+        with pytest.raises(KVError):
+            t1.commit()
+        assert storage.begin().get(b"k") == b"2"
+
+    def test_iter_union(self, storage):
+        t0 = storage.begin()
+        for k in (b"a", b"b", b"d"):
+            t0.set(k, b"s" + k)
+        t0.commit()
+        t = storage.begin()
+        t.set(b"c", b"bc")       # buffer-only
+        t.delete(b"b")           # shadow delete
+        t.set(b"a", b"ba")       # shadow overwrite
+        got = list(t.iter_range(b"a", b"e"))
+        assert got == [(b"a", b"ba"), (b"c", b"bc"), (b"d", b"sd")]
+
+
+# -- distributed behavior: regions, retries, faults --------------------------
+
+class TestDistributed:
+    def test_multi_region_txn_and_scan(self, storage):
+        # write across a split, then split again mid-life
+        storage.cluster.split(b"m")
+        t = storage.begin()
+        for k in (b"a", b"k", b"n", b"z"):
+            t.set(k, b"v" + k)
+        t.commit()
+        assert len(storage.cluster.all_regions()) == 2
+        snap = storage.snapshot(storage.current_ts())
+        got = [k for k, _ in snap.iter_range(b"", None)]
+        assert got == [b"a", b"k", b"n", b"z"]
+
+    def test_stale_region_cache_retries(self, storage):
+        t = storage.begin()
+        for k in (b"a", b"p", b"z"):
+            t.set(k, b"1")
+        t.commit()
+        # warm the cache, then split behind its back
+        storage.region_cache.locate(b"p")
+        storage.cluster.split(b"m")
+        storage.cluster.split(b"t")
+        # reads must transparently recover from EpochNotMatch
+        snap = storage.snapshot(storage.current_ts())
+        snap_vals = snap.batch_get([b"a", b"p", b"z"])
+        assert len(snap_vals) == 3
+        # writes too
+        t2 = storage.begin()
+        t2.set(b"a", b"2")
+        t2.set(b"z", b"2")
+        t2.commit()
+        assert storage.begin().get(b"z") == b"2"
+
+    def test_leader_change_retry(self, storage):
+        sid2 = storage.cluster.add_store()
+        t = storage.begin()
+        t.set(b"k", b"v")
+        t.commit()
+        region = storage.cluster.region_by_key(b"k")
+        storage.region_cache.locate(b"k")  # cache current leader
+        storage.cluster.change_leader(region.id, sid2)
+        assert storage.begin().get(b"k") == b"v"  # NotLeader -> follow
+
+    def test_abandoned_lock_resolved_by_reader(self, storage):
+        # writer prewrites but never commits (crash): reader must roll it
+        # back via the resolver once TTL expires
+        t0 = storage.begin()
+        t0.set(b"k", b"committed")
+        t0.commit()
+        start_ts = storage.current_ts()
+        storage.engine.prewrite(
+            [Mutation(MutationOp.PUT, b"k", b"orphan")], b"k", start_ts,
+            ttl_ms=0)  # instantly expired
+        assert storage.begin().get(b"k") == b"committed"
+        # orphan txn is gone: its commit must now fail
+        with pytest.raises(TxnAbortedError):
+            storage.engine.commit([b"k"], start_ts, start_ts + 1)
+
+    def test_committed_primary_rolls_forward(self, storage):
+        # primary committed, secondary lock left behind (async commit death)
+        t0 = storage.begin()
+        t0.set(b"p", b"0")
+        t0.set(b"s", b"0")
+        t0.commit()
+        start_ts = storage.current_ts()
+        storage.engine.prewrite(
+            [Mutation(MutationOp.PUT, b"p", b"1"),
+             Mutation(MutationOp.PUT, b"s", b"1")], b"p", start_ts, ttl_ms=0)
+        commit_ts = storage.current_ts()
+        storage.engine.commit([b"p"], start_ts, commit_ts)  # primary only
+        # reader hits the stale lock on s -> resolver sees committed primary
+        # -> rolls forward; reads the new value
+        assert storage.begin().get(b"s") == b"1"
+
+    def test_server_busy_then_recover(self, storage):
+        t = storage.begin()
+        t.set(b"k", b"v")
+        t.commit()
+        calls = {"n": 0}
+
+        def inject(cmd, ctx):
+            if cmd == "Get" and calls["n"] < 2:
+                calls["n"] += 1
+                from tidb_tpu.kv import ServerBusyError
+                raise ServerBusyError("busy")
+
+        storage.shim.inject = inject
+        # patch sleeps out of the snapshot's backoffers via short budget
+        snap = storage.snapshot(storage.current_ts())
+        assert snap.get(b"k") == b"v"
+        assert calls["n"] == 2
+
+    def test_commit_timeout_undetermined(self, storage):
+        t = storage.begin()
+        t.set(b"k", b"v")
+
+        def inject(cmd, ctx):
+            if cmd == "Commit":
+                raise TimeoutError_("network timeout")
+
+        storage.shim.inject = inject
+        with pytest.raises(UndeterminedError):
+            t.commit()
+
+    def test_concurrent_writers_one_wins(self, storage):
+        t0 = storage.begin()
+        t0.set(b"cnt", b"0")
+        t0.commit()
+        results = []
+
+        def worker(i):
+            try:
+                t = storage.begin()
+                t.set(b"cnt", b"%d" % i)
+                t.commit()
+                results.append(("ok", i))
+            except KVError:
+                results.append(("err", i))
+
+        ts = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+        for th in ts:
+            th.start()
+        for th in ts:
+            th.join()
+        oks = [r for r in results if r[0] == "ok"]
+        assert len(oks) >= 1
+        final = storage.begin().get(b"cnt")
+        assert final in {b"%d" % i for _, i in oks}

@@ -39,6 +39,7 @@ INT_FLAG = 0x03
 UINT_FLAG = 0x04
 FLOAT_FLAG = 0x05
 DECIMAL_FLAG = 0x06
+NIL_DESC_FLAG = 0xFE  # NULL under DESC order: sorts after every value
 MAX_FLAG = 0xFF
 
 _SIGN_MASK = 0x8000000000000000
@@ -163,11 +164,17 @@ def encode_datum(v, desc: bool = False) -> bytes:
     (frac, scaled) tuple -> DECIMAL. Datetimes arrive as int micros (INT).
     """
     if v is None:
-        raw = bytes([NIL_FLAG])
+        # DESC NULL gets its own high flag so it sorts after all values
+        return bytes([NIL_DESC_FLAG if desc else NIL_FLAG])
     elif isinstance(v, bool):
         raw = bytes([INT_FLAG]) + encode_int(int(v))
     elif isinstance(v, int):
-        raw = bytes([INT_FLAG]) + encode_int(v)
+        if v >= 1 << 63:
+            # unsigned BIGINT upper half: UINT flag sorts after all INTs,
+            # keeping total order correct for unsigned columns
+            raw = bytes([UINT_FLAG]) + encode_uint(v)
+        else:
+            raw = bytes([INT_FLAG]) + encode_int(v)
     elif isinstance(v, float):
         raw = bytes([FLOAT_FLAG]) + encode_float(v)
     elif isinstance(v, str):
@@ -200,7 +207,7 @@ def decode_one(b: bytes, off: int = 0, desc: bool = False):
             raise ValueError("truncated 8-byte datum")
         return bytes(0xFF - x for x in b[off:off + 8])
 
-    if flag == NIL_FLAG:
+    if flag == NIL_FLAG or flag == NIL_DESC_FLAG:
         return None, off
     if flag == MAX_FLAG:
         raise ValueError("MAX flag is not decodable")
@@ -260,10 +267,11 @@ def key_next(key: bytes) -> bytes:
 
 def prefix_next(prefix: bytes) -> bytes:
     """Smallest key strictly greater than every key starting with `prefix`
-    (increment with carry; all-0xFF prefixes fall back to append)."""
+    (increment with carry). Raises for all-0xFF prefixes: no strict upper
+    bound exists; callers must treat that range as unbounded."""
     b = bytearray(prefix)
     for i in range(len(b) - 1, -1, -1):
         if b[i] != 0xFF:
             b[i] += 1
             return bytes(b[:i + 1])
-    return prefix + b"\xff"
+    raise ValueError("all-0xFF prefix has no strict upper bound")

@@ -1,0 +1,6 @@
+from tidb_tpu.mockstore.cluster import Cluster, Region, Store
+from tidb_tpu.mockstore.mvcc import MVCCStore, WriteType
+from tidb_tpu.mockstore.rpc import RegionCtx, RPCShim, TimeoutError_
+
+__all__ = ["Cluster", "Region", "Store", "MVCCStore", "WriteType",
+           "RegionCtx", "RPCShim", "TimeoutError_"]

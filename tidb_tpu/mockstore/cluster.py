@@ -1,0 +1,161 @@
+"""Mock cluster topology: stores, regions, split/merge, leader moves.
+
+Reference: /root/reference/store/tikv/mocktikv/cluster.go:38,231-308 —
+`Cluster` simulates region topology with Bootstrap/AddStore/Split so
+distributed client behavior (routing, epoch retries, fan-out) is testable
+on one host. Also plays the PD role: region lookup by key + TSO allocation
+(ref: mocktikv/pd.go).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field, replace
+
+from sortedcontainers import SortedDict
+
+from tidb_tpu import tablecodec
+
+__all__ = ["Region", "Store", "Cluster"]
+
+
+@dataclass(frozen=True)
+class Region:
+    id: int
+    start: bytes          # inclusive; b"" = -inf
+    end: bytes            # exclusive; b"" = +inf
+    version: int          # bumped on split/merge (region epoch)
+    conf_ver: int         # bumped on peer changes
+    leader_store: int
+    peer_stores: tuple[int, ...]
+
+    def contains(self, key: bytes) -> bool:
+        return self.start <= key and (not self.end or key < self.end)
+
+
+@dataclass
+class Store:
+    id: int
+    addr: str
+    labels: dict = field(default_factory=dict)
+    dropped: bool = False
+
+
+class Cluster:
+    """Topology + TSO. Thread-safe."""
+
+    def __init__(self):
+        self._mu = threading.RLock()
+        self._id = 0
+        self.stores: dict[int, Store] = {}
+        # regions keyed by start key for binary search routing
+        self._regions: SortedDict[bytes, Region] = SortedDict()
+        self._tso_physical = 0
+        self._tso_logical = 0
+
+    # -- ids / tso -----------------------------------------------------------
+
+    def alloc_id(self) -> int:
+        with self._mu:
+            self._id += 1
+            return self._id
+
+    def tso(self) -> int:
+        """Hybrid timestamp: physical ms << 18 | logical.
+        Ref: oracle/oracles/pd.go; mocktikv/pd.go GetTS."""
+        with self._mu:
+            ms = int(time.time() * 1000)
+            if ms > self._tso_physical:
+                self._tso_physical = ms
+                self._tso_logical = 0
+            self._tso_logical += 1
+            return (self._tso_physical << 18) | self._tso_logical
+
+    # -- bootstrap / topology ------------------------------------------------
+
+    def bootstrap(self, num_stores: int = 1) -> None:
+        with self._mu:
+            for _ in range(num_stores):
+                sid = self.alloc_id()
+                self.stores[sid] = Store(sid, f"store{sid}")
+            store_ids = tuple(self.stores)
+            rid = self.alloc_id()
+            self._regions[b""] = Region(rid, b"", b"", 1, 1,
+                                        store_ids[0], store_ids)
+
+    def add_store(self) -> int:
+        with self._mu:
+            sid = self.alloc_id()
+            self.stores[sid] = Store(sid, f"store{sid}")
+            return sid
+
+    # -- routing (the PD role) ----------------------------------------------
+
+    def region_by_key(self, key: bytes) -> Region:
+        with self._mu:
+            idx = self._regions.bisect_right(key) - 1
+            start = self._regions.keys()[idx]
+            return self._regions[start]
+
+    def region_by_id(self, rid: int) -> Region | None:
+        with self._mu:
+            for r in self._regions.values():
+                if r.id == rid:
+                    return r
+            return None
+
+    def all_regions(self) -> list[Region]:
+        with self._mu:
+            return list(self._regions.values())
+
+    # -- mutation ------------------------------------------------------------
+
+    def split(self, key: bytes) -> tuple[Region, Region]:
+        """Split the region containing `key` at `key`; bumps epoch of both
+        halves. Ref: cluster.go Split."""
+        with self._mu:
+            old = self.region_by_key(key)
+            if old.start == key:
+                raise ValueError("split at region start")
+            left = replace(old, end=key, version=old.version + 1)
+            right = Region(self.alloc_id(), key, old.end, old.version + 1,
+                           old.conf_ver, old.leader_store, old.peer_stores)
+            self._regions[old.start] = left
+            self._regions[key] = right
+            return left, right
+
+    def split_table(self, table_id: int, count: int,
+                    max_handle: int = 1 << 20) -> None:
+        """Split a table's record range into `count` regions at evenly spaced
+        handles in [0, max_handle). Ref: cluster.go SplitTable."""
+        if count <= 1:
+            return
+        span = max_handle // count
+        for i in range(1, count):
+            self.split(tablecodec.record_key(table_id, span * i))
+
+    def split_keys(self, keys: list[bytes]) -> None:
+        for k in keys:
+            self.split(k)
+
+    def merge(self, left_start: bytes) -> None:
+        """Merge the region starting at left_start with its right neighbor."""
+        with self._mu:
+            left = self._regions[left_start]
+            if not left.end:
+                raise ValueError("no right neighbor")
+            right = self._regions[left.end]
+            merged = replace(left, end=right.end,
+                             version=max(left.version, right.version) + 1)
+            del self._regions[left.end]
+            self._regions[left_start] = merged
+
+    def change_leader(self, region_id: int, store_id: int) -> None:
+        with self._mu:
+            for start, r in self._regions.items():
+                if r.id == region_id:
+                    self._regions[start] = replace(
+                        r, leader_store=store_id, conf_ver=r.conf_ver + 1)
+                    return
+            raise ValueError(f"no region {region_id}")

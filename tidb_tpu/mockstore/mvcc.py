@@ -1,0 +1,301 @@
+"""In-process MVCC store with Percolator transaction primitives.
+
+Reference: /root/reference/store/tikv/mocktikv/mvcc.go:418-429 (MVCCStore
+iface: Get/Scan/BatchGet/Prewrite/Commit/Rollback/Cleanup/ScanLock/
+ResolveLock) and mvcc_leveldb.go (the engine). This is the spec for what a
+real storage node must do; here it is one python object guarded by a lock,
+so a mock cluster can host many "regions" over one engine hermetically
+(SURVEY.md §4: the single highest-leverage test artifact).
+
+Per key, state is:
+    lock:   at most one {primary, start_ts, ttl, op, value}
+    writes: newest-first list of (commit_ts, start_ts, WriteType)
+    data:   {start_ts: value} for committed Puts
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Optional
+
+from sortedcontainers import SortedDict
+
+from tidb_tpu.kv import (IsolationLevel, KeyLockedError, KVError, LockInfo,
+                         Mutation, MutationOp, TxnAbortedError,
+                         WriteConflictError)
+
+__all__ = ["MVCCStore", "WriteType", "physical_ms"]
+
+
+class WriteType(Enum):
+    PUT = "put"
+    DELETE = "delete"
+    ROLLBACK = "rollback"
+    LOCK = "lock"
+
+
+@dataclass
+class _Lock:
+    primary: bytes
+    start_ts: int
+    ttl_ms: int
+    op: MutationOp
+    value: bytes
+
+    def info(self, key: bytes) -> LockInfo:
+        return LockInfo(self.primary, self.start_ts, key, self.ttl_ms)
+
+
+@dataclass
+class _Entry:
+    lock: Optional[_Lock] = None
+    writes: list = field(default_factory=list)   # [(commit_ts, start_ts, WriteType)] newest first
+    data: dict = field(default_factory=dict)     # start_ts -> value
+
+
+def physical_ms(ts: int) -> int:
+    """Hybrid timestamp physical part. Ref: oracle/oracle.go:35
+    (ts = physical_ms << 18 | logical)."""
+    return ts >> 18
+
+
+class MVCCStore:
+    """Thread-safe Percolator MVCC engine over sorted keys."""
+
+    def __init__(self):
+        self._entries: SortedDict[bytes, _Entry] = SortedDict()
+        self._mu = threading.RLock()
+
+    # -- internal ------------------------------------------------------------
+
+    def _entry(self, key: bytes) -> _Entry:
+        e = self._entries.get(key)
+        if e is None:
+            e = _Entry()
+            self._entries[key] = e
+        return e
+
+    def _check_lock(self, key: bytes, e: _Entry, ts: int,
+                    isolation: IsolationLevel) -> None:
+        """A read at `ts` is blocked by a lock from an older txn (SI).
+        RC reads skip locks. Ref: mvcc_leveldb.go getValue lock check."""
+        if e.lock is not None and isolation == IsolationLevel.SI:
+            if e.lock.start_ts <= ts and e.lock.op != MutationOp.LOCK:
+                raise KeyLockedError(e.lock.info(key))
+
+    def _read(self, key: bytes, e: _Entry, ts: int) -> Optional[bytes]:
+        for commit_ts, start_ts, wt in e.writes:
+            if commit_ts > ts:
+                continue
+            if wt == WriteType.PUT:
+                return e.data[start_ts]
+            if wt == WriteType.DELETE:
+                return None
+            # ROLLBACK/LOCK records: keep looking at older versions
+        return None
+
+    # -- reads ---------------------------------------------------------------
+
+    def get(self, key: bytes, ts: int,
+            isolation: IsolationLevel = IsolationLevel.SI) -> Optional[bytes]:
+        with self._mu:
+            e = self._entries.get(key)
+            if e is None:
+                return None
+            self._check_lock(key, e, ts, isolation)
+            return self._read(key, e, ts)
+
+    def batch_get(self, keys: list[bytes], ts: int,
+                  isolation: IsolationLevel = IsolationLevel.SI) -> dict[bytes, bytes]:
+        out = {}
+        with self._mu:
+            for k in keys:
+                e = self._entries.get(k)
+                if e is None:
+                    continue
+                self._check_lock(k, e, ts, isolation)
+                v = self._read(k, e, ts)
+                if v is not None:
+                    out[k] = v
+        return out
+
+    def scan(self, start: bytes, end: bytes, limit: int, ts: int,
+             isolation: IsolationLevel = IsolationLevel.SI,
+             desc: bool = False) -> list[tuple[bytes, bytes]]:
+        """First `limit` live (key, value) pairs in [start, end).
+        end=b"" means unbounded."""
+        out = []
+        with self._mu:
+            keys = self._entries.irange(start, end or None,
+                                        inclusive=(True, False), reverse=desc)
+            for k in keys:
+                e = self._entries[k]
+                self._check_lock(k, e, ts, isolation)
+                v = self._read(k, e, ts)
+                if v is not None:
+                    out.append((k, v))
+                    if limit and len(out) >= limit:
+                        break
+        return out
+
+    # -- percolator write protocol ------------------------------------------
+
+    def prewrite(self, mutations: list[Mutation], primary: bytes,
+                 start_ts: int, ttl_ms: int = 3000) -> None:
+        """All-or-nothing lock acquisition. Ref: mvcc_leveldb.go Prewrite."""
+        with self._mu:
+            for m in mutations:
+                e = self._entry(m.key)
+                if e.lock is not None:
+                    if e.lock.start_ts != start_ts:
+                        raise KeyLockedError(e.lock.info(m.key))
+                    continue  # idempotent re-prewrite by the same txn
+                if self._find_txn_write(e, start_ts) == WriteType.ROLLBACK:
+                    raise TxnAbortedError(f"txn {start_ts} already rolled back")
+                # conflict: newest real write committed at/after our start_ts
+                for commit_ts, _wts, wt in e.writes:
+                    if wt == WriteType.ROLLBACK:
+                        continue
+                    if commit_ts >= start_ts:
+                        raise WriteConflictError(m.key, start_ts, commit_ts)
+                    break
+            for m in mutations:
+                e = self._entry(m.key)
+                e.lock = _Lock(primary, start_ts, ttl_ms, m.op, m.value)
+
+    def commit(self, keys: list[bytes], start_ts: int, commit_ts: int) -> None:
+        """Ref: mvcc_leveldb.go Commit — idempotent for already-committed."""
+        with self._mu:
+            for k in keys:
+                e = self._entries.get(k)
+                if e is None or e.lock is None or e.lock.start_ts != start_ts:
+                    # lock gone: committed already, or rolled back?
+                    st = self._find_txn_write(e, start_ts) if e else None
+                    if st == WriteType.ROLLBACK or st is None:
+                        raise TxnAbortedError(
+                            f"commit of {start_ts} on {k!r}: lock missing")
+                    continue  # already committed: idempotent
+                self._commit_locked(k, e, start_ts, commit_ts)
+
+    def _commit_locked(self, key: bytes, e: _Entry, start_ts: int,
+                       commit_ts: int) -> None:
+        lock = e.lock
+        if lock.op == MutationOp.PUT:
+            e.data[start_ts] = lock.value
+            e.writes.insert(0, (commit_ts, start_ts, WriteType.PUT))
+        elif lock.op == MutationOp.DELETE:
+            e.writes.insert(0, (commit_ts, start_ts, WriteType.DELETE))
+        else:
+            e.writes.insert(0, (commit_ts, start_ts, WriteType.LOCK))
+        e.lock = None
+
+    def _find_txn_write(self, e: Optional[_Entry], start_ts: int):
+        if e is None:
+            return None
+        for commit_ts, wts, wt in e.writes:
+            if wts == start_ts:
+                return wt
+        return None
+
+    def rollback(self, keys: list[bytes], start_ts: int) -> None:
+        """Ref: mvcc_leveldb.go Rollback; errors if already committed."""
+        with self._mu:
+            for k in keys:
+                e = self._entry(k)
+                wt = self._find_txn_write(e, start_ts)
+                if wt is not None and wt != WriteType.ROLLBACK:
+                    raise KVError(f"txn {start_ts} already committed on {k!r}")
+                if e.lock is not None and e.lock.start_ts == start_ts:
+                    e.lock = None
+                if wt is None:
+                    # rollback record blocks a late prewrite from this txn
+                    e.writes.insert(0, (start_ts, start_ts, WriteType.ROLLBACK))
+
+    def cleanup(self, key: bytes, start_ts: int, current_ts: int = 0) -> int:
+        """Resolve a single (possibly dead) txn's lock on `key`.
+        Returns commit_ts if the txn turned out committed, else 0 after
+        rolling back. Raises KeyLockedError if the lock is still alive.
+        Ref: mvcc_leveldb.go Cleanup + lock_resolver.go getTxnStatus."""
+        with self._mu:
+            e = self._entry(key)
+            if e.lock is not None and e.lock.start_ts == start_ts:
+                if current_ts and physical_ms(current_ts) < \
+                        physical_ms(start_ts) + e.lock.ttl_ms:
+                    raise KeyLockedError(e.lock.info(key))
+                e.lock = None
+                e.writes.insert(0, (start_ts, start_ts, WriteType.ROLLBACK))
+                return 0
+            wt = self._find_txn_write(e, start_ts)
+            if wt == WriteType.ROLLBACK or wt is None:
+                if wt is None:
+                    e.writes.insert(0, (start_ts, start_ts, WriteType.ROLLBACK))
+                return 0
+            for commit_ts, wts, w in e.writes:
+                if wts == start_ts and w != WriteType.ROLLBACK:
+                    return commit_ts
+            return 0
+
+    def scan_lock(self, start: bytes, end: bytes, max_ts: int) -> list[LockInfo]:
+        out = []
+        with self._mu:
+            for k in self._entries.irange(start, end or None,
+                                          inclusive=(True, False)):
+                e = self._entries[k]
+                if e.lock is not None and e.lock.start_ts <= max_ts:
+                    out.append(e.lock.info(k))
+        return out
+
+    def resolve_lock(self, start: bytes, end: bytes, start_ts: int,
+                     commit_ts: int) -> None:
+        """Commit (commit_ts > 0) or roll back every lock of txn start_ts in
+        range. Ref: mvcc_leveldb.go ResolveLock."""
+        with self._mu:
+            for k in list(self._entries.irange(start, end or None,
+                                               inclusive=(True, False))):
+                e = self._entries[k]
+                if e.lock is not None and e.lock.start_ts == start_ts:
+                    if commit_ts > 0:
+                        self._commit_locked(k, e, start_ts, commit_ts)
+                    else:
+                        e.lock = None
+                        e.writes.insert(0, (start_ts, start_ts, WriteType.ROLLBACK))
+
+    # -- maintenance ---------------------------------------------------------
+
+    def delete_range(self, start: bytes, end: bytes) -> None:
+        with self._mu:
+            for k in list(self._entries.irange(start, end or None,
+                                               inclusive=(True, False))):
+                del self._entries[k]
+
+    def gc(self, safepoint_ts: int) -> int:
+        """Drop versions no snapshot >= safepoint can see. Returns #pruned.
+        Ref: gcworker/gc_worker.go doGC."""
+        pruned = 0
+        with self._mu:
+            for k in list(self._entries):
+                e = self._entries[k]
+                keep = []
+                seen_visible = False
+                for w in e.writes:
+                    commit_ts, start_ts, wt = w
+                    if commit_ts > safepoint_ts or not seen_visible:
+                        keep.append(w)
+                        if commit_ts <= safepoint_ts and wt in (
+                                WriteType.PUT, WriteType.DELETE):
+                            seen_visible = True
+                    else:
+                        if wt == WriteType.PUT:
+                            e.data.pop(start_ts, None)
+                        pruned += 1
+                e.writes = keep
+                if not e.writes and e.lock is None:
+                    del self._entries[k]
+        return pruned
+
+    def num_keys(self) -> int:
+        with self._mu:
+            return len(self._entries)

@@ -1,0 +1,148 @@
+"""RPC shim: the "network" between client and storage nodes.
+
+Reference: /root/reference/store/tikv/mocktikv/rpc.go:112-464 — every request
+carries a region context (id, epoch); the handler re-checks it against the
+cluster so the client's region-error retry paths (NotLeader, EpochNotMatch,
+ServerBusy) actually execute in tests. Failpoints (ref: rpc.go:465-521
+gofail sites rpcServerBusy/rpcCommitResult/rpcCommitTimeout) become the
+`inject` hook: tests set `shim.inject = fn(cmd, ctx)` to raise errors or
+simulate timeouts for specific commands.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from tidb_tpu.kv import (EpochNotMatchError, IsolationLevel, KVError,
+                         Mutation, NotLeaderError, RegionError,
+                         ServerBusyError)
+from tidb_tpu.mockstore.cluster import Cluster, Region
+from tidb_tpu.mockstore.mvcc import MVCCStore
+
+__all__ = ["RegionCtx", "RPCShim", "TimeoutError_"]
+
+
+class TimeoutError_(KVError):
+    """Simulated network timeout: the request may or may not have executed
+    (drives undetermined-commit handling, ref: 2pc.go:421-431)."""
+
+
+@dataclass
+class RegionCtx:
+    region_id: int
+    version: int
+    conf_ver: int
+    store_id: int  # the store the client believes is leader
+
+
+class RPCShim:
+    """Routes commands to the MVCC engine after simulating region checks."""
+
+    def __init__(self, cluster: Cluster, store: MVCCStore):
+        self.cluster = cluster
+        self.store = store
+        # test hook: fn(cmd: str, ctx: RegionCtx) -> None, may raise
+        self.inject: Optional[Callable[[str, RegionCtx], None]] = None
+        self._mu = threading.Lock()
+
+    # -- region checks -------------------------------------------------------
+
+    def _check(self, cmd: str, ctx: RegionCtx) -> Region:
+        if self.inject is not None:
+            self.inject(cmd, ctx)
+        region = self.cluster.region_by_id(ctx.region_id)
+        if region is None:
+            raise EpochNotMatchError(ctx.region_id)
+        if region.leader_store != ctx.store_id:
+            raise NotLeaderError(ctx.region_id, region.leader_store)
+        if region.version != ctx.version or region.conf_ver != ctx.conf_ver:
+            raise EpochNotMatchError(ctx.region_id)
+        return region
+
+    def _check_keys_in(self, region: Region, keys) -> None:
+        for k in keys:
+            if not region.contains(k):
+                raise EpochNotMatchError(region.id)
+
+    # -- commands (mirror tikvrpc CmdType set, tikvrpc.go:31-53) ------------
+
+    def kv_get(self, ctx: RegionCtx, key: bytes, ts: int,
+               isolation=IsolationLevel.SI):
+        r = self._check("Get", ctx)
+        self._check_keys_in(r, [key])
+        return self.store.get(key, ts, isolation)
+
+    def kv_batch_get(self, ctx: RegionCtx, keys: list[bytes], ts: int,
+                     isolation=IsolationLevel.SI):
+        r = self._check("BatchGet", ctx)
+        self._check_keys_in(r, keys)
+        return self.store.batch_get(keys, ts, isolation)
+
+    def kv_scan(self, ctx: RegionCtx, start: bytes, end: bytes, limit: int,
+                ts: int, isolation=IsolationLevel.SI, desc: bool = False):
+        r = self._check("Scan", ctx)
+        # clamp scan to region bounds
+        s = max(start, r.start)
+        e = r.end if not end else (min(end, r.end) if r.end else end)
+        return self.store.scan(s, e, limit, ts, isolation, desc)
+
+    def kv_prewrite(self, ctx: RegionCtx, mutations: list[Mutation],
+                    primary: bytes, start_ts: int, ttl_ms: int = 3000):
+        r = self._check("Prewrite", ctx)
+        self._check_keys_in(r, [m.key for m in mutations])
+        self.store.prewrite(mutations, primary, start_ts, ttl_ms)
+
+    def kv_commit(self, ctx: RegionCtx, keys: list[bytes], start_ts: int,
+                  commit_ts: int):
+        r = self._check("Commit", ctx)
+        self._check_keys_in(r, keys)
+        self.store.commit(keys, start_ts, commit_ts)
+
+    def kv_batch_rollback(self, ctx: RegionCtx, keys: list[bytes],
+                          start_ts: int):
+        r = self._check("BatchRollback", ctx)
+        self._check_keys_in(r, keys)
+        self.store.rollback(keys, start_ts)
+
+    def kv_cleanup(self, ctx: RegionCtx, key: bytes, start_ts: int,
+                   current_ts: int = 0):
+        r = self._check("Cleanup", ctx)
+        self._check_keys_in(r, [key])
+        return self.store.cleanup(key, start_ts, current_ts)
+
+    def kv_scan_lock(self, ctx: RegionCtx, max_ts: int):
+        r = self._check("ScanLock", ctx)
+        return self.store.scan_lock(r.start, r.end, max_ts)
+
+    def kv_resolve_lock(self, ctx: RegionCtx, start_ts: int, commit_ts: int):
+        r = self._check("ResolveLock", ctx)
+        self.store.resolve_lock(r.start, r.end, start_ts, commit_ts)
+
+    def kv_delete_range(self, ctx: RegionCtx, start: bytes, end: bytes):
+        r = self._check("DeleteRange", ctx)
+        self.store.delete_range(max(start, r.start),
+                                min(end, r.end) if r.end else end)
+
+    def kv_gc(self, ctx: RegionCtx, safepoint: int):
+        self._check("GC", ctx)
+        return self.store.gc(safepoint)
+
+    def split_region(self, ctx: RegionCtx, key: bytes):
+        self._check("SplitRegion", ctx)
+        return self.cluster.split(key)
+
+    def coprocessor(self, ctx: RegionCtx, req):
+        """Executes a pushed-down subplan against this region's data.
+        Handler installed by tidb_tpu.store.copr (set at storage build time
+        to avoid a module cycle)."""
+        r = self._check("Cop", ctx)
+        if self._cop_handler is None:
+            raise KVError("no coprocessor handler installed")
+        return self._cop_handler(r, req)
+
+    _cop_handler = None
+
+    def install_cop_handler(self, fn) -> None:
+        self._cop_handler = fn

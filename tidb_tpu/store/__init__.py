@@ -1,0 +1,3 @@
+from tidb_tpu.store.storage import MockStorage, new_mock_storage
+
+__all__ = ["MockStorage", "new_mock_storage"]

@@ -1,0 +1,94 @@
+"""Client-side region cache: key -> region routing with invalidation.
+
+Reference: /root/reference/store/tikv/region_cache.go:49,137,200,326 —
+sorted-key lookup, miss -> PD load, invalidation on region errors, leader
+switch on NotLeader, GroupKeysByRegion for 2PC batching.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+from sortedcontainers import SortedDict
+
+from tidb_tpu.kv import KVRange, NotLeaderError
+from tidb_tpu.mockstore.cluster import Cluster, Region
+from tidb_tpu.mockstore.rpc import RegionCtx
+
+__all__ = ["RegionCache", "KeyLocation"]
+
+
+@dataclass
+class KeyLocation:
+    region: Region
+    ctx: RegionCtx
+
+
+class RegionCache:
+    """Caches Region objects; the Cluster plays PD for cache misses."""
+
+    def __init__(self, pd: Cluster):
+        self.pd = pd
+        self._mu = threading.RLock()
+        self._by_start: SortedDict[bytes, Region] = SortedDict()
+        self._leaders: dict[int, int] = {}  # region_id -> learned leader store
+
+    def _ctx(self, r: Region) -> RegionCtx:
+        leader = self._leaders.get(r.id, r.leader_store)
+        return RegionCtx(r.id, r.version, r.conf_ver, leader)
+
+    def locate(self, key: bytes) -> KeyLocation:
+        with self._mu:
+            idx = self._by_start.bisect_right(key) - 1
+            if idx >= 0:
+                r = self._by_start.values()[idx]
+                if r.contains(key):
+                    return KeyLocation(r, self._ctx(r))
+            r = self.pd.region_by_key(key)  # "PD RPC"
+            self._by_start[r.start] = r
+            return KeyLocation(r, self._ctx(r))
+
+    def invalidate(self, region_id: int) -> None:
+        with self._mu:
+            for start, r in list(self._by_start.items()):
+                if r.id == region_id:
+                    del self._by_start[start]
+            self._leaders.pop(region_id, None)
+
+    def on_not_leader(self, err: NotLeaderError) -> None:
+        """Switch leader in place when the error names one, else invalidate.
+        Ref: region_cache.go UpdateLeader."""
+        with self._mu:
+            if err.leader_store is not None:
+                self._leaders[err.region_id] = err.leader_store
+            else:
+                self.invalidate(err.region_id)
+
+    def group_keys_by_region(self, keys: list[bytes]) -> dict[int, tuple[KeyLocation, list[bytes]]]:
+        """Ref: region_cache.go:200 GroupKeysByRegion."""
+        groups: dict[int, tuple[KeyLocation, list[bytes]]] = {}
+        for k in sorted(keys):
+            loc = self.locate(k)
+            if loc.region.id not in groups:
+                groups[loc.region.id] = (loc, [])
+            groups[loc.region.id][1].append(k)
+        return groups
+
+    def split_ranges_by_region(self, ranges: list[KVRange]
+                               ) -> list[tuple[KeyLocation, KVRange]]:
+        """Split [start, end) ranges along region boundaries, in key order.
+        Ref: store/tikv/coprocessor.go:263 buildCopTasks."""
+        out = []
+        for rg in ranges:
+            cur = rg.start
+            while True:
+                loc = self.locate(cur)
+                r_end = loc.region.end
+                if r_end and (not rg.end or r_end < rg.end):
+                    out.append((loc, KVRange(cur, r_end)))
+                    cur = r_end
+                else:
+                    out.append((loc, KVRange(cur, rg.end)))
+                    break
+        return out

@@ -1,0 +1,58 @@
+"""Storage facade: composes cluster, engine, rpc shim, caches, oracle.
+
+Reference: /root/reference/store/tikv/kv.go:138-157 (tikvStore composition)
+and test_util.go:122 NewMockTikvStore.
+"""
+
+from __future__ import annotations
+
+from tidb_tpu import kv
+from tidb_tpu.mockstore.cluster import Cluster
+from tidb_tpu.mockstore.mvcc import MVCCStore
+from tidb_tpu.mockstore.rpc import RPCShim
+from tidb_tpu.store.oracle import PDOracle
+from tidb_tpu.store.region_cache import RegionCache
+from tidb_tpu.store.txn import KVTxn, LockResolver, TxnSnapshot
+
+__all__ = ["MockStorage", "new_mock_storage"]
+
+
+class MockStorage(kv.Storage):
+    """In-process distributed-store simulation behind the kv.Storage API."""
+
+    def __init__(self, cluster: Cluster, engine: MVCCStore):
+        self.cluster = cluster
+        self.engine = engine
+        self.shim = RPCShim(cluster, engine)
+        self.region_cache = RegionCache(cluster)
+        self.oracle = PDOracle(cluster)
+        self.resolver = LockResolver(self.shim, self.region_cache, self.oracle)
+        self.async_commit_secondaries = True
+        self._client = None
+
+    def begin(self, start_ts: int | None = None) -> KVTxn:
+        return KVTxn(self, start_ts if start_ts is not None
+                     else self.oracle.get_timestamp())
+
+    def snapshot(self, ts: int) -> TxnSnapshot:
+        return TxnSnapshot(self.shim, self.region_cache, self.resolver, ts)
+
+    def current_ts(self) -> int:
+        return self.oracle.get_timestamp()
+
+    def client(self):
+        """Coprocessor client; installed by tidb_tpu.store.copr."""
+        if self._client is None:
+            from tidb_tpu.store.copr import CopClient
+            self._client = CopClient(self)
+        return self._client
+
+    def close(self) -> None:
+        self.oracle.close()
+
+
+def new_mock_storage(num_stores: int = 1) -> MockStorage:
+    """Hermetic store for tests (ref: NewMockTikvStore)."""
+    cluster = Cluster()
+    cluster.bootstrap(num_stores)
+    return MockStorage(cluster, MVCCStore())

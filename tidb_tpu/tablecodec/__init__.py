@@ -78,7 +78,7 @@ def encode_row(col_ids, values) -> bytes:
     """Row value: flat [colID, value, colID, value, ...] datum sequence.
     Ref: tablecodec.go EncodeRow (datum-pairs codec)."""
     flat = []
-    for cid, v in zip(col_ids, values):
+    for cid, v in zip(col_ids, values, strict=True):
         flat.append(cid)
         flat.append(v)
     return codec.encode_key(flat)
