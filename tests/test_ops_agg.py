@@ -1,0 +1,197 @@
+"""Device aggregation kernel tests: agree with a naive numpy/python oracle.
+
+Ref model: executor/aggregate_test.go + mocktikv/aggregate.go behavior.
+Runs on the CPU backend (conftest pins platforms) but the same XLA programs
+compile for TPU.
+"""
+
+import decimal
+import random
+from collections import defaultdict
+
+import numpy as np
+import pytest
+
+from tidb_tpu import sqltypes as st
+from tidb_tpu.chunk import Chunk
+from tidb_tpu.expression import AggDesc, AggFunc, Op, col, const, func
+from tidb_tpu.ops.hashagg import (CapacityError, HashAggKernel,
+                                  HashAggregator, ScalarAggKernel)
+
+INT = st.new_int_field()
+DBL = st.new_double_field()
+DEC2 = st.new_decimal_field(frac=2)
+STR = st.new_string_field()
+
+
+def oracle_agg(rows, key_fn, val_fn, agg):
+    groups = defaultdict(list)
+    for r in rows:
+        k = key_fn(r)
+        if k is not None:
+            groups[k].append(val_fn(r))
+    return groups
+
+
+def test_sum_count_by_int_key():
+    rng = random.Random(1)
+    rows = [(rng.randrange(5), rng.randrange(100)) for _ in range(3000)]
+    ch = Chunk.from_rows([INT, INT], rows)
+    k = HashAggKernel(None, [col(0, INT)],
+                      [AggDesc(AggFunc.SUM, col(1, INT)),
+                       AggDesc(AggFunc.COUNT, None)])
+    agg = HashAggregator(k.aggs)
+    agg.update(k(ch))
+    got = {key[0]: tuple(v) for key, v in agg.results()}
+    exp = defaultdict(lambda: [0, 0])
+    for a, b in rows:
+        exp[a][0] += b
+        exp[a][1] += 1
+    assert got == {k2: (v[0], v[1]) for k2, v in exp.items()}
+
+
+def test_filter_and_group_with_nulls():
+    rows = [(1, 10), (1, None), (2, 5), (None, 7), (2, 3), (1, 2)]
+    ch = Chunk.from_rows([INT, INT], rows)
+    # WHERE v >= 3
+    k = HashAggKernel(col(1, INT).ge(3), [col(0, INT)],
+                      [AggDesc(AggFunc.SUM, col(1, INT)),
+                       AggDesc(AggFunc.COUNT, None),
+                       AggDesc(AggFunc.MIN, col(1, INT)),
+                       AggDesc(AggFunc.MAX, col(1, INT))])
+    agg = HashAggregator(k.aggs)
+    agg.update(k(ch))
+    res = {key[0]: v for key, v in agg.results()}
+    assert res[1] == [10, 1, 10, 10]
+    assert res[2] == [8, 2, 3, 5]
+    assert res[None] == [7, 1, 7, 7]  # NULL is its own group
+    # row (1, None) dropped by filter; row (1,2) filtered out
+
+
+def test_string_group_key():
+    rows = [("aa", 1), ("bb", 2), ("aa", 3), (None, 4), ("cc", 5), ("bb", 6)]
+    ch = Chunk.from_rows([STR, INT], rows)
+    k = HashAggKernel(None, [col(0, STR)],
+                      [AggDesc(AggFunc.SUM, col(1, INT))])
+    agg = HashAggregator(k.aggs)
+    agg.update(k(ch))
+    res = {key[0]: v[0] for key, v in agg.results()}
+    assert res == {"aa": 4, "bb": 8, "cc": 5, None: 4}
+
+
+def test_multi_chunk_merge():
+    k = HashAggKernel(None, [col(0, INT)],
+                      [AggDesc(AggFunc.SUM, col(1, INT)),
+                       AggDesc(AggFunc.AVG, col(1, DBL)),
+                       AggDesc(AggFunc.MIN, col(1, INT))])
+    agg = HashAggregator(k.aggs)
+    all_rows = []
+    rng = random.Random(2)
+    for _ in range(4):
+        rows = [(rng.randrange(3), rng.randrange(1000)) for _ in range(500)]
+        all_rows += rows
+        agg.update(k(Chunk.from_rows([INT, INT], rows)))
+    res = {key[0]: v for key, v in agg.results()}
+    for g in range(3):
+        vals = [b for a, b in all_rows if a == g]
+        assert res[g][0] == sum(vals)
+        assert abs(res[g][1] - sum(vals) / len(vals)) < 1e-9
+        assert res[g][2] == min(vals)
+
+
+def test_decimal_sum_avg():
+    rows = [(1, decimal.Decimal("1.50")), (1, decimal.Decimal("2.25")),
+            (2, decimal.Decimal("-0.75")), (1, None)]
+    ch = Chunk.from_rows([INT, DEC2], rows)
+    aggs = [AggDesc(AggFunc.SUM, col(1, DEC2)),
+            AggDesc(AggFunc.AVG, col(1, DEC2))]
+    k = HashAggKernel(None, [col(0, INT)], aggs)
+    agg = HashAggregator(aggs)
+    agg.update(k(ch))
+    res = {key[0]: v for key, v in agg.results()}
+    assert res[1][0] == 375        # 3.75 @ frac2
+    # avg result frac = 2+4 = 6: 1.875 -> 1875000
+    assert aggs[1].result_ft.frac == 6
+    assert res[1][1] == 1_875_000
+    assert res[2][0] == -75
+
+
+def test_avg_sum_real():
+    rows = [(1, 1.5), (1, 2.5), (2, None)]
+    ch = Chunk.from_rows([INT, DBL], rows)
+    aggs = [AggDesc(AggFunc.SUM, col(1, DBL)),
+            AggDesc(AggFunc.AVG, col(1, DBL)),
+            AggDesc(AggFunc.COUNT, col(1, DBL))]
+    k = HashAggKernel(None, [col(0, INT)], aggs)
+    agg = HashAggregator(aggs)
+    agg.update(k(ch))
+    res = {key[0]: v for key, v in agg.results()}
+    assert res[1] == [4.0, 2.0, 2]
+    assert res[2] == [None, None, 0]  # all-null group
+
+
+def test_expression_group_key():
+    # GROUP BY a % 3
+    rows = [(i, i * 10) for i in range(100)]
+    ch = Chunk.from_rows([INT, INT], rows)
+    gexpr = func(Op.MOD, col(0, INT), const(3))
+    k = HashAggKernel(None, [gexpr], [AggDesc(AggFunc.COUNT, None)])
+    agg = HashAggregator(k.aggs)
+    agg.update(k(ch))
+    res = {key[0]: v[0] for key, v in agg.results()}
+    assert res == {0: 34, 1: 33, 2: 33}
+
+
+def test_scalar_agg():
+    rows = [(i, float(i)) for i in range(1000)]
+    ch = Chunk.from_rows([INT, DBL], rows)
+    aggs = [AggDesc(AggFunc.SUM, col(0, INT)),
+            AggDesc(AggFunc.COUNT, None),
+            AggDesc(AggFunc.MAX, col(1, DBL))]
+    k = ScalarAggKernel(col(0, INT).lt(500), aggs)
+    agg = HashAggregator(aggs)
+    agg.update(k(ch))
+    [(key, vals)] = agg.results()
+    assert key == ()
+    assert vals == [sum(range(500)), 500, 499.0]
+
+
+def test_first_row():
+    rows = [(1, "x"), (2, "y"), (1, "z")]
+    ch = Chunk.from_rows([INT, STR], rows)
+    aggs = [AggDesc(AggFunc.FIRST_ROW, col(1, STR))]
+    k = HashAggKernel(None, [col(0, INT)], aggs)
+    agg = HashAggregator(aggs)
+    agg.update(k(ch))
+    res = {key[0]: v[0] for key, v in agg.results()}
+    assert res == {1: "x", 2: "y"}
+
+
+def test_capacity_overflow_detected():
+    rows = [(i,) for i in range(200)]
+    ch = Chunk.from_rows([INT], rows)
+    k = HashAggKernel(None, [col(0, INT)],
+                      [AggDesc(AggFunc.COUNT, None)], capacity=64)
+    with pytest.raises(CapacityError):
+        k(ch)
+
+
+def test_device_safety_validation():
+    with pytest.raises(ValueError):
+        HashAggKernel(func(Op.LIKE, col(0, STR), extra="%x%"), [col(1, INT)],
+                      [AggDesc(AggFunc.COUNT, None)])
+    with pytest.raises(ValueError):
+        HashAggKernel(None, [func(Op.UPPER, col(0, STR))],
+                      [AggDesc(AggFunc.COUNT, None)])
+    with pytest.raises(ValueError):
+        HashAggKernel(None, [col(1, INT)],
+                      [AggDesc(AggFunc.MIN, col(0, STR))])
+
+
+def test_empty_chunk_and_no_match_filter():
+    ch = Chunk.from_rows([INT, INT], [(1, 2)])
+    k = HashAggKernel(col(1, INT).gt(100), [col(0, INT)],
+                      [AggDesc(AggFunc.SUM, col(1, INT))])
+    agg = HashAggregator(k.aggs)
+    agg.update(k(ch))
+    assert agg.results() == []

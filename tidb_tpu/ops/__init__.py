@@ -1,0 +1,27 @@
+"""TPU operator kernels: the analytical data plane.
+
+This package replaces the reference's Go chunk executors — SelectionExec
+(executor/executor.go:689), ProjectionExec (:598), HashAggExec
+(executor/aggregate.go:32), and the per-row Datum evaluation of
+expression/chunk_executor.go:67-100 (the reference's biggest CPU sink,
+SURVEY.md §3.2) — with jit-compiled whole-column XLA programs.
+
+Design (SURVEY.md §7 stages 4-5):
+* Chunks are padded to bucketed static shapes so XLA compiles one program
+  per (plan, bucket) instead of per batch size.
+* NULLs ride as bool validity arrays; the filter mask folds into validity.
+* Group-by is hash-based: 64-bit mixed key hash -> sorted-unique (static
+  capacity) -> segment reduce. Dynamic hash tables (the reference's mvmap)
+  don't fit XLA's static shapes; sort+segment is the TPU-native recast.
+* Every aggregate produces fixed-width partial states (expression/agg.py)
+  so storage-side partial agg / root-side final agg — and psum-style mesh
+  merges — compose exactly like the reference's partial-agg protocol
+  (expression/aggregation/aggregation.go:36-41).
+"""
+
+from tidb_tpu.ops.runtime import (bucket_size, device_put_chunk,
+                                  eval_filter_host)
+from tidb_tpu.ops.hashagg import HashAggKernel, ScalarAggKernel, AggSpec
+
+__all__ = ["bucket_size", "device_put_chunk", "eval_filter_host",
+           "HashAggKernel", "ScalarAggKernel", "AggSpec"]

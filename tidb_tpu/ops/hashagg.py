@@ -1,0 +1,370 @@
+"""Hash aggregation on device: filter + group-by + partial agg in one XLA
+program.
+
+Replaces /root/reference/executor/aggregate.go:32-57 (HashAggExec over an
+mvmap hash table, row-at-a-time aggCtx updates) and the storage-side agg of
+mocktikv/aggregate.go. The dynamic hash table becomes a TPU-friendly
+sort-based group-by (SURVEY.md §7 "Device hash tables", Plan A):
+
+    1. mix group-key lanes into a 64-bit hash per row (masked rows get a
+       sentinel bucket)
+    2. jnp.unique(size=capacity) -> sorted group hashes + inverse ids
+       (static shapes; capacity overflow detected and surfaced)
+    3. jax.ops.segment_* reduces produce fixed-width partial states
+    4. a second independent hash verifies per-group key agreement, so a
+       64-bit collision is *detected* (collision -> caller falls back to
+       the host path) rather than silently merging groups
+
+Partial states follow expression/agg.py's protocol, so chunk partials merge
+on the host (or across a mesh with psum) exactly like the reference's
+partial/final agg split.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from tidb_tpu.chunk import Chunk
+from tidb_tpu.expression import AggDesc, AggFunc, Expression
+from tidb_tpu.ops import runtime
+from tidb_tpu.sqltypes import EvalType
+
+__all__ = ["AggSpec", "HashAggKernel", "ScalarAggKernel", "HashAggregator",
+           "CapacityError", "CollisionError"]
+
+AggSpec = AggDesc  # the planner's descriptor doubles as the kernel spec
+
+_SENTINEL_MASKED = np.int64(-(1 << 63))        # all filtered-out rows
+_FILL = np.int64((1 << 63) - 1)                # unique() padding
+_I64_MAX = np.int64((1 << 63) - 1)
+_I64_MIN = np.int64(-(1 << 63))
+
+# golden-ratio mixing constants (splitmix64, public domain)
+_MIX1 = np.uint64(0xBF58476D1CE4E5B9)
+_MIX2 = np.uint64(0x94D049BB133111EB)
+_GOLD = np.uint64(0x9E3779B97F4A7C15)
+
+
+class CapacityError(Exception):
+    """More groups than the kernel's static capacity: re-plan with a larger
+    capacity or fall back to the host path."""
+
+
+class CollisionError(Exception):
+    """Two distinct key tuples collided in 64-bit hash space (detected by
+    the check hash); fall back to the host path."""
+
+
+def _splitmix(xp, h):
+    h = xp.asarray(h).astype(jnp.uint64) if xp is jnp else h.astype(np.uint64)
+    h = (h + _GOLD)
+    h = (h ^ (h >> np.uint64(30))) * _MIX1
+    h = (h ^ (h >> np.uint64(27))) * _MIX2
+    h = h ^ (h >> np.uint64(31))
+    return h
+
+
+def _hash_keys(xp, key_cols, n, seed: int):
+    """Combine (data, valid) int64 key lanes into one int64 hash per row.
+    NULL contributes a distinct tag so NULL groups separately from 0."""
+    h = xp.full(n, np.uint64(seed), dtype=jnp.uint64 if xp is jnp else np.uint64)
+    for d, v in key_cols:
+        u = xp.asarray(d).astype(jnp.uint64 if xp is jnp else np.uint64)
+        # validity mixes as its OWN lane: zeroing the data under NULL and
+        # hashing v separately means no data value can alias the NULL key
+        # (a fixed null-tag constant would collide with that literal value
+        # under BOTH seeds, defeating the dual-hash collision check)
+        h = _splitmix(xp, h ^ xp.where(v, u, np.uint64(0)))
+        h = _splitmix(xp, h ^ v.astype(h.dtype))
+    out = h.astype(jnp.int64 if xp is jnp else np.int64)
+    # reserve the sentinel values for masked/fill
+    out = xp.where(out == _SENTINEL_MASKED, np.int64(-(1 << 63) + 1), out)
+    out = xp.where(out == _FILL, np.int64((1 << 63) - 2), out)
+    return out
+
+
+def _agg_lanes(xp, agg: AggDesc, cols, n, mask, inv, capacity: int):
+    """Emit this aggregate's partial-state lanes as [capacity] arrays."""
+    fn = agg.fn
+    if agg.arg is not None:
+        d, v = agg.arg.eval_xp(xp, cols, n)
+        live = mask & v
+    else:
+        d, live = None, mask
+    seg_sum = lambda x: jax.ops.segment_sum(x, inv, num_segments=capacity)
+    seg_min = lambda x: jax.ops.segment_min(x, inv, num_segments=capacity)
+    seg_max = lambda x: jax.ops.segment_max(x, inv, num_segments=capacity)
+    has = seg_max(live.astype(jnp.int64))
+
+    if fn == AggFunc.COUNT:
+        return [seg_sum(live.astype(jnp.int64))]
+    if fn == AggFunc.SUM:
+        if d.dtype == jnp.float64:
+            vals = xp.where(live, d, 0.0)
+        else:
+            vals = xp.where(live, d, 0)
+        return [seg_sum(vals), has]
+    if fn == AggFunc.AVG:
+        if d.dtype == jnp.float64:
+            vals = xp.where(live, d, 0.0)
+        else:
+            vals = xp.where(live, d, 0)
+        return [seg_sum(vals), seg_sum(live.astype(jnp.int64))]
+    if fn == AggFunc.MIN:
+        if d.dtype == jnp.float64:
+            vals = xp.where(live, d, jnp.inf)
+        else:
+            vals = xp.where(live, d, _I64_MAX)
+        return [seg_min(vals), has]
+    if fn == AggFunc.MAX:
+        if d.dtype == jnp.float64:
+            vals = xp.where(live, d, -jnp.inf)
+        else:
+            vals = xp.where(live, d, _I64_MIN)
+        return [seg_max(vals), has]
+    if fn == AggFunc.FIRST_ROW:
+        idx = xp.where(live, xp.arange(n), n)
+        first = seg_min(idx)
+        return [first, has]  # host gathers the value at `first`
+    raise NotImplementedError(f"device agg {fn}")
+
+
+def _validate_device_exprs(filter_expr, group_exprs, aggs) -> None:
+    """Device kernels see dict-encoded int64 codes for varlen columns, so a
+    string column may appear ONLY as a bare group-key ColumnRef (codes group
+    identically to values within a chunk; exact values are recovered from
+    representative rows). Any computation over strings must be pre-applied
+    on the host by the planner."""
+    from tidb_tpu.expression import ColumnRef
+    if filter_expr is not None and not filter_expr.is_device_safe():
+        raise ValueError("filter expression is not device-safe; planner "
+                         "must split string predicates to the host path")
+    for g in group_exprs:
+        if not g.is_device_safe() and not isinstance(g, ColumnRef):
+            raise ValueError(f"group expr {g!r} computes over a varlen "
+                             "column; pre-project it on the host")
+    for a in aggs:
+        if a.arg is not None and not a.arg.is_device_safe():
+            # FIRST_ROW only needs a row index on device, so a bare string
+            # ColumnRef is fine (value gathered host-side); computed string
+            # exprs would still trace eval_xp and explode mid-jit
+            if not (a.fn == AggFunc.FIRST_ROW and
+                    isinstance(a.arg, ColumnRef)):
+                raise ValueError(f"agg arg {a.arg!r} is not device-safe")
+
+
+@dataclass
+class GroupResult:
+    """Partial aggregation result of one chunk."""
+
+    keys: list[tuple]            # group key tuples (host python values)
+    partials: list[np.ndarray]   # per agg: [lanes][num_groups] arrays
+    counts: np.ndarray           # rows per group
+
+
+class HashAggKernel:
+    """Compiled filter+group+partial-agg over one chunk schema.
+
+    group_exprs must be device-safe (strings dict-encoded upstream by
+    runtime.device_put_chunk; their ColumnRefs then see int64 codes).
+    """
+
+    def __init__(self, filter_expr: Expression | None,
+                 group_exprs: Sequence[Expression],
+                 aggs: Sequence[AggDesc], capacity: int = 4096):
+        self.filter_expr = filter_expr
+        self.group_exprs = list(group_exprs)
+        self.aggs = list(aggs)
+        self.capacity = capacity
+        _validate_device_exprs(filter_expr, self.group_exprs, self.aggs)
+        self._jit = jax.jit(self._kernel)
+
+    def _kernel(self, cols, nrows):
+        n = cols[0][0].shape[0]
+        xp = jnp
+        mask = runtime.filter_mask_xp(xp, self.filter_expr, cols, n)
+        mask = mask & (xp.arange(n) < nrows)   # padding rows are dead
+        key_cols = [g.eval_xp(xp, cols, n) for g in self.group_exprs]
+        h = _hash_keys(xp, key_cols, n, seed=0x517CC1B727220A95)
+        h2 = _hash_keys(xp, key_cols, n, seed=0x2545F4914F6CDD1D)
+        h = xp.where(mask, h, _SENTINEL_MASKED)
+        uniq, inv = jnp.unique(h, size=self.capacity, fill_value=_FILL,
+                               return_inverse=True)
+        # true distinct count (incl. masked sentinel) for overflow detection
+        hs = jnp.sort(h)
+        nuniq = 1 + jnp.sum(hs[1:] != hs[:-1])
+        # collision check: within each group, the check hash must agree
+        c_min = jax.ops.segment_min(xp.where(mask, h2, _I64_MAX), inv,
+                                    num_segments=self.capacity)
+        c_max = jax.ops.segment_max(xp.where(mask, h2, _I64_MIN), inv,
+                                    num_segments=self.capacity)
+        live_group = jax.ops.segment_max(mask.astype(jnp.int64), inv,
+                                         num_segments=self.capacity)
+        collided = jnp.any((live_group > 0) & (c_min != c_max))
+        counts = jax.ops.segment_sum(mask.astype(jnp.int64), inv,
+                                     num_segments=self.capacity)
+        rep = jax.ops.segment_min(xp.where(mask, xp.arange(n), n), inv,
+                                  num_segments=self.capacity)
+        lanes = [_agg_lanes(xp, a, cols, n, mask, inv, self.capacity)
+                 for a in self.aggs]
+        return uniq, nuniq, collided, counts, rep, lanes
+
+    def __call__(self, chunk: Chunk) -> GroupResult:
+        cols, _dicts = runtime.device_put_chunk(chunk)
+        uniq, nuniq, collided, counts, rep, lanes = self._jit(
+            cols, chunk.num_rows)
+        uniq = np.asarray(uniq)
+        counts = np.asarray(counts)
+        rep = np.asarray(rep)
+        if bool(collided):
+            raise CollisionError("group key hash collision")
+        live = (counts > 0) & (uniq != _SENTINEL_MASKED) & (uniq != _FILL)
+        if int(nuniq) > self.capacity:
+            raise CapacityError(f"distinct groups {int(nuniq)} > capacity "
+                                f"{self.capacity}")
+        gidx = np.flatnonzero(live)
+        rep_rows = rep[gidx]
+        # exact group key values: evaluate group exprs on the tiny rep-row
+        # sub-chunk (strings included — host path)
+        sub = chunk.take(rep_rows)
+        key_cols = []
+        for g in self.group_exprs:
+            d, v = g.eval(sub)
+            key_cols.append([None if not v[i] else
+                             (d[i].item() if hasattr(d[i], "item") else d[i])
+                             for i in range(len(gidx))])
+        keys = list(zip(*key_cols)) if key_cols else []
+        partials = []
+        for a, ls in zip(self.aggs, lanes):
+            ls = [np.asarray(l)[gidx] for l in ls]
+            if a.fn == AggFunc.FIRST_ROW:
+                # gather only the first-row rows, then evaluate the arg on
+                # that tiny sub-chunk (host path handles strings)
+                idx = ls[0]
+                hasv = ls[1] > 0
+                safe_idx = np.where(hasv, idx, 0).astype(np.int64)
+                d, _v = a.arg.eval(chunk.take(safe_idx))
+                vals = np.where(hasv, d, 0) if d.dtype != object else d
+                ls = [vals, hasv.astype(np.int64)]
+            partials.append(ls)
+        return GroupResult(keys=keys, partials=partials, counts=counts[gidx])
+
+
+class ScalarAggKernel:
+    """No-group aggregation: one partial state row per chunk."""
+
+    def __init__(self, filter_expr: Expression | None,
+                 aggs: Sequence[AggDesc]):
+        self.filter_expr = filter_expr
+        self.aggs = list(aggs)
+        _validate_device_exprs(filter_expr, [], self.aggs)
+        self._jit = jax.jit(self._kernel)
+
+    def _kernel(self, cols, nrows):
+        n = cols[0][0].shape[0]
+        xp = jnp
+        mask = runtime.filter_mask_xp(xp, self.filter_expr, cols, n)
+        mask = mask & (xp.arange(n) < nrows)   # padding rows are dead
+        inv = xp.zeros(n, dtype=jnp.int32)
+        count = jax.ops.segment_sum(mask.astype(jnp.int64), inv,
+                                    num_segments=1)
+        lanes = [_agg_lanes(xp, a, cols, n, mask, inv, 1) for a in self.aggs]
+        return count, lanes
+
+    def __call__(self, chunk: Chunk) -> GroupResult:
+        cols, _ = runtime.device_put_chunk(chunk)
+        count, lanes = self._jit(cols, chunk.num_rows)
+        partials = []
+        for a, ls in zip(self.aggs, lanes):
+            ls = [np.asarray(l) for l in ls]
+            if a.fn == AggFunc.FIRST_ROW:
+                idx = ls[0]
+                hasv = ls[1] > 0
+                if hasv[0] and chunk.num_rows > 0:
+                    d, _v = a.arg.eval(chunk.take(np.array([int(idx[0])])))
+                    val = d[0]
+                else:
+                    val = 0
+                ls = [np.array([val]), hasv.astype(np.int64)]
+            partials.append(ls)
+        return GroupResult(keys=[()], partials=partials,
+                           counts=np.asarray(count))
+
+
+class HashAggregator:
+    """Stateful final aggregator: merges chunk partials on the host and
+    finalizes per-group values. Mirrors Aggregation.GetPartialResult
+    merging (expression/aggregation/aggregation.go:32-47)."""
+
+    def __init__(self, aggs: Sequence[AggDesc]):
+        self.aggs = list(aggs)
+        self._state: dict[tuple, list] = {}
+
+    def update(self, res: GroupResult) -> None:
+        for gi, key in enumerate(res.keys):
+            st = self._state.get(key)
+            if st is None:
+                self._state[key] = [
+                    [lane[gi] for lane in res.partials[ai]]
+                    for ai in range(len(self.aggs))]
+                continue
+            for ai, agg in enumerate(self.aggs):
+                lanes = res.partials[ai]
+                cur = st[ai]
+                fn = agg.fn
+                if fn == AggFunc.COUNT:
+                    cur[0] += lanes[0][gi]
+                elif fn in (AggFunc.SUM, AggFunc.AVG):
+                    cur[0] += lanes[0][gi]
+                    cur[1] = max(cur[1], lanes[1][gi]) if fn == AggFunc.SUM \
+                        else cur[1] + lanes[1][gi]
+                elif fn == AggFunc.MIN:
+                    if lanes[1][gi] > 0:
+                        cur[0] = min(cur[0], lanes[0][gi]) if cur[1] > 0 \
+                            else lanes[0][gi]
+                        cur[1] = 1
+                elif fn == AggFunc.MAX:
+                    if lanes[1][gi] > 0:
+                        cur[0] = max(cur[0], lanes[0][gi]) if cur[1] > 0 \
+                            else lanes[0][gi]
+                        cur[1] = 1
+                elif fn == AggFunc.FIRST_ROW:
+                    if cur[1] == 0 and lanes[1][gi] > 0:
+                        cur[0], cur[1] = lanes[0][gi], 1
+
+    def results(self) -> list[tuple[tuple, list]]:
+        """-> [(key, [final agg values])] with AVG finalized; SUM/AVG of
+        decimals stay scaled ints (callers format via the agg result_ft)."""
+        out = []
+        for key, st in sorted(self._state.items(),
+                              key=lambda kv: tuple(
+                                  (x is None, x) for x in kv[0])):
+            vals = []
+            for agg, cur in zip(self.aggs, st):
+                fn = agg.fn
+                if fn == AggFunc.COUNT:
+                    vals.append(int(cur[0]))
+                elif fn == AggFunc.SUM:
+                    vals.append(None if cur[1] == 0 else cur[0])
+                elif fn == AggFunc.AVG:
+                    if cur[1] == 0:
+                        vals.append(None)
+                    elif agg.result_ft.eval_type == EvalType.DECIMAL:
+                        # scaled-int avg: rescale sum by extra frac then div
+                        extra = agg.result_ft.frac - agg.arg.ft.frac
+                        vals.append(int(round(
+                            int(cur[0]) * (10 ** extra) / int(cur[1]))))
+                    else:
+                        vals.append(float(cur[0]) / float(cur[1]))
+                elif fn in (AggFunc.MIN, AggFunc.MAX, AggFunc.FIRST_ROW):
+                    vals.append(None if cur[1] == 0 else cur[0])
+                else:
+                    raise NotImplementedError(fn)
+            out.append((key, vals))
+        return out

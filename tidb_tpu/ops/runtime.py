@@ -1,0 +1,78 @@
+"""Device runtime helpers: padding, transfer, kernel caching.
+
+The reference streams 1024-row chunks through goroutine pipelines
+(util/chunk, distsql); a TPU wants large static-shape batches. Chunks are
+padded to bucketed sizes (powers of two) so each physical plan compiles a
+small, reusable set of XLA programs; padding rows carry valid=False so every
+kernel treats them as NULLs that match no filter and join no group.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from tidb_tpu.chunk import Chunk, dict_encode
+from tidb_tpu.expression import Expression
+
+__all__ = ["bucket_size", "pad_column", "device_put_chunk",
+           "eval_filter_host", "MIN_BUCKET"]
+
+MIN_BUCKET = 1024
+
+
+def bucket_size(n: int) -> int:
+    """Next power of two >= n (min MIN_BUCKET): the static shape bucket."""
+    b = MIN_BUCKET
+    while b < n:
+        b <<= 1
+    return b
+
+
+def pad_column(data: np.ndarray, valid: np.ndarray, size: int):
+    n = len(data)
+    if n == size:
+        return data, valid
+    pd = np.zeros(size, dtype=data.dtype)
+    pd[:n] = data
+    pv = np.zeros(size, dtype=bool)
+    pv[:n] = valid
+    return pd, pv
+
+
+def device_put_chunk(chunk: Chunk, size: int | None = None):
+    """-> (cols, dicts): cols is a list of (jnp data, jnp valid) per column,
+    padded to a bucketed static size; varlen columns are dict-encoded and
+    their dictionaries returned in `dicts[col_idx]` for host-side decode."""
+    size = size or bucket_size(chunk.num_rows)
+    cols = []
+    dicts: dict[int, list] = {}
+    for j, c in enumerate(chunk.columns):
+        if c.fixed_width:
+            data, valid = c.data, c.valid
+        else:
+            codes, values = dict_encode(c)
+            dicts[j] = values
+            data, valid = codes, c.valid & (codes >= 0)
+        data, valid = pad_column(np.ascontiguousarray(data), valid, size)
+        cols.append((jnp.asarray(data), jnp.asarray(valid)))
+    return cols, dicts
+
+
+def eval_filter_host(expr: Expression | None, chunk: Chunk) -> np.ndarray:
+    """Host-path filter: bool mask over rows (NULL -> False).
+    Mirror of the device mask used inside kernels."""
+    if expr is None:
+        return np.ones(chunk.num_rows, dtype=bool)
+    d, v = expr.eval(chunk)
+    return v & (d != 0)
+
+
+def filter_mask_xp(xp, expr: Expression | None, cols, n):
+    """Device-path filter mask inside a traced kernel."""
+    if expr is None:
+        return xp.ones(n, dtype=bool)
+    d, v = expr.eval_xp(xp, cols, n)
+    return v & (d != 0)
