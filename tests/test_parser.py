@@ -1,0 +1,268 @@
+"""Parser tests. Ref model: parser/parser_test.go (2.1k lines of cases);
+here the cases that matter for the framework's executable surface,
+including the verbatim TPC-H Q1/Q3/Q5 texts (the bench queries)."""
+
+import decimal
+
+import pytest
+
+from tidb_tpu import sqltypes as st
+from tidb_tpu.parser import ParseError, ast, parse, parse_one
+
+
+def test_select_basic():
+    s = parse_one("SELECT a, b+1 AS c FROM t WHERE a > 10 ORDER BY b DESC LIMIT 5")
+    assert isinstance(s, ast.SelectStmt)
+    assert len(s.fields) == 2
+    assert s.fields[1].alias == "c"
+    assert isinstance(s.where, ast.BinaryOp) and s.where.op == ">"
+    assert s.order_by[0].desc
+    assert s.limit == 5
+
+
+def test_select_star_and_qualified():
+    s = parse_one("SELECT *, t.*, db.t.c FROM db.t tt")
+    assert isinstance(s.fields[0].expr, ast.Star)
+    assert s.fields[1].expr.table == "t"
+    c = s.fields[2].expr
+    assert (c.db, c.table, c.name) == ("db", "t", "c")
+    assert s.from_clause.db == "db" and s.from_clause.alias == "tt"
+
+
+def test_operator_precedence():
+    s = parse_one("SELECT 1+2*3")
+    e = s.fields[0].expr
+    assert e.op == "+" and e.right.op == "*"
+    s2 = parse_one("SELECT a OR b AND c = d + 1")
+    e2 = s2.fields[0].expr
+    assert e2.op == "OR"
+    assert e2.right.op == "AND"
+    assert e2.right.right.op == "="
+
+
+def test_predicates():
+    s = parse_one("SELECT 1 FROM t WHERE a IN (1,2,3) AND b NOT LIKE 'x%' "
+                  "AND c BETWEEN 1 AND 10 AND d IS NOT NULL")
+    w = s.where
+    # ((a IN .. AND b NOT LIKE ..) AND c BETWEEN ..) AND d IS NOT NULL
+    assert isinstance(w.right, ast.IsNullExpr) and w.right.negated
+    assert isinstance(w.left.right, ast.BetweenExpr)
+    assert isinstance(w.left.left.right, ast.LikeExpr)
+    assert w.left.left.right.negated
+    assert isinstance(w.left.left.left, ast.InExpr)
+
+
+def test_joins():
+    s = parse_one("SELECT * FROM a JOIN b ON a.x = b.x LEFT JOIN c ON b.y = c.y")
+    j = s.from_clause
+    assert isinstance(j, ast.Join) and j.tp == ast.JoinType.LEFT
+    assert isinstance(j.left, ast.Join) and j.left.tp == ast.JoinType.INNER
+    s2 = parse_one("SELECT * FROM a, b WHERE a.x = b.x")
+    assert isinstance(s2.from_clause, ast.Join)
+    assert s2.from_clause.tp == ast.JoinType.CROSS
+
+
+def test_aggregates_and_group():
+    s = parse_one("SELECT k, COUNT(*), SUM(DISTINCT v), AVG(v) FROM t "
+                  "GROUP BY k HAVING COUNT(*) > 1")
+    assert s.fields[1].expr.star
+    assert s.fields[2].expr.distinct
+    assert len(s.group_by) == 1
+    assert isinstance(s.having, ast.BinaryOp)
+
+
+def test_case_cast():
+    s = parse_one("SELECT CASE WHEN a>1 THEN 'x' ELSE 'y' END, "
+                  "CASE a WHEN 1 THEN 2 END, CAST(a AS DECIMAL(10,2))")
+    c1, c2, c3 = (f.expr for f in s.fields)
+    assert c1.operand is None and c1.else_clause is not None
+    assert c2.operand is not None
+    assert c3.ft.tp == st.TypeCode.NEWDECIMAL and c3.ft.frac == 2
+
+
+def test_subqueries():
+    s = parse_one("SELECT a FROM t WHERE a IN (SELECT b FROM u) AND "
+                  "EXISTS (SELECT 1 FROM v)")
+    inx = s.where.left
+    assert isinstance(inx, ast.InExpr)
+    assert isinstance(inx.items, ast.SubqueryExpr)
+    assert isinstance(s.where.right, ast.ExistsSubquery)
+    s2 = parse_one("SELECT x FROM (SELECT a AS x FROM t) sub")
+    assert isinstance(s2.from_clause, ast.SubqueryTable)
+    assert s2.from_clause.alias == "sub"
+
+
+def test_insert_forms():
+    s = parse_one("INSERT INTO t (a, b) VALUES (1, 'x'), (2, 'y')")
+    assert s.columns == ["a", "b"] and len(s.values) == 2
+    s2 = parse_one("INSERT INTO t VALUES (1, DEFAULT)")
+    assert isinstance(s2.values[0][1], ast.DefaultExpr)
+    s3 = parse_one("INSERT INTO t SELECT * FROM u")
+    assert s3.select is not None
+    s4 = parse_one("INSERT INTO t (a) VALUES (1) ON DUPLICATE KEY UPDATE a = a + 1")
+    assert len(s4.on_duplicate) == 1
+    s5 = parse_one("REPLACE INTO t VALUES (1)")
+    assert s5.is_replace
+
+
+def test_update_delete():
+    s = parse_one("UPDATE t SET a = 1, b = b + 1 WHERE c = 2 LIMIT 10")
+    assert len(s.assignments) == 2 and s.limit == 10
+    d = parse_one("DELETE FROM t WHERE a < 5")
+    assert isinstance(d, ast.DeleteStmt) and d.where is not None
+
+
+def test_create_table():
+    s = parse_one("""
+    CREATE TABLE IF NOT EXISTS t (
+      id BIGINT PRIMARY KEY AUTO_INCREMENT,
+      name VARCHAR(64) NOT NULL DEFAULT '',
+      price DECIMAL(15,2),
+      created DATETIME,
+      KEY idx_name (name),
+      UNIQUE KEY uk (price, created)
+    ) ENGINE=InnoDB""")
+    assert s.if_not_exists
+    assert len(s.columns) == 4 and len(s.indexes) == 2
+    idc = s.columns[0]
+    assert idc.is_primary and idc.auto_increment
+    assert s.columns[1].ft.not_null and s.columns[1].has_default
+    assert s.columns[2].ft.frac == 2
+    assert s.indexes[1].unique and s.indexes[1].columns == ["price", "created"]
+
+
+def test_ddl_misc():
+    assert isinstance(parse_one("CREATE DATABASE IF NOT EXISTS d"),
+                      ast.CreateDatabaseStmt)
+    assert isinstance(parse_one("CREATE UNIQUE INDEX i ON t (a, b)"),
+                      ast.CreateIndexStmt)
+    assert isinstance(parse_one("DROP TABLE IF EXISTS a, b"),
+                      ast.DropTableStmt)
+    a = parse_one("ALTER TABLE t ADD COLUMN c INT, DROP COLUMN d, "
+                  "ADD INDEX i (c)")
+    assert [sp.tp for sp in a.specs] == ["add_column", "drop_column",
+                                         "add_index"]
+    assert isinstance(parse_one("TRUNCATE TABLE t"), ast.TruncateTableStmt)
+    r = parse_one("RENAME TABLE a TO b")
+    assert r.pairs[0][0].name == "a"
+
+
+def test_txn_and_session():
+    assert isinstance(parse_one("BEGIN"), ast.BeginStmt)
+    assert isinstance(parse_one("START TRANSACTION"), ast.BeginStmt)
+    assert isinstance(parse_one("COMMIT"), ast.CommitStmt)
+    assert isinstance(parse_one("ROLLBACK"), ast.RollbackStmt)
+    s = parse_one("SET @@global.autocommit = 1, @x = 5, sql_mode = 'STRICT'")
+    assert s.assignments[0].is_global
+    assert s.assignments[1].name == "@x"
+    assert s.assignments[2].is_system
+    assert isinstance(parse_one("USE test"), ast.UseStmt)
+
+
+def test_show_explain_admin():
+    assert parse_one("SHOW DATABASES").tp == "databases"
+    assert parse_one("SHOW TABLES").tp == "tables"
+    s = parse_one("SHOW COLUMNS FROM t")
+    assert s.tp == "columns" and s.table.name == "t"
+    assert parse_one("SHOW VARIABLES LIKE 'max%'").pattern == "max%"
+    e = parse_one("EXPLAIN SELECT 1")
+    assert isinstance(e.stmt, ast.SelectStmt)
+    assert parse_one("ANALYZE TABLE t").tables[0].name == "t"
+    assert parse_one("ADMIN SHOW DDL").tp == "show_ddl"
+
+
+def test_multi_statement():
+    stmts = parse("SELECT 1; SELECT 2;")
+    assert len(stmts) == 2
+
+
+def test_string_escapes_and_comments():
+    s = parse_one("SELECT 'it''s', \"a\\nb\" -- trailing\n FROM t /* c */")
+    assert s.fields[0].expr.value == "it's"
+    assert s.fields[1].expr.value == "a\nb"
+
+
+def test_literals():
+    s = parse_one("SELECT 1, 1.5, 1.5e3, -2, 'x', NULL, TRUE")
+    vals = [f.expr for f in s.fields]
+    assert vals[0].value == 1
+    assert vals[1].value == decimal.Decimal("1.5")
+    assert vals[2].value == 1500.0
+    assert isinstance(vals[3], ast.UnaryOp)
+    assert vals[5].value is None
+
+
+def test_parse_errors():
+    with pytest.raises(ParseError):
+        parse_one("SELECT FROM t")
+    with pytest.raises(ParseError):
+        parse_one("SELEC 1")
+    with pytest.raises(ParseError):
+        parse_one("SELECT 1 FROM")
+    with pytest.raises(ParseError):
+        parse_one("INSERT INTO t")
+
+
+TPCH_Q1 = """
+SELECT l_returnflag, l_linestatus,
+  SUM(l_quantity) AS sum_qty,
+  SUM(l_extendedprice) AS sum_base_price,
+  SUM(l_extendedprice * (1 - l_discount)) AS sum_disc_price,
+  SUM(l_extendedprice * (1 - l_discount) * (1 + l_tax)) AS sum_charge,
+  AVG(l_quantity) AS avg_qty,
+  AVG(l_extendedprice) AS avg_price,
+  AVG(l_discount) AS avg_disc,
+  COUNT(*) AS count_order
+FROM lineitem
+WHERE l_shipdate <= DATE_SUB('1998-12-01', INTERVAL 90 DAY)
+GROUP BY l_returnflag, l_linestatus
+ORDER BY l_returnflag, l_linestatus
+"""
+
+TPCH_Q3 = """
+SELECT l_orderkey,
+  SUM(l_extendedprice * (1 - l_discount)) AS revenue,
+  o_orderdate, o_shippriority
+FROM customer, orders, lineitem
+WHERE c_mktsegment = 'BUILDING'
+  AND c_custkey = o_custkey
+  AND l_orderkey = o_orderkey
+  AND o_orderdate < '1995-03-15'
+  AND l_shipdate > '1995-03-15'
+GROUP BY l_orderkey, o_orderdate, o_shippriority
+ORDER BY revenue DESC, o_orderdate
+LIMIT 10
+"""
+
+TPCH_Q5 = """
+SELECT n_name,
+  SUM(l_extendedprice * (1 - l_discount)) AS revenue
+FROM customer, orders, lineitem, supplier, nation, region
+WHERE c_custkey = o_custkey
+  AND l_orderkey = o_orderkey
+  AND l_suppkey = s_suppkey
+  AND c_nationkey = s_nationkey
+  AND s_nationkey = n_nationkey
+  AND n_regionkey = r_regionkey
+  AND r_name = 'ASIA'
+  AND o_orderdate >= '1994-01-01'
+  AND o_orderdate < DATE_ADD('1994-01-01', INTERVAL 1 YEAR)
+GROUP BY n_name
+ORDER BY revenue DESC
+"""
+
+
+def test_tpch_queries_parse():
+    q1 = parse_one(TPCH_Q1)
+    assert len(q1.fields) == 10 and len(q1.group_by) == 2
+    q3 = parse_one(TPCH_Q3)
+    assert q3.limit == 10 and isinstance(q3.from_clause, ast.Join)
+    q5 = parse_one(TPCH_Q5)
+    assert len(q5.group_by) == 1
+    # 6-way comma join nests 5 Joins deep
+    depth = 0
+    n = q5.from_clause
+    while isinstance(n, ast.Join):
+        depth += 1
+        n = n.left
+    assert depth == 5

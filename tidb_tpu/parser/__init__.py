@@ -1,0 +1,4 @@
+from tidb_tpu.parser.parser import ParseError, parse, parse_one
+from tidb_tpu.parser import ast
+
+__all__ = ["parse", "parse_one", "ParseError", "ast"]

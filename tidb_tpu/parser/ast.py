@@ -1,0 +1,439 @@
+"""AST node hierarchy.
+
+Reference: /root/reference/ast/ — Node/ExprNode/StmtNode (ast/ast.go:29-94),
+DML nodes (ast/dml.go), DDL nodes (ast/ddl.go). Dataclasses instead of the
+reference's visitor-heavy interfaces; the planner pattern-matches on types.
+Unresolved names live here; the planner resolves them into
+tidb_tpu.expression columnar trees.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Optional
+
+from tidb_tpu.sqltypes import FieldType
+
+__all__ = [
+    "Node", "ExprNode", "StmtNode",
+    "Literal", "ColName", "Star", "BinaryOp", "UnaryOp", "FuncCall",
+    "AggregateCall", "CaseExpr", "InExpr", "BetweenExpr", "LikeExpr",
+    "IsNullExpr", "CastExpr", "ExistsSubquery", "SubqueryExpr", "RowExpr",
+    "VariableExpr", "DefaultExpr", "ParamMarker",
+    "JoinType", "TableSource", "Join", "SubqueryTable",
+    "SelectField", "ByItem", "SelectStmt", "UnionStmt",
+    "InsertStmt", "UpdateStmt", "DeleteStmt", "Assignment",
+    "ColumnDef", "IndexDef", "CreateTableStmt", "CreateDatabaseStmt",
+    "CreateIndexStmt", "DropTableStmt", "DropDatabaseStmt", "DropIndexStmt",
+    "AlterTableStmt", "AlterSpec", "TruncateTableStmt", "RenameTableStmt",
+    "UseStmt", "BeginStmt", "CommitStmt", "RollbackStmt",
+    "SetStmt", "VarAssignment", "ShowStmt", "ExplainStmt", "AnalyzeStmt",
+    "AdminStmt",
+]
+
+
+class Node:
+    pass
+
+
+class ExprNode(Node):
+    pass
+
+
+class StmtNode(Node):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Expressions
+
+@dataclass
+class Literal(ExprNode):
+    value: Any               # python value; Decimal for DECIMAL literals
+    ft: Optional[FieldType] = None
+
+
+@dataclass
+class ColName(ExprNode):
+    name: str
+    table: str = ""
+    db: str = ""
+
+    def __repr__(self):
+        parts = [p for p in (self.db, self.table, self.name) if p]
+        return ".".join(parts)
+
+
+@dataclass
+class Star(ExprNode):
+    table: str = ""          # t.* form
+
+
+@dataclass
+class BinaryOp(ExprNode):
+    op: str                  # '+', '-', '*', '/', 'DIV', '%', '=', '<', ...
+    left: ExprNode
+    right: ExprNode
+
+
+@dataclass
+class UnaryOp(ExprNode):
+    op: str                  # '-', '+', 'NOT', '~'
+    operand: ExprNode
+
+
+@dataclass
+class FuncCall(ExprNode):
+    name: str                # uppercased
+    args: list = field(default_factory=list)
+
+
+@dataclass
+class AggregateCall(ExprNode):
+    name: str                # COUNT/SUM/AVG/MIN/MAX/GROUP_CONCAT...
+    args: list = field(default_factory=list)   # empty for COUNT(*)
+    distinct: bool = False
+    star: bool = False
+
+
+@dataclass
+class CaseExpr(ExprNode):
+    operand: Optional[ExprNode]          # CASE x WHEN ... / CASE WHEN ...
+    when_clauses: list = field(default_factory=list)  # [(cond, result)]
+    else_clause: Optional[ExprNode] = None
+
+
+@dataclass
+class InExpr(ExprNode):
+    expr: ExprNode
+    items: list = field(default_factory=list)  # exprs, or a SubqueryExpr
+    negated: bool = False
+
+
+@dataclass
+class BetweenExpr(ExprNode):
+    expr: ExprNode
+    low: ExprNode
+    high: ExprNode
+    negated: bool = False
+
+
+@dataclass
+class LikeExpr(ExprNode):
+    expr: ExprNode
+    pattern: ExprNode
+    negated: bool = False
+
+
+@dataclass
+class IsNullExpr(ExprNode):
+    expr: ExprNode
+    negated: bool = False
+
+
+@dataclass
+class CastExpr(ExprNode):
+    expr: ExprNode
+    ft: FieldType
+
+
+@dataclass
+class SubqueryExpr(ExprNode):
+    select: "SelectStmt" = None
+
+
+@dataclass
+class ExistsSubquery(ExprNode):
+    select: "SelectStmt" = None
+    negated: bool = False
+
+
+@dataclass
+class RowExpr(ExprNode):
+    items: list = field(default_factory=list)
+
+
+@dataclass
+class VariableExpr(ExprNode):
+    name: str
+    is_global: bool = False
+    is_system: bool = False
+
+
+@dataclass
+class DefaultExpr(ExprNode):
+    pass
+
+
+@dataclass
+class ParamMarker(ExprNode):
+    index: int = 0
+
+
+# ---------------------------------------------------------------------------
+# Table references
+
+class JoinType(Enum):
+    INNER = "inner"
+    LEFT = "left"
+    RIGHT = "right"
+    CROSS = "cross"
+
+
+@dataclass
+class TableSource(Node):
+    name: str
+    db: str = ""
+    alias: str = ""
+
+    @property
+    def ref_name(self) -> str:
+        return self.alias or self.name
+
+
+@dataclass
+class SubqueryTable(Node):
+    select: "SelectStmt" = None
+    alias: str = ""
+
+
+@dataclass
+class Join(Node):
+    left: Node
+    right: Node
+    tp: JoinType = JoinType.CROSS
+    on: Optional[ExprNode] = None
+    using: list = field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# SELECT
+
+@dataclass
+class SelectField(Node):
+    expr: ExprNode           # Star for '*'
+    alias: str = ""
+
+
+@dataclass
+class ByItem(Node):
+    expr: ExprNode
+    desc: bool = False
+
+
+@dataclass
+class SelectStmt(StmtNode):
+    fields: list = field(default_factory=list)        # [SelectField]
+    from_clause: Optional[Node] = None                # TableSource/Join/None
+    where: Optional[ExprNode] = None
+    group_by: list = field(default_factory=list)      # [ByItem]
+    having: Optional[ExprNode] = None
+    order_by: list = field(default_factory=list)      # [ByItem]
+    limit: Optional[int] = None
+    offset: int = 0
+    distinct: bool = False
+    for_update: bool = False
+
+
+@dataclass
+class UnionStmt(StmtNode):
+    selects: list = field(default_factory=list)
+    # alls[i] is True iff the connector before selects[i+1] was UNION ALL
+    # (per-branch, as in MySQL; a single sticky flag would make one ALL
+    # poison every branch)
+    alls: list = field(default_factory=list)
+    order_by: list = field(default_factory=list)
+    limit: Optional[int] = None
+    offset: int = 0
+
+
+# ---------------------------------------------------------------------------
+# DML
+
+@dataclass
+class Assignment(Node):
+    col: ColName
+    expr: ExprNode
+
+
+@dataclass
+class InsertStmt(StmtNode):
+    table: TableSource = None
+    columns: list = field(default_factory=list)       # [str]
+    values: list = field(default_factory=list)        # [[ExprNode]]
+    select: Optional[SelectStmt] = None
+    on_duplicate: list = field(default_factory=list)  # [Assignment]
+    is_replace: bool = False
+    ignore: bool = False
+
+
+@dataclass
+class UpdateStmt(StmtNode):
+    table: Node = None                                # TableSource or Join
+    assignments: list = field(default_factory=list)   # [Assignment]
+    where: Optional[ExprNode] = None
+    order_by: list = field(default_factory=list)
+    limit: Optional[int] = None
+
+
+@dataclass
+class DeleteStmt(StmtNode):
+    table: TableSource = None
+    where: Optional[ExprNode] = None
+    order_by: list = field(default_factory=list)
+    limit: Optional[int] = None
+
+
+# ---------------------------------------------------------------------------
+# DDL
+
+@dataclass
+class ColumnDef(Node):
+    name: str
+    ft: FieldType
+    default: Optional[ExprNode] = None
+    has_default: bool = False
+    comment: str = ""
+    is_primary: bool = False          # inline PRIMARY KEY
+    is_unique: bool = False           # inline UNIQUE
+    auto_increment: bool = False
+
+
+@dataclass
+class IndexDef(Node):
+    name: str
+    columns: list = field(default_factory=list)       # [str]
+    unique: bool = False
+    primary: bool = False
+
+
+@dataclass
+class CreateTableStmt(StmtNode):
+    table: TableSource = None
+    columns: list = field(default_factory=list)       # [ColumnDef]
+    indexes: list = field(default_factory=list)       # [IndexDef]
+    if_not_exists: bool = False
+    options: dict = field(default_factory=dict)       # engine/charset/comment
+
+
+@dataclass
+class CreateDatabaseStmt(StmtNode):
+    name: str = ""
+    if_not_exists: bool = False
+
+
+@dataclass
+class CreateIndexStmt(StmtNode):
+    index_name: str = ""
+    table: TableSource = None
+    columns: list = field(default_factory=list)
+    unique: bool = False
+
+
+@dataclass
+class DropTableStmt(StmtNode):
+    tables: list = field(default_factory=list)        # [TableSource]
+    if_exists: bool = False
+
+
+@dataclass
+class DropDatabaseStmt(StmtNode):
+    name: str = ""
+    if_exists: bool = False
+
+
+@dataclass
+class DropIndexStmt(StmtNode):
+    index_name: str = ""
+    table: TableSource = None
+    if_exists: bool = False
+
+
+@dataclass
+class AlterSpec(Node):
+    tp: str                  # add_column/drop_column/add_index/drop_index/
+    #                          modify_column/rename
+    column: Optional[ColumnDef] = None
+    index: Optional[IndexDef] = None
+    name: str = ""           # drop target / rename target
+    position: str = ""       # FIRST / AFTER <col>
+    after_col: str = ""
+
+
+@dataclass
+class AlterTableStmt(StmtNode):
+    table: TableSource = None
+    specs: list = field(default_factory=list)
+
+
+@dataclass
+class TruncateTableStmt(StmtNode):
+    table: TableSource = None
+
+
+@dataclass
+class RenameTableStmt(StmtNode):
+    pairs: list = field(default_factory=list)         # [(old TS, new TS)]
+
+
+# ---------------------------------------------------------------------------
+# Session / admin
+
+@dataclass
+class UseStmt(StmtNode):
+    db: str = ""
+
+
+@dataclass
+class BeginStmt(StmtNode):
+    pass
+
+
+@dataclass
+class CommitStmt(StmtNode):
+    pass
+
+
+@dataclass
+class RollbackStmt(StmtNode):
+    pass
+
+
+@dataclass
+class VarAssignment(Node):
+    name: str
+    value: ExprNode = None
+    is_global: bool = False
+    is_system: bool = False
+
+
+@dataclass
+class SetStmt(StmtNode):
+    assignments: list = field(default_factory=list)
+
+
+@dataclass
+class ShowStmt(StmtNode):
+    tp: str = ""             # databases/tables/columns/variables/create_table
+    table: Optional[TableSource] = None
+    db: str = ""
+    pattern: Optional[str] = None    # LIKE '...'
+    where: Optional[ExprNode] = None
+    is_global: bool = False
+
+
+@dataclass
+class ExplainStmt(StmtNode):
+    stmt: StmtNode = None
+
+
+@dataclass
+class AnalyzeStmt(StmtNode):
+    tables: list = field(default_factory=list)
+
+
+@dataclass
+class AdminStmt(StmtNode):
+    tp: str = ""             # show_ddl / check_table
+    tables: list = field(default_factory=list)

@@ -1,0 +1,1155 @@
+"""Recursive-descent SQL parser (MySQL dialect subset).
+
+Reference: /root/reference/parser/parser.y (6,404-line goyacc LALR grammar).
+Deliberately NOT a grammar port (SURVEY.md §7 stage 4: "do not rebuild the
+6.4k-line grammar; grow it feature-by-feature"): a hand-written
+Pratt/recursive-descent parser covering the SQL surface the framework
+executes — TPC-H-class SELECT (joins, subqueries, aggregates, CASE),
+DML, DDL, txn control, SET/SHOW/EXPLAIN/ANALYZE/ADMIN.
+"""
+
+from __future__ import annotations
+
+import decimal
+
+from tidb_tpu import sqltypes as st
+from tidb_tpu.parser import ast
+from tidb_tpu.parser.lexer import Lexer, Token, TokenType
+
+__all__ = ["parse", "parse_one", "ParseError"]
+
+
+class ParseError(Exception):
+    def __init__(self, msg: str, tok: Token | None = None):
+        if tok is not None:
+            msg = f"{msg} near {tok.val!r} (pos {tok.pos})"
+        super().__init__(msg)
+
+
+def parse(sql: str) -> list[ast.StmtNode]:
+    """Parse a semicolon-separated statement list.
+    Ref: parser.Parse (parser/yy_parser.go:88) -> []ast.StmtNode."""
+    toks = Lexer(sql).tokens()
+    p = Parser(toks)
+    stmts = []
+    while not p.at_eof():
+        if p.try_op(";"):
+            continue
+        stmts.append(p.statement())
+        if not p.at_eof():
+            p.expect_op(";")
+    return stmts
+
+
+def parse_one(sql: str) -> ast.StmtNode:
+    stmts = parse(sql)
+    if len(stmts) != 1:
+        raise ParseError(f"expected one statement, got {len(stmts)}")
+    return stmts[0]
+
+
+_AGG_FUNCS = {"COUNT", "SUM", "AVG", "MIN", "MAX", "GROUP_CONCAT",
+              "BIT_AND", "BIT_OR", "BIT_XOR"}
+
+_CMP_OPS = {"=", "<", "<=", ">", ">=", "<>", "!=", "<=>"}
+
+
+MAX_EXPR_DEPTH = 64  # explicit cap: clean error instead of RecursionError
+
+
+class Parser:
+    def __init__(self, toks: list[Token]):
+        self.toks = toks
+        self.i = 0
+        self.depth = 0
+
+    # -- token helpers -------------------------------------------------------
+
+    def peek(self, k: int = 0) -> Token:
+        j = min(self.i + k, len(self.toks) - 1)
+        return self.toks[j]
+
+    def next(self) -> Token:
+        t = self.toks[self.i]
+        if t.tp != TokenType.EOF:
+            self.i += 1
+        return t
+
+    def at_eof(self) -> bool:
+        return self.peek().tp == TokenType.EOF
+
+    def try_kw(self, *kws: str) -> bool:
+        t = self.peek()
+        if t.tp == TokenType.KEYWORD and t.val in kws:
+            self.next()
+            return True
+        return False
+
+    def expect_kw(self, kw: str) -> None:
+        if not self.try_kw(kw):
+            raise ParseError(f"expected {kw}", self.peek())
+
+    def try_op(self, op: str) -> bool:
+        t = self.peek()
+        if t.tp == TokenType.OP and t.val == op:
+            self.next()
+            return True
+        return False
+
+    def expect_op(self, op: str) -> None:
+        if not self.try_op(op):
+            raise ParseError(f"expected {op!r}", self.peek())
+
+    def ident(self) -> str:
+        t = self.peek()
+        if t.tp == TokenType.IDENT:
+            self.next()
+            return t.val
+        # many keywords double as identifiers in practice
+        if t.tp == TokenType.KEYWORD and t.val not in (
+                "SELECT", "FROM", "WHERE", "AND", "OR", "NOT"):
+            self.next()
+            return t.val.lower()
+        raise ParseError("expected identifier", t)
+
+    # -- statements ----------------------------------------------------------
+
+    def statement(self) -> ast.StmtNode:
+        t = self.peek()
+        if t.tp != TokenType.KEYWORD and not (t.tp == TokenType.OP and
+                                              t.val == "("):
+            raise ParseError("expected statement", t)
+        kw = t.val
+        if kw == "SELECT" or kw == "(":
+            return self.select_or_union()
+        if kw in ("INSERT", "REPLACE"):
+            return self.insert()
+        if kw == "UPDATE":
+            return self.update()
+        if kw == "DELETE":
+            return self.delete()
+        if kw == "CREATE":
+            return self.create()
+        if kw == "DROP":
+            return self.drop()
+        if kw == "ALTER":
+            return self.alter()
+        if kw == "TRUNCATE":
+            self.next()
+            self.try_kw("TABLE")
+            return ast.TruncateTableStmt(table=self.table_name())
+        if kw == "RENAME":
+            return self.rename()
+        if kw == "USE":
+            self.next()
+            return ast.UseStmt(db=self.ident())
+        if kw == "BEGIN":
+            self.next()
+            return ast.BeginStmt()
+        if kw == "START":
+            self.next()
+            self.expect_kw("TRANSACTION")
+            return ast.BeginStmt()
+        if kw == "COMMIT":
+            self.next()
+            return ast.CommitStmt()
+        if kw == "ROLLBACK":
+            self.next()
+            return ast.RollbackStmt()
+        if kw == "SET":
+            return self.set_stmt()
+        if kw == "SHOW":
+            return self.show()
+        if kw in ("EXPLAIN", "DESCRIBE"):
+            self.next()
+            if self.peek().tp in (TokenType.IDENT,) or (
+                    self.peek().tp == TokenType.KEYWORD and
+                    self.peek().val not in ("SELECT", "INSERT", "UPDATE",
+                                            "DELETE", "EXTENDED")):
+                # DESCRIBE <table>
+                return ast.ShowStmt(tp="columns", table=self.table_name())
+            self.try_kw("EXTENDED")
+            return ast.ExplainStmt(stmt=self.statement())
+        if kw == "ANALYZE":
+            self.next()
+            self.expect_kw("TABLE")
+            tables = [self.table_name()]
+            while self.try_op(","):
+                tables.append(self.table_name())
+            return ast.AnalyzeStmt(tables=tables)
+        if kw == "ADMIN":
+            self.next()
+            if self.try_kw("SHOW"):
+                if self.peek().tp == TokenType.IDENT and \
+                        self.peek().val.upper() == "DDL":
+                    self.next()
+                return ast.AdminStmt(tp="show_ddl")
+            self.expect_kw("CHECK")
+            self.expect_kw("TABLE")
+            tables = [self.table_name()]
+            while self.try_op(","):
+                tables.append(self.table_name())
+            return ast.AdminStmt(tp="check_table", tables=tables)
+        raise ParseError("unsupported statement", t)
+
+    # -- SELECT --------------------------------------------------------------
+
+    def select_or_union(self) -> ast.StmtNode:
+        first = self.select_core()
+        if not (self.peek().is_kw("UNION")):
+            return first
+        selects = [first]
+        alls = []
+        while self.try_kw("UNION"):
+            is_all = self.try_kw("ALL")
+            self.try_kw("DISTINCT")
+            alls.append(is_all)
+            selects.append(self.select_core())
+        u = ast.UnionStmt(selects=selects, alls=alls)
+        if self.try_kw("ORDER"):
+            self.expect_kw("BY")
+            u.order_by = self.by_list()
+        if self.try_kw("LIMIT"):
+            u.limit, u.offset = self.limit_clause()
+        return u
+
+    def select_core(self) -> ast.SelectStmt:
+        if self.try_op("("):
+            s = self.select_or_union()
+            self.expect_op(")")
+            return s
+        self.expect_kw("SELECT")
+        s = ast.SelectStmt()
+        s.distinct = self.try_kw("DISTINCT")
+        self.try_kw("ALL")
+        s.fields.append(self.select_field())
+        while self.try_op(","):
+            s.fields.append(self.select_field())
+        if self.try_kw("FROM"):
+            s.from_clause = self.table_refs()
+        if self.try_kw("WHERE"):
+            s.where = self.expr()
+        if self.try_kw("GROUP"):
+            self.expect_kw("BY")
+            s.group_by = self.by_list()
+        if self.try_kw("HAVING"):
+            s.having = self.expr()
+        if self.try_kw("ORDER"):
+            self.expect_kw("BY")
+            s.order_by = self.by_list()
+        if self.try_kw("LIMIT"):
+            s.limit, s.offset = self.limit_clause()
+        if self.try_kw("FOR"):
+            self.expect_kw("UPDATE")
+            s.for_update = True
+        return s
+
+    def select_field(self) -> ast.SelectField:
+        t = self.peek()
+        if t.tp == TokenType.OP and t.val == "*":
+            self.next()
+            return ast.SelectField(expr=ast.Star())
+        # t.* form
+        if t.tp == TokenType.IDENT and self.peek(1).val == "." and \
+                self.peek(2).val == "*":
+            self.next(); self.next(); self.next()
+            return ast.SelectField(expr=ast.Star(table=t.val))
+        e = self.expr()
+        alias = ""
+        if self.try_kw("AS"):
+            alias = self.ident()
+        elif self.peek().tp == TokenType.IDENT:
+            alias = self.ident()
+        return ast.SelectField(expr=e, alias=alias)
+
+    def by_list(self) -> list[ast.ByItem]:
+        items = [self.by_item()]
+        while self.try_op(","):
+            items.append(self.by_item())
+        return items
+
+    def by_item(self) -> ast.ByItem:
+        e = self.expr()
+        desc = False
+        if self.try_kw("DESC"):
+            desc = True
+        else:
+            self.try_kw("ASC")
+        return ast.ByItem(expr=e, desc=desc)
+
+    def limit_clause(self) -> tuple[int, int]:
+        a = self._int_lit()
+        if self.try_op(","):
+            return self._int_lit(), a       # LIMIT offset, count
+        if self.try_kw("OFFSET"):
+            return a, self._int_lit()
+        return a, 0
+
+    def _int_lit(self) -> int:
+        t = self.next()
+        if t.tp != TokenType.INT:
+            raise ParseError("expected integer", t)
+        return int(t.val)
+
+    # -- table refs ----------------------------------------------------------
+
+    def table_refs(self):
+        left = self.table_ref()
+        while True:
+            if self.try_op(","):
+                right = self.table_ref()
+                left = ast.Join(left, right, ast.JoinType.CROSS)
+            elif self.peek().is_kw("JOIN") or self.peek().is_kw("INNER") or \
+                    self.peek().is_kw("CROSS") or self.peek().is_kw("LEFT") \
+                    or self.peek().is_kw("RIGHT"):
+                left = self._join_rest(left)
+            else:
+                return left
+
+    def _join_rest(self, left):
+        tp = ast.JoinType.INNER
+        if self.try_kw("LEFT"):
+            tp = ast.JoinType.LEFT
+            self.try_kw("OUTER")
+        elif self.try_kw("RIGHT"):
+            tp = ast.JoinType.RIGHT
+            self.try_kw("OUTER")
+        elif self.try_kw("CROSS"):
+            tp = ast.JoinType.CROSS
+        else:
+            self.try_kw("INNER")
+        self.expect_kw("JOIN")
+        right = self.table_ref()
+        j = ast.Join(left, right, tp)
+        if self.try_kw("ON"):
+            j.on = self.expr()
+        elif self.try_kw("USING"):
+            self.expect_op("(")
+            j.using = [self.ident()]
+            while self.try_op(","):
+                j.using.append(self.ident())
+            self.expect_op(")")
+        return j
+
+    def table_ref(self):
+        if self.try_op("("):
+            if self.peek().is_kw("SELECT"):
+                sub = self.select_or_union()
+                self.expect_op(")")
+                alias = ""
+                self.try_kw("AS")
+                if self.peek().tp == TokenType.IDENT:
+                    alias = self.ident()
+                return ast.SubqueryTable(select=sub, alias=alias)
+            inner = self.table_refs()
+            self.expect_op(")")
+            return inner
+        ts = self.table_name()
+        if self.try_kw("AS"):
+            ts.alias = self.ident()
+        elif self.peek().tp == TokenType.IDENT:
+            ts.alias = self.ident()
+        return ts
+
+    def table_name(self) -> ast.TableSource:
+        a = self.ident()
+        if self.try_op("."):
+            return ast.TableSource(name=self.ident(), db=a)
+        return ast.TableSource(name=a)
+
+    # -- INSERT / UPDATE / DELETE -------------------------------------------
+
+    def insert(self) -> ast.InsertStmt:
+        is_replace = self.peek().val == "REPLACE"
+        self.next()
+        stmt = ast.InsertStmt(is_replace=is_replace)
+        stmt.ignore = self.try_kw("IGNORE")
+        self.try_kw("INTO")
+        stmt.table = self.table_name()
+        if self.peek().tp == TokenType.OP and self.peek().val == "(":
+            # could be column list or SELECT
+            if self.peek(1).is_kw("SELECT"):
+                self.next()
+                stmt.select = self.select_or_union()
+                self.expect_op(")")
+                return stmt
+            self.expect_op("(")
+            stmt.columns.append(self.ident())
+            while self.try_op(","):
+                stmt.columns.append(self.ident())
+            self.expect_op(")")
+        if self.try_kw("VALUES") or self.try_kw("VALUE"):
+            stmt.values.append(self.value_row())
+            while self.try_op(","):
+                stmt.values.append(self.value_row())
+        elif self.peek().is_kw("SELECT"):
+            stmt.select = self.select_or_union()
+        elif self.try_kw("SET"):
+            row = []
+            while True:
+                c = self.column_name()
+                self.expect_op("=")
+                stmt.columns.append(c.name)
+                row.append(self.expr())
+                if not self.try_op(","):
+                    break
+            stmt.values = [row]
+        else:
+            raise ParseError("expected VALUES or SELECT", self.peek())
+        if self.try_kw("ON"):
+            self.expect_kw("DUPLICATE")
+            self.expect_kw("KEY")
+            self.expect_kw("UPDATE")
+            stmt.on_duplicate.append(self.assignment())
+            while self.try_op(","):
+                stmt.on_duplicate.append(self.assignment())
+        return stmt
+
+    def value_row(self) -> list:
+        self.expect_op("(")
+        if self.try_op(")"):
+            return []
+        row = [self.expr_or_default()]
+        while self.try_op(","):
+            row.append(self.expr_or_default())
+        self.expect_op(")")
+        return row
+
+    def expr_or_default(self):
+        if self.try_kw("DEFAULT"):
+            return ast.DefaultExpr()
+        return self.expr()
+
+    def assignment(self) -> ast.Assignment:
+        c = self.column_name()
+        self.expect_op("=")
+        return ast.Assignment(col=c, expr=self.expr())
+
+    def update(self) -> ast.UpdateStmt:
+        self.expect_kw("UPDATE")
+        stmt = ast.UpdateStmt()
+        stmt.table = self.table_refs()
+        self.expect_kw("SET")
+        stmt.assignments.append(self.assignment())
+        while self.try_op(","):
+            stmt.assignments.append(self.assignment())
+        if self.try_kw("WHERE"):
+            stmt.where = self.expr()
+        if self.try_kw("ORDER"):
+            self.expect_kw("BY")
+            stmt.order_by = self.by_list()
+        if self.try_kw("LIMIT"):
+            stmt.limit, _ = self.limit_clause()
+        return stmt
+
+    def delete(self) -> ast.DeleteStmt:
+        self.expect_kw("DELETE")
+        self.expect_kw("FROM")
+        stmt = ast.DeleteStmt(table=self.table_name())
+        if self.try_kw("WHERE"):
+            stmt.where = self.expr()
+        if self.try_kw("ORDER"):
+            self.expect_kw("BY")
+            stmt.order_by = self.by_list()
+        if self.try_kw("LIMIT"):
+            stmt.limit, _ = self.limit_clause()
+        return stmt
+
+    # -- DDL -----------------------------------------------------------------
+
+    def create(self) -> ast.StmtNode:
+        self.expect_kw("CREATE")
+        if self.try_kw("DATABASE") or self.try_kw("SCHEMA"):
+            ine = self._if_not_exists()
+            return ast.CreateDatabaseStmt(name=self.ident(),
+                                          if_not_exists=ine)
+        unique = self.try_kw("UNIQUE")
+        if self.try_kw("INDEX"):
+            name = self.ident()
+            self.expect_kw("ON")
+            table = self.table_name()
+            self.expect_op("(")
+            cols = [self.ident()]
+            while self.try_op(","):
+                cols.append(self.ident())
+            self.expect_op(")")
+            return ast.CreateIndexStmt(index_name=name, table=table,
+                                       columns=cols, unique=unique)
+        if unique:
+            raise ParseError("expected INDEX after UNIQUE", self.peek())
+        self.try_kw("TEMPORARY")
+        self.expect_kw("TABLE")
+        ine = self._if_not_exists()
+        stmt = ast.CreateTableStmt(table=self.table_name(),
+                                   if_not_exists=ine)
+        self.expect_op("(")
+        while True:
+            if self.try_kw("PRIMARY"):
+                self.expect_kw("KEY")
+                stmt.indexes.append(ast.IndexDef(
+                    name="PRIMARY", columns=self._paren_idents(),
+                    unique=True, primary=True))
+            elif self.try_kw("UNIQUE"):
+                self.try_kw("KEY") or self.try_kw("INDEX")
+                name = "" if self.peek().val == "(" else self.ident()
+                stmt.indexes.append(ast.IndexDef(
+                    name=name, columns=self._paren_idents(), unique=True))
+            elif self.try_kw("KEY") or self.try_kw("INDEX"):
+                name = "" if self.peek().val == "(" else self.ident()
+                stmt.indexes.append(ast.IndexDef(
+                    name=name, columns=self._paren_idents()))
+            elif self.try_kw("CONSTRAINT"):
+                # CONSTRAINT [name] UNIQUE/PRIMARY/FOREIGN KEY ...
+                if self.peek().tp == TokenType.IDENT:
+                    self.ident()
+                continue
+            elif self.try_kw("FOREIGN"):
+                self.expect_kw("KEY")
+                self._paren_idents()
+                self.expect_kw("REFERENCES")
+                self.table_name()
+                self._paren_idents()
+                # FK constraints parsed + ignored (reference also defers FKs)
+            else:
+                stmt.columns.append(self.column_def())
+            if not self.try_op(","):
+                break
+        self.expect_op(")")
+        # table options
+        while self.peek().tp == TokenType.KEYWORD and self.peek().val in (
+                "ENGINE", "CHARSET", "COLLATE", "COMMENT", "AUTO_INCREMENT"):
+            opt = self.next().val
+            self.try_op("=")
+            v = self.next().val
+            stmt.options[opt.lower()] = v
+        if self.try_kw("DEFAULT"):
+            while self.peek().val in ("CHARSET", "COLLATE"):
+                opt = self.next().val
+                self.try_op("=")
+                stmt.options[opt.lower()] = self.next().val
+        return stmt
+
+    def _if_not_exists(self) -> bool:
+        if self.try_kw("IF"):
+            self.expect_kw("NOT")
+            self.expect_kw("EXISTS")
+            return True
+        return False
+
+    def _paren_idents(self) -> list[str]:
+        self.expect_op("(")
+        out = [self.ident()]
+        # ignore optional key length e.g. col(10)
+        if self.try_op("("):
+            self._int_lit()
+            self.expect_op(")")
+        while self.try_op(","):
+            out.append(self.ident())
+            if self.try_op("("):
+                self._int_lit()
+                self.expect_op(")")
+        self.expect_op(")")
+        return out
+
+    def column_def(self) -> ast.ColumnDef:
+        name = self.ident()
+        ft = self.field_type()
+        d = ast.ColumnDef(name=name, ft=ft)
+        flags = ft.flags
+        while True:
+            if self.try_kw("NOT"):
+                self.expect_kw("NULL")
+                flags |= st.Flag.NOT_NULL
+            elif self.try_kw("NULL"):
+                pass
+            elif self.try_kw("DEFAULT"):
+                d.default = self.expr_or_null_literal()
+                d.has_default = True
+            elif self.try_kw("AUTO_INCREMENT"):
+                d.auto_increment = True
+                flags |= st.Flag.AUTO_INCREMENT
+            elif self.try_kw("PRIMARY"):
+                self.expect_kw("KEY")
+                d.is_primary = True
+                flags |= st.Flag.PRI_KEY | st.Flag.NOT_NULL
+            elif self.try_kw("UNIQUE"):
+                self.try_kw("KEY")
+                d.is_unique = True
+                flags |= st.Flag.UNIQUE_KEY
+            elif self.try_kw("KEY"):
+                pass
+            elif self.try_kw("COMMENT"):
+                d.comment = self.next().val
+            elif self.try_kw("COLLATE") or self.try_kw("CHARSET"):
+                self.next()
+            else:
+                break
+        d.ft = ft.with_flags(flags)
+        return d
+
+    def expr_or_null_literal(self):
+        if self.try_kw("NULL"):
+            return ast.Literal(None)
+        return self.expr()
+
+    def field_type(self) -> st.FieldType:
+        t = self.next()
+        if t.tp != TokenType.KEYWORD:
+            raise ParseError("expected type", t)
+        name = t.val
+        # two-word type names are consumed up front, before length/flags
+        if name == "DOUBLE":
+            self.try_kw("PRECISION")
+        if name == "CHAR":
+            self.try_kw("VARYING")
+        flen, frac = -1, -1
+        if self.try_op("("):
+            flen = self._int_lit()
+            if self.try_op(","):
+                frac = self._int_lit()
+            self.expect_op(")")
+        flags = 0
+        while True:
+            if self.try_kw("UNSIGNED"):
+                flags |= st.Flag.UNSIGNED
+            elif self.try_kw("SIGNED") or self.try_kw("ZEROFILL"):
+                pass
+            else:
+                break
+        TC = st.TypeCode
+        mapping = {
+            "INT": TC.LONG, "INTEGER": TC.LONG, "BIGINT": TC.LONGLONG,
+            "SMALLINT": TC.SHORT, "TINYINT": TC.TINY, "MEDIUMINT": TC.INT24,
+            "BOOL": TC.TINY, "BOOLEAN": TC.TINY,
+            "FLOAT": TC.FLOAT, "DOUBLE": TC.DOUBLE, "REAL": TC.DOUBLE,
+            "DECIMAL": TC.NEWDECIMAL, "NUMERIC": TC.NEWDECIMAL,
+            "CHAR": TC.STRING, "VARCHAR": TC.VARCHAR, "TEXT": TC.BLOB,
+            "BLOB": TC.BLOB, "BINARY": TC.STRING,
+            "DATE": TC.DATE, "DATETIME": TC.DATETIME,
+            "TIMESTAMP": TC.TIMESTAMP, "TIME": TC.DURATION,
+            "YEAR": TC.YEAR,
+        }
+        if name not in mapping:
+            raise ParseError(f"unsupported type {name}", t)
+        tp = mapping[name]
+        if tp == TC.NEWDECIMAL:
+            if flen < 0:
+                flen = 10
+            if frac < 0:
+                frac = 0
+        return st.FieldType(tp, flags=flags, flen=flen, frac=frac)
+
+    def drop(self) -> ast.StmtNode:
+        self.expect_kw("DROP")
+        if self.try_kw("DATABASE") or self.try_kw("SCHEMA"):
+            ie = self._if_exists()
+            return ast.DropDatabaseStmt(name=self.ident(), if_exists=ie)
+        if self.try_kw("INDEX"):
+            name = self.ident()
+            self.expect_kw("ON")
+            return ast.DropIndexStmt(index_name=name,
+                                     table=self.table_name())
+        self.expect_kw("TABLE")
+        ie = self._if_exists()
+        tables = [self.table_name()]
+        while self.try_op(","):
+            tables.append(self.table_name())
+        return ast.DropTableStmt(tables=tables, if_exists=ie)
+
+    def _if_exists(self) -> bool:
+        if self.try_kw("IF"):
+            self.expect_kw("EXISTS")
+            return True
+        return False
+
+    def alter(self) -> ast.AlterTableStmt:
+        self.expect_kw("ALTER")
+        self.expect_kw("TABLE")
+        stmt = ast.AlterTableStmt(table=self.table_name())
+        while True:
+            stmt.specs.append(self.alter_spec())
+            if not self.try_op(","):
+                break
+        return stmt
+
+    def alter_spec(self) -> ast.AlterSpec:
+        if self.try_kw("ADD"):
+            if self.try_kw("INDEX") or self.try_kw("KEY"):
+                name = "" if self.peek().val == "(" else self.ident()
+                return ast.AlterSpec(tp="add_index", index=ast.IndexDef(
+                    name=name, columns=self._paren_idents()))
+            if self.try_kw("UNIQUE"):
+                self.try_kw("INDEX") or self.try_kw("KEY")
+                name = "" if self.peek().val == "(" else self.ident()
+                return ast.AlterSpec(tp="add_index", index=ast.IndexDef(
+                    name=name, columns=self._paren_idents(), unique=True))
+            if self.try_kw("PRIMARY"):
+                self.expect_kw("KEY")
+                return ast.AlterSpec(tp="add_index", index=ast.IndexDef(
+                    name="PRIMARY", columns=self._paren_idents(),
+                    unique=True, primary=True))
+            self.try_kw("COLUMN")
+            spec = ast.AlterSpec(tp="add_column", column=self.column_def())
+            if self.try_kw("FIRST"):
+                spec.position = "first"
+            elif self.try_kw("AFTER"):
+                spec.position = "after"
+                spec.after_col = self.ident()
+            return spec
+        if self.try_kw("DROP"):
+            if self.try_kw("INDEX") or self.try_kw("KEY"):
+                return ast.AlterSpec(tp="drop_index", name=self.ident())
+            if self.try_kw("PRIMARY"):
+                self.expect_kw("KEY")
+                return ast.AlterSpec(tp="drop_index", name="PRIMARY")
+            self.try_kw("COLUMN")
+            return ast.AlterSpec(tp="drop_column", name=self.ident())
+        if self.try_kw("MODIFY"):
+            self.try_kw("COLUMN")
+            return ast.AlterSpec(tp="modify_column", column=self.column_def())
+        if self.try_kw("CHANGE"):
+            self.try_kw("COLUMN")
+            old = self.ident()
+            spec = ast.AlterSpec(tp="change_column",
+                                 column=self.column_def())
+            spec.name = old
+            return spec
+        if self.try_kw("RENAME"):
+            self.try_kw("TO") or self.try_kw("AS")
+            return ast.AlterSpec(tp="rename", name=self.ident())
+        raise ParseError("unsupported ALTER spec", self.peek())
+
+    def rename(self) -> ast.RenameTableStmt:
+        self.expect_kw("RENAME")
+        self.expect_kw("TABLE")
+        pairs = []
+        while True:
+            old = self.table_name()
+            self.expect_kw("TO")
+            pairs.append((old, self.table_name()))
+            if not self.try_op(","):
+                break
+        return ast.RenameTableStmt(pairs=pairs)
+
+    # -- SET / SHOW ----------------------------------------------------------
+
+    def set_stmt(self) -> ast.SetStmt:
+        self.expect_kw("SET")
+        stmt = ast.SetStmt()
+        while True:
+            va = ast.VarAssignment(name="")
+            if self.try_kw("GLOBAL"):
+                va.is_global = True
+                va.is_system = True
+                va.name = self.ident()
+            elif self.try_kw("SESSION"):
+                va.is_system = True
+                va.name = self.ident()
+            elif self.try_op("@"):
+                if self.try_op("@"):
+                    va.is_system = True
+                    # @@global.x / @@session.x / @@x
+                    nm = self.ident()
+                    if nm in ("global", "session") and self.try_op("."):
+                        va.is_global = nm == "global"
+                        nm = self.ident()
+                    va.name = nm
+                else:
+                    va.name = "@" + self.ident()
+            else:
+                va.is_system = True
+                va.name = self.ident()
+            if not (self.try_op("=") or self.try_op(":=")):
+                raise ParseError("expected =", self.peek())
+            va.value = self.expr()
+            stmt.assignments.append(va)
+            if not self.try_op(","):
+                return stmt
+
+    def show(self) -> ast.ShowStmt:
+        self.expect_kw("SHOW")
+        s = ast.ShowStmt()
+        if self.try_kw("GLOBAL"):
+            s.is_global = True
+        else:
+            self.try_kw("SESSION")
+        if self.try_kw("DATABASES") or self.try_kw("SCHEMA"):
+            s.tp = "databases"
+        elif self.try_kw("TABLES"):
+            s.tp = "tables"
+            if self.try_kw("FROM"):
+                s.db = self.ident()
+        elif self.try_kw("CREATE"):
+            self.expect_kw("TABLE")
+            s.tp = "create_table"
+            s.table = self.table_name()
+        elif self.try_kw("COLUMNS") or self.try_kw("FIELDS"):
+            s.tp = "columns"
+            self.expect_kw("FROM")
+            s.table = self.table_name()
+        elif self.try_kw("VARIABLES"):
+            s.tp = "variables"
+        elif self.try_kw("STATUS"):
+            s.tp = "status"
+        elif self.try_kw("ENGINES"):
+            s.tp = "engines"
+        elif self.try_kw("COLLATION"):
+            s.tp = "collation"
+        else:
+            raise ParseError("unsupported SHOW", self.peek())
+        if self.try_kw("LIKE"):
+            t = self.next()
+            s.pattern = t.val
+        elif self.try_kw("WHERE"):
+            s.where = self.expr()
+        return s
+
+    # -- expressions (Pratt-ish precedence ladder) --------------------------
+
+    def expr(self) -> ast.ExprNode:
+        self.depth += 1
+        if self.depth > MAX_EXPR_DEPTH:
+            raise ParseError("expression too deeply nested", self.peek())
+        try:
+            return self.or_expr()
+        finally:
+            self.depth -= 1
+
+    def or_expr(self):
+        left = self.xor_expr()
+        while True:
+            if self.try_kw("OR") or self.try_op("||"):
+                left = ast.BinaryOp("OR", left, self.xor_expr())
+            else:
+                return left
+
+    def xor_expr(self):
+        left = self.and_expr()
+        while self.try_kw("XOR"):
+            left = ast.BinaryOp("XOR", left, self.and_expr())
+        return left
+
+    def and_expr(self):
+        left = self.not_expr()
+        while True:
+            if self.try_kw("AND") or self.try_op("&&"):
+                left = ast.BinaryOp("AND", left, self.not_expr())
+            else:
+                return left
+
+    def not_expr(self):
+        if self.try_kw("NOT"):
+            return ast.UnaryOp("NOT", self.not_expr())
+        return self.predicate()
+
+    def predicate(self):
+        left = self.bit_or_expr()
+        while True:
+            t = self.peek()
+            if t.tp == TokenType.OP and t.val in _CMP_OPS:
+                self.next()
+                left = ast.BinaryOp(t.val, left, self.bit_or_expr())
+                continue
+            if t.is_kw("IS"):
+                self.next()
+                neg = self.try_kw("NOT")
+                if self.try_kw("NULL"):
+                    left = ast.IsNullExpr(expr=left, negated=neg)
+                elif self.try_kw("TRUE"):
+                    # null-safe desugar: x IS TRUE == IFNULL(x,0) <> 0
+                    # (a plain '= 1' would yield NULL for NULL, not 0)
+                    e = ast.BinaryOp("<>", ast.FuncCall(
+                        name="IFNULL", args=[left, ast.Literal(0)]),
+                        ast.Literal(0))
+                    left = ast.UnaryOp("NOT", e) if neg else e
+                elif self.try_kw("FALSE"):
+                    # x IS FALSE == IFNULL(x,1) = 0
+                    e = ast.BinaryOp("=", ast.FuncCall(
+                        name="IFNULL", args=[left, ast.Literal(1)]),
+                        ast.Literal(0))
+                    left = ast.UnaryOp("NOT", e) if neg else e
+                else:
+                    raise ParseError("expected NULL/TRUE/FALSE", self.peek())
+                continue
+            neg = False
+            j = self.i
+            if t.is_kw("NOT"):
+                self.next()
+                neg = True
+                t = self.peek()
+            if t.is_kw("IN"):
+                self.next()
+                self.expect_op("(")
+                if self.peek().is_kw("SELECT"):
+                    sub = self.select_or_union()
+                    self.expect_op(")")
+                    left = ast.InExpr(expr=left,
+                                      items=ast.SubqueryExpr(select=sub),
+                                      negated=neg)
+                else:
+                    items = [self.expr()]
+                    while self.try_op(","):
+                        items.append(self.expr())
+                    self.expect_op(")")
+                    left = ast.InExpr(expr=left, items=items, negated=neg)
+                continue
+            if t.is_kw("BETWEEN"):
+                self.next()
+                low = self.bit_or_expr()
+                self.expect_kw("AND")
+                high = self.bit_or_expr()
+                left = ast.BetweenExpr(expr=left, low=low, high=high,
+                                       negated=neg)
+                continue
+            if t.is_kw("LIKE"):
+                self.next()
+                left = ast.LikeExpr(expr=left, pattern=self.bit_or_expr(),
+                                    negated=neg)
+                continue
+            if neg:
+                self.i = j  # lone NOT belongs to a higher level
+            return left
+
+    def bit_or_expr(self):
+        left = self.bit_and_expr()
+        while self.peek().tp == TokenType.OP and self.peek().val == "|":
+            self.next()
+            left = ast.BinaryOp("|", left, self.bit_and_expr())
+        return left
+
+    def bit_and_expr(self):
+        left = self.shift_expr()
+        while self.peek().tp == TokenType.OP and self.peek().val == "&":
+            self.next()
+            left = ast.BinaryOp("&", left, self.shift_expr())
+        return left
+
+    def shift_expr(self):
+        left = self.add_expr()
+        while self.peek().tp == TokenType.OP and self.peek().val in ("<<", ">>"):
+            op = self.next().val
+            left = ast.BinaryOp(op, left, self.add_expr())
+        return left
+
+    def add_expr(self):
+        left = self.mul_expr()
+        while self.peek().tp == TokenType.OP and self.peek().val in ("+", "-"):
+            op = self.next().val
+            left = ast.BinaryOp(op, left, self.mul_expr())
+        return left
+
+    def mul_expr(self):
+        left = self.unary_expr()
+        while True:
+            t = self.peek()
+            if t.tp == TokenType.OP and t.val in ("*", "/", "%"):
+                self.next()
+                left = ast.BinaryOp(t.val, left, self.unary_expr())
+            elif t.is_kw("DIV") or t.is_kw("MOD"):
+                self.next()
+                left = ast.BinaryOp(t.val, left, self.unary_expr())
+            else:
+                return left
+
+    def unary_expr(self):
+        t = self.peek()
+        if t.tp == TokenType.OP and t.val in ("-", "+", "~", "!"):
+            self.next()
+            if t.val == "+":
+                return self.unary_expr()
+            if t.val == "!":
+                return ast.UnaryOp("NOT", self.unary_expr())
+            return ast.UnaryOp(t.val, self.unary_expr())
+        return self.primary()
+
+    def primary(self) -> ast.ExprNode:
+        t = self.peek()
+        if t.tp == TokenType.INT:
+            self.next()
+            return ast.Literal(int(t.val))
+        if t.tp == TokenType.DECIMAL:
+            self.next()
+            return ast.Literal(decimal.Decimal(t.val))
+        if t.tp == TokenType.FLOAT:
+            self.next()
+            return ast.Literal(float(t.val))
+        if t.tp == TokenType.STRING:
+            self.next()
+            return ast.Literal(t.val)
+        if t.tp == TokenType.OP and t.val == "(":
+            self.next()
+            if self.peek().is_kw("SELECT"):
+                sub = self.select_or_union()
+                self.expect_op(")")
+                return ast.SubqueryExpr(select=sub)
+            e = self.expr()
+            if self.try_op(","):
+                items = [e, self.expr()]
+                while self.try_op(","):
+                    items.append(self.expr())
+                self.expect_op(")")
+                return ast.RowExpr(items=items)
+            self.expect_op(")")
+            return e
+        if t.tp == TokenType.OP and t.val == "@":
+            self.next()
+            if self.try_op("@"):
+                nm = self.ident()
+                is_global = False
+                if nm in ("global", "session") and self.try_op("."):
+                    is_global = nm == "global"
+                    nm = self.ident()
+                return ast.VariableExpr(name=nm, is_global=is_global,
+                                        is_system=True)
+            return ast.VariableExpr(name=self.ident())
+        if t.tp == TokenType.OP and t.val == "?":
+            self.next()
+            return ast.ParamMarker()
+        if t.tp == TokenType.KEYWORD:
+            return self._keyword_primary(t)
+        if t.tp == TokenType.IDENT:
+            return self._ident_primary()
+        raise ParseError("expected expression", t)
+
+    def _keyword_primary(self, t: Token) -> ast.ExprNode:
+        kw = t.val
+        if kw == "NULL":
+            self.next()
+            return ast.Literal(None)
+        if kw == "TRUE":
+            self.next()
+            return ast.Literal(1)
+        if kw == "FALSE":
+            self.next()
+            return ast.Literal(0)
+        if kw == "CASE":
+            return self.case_expr()
+        if kw in ("CAST", "CONVERT"):
+            self.next()
+            self.expect_op("(")
+            e = self.expr()
+            if kw == "CAST":
+                self.expect_kw("AS")
+                ft = self.cast_type()
+            else:
+                self.expect_op(",")
+                ft = self.cast_type()
+            self.expect_op(")")
+            return ast.CastExpr(expr=e, ft=ft)
+        if kw == "EXISTS":
+            self.next()
+            self.expect_op("(")
+            sub = self.select_or_union()
+            self.expect_op(")")
+            return ast.ExistsSubquery(select=sub)
+        if kw == "INTERVAL":
+            # INTERVAL n DAY — only inside date_add/sub handled there
+            raise ParseError("INTERVAL outside date arithmetic", t)
+        if kw in ("IF", "IFNULL", "COALESCE", "NULLIF", "REPLACE", "LEFT",
+                  "RIGHT", "YEAR", "DATE", "TIME", "DEFAULT", "DATABASE",
+                  "CHARSET", "MOD", "TRUNCATE"):
+            # keyword-named functions
+            if self.peek(1).tp == TokenType.OP and self.peek(1).val == "(":
+                self.next()
+                return self.func_call(kw)
+        if kw in ("DISTINCT",):
+            raise ParseError("unexpected DISTINCT", t)
+        # treat as identifier-ish (e.g. DATE literal qualifier)
+        return self._ident_primary()
+
+    def case_expr(self) -> ast.CaseExpr:
+        self.expect_kw("CASE")
+        operand = None
+        if not self.peek().is_kw("WHEN"):
+            operand = self.expr()
+        whens = []
+        while self.try_kw("WHEN"):
+            c = self.expr()
+            self.expect_kw("THEN")
+            whens.append((c, self.expr()))
+        els = None
+        if self.try_kw("ELSE"):
+            els = self.expr()
+        self.expect_kw("END")
+        return ast.CaseExpr(operand=operand, when_clauses=whens,
+                            else_clause=els)
+
+    def cast_type(self) -> st.FieldType:
+        t = self.next()
+        name = t.val
+        TC = st.TypeCode
+        flen = frac = -1
+        if self.try_op("("):
+            flen = self._int_lit()
+            if self.try_op(","):
+                frac = self._int_lit()
+            self.expect_op(")")
+        if name in ("SIGNED", "INT", "INTEGER"):
+            self.try_kw("INTEGER")
+            return st.new_int_field()
+        if name == "UNSIGNED":
+            self.try_kw("INTEGER")
+            return st.new_uint_field()
+        if name in ("DECIMAL", "NUMERIC"):
+            return st.new_decimal_field(flen if flen > 0 else 10,
+                                        frac if frac >= 0 else 0)
+        if name in ("CHAR", "BINARY"):
+            return st.new_string_field(flen if flen > 0 else 255)
+        if name in ("DOUBLE", "REAL", "FLOAT"):
+            return st.new_double_field()
+        if name == "DATE":
+            return st.new_date_field()
+        if name == "DATETIME":
+            return st.new_datetime_field()
+        raise ParseError(f"unsupported cast type {name}", t)
+
+    def _ident_primary(self) -> ast.ExprNode:
+        name = self.ident()
+        # function call?
+        if self.peek().tp == TokenType.OP and self.peek().val == "(":
+            return self.func_call(name.upper())
+        # qualified column
+        if self.try_op("."):
+            b = self.ident()
+            if self.try_op("."):
+                return ast.ColName(name=self.ident(), table=b, db=name)
+            return ast.ColName(name=b, table=name)
+        return ast.ColName(name=name)
+
+    def func_call(self, name: str) -> ast.ExprNode:
+        self.expect_op("(")
+        if name in _AGG_FUNCS:
+            distinct = self.try_kw("DISTINCT")
+            if self.try_op("*"):
+                self.expect_op(")")
+                return ast.AggregateCall(name=name, star=True)
+            args = [self.expr()]
+            while self.try_op(","):
+                args.append(self.expr())
+            self.expect_op(")")
+            return ast.AggregateCall(name=name, args=args, distinct=distinct)
+        args = []
+        if not self.try_op(")"):
+            # DATE_ADD(d, INTERVAL n DAY)
+            while True:
+                if self.peek().is_kw("INTERVAL"):
+                    self.next()
+                    n = self.expr()
+                    unit = self.ident().upper()
+                    args.append(ast.FuncCall(name="INTERVAL",
+                                             args=[n, ast.Literal(unit)]))
+                else:
+                    args.append(self.expr())
+                if not self.try_op(","):
+                    break
+            self.expect_op(")")
+        return ast.FuncCall(name=name, args=args)
+
+    def column_name(self) -> ast.ColName:
+        a = self.ident()
+        if self.try_op("."):
+            b = self.ident()
+            if self.try_op("."):
+                return ast.ColName(name=self.ident(), table=b, db=a)
+            return ast.ColName(name=b, table=a)
+        return ast.ColName(name=a)
