@@ -1,0 +1,353 @@
+"""DDL execution.
+
+Reference: /root/reference/ddl/ — the full F1 online-schema-change worker
+(state machine, owner election, backfill) arrives with the online-DDL
+milestone; this module implements the synchronous single-node versions with
+the same metadata effects (schema version bumps, TableInfo/DBInfo json in
+meta), so upgrading to async jobs changes the driver, not the format.
+"""
+
+from __future__ import annotations
+
+from tidb_tpu import codec, kv, tablecodec
+from tidb_tpu.meta import Meta, MetaError
+from tidb_tpu.parser import ast
+from tidb_tpu.schema.model import (ColumnInfo, DBInfo, IndexInfo,
+                                   SchemaState, TableInfo)
+from tidb_tpu.sqltypes import EvalType, Flag, TypeCode
+from tidb_tpu.table import Table, encode_datum_for_col
+
+__all__ = ["DDLError", "DDLExecutor"]
+
+
+class DDLError(kv.KVError):
+    pass
+
+
+class DDLExecutor:
+    """Applies one DDL statement in its own meta transaction."""
+
+    def __init__(self, storage):
+        self.storage = storage
+
+    def _txn(self):
+        return self.storage.begin()
+
+    def execute(self, stmt: ast.StmtNode, current_db: str) -> None:
+        m = getattr(self, "_exec_" + type(stmt).__name__, None)
+        if m is None:
+            raise DDLError(f"unsupported DDL {type(stmt).__name__}")
+        txn = self._txn()
+        try:
+            m(Meta(txn), stmt, current_db)
+            Meta(txn).gen_schema_version()
+            txn.commit()
+        except Exception:
+            txn.rollback()
+            raise
+
+    # -- databases -----------------------------------------------------------
+
+    def _exec_CreateDatabaseStmt(self, meta: Meta, stmt, _db):
+        for db in meta.list_databases():
+            if db.name.lower() == stmt.name.lower():
+                if stmt.if_not_exists:
+                    return
+                raise DDLError(f"database '{stmt.name}' exists")
+        meta.create_database(DBInfo(id=meta.gen_global_id(), name=stmt.name))
+
+    def _exec_DropDatabaseStmt(self, meta: Meta, stmt, _db):
+        for db in meta.list_databases():
+            if db.name.lower() == stmt.name.lower():
+                for t in meta.list_tables(db.id):
+                    self._drop_table_data(t.id)
+                meta.drop_database(db.id)
+                return
+        if not stmt.if_exists:
+            raise DDLError(f"database '{stmt.name}' doesn't exist")
+
+    # -- tables --------------------------------------------------------------
+
+    def _find_db(self, meta: Meta, name: str) -> DBInfo:
+        for db in meta.list_databases():
+            if db.name.lower() == name.lower():
+                return db
+        raise DDLError(f"Unknown database '{name}'")
+
+    def _find_table(self, meta: Meta, db_id: int, name: str):
+        for t in meta.list_tables(db_id):
+            if t.name.lower() == name.lower():
+                return t
+        return None
+
+    def _resolve_table(self, meta: Meta, ts: ast.TableSource,
+                       current_db: str):
+        dbn = ts.db or current_db
+        if not dbn:
+            raise DDLError("No database selected")
+        db = self._find_db(meta, dbn)
+        t = self._find_table(meta, db.id, ts.name)
+        return db, t
+
+    def _exec_CreateTableStmt(self, meta: Meta, stmt: ast.CreateTableStmt,
+                              current_db: str):
+        db, existing = self._resolve_table(meta, stmt.table, current_db)
+        if existing is not None:
+            if stmt.if_not_exists:
+                return
+            raise DDLError(f"table '{stmt.table.name}' exists")
+        info = build_table_info(meta, stmt)
+        meta.create_table(db.id, info)
+
+    def _exec_DropTableStmt(self, meta: Meta, stmt, current_db):
+        for ts in stmt.tables:
+            db, t = self._resolve_table(meta, ts, current_db)
+            if t is None:
+                if stmt.if_exists:
+                    continue
+                raise DDLError(f"table '{ts.name}' doesn't exist")
+            meta.drop_table(db.id, t.id)
+            self._drop_table_data(t.id)
+
+    def _exec_TruncateTableStmt(self, meta: Meta, stmt, current_db):
+        db, t = self._resolve_table(meta, stmt.table, current_db)
+        if t is None:
+            raise DDLError(f"table '{stmt.table.name}' doesn't exist")
+        # new table id, same schema (ref: ddl truncate = id swap)
+        meta.drop_table(db.id, t.id)
+        old_id = t.id
+        t.id = meta.gen_global_id()
+        meta.create_table(db.id, t)
+        self._drop_table_data(old_id)
+
+    def _exec_RenameTableStmt(self, meta: Meta, stmt, current_db):
+        for old_ts, new_ts in stmt.pairs:
+            db, t = self._resolve_table(meta, old_ts, current_db)
+            if t is None:
+                raise DDLError(f"table '{old_ts.name}' doesn't exist")
+            new_db = self._find_db(meta, new_ts.db or current_db)
+            if self._find_table(meta, new_db.id, new_ts.name) is not None:
+                raise DDLError(f"table '{new_ts.name}' exists")
+            meta.drop_table(db.id, t.id)
+            t.name = new_ts.name
+            meta.create_table(new_db.id, t)
+
+    def _drop_table_data(self, table_id: int) -> None:
+        """Immediate range delete (the delete-range/GC emulator arrives with
+        the GC milestone; ref: ddl/delete_range.go:51)."""
+        lo, hi = tablecodec.table_prefix_range(table_id)
+        self.storage.engine.delete_range(lo, hi)
+
+    # -- indexes -------------------------------------------------------------
+
+    def _exec_CreateIndexStmt(self, meta: Meta, stmt: ast.CreateIndexStmt,
+                              current_db: str):
+        db, t = self._resolve_table(meta, stmt.table, current_db)
+        if t is None:
+            raise DDLError(f"table '{stmt.table.name}' doesn't exist")
+        if t.index_by_name(stmt.index_name) is not None:
+            raise DDLError(f"index '{stmt.index_name}' exists")
+        for cn in stmt.columns:
+            if t.col_by_name(cn) is None:
+                raise DDLError(f"Unknown column '{cn}'")
+        idx = IndexInfo(id=max([i.id for i in t.indexes], default=0) + 1,
+                        name=stmt.index_name, columns=stmt.columns,
+                        unique=stmt.unique)
+        self._backfill_index(t, idx)
+        t.indexes.append(idx)
+        meta.update_table(db.id, t)
+
+    def _exec_DropIndexStmt(self, meta: Meta, stmt, current_db):
+        db, t = self._resolve_table(meta, stmt.table, current_db)
+        if t is None:
+            raise DDLError(f"table '{stmt.table.name}' doesn't exist")
+        idx = t.index_by_name(stmt.index_name)
+        if idx is None:
+            if stmt.if_exists:
+                return
+            raise DDLError(f"index '{stmt.index_name}' doesn't exist")
+        t.indexes.remove(idx)
+        meta.update_table(db.id, t)
+        prefix = tablecodec.index_prefix(t.id, idx.id)
+        self.storage.engine.delete_range(prefix, codec.prefix_next(prefix))
+
+    def _backfill_index(self, t: TableInfo, idx: IndexInfo) -> None:
+        """Synchronous backfill in one txn (the reorg worker with batched
+        txns + checkpoints replaces this in the online-DDL milestone;
+        ref: ddl/index.go:480-676 addTableIndex)."""
+        txn = self.storage.begin()
+        try:
+            tbl = Table(t, self.storage)
+            seen = {}
+            for handle, row in tbl.iter_records(txn):
+                vals = []
+                for cn in idx.columns:
+                    ci = t.col_by_name(cn)
+                    vals.append(row.get(ci.id))
+                if idx.unique and all(v is not None for v in vals):
+                    key = tuple(vals)
+                    if key in seen:
+                        raise DDLError(
+                            f"duplicate entry for new unique index")
+                    seen[key] = handle
+                    txn.set(tablecodec.index_key(t.id, idx.id, vals),
+                            codec.encode_int(handle))
+                else:
+                    txn.set(tablecodec.index_key(t.id, idx.id, vals,
+                                                 handle=handle), b"0")
+            txn.commit()
+        except Exception:
+            txn.rollback()
+            raise
+
+    # -- ALTER ---------------------------------------------------------------
+
+    def _exec_AlterTableStmt(self, meta: Meta, stmt: ast.AlterTableStmt,
+                             current_db: str):
+        db, t = self._resolve_table(meta, stmt.table, current_db)
+        if t is None:
+            raise DDLError(f"table '{stmt.table.name}' doesn't exist")
+        for spec in stmt.specs:
+            if spec.tp == "add_column":
+                self._alter_add_column(t, spec)
+            elif spec.tp == "drop_column":
+                self._alter_drop_column(t, spec)
+            elif spec.tp == "add_index":
+                idx_def = spec.index
+                if t.index_by_name(idx_def.name or "") is not None:
+                    raise DDLError(f"index '{idx_def.name}' exists")
+                idx = IndexInfo(
+                    id=max([i.id for i in t.indexes], default=0) + 1,
+                    name=idx_def.name or "_".join(idx_def.columns),
+                    columns=idx_def.columns, unique=idx_def.unique,
+                    primary=idx_def.primary)
+                self._backfill_index(t, idx)
+                t.indexes.append(idx)
+            elif spec.tp == "drop_index":
+                idx = t.index_by_name(spec.name)
+                if idx is None:
+                    raise DDLError(f"index '{spec.name}' doesn't exist")
+                t.indexes.remove(idx)
+                prefix = tablecodec.index_prefix(t.id, idx.id)
+                self.storage.engine.delete_range(prefix,
+                                                 codec.prefix_next(prefix))
+            elif spec.tp == "modify_column" or spec.tp == "change_column":
+                old_name = spec.name if spec.tp == "change_column" \
+                    else spec.column.name
+                old = t.col_by_name(old_name)
+                if old is None:
+                    raise DDLError(f"Unknown column '{old_name}'")
+                old.name = spec.column.name
+                old.ft = spec.column.ft
+            elif spec.tp == "rename":
+                t.name = spec.name
+            else:
+                raise DDLError(f"unsupported ALTER {spec.tp}")
+        meta.update_table(db.id, t)
+
+    def _alter_add_column(self, t: TableInfo, spec) -> None:
+        cd = spec.column
+        if t.col_by_name(cd.name) is not None:
+            raise DDLError(f"column '{cd.name}' exists")
+        default = None
+        has_default = cd.has_default
+        if cd.has_default and cd.default is not None:
+            default = _const_default(cd)
+        elif not cd.ft.not_null:
+            has_default = True  # NULL default for existing rows
+        col = ColumnInfo(
+            id=max([c.id for c in t.columns], default=0) + 1,
+            name=cd.name, offset=len(t.columns), ft=cd.ft,
+            default=default, has_default=has_default,
+            auto_increment=cd.auto_increment)
+        if spec.position == "first":
+            t.columns.insert(0, col)
+        elif spec.position == "after":
+            ai = next((i for i, c in enumerate(t.columns)
+                       if c.name.lower() == spec.after_col.lower()), None)
+            if ai is None:
+                raise DDLError(f"Unknown column '{spec.after_col}'")
+            t.columns.insert(ai + 1, col)
+        else:
+            t.columns.append(col)
+        for i, c in enumerate(t.columns):
+            c.offset = i
+
+    def _alter_drop_column(self, t: TableInfo, spec) -> None:
+        col = t.col_by_name(spec.name)
+        if col is None:
+            raise DDLError(f"Unknown column '{spec.name}'")
+        if t.pk_is_handle and t.pk_col_name.lower() == spec.name.lower():
+            raise DDLError("cannot drop the integer primary key")
+        for idx in t.indexes:
+            if any(c.lower() == spec.name.lower() for c in idx.columns):
+                raise DDLError(
+                    f"column '{spec.name}' is indexed; drop index first")
+        t.columns.remove(col)
+        for i, c in enumerate(t.columns):
+            c.offset = i
+
+
+def build_table_info(meta: Meta, stmt: ast.CreateTableStmt) -> TableInfo:
+    info = TableInfo(id=meta.gen_global_id(), name=stmt.table.name)
+    names = set()
+    for i, cd in enumerate(stmt.columns):
+        if cd.name.lower() in names:
+            raise DDLError(f"duplicate column '{cd.name}'")
+        names.add(cd.name.lower())
+        default = _const_default(cd) if cd.has_default else None
+        info.columns.append(ColumnInfo(
+            id=i + 1, name=cd.name, offset=i, ft=cd.ft, default=default,
+            has_default=cd.has_default or not cd.ft.not_null,
+            auto_increment=cd.auto_increment, comment=cd.comment))
+
+    # primary key: inline or table-level
+    pk_cols: list[str] = [cd.name for cd in stmt.columns if cd.is_primary]
+    idx_id = 0
+    for idef in stmt.indexes:
+        if idef.primary:
+            pk_cols = pk_cols or idef.columns
+            if idef.columns != pk_cols:
+                raise DDLError("multiple primary keys")
+    if len(pk_cols) == 1:
+        pkc = info.col_by_name(pk_cols[0])
+        if pkc is not None and pkc.ft.eval_type == EvalType.INT:
+            info.pk_is_handle = True
+            info.pk_col_name = pkc.name
+            pkc.ft = pkc.ft.with_flags(Flag.PRI_KEY | Flag.NOT_NULL)
+    if pk_cols and not info.pk_is_handle:
+        idx_id += 1
+        info.indexes.append(IndexInfo(id=idx_id, name="PRIMARY",
+                                      columns=pk_cols, unique=True,
+                                      primary=True))
+    for cd in stmt.columns:
+        if cd.is_unique:
+            idx_id += 1
+            info.indexes.append(IndexInfo(id=idx_id, name=cd.name,
+                                          columns=[cd.name], unique=True))
+    for idef in stmt.indexes:
+        if idef.primary:
+            continue
+        idx_id += 1
+        info.indexes.append(IndexInfo(
+            id=idx_id, name=idef.name or "_".join(idef.columns),
+            columns=idef.columns, unique=idef.unique))
+    for idx in info.indexes:
+        for cn in idx.columns:
+            if info.col_by_name(cn) is None:
+                raise DDLError(f"Unknown column '{cn}' in index")
+    return info
+
+
+def _const_default(cd: ast.ColumnDef):
+    d = cd.default
+    if d is None:
+        return None
+    if isinstance(d, ast.Literal):
+        v = d.value
+        if v is not None and cd.ft.eval_type == EvalType.DATETIME and \
+                isinstance(v, str):
+            from tidb_tpu import sqltypes as st
+            return st.parse_datetime(v)
+        return v
+    raise DDLError("only literal defaults supported")

@@ -1,0 +1,767 @@
+"""Volcano executors over chunks.
+
+Reference: /root/reference/executor/ — Executor iface (executor.go:172-180,
+Open/NextChunk/Close), builder dispatch (builder.go:62-146). Pull model kept
+(chunked iterators), but per-chunk compute is columnar numpy / XLA instead
+of row loops; the distsql leaves stream partial results from the
+coprocessor fan-out (distsql.go:92).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tidb_tpu import kv, tablecodec
+from tidb_tpu.chunk import Chunk, Column
+from tidb_tpu.expression import AggDesc, AggFunc, Expression
+from tidb_tpu.kv import CopRequest, KVRange, ReqType
+from tidb_tpu.ops.hashagg import (CapacityError, CollisionError,
+                                  GroupResult, HashAggKernel, HashAggregator)
+from tidb_tpu.ops.hostagg import host_hash_agg
+from tidb_tpu.ops.runtime import eval_filter_host
+from tidb_tpu.plan import physical as ph
+from tidb_tpu.sqltypes import EvalType, FieldType, np_dtype_for
+from tidb_tpu.store.copr import exec_cop_plan
+from tidb_tpu.table import Table, encode_datum_for_col, kvrows_to_chunk
+
+__all__ = ["build_executor", "ExecError", "ExecContext"]
+
+
+class ExecError(kv.KVError):
+    pass
+
+
+class ExecContext:
+    """What executors need from the session: storage, the read ts, and the
+    active transaction (for writes and dirty reads)."""
+
+    def __init__(self, storage, read_ts: int, txn=None):
+        self.storage = storage
+        self.read_ts = read_ts
+        self.txn = txn   # kv transaction or None (autocommit read)
+
+
+class Executor:
+    schema = None
+
+    def open(self, ctx: ExecContext):
+        pass
+
+    def chunks(self, ctx: ExecContext):
+        """Yields Chunks."""
+        raise NotImplementedError
+
+    def close(self):
+        pass
+
+
+def build_executor(plan: ph.PhysPlan) -> Executor:
+    """Ref: executorBuilder.build (builder.go:62-146)."""
+    t = type(plan)
+    b = _BUILDERS.get(t)
+    if b is None:
+        raise ExecError(f"no executor for {t.__name__}")
+    return b(plan)
+
+
+# ---------------------------------------------------------------------------
+# Readers
+
+def _txn_is_dirty(ctx: ExecContext, table_id: int) -> bool:
+    if ctx.txn is None:
+        return False
+    lo, hi = tablecodec.table_prefix_range(table_id)
+    for _k, _v in ctx.txn.us.membuf.iter_range(lo, hi):
+        return True
+    return False
+
+
+class TableReaderExec(Executor):
+    """distsql leaf (ref: executor/distsql.go:297 TableReaderExecutor).
+    Streams region partial results; in a dirty transaction, falls back to
+    scanning through the union store so own writes are visible
+    (ref: UnionScanExec, executor/union_scan.go:90)."""
+
+    def __init__(self, plan: ph.PhysTableReader):
+        self.plan = plan
+        self.schema = plan.schema
+
+    def _ranges(self):
+        cop = self.plan.cop
+        if cop.ranges is not None:
+            return cop.ranges
+        lo = tablecodec.record_prefix(cop.table.id)
+        from tidb_tpu import codec
+        return [KVRange(lo, codec.prefix_next(lo))]
+
+    def partials(self, ctx: ExecContext):
+        """Agg mode: yields GroupResults."""
+        cop = self.plan.cop
+        if _txn_is_dirty(ctx, cop.table.id):
+            for chunk in self._dirty_chunks(ctx):
+                yield exec_cop_plan(cop, chunk).chunk
+            return
+        req = CopRequest(tp=ReqType.DAG, ranges=self._ranges(), plan=cop,
+                         start_ts=ctx.read_ts)
+        for resp in ctx.storage.client().send(req):
+            yield resp.chunk
+
+    def chunks(self, ctx: ExecContext):
+        cop = self.plan.cop
+        assert not cop.is_agg
+        if _txn_is_dirty(ctx, cop.table.id):
+            for chunk in self._dirty_chunks(ctx):
+                yield exec_cop_plan(cop, chunk).chunk
+            return
+        req = CopRequest(tp=ReqType.DAG, ranges=self._ranges(), plan=cop,
+                         start_ts=ctx.read_ts)
+        remaining = cop.limit
+        for resp in ctx.storage.client().send(req):
+            ch = resp.chunk
+            if remaining is not None:
+                if remaining <= 0:
+                    return
+                if ch.num_rows > remaining:
+                    ch = ch.slice(0, remaining)
+                remaining -= ch.num_rows
+            yield ch
+
+    def _dirty_chunks(self, ctx: ExecContext):
+        """Union-store scan: buffered writes shadow the snapshot. The cop
+        plan then runs at the root over these chunks (host compute)."""
+        cop = self.plan.cop
+        rows = []
+        for rng in self._ranges():
+            for k, v in ctx.txn.iter_range(rng.start, rng.end):
+                rows.append((k, v))
+                if len(rows) >= 65536:
+                    yield kvrows_to_chunk(cop.table, cop.cols, rows,
+                                          cop.handle_col)
+                    rows = []
+        yield kvrows_to_chunk(cop.table, cop.cols, rows, cop.handle_col)
+
+
+class ValuesExec(Executor):
+    def __init__(self, plan: ph.PhysValues):
+        self.plan = plan
+        self.schema = plan.schema
+
+    def chunks(self, ctx):
+        fts = [c.ft for c in self.plan.schema.cols] if self.plan.schema.cols \
+            else []
+        rows = []
+        for rexprs in self.plan.rows:
+            row = []
+            for e in rexprs:
+                d, v = e.eval_xp(np, [], 1)
+                row.append(None if not v[0] else
+                           (d[0].item() if hasattr(d[0], "item") else d[0]))
+            rows.append(row)
+        if not fts and rows:
+            fts = [e.ft for e in self.plan.rows[0]]
+        cols = []
+        for j, ft in enumerate(fts):
+            dtype = np_dtype_for(ft.tp)
+            valid = np.array([r[j] is not None for r in rows], dtype=bool)
+            if dtype == np.dtype(object):
+                data = np.array([r[j] if r[j] is not None else ""
+                                 for r in rows], dtype=object)
+            else:
+                data = np.array([r[j] if r[j] is not None else 0
+                                 for r in rows], dtype=dtype)
+            cols.append(Column(ft, data, valid))
+        yield Chunk(cols)
+
+
+# ---------------------------------------------------------------------------
+# Aggregation
+
+def _agg_results_to_chunk(schema, num_group: int, aggs: list[AggDesc],
+                          results) -> Chunk:
+    fts = [c.ft for c in schema.cols]
+    n = len(results)
+    arrays = []
+    for j, ft in enumerate(fts):
+        dtype = np_dtype_for(ft.tp)
+        valid = np.ones(n, dtype=bool)
+        data = np.empty(n, dtype=object) if dtype == np.dtype(object) \
+            else np.zeros(n, dtype=dtype)
+        arrays.append((data, valid))
+    for i, (key, vals) in enumerate(results):
+        for j in range(num_group):
+            v = key[j]
+            data, valid = arrays[j]
+            if v is None:
+                valid[i] = False
+                if data.dtype == np.dtype(object):
+                    data[i] = ""
+            else:
+                data[i] = v
+        for a_i, v in enumerate(vals):
+            data, valid = arrays[num_group + a_i]
+            if v is None:
+                valid[i] = False
+                if data.dtype == np.dtype(object):
+                    data[i] = ""
+            else:
+                data[i] = v
+    return Chunk([Column(ft, d, v) for ft, (d, v) in zip(fts, arrays)])
+
+
+class FinalAggExec(Executor):
+    """Merges storage-side partials (ref: final HashAgg over partial agg,
+    executor/aggregate.go + aggregation.GetPartialResult protocol)."""
+
+    def __init__(self, plan: ph.PhysFinalAgg):
+        self.plan = plan
+        self.schema = plan.schema
+        self.reader = build_executor(plan.children[0])
+
+    def chunks(self, ctx):
+        agg = HashAggregator(self.plan.aggs)
+        for gr in self.reader.partials(ctx):
+            agg.update(gr)
+        results = agg.results()
+        if not self.plan.num_group_cols and not results:
+            results = [((), [_empty_agg_value(a) for a in self.plan.aggs])]
+        yield _agg_results_to_chunk(self.schema, self.plan.num_group_cols,
+                                    self.plan.aggs, results)
+
+
+def _empty_agg_value(a: AggDesc):
+    return 0 if a.fn == AggFunc.COUNT else None
+
+
+class HashAggExec(Executor):
+    """Root-side complete aggregation over child chunks."""
+
+    def __init__(self, plan: ph.PhysHashAgg):
+        self.plan = plan
+        self.schema = plan.schema
+        self.child = build_executor(plan.children[0])
+        self._kernel = None
+
+    def chunks(self, ctx):
+        agg = HashAggregator(self.plan.aggs)
+        distinct_ok = all(not a.distinct for a in self.plan.aggs)
+        seen_any = False
+        for chunk in self.child.chunks(ctx):
+            if chunk.num_rows == 0:
+                continue
+            seen_any = True
+            gr = None
+            if distinct_ok and chunk.num_rows >= 2048:
+                try:
+                    if self._kernel is None:
+                        self._kernel = HashAggKernel(
+                            None, self.plan.group_exprs, self.plan.aggs)
+                    gr = self._kernel(chunk)
+                except (CapacityError, CollisionError, ValueError):
+                    gr = None
+            if gr is None:
+                gr = host_hash_agg(chunk, None, self.plan.group_exprs,
+                                   self.plan.aggs)
+            agg.update(gr)
+        results = agg.results()
+        if not self.plan.group_exprs and not results:
+            results = [((), [_empty_agg_value(a) for a in self.plan.aggs])]
+        num_g = len(self.plan.group_exprs)
+        yield _agg_results_to_chunk(self.schema, num_g, self.plan.aggs,
+                                    results)
+
+
+# ---------------------------------------------------------------------------
+# Row ops
+
+class SelectionExec(Executor):
+    def __init__(self, plan: ph.PhysSelection):
+        self.plan = plan
+        self.schema = plan.schema
+        self.child = build_executor(plan.children[0])
+
+    def chunks(self, ctx):
+        for chunk in self.child.chunks(ctx):
+            mask = eval_filter_host(self.plan.cond, chunk)
+            yield chunk.filter(mask)
+
+
+class ProjectionExec(Executor):
+    def __init__(self, plan: ph.PhysProjection):
+        self.plan = plan
+        self.schema = plan.schema
+        self.child = build_executor(plan.children[0])
+
+    def chunks(self, ctx):
+        fts = [c.ft for c in self.schema.cols]
+        for chunk in self.child.chunks(ctx):
+            cols = []
+            for e, ft in zip(self.plan.exprs, fts):
+                d, v = e.eval(chunk)
+                if d.dtype != np.dtype(object):
+                    want = np_dtype_for(ft.tp)
+                    if d.dtype != want:
+                        d = d.astype(want)
+                cols.append(Column(ft, d, v.copy()))
+            yield Chunk(cols)
+
+
+class LimitExec(Executor):
+    def __init__(self, plan: ph.PhysLimit):
+        self.plan = plan
+        self.schema = plan.schema
+        self.child = build_executor(plan.children[0])
+
+    def chunks(self, ctx):
+        skip = self.plan.offset
+        left = self.plan.count
+        for chunk in self.child.chunks(ctx):
+            if skip >= chunk.num_rows:
+                skip -= chunk.num_rows
+                continue
+            if skip:
+                chunk = chunk.slice(skip, chunk.num_rows)
+                skip = 0
+            if chunk.num_rows > left:
+                chunk = chunk.slice(0, left)
+            left -= chunk.num_rows
+            yield chunk
+            if left <= 0:
+                return
+
+
+def _sort_key_rows(by, chunk):
+    """-> list of per-row sort key tuples handling NULLs (asc: NULLs first)."""
+    keycols = []
+    for e, desc in by:
+        d, v = e.eval(chunk)
+        keycols.append((d, v, desc))
+    keys = []
+    for i in range(chunk.num_rows):
+        parts = []
+        for d, v, desc in keycols:
+            null = not v[i]
+            val = d[i] if not null else None
+            parts.append((null, val, desc))
+        keys.append(_SortKey(parts))
+    return keys
+
+
+class _SortKey:
+    __slots__ = ("parts",)
+
+    def __init__(self, parts):
+        self.parts = parts
+
+    def __lt__(self, other):
+        for (n1, v1, desc), (n2, v2, _d) in zip(self.parts, other.parts):
+            if n1 != n2:
+                lt = n1  # NULL sorts first asc
+                return lt if not desc else not lt
+            if n1:
+                continue
+            if v1 == v2:
+                continue
+            lt = v1 < v2
+            return lt if not desc else not lt
+        return False
+
+
+class SortExec(Executor):
+    """In-memory sort (ref: executor/sort.go:35; external sort later)."""
+
+    def __init__(self, plan: ph.PhysSort):
+        self.plan = plan
+        self.schema = plan.schema
+        self.child = build_executor(plan.children[0])
+
+    def chunks(self, ctx):
+        whole = None
+        for chunk in self.child.chunks(ctx):
+            whole = chunk if whole is None else whole.concat(chunk)
+        if whole is None or whole.num_rows == 0:
+            if whole is not None:
+                yield whole
+            return
+        keys = _sort_key_rows(self.plan.by, whole)
+        order = sorted(range(len(keys)), key=lambda i: keys[i])
+        yield whole.take(np.array(order, dtype=np.int64))
+
+
+class TopNExec(Executor):
+    """Heap-free TopN: keep best (count+offset) rows per chunk
+    (ref: pushDownTopNOptimizer + executor TopN)."""
+
+    def __init__(self, plan: ph.PhysTopN):
+        self.plan = plan
+        self.schema = plan.schema
+        self.child = build_executor(plan.children[0])
+
+    def chunks(self, ctx):
+        n = self.plan.count + self.plan.offset
+        best = None
+        for chunk in self.child.chunks(ctx):
+            cand = chunk if best is None else best.concat(chunk)
+            if cand.num_rows > 0:
+                keys = _sort_key_rows(self.plan.by, cand)
+                order = sorted(range(len(keys)), key=lambda i: keys[i])[:n]
+                best = cand.take(np.array(order, dtype=np.int64))
+            else:
+                best = cand
+        if best is None:
+            return
+        yield best.slice(min(self.plan.offset, best.num_rows), best.num_rows)
+
+
+class HashJoinExec(Executor):
+    """Host hash join (ref: executor/join.go:37 HashJoinExec; device join
+    lands with the join kernel milestone). Build side = right child."""
+
+    def __init__(self, plan: ph.PhysHashJoin):
+        self.plan = plan
+        self.schema = plan.schema
+        self.left = build_executor(plan.children[0])
+        self.right = build_executor(plan.children[1])
+
+    def chunks(self, ctx):
+        plan = self.plan
+        if not plan.left_keys:
+            yield from self._cross_join(ctx)
+            return
+        # build
+        build = None
+        for chunk in self.right.chunks(ctx):
+            build = chunk if build is None else build.concat(chunk)
+        table: dict = {}
+        if build is not None and build.num_rows:
+            bkeys = [e.eval(build) for e in plan.right_keys]
+            for i in range(build.num_rows):
+                if any(not v[i] for _d, v in bkeys):
+                    continue  # NULL keys never match
+                k = tuple(d[i] for d, _v in bkeys)
+                table.setdefault(k, []).append(i)
+        matched_right = np.zeros(build.num_rows if build is not None else 0,
+                                 dtype=bool)
+        # probe
+        for chunk in self.left.chunks(ctx):
+            if chunk.num_rows == 0:
+                continue
+            pkeys = [e.eval(chunk) for e in plan.left_keys]
+            li, ri = [], []
+            unmatched = []
+            for i in range(chunk.num_rows):
+                if any(not v[i] for _d, v in pkeys):
+                    if plan.join_type == "left":
+                        unmatched.append(i)
+                    continue
+                k = tuple(d[i] for d, _v in pkeys)
+                rows = table.get(k)
+                if rows is None:
+                    if plan.join_type == "left":
+                        unmatched.append(i)
+                    continue
+                for r in rows:
+                    li.append(i)
+                    ri.append(r)
+                    matched_right[r] = True
+            out = self._emit(chunk, build, li, ri, unmatched)
+            if out is not None:
+                yield out
+        if plan.join_type == "right" and build is not None:
+            un = np.flatnonzero(~matched_right)
+            if len(un):
+                yield self._emit_right_unmatched(build, un)
+
+    def _emit(self, left_chunk, build, li, ri, left_unmatched):
+        plan = self.plan
+        lcols = left_chunk.columns
+        rcols = build.columns if build is not None else []
+        li_a = np.array(li, dtype=np.int64)
+        ri_a = np.array(ri, dtype=np.int64)
+        cols = []
+        for c in lcols:
+            cols.append(Column(c.ft, c.data[li_a], c.valid[li_a]))
+        for c in rcols:
+            cols.append(Column(c.ft, c.data[ri_a], c.valid[ri_a]))
+        out = Chunk(cols) if cols else None
+        if out is not None and plan.other_cond is not None:
+            # NOTE: for LEFT joins, rows whose only matches fail other_cond
+            # should re-enter as unmatched; not needed by current SQL
+            # surface (ON extra conds on outer joins) — tracked for later
+            out = out.filter(eval_filter_host(plan.other_cond, out))
+        if plan.join_type == "left" and left_unmatched:
+            ui = np.array(left_unmatched, dtype=np.int64)
+            ucols = [Column(c.ft, c.data[ui], c.valid[ui]) for c in lcols]
+            for sc in self.plan.children[1].schema.cols:
+                dtype = np_dtype_for(sc.ft.tp)
+                data = np.zeros(len(ui), dtype=dtype) \
+                    if dtype != np.dtype(object) \
+                    else np.full(len(ui), "", dtype=object)
+                ucols.append(Column(sc.ft, data,
+                                    np.zeros(len(ui), dtype=bool)))
+            uchunk = Chunk(ucols)
+            out = uchunk if out is None else out.concat(uchunk)
+        return out
+
+    def _emit_right_unmatched(self, build, un):
+        cols = []
+        for sc in self.left.schema.cols:
+            dtype = np_dtype_for(sc.ft.tp)
+            data = np.zeros(len(un), dtype=dtype) \
+                if dtype != np.dtype(object) \
+                else np.full(len(un), "", dtype=object)
+            cols.append(Column(sc.ft, data, np.zeros(len(un), dtype=bool)))
+        for c in build.columns:
+            cols.append(Column(c.ft, c.data[un], c.valid[un]))
+        return Chunk(cols)
+
+    def _cross_join(self, ctx):
+        build = None
+        for chunk in self.right.chunks(ctx):
+            build = chunk if build is None else build.concat(chunk)
+        if build is None or build.num_rows == 0:
+            return
+        nb = build.num_rows
+        for chunk in self.left.chunks(ctx):
+            nl = chunk.num_rows
+            if nl == 0:
+                continue
+            li = np.repeat(np.arange(nl), nb)
+            ri = np.tile(np.arange(nb), nl)
+            cols = [Column(c.ft, c.data[li], c.valid[li])
+                    for c in chunk.columns]
+            cols += [Column(c.ft, c.data[ri], c.valid[ri])
+                     for c in build.columns]
+            out = Chunk(cols)
+            if self.plan.other_cond is not None:
+                out = out.filter(eval_filter_host(self.plan.other_cond, out))
+            yield out
+
+
+# ---------------------------------------------------------------------------
+# Writes
+
+def _chunk_row_to_kvdatums(chunk: Chunk, cols, row: int) -> dict[int, object]:
+    """Row of a reader chunk -> {col_id: KV datum} for index maintenance."""
+    out = {}
+    for j, ci in enumerate(cols):
+        c = chunk.columns[j]
+        if not c.valid[row]:
+            out[ci.id] = None
+            continue
+        v = c.data[row]
+        if ci.ft.eval_type == EvalType.DECIMAL:
+            out[ci.id] = (ci.ft.frac, int(v))
+        elif c.data.dtype == np.dtype(object):
+            out[ci.id] = v
+        else:
+            out[ci.id] = v.item()
+    return out
+
+
+class InsertExec(Executor):
+    """Ref: executor/write.go:896 InsertExec (dup handling :1343)."""
+
+    def __init__(self, plan: ph.PhysInsert):
+        self.plan = plan
+        self.schema = plan.schema
+        self.source = build_executor(plan.source)
+
+    def execute(self, ctx: ExecContext) -> int:
+        from tidb_tpu.table import DupKeyError, Table
+        plan = self.plan
+        tbl = Table(plan.table, ctx.storage)
+        txn = ctx.txn
+        affected = 0
+        for values in self._source_rows(ctx):
+            try:
+                tbl.add_record(txn, values)
+                affected += 1
+            except DupKeyError:
+                if plan.ignore:
+                    continue
+                if plan.is_replace or plan.on_duplicate:
+                    affected += self._handle_dup(ctx, tbl, txn, values)
+                    continue
+                raise
+        return affected
+
+    def _source_rows(self, ctx):
+        """Yields {col_name: value} dicts; a key present with None is an
+        explicit NULL, an absent key means 'use the default' (DEFAULT
+        keyword or omitted column)."""
+        plan = self.plan
+        if isinstance(plan.source, ph.PhysValues) and not plan.source.schema.cols:
+            # literal VALUES rows: evaluate per cell; None expr == DEFAULT
+            for rexprs in plan.source.rows:
+                values = {}
+                for cname, e in zip(plan.columns, rexprs):
+                    if e is None:      # DEFAULT keyword
+                        continue
+                    d, v = e.eval_xp(np, [], 1)
+                    if not v[0]:
+                        values[cname] = None
+                    elif e.ft.eval_type == EvalType.DECIMAL:
+                        values[cname] = (e.ft.frac, int(d[0]))
+                    else:
+                        values[cname] = d[0].item() \
+                            if hasattr(d[0], "item") else d[0]
+                yield values
+            return
+        for chunk in self.source.chunks(ctx):
+            src_cols = chunk.columns
+            for i in range(chunk.num_rows):
+                values = {}
+                for cname, col in zip(plan.columns, src_cols):
+                    if not col.valid[i]:
+                        values[cname] = None   # explicit NULL
+                        continue
+                    v = col.data[i]
+                    if col.ft.eval_type == EvalType.DECIMAL:
+                        # scaled at the SOURCE column's frac; target frac
+                        # conversion happens in encode_datum_for_col
+                        values[cname] = (col.ft.frac, int(v))
+                    else:
+                        values[cname] = v.item() if hasattr(v, "item") else v
+                yield values
+
+    def _handle_dup(self, ctx, tbl: "Table", txn, values) -> int:
+        """REPLACE / ON DUPLICATE KEY UPDATE: find the conflicting row."""
+        info = self.plan.table
+        handle = self._find_conflict(tbl, txn, values)
+        if handle is None:
+            raise ExecError("duplicate row vanished")
+        old = tbl.row_by_handle(txn, handle)
+        if self.plan.is_replace:
+            tbl.remove_record(txn, handle, old)
+            tbl.add_record(txn, values)
+            return 2
+        # ON DUPLICATE KEY UPDATE over the existing row
+        cols = info.public_columns()
+        from tidb_tpu.table import rows_to_chunk
+        row_chunk = rows_to_chunk([c.ft for c in cols],
+                                  [[old.get(c.id) for c in cols]])
+        new_vals = {}
+        for cname, expr in self.plan.on_duplicate:
+            d, v = expr.eval(row_chunk)
+            ci = info.col_by_name(cname)
+            if not v[0]:
+                new_vals[cname] = None
+            elif ci.ft.eval_type == EvalType.DECIMAL:
+                new_vals[cname] = (expr.ft.frac if
+                                   expr.ft.eval_type == EvalType.DECIMAL
+                                   else ci.ft.frac, int(d[0]))
+            else:
+                new_vals[cname] = d[0].item() if hasattr(d[0], "item") \
+                    else d[0]
+        tbl.update_record(txn, handle, old, new_vals)
+        return 2
+
+    def _find_conflict(self, tbl, txn, values):
+        info = self.plan.table
+        if info.pk_is_handle:
+            pk = info.col_by_name(info.pk_col_name)
+            v = values.get(info.pk_col_name.lower())
+            if v is not None and tbl.row_by_handle(txn, int(v)) is not None:
+                return int(v)
+        for idx in info.indexes:
+            if not idx.unique:
+                continue
+            vals = []
+            for cn in idx.columns:
+                ci = info.col_by_name(cn)
+                vals.append(encode_datum_for_col(values.get(cn.lower()),
+                                                 ci.ft))
+            if any(v is None for v in vals):
+                continue
+            raw = txn.get(tablecodec.index_key(info.id, idx.id, vals))
+            if raw is not None:
+                from tidb_tpu import codec
+                return codec.decode_int(raw)[0]
+        return None
+
+
+class UpdateExec(Executor):
+    def __init__(self, plan: ph.PhysUpdate):
+        self.plan = plan
+        self.reader = build_executor(plan.reader)
+
+    def execute(self, ctx: ExecContext) -> int:
+        plan = self.plan
+        tbl = Table(plan.table, ctx.storage)
+        cols = plan.table.public_columns()
+        affected = 0
+        for chunk in self.reader.chunks(ctx):
+            if chunk.num_rows == 0:
+                continue
+            handle_col = chunk.columns[-1]
+            new_cols = {}
+            for cname, expr in plan.assignments:
+                new_cols[cname] = (expr, *expr.eval(chunk))
+            pk_name = plan.table.pk_col_name.lower() \
+                if plan.table.pk_is_handle else None
+            for i in range(chunk.num_rows):
+                handle = int(handle_col.data[i])
+                old = _chunk_row_to_kvdatums(chunk, cols, i)
+                new_vals = {}
+                for cname, (expr, d, v) in new_cols.items():
+                    ci = plan.table.col_by_name(cname)
+                    if not v[i]:
+                        new_vals[cname] = None
+                    elif ci.ft.eval_type == EvalType.DECIMAL:
+                        frac = expr.ft.frac if \
+                            expr.ft.eval_type == EvalType.DECIMAL else ci.ft.frac
+                        new_vals[cname] = (frac, int(d[i]))
+                    else:
+                        new_vals[cname] = d[i].item() \
+                            if hasattr(d[i], "item") else d[i]
+                if pk_name is not None and pk_name in new_vals and \
+                        new_vals[pk_name] is not None and \
+                        int(new_vals[pk_name]) != handle:
+                    # handle change: move the row (delete + insert w/ dup
+                    # check) instead of rewriting under the old handle
+                    merged = {}
+                    for c in cols:
+                        merged[c.name.lower()] = old.get(c.id)
+                    merged.update(new_vals)
+                    tbl.remove_record(ctx.txn, handle, old)
+                    tbl.add_record(ctx.txn, merged)
+                else:
+                    tbl.update_record(ctx.txn, handle, old, new_vals)
+                affected += 1
+        return affected
+
+
+class DeleteExec(Executor):
+    def __init__(self, plan: ph.PhysDelete):
+        self.plan = plan
+        self.reader = build_executor(plan.reader)
+
+    def execute(self, ctx: ExecContext) -> int:
+        tbl = Table(self.plan.table, ctx.storage)
+        cols = self.plan.table.public_columns()
+        affected = 0
+        for chunk in self.reader.chunks(ctx):
+            handle_col = chunk.columns[-1]
+            for i in range(chunk.num_rows):
+                handle = int(handle_col.data[i])
+                old = _chunk_row_to_kvdatums(chunk, cols, i)
+                tbl.remove_record(ctx.txn, handle, old)
+                affected += 1
+        return affected
+
+
+_BUILDERS = {
+    ph.PhysTableReader: TableReaderExec,
+    ph.PhysValues: ValuesExec,
+    ph.PhysFinalAgg: FinalAggExec,
+    ph.PhysHashAgg: HashAggExec,
+    ph.PhysSelection: SelectionExec,
+    ph.PhysProjection: ProjectionExec,
+    ph.PhysLimit: LimitExec,
+    ph.PhysSort: SortExec,
+    ph.PhysTopN: TopNExec,
+    ph.PhysHashJoin: HashJoinExec,
+    ph.PhysInsert: InsertExec,
+    ph.PhysUpdate: UpdateExec,
+    ph.PhysDelete: DeleteExec,
+}

@@ -1,0 +1,143 @@
+"""Schema metadata on the KV plane.
+
+Reference: /root/reference/meta/meta.go:55-178 over structure/ (TxStructure
+hashes). Layout under the "m" prefix:
+
+    m_nextID                   -> global id allocator
+    m_schemaVersion            -> global schema version counter
+    m_dbs/{dbID}               -> DBInfo json
+    m_db/{dbID}/{tableID}      -> TableInfo json
+    m_autoid/{tableID}         -> auto-increment base
+    m_ddljobs / m_ddlhistory   -> DDL job queues (ddl module)
+
+All keys sort after table-data keys ("m" > "t" is false — "m" < "t", so the
+meta range precedes table ranges; either way they are disjoint).
+"""
+
+from __future__ import annotations
+
+import json
+
+from tidb_tpu import kv
+from tidb_tpu.schema.model import DBInfo, TableInfo
+
+__all__ = ["Meta", "MetaError"]
+
+_PREFIX = b"m_"
+
+
+class MetaError(Exception):
+    pass
+
+
+def _db_key(db_id: int) -> bytes:
+    return b"m_dbs/%020d" % db_id
+
+
+def _table_key(db_id: int, table_id: int) -> bytes:
+    return b"m_db/%020d/%020d" % (db_id, table_id)
+
+
+def _table_prefix(db_id: int) -> bytes:
+    return b"m_db/%020d/" % db_id
+
+
+class Meta:
+    """Meta operations inside one kv.Transaction (like the reference, every
+    meta op set runs in its caller's txn for atomicity with schema version
+    bumps)."""
+
+    NEXT_ID_KEY = b"m_nextID"
+    SCHEMA_VERSION_KEY = b"m_schemaVersion"
+
+    def __init__(self, txn: kv.Transaction):
+        self.txn = txn
+
+    # -- id allocation -------------------------------------------------------
+
+    def _bump(self, key: bytes, step: int = 1) -> int:
+        raw = self.txn.get(key)
+        cur = int(raw) if raw else 0
+        cur += step
+        self.txn.set(key, b"%d" % cur)
+        return cur
+
+    def gen_global_id(self) -> int:
+        return self._bump(self.NEXT_ID_KEY)
+
+    def gen_schema_version(self) -> int:
+        """Ref: meta.go:177 GenSchemaVersion."""
+        return self._bump(self.SCHEMA_VERSION_KEY)
+
+    def schema_version(self) -> int:
+        raw = self.txn.get(self.SCHEMA_VERSION_KEY)
+        return int(raw) if raw else 0
+
+    # -- auto increment ------------------------------------------------------
+
+    def gen_auto_id(self, table_id: int, step: int) -> tuple[int, int]:
+        """Allocate [base+1, base+step]; returns (first, last).
+        Ref: meta/autoid batched allocator (autoid.go:36-46)."""
+        key = b"m_autoid/%020d" % table_id
+        raw = self.txn.get(key)
+        base = int(raw) if raw else 0
+        self.txn.set(key, b"%d" % (base + step))
+        return base + 1, base + step
+
+    def rebase_auto_id(self, table_id: int, at_least: int) -> None:
+        key = b"m_autoid/%020d" % table_id
+        raw = self.txn.get(key)
+        base = int(raw) if raw else 0
+        if at_least > base:
+            self.txn.set(key, b"%d" % at_least)
+
+    # -- databases -----------------------------------------------------------
+
+    def create_database(self, db: DBInfo) -> None:
+        key = _db_key(db.id)
+        if self.txn.get(key) is not None:
+            raise MetaError(f"db {db.id} already exists")
+        self.txn.set(key, db.dumps())
+
+    def drop_database(self, db_id: int) -> None:
+        self.txn.delete(_db_key(db_id))
+        for k, _ in list(self.txn.iter_range(_table_prefix(db_id),
+                                             _table_prefix(db_id + 1))):
+            self.txn.delete(k)
+
+    def get_database(self, db_id: int) -> DBInfo | None:
+        raw = self.txn.get(_db_key(db_id))
+        return DBInfo.loads(raw) if raw else None
+
+    def list_databases(self) -> list[DBInfo]:
+        out = []
+        for _k, v in self.txn.iter_range(b"m_dbs/", b"m_dbs0"):
+            out.append(DBInfo.loads(v))
+        return out
+
+    # -- tables --------------------------------------------------------------
+
+    def create_table(self, db_id: int, tbl: TableInfo) -> None:
+        if self.get_database(db_id) is None:
+            raise MetaError(f"db {db_id} does not exist")
+        key = _table_key(db_id, tbl.id)
+        if self.txn.get(key) is not None:
+            raise MetaError(f"table {tbl.id} already exists")
+        self.txn.set(key, tbl.dumps())
+
+    def update_table(self, db_id: int, tbl: TableInfo) -> None:
+        self.txn.set(_table_key(db_id, tbl.id), tbl.dumps())
+
+    def drop_table(self, db_id: int, table_id: int) -> None:
+        self.txn.delete(_table_key(db_id, table_id))
+
+    def get_table(self, db_id: int, table_id: int) -> TableInfo | None:
+        raw = self.txn.get(_table_key(db_id, table_id))
+        return TableInfo.loads(raw) if raw else None
+
+    def list_tables(self, db_id: int) -> list[TableInfo]:
+        out = []
+        for _k, v in self.txn.iter_range(_table_prefix(db_id),
+                                         _table_prefix(db_id + 1)):
+            out.append(TableInfo.loads(v))
+        return out

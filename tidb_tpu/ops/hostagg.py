@@ -1,0 +1,159 @@
+"""Host (numpy/python) aggregation fallback.
+
+Used when a pushed aggregate can't ride the device kernel: DISTINCT aggs,
+string MIN/MAX, hash-collision or capacity fallback (ops/hashagg.py), and
+tiny chunks where jit dispatch overhead would dominate. Produces the same
+GroupResult partial-state protocol, so the final merge path is identical.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tidb_tpu.chunk import Chunk
+from tidb_tpu.expression import AggDesc, AggFunc, Expression
+from tidb_tpu.ops.hashagg import GroupResult
+from tidb_tpu.ops.runtime import eval_filter_host
+
+__all__ = ["host_hash_agg", "host_scalar_agg"]
+
+
+def _eval_cols(exprs, chunk):
+    out = []
+    for e in exprs:
+        d, v = e.eval(chunk)
+        out.append((d, v))
+    return out
+
+
+def host_hash_agg(chunk: Chunk, filter_expr: Expression | None,
+                  group_exprs: list[Expression],
+                  aggs: list[AggDesc]) -> GroupResult:
+    mask = eval_filter_host(filter_expr, chunk)
+    gcols = _eval_cols(group_exprs, chunk)
+    acols = [(None, None) if a.arg is None else a.arg.eval(chunk)
+             for a in aggs]
+
+    groups: dict[tuple, int] = {}
+    keys: list[tuple] = []
+    states: list[list] = []     # per group: per agg: lanes
+    counts: list[int] = []
+
+    n = chunk.num_rows
+    for i in range(n):
+        if not mask[i]:
+            continue
+        key = tuple(
+            None if not v[i] else (d[i].item() if hasattr(d[i], "item")
+                                   else d[i])
+            for d, v in gcols)
+        gi = groups.get(key)
+        if gi is None:
+            gi = len(keys)
+            groups[key] = gi
+            keys.append(key)
+            counts.append(0)
+            states.append([_init_state(a) for a in aggs])
+        counts[gi] += 1
+        for ai, a in enumerate(aggs):
+            _update_state(a, states[gi][ai], acols[ai], i)
+
+    partials = []
+    for ai, a in enumerate(aggs):
+        lanes = _states_to_lanes(a, [s[ai] for s in states])
+        partials.append(lanes)
+    return GroupResult(keys=keys, partials=partials,
+                       counts=np.array(counts, dtype=np.int64))
+
+
+def host_scalar_agg(chunk: Chunk, filter_expr: Expression | None,
+                    aggs: list[AggDesc]) -> GroupResult:
+    mask = eval_filter_host(filter_expr, chunk)
+    acols = [(None, None) if a.arg is None else a.arg.eval(chunk)
+             for a in aggs]
+    states = [_init_state(a) for a in aggs]
+    cnt = 0
+    for i in range(chunk.num_rows):
+        if not mask[i]:
+            continue
+        cnt += 1
+        for ai, a in enumerate(aggs):
+            _update_state(a, states[ai], acols[ai], i)
+    partials = [_states_to_lanes(a, [states[ai]])
+                for ai, a in enumerate(aggs)]
+    return GroupResult(keys=[()], partials=partials,
+                       counts=np.array([cnt], dtype=np.int64))
+
+
+def _init_state(a: AggDesc):
+    if a.distinct:
+        return {"seen": set(), "sum": 0, "cnt": 0, "min": None, "max": None}
+    return {"sum": 0, "cnt": 0, "min": None, "max": None, "first": None,
+            "has": False}
+
+
+def _update_state(a: AggDesc, st, col, i):
+    fn = a.fn
+    if a.arg is None:   # COUNT(*)
+        st["cnt"] += 1
+        return
+    d, v = col
+    if not v[i]:
+        return
+    val = d[i].item() if hasattr(d[i], "item") else d[i]
+    if a.distinct:
+        if val in st["seen"]:
+            return
+        st["seen"].add(val)
+    st["has"] = True if "has" in st else None
+    if fn in (AggFunc.SUM, AggFunc.AVG):
+        st["sum"] += val
+        st["cnt"] += 1
+    elif fn == AggFunc.COUNT:
+        st["cnt"] += 1
+    elif fn == AggFunc.MIN:
+        st["min"] = val if st["min"] is None else min(st["min"], val)
+    elif fn == AggFunc.MAX:
+        st["max"] = val if st["max"] is None else max(st["max"], val)
+    elif fn == AggFunc.FIRST_ROW:
+        if st.get("first") is None:
+            st["first"] = val
+    else:
+        raise NotImplementedError(fn)
+
+
+def _states_to_lanes(a: AggDesc, sts: list[dict]):
+    """Convert host states into the kernel's partial-lane layout so
+    HashAggregator merges both identically."""
+    fn = a.fn
+    n = len(sts)
+    if fn == AggFunc.COUNT:
+        return [np.array([s["cnt"] for s in sts], dtype=np.int64)]
+    if fn == AggFunc.SUM:
+        dtype = np.float64 if any(isinstance(s["sum"], float) for s in sts) \
+            else np.int64
+        return [np.array([s["sum"] for s in sts], dtype=dtype),
+                np.array([1 if s["cnt"] else 0 for s in sts],
+                         dtype=np.int64)]
+    if fn == AggFunc.AVG:
+        dtype = np.float64 if any(isinstance(s["sum"], float) for s in sts) \
+            else np.int64
+        return [np.array([s["sum"] for s in sts], dtype=dtype),
+                np.array([s["cnt"] for s in sts], dtype=np.int64)]
+    if fn in (AggFunc.MIN, AggFunc.MAX):
+        key = "min" if fn == AggFunc.MIN else "max"
+        has = [0 if sts[i][key] is None else 1 for i in range(n)]
+        vals = [sts[i][key] if has[i] else 0 for i in range(n)]
+        arr = np.array(vals, dtype=object) \
+            if any(isinstance(v, (str, bytes)) for v in vals) else \
+            np.asarray(vals)
+        return [arr, np.array(has, dtype=np.int64)]
+    if fn == AggFunc.FIRST_ROW:
+        has = [0 if s.get("first") is None else 1 for s in sts]
+        vals = [s.get("first") if has[i] else 0
+                for i, s in enumerate(sts)]
+        arr = np.array(vals, dtype=object) \
+            if any(isinstance(v, (str, bytes)) for v in vals) else \
+            np.asarray(vals)
+        return [arr, np.array(has, dtype=np.int64)]
+    raise NotImplementedError(fn)

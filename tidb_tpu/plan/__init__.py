@@ -1,0 +1,4 @@
+from tidb_tpu.plan.planner import Planner, PlanError
+from tidb_tpu.plan import physical
+
+__all__ = ["Planner", "PlanError", "physical"]

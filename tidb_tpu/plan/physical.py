@@ -1,0 +1,182 @@
+"""Physical plan nodes.
+
+Reference: /root/reference/plan/physical_plans.go + the copTask/rootTask
+split of plan/task.go:31-49 — `CopPlan` is the pushed-down subplan a
+storage node executes next to the data (the tipb.DAGRequest analogue,
+plan/plan_to_pb.go:30), everything else runs at the root.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from tidb_tpu.expression import AggDesc, Expression
+from tidb_tpu.kv import KVRange
+from tidb_tpu.plan.resolver import PlanSchema
+from tidb_tpu.schema.model import ColumnInfo, TableInfo
+
+__all__ = ["CopPlan", "PhysPlan", "PhysTableReader", "PhysSelection",
+           "PhysProjection", "PhysHashAgg", "PhysFinalAgg", "PhysHashJoin",
+           "PhysSort", "PhysLimit", "PhysTopN", "PhysInsert", "PhysUpdate",
+           "PhysDelete", "PhysValues"]
+
+
+@dataclass
+class CopPlan:
+    """Storage-side subplan: scan -> [host_filter] -> [filter] ->
+    [partial agg] -> [limit], executed per region."""
+
+    table: TableInfo
+    cols: list[ColumnInfo]                  # scan output, in order
+    handle_col: Optional[int] = None        # emit handle at this position
+    ranges: Optional[list[KVRange]] = None  # None = whole table
+    filter: Optional[Expression] = None     # device-safe conjuncts
+    host_filter: Optional[Expression] = None  # string/varlen conjuncts
+    group_exprs: Optional[list[Expression]] = None
+    aggs: Optional[list[AggDesc]] = None
+    limit: Optional[int] = None             # only when no aggs
+    desc: bool = False
+
+    @property
+    def is_agg(self) -> bool:
+        return self.aggs is not None
+
+
+@dataclass
+class PhysPlan:
+    schema: PlanSchema = field(default_factory=PlanSchema)
+    children: list = field(default_factory=list)
+
+    def explain(self, depth: int = 0) -> str:
+        name = type(self).__name__.replace("Phys", "")
+        line = "  " * depth + name + self._explain_info()
+        return "\n".join([line] + [c.explain(depth + 1)
+                                   for c in self.children])
+
+    def _explain_info(self) -> str:
+        return ""
+
+
+@dataclass
+class PhysTableReader(PhysPlan):
+    cop: CopPlan = None
+
+    def _explain_info(self):
+        parts = [f" table:{self.cop.table.name}"]
+        if self.cop.filter is not None:
+            parts.append(f" pushed_filter:{self.cop.filter!r}")
+        if self.cop.host_filter is not None:
+            parts.append(f" host_filter:{self.cop.host_filter!r}")
+        if self.cop.is_agg:
+            parts.append(f" partial_agg:{self.cop.aggs!r}")
+        if self.cop.limit is not None:
+            parts.append(f" limit:{self.cop.limit}")
+        return ",".join(parts)
+
+
+@dataclass
+class PhysSelection(PhysPlan):
+    cond: Expression = None
+
+    def _explain_info(self):
+        return f" cond:{self.cond!r}"
+
+
+@dataclass
+class PhysProjection(PhysPlan):
+    exprs: list = field(default_factory=list)
+
+    def _explain_info(self):
+        return f" exprs:{self.exprs!r}"
+
+
+@dataclass
+class PhysHashAgg(PhysPlan):
+    """Root-side complete aggregation (input = raw rows)."""
+
+    group_exprs: list = field(default_factory=list)
+    aggs: list = field(default_factory=list)
+
+    def _explain_info(self):
+        return f" group:{self.group_exprs!r} aggs:{self.aggs!r}"
+
+
+@dataclass
+class PhysFinalAgg(PhysPlan):
+    """Root-side merge of storage-side partial agg results."""
+
+    aggs: list = field(default_factory=list)
+    num_group_cols: int = 0
+
+    def _explain_info(self):
+        return f" aggs:{self.aggs!r}"
+
+
+@dataclass
+class PhysHashJoin(PhysPlan):
+    left_keys: list = field(default_factory=list)
+    right_keys: list = field(default_factory=list)
+    join_type: str = "inner"       # inner/left/right
+    other_cond: Optional[Expression] = None
+
+    def _explain_info(self):
+        return (f" type:{self.join_type} lkeys:{self.left_keys!r} "
+                f"rkeys:{self.right_keys!r}")
+
+
+@dataclass
+class PhysSort(PhysPlan):
+    by: list = field(default_factory=list)     # [(Expression, desc)]
+
+    def _explain_info(self):
+        return f" by:{[(repr(e), d) for e, d in self.by]}"
+
+
+@dataclass
+class PhysTopN(PhysPlan):
+    by: list = field(default_factory=list)
+    count: int = 0
+    offset: int = 0
+
+    def _explain_info(self):
+        return f" by:{[(repr(e), d) for e, d in self.by]} n:{self.count}"
+
+
+@dataclass
+class PhysLimit(PhysPlan):
+    count: int = 0
+    offset: int = 0
+
+    def _explain_info(self):
+        return f" n:{self.count} offset:{self.offset}"
+
+
+@dataclass
+class PhysValues(PhysPlan):
+    """Constant rows (SELECT without FROM / INSERT VALUES source)."""
+
+    rows: list = field(default_factory=list)   # [[Expression]]
+
+
+@dataclass
+class PhysInsert(PhysPlan):
+    table: TableInfo = None
+    columns: list = field(default_factory=list)     # column names, in order
+    source: PhysPlan = None                         # PhysValues or select
+    on_duplicate: list = field(default_factory=list)  # [(col_name, Expression)]
+    is_replace: bool = False
+    ignore: bool = False
+
+
+@dataclass
+class PhysUpdate(PhysPlan):
+    table: TableInfo = None
+    reader: PhysPlan = None        # scan emitting full row + handle
+    assignments: list = field(default_factory=list)  # [(col_name, Expression)]
+
+
+@dataclass
+class PhysDelete(PhysPlan):
+    table: TableInfo = None
+    reader: PhysPlan = None

@@ -1,0 +1,592 @@
+"""Rule-based planner: AST -> physical plan with storage pushdown.
+
+Reference: /root/reference/plan/ — logical build (logical_plan_builder.go),
+rule-based optimization {columnPruner, ppdSolver, aggregationOptimizer,
+pushDownTopNOptimizer} (plan/optimizer.go:42-50), and the copTask/rootTask
+split (plan/task.go:116-499). Rules here run during construction:
+
+* predicate pushdown: WHERE/ON conjuncts sink into table readers (split
+  into device-safe vs host-only parts), equi-conds become hash-join keys
+* column pruning: readers scan only referenced columns
+* aggregation pushdown: single-reader group-by ships as a storage-side
+  partial agg (CopPlan.aggs) merged by a root PhysFinalAgg
+* TopN pushdown: ORDER BY + LIMIT over a bare reader pushes the limit
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass
+
+from tidb_tpu import sqltypes as st
+from tidb_tpu.expression import (AggDesc, AggFunc, ColumnRef, Constant,
+                                 Expression, Op, ScalarFunc, and_all, func)
+from tidb_tpu.parser import ast
+from tidb_tpu.plan import physical as ph
+from tidb_tpu.plan.resolver import (PlanSchema, Resolver, ResolveError,
+                                    SchemaCol)
+from tidb_tpu.schema.infoschema import InfoSchema, SchemaError
+
+__all__ = ["Planner", "PlanError"]
+
+
+class PlanError(Exception):
+    pass
+
+
+def split_conjuncts(e: ast.ExprNode | None) -> list[ast.ExprNode]:
+    if e is None:
+        return []
+    if isinstance(e, ast.BinaryOp) and e.op == "AND":
+        return split_conjuncts(e.left) + split_conjuncts(e.right)
+    return [e]
+
+
+def split_device_host(cond: Expression | None):
+    """Partition a resolved conjunction into (device_safe, host_only)."""
+    if cond is None:
+        return None, None
+    dev, host = [], []
+
+    def walk(c: Expression):
+        if isinstance(c, ScalarFunc) and c.op == Op.AND:
+            walk(c.args[0])
+            walk(c.args[1])
+        elif c.is_device_safe():
+            dev.append(c)
+        else:
+            host.append(c)
+
+    walk(cond)
+    return and_all(dev), and_all(host)
+
+
+class Planner:
+    def __init__(self, infoschema: InfoSchema, current_db: str):
+        self.ischema = infoschema
+        self.db = current_db
+
+    # -- entry ---------------------------------------------------------------
+
+    def plan(self, stmt: ast.StmtNode) -> ph.PhysPlan:
+        if isinstance(stmt, ast.SelectStmt):
+            return self.plan_select(stmt)
+        if isinstance(stmt, ast.InsertStmt):
+            return self.plan_insert(stmt)
+        if isinstance(stmt, ast.UpdateStmt):
+            return self.plan_update(stmt)
+        if isinstance(stmt, ast.DeleteStmt):
+            return self.plan_delete(stmt)
+        raise PlanError(f"no plan for {type(stmt).__name__}")
+
+    # -- FROM ----------------------------------------------------------------
+
+    def _table_info(self, ts: ast.TableSource):
+        db = ts.db or self.db
+        if not db:
+            raise PlanError("No database selected")
+        try:
+            return db, self.ischema.table(db, ts.name)
+        except SchemaError as e:
+            raise PlanError(str(e)) from None
+
+    def build_reader(self, ts: ast.TableSource) -> ph.PhysTableReader:
+        _db, info = self._table_info(ts)
+        cols = info.public_columns()
+        schema = PlanSchema([
+            SchemaCol(c.name.lower(), ts.ref_name.lower(), c.ft, c.id)
+            for c in cols])
+        cop = ph.CopPlan(table=info, cols=list(cols))
+        return ph.PhysTableReader(schema=schema, cop=cop)
+
+    def build_from(self, node) -> ph.PhysPlan:
+        if isinstance(node, ast.TableSource):
+            return self.build_reader(node)
+        if isinstance(node, ast.SubqueryTable):
+            sub = self.plan_select(node.select)
+            alias = node.alias.lower()
+            schema = PlanSchema([
+                SchemaCol(c.name, alias, c.ft) for c in sub.schema.cols])
+            sub.schema = schema
+            return sub
+        if isinstance(node, ast.Join):
+            left = self.build_from(node.left)
+            right = self.build_from(node.right)
+            tp = {ast.JoinType.INNER: "inner", ast.JoinType.CROSS: "inner",
+                  ast.JoinType.LEFT: "left",
+                  ast.JoinType.RIGHT: "right"}[node.tp]
+            join = ph.PhysHashJoin(
+                schema=left.schema.merge(right.schema),
+                children=[left, right], join_type=tp)
+            conds = []
+            if node.on is not None:
+                r = Resolver(join.schema)
+                conds = [r.resolve(c) for c in split_conjuncts(node.on)]
+            for u in node.using:
+                li = left.schema.find(u)
+                ri = right.schema.find(u)
+                conds.append(func(
+                    Op.EQ, ColumnRef(li, left.schema.cols[li].ft),
+                    ColumnRef(ri + len(left.schema), right.schema.cols[ri].ft)))
+            for c in conds:
+                self._assign_cond(join, c, where_phase=False)
+            return join
+        raise PlanError(f"unsupported FROM {type(node).__name__}")
+
+    # -- predicate assignment ------------------------------------------------
+
+    def _assign_cond(self, plan: ph.PhysPlan, cond: Expression,
+                     where_phase: bool) -> ph.PhysPlan:
+        """Sink one resolved conjunct as deep as legal; returns the
+        (possibly wrapped) plan."""
+        if isinstance(plan, ph.PhysHashJoin):
+            nl = len(plan.children[0].schema)
+            used = cond.columns_used()
+            left_ok = all(i < nl for i in used)
+            right_ok = all(i >= nl for i in used)
+            lt = plan.join_type
+            if left_ok and (lt != "right" or not where_phase or
+                            self._rejects_null(cond)):
+                plan.children[0] = self._assign_cond(
+                    plan.children[0], cond, where_phase)
+                return plan
+            if right_ok and (lt != "left" or not where_phase or
+                             self._rejects_null(cond)):
+                remap = {i: i - nl for i in used}
+                plan.children[1] = self._assign_cond(
+                    plan.children[1], cond.map_columns(remap), where_phase)
+                return plan
+            # equi-join key? EQ(left col expr, right col expr)
+            if isinstance(cond, ScalarFunc) and cond.op == Op.EQ and \
+                    lt in ("inner", "left", "right"):
+                a, b = cond.args
+                ua, ub = a.columns_used(), b.columns_used()
+                if ua and ub:
+                    if all(i < nl for i in ua) and all(i >= nl for i in ub):
+                        plan.left_keys.append(a)
+                        plan.right_keys.append(
+                            b.map_columns({i: i - nl for i in ub}))
+                        return plan
+                    if all(i < nl for i in ub) and all(i >= nl for i in ua):
+                        plan.left_keys.append(b)
+                        plan.right_keys.append(
+                            a.map_columns({i: i - nl for i in ua}))
+                        return plan
+            if lt == "inner":
+                plan.other_cond = cond if plan.other_cond is None else \
+                    func(Op.AND, plan.other_cond, cond)
+                return plan
+            # outer join + unpushable WHERE cond: filter above the join
+            return ph.PhysSelection(schema=plan.schema, children=[plan],
+                                    cond=cond)
+        if isinstance(plan, ph.PhysTableReader) and not plan.cop.is_agg:
+            dev, host = split_device_host(cond)
+            if dev is not None:
+                plan.cop.filter = dev if plan.cop.filter is None else \
+                    func(Op.AND, plan.cop.filter, dev)
+            if host is not None:
+                plan.cop.host_filter = host if plan.cop.host_filter is None \
+                    else func(Op.AND, plan.cop.host_filter, host)
+            return plan
+        if isinstance(plan, ph.PhysSelection):
+            plan.cond = func(Op.AND, plan.cond, cond)
+            return plan
+        return ph.PhysSelection(schema=plan.schema, children=[plan],
+                                cond=cond)
+
+    @staticmethod
+    def _rejects_null(cond: Expression) -> bool:
+        """True if the cond is false for NULL inputs (so pushing below an
+        outer join's null-supplying side is sound). Conservative: plain
+        comparisons reject NULL; IS NULL / IFNULL-style do not."""
+        if isinstance(cond, ScalarFunc) and cond.op in (
+                Op.EQ, Op.NE, Op.LT, Op.LE, Op.GT, Op.GE, Op.LIKE, Op.IN):
+            return True
+        return False
+
+    # -- SELECT --------------------------------------------------------------
+
+    def plan_select(self, stmt: ast.SelectStmt) -> ph.PhysPlan:
+        if stmt.from_clause is None:
+            return self._plan_select_no_from(stmt)
+        plan = self.build_from(stmt.from_clause)
+        # WHERE
+        r = Resolver(plan.schema)
+        for c_ast in split_conjuncts(stmt.where):
+            plan = self._assign_cond(plan, r.resolve(c_ast),
+                                     where_phase=True)
+
+        has_agg = bool(stmt.group_by) or _contains_agg(stmt)
+        if has_agg:
+            plan, out_schema, proj_exprs, proj_names, order_keys = \
+                self._plan_agg_select(stmt, plan)
+        else:
+            proj_exprs, proj_names = self._resolve_fields(stmt, plan.schema)
+            out_schema = PlanSchema([
+                SchemaCol(n, "", e.ft) for n, e in
+                zip(proj_names, proj_exprs)])
+            order_keys = None
+
+        if stmt.distinct:
+            # SQL order: projection -> DISTINCT -> ORDER BY -> LIMIT
+            plan = ph.PhysProjection(schema=out_schema, children=[plan],
+                                     exprs=proj_exprs)
+            gexprs = [ColumnRef(i, c.ft) for i, c in
+                      enumerate(out_schema.cols)]
+            plan = ph.PhysHashAgg(schema=out_schema, children=[plan],
+                                  group_exprs=gexprs, aggs=[])
+            if stmt.order_by:
+                by = []
+                for bi in stmt.order_by:
+                    target = self._maybe_alias_target(bi.expr, stmt)
+                    if not isinstance(target, ast.ColName):
+                        raise PlanError("ORDER BY with DISTINCT must name "
+                                        "select-list columns")
+                    oi = out_schema.find(target.name, target.table)
+                    by.append((ColumnRef(oi, out_schema.cols[oi].ft),
+                               bi.desc))
+                plan = ph.PhysSort(schema=out_schema, children=[plan], by=by)
+            if stmt.limit is not None:
+                plan = ph.PhysLimit(schema=out_schema, children=[plan],
+                                    count=stmt.limit, offset=stmt.offset)
+            return plan
+
+        # ORDER BY
+        by = []
+        if stmt.order_by:
+            by = self._resolve_order(stmt, plan.schema, out_schema,
+                                     proj_exprs, order_keys)
+        # TopN pushdown / sort / limit assembly
+        if by:
+            if stmt.limit is not None:
+                plan = ph.PhysTopN(schema=plan.schema, children=[plan],
+                                   by=by, count=stmt.limit,
+                                   offset=stmt.offset)
+            else:
+                plan = ph.PhysSort(schema=plan.schema, children=[plan],
+                                   by=by)
+        elif stmt.limit is not None:
+            if isinstance(plan, ph.PhysTableReader) and not plan.cop.is_agg \
+                    and stmt.offset == 0:
+                plan.cop.limit = stmt.limit
+            plan = ph.PhysLimit(schema=plan.schema, children=[plan],
+                                count=stmt.limit, offset=stmt.offset)
+        return ph.PhysProjection(schema=out_schema, children=[plan],
+                                 exprs=proj_exprs)
+
+    def _plan_select_no_from(self, stmt: ast.SelectStmt) -> ph.PhysPlan:
+        r = Resolver(PlanSchema([]))
+        exprs, names = [], []
+        for f in stmt.fields:
+            if isinstance(f.expr, ast.Star):
+                raise PlanError("SELECT * requires FROM")
+            e = r.resolve(f.expr)
+            exprs.append(e)
+            names.append(f.alias or _field_name(f.expr))
+        schema = PlanSchema([SchemaCol(n, "", e.ft)
+                             for n, e in zip(names, exprs)])
+        vals = ph.PhysValues(schema=schema, rows=[exprs])
+        return vals
+
+    # -- fields / projection -------------------------------------------------
+
+    def _expand_fields(self, stmt: ast.SelectStmt, schema: PlanSchema):
+        """Expand * / t.* into per-column fields."""
+        out = []
+        for f in stmt.fields:
+            if isinstance(f.expr, ast.Star):
+                tbl = f.expr.table.lower()
+                for i, c in enumerate(schema.cols):
+                    if not tbl or c.table == tbl:
+                        out.append((ast.ColName(name=c.name, table=c.table),
+                                    c.name))
+                if not out:
+                    raise PlanError(f"unknown table '{tbl}' in {tbl}.*")
+            else:
+                out.append((f.expr, f.alias or _field_name(f.expr)))
+        return out
+
+    def _resolve_fields(self, stmt, schema: PlanSchema):
+        r = Resolver(schema)
+        exprs, names = [], []
+        for e_ast, name in self._expand_fields(stmt, schema):
+            exprs.append(r.resolve(e_ast))
+            names.append(name)
+        return exprs, names
+
+    # -- aggregation ---------------------------------------------------------
+
+    def _plan_agg_select(self, stmt: ast.SelectStmt, plan: ph.PhysPlan):
+        in_schema = plan.schema
+        base_r = Resolver(in_schema)
+        # 1. group exprs over input schema
+        group_asts = [bi.expr for bi in stmt.group_by]
+        group_exprs = []
+        for ga in group_asts:
+            # GROUP BY <alias> / <position>
+            ga2 = self._maybe_alias_target(ga, stmt)
+            group_exprs.append(base_r.resolve(ga2))
+        group_ast_reprs = [repr(self._maybe_alias_target(g, stmt))
+                           for g in group_asts]
+
+        aggs: list[AggDesc] = []
+        num_g = len(group_exprs)
+
+        def agg_schema():
+            cols = []
+            for i, (ge, gr) in enumerate(zip(group_exprs, group_asts)):
+                nm = gr.name.lower() if isinstance(gr, ast.ColName) else \
+                    f"_g{i}"
+                tb = gr.table.lower() if isinstance(gr, ast.ColName) else ""
+                cols.append(SchemaCol(nm, tb, ge.ft))
+            for j, a in enumerate(aggs):
+                cols.append(SchemaCol(f"_a{j}", "", a.result_ft))
+            return PlanSchema(cols)
+
+        resolver = _AggResolver(in_schema, aggs, num_g, group_ast_reprs,
+                                group_exprs)
+        # 2. select fields over (group cols + aggs)
+        proj_exprs, proj_names = [], []
+        for e_ast, name in self._expand_fields(stmt, in_schema):
+            proj_exprs.append(resolver.resolve_over_agg(e_ast))
+            proj_names.append(name)
+        # 3. having
+        having_expr = None
+        if stmt.having is not None:
+            having_expr = resolver.resolve_over_agg(stmt.having)
+        # 4. order by may reference aggs too — resolve now, carry through
+        order_keys = []
+        if stmt.order_by:
+            for bi in stmt.order_by:
+                target = self._maybe_alias_target(bi.expr, stmt)
+                try:
+                    order_keys.append(
+                        (resolver.resolve_over_agg(target), bi.desc))
+                except ResolveError:
+                    order_keys.append(None)  # resolved later vs aliases
+
+        # decide pushdown: single bare reader + no distinct aggs
+        reader_ok = isinstance(plan, ph.PhysTableReader) and \
+            not plan.cop.is_agg and plan.cop.limit is None
+        no_distinct = all(not a.distinct for a in aggs)
+        if reader_ok and no_distinct:
+            plan.cop.group_exprs = group_exprs
+            plan.cop.aggs = aggs
+            agg_plan = ph.PhysFinalAgg(schema=agg_schema(), children=[plan],
+                                       aggs=aggs, num_group_cols=num_g)
+        else:
+            agg_plan = ph.PhysHashAgg(schema=agg_schema(), children=[plan],
+                                      group_exprs=group_exprs, aggs=aggs)
+        out = agg_plan
+        if having_expr is not None:
+            out = ph.PhysSelection(schema=agg_plan.schema, children=[out],
+                                   cond=having_expr)
+        out_schema = PlanSchema([SchemaCol(n, "", e.ft)
+                                 for n, e in zip(proj_names, proj_exprs)])
+        return out, out_schema, proj_exprs, proj_names, order_keys
+
+    def _maybe_alias_target(self, e: ast.ExprNode, stmt: ast.SelectStmt):
+        """GROUP BY / ORDER BY may name a select alias or 1-based position."""
+        if isinstance(e, ast.Literal) and isinstance(e.value, int) and \
+                1 <= e.value <= len(stmt.fields):
+            f = stmt.fields[e.value - 1]
+            if not isinstance(f.expr, ast.Star):
+                return f.expr
+        if isinstance(e, ast.ColName) and not e.table:
+            for f in stmt.fields:
+                if f.alias and f.alias.lower() == e.name.lower():
+                    return f.expr
+        return e
+
+    def _resolve_order(self, stmt, in_schema: PlanSchema,
+                       out_schema: PlanSchema, proj_exprs, order_keys):
+        """Order keys run BELOW the projection, over in_schema."""
+        by = []
+        for i, bi in enumerate(stmt.order_by):
+            if order_keys is not None and order_keys[i] is not None:
+                by.append((order_keys[i][0], order_keys[i][1]))
+                continue
+            target = self._maybe_alias_target(bi.expr, stmt)
+            # alias/output name -> reuse the projection expression
+            try:
+                oi = out_schema.find(
+                    target.name if isinstance(target, ast.ColName) else "",
+                    target.table if isinstance(target, ast.ColName) else "")
+                by.append((proj_exprs[oi], bi.desc))
+                continue
+            except (ResolveError, AttributeError):
+                pass
+            by.append((Resolver(in_schema).resolve(target), bi.desc))
+        return by
+
+    # -- DML -----------------------------------------------------------------
+
+    def plan_insert(self, stmt: ast.InsertStmt) -> ph.PhysInsert:
+        _db, info = self._table_info(stmt.table)
+        cols = stmt.columns or [c.name for c in info.public_columns()]
+        for c in cols:
+            if info.col_by_name(c) is None:
+                raise PlanError(f"Unknown column '{c}'")
+        if stmt.select is not None:
+            source = self.plan_select(stmt.select)
+            if len(source.schema) != len(cols):
+                raise PlanError("Column count doesn't match value count")
+        else:
+            r = Resolver(PlanSchema([]))
+            rows = []
+            for vr in stmt.values:
+                if len(vr) != len(cols):
+                    raise PlanError("Column count doesn't match value count")
+                rows.append([None if isinstance(v, ast.DefaultExpr)
+                             else r.resolve(v) for v in vr])
+            source = ph.PhysValues(rows=rows)
+        dup = []
+        if stmt.on_duplicate:
+            # assignments may reference existing row columns
+            schema = PlanSchema([
+                SchemaCol(c.name.lower(), info.name.lower(), c.ft, c.id)
+                for c in info.public_columns()])
+            r2 = Resolver(schema)
+            for a in stmt.on_duplicate:
+                if info.col_by_name(a.col.name) is None:
+                    raise PlanError(f"Unknown column '{a.col.name}'")
+                dup.append((a.col.name.lower(), r2.resolve(a.expr)))
+        return ph.PhysInsert(table=info, columns=[c.lower() for c in cols],
+                             source=source, on_duplicate=dup,
+                             is_replace=stmt.is_replace, ignore=stmt.ignore)
+
+    def _plan_writable_reader(self, ts: ast.TableSource,
+                              where: ast.ExprNode | None):
+        """Reader emitting all public columns + trailing _handle col."""
+        _db, info = self._table_info(ts)
+        cols = info.public_columns()
+        schema = PlanSchema(
+            [SchemaCol(c.name.lower(), ts.ref_name.lower(), c.ft, c.id)
+             for c in cols] +
+            [SchemaCol("_handle", ts.ref_name.lower(), st.new_int_field())])
+        cop = ph.CopPlan(table=info, cols=list(cols),
+                         handle_col=len(cols))
+        plan = ph.PhysTableReader(schema=schema, cop=cop)
+        if where is not None:
+            r = Resolver(schema)
+            for c_ast in split_conjuncts(where):
+                plan = self._assign_cond(plan, r.resolve(c_ast), True)
+        return info, plan
+
+    def plan_update(self, stmt: ast.UpdateStmt) -> ph.PhysUpdate:
+        if not isinstance(stmt.table, ast.TableSource):
+            raise PlanError("multi-table UPDATE not supported")
+        info, reader = self._plan_writable_reader(stmt.table, stmt.where)
+        assigns = []
+        r = Resolver(reader.schema)
+        for a in stmt.assignments:
+            if info.col_by_name(a.col.name) is None:
+                raise PlanError(f"Unknown column '{a.col.name}'")
+            assigns.append((a.col.name.lower(), r.resolve(a.expr)))
+        return ph.PhysUpdate(table=info, reader=reader, assignments=assigns)
+
+    def plan_delete(self, stmt: ast.DeleteStmt) -> ph.PhysDelete:
+        info, reader = self._plan_writable_reader(stmt.table, stmt.where)
+        return ph.PhysDelete(table=info, reader=reader)
+
+
+def _contains_agg(stmt: ast.SelectStmt) -> bool:
+    found = False
+
+    def walk(n):
+        nonlocal found
+        if found or n is None or not isinstance(n, ast.Node):
+            return
+        if isinstance(n, ast.AggregateCall):
+            found = True
+            return
+        if isinstance(n, (ast.SubqueryExpr, ast.ExistsSubquery)):
+            return  # inner aggregates belong to the subquery
+        for f in vars(n).values():
+            if isinstance(f, ast.Node):
+                walk(f)
+            elif isinstance(f, (list, tuple)):
+                for x in f:
+                    if isinstance(x, ast.Node):
+                        walk(x)
+                    elif isinstance(x, tuple):
+                        for y in x:
+                            walk(y) if isinstance(y, ast.Node) else None
+    for f in stmt.fields:
+        walk(f.expr)
+    walk(stmt.having)
+    for bi in stmt.order_by:
+        walk(bi.expr)
+    return found
+
+
+def _field_name(e: ast.ExprNode) -> str:
+    if isinstance(e, ast.ColName):
+        return e.name.lower()
+    if isinstance(e, ast.AggregateCall):
+        return f"{e.name.lower()}({'*' if e.star else '...'})"
+    if isinstance(e, ast.Literal):
+        return str(e.value)
+    return type(e).__name__.lower()
+
+
+class _AggResolver:
+    """Resolves select/having/order exprs over an aggregation's output:
+    whole-or-sub expressions matching a GROUP BY item become group column
+    refs; AggregateCalls land in the agg list; bare columns not in GROUP BY
+    get implicit FIRST_ROW (MySQL loose group-by, like the reference's
+    aggregation builder)."""
+
+    def __init__(self, in_schema: PlanSchema, aggs: list[AggDesc],
+                 num_group: int, group_reprs: list[str],
+                 group_exprs: list[Expression]):
+        self.in_schema = in_schema
+        self.aggs = aggs
+        self.num_group = num_group
+        self.group_reprs = group_reprs
+        self.group_exprs = group_exprs
+
+    def resolve_over_agg(self, e: ast.ExprNode) -> Expression:
+        # whole-expr group match
+        er = repr(e)
+        for i, gr in enumerate(self.group_reprs):
+            if er == gr:
+                return ColumnRef(i, self.group_exprs[i].ft)
+        if isinstance(e, ast.AggregateCall):
+            r = Resolver(self.in_schema, agg_collector=self.aggs,
+                         agg_base=self.num_group)
+            return r._r_AggregateCall(e)
+        if isinstance(e, ast.ColName):
+            # bare column not in group -> implicit first_row
+            r = Resolver(self.in_schema)
+            inner = r.resolve(e)
+            desc = AggDesc(AggFunc.FIRST_ROW, inner)
+            for i, d in enumerate(self.aggs):
+                if repr(d) == repr(desc):
+                    return ColumnRef(self.num_group + i, d.result_ft)
+            self.aggs.append(desc)
+            return ColumnRef(self.num_group + len(self.aggs) - 1,
+                             desc.result_ft)
+        if isinstance(e, ast.Literal):
+            return Resolver(self.in_schema).resolve(e)
+        # composite: rebuild node with resolved children
+        sub = _SubResolver(self)
+        return sub.resolve(e)
+
+
+class _SubResolver(Resolver):
+    """Resolver whose leaf ColName/AggregateCall handling delegates to the
+    surrounding _AggResolver (group/agg output refs)."""
+
+    def __init__(self, parent: _AggResolver):
+        super().__init__(parent.in_schema)
+        self.parent = parent
+
+    def resolve(self, e: ast.ExprNode) -> Expression:
+        er = repr(e)
+        for i, gr in enumerate(self.parent.group_reprs):
+            if er == gr:
+                return ColumnRef(i, self.parent.group_exprs[i].ft)
+        if isinstance(e, (ast.ColName, ast.AggregateCall)):
+            return self.parent.resolve_over_agg(e)
+        return super().resolve(e)

@@ -1,0 +1,318 @@
+"""Name resolution: AST expressions -> columnar expression trees.
+
+Reference: /root/reference/plan/expression_rewriter.go (AST -> Expression
+with column resolution against the child plan's schema) and
+plan/resolver.go name checks.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from dataclasses import dataclass, field
+
+from tidb_tpu import sqltypes as st
+from tidb_tpu.expression import (AggDesc, AggFunc, ColumnRef, Constant,
+                                 Expression, Op, col, const, func)
+from tidb_tpu.parser import ast
+
+__all__ = ["PlanSchema", "SchemaCol", "Resolver", "ResolveError"]
+
+
+class ResolveError(Exception):
+    pass
+
+
+@dataclass
+class SchemaCol:
+    name: str                 # lower column/alias name
+    table: str = ""           # lower table alias
+    ft: st.FieldType = None
+    col_id: int = 0           # ColumnInfo.id for datasource columns
+
+
+@dataclass
+class PlanSchema:
+    cols: list[SchemaCol] = field(default_factory=list)
+
+    def find(self, name: str, table: str = "") -> int:
+        name = name.lower()
+        table = table.lower()
+        hits = [i for i, c in enumerate(self.cols)
+                if c.name == name and (not table or c.table == table)]
+        if not hits:
+            raise ResolveError(f"Unknown column '{name}'")
+        if len(hits) > 1:
+            raise ResolveError(f"Column '{name}' is ambiguous")
+        return hits[0]
+
+    def merge(self, other: "PlanSchema") -> "PlanSchema":
+        return PlanSchema(self.cols + other.cols)
+
+    def __len__(self):
+        return len(self.cols)
+
+
+_FUNC_OPS = {
+    "ABS": Op.ABS, "CEIL": Op.CEIL, "CEILING": Op.CEIL, "FLOOR": Op.FLOOR,
+    "ROUND": Op.ROUND, "POW": Op.POW, "POWER": Op.POW, "SQRT": Op.SQRT,
+    "EXP": Op.EXP, "LN": Op.LN, "LOG2": Op.LOG2, "SIGN": Op.SIGN,
+    "CONCAT": Op.CONCAT, "LENGTH": Op.LENGTH, "UPPER": Op.UPPER,
+    "UCASE": Op.UPPER, "LOWER": Op.LOWER, "LCASE": Op.LOWER,
+    "TRIM": Op.TRIM, "LEFT": Op.LEFT, "RIGHT": Op.RIGHT,
+    "SUBSTRING": Op.SUBSTRING, "SUBSTR": Op.SUBSTRING, "REPLACE": Op.REPLACE,
+    "INSTR": Op.INSTR, "ASCII": Op.ASCII,
+    "YEAR": Op.YEAR, "MONTH": Op.MONTH, "DAY": Op.DAY,
+    "DAYOFMONTH": Op.DAY, "HOUR": Op.HOUR, "MINUTE": Op.MINUTE,
+    "SECOND": Op.SECOND, "DATEDIFF": Op.DATEDIFF,
+    "IF": Op.IF, "IFNULL": Op.IFNULL, "COALESCE": Op.COALESCE,
+}
+
+_AGG_MAP = {"COUNT": AggFunc.COUNT, "SUM": AggFunc.SUM, "AVG": AggFunc.AVG,
+            "MIN": AggFunc.MIN, "MAX": AggFunc.MAX,
+            "BIT_AND": AggFunc.BIT_AND, "BIT_OR": AggFunc.BIT_OR,
+            "BIT_XOR": AggFunc.BIT_XOR}
+
+_BIN_OPS = {"+": Op.PLUS, "-": Op.MINUS, "*": Op.MUL, "/": Op.DIV,
+            "DIV": Op.INTDIV, "%": Op.MOD, "MOD": Op.MOD,
+            "=": Op.EQ, "<": Op.LT, "<=": Op.LE, ">": Op.GT, ">=": Op.GE,
+            "<>": Op.NE, "!=": Op.NE, "<=>": Op.NULLEQ,
+            "AND": Op.AND, "OR": Op.OR, "XOR": Op.XOR}
+
+
+class Resolver:
+    """Resolves AST exprs against a PlanSchema. When `agg_collector` is set,
+    AggregateCall nodes are collected as AggDescs and replaced by refs into
+    the aggregation's output schema."""
+
+    def __init__(self, schema: PlanSchema,
+                 agg_collector: list[AggDesc] | None = None,
+                 agg_base: int = 0):
+        self.schema = schema
+        self.aggs = agg_collector
+        self.agg_base = agg_base  # index offset of agg outputs in out schema
+
+    def resolve(self, e: ast.ExprNode) -> Expression:
+        m = getattr(self, "_r_" + type(e).__name__, None)
+        if m is None:
+            raise ResolveError(f"unsupported expression {type(e).__name__}")
+        return m(e)
+
+    # -- leaves --------------------------------------------------------------
+
+    def _r_Literal(self, e: ast.Literal) -> Expression:
+        v = e.value
+        if isinstance(v, str):
+            # date-ish literals stay strings until compared with a time
+            # column; the comparison coercion below handles it
+            return const(v)
+        return const(v)
+
+    def _r_ColName(self, e: ast.ColName) -> Expression:
+        idx = self.schema.find(e.name, e.table)
+        sc = self.schema.cols[idx]
+        return ColumnRef(idx, sc.ft, name=sc.name)
+
+    def _r_VariableExpr(self, e: ast.VariableExpr) -> Expression:
+        raise ResolveError("variables resolve in the session layer")
+
+    # -- operators -----------------------------------------------------------
+
+    def _coerce_time(self, a: Expression, b: Expression):
+        """'2024-01-01' literals compared to DATETIME columns become
+        epoch-micros constants (MySQL implicit date coercion)."""
+        for x, y in ((a, b), (b, a)):
+            if x.ft.eval_type == st.EvalType.DATETIME and \
+                    isinstance(y, Constant) and isinstance(y.value, str):
+                try:
+                    micros = st.parse_datetime(y.value)
+                except ValueError:
+                    raise ResolveError(f"invalid date literal {y.value!r}")
+                new = Constant(micros, x.ft)
+                if y is b:
+                    return a, new
+                return new, b
+        return a, b
+
+    def _r_BinaryOp(self, e: ast.BinaryOp) -> Expression:
+        op = _BIN_OPS.get(e.op)
+        if op is None:
+            raise ResolveError(f"unsupported operator {e.op}")
+        a = self.resolve(e.left)
+        b = self.resolve(e.right)
+        a, b = self._coerce_time(a, b)
+        return func(op, a, b)
+
+    def _r_UnaryOp(self, e: ast.UnaryOp) -> Expression:
+        a = self.resolve(e.operand)
+        if e.op == "-":
+            return func(Op.UNARY_MINUS, a)
+        if e.op == "NOT":
+            return func(Op.NOT, a)
+        raise ResolveError(f"unsupported unary {e.op}")
+
+    def _r_IsNullExpr(self, e: ast.IsNullExpr) -> Expression:
+        f = func(Op.IS_NOT_NULL if e.negated else Op.IS_NULL,
+                 self.resolve(e.expr))
+        return f
+
+    def _r_InExpr(self, e: ast.InExpr) -> Expression:
+        if isinstance(e.items, ast.SubqueryExpr):
+            raise ResolveError("IN (subquery) not yet supported")
+        target = self.resolve(e.expr)
+        vals = []
+        for item in e.items:
+            r = self.resolve(item)
+            if not isinstance(r, Constant):
+                # fall back to OR chain for non-constant items
+                ors = None
+                for item2 in e.items:
+                    t2, r2 = self._coerce_time(target, self.resolve(item2))
+                    cmp_ = func(Op.EQ, t2, r2)
+                    ors = cmp_ if ors is None else func(Op.OR, ors, cmp_)
+                return func(Op.NOT, ors) if e.negated else ors
+            _, r = self._coerce_time(target, r)
+            vals.append(r.value)
+        out = func(Op.IN, target, extra=vals)
+        return func(Op.NOT, out) if e.negated else out
+
+    def _r_BetweenExpr(self, e: ast.BetweenExpr) -> Expression:
+        x = self.resolve(e.expr)
+        lo = self.resolve(e.low)
+        hi = self.resolve(e.high)
+        x1, lo = self._coerce_time(x, lo)
+        x2, hi = self._coerce_time(x, hi)
+        r = func(Op.AND, func(Op.GE, x1, lo), func(Op.LE, x2, hi))
+        return func(Op.NOT, r) if e.negated else r
+
+    def _r_LikeExpr(self, e: ast.LikeExpr) -> Expression:
+        pat = self.resolve(e.pattern)
+        if not isinstance(pat, Constant) or not isinstance(pat.value, str):
+            raise ResolveError("LIKE pattern must be a string literal")
+        out = func(Op.LIKE, self.resolve(e.expr), extra=pat.value)
+        return func(Op.NOT, out) if e.negated else out
+
+    def _r_CaseExpr(self, e: ast.CaseExpr) -> Expression:
+        args = []
+        if e.operand is not None:
+            op_expr = self.resolve(e.operand)
+            for c, v in e.when_clauses:
+                cc, rc = self._coerce_time(op_expr, self.resolve(c))
+                args.append(func(Op.EQ, cc, rc))
+                args.append(self.resolve(v))
+        else:
+            for c, v in e.when_clauses:
+                args.append(self.resolve(c))
+                args.append(self.resolve(v))
+        if e.else_clause is not None:
+            args.append(self.resolve(e.else_clause))
+        return func(Op.CASE, *args)
+
+    def _r_CastExpr(self, e: ast.CastExpr) -> Expression:
+        a = self.resolve(e.expr)
+        et = e.ft.eval_type
+        if et == st.EvalType.INT:
+            return func(Op.CAST_INT, a)
+        if et == st.EvalType.REAL:
+            return func(Op.CAST_REAL, a)
+        if et == st.EvalType.DECIMAL:
+            return func(Op.CAST_DECIMAL, a, extra=e.ft)
+        if et == st.EvalType.DATETIME:
+            if isinstance(a, Constant) and isinstance(a.value, str):
+                return Constant(st.parse_datetime(a.value), e.ft)
+            return a  # already micros
+        return func(Op.CAST_STRING, a)
+
+    def _r_FuncCall(self, e: ast.FuncCall) -> Expression:
+        name = e.name.upper()
+        if name in ("DATE_ADD", "DATE_SUB", "ADDDATE", "SUBDATE"):
+            return self._date_arith(e, sub=name in ("DATE_SUB", "SUBDATE"))
+        if name == "DATE":
+            a = self.resolve(e.args[0])
+            if isinstance(a, Constant) and isinstance(a.value, str):
+                return Constant(st.parse_datetime(a.value),
+                                st.new_date_field())
+            return a
+        if name == "NOW" or name == "CURRENT_TIMESTAMP":
+            return Constant(st.datetime_to_micros(_dt.datetime.now()),
+                            st.new_datetime_field())
+        if name == "DATABASE":
+            raise ResolveError("DATABASE() resolves in the session layer")
+        op = _FUNC_OPS.get(name)
+        if op is None:
+            raise ResolveError(f"unsupported function {name}")
+        args = [self.resolve(a) for a in e.args]
+        return func(op, *args)
+
+    def _date_arith(self, e: ast.FuncCall, sub: bool) -> Expression:
+        base = self.resolve(e.args[0])
+        if isinstance(base, Constant) and isinstance(base.value, str):
+            base = Constant(st.parse_datetime(base.value),
+                            st.new_datetime_field())
+        iv = e.args[1]
+        if isinstance(iv, ast.FuncCall) and iv.name == "INTERVAL":
+            n = self.resolve(iv.args[0])
+            unit = iv.args[1].value
+        else:
+            n = self.resolve(iv)
+            unit = "DAY"
+        if not isinstance(n, Constant):
+            raise ResolveError("INTERVAL amount must be constant")
+        amount = int(n.value)
+        days = {"DAY": 1, "WEEK": 7, "MONTH": 30, "YEAR": 365,
+                "QUARTER": 91}.get(unit)
+        if days is None:
+            raise ResolveError(f"unsupported INTERVAL unit {unit}")
+        if unit in ("MONTH", "YEAR", "QUARTER") and isinstance(base, Constant):
+            # calendar-exact for constants (the common TPC-H case)
+            dt = st.micros_to_datetime(base.value)
+            months = {"MONTH": 1, "YEAR": 12, "QUARTER": 3}[unit] * amount
+            if sub:
+                months = -months
+            y = dt.year + (dt.month - 1 + months) // 12
+            m = (dt.month - 1 + months) % 12 + 1
+            try:
+                nd = dt.replace(year=y, month=m)
+            except ValueError:  # e.g. Jan 31 + 1 month
+                nd = dt.replace(year=y, month=m, day=28)
+            return Constant(st.datetime_to_micros(nd), base.ft)
+        return func(Op.DATE_SUB_DAYS if sub else Op.DATE_ADD_DAYS, base,
+                    const(amount * days))
+
+    def _r_AggregateCall(self, e: ast.AggregateCall) -> Expression:
+        if self.aggs is None:
+            raise ResolveError(
+                f"aggregate {e.name} not allowed in this clause")
+        name = e.name.upper()
+        fn = _AGG_MAP.get(name)
+        if fn is None:
+            raise ResolveError(f"unsupported aggregate {name}")
+        arg = None
+        if not e.star:
+            if len(e.args) != 1:
+                raise ResolveError(f"{name} takes one argument")
+            arg = self.resolve(e.args[0])
+        desc = AggDesc(fn, arg, distinct=e.distinct)
+        # reuse identical agg (same fn/arg repr)
+        for i, d in enumerate(self.aggs):
+            if repr(d) == repr(desc):
+                return ColumnRef(self.agg_base + i, d.result_ft)
+        self.aggs.append(desc)
+        return ColumnRef(self.agg_base + len(self.aggs) - 1, desc.result_ft)
+
+    def _r_SubqueryExpr(self, e):
+        raise ResolveError("scalar subqueries not yet supported")
+
+    def _r_ExistsSubquery(self, e):
+        raise ResolveError("EXISTS subqueries not yet supported")
+
+    def _r_RowExpr(self, e):
+        raise ResolveError("row expressions not yet supported")
+
+    def _r_DefaultExpr(self, e):
+        raise ResolveError("DEFAULT only valid in INSERT values")
+
+    def _r_ParamMarker(self, e):
+        raise ResolveError("parameter markers resolve in prepared stmts")
+
+    def _r_Star(self, e):
+        raise ResolveError("* only valid in select list")

@@ -1,0 +1,6 @@
+from tidb_tpu.schema.model import (ColumnInfo, DBInfo, IndexInfo,
+                                   SchemaState, TableInfo)
+from tidb_tpu.schema.infoschema import InfoSchema
+
+__all__ = ["ColumnInfo", "DBInfo", "IndexInfo", "SchemaState", "TableInfo",
+           "InfoSchema"]

@@ -1,0 +1,400 @@
+"""Session: the SQL entry point.
+
+Reference: /root/reference/session.go — Session.Execute (parse -> compile ->
+run, :691-774), txn lifecycle with autocommit (tidb.go:155-177), and the
+Domain role (domain/domain.go) of caching infoschema versions. Optimistic
+retry on commit conflict replays the statement history
+(session.go:287,393-470).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+from tidb_tpu import kv
+from tidb_tpu.executor import (ExecContext, ExecError, build_executor)
+from tidb_tpu.ddl import DDLExecutor
+from tidb_tpu.meta import Meta
+from tidb_tpu.parser import ParseError, ast, parse
+from tidb_tpu.plan import Planner
+from tidb_tpu.plan.planner import PlanError
+from tidb_tpu.plan.resolver import ResolveError
+from tidb_tpu.schema.infoschema import InfoSchema
+from tidb_tpu.sqltypes import (EvalType, TypeCode, format_datetime,
+                               scaled_to_decimal)
+
+__all__ = ["Session", "ResultSet", "Domain", "SQLError"]
+
+COMMIT_RETRY_LIMIT = 10  # ref: tidb.go:109 commitRetryLimit
+
+
+class SQLError(Exception):
+    pass
+
+
+@dataclass
+class ResultSet:
+    columns: list[str]
+    rows: list[tuple]
+
+    def __repr__(self):
+        return f"ResultSet({self.columns}, {len(self.rows)} rows)"
+
+
+class Domain:
+    """Caches the InfoSchema per schema version (ref: domain.Reload,
+    domain/domain.go:267). One per storage."""
+
+    _instances: dict = {}
+    _lock = threading.Lock()
+
+    def __init__(self, storage):
+        self.storage = storage
+        self._schema: InfoSchema | None = None
+        self._mu = threading.Lock()
+
+    @classmethod
+    def get(cls, storage) -> "Domain":
+        with cls._lock:
+            d = cls._instances.get(id(storage))
+            if d is None:
+                d = cls(storage)
+                cls._instances[id(storage)] = d
+            return d
+
+    def info_schema(self) -> InfoSchema:
+        txn = self.storage.begin()
+        try:
+            meta = Meta(txn)
+            ver = meta.schema_version()
+            with self._mu:
+                if self._schema is not None and self._schema.version == ver:
+                    return self._schema
+                self._schema = InfoSchema.load(meta)
+                return self._schema
+        finally:
+            txn.rollback()
+
+
+class Session:
+    """Ref: session.go Session iface (:62-86)."""
+
+    def __init__(self, storage, db: str = ""):
+        self.storage = storage
+        self.domain = Domain.get(storage)
+        self.current_db = db
+        self.txn: kv.Transaction | None = None
+        self.autocommit = True
+        self.vars: dict[str, object] = {}
+        self.sys_vars: dict[str, object] = {"autocommit": 1,
+                                            "sql_mode": "STRICT_TRANS_TABLES"}
+        self._history: list[ast.StmtNode] = []  # stmt replay for retry
+
+    # -- public API ----------------------------------------------------------
+
+    def execute(self, sql: str):
+        """Execute semicolon-separated statements; returns a list of
+        ResultSet (queries) / int (affected rows) / None (commands)."""
+        stmts = parse(sql)
+        out = []
+        for stmt in stmts:
+            out.append(self._run_stmt(stmt))
+        return out
+
+    def query(self, sql: str) -> ResultSet:
+        res = self.execute(sql)
+        for r in res:
+            if isinstance(r, ResultSet):
+                return r
+        raise SQLError("statement returned no result set")
+
+    def close(self):
+        if self.txn is not None:
+            self.txn.rollback()
+            self.txn = None
+
+    # -- txn lifecycle -------------------------------------------------------
+
+    def _begin_txn(self):
+        if self.txn is None:
+            self.txn = self.storage.begin()
+            self._history = []
+        return self.txn
+
+    def _read_ts(self) -> int:
+        if self.txn is not None:
+            return self.txn.start_ts
+        return self.storage.current_ts()
+
+    def _commit(self):
+        """Commit with optimistic retry: on retryable conflict, replay the
+        txn's statement history at a fresh ts (ref: session.go:287
+        doCommitWithRetry + retry :393)."""
+        txn = self.txn
+        self.txn = None
+        if txn is None:
+            return
+        history = self._history
+        self._history = []
+        try:
+            txn.commit()
+            return
+        except kv.UndeterminedError:
+            raise
+        except kv.RetryableError as first_err:
+            last = first_err
+            for _ in range(COMMIT_RETRY_LIMIT):
+                retry_txn = self.storage.begin()
+                try:
+                    self.txn = retry_txn
+                    for stmt in history:
+                        self._exec_dml_in_txn(stmt)
+                    self.txn = None
+                    retry_txn.commit()
+                    return
+                except kv.RetryableError as e:
+                    self.txn = None
+                    last = e
+                except Exception:
+                    self.txn = None
+                    retry_txn.rollback()
+                    raise
+            raise last
+
+    def _rollback(self):
+        if self.txn is not None:
+            self.txn.rollback()
+            self.txn = None
+        self._history = []
+
+    # -- dispatch ------------------------------------------------------------
+
+    def _run_stmt(self, stmt: ast.StmtNode):
+        t = type(stmt).__name__
+        if isinstance(stmt, (ast.SelectStmt, ast.UnionStmt)):
+            return self._exec_query(stmt)
+        if isinstance(stmt, (ast.InsertStmt, ast.UpdateStmt,
+                             ast.DeleteStmt)):
+            return self._exec_dml(stmt)
+        if isinstance(stmt, (ast.CreateDatabaseStmt, ast.CreateTableStmt,
+                             ast.CreateIndexStmt, ast.DropTableStmt,
+                             ast.DropDatabaseStmt, ast.DropIndexStmt,
+                             ast.AlterTableStmt, ast.TruncateTableStmt,
+                             ast.RenameTableStmt)):
+            if self.txn is not None:
+                self._commit()  # implicit commit before DDL (MySQL semantics)
+            DDLExecutor(self.storage).execute(stmt, self.current_db)
+            return None
+        if isinstance(stmt, ast.UseStmt):
+            ischema = self.domain.info_schema()
+            if not ischema.has_db(stmt.db):
+                raise SQLError(f"Unknown database '{stmt.db}'")
+            self.current_db = stmt.db
+            return None
+        if isinstance(stmt, ast.BeginStmt):
+            if self.txn is not None:
+                self._commit()
+            self._begin_txn()
+            return None
+        if isinstance(stmt, ast.CommitStmt):
+            self._commit()
+            return None
+        if isinstance(stmt, ast.RollbackStmt):
+            self._rollback()
+            return None
+        if isinstance(stmt, ast.SetStmt):
+            return self._exec_set(stmt)
+        if isinstance(stmt, ast.ShowStmt):
+            return self._exec_show(stmt)
+        if isinstance(stmt, ast.ExplainStmt):
+            return self._exec_explain(stmt)
+        if isinstance(stmt, ast.AnalyzeStmt):
+            return None  # stats milestone
+        if isinstance(stmt, ast.AdminStmt):
+            return ResultSet(columns=["info"], rows=[])
+        raise SQLError(f"unsupported statement {t}")
+
+    # -- queries -------------------------------------------------------------
+
+    def _planner(self) -> Planner:
+        return Planner(self.domain.info_schema(), self.current_db)
+
+    def _exec_query(self, stmt) -> ResultSet:
+        if isinstance(stmt, ast.UnionStmt):
+            return self._exec_union(stmt)
+        try:
+            plan = self._planner().plan_select(stmt)
+        except (PlanError, ResolveError) as e:
+            raise SQLError(str(e)) from None
+        ctx = ExecContext(self.storage, self._read_ts(), self.txn)
+        exe = build_executor(plan)
+        chunks = list(exe.chunks(ctx))
+        names = [c.name for c in plan.schema.cols]
+        rows = []
+        for ch in chunks:
+            rows.extend(_format_chunk(ch))
+        return ResultSet(columns=names, rows=rows)
+
+    def _exec_union(self, stmt: ast.UnionStmt) -> ResultSet:
+        results = [self._exec_query(s) for s in stmt.selects]
+        rows = list(results[0].rows)
+        for i, r in enumerate(results[1:]):
+            if len(r.columns) != len(results[0].columns):
+                raise SQLError("UNION column count mismatch")
+            rows.extend(r.rows)
+            if not stmt.alls[i]:
+                seen = []
+                dedup = set()
+                for row in rows:
+                    if row not in dedup:
+                        dedup.add(row)
+                        seen.append(row)
+                rows = seen
+        if stmt.limit is not None:
+            rows = rows[stmt.offset:stmt.offset + stmt.limit]
+        return ResultSet(columns=results[0].columns, rows=rows)
+
+    # -- DML -----------------------------------------------------------------
+
+    def _exec_dml(self, stmt) -> int:
+        in_txn = self.txn is not None
+        self._begin_txn()
+        # statement-level atomicity: snapshot the write buffer so a failed
+        # statement rolls back ITS writes without killing the txn
+        # (ref: StmtCommit/StmtRollback semantics)
+        saved = self.txn.us.membuf._d.copy()
+        saved_size = self.txn.us.membuf.size
+        saved_presumed = set(self.txn.us.presumed_not_exists)
+        try:
+            n = self._exec_dml_in_txn(stmt)
+        except Exception:
+            if self.txn is not None:
+                self.txn.us.membuf._d = saved
+                self.txn.us.membuf.size = saved_size
+                self.txn.us.presumed_not_exists = saved_presumed
+            if not in_txn and not self.autocommit:
+                pass  # keep the implicit txn open
+            elif not in_txn:
+                self._rollback()
+            raise
+        self._history.append(stmt)
+        if not in_txn and self.autocommit:
+            self._commit()
+        return n
+
+    def _exec_dml_in_txn(self, stmt) -> int:
+        try:
+            plan = self._planner().plan(stmt)
+        except (PlanError, ResolveError) as e:
+            raise SQLError(str(e)) from None
+        ctx = ExecContext(self.storage, self.txn.start_ts, self.txn)
+        exe = build_executor(plan)
+        return exe.execute(ctx)
+
+    # -- SET / SHOW / EXPLAIN ------------------------------------------------
+
+    def _exec_set(self, stmt: ast.SetStmt):
+        from tidb_tpu.plan.resolver import PlanSchema, Resolver
+        r = Resolver(PlanSchema([]))
+        for a in stmt.assignments:
+            if isinstance(a.value, ast.ColName):
+                val = a.value.name  # bare words like STRICT
+            else:
+                e = r.resolve(a.value)
+                import numpy as np
+                d, v = e.eval_xp(np, [], 1)
+                val = None if not v[0] else (
+                    d[0].item() if hasattr(d[0], "item") else d[0])
+            if a.is_system:
+                self.sys_vars[a.name.lower()] = val
+                if a.name.lower() == "autocommit":
+                    self.autocommit = bool(int(val)) if val is not None \
+                        else True
+            else:
+                self.vars[a.name] = val
+        return None
+
+    def _exec_show(self, stmt: ast.ShowStmt) -> ResultSet:
+        ischema = self.domain.info_schema()
+        if stmt.tp == "databases":
+            return ResultSet(["Database"],
+                             [(n,) for n in ischema.db_names()])
+        if stmt.tp == "tables":
+            db = stmt.db or self.current_db
+            return ResultSet([f"Tables_in_{db}"],
+                             [(n,) for n in ischema.table_names(db)])
+        if stmt.tp == "columns":
+            db = stmt.table.db or self.current_db
+            t = ischema.table(db, stmt.table.name)
+            rows = []
+            for c in t.public_columns():
+                rows.append((c.name, _type_name(c),
+                             "NO" if c.ft.not_null else "YES",
+                             "PRI" if (t.pk_is_handle and
+                                       c.name == t.pk_col_name) else "",
+                             None, ""))
+            return ResultSet(["Field", "Type", "Null", "Key", "Default",
+                              "Extra"], rows)
+        if stmt.tp == "variables":
+            rows = sorted((k, str(v)) for k, v in self.sys_vars.items())
+            if stmt.pattern:
+                import re
+                from tidb_tpu.expression.core import _like_to_regex
+                rx = re.compile(_like_to_regex(stmt.pattern))
+                rows = [r for r in rows if rx.fullmatch(r[0])]
+            return ResultSet(["Variable_name", "Value"], rows)
+        if stmt.tp == "create_table":
+            db = stmt.table.db or self.current_db
+            t = ischema.table(db, stmt.table.name)
+            cols = ",\n  ".join(f"`{c.name}` {_type_name(c)}"
+                                for c in t.public_columns())
+            return ResultSet(["Table", "Create Table"],
+                             [(t.name,
+                               f"CREATE TABLE `{t.name}` (\n  {cols}\n)")])
+        return ResultSet(["info"], [])
+
+    def _exec_explain(self, stmt: ast.ExplainStmt) -> ResultSet:
+        plan = self._planner().plan(stmt.stmt)
+        lines = plan.explain().split("\n")
+        return ResultSet(["plan"], [(l,) for l in lines])
+
+
+def _type_name(c) -> str:
+    ft = c.ft
+    names = {TypeCode.LONGLONG: "bigint", TypeCode.LONG: "int",
+             TypeCode.SHORT: "smallint", TypeCode.TINY: "tinyint",
+             TypeCode.DOUBLE: "double", TypeCode.FLOAT: "float",
+             TypeCode.NEWDECIMAL: f"decimal({ft.flen},{ft.frac})",
+             TypeCode.VARCHAR: f"varchar({ft.flen})",
+             TypeCode.STRING: f"char({ft.flen})",
+             TypeCode.BLOB: "text", TypeCode.DATE: "date",
+             TypeCode.DATETIME: "datetime",
+             TypeCode.TIMESTAMP: "timestamp",
+             TypeCode.DURATION: "time", TypeCode.YEAR: "year"}
+    return names.get(ft.tp, "unknown")
+
+
+def _format_chunk(ch) -> list[tuple]:
+    """Chunk-layer values -> client values (Decimal objects, datetime
+    strings)."""
+    rows = []
+    cols = ch.columns
+    for i in range(ch.num_rows):
+        row = []
+        for c in cols:
+            if not c.valid[i]:
+                row.append(None)
+                continue
+            v = c.data[i]
+            et = c.ft.eval_type
+            if et == EvalType.DECIMAL:
+                row.append(scaled_to_decimal(int(v), c.ft.frac))
+            elif et == EvalType.DATETIME:
+                row.append(format_datetime(int(v), c.ft.tp))
+            elif hasattr(v, "item"):
+                row.append(v.item())
+            else:
+                row.append(v)
+        rows.append(tuple(row))
+    return rows

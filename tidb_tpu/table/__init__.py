@@ -1,0 +1,300 @@
+"""Row-level table operations over the transactional KV store.
+
+Reference: /root/reference/table/tables/tables.go — AddRecord (:309),
+RowWithCols (:442), index maintenance (:601, table/tables/index.go);
+key layout via tablecodec.
+
+Datum conventions at this layer (matching sqltypes):
+    INT/DATETIME/DURATION -> python int (epoch micros for times)
+    REAL                  -> float
+    DECIMAL               -> (frac, scaled_int) tuple in KV, scaled per
+                             column frac in chunks
+    STRING                -> str/bytes
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tidb_tpu import codec, kv, tablecodec
+from tidb_tpu.chunk import Chunk, Column
+from tidb_tpu.schema.model import IndexInfo, SchemaState, TableInfo
+from tidb_tpu.sqltypes import (EvalType, FieldType, decimal_to_scaled,
+                               np_dtype_for)
+
+__all__ = ["Table", "DupKeyError", "encode_datum_for_col",
+           "decode_datum_for_col", "rows_to_chunk", "kvrows_to_chunk"]
+
+
+class DupKeyError(kv.KVError):
+    def __init__(self, key_desc: str):
+        super().__init__(f"Duplicate entry for key '{key_desc}'")
+
+
+def encode_datum_for_col(v, ft: FieldType):
+    """Python value -> KV datum representation."""
+    if v is None:
+        return None
+    if ft.eval_type == EvalType.DECIMAL:
+        if isinstance(v, tuple):
+            return v
+        return (ft.frac, decimal_to_scaled(v, ft.frac))
+    if ft.eval_type == EvalType.STRING:
+        return v if isinstance(v, (str, bytes)) else str(v)
+    if ft.eval_type == EvalType.REAL:
+        return float(v)
+    if ft.eval_type == EvalType.DATETIME and isinstance(v, str):
+        from tidb_tpu.sqltypes import parse_datetime
+        return parse_datetime(v)
+    return int(v)
+
+
+def decode_datum_for_col(v, ft: FieldType):
+    """KV datum -> chunk-layer value (scaled int for decimals)."""
+    if v is None:
+        return None
+    if ft.eval_type == EvalType.DECIMAL:
+        frac, scaled = v
+        if frac != ft.frac:
+            scaled = scaled * (10 ** (ft.frac - frac)) if ft.frac > frac \
+                else scaled // (10 ** (frac - ft.frac))
+        return scaled
+    if ft.eval_type == EvalType.STRING and isinstance(v, bytes):
+        try:
+            return v.decode("utf8")
+        except UnicodeDecodeError:
+            return v
+    return v
+
+
+class Table:
+    """Operations for one table inside caller-provided transactions."""
+
+    def __init__(self, info: TableInfo, storage):
+        self.info = info
+        self.storage = storage  # for auto-id allocation meta txns
+        self._auto_cache: tuple[int, int] | None = None  # [next, last]
+
+    # -- auto increment ------------------------------------------------------
+
+    AUTO_ID_STEP = 4000  # ref: meta/autoid allocator batch (autoid.go:36)
+
+    def alloc_auto_id(self) -> int:
+        if self._auto_cache is not None:
+            nxt, last = self._auto_cache
+            if nxt <= last:
+                self._auto_cache = (nxt + 1, last)
+                return nxt
+        from tidb_tpu.meta import Meta
+        txn = self.storage.begin()
+        try:
+            first, last = Meta(txn).gen_auto_id(self.info.id,
+                                                self.AUTO_ID_STEP)
+            txn.commit()
+        except Exception:
+            txn.rollback()
+            raise
+        self._auto_cache = (first + 1, last)
+        return first
+
+    def rebase_auto_id(self, at_least: int) -> None:
+        from tidb_tpu.meta import Meta
+        txn = self.storage.begin()
+        try:
+            Meta(txn).rebase_auto_id(self.info.id, at_least)
+            txn.commit()
+        except Exception:
+            txn.rollback()
+            raise
+        if self._auto_cache is not None and at_least >= self._auto_cache[0]:
+            self._auto_cache = None
+
+    # -- write path ----------------------------------------------------------
+
+    def add_record(self, txn: kv.Transaction, values: dict[str, object],
+                   handle: int | None = None, skip_dup_check: bool = False
+                   ) -> int:
+        """Insert one row; values keyed by lower column name. Returns the
+        handle. Ref: tables.go:309 AddRecord."""
+        info = self.info
+        row_vals = {}
+        for col in info.writable_columns():
+            cname = col.name.lower()
+            if cname in values:
+                v = values[cname]
+                # explicit NULL: auto-inc still allocates (MySQL), NOT NULL
+                # errors; it is NOT replaced by the default
+                if v is None and col.auto_increment:
+                    v = self.alloc_auto_id()
+                elif v is None and col.ft.not_null and \
+                        col.state == SchemaState.PUBLIC:
+                    raise kv.KVError(f"column '{col.name}' cannot be null")
+            else:
+                # omitted column: default / auto-increment
+                if col.auto_increment:
+                    v = self.alloc_auto_id()
+                elif col.has_default:
+                    v = col.default
+                elif col.ft.not_null and col.state == SchemaState.PUBLIC:
+                    raise kv.KVError(f"column '{col.name}' cannot be null")
+                else:
+                    v = None
+            row_vals[col.id] = encode_datum_for_col(v, col.ft) \
+                if v is not None else None
+
+        if handle is None:
+            if info.pk_is_handle:
+                pk = info.col_by_name(info.pk_col_name)
+                hv = row_vals.get(pk.id)
+                if hv is None:
+                    raise kv.KVError("primary key cannot be null")
+                handle = int(hv)
+                self.rebase_auto_id(handle) if pk.auto_increment else None
+            else:
+                handle = self.alloc_auto_id()
+
+        rk = tablecodec.record_key(info.id, handle)
+        if not skip_dup_check:
+            if info.pk_is_handle and txn.get(rk) is not None:
+                raise DupKeyError(f"{handle} for key 'PRIMARY'")
+        # indexes first (unique checks), then the row
+        for idx in self.info.writable_indexes():
+            self._add_index_entry(txn, idx, row_vals, handle,
+                                  check_dup=not skip_dup_check)
+        col_ids = sorted(row_vals)
+        txn.set(rk, tablecodec.encode_row(
+            col_ids, [row_vals[c] for c in col_ids]))
+        return handle
+
+    def _index_values(self, idx: IndexInfo, row_vals: dict[int, object]):
+        out = []
+        for cname in idx.columns:
+            col = self.info.col_by_name(cname)
+            out.append(row_vals.get(col.id))
+        return out
+
+    def _add_index_entry(self, txn, idx: IndexInfo,
+                         row_vals: dict[int, object], handle: int,
+                         check_dup: bool) -> None:
+        vals = self._index_values(idx, row_vals)
+        if idx.unique and all(v is not None for v in vals):
+            ik = tablecodec.index_key(self.info.id, idx.id, vals)
+            if check_dup:
+                existing = txn.get(ik)
+                if existing is not None:
+                    raise DupKeyError(f"{vals} for key '{idx.name}'")
+            txn.set(ik, codec.encode_int(handle))
+        else:
+            # non-unique (or unique w/ NULL part): handle in the key
+            ik = tablecodec.index_key(self.info.id, idx.id, vals,
+                                      handle=handle)
+            txn.set(ik, b"0")
+
+    def remove_record(self, txn: kv.Transaction, handle: int,
+                      row_vals: dict[int, object]) -> None:
+        """Ref: tables.go RemoveRecord + DeletableIndices."""
+        txn.delete(tablecodec.record_key(self.info.id, handle))
+        for idx in self.info.deletable_indexes():
+            vals = self._index_values(idx, row_vals)
+            if idx.unique and all(v is not None for v in vals):
+                txn.delete(tablecodec.index_key(self.info.id, idx.id, vals))
+            else:
+                txn.delete(tablecodec.index_key(self.info.id, idx.id, vals,
+                                                handle=handle))
+
+    def update_record(self, txn: kv.Transaction, handle: int,
+                      old_vals: dict[int, object],
+                      new_values: dict[str, object]) -> None:
+        """new_values keyed by lower column name (python values)."""
+        merged = dict(old_vals)
+        for name, v in new_values.items():
+            col = self.info.col_by_name(name)
+            merged[col.id] = encode_datum_for_col(v, col.ft) \
+                if v is not None else None
+        self.remove_record(txn, handle, old_vals)
+        col_ids = sorted(merged)
+        rk = tablecodec.record_key(self.info.id, handle)
+        for idx in self.info.writable_indexes():
+            self._add_index_entry(txn, idx, merged, handle, check_dup=True)
+        txn.set(rk, tablecodec.encode_row(
+            col_ids, [merged[c] for c in col_ids]))
+
+    # -- read path -----------------------------------------------------------
+
+    def row_by_handle(self, retriever, handle: int) -> dict[int, object] | None:
+        raw = retriever.get(tablecodec.record_key(self.info.id, handle))
+        if raw is None:
+            return None
+        return tablecodec.decode_row(raw)
+
+    def iter_records(self, retriever, start_handle: int | None = None):
+        """Yields (handle, {col_id: datum}). Ref: tables.go IterRecords."""
+        info = self.info
+        start = tablecodec.record_key(info.id, start_handle) \
+            if start_handle is not None else tablecodec.record_prefix(info.id)
+        end = codec.prefix_next(tablecodec.record_prefix(info.id))
+        for k, v in retriever.iter_range(start, end):
+            _tid, handle = tablecodec.decode_record_key(k)
+            yield handle, tablecodec.decode_row(v)
+
+
+def rows_to_chunk(fts: list[FieldType], rows: list[list]) -> Chunk:
+    """Build a chunk from decoded python values (decimals may be tuples)."""
+    cols = []
+    for j, ft in enumerate(fts):
+        vals = [decode_datum_for_col(r[j], ft) for r in rows]
+        dtype = np_dtype_for(ft.tp)
+        valid = np.array([v is not None for v in vals], dtype=bool)
+        if dtype == np.dtype(object):
+            data = np.empty(len(vals), dtype=object)
+            for i, v in enumerate(vals):
+                data[i] = v if v is not None else ""
+        else:
+            data = np.zeros(len(vals), dtype=dtype)
+            for i, v in enumerate(vals):
+                if v is not None:
+                    data[i] = v
+        cols.append(Column(ft, data, valid))
+    return Chunk(cols)
+
+
+def kvrows_to_chunk(info: TableInfo, col_infos, kvrows,
+                    with_handle_col: int | None = None) -> Chunk:
+    """Decode raw (key, value) record pairs into a chunk of the requested
+    columns. col_infos: list of ColumnInfo to emit, in order.
+    with_handle_col: emit the row handle as an extra int column at this
+    output position (DML readers need it to address rows).
+    This python loop is the row-decode hot path the native codec will
+    replace (ref: util/codec DecodeOneToChunk, codec.go:387)."""
+    from tidb_tpu.sqltypes import new_int_field
+    ncols = len(col_infos) + (1 if with_handle_col is not None else 0)
+    rows = []
+    for k, v in kvrows:
+        _tid, handle = tablecodec.decode_record_key(k)
+        d = tablecodec.decode_row(v)
+        row = []
+        src = 0
+        for j in range(ncols):
+            if with_handle_col is not None and j == with_handle_col:
+                row.append(handle)
+                continue
+            ci = col_infos[src]
+            src += 1
+            if ci.id in d:
+                val = d[ci.id]   # stored value, including explicit NULL
+            elif ci.has_default:
+                # row written before ALTER ADD COLUMN: synthesize default
+                val = encode_datum_for_col(ci.default, ci.ft)
+            else:
+                val = None
+            row.append(val)
+        rows.append(row)
+    fts = []
+    src = 0
+    for j in range(ncols):
+        if with_handle_col is not None and j == with_handle_col:
+            fts.append(new_int_field())
+        else:
+            fts.append(col_infos[src].ft)
+            src += 1
+    return rows_to_chunk(fts, rows)
