@@ -1,0 +1,59 @@
+"""Round benchmark: TPC-H Q1-shaped filter + 8-agg group-by on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference's Go HashAggExec path (executor/aggregate.go:32 over
+util/chunk) publishes no numbers (BASELINE.md), so vs_baseline is computed
+against a fixed 10M rows/sec estimate for the single-threaded Go chunk
+executor on Q1-shaped data — the north star in BASELINE.json is >=10x that.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+GO_BASELINE_ROWS_PER_SEC = 10e6
+
+ROWS = int(os.environ.get("BENCH_ROWS", 1 << 21))
+ITERS = int(os.environ.get("BENCH_ITERS", 8))
+
+
+def main() -> None:
+    import jax
+
+    from __graft_entry__ import _lineitem_chunk, _q1_exprs
+    from tidb_tpu.ops import runtime
+    from tidb_tpu.ops.hashagg import HashAggKernel
+
+    chunk = _lineitem_chunk(ROWS)
+    flt, groups, aggs = _q1_exprs()
+    kernel = HashAggKernel(flt, groups, aggs, capacity=64)
+
+    cols, _dicts = runtime.device_put_chunk(chunk)
+    n = chunk.num_rows
+
+    # warmup: compile + one run
+    out = kernel._jit(cols, n)
+    jax.block_until_ready(out)
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        out = kernel._jit(cols, n)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+
+    rows_per_sec = ROWS * ITERS / dt
+    print(json.dumps({
+        "metric": "tpch_q1_agg_rows_per_sec_per_chip",
+        "value": round(rows_per_sec, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(rows_per_sec / GO_BASELINE_ROWS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -36,7 +36,8 @@ from tidb_tpu.ops import runtime
 from tidb_tpu.sqltypes import EvalType
 
 __all__ = ["AggSpec", "HashAggKernel", "ScalarAggKernel", "HashAggregator",
-           "CapacityError", "CollisionError"]
+           "CapacityError", "CollisionError", "GroupResult",
+           "finalize_group_result"]
 
 AggSpec = AggDesc  # the planner's descriptor doubles as the kernel spec
 
@@ -168,6 +169,38 @@ class GroupResult:
     counts: np.ndarray           # rows per group
 
 
+def finalize_group_result(chunk: Chunk, group_exprs, aggs, gidx: np.ndarray,
+                          rep_rows: np.ndarray, lanes_per_agg,
+                          counts: np.ndarray) -> GroupResult:
+    """Shared host tail of the device kernels: recover exact group-key
+    values from representative rows (strings included — host path),
+    materialize FIRST_ROW values, and package a GroupResult.
+
+    lanes_per_agg: per agg, the [num_live_groups]-length lane arrays
+    (already gathered at gidx)."""
+    sub = chunk.take(rep_rows)
+    key_cols = []
+    for g in group_exprs:
+        d, v = g.eval(sub)
+        key_cols.append([None if not v[i] else
+                         (d[i].item() if hasattr(d[i], "item") else d[i])
+                         for i in range(len(gidx))])
+    keys = list(zip(*key_cols)) if key_cols else [()] * len(gidx)
+    partials = []
+    for a, ls in zip(aggs, lanes_per_agg):
+        if a.fn == AggFunc.FIRST_ROW:
+            # gather only the first-row rows, then evaluate the arg on
+            # that tiny sub-chunk (host path handles strings)
+            idx = ls[0]
+            hasv = ls[1] > 0
+            safe_idx = np.where(hasv, idx, 0).astype(np.int64)
+            d, _v = a.arg.eval(chunk.take(safe_idx))
+            vals = np.where(hasv, d, 0) if d.dtype != object else d
+            ls = [vals, hasv.astype(np.int64)]
+        partials.append(ls)
+    return GroupResult(keys=keys, partials=partials, counts=counts)
+
+
 class HashAggKernel:
     """Compiled filter+group+partial-agg over one chunk schema.
 
@@ -229,31 +262,9 @@ class HashAggKernel:
             raise CapacityError(f"distinct groups {int(nuniq)} > capacity "
                                 f"{self.capacity}")
         gidx = np.flatnonzero(live)
-        rep_rows = rep[gidx]
-        # exact group key values: evaluate group exprs on the tiny rep-row
-        # sub-chunk (strings included — host path)
-        sub = chunk.take(rep_rows)
-        key_cols = []
-        for g in self.group_exprs:
-            d, v = g.eval(sub)
-            key_cols.append([None if not v[i] else
-                             (d[i].item() if hasattr(d[i], "item") else d[i])
-                             for i in range(len(gidx))])
-        keys = list(zip(*key_cols)) if key_cols else []
-        partials = []
-        for a, ls in zip(self.aggs, lanes):
-            ls = [np.asarray(l)[gidx] for l in ls]
-            if a.fn == AggFunc.FIRST_ROW:
-                # gather only the first-row rows, then evaluate the arg on
-                # that tiny sub-chunk (host path handles strings)
-                idx = ls[0]
-                hasv = ls[1] > 0
-                safe_idx = np.where(hasv, idx, 0).astype(np.int64)
-                d, _v = a.arg.eval(chunk.take(safe_idx))
-                vals = np.where(hasv, d, 0) if d.dtype != object else d
-                ls = [vals, hasv.astype(np.int64)]
-            partials.append(ls)
-        return GroupResult(keys=keys, partials=partials, counts=counts[gidx])
+        lanes_at = [[np.asarray(l)[gidx] for l in ls] for ls in lanes]
+        return finalize_group_result(chunk, self.group_exprs, self.aggs,
+                                     gidx, rep[gidx], lanes_at, counts[gidx])
 
 
 class ScalarAggKernel:

@@ -42,10 +42,13 @@ def pad_column(data: np.ndarray, valid: np.ndarray, size: int):
     return pd, pv
 
 
-def device_put_chunk(chunk: Chunk, size: int | None = None):
-    """-> (cols, dicts): cols is a list of (jnp data, jnp valid) per column,
-    padded to a bucketed static size; varlen columns are dict-encoded and
-    their dictionaries returned in `dicts[col_idx]` for host-side decode."""
+def device_put_chunk(chunk: Chunk, size: int | None = None,
+                     to_device: bool = True):
+    """-> (cols, dicts): cols is a list of (data, valid) per column, padded
+    to a bucketed static size; varlen columns are dict-encoded and their
+    dictionaries returned in `dicts[col_idx]` for host-side decode.
+    With to_device=False the arrays stay numpy so the caller can issue one
+    jax.device_put with an explicit sharding (no double transfer)."""
     size = size or bucket_size(chunk.num_rows)
     cols = []
     dicts: dict[int, list] = {}
@@ -57,7 +60,9 @@ def device_put_chunk(chunk: Chunk, size: int | None = None):
             dicts[j] = values
             data, valid = codes, c.valid & (codes >= 0)
         data, valid = pad_column(np.ascontiguousarray(data), valid, size)
-        cols.append((jnp.asarray(data), jnp.asarray(valid)))
+        if to_device:
+            data, valid = jnp.asarray(data), jnp.asarray(valid)
+        cols.append((data, valid))
     return cols, dicts
 
 

@@ -1,0 +1,20 @@
+"""Multi-chip parallelism: device meshes + distributed operators.
+
+The reference scales reads by splitting key ranges into regions and fanning
+out goroutine workers (/root/reference/store/tikv/coprocessor.go:263,342).
+On TPU the same two axes become mesh axes (SURVEY.md §2.7, §5.7-5.8):
+
+* ``dp`` — data parallel over rows: each chip aggregates its shard of the
+  scan, the moral equivalent of per-region coprocessor workers.
+* ``tp`` — state parallel over the group-hash-table: the merged aggregate
+  state is reduce-scattered so each chip owns a slice of the buckets, the
+  analogue of sharding a hash join/agg build side across nodes.
+
+All cross-chip traffic is XLA collectives (psum / pmin / pmax /
+psum_scatter) riding ICI — never host RPC.
+"""
+
+from tidb_tpu.parallel.mesh import build_mesh, default_axes
+from tidb_tpu.parallel.dist_agg import MeshAggKernel
+
+__all__ = ["build_mesh", "default_axes", "MeshAggKernel"]

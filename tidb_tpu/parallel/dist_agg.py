@@ -1,0 +1,226 @@
+"""Distributed group-by aggregation over a device mesh.
+
+The reference merges per-region partial aggregates on one Go root
+(/root/reference/executor/aggregate.go + distsql fan-in, distsql.go:92).
+Here the merge itself is distributed: every chip aggregates its row shard
+locally (sort-based groups, exactly like ops/hashagg.py), the per-chip
+group tables ride an ``all_gather`` over ICI, and each chip re-reduces the
+gathered tables — the aggregation-state analogue of ring attention
+(SURVEY.md §5.7). The finalized bucket table is then sliced over the
+``tp`` axis so downstream per-group work (finalize, join probe) is
+state-parallel.
+
+Collision/overflow semantics match the single-chip kernel: a dual 64-bit
+hash detects key collisions, a true-distinct count detects capacity
+overflow; both raise so the caller can fall back or re-plan.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+
+from tidb_tpu.chunk import Chunk
+from tidb_tpu.expression import AggDesc, AggFunc, Expression
+from tidb_tpu.ops import runtime
+from tidb_tpu.ops.hashagg import (CapacityError, CollisionError, GroupResult,
+                                  _FILL, _SENTINEL_MASKED, _I64_MAX, _I64_MIN,
+                                  _hash_keys, _validate_device_exprs,
+                                  finalize_group_result)
+
+__all__ = ["MeshAggKernel"]
+
+_BIG = _I64_MAX
+
+
+def _distinct_count(xp, h):
+    """True number of distinct values in h (any size), static shape."""
+    s = xp.sort(h)
+    return 1 + xp.sum(s[1:] != s[:-1])
+
+
+def _local_agg_lanes(xp, agg: AggDesc, cols, n, mask, inv, capacity, offs):
+    """Per-shard lanes + their cross-shard merge ops ('sum'|'min'|'max').
+
+    Mirrors ops.hashagg._agg_lanes but every lane is mergeable by a
+    segment reduction after the all_gather (FIRST_ROW indices globalized
+    with the shard's row offset)."""
+    fn = agg.fn
+    if agg.arg is not None:
+        d, v = agg.arg.eval_xp(xp, cols, n)
+        live = mask & v
+    else:
+        d, live = None, mask
+    seg_sum = lambda x: jax.ops.segment_sum(x, inv, num_segments=capacity)
+    seg_min = lambda x: jax.ops.segment_min(x, inv, num_segments=capacity)
+    seg_max = lambda x: jax.ops.segment_max(x, inv, num_segments=capacity)
+    has = seg_max(live.astype(jnp.int64))
+
+    if fn == AggFunc.COUNT:
+        return [(seg_sum(live.astype(jnp.int64)), "sum")]
+    if fn == AggFunc.SUM:
+        zero = 0.0 if d.dtype == jnp.float64 else 0
+        return [(seg_sum(xp.where(live, d, zero)), "sum"), (has, "max")]
+    if fn == AggFunc.AVG:
+        zero = 0.0 if d.dtype == jnp.float64 else 0
+        return [(seg_sum(xp.where(live, d, zero)), "sum"),
+                (seg_sum(live.astype(jnp.int64)), "sum")]
+    if fn == AggFunc.MIN:
+        ident = jnp.inf if d.dtype == jnp.float64 else _I64_MAX
+        return [(seg_min(xp.where(live, d, ident)), "min"), (has, "max")]
+    if fn == AggFunc.MAX:
+        ident = -jnp.inf if d.dtype == jnp.float64 else _I64_MIN
+        return [(seg_max(xp.where(live, d, ident)), "max"), (has, "max")]
+    if fn == AggFunc.FIRST_ROW:
+        first = seg_min(xp.where(live, xp.arange(n), n))
+        gfirst = xp.where(has > 0, offs + first, _BIG)
+        return [(gfirst, "min"), (has, "max")]
+    raise NotImplementedError(f"device agg {fn}")
+
+
+_MERGE = {"sum": jax.ops.segment_sum,
+          "min": jax.ops.segment_min,
+          "max": jax.ops.segment_max}
+
+
+class MeshAggKernel:
+    """Filter + group-by + aggregation, distributed over a ('dp','tp') mesh.
+
+    One compiled XLA program: per-shard local aggregation, all_gather of
+    the group tables across every mesh axis, re-reduction, and a tp-axis
+    slice of the merged state. Rows are sharded over the flattened mesh;
+    columns stay separate arrays so int64 keys keep exact bits.
+    """
+
+    def __init__(self, mesh: Mesh, filter_expr: Expression | None,
+                 group_exprs: Sequence[Expression],
+                 aggs: Sequence[AggDesc], capacity: int = 4096):
+        self.mesh = mesh
+        self.filter_expr = filter_expr
+        self.group_exprs = list(group_exprs)
+        self.aggs = list(aggs)
+        _validate_device_exprs(filter_expr, self.group_exprs, self.aggs)
+        self.ndev = mesh.devices.size
+        self.tp = mesh.shape["tp"]
+        # internal table size = requested capacity + 2 headroom slots for
+        # the masked-sentinel and fill phantoms (which count as "distinct"
+        # but are never live groups), rounded up to a tp multiple so the
+        # merged table slices evenly
+        self.capacity = max(capacity, 1)
+        self._C = self.capacity + 2
+        self._C += (-self._C) % self.tp
+        self._row_spec = P(("dp", "tp"))
+        kwargs = dict(mesh=mesh, in_specs=(self._row_spec, P()),
+                      out_specs=(P("tp"), P("tp"), P("tp"), P("tp"),
+                                 P("tp"), P("tp"), P()))
+        try:
+            shard = shard_map(self._kernel, check_vma=False, **kwargs)
+        except TypeError:  # older jax spells it check_rep
+            shard = shard_map(self._kernel, check_rep=False, **kwargs)
+        self._jit = jax.jit(shard)
+
+    # -- traced program ------------------------------------------------------
+
+    def _kernel(self, cols, nrows):
+        ln = cols[0][0].shape[0]
+        xp = jnp
+        C = self._C
+        di = lax.axis_index("dp")
+        ti = lax.axis_index("tp")
+        offs = (di * self.tp + ti).astype(jnp.int64) * ln
+        alive = (offs + xp.arange(ln)) < nrows
+        mask = runtime.filter_mask_xp(xp, self.filter_expr, cols, ln) & alive
+        key_cols = [g.eval_xp(xp, cols, ln) for g in self.group_exprs]
+        h = _hash_keys(xp, key_cols, ln, seed=0x517CC1B727220A95)
+        h2 = _hash_keys(xp, key_cols, ln, seed=0x2545F4914F6CDD1D)
+        h = xp.where(mask, h, _SENTINEL_MASKED)
+
+        uniq, inv = jnp.unique(h, size=C, fill_value=_FILL,
+                               return_inverse=True)
+        local_tot = _distinct_count(xp, h)
+
+        lanes: list[tuple] = []  # (array[C], merge_op)
+        seg = lambda op, x: _MERGE[op](x, inv, num_segments=C)
+        lanes.append((seg("sum", mask.astype(jnp.int64)), "sum"))      # cnt
+        lanes.append((seg("min", xp.where(mask, h2, _I64_MAX)), "min"))
+        lanes.append((seg("max", xp.where(mask, h2, _I64_MIN)), "max"))
+        grep = seg("min", xp.where(mask, xp.arange(ln), ln))
+        ghas = seg("max", mask.astype(jnp.int64))
+        lanes.append((xp.where(ghas > 0, offs + grep, _BIG), "min"))   # rep
+        agg_lane_slices = []
+        for a in self.aggs:
+            ls = _local_agg_lanes(xp, a, cols, ln, mask, inv, C, offs)
+            agg_lane_slices.append((len(lanes) - 4, len(ls)))
+            lanes.extend(ls)
+
+        # -- cross-chip merge: gather every shard's table, re-reduce -------
+        # (single-device meshes skip the collectives entirely: some
+        # single-chip runtimes can't lower pmax/all_gather, and the local
+        # table already is the global table)
+        if self.ndev == 1:
+            return (uniq, *(l for l, _op in lanes[:4]),
+                    tuple(tuple(lanes[4 + s + i][0] for i in range(w))
+                          for s, w in agg_lane_slices),
+                    local_tot)
+        ax = ("dp", "tp")
+        all_uniq = lax.all_gather(uniq, ax, tiled=True)          # [ndev*C]
+        muniq, minv = jnp.unique(all_uniq, size=C, fill_value=_FILL,
+                                 return_inverse=True)
+        gtot = _distinct_count(xp, all_uniq)
+        # gathered fill/sentinel slots can add up to 2 phantom values to
+        # gtot relative to a single table; they are excluded on the host
+        # via the live mask, and capacity is checked with slack for them
+        tot = xp.maximum(gtot, lax.pmax(local_tot, ax))
+        merged = []
+        for lane, op in lanes:
+            g = lax.all_gather(lane, ax, tiled=True)
+            merged.append(_MERGE[op](g, minv, num_segments=C))
+
+        # -- tp-sliced outputs (replicated over dp) ------------------------
+        blk = C // self.tp
+        sl = lambda a: lax.dynamic_slice_in_dim(a, ti * blk, blk)
+        cnt, h2min, h2max, rep = merged[:4]
+        agg_out = tuple(
+            tuple(sl(merged[4 + start + i]) for i in range(width))
+            for start, width in agg_lane_slices)
+        return (sl(muniq), sl(cnt), sl(h2min), sl(h2max), sl(rep),
+                agg_out, tot)
+
+    # -- host driver ---------------------------------------------------------
+
+    def __call__(self, chunk: Chunk) -> GroupResult:
+        n = chunk.num_rows
+        ln = -(-max(n, 1) // self.ndev)
+        ln += (-ln) % 8
+        cols, _dicts = runtime.device_put_chunk(chunk, size=ln * self.ndev,
+                                                to_device=False)
+        sh = NamedSharding(self.mesh, self._row_spec)
+        cols = [(jax.device_put(d, sh), jax.device_put(v, sh))
+                for d, v in cols]
+        uniq, cnt, h2min, h2max, rep, agg_out, tot = self._jit(
+            cols, jnp.int64(n))
+        uniq = np.asarray(uniq)
+        cnt = np.asarray(cnt)
+        # tot counts the masked sentinel / fill phantoms; _C holds >= 2
+        # headroom slots for them, so tot > _C means possible truncation
+        if int(tot) > self._C:
+            raise CapacityError(
+                f"distinct groups {int(tot)} > capacity {self.capacity}")
+        live = (cnt > 0) & (uniq != _SENTINEL_MASKED) & (uniq != _FILL)
+        if bool(np.any(live & (np.asarray(h2min) != np.asarray(h2max)))):
+            raise CollisionError("group key hash collision")
+        gidx = np.flatnonzero(live)
+        lanes_at = [[np.asarray(l)[gidx] for l in ls] for ls in agg_out]
+        return finalize_group_result(chunk, self.group_exprs, self.aggs,
+                                     gidx, np.asarray(rep)[gidx], lanes_at,
+                                     cnt[gidx])

@@ -20,7 +20,7 @@ from tidb_tpu import codec, kv, tablecodec
 from tidb_tpu.chunk import Chunk, Column
 from tidb_tpu.schema.model import IndexInfo, SchemaState, TableInfo
 from tidb_tpu.sqltypes import (EvalType, FieldType, decimal_to_scaled,
-                               np_dtype_for)
+                               np_dtype_for, scaled_to_decimal)
 
 __all__ = ["Table", "DupKeyError", "encode_datum_for_col",
            "decode_datum_for_col", "rows_to_chunk", "kvrows_to_chunk"]
@@ -41,11 +41,22 @@ def encode_datum_for_col(v, ft: FieldType):
         return (ft.frac, decimal_to_scaled(v, ft.frac))
     if ft.eval_type == EvalType.STRING:
         return v if isinstance(v, (str, bytes)) else str(v)
+    if isinstance(v, tuple):      # decimal datum into a non-decimal column
+        frac, scaled = v
+        if ft.eval_type == EvalType.REAL:
+            return float(scaled_to_decimal(scaled, frac))
+        # exact int64-safe rounding, MySQL half-away-from-zero
+        q, r = divmod(abs(scaled), 10 ** frac)
+        out = q + (1 if 2 * r >= 10 ** frac else 0)
+        return out if scaled >= 0 else -out
     if ft.eval_type == EvalType.REAL:
         return float(v)
     if ft.eval_type == EvalType.DATETIME and isinstance(v, str):
         from tidb_tpu.sqltypes import parse_datetime
         return parse_datetime(v)
+    if isinstance(v, float):      # MySQL rounds halves away from zero
+        import math
+        return int(math.floor(v + 0.5)) if v >= 0 else int(math.ceil(v - 0.5))
     return int(v)
 
 
