@@ -1,0 +1,65 @@
+"""SQL joins large enough to ride the device join kernel path."""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.session import Session
+from tidb_tpu.store.storage import new_mock_storage
+
+
+@pytest.fixture(scope="module")
+def sess():
+    s = Session(new_mock_storage())
+    s.execute("CREATE DATABASE d")
+    s.execute("USE d")
+    s.execute("CREATE TABLE c (id BIGINT PRIMARY KEY, seg BIGINT)")
+    s.execute("CREATE TABLE o (id BIGINT PRIMARY KEY, cid BIGINT, "
+              "amt DOUBLE)")
+    rng = np.random.default_rng(11)
+    crows = ",".join(f"({i}, {i % 4})" for i in range(300))
+    s.execute(f"INSERT INTO c VALUES {crows}")
+    cid = rng.integers(0, 400, 3000)  # some orders dangle (cid >= 300)
+    amt = rng.uniform(1, 100, 3000).round(2)
+    orows = ",".join(f"({i}, {cid[i]}, {amt[i]})" for i in range(3000))
+    s.execute(f"INSERT INTO o VALUES {orows}")
+    s._truth = (cid, amt)
+    return s
+
+
+def test_device_join_agg(sess):
+    cid, amt = sess._truth
+    rows = sess.query(
+        "SELECT c.seg, COUNT(*), SUM(o.amt) FROM o JOIN c ON o.cid = c.id "
+        "GROUP BY c.seg ORDER BY c.seg").rows
+    want = {}
+    for i in range(3000):
+        if cid[i] < 300:
+            e = want.setdefault(cid[i] % 4, [0, 0.0])
+            e[0] += 1
+            e[1] += amt[i]
+    assert len(rows) == len(want)
+    for seg, cnt, s_ in rows:
+        assert cnt == want[seg][0]
+        assert s_ == pytest.approx(want[seg][1], rel=1e-9)
+
+
+def test_device_left_join_null_extension(sess):
+    cid, amt = sess._truth
+    rows = sess.query(
+        "SELECT COUNT(*) FROM o LEFT JOIN c ON o.cid = c.id "
+        "WHERE c.id IS NULL").rows
+    dangling = int(np.sum(cid >= 300))
+    assert rows[0][0] == dangling
+
+
+def test_device_join_topn(sess):
+    cid, amt = sess._truth
+    rows = sess.query(
+        "SELECT o.id, o.amt FROM o JOIN c ON o.cid = c.id "
+        "WHERE c.seg = 1 ORDER BY o.amt DESC LIMIT 5").rows
+    cand = sorted(
+        ((i, amt[i]) for i in range(3000)
+         if cid[i] < 300 and cid[i] % 4 == 1),
+        key=lambda t: -t[1])[:5]
+    assert [(r[0], pytest.approx(r[1])) for r in rows] == \
+        [(i, pytest.approx(a)) for i, a in cand]

@@ -125,3 +125,16 @@ def test_dist_agg_empty_chunk(mesh):
     k = MeshAggKernel(mesh, None, [gcol], aggs, capacity=8)
     gr = k(ch)
     assert gr.keys == []
+
+
+def test_dist_agg_float_group_keys(mesh):
+    # regression: value-cast hashing truncated 2.3 and 2.7 to the same
+    # group under both seeds; bitcast hashing must keep them distinct
+    n = 4096
+    vals = np.tile(np.array([2.3, 2.7, -0.0, 0.0]), n // 4)
+    ch = Chunk([Column(new_double_field(), vals)])
+    gcol = col(0, new_double_field(), "g")
+    aggs = [AggDesc(AggFunc.COUNT, None)]
+    k = MeshAggKernel(mesh, None, [gcol], aggs, capacity=16)
+    got = dict((key[0], v[0]) for key, v in _results([gcol], aggs, k(ch)))
+    assert got == {2.3: n // 4, 2.7: n // 4, 0.0: n // 2}

@@ -18,6 +18,7 @@ from tidb_tpu.kv import CopRequest, KVRange, ReqType
 from tidb_tpu.ops.hashagg import (CapacityError, CollisionError,
                                   GroupResult, HashAggKernel, HashAggregator)
 from tidb_tpu.ops.hostagg import host_hash_agg
+from tidb_tpu.ops.join import JoinKernel, JoinKeyEncoder
 from tidb_tpu.ops.runtime import eval_filter_host
 from tidb_tpu.plan import physical as ph
 from tidb_tpu.sqltypes import EvalType, FieldType, np_dtype_for
@@ -413,84 +414,143 @@ class TopNExec(Executor):
 
 
 class HashJoinExec(Executor):
-    """Host hash join (ref: executor/join.go:37 HashJoinExec; device join
-    lands with the join kernel milestone). Build side = right child."""
+    """Equi-join: device sort-based pair matching (ops/join.py) for large
+    inputs, python hash probe for small ones (ref: executor/join.go:37
+    HashJoinExec). Build side = right child, probe streams left chunks."""
+
+    # below these sizes the jit dispatch beats the device win
+    _DEVICE_MIN_PROBE = 1024
+    _DEVICE_MIN_BUILD = 4096
 
     def __init__(self, plan: ph.PhysHashJoin):
         self.plan = plan
         self.schema = plan.schema
         self.left = build_executor(plan.children[0])
         self.right = build_executor(plan.children[1])
+        self._kernel = JoinKernel(len(plan.left_keys)) \
+            if plan.left_keys else None
+
+    def _eval_keys(self, exprs, chunk):
+        """-> [(data, valid)] with both sides brought to one comparable
+        representation: decimal-vs-decimal/int rescale to the common frac
+        as exact scaled ints (falling back to double when the scaled value
+        could overflow int64); anything involving a REAL side compares as
+        double, matching MySQL's mixed-numeric comparison."""
+        out = []
+        for e, oe in zip(exprs, self._other_keys(exprs)):
+            d, v = e.eval(chunk)
+            d, v = np.asarray(d), np.asarray(v)
+            et, ot = e.ft.eval_type, oe.ft.eval_type
+            my = e.ft.frac if et == EvalType.DECIMAL else 0
+            their = oe.ft.frac if ot == EvalType.DECIMAL else 0
+            if EvalType.REAL in (et, ot):
+                if et == EvalType.DECIMAL:
+                    d = d.astype(np.float64) / (10 ** my)
+                elif d.dtype != np.float64 and d.dtype != np.dtype(object):
+                    d = d.astype(np.float64)
+            elif EvalType.DECIMAL in (et, ot):
+                common = max(my, their)
+                dig = (e.ft.flen if et == EvalType.DECIMAL else 19) \
+                    + common - my
+                odig = (oe.ft.flen if ot == EvalType.DECIMAL else 19) \
+                    + common - their
+                if max(dig, odig) > 18:   # scaled int64 could overflow
+                    d = d.astype(np.float64) / (10 ** my)
+                elif common > my:
+                    d = d * np.int64(10 ** (common - my))
+            out.append((d, v))
+        return out
+
+    def _other_keys(self, exprs):
+        return self.plan.right_keys if exprs is self.plan.left_keys \
+            else self.plan.left_keys
 
     def chunks(self, ctx):
         plan = self.plan
         if not plan.left_keys:
             yield from self._cross_join(ctx)
             return
-        # build
         build = None
         for chunk in self.right.chunks(ctx):
             build = chunk if build is None else build.concat(chunk)
-        table: dict = {}
-        if build is not None and build.num_rows:
-            bkeys = [e.eval(build) for e in plan.right_keys]
-            for i in range(build.num_rows):
-                if any(not v[i] for _d, v in bkeys):
-                    continue  # NULL keys never match
-                k = tuple(d[i] for d, _v in bkeys)
-                table.setdefault(k, []).append(i)
-        matched_right = np.zeros(build.num_rows if build is not None else 0,
-                                 dtype=bool)
-        # probe
+        nb = build.num_rows if build is not None else 0
+        enc = JoinKeyEncoder(len(plan.right_keys))
+        bk = enc.fit_build(self._eval_keys(plan.right_keys, build)) \
+            if nb else None
+        btable = None  # lazy python-dict probe table for small chunks
+        matched_build = np.zeros(nb, dtype=bool)
         for chunk in self.left.chunks(ctx):
-            if chunk.num_rows == 0:
+            n = chunk.num_rows
+            if n == 0:
                 continue
-            pkeys = [e.eval(chunk) for e in plan.left_keys]
-            li, ri = [], []
-            unmatched = []
-            for i in range(chunk.num_rows):
-                if any(not v[i] for _d, v in pkeys):
-                    if plan.join_type == "left":
-                        unmatched.append(i)
-                    continue
-                k = tuple(d[i] for d, _v in pkeys)
-                rows = table.get(k)
-                if rows is None:
-                    if plan.join_type == "left":
-                        unmatched.append(i)
-                    continue
-                for r in rows:
-                    li.append(i)
-                    ri.append(r)
-                    matched_right[r] = True
-            out = self._emit(chunk, build, li, ri, unmatched)
+            if nb == 0:
+                if plan.join_type == "left":
+                    out = self._emit(chunk, build,
+                                     np.empty(0, np.int64),
+                                     np.empty(0, np.int64),
+                                     np.arange(n))
+                    if out is not None:
+                        yield out
+                continue
+            pk = enc.transform_probe(self._eval_keys(plan.left_keys, chunk))
+            if n >= self._DEVICE_MIN_PROBE or nb >= self._DEVICE_MIN_BUILD:
+                li, ri = self._kernel(bk, pk, nb, n)
+            else:
+                if btable is None:
+                    btable = {}
+                    for i in range(nb):
+                        if all(v[i] for _d, v in bk):
+                            k = tuple(d[i] for d, _v in bk)
+                            btable.setdefault(k, []).append(i)
+                li_l, ri_l = [], []
+                for i in range(n):
+                    if any(not v[i] for _d, v in pk):
+                        continue
+                    for r in btable.get(tuple(d[i] for d, _v in pk), ()):
+                        li_l.append(i)
+                        ri_l.append(r)
+                li = np.array(li_l, dtype=np.int64)
+                ri = np.array(ri_l, dtype=np.int64)
+            # other_cond filters pairs BEFORE unmatched detection, so a
+            # probe row whose every match fails the condition re-enters
+            # as unmatched (outer-join ON-clause semantics)
+            pair = None
+            if plan.other_cond is not None and len(li):
+                pair = self._gather(chunk, build, li, ri)
+                keep = eval_filter_host(plan.other_cond, pair)
+                li, ri = li[keep], ri[keep]
+                pair = pair.filter(keep)
+            matched_build[ri] = True
+            unmatched = np.empty(0, np.int64)
+            if plan.join_type == "left":
+                m = np.zeros(n, dtype=bool)
+                m[li] = True
+                unmatched = np.flatnonzero(~m)
+            out = self._emit(chunk, build, li, ri, unmatched, pair=pair)
             if out is not None:
                 yield out
         if plan.join_type == "right" and build is not None:
-            un = np.flatnonzero(~matched_right)
+            un = np.flatnonzero(~matched_build)
             if len(un):
                 yield self._emit_right_unmatched(build, un)
 
-    def _emit(self, left_chunk, build, li, ri, left_unmatched):
+    def _gather(self, left_chunk, build, li, ri):
+        cols = [Column(c.ft, c.data[li], c.valid[li])
+                for c in left_chunk.columns]
+        cols += [Column(c.ft, c.data[ri], c.valid[ri])
+                 for c in build.columns]
+        return Chunk(cols)
+
+    def _emit(self, left_chunk, build, li, ri, left_unmatched, pair=None):
         plan = self.plan
-        lcols = left_chunk.columns
-        rcols = build.columns if build is not None else []
-        li_a = np.array(li, dtype=np.int64)
-        ri_a = np.array(ri, dtype=np.int64)
-        cols = []
-        for c in lcols:
-            cols.append(Column(c.ft, c.data[li_a], c.valid[li_a]))
-        for c in rcols:
-            cols.append(Column(c.ft, c.data[ri_a], c.valid[ri_a]))
-        out = Chunk(cols) if cols else None
-        if out is not None and plan.other_cond is not None:
-            # NOTE: for LEFT joins, rows whose only matches fail other_cond
-            # should re-enter as unmatched; not needed by current SQL
-            # surface (ON extra conds on outer joins) — tracked for later
-            out = out.filter(eval_filter_host(plan.other_cond, out))
-        if plan.join_type == "left" and left_unmatched:
-            ui = np.array(left_unmatched, dtype=np.int64)
-            ucols = [Column(c.ft, c.data[ui], c.valid[ui]) for c in lcols]
+        out = pair
+        if out is None:
+            out = self._gather(left_chunk, build, li, ri) \
+                if len(li) or not len(left_unmatched) else None
+        if plan.join_type == "left" and len(left_unmatched):
+            ui = np.asarray(left_unmatched, dtype=np.int64)
+            ucols = [Column(c.ft, c.data[ui], c.valid[ui])
+                     for c in left_chunk.columns]
             for sc in self.plan.children[1].schema.cols:
                 dtype = np_dtype_for(sc.ft.tp)
                 data = np.zeros(len(ui), dtype=dtype) \

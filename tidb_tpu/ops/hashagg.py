@@ -71,12 +71,27 @@ def _splitmix(xp, h):
     return h
 
 
+def _key_bits(xp, d):
+    """Exact uint64 bit pattern of a key lane: floats are bitcast (value
+    cast would truncate 2.3 and 2.7 to the same hash under BOTH seeds,
+    silently merging groups), with -0.0 normalized to +0.0 first since
+    SQL treats them as equal."""
+    ut = jnp.uint64 if xp is jnp else np.uint64
+    d = xp.asarray(d)
+    if d.dtype == (jnp.float64 if xp is jnp else np.float64):
+        d = xp.where(d == 0.0, 0.0, d)
+        if xp is jnp:
+            return jax.lax.bitcast_convert_type(d, jnp.uint64)
+        return d.view(np.uint64)
+    return d.astype(ut)
+
+
 def _hash_keys(xp, key_cols, n, seed: int):
-    """Combine (data, valid) int64 key lanes into one int64 hash per row.
+    """Combine (data, valid) key lanes into one int64 hash per row.
     NULL contributes a distinct tag so NULL groups separately from 0."""
     h = xp.full(n, np.uint64(seed), dtype=jnp.uint64 if xp is jnp else np.uint64)
     for d, v in key_cols:
-        u = xp.asarray(d).astype(jnp.uint64 if xp is jnp else np.uint64)
+        u = _key_bits(xp, d)
         # validity mixes as its OWN lane: zeroing the data under NULL and
         # hashing v separately means no data value can alias the NULL key
         # (a fixed null-tag constant would collide with that literal value
@@ -90,8 +105,19 @@ def _hash_keys(xp, key_cols, n, seed: int):
     return out
 
 
-def _agg_lanes(xp, agg: AggDesc, cols, n, mask, inv, capacity: int):
-    """Emit this aggregate's partial-state lanes as [capacity] arrays."""
+def _distinct_count(xp, h):
+    """True number of distinct values in h (any size), static shape."""
+    s = xp.sort(h)
+    return 1 + xp.sum(s[1:] != s[:-1])
+
+
+def _agg_lanes(xp, agg: AggDesc, cols, n, mask, inv, capacity: int,
+               offs=None):
+    """This aggregate's partial-state lanes as [(array[capacity],
+    merge_op)] with merge_op in {'sum','min','max'} — how lanes of the
+    same group combine across chunks/shards. With offs (a shard's global
+    row offset) FIRST_ROW indices are globalized for cross-shard merging;
+    without it they stay chunk-local (host gathers within the chunk)."""
     fn = agg.fn
     if agg.arg is not None:
         d, v = agg.arg.eval_xp(xp, cols, n)
@@ -104,35 +130,25 @@ def _agg_lanes(xp, agg: AggDesc, cols, n, mask, inv, capacity: int):
     has = seg_max(live.astype(jnp.int64))
 
     if fn == AggFunc.COUNT:
-        return [seg_sum(live.astype(jnp.int64))]
+        return [(seg_sum(live.astype(jnp.int64)), "sum")]
     if fn == AggFunc.SUM:
-        if d.dtype == jnp.float64:
-            vals = xp.where(live, d, 0.0)
-        else:
-            vals = xp.where(live, d, 0)
-        return [seg_sum(vals), has]
+        zero = 0.0 if d.dtype == jnp.float64 else 0
+        return [(seg_sum(xp.where(live, d, zero)), "sum"), (has, "max")]
     if fn == AggFunc.AVG:
-        if d.dtype == jnp.float64:
-            vals = xp.where(live, d, 0.0)
-        else:
-            vals = xp.where(live, d, 0)
-        return [seg_sum(vals), seg_sum(live.astype(jnp.int64))]
+        zero = 0.0 if d.dtype == jnp.float64 else 0
+        return [(seg_sum(xp.where(live, d, zero)), "sum"),
+                (seg_sum(live.astype(jnp.int64)), "sum")]
     if fn == AggFunc.MIN:
-        if d.dtype == jnp.float64:
-            vals = xp.where(live, d, jnp.inf)
-        else:
-            vals = xp.where(live, d, _I64_MAX)
-        return [seg_min(vals), has]
+        ident = jnp.inf if d.dtype == jnp.float64 else _I64_MAX
+        return [(seg_min(xp.where(live, d, ident)), "min"), (has, "max")]
     if fn == AggFunc.MAX:
-        if d.dtype == jnp.float64:
-            vals = xp.where(live, d, -jnp.inf)
-        else:
-            vals = xp.where(live, d, _I64_MIN)
-        return [seg_max(vals), has]
+        ident = -jnp.inf if d.dtype == jnp.float64 else _I64_MIN
+        return [(seg_max(xp.where(live, d, ident)), "max"), (has, "max")]
     if fn == AggFunc.FIRST_ROW:
-        idx = xp.where(live, xp.arange(n), n)
-        first = seg_min(idx)
-        return [first, has]  # host gathers the value at `first`
+        first = seg_min(xp.where(live, xp.arange(n), n))
+        if offs is not None:
+            first = xp.where(has > 0, offs + first, _I64_MAX)
+        return [(first, "min"), (has, "max")]
     raise NotImplementedError(f"device agg {fn}")
 
 
@@ -230,8 +246,7 @@ class HashAggKernel:
         uniq, inv = jnp.unique(h, size=self.capacity, fill_value=_FILL,
                                return_inverse=True)
         # true distinct count (incl. masked sentinel) for overflow detection
-        hs = jnp.sort(h)
-        nuniq = 1 + jnp.sum(hs[1:] != hs[:-1])
+        nuniq = _distinct_count(xp, h)
         # collision check: within each group, the check hash must agree
         c_min = jax.ops.segment_min(xp.where(mask, h2, _I64_MAX), inv,
                                     num_segments=self.capacity)
@@ -244,7 +259,8 @@ class HashAggKernel:
                                      num_segments=self.capacity)
         rep = jax.ops.segment_min(xp.where(mask, xp.arange(n), n), inv,
                                   num_segments=self.capacity)
-        lanes = [_agg_lanes(xp, a, cols, n, mask, inv, self.capacity)
+        lanes = [[l for l, _op in
+                  _agg_lanes(xp, a, cols, n, mask, inv, self.capacity)]
                  for a in self.aggs]
         return uniq, nuniq, collided, counts, rep, lanes
 
@@ -285,7 +301,8 @@ class ScalarAggKernel:
         inv = xp.zeros(n, dtype=jnp.int32)
         count = jax.ops.segment_sum(mask.astype(jnp.int64), inv,
                                     num_segments=1)
-        lanes = [_agg_lanes(xp, a, cols, n, mask, inv, 1) for a in self.aggs]
+        lanes = [[l for l, _op in _agg_lanes(xp, a, cols, n, mask, inv, 1)]
+                 for a in self.aggs]
         return count, lanes
 
     def __call__(self, chunk: Chunk) -> GroupResult:

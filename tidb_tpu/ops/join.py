@@ -1,0 +1,173 @@
+"""Equi-join pair matching on device.
+
+Replaces the matching loop of /root/reference/executor/join.go:37
+(HashJoinExec: mvmap build + per-row probe goroutines). A dynamic hash
+table fights XLA's static shapes, so the device program is sort-based
+(SURVEY.md §7 "Device hash tables", Plan A):
+
+    1. hash both sides' key tuples to int64 (NULL keys -> per-side
+       sentinels so they never match anything, SQL semantics)
+    2. sort the build hashes once; searchsorted gives every probe row its
+       contiguous candidate run [left,right)
+    3. a prefix sum over run lengths + one searchsorted turns the dynamic
+       fan-out into a static-capacity (li, ri) pair list with an overflow
+       flag (caller doubles capacity and retries)
+    4. candidate pairs are verified by EXACT key equality on device, so
+       hash collisions only cost a discarded candidate — never a wrong row
+
+Keys are evaluated to fixed-width arrays on the host first (strings get a
+dictionary shared across both sides), so the kernel only ever sees int64 /
+float64 lanes; payload gather happens on the host from the returned pair
+indices.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from tidb_tpu.ops import runtime
+from tidb_tpu.ops.hashagg import _FILL, _SENTINEL_MASKED, _hash_keys
+
+__all__ = ["JoinKernel", "JoinOverflowError", "JoinKeyEncoder"]
+
+# build-side dead rows hash to _SENTINEL_MASKED, probe-side to _FILL:
+# distinct values, and _hash_keys never produces either for live rows
+_DEAD_BUILD = _SENTINEL_MASKED
+_DEAD_PROBE = _FILL
+
+
+class JoinOverflowError(Exception):
+    """More output pairs than the kernel's static capacity."""
+
+    def __init__(self, needed: int):
+        super().__init__(f"join output needs {needed} pairs")
+        self.needed = needed
+
+
+class JoinKeyEncoder:
+    """Aligns varlen key columns across both sides of a join.
+
+    Fitted once on the (materialized) build side; probe chunks stream
+    through transform(). String values get int64 codes from one shared
+    dictionary; probe values absent from it get unique negative codes so
+    they match nothing yet remain live rows (outer-join semantics)."""
+
+    def __init__(self, num_keys: int):
+        self._dicts: list[dict | None] = [None] * num_keys
+
+    def fit_build(self, cols):
+        out = []
+        for j, (d, v) in enumerate(cols):
+            if d.dtype != object:
+                out.append((d, v))
+                continue
+            mapping: dict = {}
+            codes = np.empty(len(d), dtype=np.int64)
+            for i, val in enumerate(d):
+                codes[i] = mapping.setdefault(val, len(mapping)) if v[i] \
+                    else -1
+            self._dicts[j] = mapping
+            out.append((codes, v))
+        return out
+
+    def transform_probe(self, cols):
+        out = []
+        for j, (d, v) in enumerate(cols):
+            mapping = self._dicts[j]
+            if mapping is None:
+                if d.dtype == object:
+                    # build side had no string values at all: nothing can
+                    # match, but rows stay live for outer joins
+                    codes = np.arange(-2, -2 - len(d), -1, dtype=np.int64)
+                    out.append((codes, v))
+                else:
+                    out.append((d, v))
+                continue
+            codes = np.empty(len(d), dtype=np.int64)
+            for i, val in enumerate(d):
+                codes[i] = mapping.get(val, -2 - i) if v[i] else -1
+            out.append((codes, v))
+        return out
+
+
+class JoinKernel:
+    """Compiled pair matcher for one key-lane signature.
+
+    One instance per join plan; jit programs are cached per
+    (build_bucket, probe_bucket, out_cap) shape triple."""
+
+    def __init__(self, num_keys: int):
+        self.num_keys = num_keys
+        self._jits: dict = {}
+
+    def _program(self, out_cap: int):
+        def kernel(bkeys, pkeys, nb, np_):
+            xp = jnp
+            b_n = bkeys[0][0].shape[0]
+            p_n = pkeys[0][0].shape[0]
+            b_alive = (xp.arange(b_n) < nb)
+            p_alive = (xp.arange(p_n) < np_)
+            b_valid = b_alive
+            for _d, v in bkeys:
+                b_valid = b_valid & v
+            p_valid = p_alive
+            for _d, v in pkeys:
+                p_valid = p_valid & v
+            hb = _hash_keys(xp, [(d, v & b_valid) for d, v in bkeys],
+                            b_n, seed=0x9E3779B97F4A7C15)
+            hp = _hash_keys(xp, [(d, v & p_valid) for d, v in pkeys],
+                            p_n, seed=0x9E3779B97F4A7C15)
+            hb = xp.where(b_valid, hb, _DEAD_BUILD)
+            hp = xp.where(p_valid, hp, _DEAD_PROBE)
+
+            perm = xp.argsort(hb)
+            sb = hb[perm]
+            left = xp.searchsorted(sb, hp, side="left")
+            right = xp.searchsorted(sb, hp, side="right")
+            counts = xp.where(p_valid, right - left, 0)
+            cum = xp.cumsum(counts)
+            total = cum[p_n - 1] if p_n else 0
+
+            k = xp.arange(out_cap)
+            li = xp.searchsorted(cum, k, side="right")
+            li_c = xp.clip(li, 0, p_n - 1)
+            start = cum[li_c] - counts[li_c]
+            pos = left[li_c] + (k - start)
+            ri = perm[xp.clip(pos, 0, b_n - 1)]
+            ok = k < xp.minimum(total, out_cap)
+            # exact key verification: candidates from colliding hashes
+            # are discarded here, making the join exact
+            for (bd, _bv), (pd, _pv) in zip(bkeys, pkeys):
+                ok = ok & (bd[ri] == pd[li_c])
+            return li_c, ri, ok, total
+
+        return jax.jit(kernel)
+
+    def __call__(self, build_keys, probe_keys, nb: int, np_: int,
+                 out_cap: int | None = None):
+        """build_keys/probe_keys: [(np data, np valid)] aligned fixed-width
+        lanes (see encode_join_keys). Returns (li, ri) numpy index arrays
+        of matching (probe, build) row pairs."""
+        bb = runtime.bucket_size(max(nb, 1))
+        pb = runtime.bucket_size(max(np_, 1))
+        cap = out_cap or runtime.bucket_size(max(np_ * 2, 1024))
+        while True:
+            key = (bb, pb, cap)
+            prog = self._jits.get(key)
+            if prog is None:
+                prog = self._program(cap)
+                self._jits[key] = prog
+            bk = [tuple(map(jnp.asarray, runtime.pad_column(d, v, bb)))
+                  for d, v in build_keys]
+            pk = [tuple(map(jnp.asarray, runtime.pad_column(d, v, pb)))
+                  for d, v in probe_keys]
+            li, ri, ok, total = prog(bk, pk, nb, np_)
+            total = int(total)
+            if total > cap:
+                cap = runtime.bucket_size(total)
+                continue
+            sel = np.flatnonzero(np.asarray(ok))
+            return np.asarray(li)[sel], np.asarray(ri)[sel]

@@ -35,57 +35,13 @@ from tidb_tpu.expression import AggDesc, AggFunc, Expression
 from tidb_tpu.ops import runtime
 from tidb_tpu.ops.hashagg import (CapacityError, CollisionError, GroupResult,
                                   _FILL, _SENTINEL_MASKED, _I64_MAX, _I64_MIN,
-                                  _hash_keys, _validate_device_exprs,
+                                  _agg_lanes, _distinct_count, _hash_keys,
+                                  _validate_device_exprs,
                                   finalize_group_result)
 
 __all__ = ["MeshAggKernel"]
 
 _BIG = _I64_MAX
-
-
-def _distinct_count(xp, h):
-    """True number of distinct values in h (any size), static shape."""
-    s = xp.sort(h)
-    return 1 + xp.sum(s[1:] != s[:-1])
-
-
-def _local_agg_lanes(xp, agg: AggDesc, cols, n, mask, inv, capacity, offs):
-    """Per-shard lanes + their cross-shard merge ops ('sum'|'min'|'max').
-
-    Mirrors ops.hashagg._agg_lanes but every lane is mergeable by a
-    segment reduction after the all_gather (FIRST_ROW indices globalized
-    with the shard's row offset)."""
-    fn = agg.fn
-    if agg.arg is not None:
-        d, v = agg.arg.eval_xp(xp, cols, n)
-        live = mask & v
-    else:
-        d, live = None, mask
-    seg_sum = lambda x: jax.ops.segment_sum(x, inv, num_segments=capacity)
-    seg_min = lambda x: jax.ops.segment_min(x, inv, num_segments=capacity)
-    seg_max = lambda x: jax.ops.segment_max(x, inv, num_segments=capacity)
-    has = seg_max(live.astype(jnp.int64))
-
-    if fn == AggFunc.COUNT:
-        return [(seg_sum(live.astype(jnp.int64)), "sum")]
-    if fn == AggFunc.SUM:
-        zero = 0.0 if d.dtype == jnp.float64 else 0
-        return [(seg_sum(xp.where(live, d, zero)), "sum"), (has, "max")]
-    if fn == AggFunc.AVG:
-        zero = 0.0 if d.dtype == jnp.float64 else 0
-        return [(seg_sum(xp.where(live, d, zero)), "sum"),
-                (seg_sum(live.astype(jnp.int64)), "sum")]
-    if fn == AggFunc.MIN:
-        ident = jnp.inf if d.dtype == jnp.float64 else _I64_MAX
-        return [(seg_min(xp.where(live, d, ident)), "min"), (has, "max")]
-    if fn == AggFunc.MAX:
-        ident = -jnp.inf if d.dtype == jnp.float64 else _I64_MIN
-        return [(seg_max(xp.where(live, d, ident)), "max"), (has, "max")]
-    if fn == AggFunc.FIRST_ROW:
-        first = seg_min(xp.where(live, xp.arange(n), n))
-        gfirst = xp.where(has > 0, offs + first, _BIG)
-        return [(gfirst, "min"), (has, "max")]
-    raise NotImplementedError(f"device agg {fn}")
 
 
 _MERGE = {"sum": jax.ops.segment_sum,
@@ -159,7 +115,7 @@ class MeshAggKernel:
         lanes.append((xp.where(ghas > 0, offs + grep, _BIG), "min"))   # rep
         agg_lane_slices = []
         for a in self.aggs:
-            ls = _local_agg_lanes(xp, a, cols, ln, mask, inv, C, offs)
+            ls = _agg_lanes(xp, a, cols, ln, mask, inv, C, offs=offs)
             agg_lane_slices.append((len(lanes) - 4, len(ls)))
             lanes.extend(ls)
 
