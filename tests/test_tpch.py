@@ -1,0 +1,56 @@
+"""TPC-H Q1/Q3/Q5 end-to-end through the session, vs independent truth."""
+
+from decimal import Decimal
+
+import pytest
+
+import tpch
+from tidb_tpu.session import Session
+from tidb_tpu.store.storage import new_mock_storage
+
+
+@pytest.fixture(scope="module")
+def sess():
+    s = Session(new_mock_storage())
+    s.execute("CREATE DATABASE tpch")
+    s.execute("USE tpch")
+    data = tpch.TpchData()
+    tpch.load(s, data)
+    s._data = data
+    return s
+
+
+def _approx(a, b, tol=1e-6):
+    a = float(a) if isinstance(a, Decimal) else a
+    b = float(b) if isinstance(b, Decimal) else b
+    assert a == pytest.approx(b, rel=tol, abs=1e-6), (a, b)
+
+
+def test_q1(sess):
+    rows = sess.query(tpch.Q1).rows
+    want = tpch.truth_q1(sess._data)
+    assert len(rows) == len(want) == 6
+    for got, exp in zip(rows, want):
+        assert got[0] == exp[0] and got[1] == exp[1]
+        for g, w in zip(got[2:], exp[2:]):
+            _approx(g, w)
+
+
+def test_q3(sess):
+    rows = sess.query(tpch.Q3).rows
+    want = tpch.truth_q3(sess._data)
+    assert len(rows) == len(want)
+    for got, exp in zip(rows, want):
+        assert got[0] == exp[0], (got, exp)
+        _approx(got[1], exp[1])
+        assert got[2] == exp[2]
+        assert got[3] == exp[3]
+
+
+def test_q5(sess):
+    rows = sess.query(tpch.Q5).rows
+    want = tpch.truth_q5(sess._data)
+    assert len(rows) == len(want)
+    for got, exp in zip(rows, want):
+        assert got[0] == exp[0]
+        _approx(got[1], exp[1])

@@ -935,6 +935,13 @@ class Parser:
         left = self.mul_expr()
         while self.peek().tp == TokenType.OP and self.peek().val in ("+", "-"):
             op = self.next().val
+            if self.peek().is_kw("INTERVAL"):
+                # expr +/- INTERVAL n UNIT (TPC-H date arithmetic)
+                self.next()
+                left = ast.FuncCall(
+                    name="DATE_SUB" if op == "-" else "DATE_ADD",
+                    args=[left, self._interval_expr()])
+                continue
             left = ast.BinaryOp(op, left, self.mul_expr())
         return left
 
@@ -1054,7 +1061,11 @@ class Parser:
                 return self.func_call(kw)
         if kw in ("DISTINCT",):
             raise ParseError("unexpected DISTINCT", t)
-        # treat as identifier-ish (e.g. DATE literal qualifier)
+        if kw in ("DATE", "TIMESTAMP", "TIME") and \
+                self.peek(1).tp == TokenType.STRING:
+            # typed literal: DATE '1998-12-01'
+            self.next()
+            return ast.Literal(self.next().val)
         return self._ident_primary()
 
     def case_expr(self) -> ast.CaseExpr:
@@ -1134,16 +1145,19 @@ class Parser:
             while True:
                 if self.peek().is_kw("INTERVAL"):
                     self.next()
-                    n = self.expr()
-                    unit = self.ident().upper()
-                    args.append(ast.FuncCall(name="INTERVAL",
-                                             args=[n, ast.Literal(unit)]))
+                    args.append(self._interval_expr())
                 else:
                     args.append(self.expr())
                 if not self.try_op(","):
                     break
             self.expect_op(")")
         return ast.FuncCall(name=name, args=args)
+
+    def _interval_expr(self) -> ast.FuncCall:
+        """`n UNIT` after a consumed INTERVAL keyword."""
+        n = self.expr()
+        unit = self.ident().upper()
+        return ast.FuncCall(name="INTERVAL", args=[n, ast.Literal(unit)])
 
     def column_name(self) -> ast.ColName:
         a = self.ident()
