@@ -1,0 +1,324 @@
+"""Range extraction + index access paths: unit tests for tidb_tpu.ranger
+plus SQL-level tests that indexed queries pick index plans and agree with
+full-scan results.
+
+Ref model: util/ranger tests + executor index-read tests
+(executor/executor_test.go index scan cases).
+"""
+
+import pytest
+
+from tidb_tpu import codec, ranger, tablecodec
+from tidb_tpu.expression import Op, col, const, func
+from tidb_tpu.plan import physical as ph
+from tidb_tpu.session import Session
+from tidb_tpu.sqltypes import (new_double_field, new_int_field,
+                               new_string_field)
+from tidb_tpu.store import new_mock_storage
+
+
+@pytest.fixture
+def tk():
+    storage = new_mock_storage()
+    storage.async_commit_secondaries = False
+    s = Session(storage)
+    s.execute("CREATE DATABASE test; USE test")
+    yield s
+    s.close()
+    storage.close()
+
+
+def q(tk, sql):
+    return tk.query(sql).rows
+
+
+IF = new_int_field()
+SF = new_string_field()
+
+
+class TestDetach:
+    def test_eq_chain(self):
+        c0, c1 = col(0, IF, "a"), col(1, IF, "b")
+        conj = [func(Op.EQ, c0, const(5)), func(Op.EQ, c1, const(7))]
+        p = ranger.detach_index_conditions(conj, [0, 1], [IF, IF])
+        assert p.eq_count == 2 and not p.has_interval
+        assert len(p.ranges) == 1
+        assert p.ranges[0].low == [5, 7] and p.ranges[0].high == [5, 7]
+
+    def test_eq_then_interval(self):
+        c0, c1 = col(0, IF, "a"), col(1, IF, "b")
+        conj = [func(Op.EQ, c0, const(5)), func(Op.GT, c1, const(3)),
+                func(Op.LE, c1, const(9))]
+        p = ranger.detach_index_conditions(conj, [0, 1], [IF, IF])
+        assert p.eq_count == 1 and p.has_interval
+        r = p.ranges[0]
+        assert r.low == [5, 3] and not r.low_incl
+        assert r.high == [5, 9] and r.high_incl
+
+    def test_reversed_operands(self):
+        c0 = col(0, IF, "a")
+        p = ranger.detach_index_conditions(
+            [func(Op.LT, const(10), c0)], [0], [IF])
+        assert p.has_interval
+        r = p.ranges[0]
+        assert r.low == [10] and not r.low_incl and r.high_unbounded
+
+    def test_in_points(self):
+        c0 = col(0, IF, "a")
+        p = ranger.detach_index_conditions(
+            [func(Op.IN, c0, extra=[3, 1, 2])], [0], [IF])
+        assert p.eq_count == 1
+        assert [r.low[0] for r in p.ranges] == [1, 2, 3]
+
+    def test_inexact_float_bound_on_int(self):
+        c0 = col(0, IF, "a")
+        # a <= 3.5 -> range high becomes inclusive 3 (floor)
+        p = ranger.detach_index_conditions(
+            [func(Op.LE, c0, const(3.5))], [0], [IF])
+        assert p.has_interval
+        r = p.ranges[0]
+        assert r.high == [3] and r.high_incl
+
+    def test_unusable_condition_left_out(self):
+        c0, c1 = col(0, IF, "a"), col(1, IF, "b")
+        # condition on a non-prefix column only -> useless path
+        p = ranger.detach_index_conditions(
+            [func(Op.EQ, c1, const(5))], [0, 1], [IF, IF])
+        assert not p.useful
+
+    def test_empty_interval(self):
+        c0 = col(0, IF, "a")
+        p = ranger.detach_index_conditions(
+            [func(Op.GT, c0, const(9)), func(Op.LT, c0, const(3))], [0], [IF])
+        assert p.ranges == []
+
+    def test_string_range_kv_order(self):
+        c0 = col(0, SF, "s")
+        p = ranger.detach_index_conditions(
+            [func(Op.GE, c0, const("b")), func(Op.LT, c0, const("d"))],
+            [0], [SF])
+        kvr = ranger.index_ranges_to_kv(1, 1, p.ranges)
+        assert len(kvr) == 1
+        k_b = tablecodec.index_key(1, 1, ["b"])
+        k_c = tablecodec.index_key(1, 1, ["c"])
+        k_d = tablecodec.index_key(1, 1, ["d"])
+        assert kvr[0].start <= k_b < kvr[0].end
+        assert kvr[0].start <= k_c < kvr[0].end
+        assert not (kvr[0].start <= k_d < kvr[0].end)
+
+    def test_null_skip_on_unbounded_low(self):
+        c0 = col(0, IF, "a")
+        p = ranger.detach_index_conditions(
+            [func(Op.LT, c0, const(5))], [0], [IF])
+        kvr = ranger.index_ranges_to_kv(1, 1, p.ranges)
+        null_key = tablecodec.index_key(1, 1, [None])
+        assert not (kvr[0].start <= null_key < kvr[0].end)
+
+    def test_handle_ranges(self):
+        c0 = col(0, IF, "id")
+        p = ranger.detach_handle_conditions(
+            [func(Op.GE, c0, const(10)), func(Op.LT, c0, const(20))], 0)
+        kvr = ranger.handle_ranges_to_kv(7, p.ranges)
+        assert kvr is not None and len(kvr) == 1
+        assert kvr[0].start == tablecodec.record_key(7, 10)
+        assert kvr[0].end == tablecodec.record_key(7, 20)
+
+
+class TestPlanChoice:
+    def _plan(self, tk, sql):
+        from tidb_tpu.parser import parse_one
+        from tidb_tpu.plan.planner import Planner
+        p = Planner(tk.domain.info_schema(), tk.current_db)
+        return p.plan(parse_one(sql))
+
+    def test_pk_range_narrows_scan(self, tk):
+        tk.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, v INT)")
+        plan = self._plan(tk, "SELECT v FROM t WHERE id >= 5 AND id < 8")
+        readers = _find(plan, ph.PhysTableReader)
+        assert readers and readers[0].cop.ranges is not None
+
+    def test_pk_point_becomes_point_get(self, tk):
+        tk.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, v INT)")
+        plan = self._plan(tk, "SELECT v FROM t WHERE id = 5")
+        assert _find(plan, ph.PhysPointGet)
+
+    def test_unique_index_point_get(self, tk):
+        tk.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, u INT UNIQUE)")
+        plan = self._plan(tk, "SELECT id FROM t WHERE u = 5")
+        assert _find(plan, ph.PhysPointGet)
+
+    def test_index_lookup_chosen(self, tk):
+        tk.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, a INT, b INT)")
+        tk.execute("CREATE INDEX ia ON t (a)")
+        plan = self._plan(tk, "SELECT b FROM t WHERE a = 3")
+        assert _find(plan, ph.PhysIndexLookUp)
+
+    def test_agg_reader_keeps_pushdown(self, tk):
+        tk.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, a INT, b INT)")
+        tk.execute("CREATE INDEX ia ON t (a)")
+        plan = self._plan(tk, "SELECT SUM(b) FROM t WHERE a = 3")
+        readers = _find(plan, ph.PhysTableReader)
+        assert readers and readers[0].cop.is_agg
+        assert not _find(plan, ph.PhysIndexLookUp)
+
+
+def _find(plan, tp):
+    out = []
+
+    def walk(p):
+        if isinstance(p, tp):
+            out.append(p)
+        for c in getattr(p, "children", []):
+            walk(c)
+        for attr in ("source", "reader"):
+            sub = getattr(p, attr, None)
+            if sub is not None:
+                walk(sub)
+    walk(plan)
+    return out
+
+
+class TestIndexReads:
+    def test_pk_range_results(self, tk):
+        tk.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, v INT)")
+        tk.execute("INSERT INTO t VALUES " +
+                   ",".join(f"({i},{i * 10})" for i in range(1, 21)))
+        assert q(tk, "SELECT v FROM t WHERE id = 7") == [(70,)]
+        assert q(tk, "SELECT v FROM t WHERE id >= 18 ORDER BY id") == \
+            [(180,), (190,), (200,)]
+        assert q(tk, "SELECT COUNT(*) FROM t WHERE id > 5 AND id <= 15") == \
+            [(10,)]
+
+    def test_secondary_index_results(self, tk):
+        tk.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, a INT, s VARCHAR(10))")
+        tk.execute("CREATE INDEX ia ON t (a)")
+        tk.execute("INSERT INTO t VALUES " +
+                   ",".join(f"({i},{i % 5},'s{i}')" for i in range(1, 51)))
+        got = q(tk, "SELECT id FROM t WHERE a = 3 ORDER BY id")
+        assert got == [(i,) for i in range(1, 51) if i % 5 == 3]
+        got = q(tk, "SELECT s FROM t WHERE a IN (1, 2) AND id <= 10 ORDER BY id")
+        assert got == [(f"s{i}",) for i in range(1, 11) if i % 5 in (1, 2)]
+
+    def test_unique_index_point(self, tk):
+        tk.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, u INT UNIQUE, v INT)")
+        tk.execute("INSERT INTO t VALUES (1, 100, 7), (2, 200, 8)")
+        assert q(tk, "SELECT v FROM t WHERE u = 200") == [(8,)]
+        assert q(tk, "SELECT v FROM t WHERE u = 999") == []
+
+    def test_composite_index(self, tk):
+        tk.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, a INT, b INT, v INT)")
+        tk.execute("CREATE INDEX iab ON t (a, b)")
+        rows = [(i, i % 3, i % 7, i * 2) for i in range(1, 43)]
+        tk.execute("INSERT INTO t VALUES " +
+                   ",".join(f"({a},{b},{c},{d})" for a, b, c, d in rows))
+        got = q(tk, "SELECT id FROM t WHERE a = 1 AND b > 2 AND b <= 5 ORDER BY id")
+        want = [(i,) for i, a, b, _ in rows if a == 1 and 2 < b <= 5]
+        assert got == want
+
+    def test_index_with_nulls(self, tk):
+        tk.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, a INT)")
+        tk.execute("CREATE INDEX ia ON t (a)")
+        tk.execute("INSERT INTO t VALUES (1, NULL), (2, 5), (3, NULL), (4, 1)")
+        # range scan must not return NULL rows
+        assert q(tk, "SELECT id FROM t WHERE a < 10 ORDER BY id") == [(2,), (4,)]
+        assert q(tk, "SELECT id FROM t WHERE a IS NULL ORDER BY id") == \
+            [(1,), (3,)]
+
+    def test_dirty_txn_sees_own_writes_through_index(self, tk):
+        tk.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, a INT)")
+        tk.execute("CREATE INDEX ia ON t (a)")
+        tk.execute("INSERT INTO t VALUES (1, 10)")
+        tk.execute("BEGIN")
+        tk.execute("INSERT INTO t VALUES (2, 10)")
+        assert q(tk, "SELECT id FROM t WHERE a = 10 ORDER BY id") == \
+            [(1,), (2,)]
+        tk.execute("COMMIT")
+        assert q(tk, "SELECT id FROM t WHERE a = 10 ORDER BY id") == \
+            [(1,), (2,)]
+
+    def test_update_delete_via_index(self, tk):
+        tk.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, a INT, v INT)")
+        tk.execute("CREATE INDEX ia ON t (a)")
+        tk.execute("INSERT INTO t VALUES (1, 1, 0), (2, 2, 0), (3, 1, 0)")
+        tk.execute("UPDATE t SET v = 9 WHERE a = 1")
+        assert q(tk, "SELECT id, v FROM t ORDER BY id") == \
+            [(1, 9), (2, 0), (3, 9)]
+        tk.execute("DELETE FROM t WHERE a = 1")
+        assert q(tk, "SELECT id FROM t ORDER BY id") == [(2,)]
+        # index entries for deleted rows must be gone
+        assert q(tk, "SELECT id FROM t WHERE a = 1") == []
+
+    def test_index_maintained_on_update(self, tk):
+        tk.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, a INT)")
+        tk.execute("CREATE INDEX ia ON t (a)")
+        tk.execute("INSERT INTO t VALUES (1, 5)")
+        tk.execute("UPDATE t SET a = 6 WHERE id = 1")
+        assert q(tk, "SELECT id FROM t WHERE a = 6") == [(1,)]
+        assert q(tk, "SELECT id FROM t WHERE a = 5") == []
+
+    def test_decimal_index_inexact_bound(self, tk):
+        # regression: decimal_to_scaled rounds 1.5 -> 2 at scale 0; the
+        # range bound must floor (not round) or rows silently escape DML
+        tk.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, d DECIMAL(10,0))")
+        tk.execute("CREATE INDEX kd ON t (d)")
+        tk.execute("INSERT INTO t VALUES (1, 1), (2, 2), (3, 3)")
+        tk.execute("DELETE FROM t WHERE d > 1.5")
+        assert q(tk, "SELECT id FROM t ORDER BY id") == [(1,)]
+
+    def test_decimal_index_scale_normalized(self, tk):
+        # regression: stored index keys must carry the COLUMN's frac, not
+        # the literal's, or range probes at column scale never match
+        tk.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, c DECIMAL(10,2))")
+        tk.execute("CREATE INDEX ic ON t (c)")
+        tk.execute("INSERT INTO t VALUES (1, 1.5), (2, 2.5), (3, 3.25)")
+        assert q(tk, "SELECT id FROM t WHERE c = 2.5") == [(2,)]
+        assert q(tk, "SELECT id FROM t WHERE c > 2.0 AND c < 3.0") == [(2,)]
+        assert q(tk, "SELECT id FROM t WHERE c >= 1.5 AND c <= 3.25 "
+                     "ORDER BY id") == [(1,), (2,), (3,)]
+
+    def test_out_of_int64_literal_no_crash(self, tk):
+        tk.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, v INT)")
+        tk.execute("INSERT INTO t VALUES (1, 10)")
+        assert q(tk, "SELECT id FROM t WHERE id > 9223372036854775808") == []
+        assert q(tk, "SELECT id FROM t WHERE id < 9223372036854775808") == \
+            [(1,)]
+
+    def test_covering_index_reader(self, tk):
+        tk.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, a INT, b INT)")
+        tk.execute("CREATE INDEX iab ON t (a, b)")
+        tk.execute("INSERT INTO t VALUES (1, 1, 10), (2, 1, 20), (3, 2, 30)")
+        from tidb_tpu.parser import parse_one
+        from tidb_tpu.plan.planner import Planner
+        plan = Planner(tk.domain.info_schema(), tk.current_db).plan(
+            parse_one("SELECT a, b FROM t WHERE a = 1"))
+        assert _find(plan, ph.PhysIndexReader)
+        assert q(tk, "SELECT a, b FROM t WHERE a = 1 ORDER BY b") == \
+            [(1, 10), (1, 20)]
+
+    def test_select_actually_uses_index_plan(self, tk):
+        # regression: session SELECT path must run access-path optimization
+        tk.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, a INT, b INT)")
+        tk.execute("CREATE INDEX ia ON t (a)")
+        tk.execute("INSERT INTO t VALUES (1, 5, 1), (2, 6, 2)")
+        import tidb_tpu.executor as ex
+        seen = []
+        orig = ex.IndexLookUpExec.chunks
+
+        def spy(self, ctx):
+            seen.append(True)
+            return orig(self, ctx)
+        ex.IndexLookUpExec.chunks = spy
+        try:
+            assert q(tk, "SELECT b FROM t WHERE a = 5") == [(1,)]
+        finally:
+            ex.IndexLookUpExec.chunks = orig
+        assert seen
+
+    def test_large_index_scan_batches(self, tk):
+        tk.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, a INT)")
+        tk.execute("CREATE INDEX ia ON t (a)")
+        n = 3000
+        for base in range(0, n, 500):
+            tk.execute("INSERT INTO t VALUES " + ",".join(
+                f"({i},{i % 2})" for i in range(base + 1, base + 501)))
+        assert q(tk, "SELECT COUNT(*) FROM t WHERE a = 1") == [(1500,)]

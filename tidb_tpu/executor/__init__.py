@@ -127,19 +127,134 @@ class TableReaderExec(Executor):
                 remaining -= ch.num_rows
             yield ch
 
+    def _decode_rows(self, rows):
+        cop = self.plan.cop
+        return kvrows_to_chunk(cop.table, cop.cols, rows, cop.handle_col)
+
     def _dirty_chunks(self, ctx: ExecContext):
         """Union-store scan: buffered writes shadow the snapshot. The cop
         plan then runs at the root over these chunks (host compute)."""
-        cop = self.plan.cop
         rows = []
         for rng in self._ranges():
             for k, v in ctx.txn.iter_range(rng.start, rng.end):
                 rows.append((k, v))
                 if len(rows) >= 65536:
-                    yield kvrows_to_chunk(cop.table, cop.cols, rows,
-                                          cop.handle_col)
+                    yield self._decode_rows(rows)
                     rows = []
-        yield kvrows_to_chunk(cop.table, cop.cols, rows, cop.handle_col)
+        yield self._decode_rows(rows)
+
+
+class IndexReaderExec(TableReaderExec):
+    """Covering-index distsql leaf (ref: executor/distsql.go:412
+    IndexReaderExecutor): identical client machinery; the storage side
+    decodes index entries instead of rows."""
+
+    def __init__(self, plan: ph.PhysIndexReader):
+        self.plan = plan
+        self.schema = plan.schema
+
+    def _decode_rows(self, rows):
+        from tidb_tpu.table import index_kvrows_to_chunk
+        cop = self.plan.cop
+        return index_kvrows_to_chunk(cop.table, cop.index, cop.cols, rows,
+                                     cop.handle_col)
+
+
+class IndexLookUpExec(Executor):
+    """Index scan -> handle batches -> parallel batched row fetch.
+    Ref: executor/distsql.go:524-737 — index worker streaming handles into
+    lookupTableTasks consumed by a table-worker pool; order preserved by
+    yielding futures in submission order."""
+
+    BATCH = 1024              # handles per lookup task
+    LOOKUP_CONCURRENCY = 4    # ref: IndexLookupConcurrency default
+
+    def __init__(self, plan: ph.PhysIndexLookUp):
+        self.plan = plan
+        self.schema = plan.schema
+
+    def _handle_batches(self, ctx: ExecContext):
+        icop = self.plan.index_cop
+        req = CopRequest(tp=ReqType.DAG, ranges=icop.ranges, plan=icop,
+                         start_ts=ctx.read_ts,
+                         keep_order=self.plan.keep_order)
+        batch: list[int] = []
+        hcol = icop.handle_col
+        for resp in ctx.storage.client().send(req):
+            ch = resp.chunk
+            handles = ch.columns[hcol].data
+            for h in handles.tolist():
+                batch.append(h)
+                if len(batch) >= self.BATCH:
+                    yield batch
+                    batch = []
+        if batch:
+            yield batch
+
+    def _fetch_rows(self, ctx: ExecContext, handles: list[int]):
+        tcop = self.plan.table_cop
+        snap = ctx.storage.snapshot(ctx.read_ts)
+        keys = [tablecodec.record_key(tcop.table.id, h) for h in handles]
+        got = snap.batch_get(keys)
+        kvrows = [(k, got[k]) for k in keys if k in got]
+        chunk = kvrows_to_chunk(tcop.table, tcop.cols, kvrows,
+                                tcop.handle_col)
+        return exec_cop_plan(tcop, chunk).chunk
+
+    def chunks(self, ctx: ExecContext):
+        tcop = self.plan.table_cop
+        if _txn_is_dirty(ctx, tcop.table.id):
+            # own writes visible: all conjuncts are retained in the
+            # residual filters, so a full union-store scan is equivalent
+            yield from TableReaderExec(
+                ph.PhysTableReader(schema=self.schema, cop=tcop)).chunks(ctx)
+            return
+        from collections import deque
+        from concurrent.futures import ThreadPoolExecutor
+        pool = ThreadPoolExecutor(max_workers=self.LOOKUP_CONCURRENCY,
+                                  thread_name_prefix="idxlookup")
+        pending = deque()
+        try:
+            for batch in self._handle_batches(ctx):
+                pending.append(pool.submit(self._fetch_rows, ctx, batch))
+                while len(pending) >= self.LOOKUP_CONCURRENCY:
+                    yield pending.popleft().result()
+            while pending:
+                yield pending.popleft().result()
+        finally:
+            pool.shutdown(wait=False, cancel_futures=True)
+
+
+class PointGetExec(Executor):
+    """Single-row read bypassing the coprocessor (ref: the point-get fast
+    path detector, executor/adapter.go:381). Reads through the active
+    transaction's union store so own writes are visible."""
+
+    def __init__(self, plan: ph.PhysPointGet):
+        self.plan = plan
+        self.schema = plan.schema
+
+    def chunks(self, ctx: ExecContext):
+        p = self.plan
+        retr = ctx.txn if ctx.txn is not None \
+            else ctx.storage.snapshot(ctx.read_ts)
+        handle = p.handle
+        if p.index is not None:
+            ik = tablecodec.index_key(p.table.id, p.index.id,
+                                      list(p.index_values))
+            from tidb_tpu import codec as _codec
+            v = retr.get(ik)
+            if v is None:
+                yield kvrows_to_chunk(p.table, p.cols, [], p.handle_col)
+                return
+            handle, _ = _codec.decode_int(v, 0)
+        rk = tablecodec.record_key(p.table.id, handle)
+        raw = retr.get(rk)
+        kvrows = [] if raw is None else [(rk, raw)]
+        chunk = kvrows_to_chunk(p.table, p.cols, kvrows, p.handle_col)
+        if p.filter is not None and chunk.num_rows:
+            chunk = chunk.filter(eval_filter_host(p.filter, chunk))
+        yield chunk
 
 
 class ValuesExec(Executor):
@@ -812,6 +927,9 @@ class DeleteExec(Executor):
 
 _BUILDERS = {
     ph.PhysTableReader: TableReaderExec,
+    ph.PhysIndexReader: IndexReaderExec,
+    ph.PhysIndexLookUp: IndexLookUpExec,
+    ph.PhysPointGet: PointGetExec,
     ph.PhysValues: ValuesExec,
     ph.PhysFinalAgg: FinalAggExec,
     ph.PhysHashAgg: HashAggExec,

@@ -186,7 +186,13 @@ def const(v, ft: FieldType | None = None) -> Constant:
         elif isinstance(v, bool):
             v, ft = int(v), new_int_field()
         elif isinstance(v, (int, np.integer)):
-            ft = new_int_field()
+            if not (-(1 << 63) <= int(v) < (1 << 63)):
+                # beyond BIGINT: evaluate as real (MySQL promotes to
+                # DECIMAL; comparisons vs int columns fold exactly in
+                # ScalarFunc._fold_huge_int_cmp)
+                v, ft = float(v), new_double_field()
+            else:
+                ft = new_int_field()
         elif isinstance(v, (float, np.floating)):
             ft = new_double_field()
         elif isinstance(v, _d.Decimal):
@@ -318,6 +324,10 @@ class ScalarFunc(Expression):
 
     def eval_xp(self, xp, cols, n):
         op = self.op
+        if op in _CMP:
+            folded = self._fold_huge_int_cmp(xp, cols, n)
+            if folded is not None:
+                return folded
         argv = [a.eval_xp(xp, cols, n) for a in self.args]
 
         if op in _LOGIC:
@@ -358,6 +368,40 @@ class ScalarFunc(Expression):
         if op in (Op.CAST_INT, Op.CAST_REAL, Op.CAST_DECIMAL, Op.CAST_STRING):
             return _eval_cast(xp, op, self, argv, n)
         raise NotImplementedError(f"op {op}")
+
+    def _fold_huge_int_cmp(self, xp, cols, n):
+        """Comparing an int64-domain column with a constant beyond the
+        int64 range: the truth value is known exactly (the constant is
+        strictly outside every possible column value), while a numeric
+        evaluation would wrap or lose precision at the boundary."""
+        if len(self.args) != 2:
+            return None
+        i64_max, i64_min = (1 << 63) - 1, -(1 << 63)
+        for c_expr, o_expr, c_on_left in ((self.args[1], self.args[0], False),
+                                          (self.args[0], self.args[1], True)):
+            if not (isinstance(c_expr, Constant) and
+                    isinstance(c_expr.value, (int, float)) and
+                    not isinstance(c_expr.value, bool)):
+                continue
+            v = c_expr.value
+            if i64_min <= v <= i64_max:
+                continue
+            if o_expr.ft.eval_type not in (EvalType.INT, EvalType.DATETIME):
+                continue
+            op = self.op
+            if c_on_left:   # const op col  ==  col flipped(op) const
+                op = {Op.LT: Op.GT, Op.LE: Op.GE, Op.GT: Op.LT,
+                      Op.GE: Op.LE}.get(op, op)
+            above = v > i64_max        # else: below int64 min
+            truth = {Op.LT: above, Op.LE: above, Op.GT: not above,
+                     Op.GE: not above, Op.EQ: False, Op.NULLEQ: False,
+                     Op.NE: True}[op]
+            _, valid = o_expr.eval_xp(xp, cols, n)
+            data = xp.full(n, 1 if truth else 0, dtype=np.int64)
+            if op == Op.NULLEQ:
+                return data, _ones(xp, n)
+            return data, valid
+        return None
 
     def _eval_in(self, xp, argv, n):
         d, v = argv[0]

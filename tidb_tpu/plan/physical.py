@@ -14,9 +14,10 @@ from typing import Optional
 from tidb_tpu.expression import AggDesc, Expression
 from tidb_tpu.kv import KVRange
 from tidb_tpu.plan.resolver import PlanSchema
-from tidb_tpu.schema.model import ColumnInfo, TableInfo
+from tidb_tpu.schema.model import ColumnInfo, IndexInfo, TableInfo
 
-__all__ = ["CopPlan", "PhysPlan", "PhysTableReader", "PhysSelection",
+__all__ = ["CopPlan", "PhysPlan", "PhysTableReader", "PhysIndexReader",
+           "PhysIndexLookUp", "PhysPointGet", "PhysSelection",
            "PhysProjection", "PhysHashAgg", "PhysFinalAgg", "PhysHashJoin",
            "PhysSort", "PhysLimit", "PhysTopN", "PhysInsert", "PhysUpdate",
            "PhysDelete", "PhysValues"]
@@ -37,6 +38,7 @@ class CopPlan:
     aggs: Optional[list[AggDesc]] = None
     limit: Optional[int] = None             # only when no aggs
     desc: bool = False
+    index: Optional[IndexInfo] = None       # index scan: decode index keys
 
     @property
     def is_agg(self) -> bool:
@@ -73,6 +75,60 @@ class PhysTableReader(PhysPlan):
         if self.cop.limit is not None:
             parts.append(f" limit:{self.cop.limit}")
         return ",".join(parts)
+
+
+@dataclass
+class PhysIndexReader(PhysPlan):
+    """Covering-index scan: the cop subplan scans index keys only and its
+    decoded columns satisfy the whole reader schema (ref:
+    executor/distsql.go:412 IndexReaderExecutor)."""
+
+    cop: CopPlan = None
+
+    def _explain_info(self):
+        return (f" table:{self.cop.table.name} index:{self.cop.index.name}"
+                f" ranges:{len(self.cop.ranges or [])}")
+
+
+@dataclass
+class PhysIndexLookUp(PhysPlan):
+    """Index scan -> handles -> batched row fetch (ref:
+    executor/distsql.go:524 IndexLookUpExecutor). `index_cop` scans and
+    decodes index entries (index cols + handle); residual filters over the
+    fetched full rows live in `table_cop` (ranges unused there)."""
+
+    index_cop: CopPlan = None
+    table_cop: CopPlan = None
+    keep_order: bool = False
+
+    def _explain_info(self):
+        parts = [f" table:{self.table_cop.table.name}"
+                 f" index:{self.index_cop.index.name}"
+                 f" ranges:{len(self.index_cop.ranges or [])}"]
+        if self.table_cop.filter is not None:
+            parts.append(f" filter:{self.table_cop.filter!r}")
+        if self.table_cop.host_filter is not None:
+            parts.append(f" host_filter:{self.table_cop.host_filter!r}")
+        return ",".join(parts)
+
+
+@dataclass
+class PhysPointGet(PhysPlan):
+    """Single-row fetch by handle or unique index point (ref: the point-get
+    fast path, executor/adapter.go:381). Bypasses the coprocessor."""
+
+    table: TableInfo = None
+    cols: list = field(default_factory=list)   # ColumnInfo to emit
+    handle_col: Optional[int] = None
+    handle: Optional[int] = None               # pk-is-handle point
+    index: Optional[IndexInfo] = None          # or unique-index point
+    index_values: Optional[list] = None
+    filter: Optional[Expression] = None        # residual conjuncts
+
+    def _explain_info(self):
+        via = f"handle:{self.handle}" if self.index is None else \
+            f"index:{self.index.name}"
+        return f" table:{self.table.name} {via}"
 
 
 @dataclass

@@ -42,6 +42,14 @@ def split_conjuncts(e: ast.ExprNode | None) -> list[ast.ExprNode]:
     return [e]
 
 
+def flatten_and(e: Expression | None) -> list[Expression]:
+    if e is None:
+        return []
+    if isinstance(e, ScalarFunc) and e.op == Op.AND:
+        return flatten_and(e.args[0]) + flatten_and(e.args[1])
+    return [e]
+
+
 def split_device_host(cond: Expression | None):
     """Partition a resolved conjunction into (device_safe, host_only)."""
     if cond is None:
@@ -70,13 +78,20 @@ class Planner:
 
     def plan(self, stmt: ast.StmtNode) -> ph.PhysPlan:
         if isinstance(stmt, ast.SelectStmt):
-            return self.plan_select(stmt)
+            return self._opt_access(self.plan_select(stmt))
         if isinstance(stmt, ast.InsertStmt):
-            return self.plan_insert(stmt)
+            p = self.plan_insert(stmt)
+            if p.source is not None:
+                p.source = self._opt_access(p.source)
+            return p
         if isinstance(stmt, ast.UpdateStmt):
-            return self.plan_update(stmt)
+            p = self.plan_update(stmt)
+            p.reader = self._opt_access(p.reader)
+            return p
         if isinstance(stmt, ast.DeleteStmt):
-            return self.plan_delete(stmt)
+            p = self.plan_delete(stmt)
+            p.reader = self._opt_access(p.reader)
+            return p
         raise PlanError(f"no plan for {type(stmt).__name__}")
 
     # -- FROM ----------------------------------------------------------------
@@ -193,6 +208,113 @@ class Planner:
             return plan
         return ph.PhysSelection(schema=plan.schema, children=[plan],
                                 cond=cond)
+
+    # -- access path selection ----------------------------------------------
+
+    def _opt_access(self, plan: ph.PhysPlan) -> ph.PhysPlan:
+        """Post-pass (ref: plan/physical_plan_builder.go:203-516 access-path
+        choice, rule-based until stats land): walk the tree; for every
+        table reader, extract pk-handle ranges (always, also under agg
+        pushdown) and consider unique-point gets / secondary-index paths
+        for non-agg readers. All original conjuncts stay as residual
+        filters, so range extraction can never change results."""
+        for i, c in enumerate(plan.children):
+            plan.children[i] = self._opt_access(c)
+        if isinstance(plan, ph.PhysTableReader):
+            return self._choose_access_path(plan)
+        return plan
+
+    def _choose_access_path(self, reader: ph.PhysTableReader) -> ph.PhysPlan:
+        from tidb_tpu import ranger as rg
+        cop = reader.cop
+        info = cop.table
+        conj = flatten_and(cop.filter) + flatten_and(cop.host_filter)
+        if not conj or cop.ranges is not None:
+            return reader
+        off_by_name: dict[str, int] = {}
+        for i, sc in enumerate(reader.schema.cols):
+            off_by_name.setdefault(sc.name, i)
+
+        # 1. pk-is-handle ranges (narrow the record scan in place)
+        if info.pk_is_handle and info.pk_col_name:
+            pk_off = off_by_name.get(info.pk_col_name.lower())
+            if pk_off is not None:
+                path = rg.detach_handle_conditions(conj, pk_off)
+                if path.useful and path.ranges is not None:
+                    kvr = rg.handle_ranges_to_kv(info.id, path.ranges)
+                    if kvr is not None:
+                        if not cop.is_agg and len(path.ranges) == 1 and \
+                                path.eq_count == 1 and \
+                                isinstance(path.ranges[0].low[0], int) and \
+                                path.ranges[0].low == path.ranges[0].high:
+                            return self._point_get(reader,
+                                                   path.ranges[0].low[0],
+                                                   None, None)
+                        cop.ranges = kvr
+                        return reader
+
+        # 2. secondary-index paths (non-agg readers only: agg pushdown to
+        # the TPU kernel beats an index lookup without stats to say
+        # otherwise)
+        if cop.is_agg or cop.limit is not None:
+            return reader
+        best = None
+        for idx in info.indexes:
+            from tidb_tpu.schema.model import SchemaState
+            if idx.state != SchemaState.PUBLIC:
+                continue
+            offsets, fts = [], []
+            ok = True
+            for cname in idx.columns:
+                o = off_by_name.get(cname.lower())
+                if o is None:
+                    ok = False
+                    break
+                offsets.append(o)
+                fts.append(reader.schema.cols[o].ft)
+            if not ok:
+                continue
+            path = rg.detach_index_conditions(conj, offsets, fts)
+            if path.useful and path.ranges:
+                if best is None or path.score > best[1].score:
+                    best = (idx, path)
+        if best is None:
+            return reader
+        idx, path = best
+        # unique full point -> PointGet
+        if idx.unique and path.eq_count == len(idx.columns) and \
+                len(path.ranges) == 1 and not path.has_interval:
+            r = path.ranges[0]
+            if r.low == r.high and all(v is not None for v in r.low):
+                return self._point_get(reader, None, idx, list(r.low))
+        kv_ranges = rg.index_ranges_to_kv(info.id, idx.id, path.ranges)
+        # covering index: every output column is an index column -> decode
+        # straight from index entries, skip the row fetch entirely
+        idx_names = {c.lower() for c in idx.columns}
+        if info.pk_is_handle and info.pk_col_name:
+            idx_names.add(info.pk_col_name.lower())   # handle is in the key
+        if all(c.name.lower() in idx_names for c in cop.cols):
+            cov = ph.CopPlan(
+                table=info, cols=cop.cols, handle_col=cop.handle_col,
+                ranges=kv_ranges, index=idx, filter=cop.filter,
+                host_filter=cop.host_filter)
+            return ph.PhysIndexReader(schema=reader.schema, cop=cov)
+        index_cols = [info.col_by_name(c) for c in idx.columns]
+        index_cop = ph.CopPlan(
+            table=info, cols=index_cols, handle_col=len(index_cols),
+            ranges=kv_ranges, index=idx)
+        return ph.PhysIndexLookUp(schema=reader.schema, index_cop=index_cop,
+                                  table_cop=cop)
+
+    def _point_get(self, reader: ph.PhysTableReader, handle, idx, values
+                   ) -> ph.PhysPointGet:
+        cop = reader.cop
+        filt = and_all([e for e in (cop.filter, cop.host_filter)
+                        if e is not None])
+        return ph.PhysPointGet(schema=reader.schema, table=cop.table,
+                               cols=cop.cols, handle_col=cop.handle_col,
+                               handle=handle, index=idx, index_values=values,
+                               filter=filt)
 
     @staticmethod
     def _rejects_null(cond: Expression) -> bool:

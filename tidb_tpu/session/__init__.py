@@ -224,7 +224,7 @@ class Session:
         if isinstance(stmt, ast.UnionStmt):
             return self._exec_union(stmt)
         try:
-            plan = self._planner().plan_select(stmt)
+            plan = self._planner().plan(stmt)
         except (PlanError, ResolveError) as e:
             raise SQLError(str(e)) from None
         ctx = ExecContext(self.storage, self._read_ts(), self.txn)

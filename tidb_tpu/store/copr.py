@@ -26,7 +26,7 @@ from tidb_tpu.ops.runtime import eval_filter_host
 from tidb_tpu.plan.physical import CopPlan
 from tidb_tpu.store.backoff import (BO_REGION_MISS, BO_SERVER_BUSY,
                                     BO_TXN_LOCK, Backoffer, COP_MAX_BACKOFF)
-from tidb_tpu.table import kvrows_to_chunk
+from tidb_tpu.table import index_kvrows_to_chunk, kvrows_to_chunk
 
 __all__ = ["CopClient", "cop_handler", "DEFAULT_COP_CONCURRENCY"]
 
@@ -99,8 +99,13 @@ def cop_handler(storage):
                                         req.isolation, desc=False)
             if not batch:
                 break
-            chunk = kvrows_to_chunk(plan.table, plan.cols, batch,
-                                    with_handle_col=plan.handle_col)
+            if plan.index is not None:
+                chunk = index_kvrows_to_chunk(plan.table, plan.index,
+                                              plan.cols, batch,
+                                              handle_col=plan.handle_col)
+            else:
+                chunk = kvrows_to_chunk(plan.table, plan.cols, batch,
+                                        with_handle_col=plan.handle_col)
             resp = exec_cop_plan(plan, chunk)
             out.append(resp)
             if remaining is not None and not plan.is_agg:

@@ -36,8 +36,12 @@ def encode_datum_for_col(v, ft: FieldType):
     if v is None:
         return None
     if ft.eval_type == EvalType.DECIMAL:
+        # normalize to the column's scale: the memcomparable decimal
+        # encoding orders by (frac, scaled), so every stored datum of a
+        # column MUST share the column frac or index ranges break
         if isinstance(v, tuple):
-            return v
+            frac, scaled = v
+            return (ft.frac, _rescale_decimal(scaled, frac, ft.frac))
         return (ft.frac, decimal_to_scaled(v, ft.frac))
     if ft.eval_type == EvalType.STRING:
         return v if isinstance(v, (str, bytes)) else str(v)
@@ -60,16 +64,26 @@ def encode_datum_for_col(v, ft: FieldType):
     return int(v)
 
 
+def _rescale_decimal(scaled: int, frac: int, to_frac: int) -> int:
+    """Change a scaled decimal's scale; MySQL half-away-from-zero when
+    dropping digits."""
+    if to_frac == frac:
+        return scaled
+    if to_frac > frac:
+        return scaled * (10 ** (to_frac - frac))
+    div = 10 ** (frac - to_frac)
+    q, r = divmod(abs(scaled), div)
+    out = q + (1 if 2 * r >= div else 0)
+    return out if scaled >= 0 else -out
+
+
 def decode_datum_for_col(v, ft: FieldType):
     """KV datum -> chunk-layer value (scaled int for decimals)."""
     if v is None:
         return None
     if ft.eval_type == EvalType.DECIMAL:
         frac, scaled = v
-        if frac != ft.frac:
-            scaled = scaled * (10 ** (ft.frac - frac)) if ft.frac > frac \
-                else scaled // (10 ** (frac - ft.frac))
-        return scaled
+        return _rescale_decimal(scaled, frac, ft.frac)
     if ft.eval_type == EvalType.STRING and isinstance(v, bytes):
         try:
             return v.decode("utf8")
@@ -247,6 +261,51 @@ class Table:
         for k, v in retriever.iter_range(start, end):
             _tid, handle = tablecodec.decode_record_key(k)
             yield handle, tablecodec.decode_row(v)
+
+
+def index_kvrows_to_chunk(info: TableInfo, idx: IndexInfo, col_infos,
+                          kvrows, handle_col: int | None = None) -> Chunk:
+    """Decode raw index (key, value) pairs into a chunk of the requested
+    index columns (+ handle). Non-unique entries carry the handle as the
+    key's last datum; unique entries carry it in the value
+    (ref: tablecodec.go index layout, table/tables/index.go)."""
+    from tidb_tpu import codec as _codec
+    from tidb_tpu.sqltypes import new_int_field
+    n_idx_cols = len(idx.columns)
+    # map requested col name -> position among the index's columns
+    pos_by_name = {c.lower(): i for i, c in enumerate(idx.columns)}
+    ncols = len(col_infos) + (1 if handle_col is not None else 0)
+    rows = []
+    for k, v in kvrows:
+        _tid, _iid, suffix = tablecodec.decode_index_key(k)
+        vals = _codec.decode_key(suffix)
+        if len(vals) > n_idx_cols:          # handle stored in-key
+            handle = vals[n_idx_cols]
+            vals = vals[:n_idx_cols]
+        else:                               # unique entry: handle in value
+            handle, _ = _codec.decode_int(v, 0)
+        row = []
+        src = 0
+        for j in range(ncols):
+            if handle_col is not None and j == handle_col:
+                row.append(handle)
+                continue
+            ci = col_infos[src]
+            src += 1
+            pos = pos_by_name.get(ci.name.lower())
+            # pk-is-handle column is not among index columns; its value IS
+            # the handle (covering-index reads rely on this)
+            row.append(handle if pos is None else vals[pos])
+        rows.append(row)
+    fts = []
+    src = 0
+    for j in range(ncols):
+        if handle_col is not None and j == handle_col:
+            fts.append(new_int_field())
+        else:
+            fts.append(col_infos[src].ft)
+            src += 1
+    return rows_to_chunk(fts, rows)
 
 
 def rows_to_chunk(fts: list[FieldType], rows: list[list]) -> Chunk:
