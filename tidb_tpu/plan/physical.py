@@ -50,9 +50,13 @@ class PhysPlan:
     schema: PlanSchema = field(default_factory=PlanSchema)
     children: list = field(default_factory=list)
 
+    est_rows = None   # CBO row estimate, set by the planner when stats exist
+
     def explain(self, depth: int = 0) -> str:
         name = type(self).__name__.replace("Phys", "")
         line = "  " * depth + name + self._explain_info()
+        if self.est_rows is not None:
+            line += f" est_rows:{self.est_rows:.0f}"
         return "\n".join([line] + [c.explain(depth + 1)
                                    for c in self.children])
 

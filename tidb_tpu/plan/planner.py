@@ -70,9 +70,18 @@ def split_device_host(cond: Expression | None):
 
 
 class Planner:
-    def __init__(self, infoschema: InfoSchema, current_db: str):
+    def __init__(self, infoschema: InfoSchema, current_db: str,
+                 stats_handle=None):
+        self.stats = stats_handle
         self.ischema = infoschema
         self.db = current_db
+
+    def _tbl_stats(self, info):
+        """TableStats for the table — pseudo when never analyzed."""
+        if self.stats is None:
+            from tidb_tpu.statistics import TableStats
+            return TableStats(table_id=info.id)
+        return self.stats.get(info.id)
 
     # -- entry ---------------------------------------------------------------
 
@@ -224,6 +233,11 @@ class Planner:
             return self._choose_access_path(plan)
         return plan
 
+    # Cost factors (ref: the copTask/rootTask cost charges, plan/task.go:213
+    # netWorkFactor and the double-read penalty of IndexLookUp).
+    _COVER_FACTOR = 1.2    # covering index: scan + net per row
+    _LOOKUP_FACTOR = 4.0   # index lookup: scan + net + random row fetch
+
     def _choose_access_path(self, reader: ph.PhysTableReader) -> ph.PhysPlan:
         from tidb_tpu import ranger as rg
         cop = reader.cop
@@ -231,6 +245,12 @@ class Planner:
         conj = flatten_and(cop.filter) + flatten_and(cop.host_filter)
         if not conj or cop.ranges is not None:
             return reader
+        st = self._tbl_stats(info)
+        use_cbo = not st.pseudo
+        if use_cbo:
+            from tidb_tpu.statistics import selectivity
+            reader.est_rows = max(1, st.count) * selectivity(
+                st, conj, reader.schema.cols, info)
         off_by_name: dict[str, int] = {}
         for i, sc in enumerate(reader.schema.cols):
             off_by_name.setdefault(sc.name, i)
@@ -254,11 +274,14 @@ class Planner:
                         return reader
 
         # 2. secondary-index paths (non-agg readers only: agg pushdown to
-        # the TPU kernel beats an index lookup without stats to say
-        # otherwise)
+        # the TPU kernel beats an index lookup unless stats say otherwise)
         if cop.is_agg or cop.limit is not None:
             return reader
-        best = None
+        # index columns are covering iff every output column is indexed
+        idx_cover_base = set()
+        if info.pk_is_handle and info.pk_col_name:
+            idx_cover_base.add(info.pk_col_name.lower())
+        candidates = []
         for idx in info.indexes:
             from tidb_tpu.schema.model import SchemaState
             if idx.state != SchemaState.PUBLIC:
@@ -276,11 +299,26 @@ class Planner:
                 continue
             path = rg.detach_index_conditions(conj, offsets, fts)
             if path.useful and path.ranges:
-                if best is None or path.score > best[1].score:
-                    best = (idx, path)
-        if best is None:
+                indexed = idx_cover_base | {cn.lower() for cn in idx.columns}
+                covering = all(c.name.lower() in indexed for c in cop.cols)
+                candidates.append((idx, path, covering))
+        if not candidates:
             return reader
-        idx, path = best
+        if use_cbo:
+            # cost = rows read x per-row factor; full scan reads count rows
+            scan_cost = float(max(1, st.count))
+            best = None
+            for idx, path, cov in candidates:
+                rows = st.index_ranges_row_count(idx, path.ranges)
+                factor = self._COVER_FACTOR if cov else self._LOOKUP_FACTOR
+                cost = rows * factor
+                if best is None or cost < best[3]:
+                    best = (idx, path, cov, cost)
+            if best[3] >= scan_cost:
+                return reader            # table scan wins
+            idx, path, covering, _cost = best
+        else:
+            idx, path, covering = max(candidates, key=lambda c: c[1].score)
         # unique full point -> PointGet
         if idx.unique and path.eq_count == len(idx.columns) and \
                 len(path.ranges) == 1 and not path.has_interval:
@@ -290,21 +328,22 @@ class Planner:
         kv_ranges = rg.index_ranges_to_kv(info.id, idx.id, path.ranges)
         # covering index: every output column is an index column -> decode
         # straight from index entries, skip the row fetch entirely
-        idx_names = {c.lower() for c in idx.columns}
-        if info.pk_is_handle and info.pk_col_name:
-            idx_names.add(info.pk_col_name.lower())   # handle is in the key
-        if all(c.name.lower() in idx_names for c in cop.cols):
+        if covering:
             cov = ph.CopPlan(
                 table=info, cols=cop.cols, handle_col=cop.handle_col,
                 ranges=kv_ranges, index=idx, filter=cop.filter,
                 host_filter=cop.host_filter)
-            return ph.PhysIndexReader(schema=reader.schema, cop=cov)
+            out = ph.PhysIndexReader(schema=reader.schema, cop=cov)
+            out.est_rows = reader.est_rows
+            return out
         index_cols = [info.col_by_name(c) for c in idx.columns]
         index_cop = ph.CopPlan(
             table=info, cols=index_cols, handle_col=len(index_cols),
             ranges=kv_ranges, index=idx)
-        return ph.PhysIndexLookUp(schema=reader.schema, index_cop=index_cop,
-                                  table_cop=cop)
+        out = ph.PhysIndexLookUp(schema=reader.schema, index_cop=index_cop,
+                                 table_cop=cop)
+        out.est_rows = reader.est_rows
+        return out
 
     def _point_get(self, reader: ph.PhysTableReader, handle, idx, values
                    ) -> ph.PhysPointGet:

@@ -53,6 +53,14 @@ class Domain:
         self.storage = storage
         self._schema: InfoSchema | None = None
         self._mu = threading.Lock()
+        self._stats = None
+
+    def stats_handle(self):
+        """Lazy per-store stats cache (ref: statistics/handle.go:32)."""
+        if self._stats is None:
+            from tidb_tpu.statistics import StatsHandle
+            self._stats = StatsHandle(self.storage)
+        return self._stats
 
     @classmethod
     def get(cls, storage) -> "Domain":
@@ -184,7 +192,10 @@ class Session:
                              ast.RenameTableStmt)):
             if self.txn is not None:
                 self._commit()  # implicit commit before DDL (MySQL semantics)
+            dropped = self._dropped_table_ids(stmt)
             DDLExecutor(self.storage).execute(stmt, self.current_db)
+            for tid in dropped:
+                self.domain.stats_handle().drop(tid)
             return None
         if isinstance(stmt, ast.UseStmt):
             ischema = self.domain.info_schema()
@@ -210,7 +221,7 @@ class Session:
         if isinstance(stmt, ast.ExplainStmt):
             return self._exec_explain(stmt)
         if isinstance(stmt, ast.AnalyzeStmt):
-            return None  # stats milestone
+            return self._exec_analyze(stmt)
         if isinstance(stmt, ast.AdminStmt):
             return ResultSet(columns=["info"], rows=[])
         raise SQLError(f"unsupported statement {t}")
@@ -218,7 +229,8 @@ class Session:
     # -- queries -------------------------------------------------------------
 
     def _planner(self) -> Planner:
-        return Planner(self.domain.info_schema(), self.current_db)
+        return Planner(self.domain.info_schema(), self.current_db,
+                       stats_handle=self.domain.stats_handle())
 
     def _exec_query(self, stmt) -> ResultSet:
         if isinstance(stmt, ast.UnionStmt):
@@ -279,6 +291,7 @@ class Session:
                 self._rollback()
             raise
         self._history.append(stmt)
+        self._note_dml_delta(stmt, n)
         if not in_txn and self.autocommit:
             self._commit()
         return n
@@ -353,6 +366,57 @@ class Session:
                              [(t.name,
                                f"CREATE TABLE `{t.name}` (\n  {cols}\n)")])
         return ResultSet(["info"], [])
+
+    # -- ANALYZE / stats -----------------------------------------------------
+
+    def _resolve_table(self, ts):
+        ischema = self.domain.info_schema()
+        db = (getattr(ts, "db", "") or self.current_db)
+        return ischema.table(db, ts.name)
+
+    def _exec_analyze(self, stmt: ast.AnalyzeStmt):
+        """ANALYZE TABLE: full-scan stats build + persist (ref:
+        executor/analyze.go:42; statistics/handle.go)."""
+        from tidb_tpu.statistics import analyze_table
+        handle = self.domain.stats_handle()
+        for ts in stmt.tables:
+            try:
+                info = self._resolve_table(ts)
+            except Exception as e:
+                raise SQLError(str(e)) from None
+            stats = analyze_table(self.storage, self.storage.current_ts(),
+                                  info)
+            handle.save(stats)
+        return None
+
+    def _dropped_table_ids(self, stmt) -> list:
+        """Table ids about to be dropped/truncated, for stats cleanup."""
+        sources = []
+        if isinstance(stmt, ast.DropTableStmt):
+            sources = stmt.tables
+        elif isinstance(stmt, ast.TruncateTableStmt):
+            sources = [stmt.table]
+        elif isinstance(stmt, ast.DropDatabaseStmt):
+            ischema = self.domain.info_schema()
+            if ischema.has_db(stmt.name):
+                return [ischema.table(stmt.name, n).id
+                        for n in ischema.table_names(stmt.name)]
+        out = []
+        for ts in sources:
+            try:
+                out.append(self._resolve_table(ts).id)
+            except Exception:
+                pass
+        return out
+
+    def _note_dml_delta(self, stmt, n: int) -> None:
+        ts = stmt.table
+        if isinstance(ts, ast.TableSource):
+            try:
+                self.domain.stats_handle().note_dml(
+                    self._resolve_table(ts).id, n)
+            except Exception:
+                pass
 
     def _exec_explain(self, stmt: ast.ExplainStmt) -> ResultSet:
         plan = self._planner().plan(stmt.stmt)
