@@ -1,291 +1,298 @@
-"""DDL execution.
+"""DDL: statement validation + job construction (the API half).
 
-Reference: /root/reference/ddl/ — the full F1 online-schema-change worker
-(state machine, owner election, backfill) arrives with the online-DDL
-milestone; this module implements the synchronous single-node versions with
-the same metadata effects (schema version bumps, TableInfo/DBInfo json in
-meta), so upgrading to async jobs changes the driver, not the format.
+Reference: /root/reference/ddl/ddl_api.go (validation + job build),
+ddl/ddl.go:406 doDDLJob (enqueue, then wait for the owner's worker to
+finish the job). Statements validate against the current schema, enqueue a
+`Job`, and drive the in-process worker (ddl/worker.py) until the job
+reaches history — so the session API is synchronous while the metadata
+walks the full F1 state machine, one schema version per transition, with
+every intermediate state visible to concurrent sessions.
 """
 
 from __future__ import annotations
 
-from tidb_tpu import codec, kv, tablecodec
-from tidb_tpu.meta import Meta, MetaError
+from tidb_tpu import kv
+from tidb_tpu.ddl.job import Job, JobType
+from tidb_tpu.ddl.worker import DDLWorker, JobFailed
+from tidb_tpu.meta import Meta
 from tidb_tpu.parser import ast
 from tidb_tpu.schema.model import (ColumnInfo, DBInfo, IndexInfo,
                                    SchemaState, TableInfo)
-from tidb_tpu.sqltypes import EvalType, Flag, TypeCode
-from tidb_tpu.table import Table, encode_datum_for_col
+from tidb_tpu.sqltypes import EvalType, Flag
+from tidb_tpu.table import Table  # noqa: F401  (re-export for callers)
 
-__all__ = ["DDLError", "DDLExecutor"]
+__all__ = ["DDLError", "DDL", "DDLExecutor", "build_table_info"]
 
 
 class DDLError(kv.KVError):
     pass
 
 
-class DDLExecutor:
-    """Applies one DDL statement in its own meta transaction."""
+class DDL:
+    """Validates a DDL statement, enqueues its job(s), runs the worker."""
 
-    def __init__(self, storage):
+    def __init__(self, storage, worker: DDLWorker | None = None):
         self.storage = storage
-
-    def _txn(self):
-        return self.storage.begin()
+        self.worker = worker or DDLWorker(storage)
 
     def execute(self, stmt: ast.StmtNode, current_db: str) -> None:
-        m = getattr(self, "_exec_" + type(stmt).__name__, None)
+        m = getattr(self, "_build_" + type(stmt).__name__, None)
         if m is None:
             raise DDLError(f"unsupported DDL {type(stmt).__name__}")
-        txn = self._txn()
+        # Build + run jobs one at a time: later specs of one ALTER validate
+        # against the schema the earlier ones produced.
+        builders = m(stmt, current_db)
+        for build in builders:
+            job = self._enqueue(build)
+            if job is None:
+                continue
+            try:
+                self.worker.run_job(job.id)
+            except JobFailed as e:
+                raise DDLError(str(e)) from None
+
+    def _enqueue(self, build) -> Job | None:
+        """Run `build(meta) -> Job|None` and enqueue in one meta txn."""
+        txn = self.storage.begin()
         try:
-            m(Meta(txn), stmt, current_db)
-            Meta(txn).gen_schema_version()
+            meta = Meta(txn)
+            job = build(meta)
+            if job is None:
+                txn.rollback()
+                return None
+            job.id = meta.gen_global_id()
+            meta.enqueue_job(job)
             txn.commit()
+            return job
         except Exception:
-            txn.rollback()
+            if txn.valid:
+                txn.rollback()
             raise
 
-    # -- databases -----------------------------------------------------------
+    # -- helpers -------------------------------------------------------------
 
-    def _exec_CreateDatabaseStmt(self, meta: Meta, stmt, _db):
-        for db in meta.list_databases():
-            if db.name.lower() == stmt.name.lower():
-                if stmt.if_not_exists:
-                    return
-                raise DDLError(f"database '{stmt.name}' exists")
-        meta.create_database(DBInfo(id=meta.gen_global_id(), name=stmt.name))
-
-    def _exec_DropDatabaseStmt(self, meta: Meta, stmt, _db):
-        for db in meta.list_databases():
-            if db.name.lower() == stmt.name.lower():
-                for t in meta.list_tables(db.id):
-                    self._drop_table_data(t.id)
-                meta.drop_database(db.id)
-                return
-        if not stmt.if_exists:
-            raise DDLError(f"database '{stmt.name}' doesn't exist")
-
-    # -- tables --------------------------------------------------------------
-
-    def _find_db(self, meta: Meta, name: str) -> DBInfo:
+    @staticmethod
+    def _find_db(meta: Meta, name: str) -> DBInfo:
         for db in meta.list_databases():
             if db.name.lower() == name.lower():
                 return db
         raise DDLError(f"Unknown database '{name}'")
 
-    def _find_table(self, meta: Meta, db_id: int, name: str):
+    @staticmethod
+    def _find_table(meta: Meta, db_id: int, name: str):
         for t in meta.list_tables(db_id):
             if t.name.lower() == name.lower():
                 return t
         return None
 
-    def _resolve_table(self, meta: Meta, ts: ast.TableSource,
-                       current_db: str):
+    def _resolve(self, meta: Meta, ts: ast.TableSource, current_db: str):
         dbn = ts.db or current_db
         if not dbn:
             raise DDLError("No database selected")
         db = self._find_db(meta, dbn)
-        t = self._find_table(meta, db.id, ts.name)
+        return db, self._find_table(meta, db.id, ts.name)
+
+    def _must_resolve(self, meta: Meta, ts, current_db):
+        db, t = self._resolve(meta, ts, current_db)
+        if t is None:
+            raise DDLError(f"table '{ts.name}' doesn't exist")
         return db, t
 
-    def _exec_CreateTableStmt(self, meta: Meta, stmt: ast.CreateTableStmt,
-                              current_db: str):
-        db, existing = self._resolve_table(meta, stmt.table, current_db)
-        if existing is not None:
-            if stmt.if_not_exists:
-                return
-            raise DDLError(f"table '{stmt.table.name}' exists")
-        info = build_table_info(meta, stmt)
-        meta.create_table(db.id, info)
+    # -- databases -----------------------------------------------------------
 
-    def _exec_DropTableStmt(self, meta: Meta, stmt, current_db):
+    def _build_CreateDatabaseStmt(self, stmt, _db):
+        def build(meta: Meta):
+            for db in meta.list_databases():
+                if db.name.lower() == stmt.name.lower():
+                    if stmt.if_not_exists:
+                        return None
+                    raise DDLError(f"database '{stmt.name}' exists")
+            return Job(tp=JobType.CREATE_SCHEMA,
+                       schema_id=meta.gen_global_id(),
+                       args={"name": stmt.name})
+        return [build]
+
+    def _build_DropDatabaseStmt(self, stmt, _db):
+        def build(meta: Meta):
+            for db in meta.list_databases():
+                if db.name.lower() == stmt.name.lower():
+                    return Job(tp=JobType.DROP_SCHEMA, schema_id=db.id)
+            if stmt.if_exists:
+                return None
+            raise DDLError(f"database '{stmt.name}' doesn't exist")
+        return [build]
+
+    # -- tables --------------------------------------------------------------
+
+    def _build_CreateTableStmt(self, stmt, current_db):
+        def build(meta: Meta):
+            db, existing = self._resolve(meta, stmt.table, current_db)
+            if existing is not None:
+                if stmt.if_not_exists:
+                    return None
+                raise DDLError(f"table '{stmt.table.name}' exists")
+            info = build_table_info(meta, stmt)
+            return Job(tp=JobType.CREATE_TABLE, schema_id=db.id,
+                       table_id=info.id, args={"table": info.to_json()})
+        return [build]
+
+    def _build_DropTableStmt(self, stmt, current_db):
+        builders = []
         for ts in stmt.tables:
-            db, t = self._resolve_table(meta, ts, current_db)
-            if t is None:
-                if stmt.if_exists:
-                    continue
-                raise DDLError(f"table '{ts.name}' doesn't exist")
-            meta.drop_table(db.id, t.id)
-            self._drop_table_data(t.id)
+            def build(meta: Meta, ts=ts):
+                db, t = self._resolve(meta, ts, current_db)
+                if t is None:
+                    if stmt.if_exists:
+                        return None
+                    raise DDLError(f"table '{ts.name}' doesn't exist")
+                return Job(tp=JobType.DROP_TABLE, schema_id=db.id,
+                           table_id=t.id)
+            builders.append(build)
+        return builders
 
-    def _exec_TruncateTableStmt(self, meta: Meta, stmt, current_db):
-        db, t = self._resolve_table(meta, stmt.table, current_db)
-        if t is None:
-            raise DDLError(f"table '{stmt.table.name}' doesn't exist")
-        # new table id, same schema (ref: ddl truncate = id swap)
-        meta.drop_table(db.id, t.id)
-        old_id = t.id
-        t.id = meta.gen_global_id()
-        meta.create_table(db.id, t)
-        self._drop_table_data(old_id)
+    def _build_TruncateTableStmt(self, stmt, current_db):
+        def build(meta: Meta):
+            db, t = self._must_resolve(meta, stmt.table, current_db)
+            return Job(tp=JobType.TRUNCATE_TABLE, schema_id=db.id,
+                       table_id=t.id,
+                       args={"new_table_id": meta.gen_global_id()})
+        return [build]
 
-    def _exec_RenameTableStmt(self, meta: Meta, stmt, current_db):
+    def _build_RenameTableStmt(self, stmt, current_db):
+        builders = []
         for old_ts, new_ts in stmt.pairs:
-            db, t = self._resolve_table(meta, old_ts, current_db)
-            if t is None:
-                raise DDLError(f"table '{old_ts.name}' doesn't exist")
-            new_db = self._find_db(meta, new_ts.db or current_db)
-            if self._find_table(meta, new_db.id, new_ts.name) is not None:
-                raise DDLError(f"table '{new_ts.name}' exists")
-            meta.drop_table(db.id, t.id)
-            t.name = new_ts.name
-            meta.create_table(new_db.id, t)
-
-    def _drop_table_data(self, table_id: int) -> None:
-        """Immediate range delete (the delete-range/GC emulator arrives with
-        the GC milestone; ref: ddl/delete_range.go:51)."""
-        lo, hi = tablecodec.table_prefix_range(table_id)
-        self.storage.engine.delete_range(lo, hi)
+            def build(meta: Meta, old_ts=old_ts, new_ts=new_ts):
+                db, t = self._must_resolve(meta, old_ts, current_db)
+                new_db = self._find_db(meta, new_ts.db or current_db)
+                if self._find_table(meta, new_db.id, new_ts.name) is not None:
+                    raise DDLError(f"table '{new_ts.name}' exists")
+                return Job(tp=JobType.RENAME_TABLE, schema_id=db.id,
+                           table_id=t.id,
+                           args={"new_name": new_ts.name,
+                                 "new_schema_id": new_db.id})
+            builders.append(build)
+        return builders
 
     # -- indexes -------------------------------------------------------------
 
-    def _exec_CreateIndexStmt(self, meta: Meta, stmt: ast.CreateIndexStmt,
-                              current_db: str):
-        db, t = self._resolve_table(meta, stmt.table, current_db)
-        if t is None:
-            raise DDLError(f"table '{stmt.table.name}' doesn't exist")
-        if t.index_by_name(stmt.index_name) is not None:
-            raise DDLError(f"index '{stmt.index_name}' exists")
-        for cn in stmt.columns:
+    def _index_job(self, meta: Meta, db, t: TableInfo, name: str,
+                   columns: list[str], unique: bool) -> Job:
+        if t.index_by_name(name) is not None:
+            raise DDLError(f"index '{name}' exists")
+        for cn in columns:
             if t.col_by_name(cn) is None:
                 raise DDLError(f"Unknown column '{cn}'")
-        idx = IndexInfo(id=max([i.id for i in t.indexes], default=0) + 1,
-                        name=stmt.index_name, columns=stmt.columns,
-                        unique=stmt.unique)
-        self._backfill_index(t, idx)
-        t.indexes.append(idx)
+        idx = IndexInfo(id=t.alloc_index_id(), name=name, columns=columns,
+                        unique=unique)
+        # persist the bumped max_index_id now so a concurrent/later job
+        # can't hand out the same id
         meta.update_table(db.id, t)
+        return Job(tp=JobType.ADD_INDEX, schema_id=db.id, table_id=t.id,
+                   args={"index": idx.to_json()})
 
-    def _exec_DropIndexStmt(self, meta: Meta, stmt, current_db):
-        db, t = self._resolve_table(meta, stmt.table, current_db)
-        if t is None:
-            raise DDLError(f"table '{stmt.table.name}' doesn't exist")
-        idx = t.index_by_name(stmt.index_name)
-        if idx is None:
-            if stmt.if_exists:
-                return
-            raise DDLError(f"index '{stmt.index_name}' doesn't exist")
-        t.indexes.remove(idx)
-        meta.update_table(db.id, t)
-        prefix = tablecodec.index_prefix(t.id, idx.id)
-        self.storage.engine.delete_range(prefix, codec.prefix_next(prefix))
+    def _build_CreateIndexStmt(self, stmt, current_db):
+        def build(meta: Meta):
+            db, t = self._must_resolve(meta, stmt.table, current_db)
+            return self._index_job(meta, db, t, stmt.index_name,
+                                   stmt.columns, stmt.unique)
+        return [build]
 
-    def _backfill_index(self, t: TableInfo, idx: IndexInfo) -> None:
-        """Synchronous backfill in one txn (the reorg worker with batched
-        txns + checkpoints replaces this in the online-DDL milestone;
-        ref: ddl/index.go:480-676 addTableIndex)."""
-        txn = self.storage.begin()
-        try:
-            tbl = Table(t, self.storage)
-            seen = {}
-            for handle, row in tbl.iter_records(txn):
-                vals = []
-                for cn in idx.columns:
-                    ci = t.col_by_name(cn)
-                    vals.append(row.get(ci.id))
-                if idx.unique and all(v is not None for v in vals):
-                    key = tuple(vals)
-                    if key in seen:
-                        raise DDLError(
-                            f"duplicate entry for new unique index")
-                    seen[key] = handle
-                    txn.set(tablecodec.index_key(t.id, idx.id, vals),
-                            codec.encode_int(handle))
-                else:
-                    txn.set(tablecodec.index_key(t.id, idx.id, vals,
-                                                 handle=handle), b"0")
-            txn.commit()
-        except Exception:
-            txn.rollback()
-            raise
+    def _build_DropIndexStmt(self, stmt, current_db):
+        def build(meta: Meta):
+            db, t = self._must_resolve(meta, stmt.table, current_db)
+            if t.index_by_name(stmt.index_name) is None:
+                if stmt.if_exists:
+                    return None
+                raise DDLError(f"index '{stmt.index_name}' doesn't exist")
+            return Job(tp=JobType.DROP_INDEX, schema_id=db.id,
+                       table_id=t.id, args={"name": stmt.index_name})
+        return [build]
 
     # -- ALTER ---------------------------------------------------------------
 
-    def _exec_AlterTableStmt(self, meta: Meta, stmt: ast.AlterTableStmt,
-                             current_db: str):
-        db, t = self._resolve_table(meta, stmt.table, current_db)
-        if t is None:
-            raise DDLError(f"table '{stmt.table.name}' doesn't exist")
-        for spec in stmt.specs:
-            if spec.tp == "add_column":
-                self._alter_add_column(t, spec)
-            elif spec.tp == "drop_column":
-                self._alter_drop_column(t, spec)
-            elif spec.tp == "add_index":
-                idx_def = spec.index
-                if t.index_by_name(idx_def.name or "") is not None:
-                    raise DDLError(f"index '{idx_def.name}' exists")
-                idx = IndexInfo(
-                    id=max([i.id for i in t.indexes], default=0) + 1,
-                    name=idx_def.name or "_".join(idx_def.columns),
-                    columns=idx_def.columns, unique=idx_def.unique,
-                    primary=idx_def.primary)
-                self._backfill_index(t, idx)
-                t.indexes.append(idx)
-            elif spec.tp == "drop_index":
-                idx = t.index_by_name(spec.name)
-                if idx is None:
-                    raise DDLError(f"index '{spec.name}' doesn't exist")
-                t.indexes.remove(idx)
-                prefix = tablecodec.index_prefix(t.id, idx.id)
-                self.storage.engine.delete_range(prefix,
-                                                 codec.prefix_next(prefix))
-            elif spec.tp == "modify_column" or spec.tp == "change_column":
-                old_name = spec.name if spec.tp == "change_column" \
-                    else spec.column.name
-                old = t.col_by_name(old_name)
-                if old is None:
-                    raise DDLError(f"Unknown column '{old_name}'")
-                old.name = spec.column.name
-                old.ft = spec.column.ft
-            elif spec.tp == "rename":
-                t.name = spec.name
-            else:
-                raise DDLError(f"unsupported ALTER {spec.tp}")
-        meta.update_table(db.id, t)
+    def _build_AlterTableStmt(self, stmt, current_db):
+        # one schema change per statement, like the reference
+        # (ddl_api.go AlterTable: errRunMultiSchemaChanges) — keeps ALTER
+        # atomic: a failing spec can't leave earlier specs applied
+        if len(stmt.specs) != 1:
+            raise DDLError("running multiple schema changes in one "
+                           "statement is not supported")
 
-    def _alter_add_column(self, t: TableInfo, spec) -> None:
-        cd = spec.column
-        if t.col_by_name(cd.name) is not None:
-            raise DDLError(f"column '{cd.name}' exists")
-        default = None
-        has_default = cd.has_default
-        if cd.has_default and cd.default is not None:
-            default = _const_default(cd)
-        elif not cd.ft.not_null:
-            has_default = True  # NULL default for existing rows
-        col = ColumnInfo(
-            id=max([c.id for c in t.columns], default=0) + 1,
-            name=cd.name, offset=len(t.columns), ft=cd.ft,
-            default=default, has_default=has_default,
-            auto_increment=cd.auto_increment)
-        if spec.position == "first":
-            t.columns.insert(0, col)
-        elif spec.position == "after":
-            ai = next((i for i, c in enumerate(t.columns)
-                       if c.name.lower() == spec.after_col.lower()), None)
-            if ai is None:
+        def build(meta: Meta):
+            db, t = self._must_resolve(meta, stmt.table, current_db)
+            return self._alter_spec_job(meta, db, t, stmt.specs[0])
+        return [build]
+
+    def _alter_spec_job(self, meta: Meta, db, t: TableInfo, spec):
+        if spec.tp == "add_column":
+            cd = spec.column
+            if t.col_by_name(cd.name) is not None:
+                raise DDLError(f"column '{cd.name}' exists")
+            default = None
+            has_default = cd.has_default
+            if cd.has_default and cd.default is not None:
+                default = _const_default(cd)
+            elif not cd.ft.not_null:
+                has_default = True   # NULL default for existing rows
+            col = ColumnInfo(id=t.alloc_column_id(), name=cd.name,
+                             offset=len(t.columns), ft=cd.ft,
+                             default=default, has_default=has_default,
+                             auto_increment=cd.auto_increment)
+            meta.update_table(db.id, t)   # persist max_column_id bump
+            if spec.position == "after" and \
+                    t.col_by_name(spec.after_col) is None:
                 raise DDLError(f"Unknown column '{spec.after_col}'")
-            t.columns.insert(ai + 1, col)
-        else:
-            t.columns.append(col)
-        for i, c in enumerate(t.columns):
-            c.offset = i
+            return Job(tp=JobType.ADD_COLUMN, schema_id=db.id,
+                       table_id=t.id,
+                       args={"column": col.to_json(),
+                             "position": spec.position,
+                             "after_col": spec.after_col})
+        if spec.tp == "drop_column":
+            col = t.col_by_name(spec.name)
+            if col is None:
+                raise DDLError(f"Unknown column '{spec.name}'")
+            if t.pk_is_handle and \
+                    t.pk_col_name.lower() == spec.name.lower():
+                raise DDLError("cannot drop the integer primary key")
+            for idx in t.indexes:
+                if any(c.lower() == spec.name.lower()
+                       for c in idx.columns):
+                    raise DDLError(f"column '{spec.name}' is indexed; "
+                                   "drop index first")
+            return Job(tp=JobType.DROP_COLUMN, schema_id=db.id,
+                       table_id=t.id, args={"name": spec.name})
+        if spec.tp == "add_index":
+            idef = spec.index
+            return self._index_job(meta, db, t,
+                                   idef.name or "_".join(idef.columns),
+                                   idef.columns, idef.unique)
+        if spec.tp == "drop_index":
+            if t.index_by_name(spec.name) is None:
+                raise DDLError(f"index '{spec.name}' doesn't exist")
+            return Job(tp=JobType.DROP_INDEX, schema_id=db.id,
+                       table_id=t.id, args={"name": spec.name})
+        if spec.tp in ("modify_column", "change_column"):
+            old_name = spec.name if spec.tp == "change_column" \
+                else spec.column.name
+            old = t.col_by_name(old_name)
+            if old is None:
+                raise DDLError(f"Unknown column '{old_name}'")
+            new = ColumnInfo(id=old.id, name=spec.column.name,
+                             offset=old.offset, ft=spec.column.ft)
+            return Job(tp=JobType.MODIFY_COLUMN, schema_id=db.id,
+                       table_id=t.id,
+                       args={"old_name": old_name,
+                             "column": new.to_json()})
+        if spec.tp == "rename":
+            return Job(tp=JobType.RENAME_TABLE, schema_id=db.id,
+                       table_id=t.id,
+                       args={"new_name": spec.name,
+                             "new_schema_id": db.id})
+        raise DDLError(f"unsupported ALTER {spec.tp}")
 
-    def _alter_drop_column(self, t: TableInfo, spec) -> None:
-        col = t.col_by_name(spec.name)
-        if col is None:
-            raise DDLError(f"Unknown column '{spec.name}'")
-        if t.pk_is_handle and t.pk_col_name.lower() == spec.name.lower():
-            raise DDLError("cannot drop the integer primary key")
-        for idx in t.indexes:
-            if any(c.lower() == spec.name.lower() for c in idx.columns):
-                raise DDLError(
-                    f"column '{spec.name}' is indexed; drop index first")
-        t.columns.remove(col)
-        for i, c in enumerate(t.columns):
-            c.offset = i
+
+# Back-compat alias: the session layer predates the job-based front-end.
+DDLExecutor = DDL
 
 
 def build_table_info(meta: Meta, stmt: ast.CreateTableStmt) -> TableInfo:
@@ -300,6 +307,7 @@ def build_table_info(meta: Meta, stmt: ast.CreateTableStmt) -> TableInfo:
             id=i + 1, name=cd.name, offset=i, ft=cd.ft, default=default,
             has_default=cd.has_default or not cd.ft.not_null,
             auto_increment=cd.auto_increment, comment=cd.comment))
+    info.max_column_id = len(stmt.columns)
 
     # primary key: inline or table-level
     pk_cols: list[str] = [cd.name for cd in stmt.columns if cd.is_primary]
@@ -332,6 +340,7 @@ def build_table_info(meta: Meta, stmt: ast.CreateTableStmt) -> TableInfo:
         info.indexes.append(IndexInfo(
             id=idx_id, name=idef.name or "_".join(idef.columns),
             columns=idef.columns, unique=idef.unique))
+    info.max_index_id = idx_id
     for idx in info.indexes:
         for cn in idx.columns:
             if info.col_by_name(cn) is None:

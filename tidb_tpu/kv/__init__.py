@@ -67,6 +67,13 @@ class RetryableError(KVError):
     """Base for errors the client may retry after backoff."""
 
 
+class SchemaChangedError(RetryableError):
+    """The schema a txn planned against changed before its commit ts
+    (ref: domain/schema_validator.go:35 + 2pc.go:653 checkSchemaValid).
+    Retryable: the session replays the statement history against the
+    fresh schema."""
+
+
 @dataclass
 class LockInfo:
     primary: bytes

@@ -141,3 +141,69 @@ class Meta:
                                          _table_prefix(db_id + 1)):
             out.append(TableInfo.loads(v))
         return out
+
+    # -- DDL job queue (ref: meta.go:443-457 EnQueue/DeQueue/history) --------
+
+    JOB_SEQ_KEY = b"m_ddlJobSeq"
+
+    @staticmethod
+    def _job_key(seq: int) -> bytes:
+        return b"m_ddlJobQ/%020d" % seq
+
+    def enqueue_job(self, job) -> None:
+        seq = self._bump(self.JOB_SEQ_KEY)
+        job.seq = seq
+        self.txn.set(self._job_key(seq), job.dumps())
+
+    def first_job(self):
+        from tidb_tpu.ddl.job import Job
+        for _k, v in self.txn.iter_range(b"m_ddlJobQ/", b"m_ddlJobQ0"):
+            return Job.loads(v)
+        return None
+
+    def update_job(self, job) -> None:
+        self.txn.set(self._job_key(job.seq), job.dumps())
+
+    def finish_job(self, job) -> None:
+        """Move from queue to history (ref: job to history queue)."""
+        self.txn.delete(self._job_key(job.seq))
+        self.txn.set(b"m_ddlHist/%020d" % job.id, job.dumps())
+
+    def history_job(self, job_id: int):
+        from tidb_tpu.ddl.job import Job
+        raw = self.txn.get(b"m_ddlHist/%020d" % job_id)
+        return Job.loads(raw) if raw else None
+
+    # -- schema diffs (ref: model.SchemaDiff; consumed by the schema
+    # validator and incremental infoschema reload) ---------------------------
+
+    def set_schema_diff(self, version: int, table_ids: list[int]) -> None:
+        self.txn.set(b"m_schemaDiff/%020d" % version,
+                     json.dumps(table_ids).encode())
+
+    def schema_diff(self, version: int) -> list[int] | None:
+        raw = self.txn.get(b"m_schemaDiff/%020d" % version)
+        return json.loads(raw) if raw else None
+
+    # -- delete-range queue (ref: ddl/delete_range.go:51 inserts into
+    # mysql.gc_delete_range; drained by the GC worker) -----------------------
+
+    DR_SEQ_KEY = b"m_drSeq"
+
+    def add_delete_range(self, job_id: int, start: bytes, end: bytes) -> None:
+        seq = self._bump(self.DR_SEQ_KEY)
+        rec = json.dumps({"job": job_id, "start": start.hex(),
+                          "end": end.hex()}).encode()
+        self.txn.set(b"m_deleteRange/%020d" % seq, rec)
+
+    def pending_delete_ranges(self) -> list[tuple[bytes, int, bytes, bytes]]:
+        """-> [(queue_key, job_id, start, end)]"""
+        out = []
+        for k, v in self.txn.iter_range(b"m_deleteRange/", b"m_deleteRange0"):
+            o = json.loads(v)
+            out.append((k, o["job"], bytes.fromhex(o["start"]),
+                        bytes.fromhex(o["end"])))
+        return out
+
+    def remove_delete_range(self, queue_key: bytes) -> None:
+        self.txn.delete(queue_key)

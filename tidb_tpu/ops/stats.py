@@ -14,18 +14,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-_sort_cache: dict = {}
-
-
-def _sort_fn(dtype):
-    fn = _sort_cache.get(dtype)
-    if fn is None:
-        fn = jax.jit(jnp.sort)
-        _sort_cache[dtype] = fn
-    return fn
+_jit_sort = jax.jit(jnp.sort)   # jit caches one executable per dtype/shape
 
 
 def device_sort(data: np.ndarray) -> np.ndarray:
     """Sort a numeric column on the default device; returns numpy."""
-    out = _sort_fn(data.dtype)(data)
-    return np.asarray(out)
+    return np.asarray(_jit_sort(data))

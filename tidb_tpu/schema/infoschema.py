@@ -7,7 +7,10 @@ statement/txn.
 
 from __future__ import annotations
 
-from tidb_tpu.meta import Meta
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:   # avoid meta <-> schema circular import at runtime
+    from tidb_tpu.meta import Meta
 from tidb_tpu.schema.model import DBInfo, TableInfo
 
 __all__ = ["InfoSchema", "SchemaError"]

@@ -98,6 +98,23 @@ class TableInfo:
     auto_inc_id: int = 0           # next auto-increment base (meta-managed)
     state: SchemaState = SchemaState.PUBLIC
     comment: str = ""
+    # Monotonic id allocators (ref: model.TableInfo MaxColumnID/MaxIndexID):
+    # ids are never reused, so data of dropped columns/indexes awaiting GC
+    # can never alias a new object's.
+    max_column_id: int = 0
+    max_index_id: int = 0
+
+    def alloc_column_id(self) -> int:
+        self.max_column_id = max(self.max_column_id,
+                                 max((c.id for c in self.columns),
+                                     default=0)) + 1
+        return self.max_column_id
+
+    def alloc_index_id(self) -> int:
+        self.max_index_id = max(self.max_index_id,
+                                max((i.id for i in self.indexes),
+                                    default=0)) + 1
+        return self.max_index_id
 
     def col_by_name(self, name: str) -> Optional[ColumnInfo]:
         lname = name.lower()
@@ -140,6 +157,8 @@ class TableInfo:
             "pk_is_handle": self.pk_is_handle,
             "pk_col_name": self.pk_col_name,
             "state": int(self.state), "comment": self.comment,
+            "max_column_id": self.max_column_id,
+            "max_index_id": self.max_index_id,
         }
 
     @staticmethod
@@ -152,6 +171,8 @@ class TableInfo:
             pk_col_name=d.get("pk_col_name", ""),
             state=SchemaState(d.get("state", SchemaState.PUBLIC)),
             comment=d.get("comment", ""),
+            max_column_id=d.get("max_column_id", 0),
+            max_index_id=d.get("max_index_id", 0),
         )
 
     def dumps(self) -> bytes:

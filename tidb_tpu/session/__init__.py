@@ -84,6 +84,26 @@ class Domain:
         finally:
             txn.rollback()
 
+    def check_schema_valid(self, start_ver: int, table_ids) -> None:
+        """Commit-time schema validation (ref: domain/schema_validator.go:
+        35-47): a txn that planned against schema version `start_ver` may
+        commit iff no later version changed a table it wrote. Versions with
+        no diff record are treated as changing everything."""
+        txn = self.storage.begin()
+        try:
+            m = Meta(txn)
+            cur = m.schema_version()
+            if cur == start_ver:
+                return
+            for v in range(start_ver + 1, cur + 1):
+                diff = m.schema_diff(v)
+                if diff is None or any(t in table_ids for t in diff):
+                    raise kv.SchemaChangedError(
+                        f"schema changed (v{start_ver} -> v{cur}), "
+                        f"txn must retry")
+        finally:
+            txn.rollback()
+
 
 class Session:
     """Ref: session.go Session iface (:62-86)."""
@@ -124,10 +144,16 @@ class Session:
 
     # -- txn lifecycle -------------------------------------------------------
 
+    def _attach_schema_checker(self, txn) -> None:
+        start_ver = self.domain.info_schema().version
+        txn.schema_checker = lambda: self.domain.check_schema_valid(
+            start_ver, txn.related_tables)
+
     def _begin_txn(self):
         if self.txn is None:
             self.txn = self.storage.begin()
             self._history = []
+            self._attach_schema_checker(self.txn)
         return self.txn
 
     def _read_ts(self) -> int:
@@ -154,6 +180,7 @@ class Session:
             last = first_err
             for _ in range(COMMIT_RETRY_LIMIT):
                 retry_txn = self.storage.begin()
+                self._attach_schema_checker(retry_txn)
                 try:
                     self.txn = retry_txn
                     for stmt in history:
@@ -193,7 +220,11 @@ class Session:
             if self.txn is not None:
                 self._commit()  # implicit commit before DDL (MySQL semantics)
             dropped = self._dropped_table_ids(stmt)
-            DDLExecutor(self.storage).execute(stmt, self.current_db)
+            from tidb_tpu.ddl import DDLError
+            try:
+                DDLExecutor(self.storage).execute(stmt, self.current_db)
+            except DDLError as e:
+                raise SQLError(str(e)) from None
             for tid in dropped:
                 self.domain.stats_handle().drop(tid)
             return None
@@ -301,6 +332,9 @@ class Session:
             plan = self._planner().plan(stmt)
         except (PlanError, ResolveError) as e:
             raise SQLError(str(e)) from None
+        tinfo = getattr(plan, "table", None)
+        if tinfo is not None:   # schema validation scope (written tables)
+            self.txn.related_tables.add(tinfo.id)
         ctx = ExecContext(self.storage, self.txn.start_ts, self.txn)
         exe = build_executor(plan)
         return exe.execute(ctx)

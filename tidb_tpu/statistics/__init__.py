@@ -510,22 +510,23 @@ def analyze_table(storage, read_ts: int, info: TableInfo,
 
     ts = TableStats(table_id=info.id, version=read_ts, count=total,
                     pseudo=False)
+    from tidb_tpu.chunk import Column
     for ci, cinfo in enumerate(cols):
-        merged_vals: dict = {}
-        nulls = 0
-        for ch in parts:
-            vals, counts, nc = _distinct_sorted(ch.columns[ci])
-            nulls += nc
-            for v, c in zip(vals, counts):
-                key = v.item() if hasattr(v, "item") else v
-                merged_vals[key] = merged_vals.get(key, 0) + int(c)
-        keys = sorted(merged_vals)
-        counts = np.array([merged_vals[k] for k in keys], np.int64) if keys \
-            else np.empty(0, np.int64)
+        # concatenate once, one whole-column sort (device for big numerics)
+        if parts:
+            whole = Column(
+                cinfo.ft,
+                np.concatenate([ch.columns[ci].data for ch in parts]),
+                np.concatenate([np.asarray(ch.columns[ci].valid)
+                                for ch in parts]))
+        else:
+            whole = Column.empty(cinfo.ft)
+        vals, counts, nulls = _distinct_sorted(whole)
+        keys = [v.item() if hasattr(v, "item") else v for v in vals]
         hist = build_histogram(keys, counts, n_buckets, null_count=nulls)
         cms = CMSketch()
-        for k in keys:
-            cms.insert(_cm_key(k), int(merged_vals[k]))
+        for k, c in zip(keys, counts):
+            cms.insert(_cm_key(k), int(c))
         ts.columns[cinfo.id] = ColumnStats(hist, cms)
 
     # index stats over encoded keys (sampled above MAX_SAMPLE rows)

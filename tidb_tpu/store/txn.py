@@ -229,7 +229,8 @@ class TwoPhaseCommitter:
     def __init__(self, shim: RPCShim, cache: RegionCache, oracle,
                  resolver: LockResolver, mutations: dict[bytes, Mutation],
                  start_ts: int, concurrency: int = 8,
-                 async_secondaries: bool = True):
+                 async_secondaries: bool = True, schema_checker=None):
+        self.schema_checker = schema_checker
         self.shim = shim
         self.cache = cache
         self.oracle = oracle
@@ -369,6 +370,14 @@ class TwoPhaseCommitter:
             self._cleanup_async()
             raise
         self.commit_ts = self.oracle.get_timestamp()
+        if self.schema_checker is not None:
+            # revalidate the schema lease between prewrite and the point of
+            # no return (ref: 2pc.go:653 checkSchemaValid)
+            try:
+                self.schema_checker()
+            except Exception:
+                self._cleanup_async()
+                raise
         cbo = Backoffer(COMMIT_MAX_BACKOFF)
         try:
             self._on_batches(cbo, [self.primary], self._commit_batch,
@@ -423,6 +432,10 @@ class KVTxn(kv.Transaction):
         self.us = kv.UnionStore(self.snapshot)
         self.valid = True
         self.committed = False
+        # schema-lease check hook, set by the session (ref: kv.Options
+        # SchemaLeaseChecker, kv/kv.go:38; checked at 2pc.go:653)
+        self.schema_checker = None
+        self.related_tables: set[int] = set()
 
     def get(self, key: bytes) -> Optional[bytes]:
         return self.us.get(key)
@@ -460,7 +473,8 @@ class KVTxn(kv.Transaction):
         committer = TwoPhaseCommitter(
             self.storage.shim, self.storage.region_cache, self.storage.oracle,
             self.storage.resolver, muts, self.start_ts,
-            async_secondaries=self.storage.async_commit_secondaries)
+            async_secondaries=self.storage.async_commit_secondaries,
+            schema_checker=self.schema_checker)
         try:
             committer.execute()
             self.committed = True
