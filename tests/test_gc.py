@@ -1,0 +1,139 @@
+"""GC worker / safepoint tests.
+
+Ref model: store/tikv/gcworker tests + safepoint checks — safepoint
+computation, expired-lock resolution, delete-range drain after DDL,
+version pruning, read rejection below the safepoint.
+
+gc_life_time is 0 throughout so the safepoint lands at "now"; a short
+sleep puts earlier writes strictly below it (timestamps are hybrid
+physical-ms << 18).
+"""
+
+import time
+
+import pytest
+
+from tidb_tpu import kv
+from tidb_tpu.meta import Meta
+from tidb_tpu.session import Session
+from tidb_tpu.store import new_mock_storage
+from tidb_tpu.store.gcworker import GCWorker
+from tidb_tpu.store.oracle import compose_ts, physical_ms
+
+
+@pytest.fixture
+def env():
+    storage = new_mock_storage()
+    storage.async_commit_secondaries = False
+    s = Session(storage)
+    s.execute("CREATE DATABASE test; USE test")
+    yield storage, s
+    s.close()
+    storage.close()
+
+
+def _gc(storage) -> dict:
+    time.sleep(0.02)    # move the ms clock past every prior commit
+    return GCWorker(storage, gc_life_time_ms=0).run_once()
+
+
+class TestSafepoint:
+    def test_advances_and_persists(self, env):
+        storage, _s = env
+        w = GCWorker(storage, gc_life_time_ms=0)
+        time.sleep(0.02)
+        stats = w.run_once()
+        assert stats["leader"] and stats["advanced"]
+        assert 0 < stats["safepoint"] <= storage.current_ts()
+        assert w.saved_safepoint() == stats["safepoint"]
+        assert storage.safepoint == stats["safepoint"]
+        # same tick again: safepoint can only move forward
+        again = w.run_once(now_ts=stats["safepoint"])
+        assert not again["advanced"]
+
+    def test_reads_below_safepoint_rejected(self, env):
+        storage, s = env
+        s.execute("CREATE TABLE t (a BIGINT PRIMARY KEY)")
+        s.execute("INSERT INTO t VALUES (1)")
+        old_ts = storage.current_ts()
+        stats = _gc(storage)
+        assert stats["advanced"] and storage.safepoint > old_ts
+        snap = storage.snapshot(old_ts)
+        with pytest.raises(kv.GCTooEarlyError):
+            snap.get(b"anything")
+        # fresh reads fine
+        assert s.query("SELECT * FROM t").rows == [(1,)]
+
+    def test_second_worker_not_leader(self, env):
+        storage, _s = env
+        w1 = GCWorker(storage, gc_life_time_ms=0)
+        time.sleep(0.02)
+        assert w1.run_once()["leader"]
+        w2 = GCWorker(storage, gc_life_time_ms=0)
+        assert w2.run_once() == {"leader": False}
+
+
+class TestPruning:
+    def test_old_versions_pruned(self, env):
+        storage, s = env
+        s.execute("CREATE TABLE t (a BIGINT PRIMARY KEY, b INT)")
+        s.execute("INSERT INTO t VALUES (1, 0)")
+        for i in range(1, 6):
+            s.execute(f"UPDATE t SET b = {i} WHERE a = 1")
+        stats = _gc(storage)
+        assert stats["pruned"] >= 5     # five superseded row versions
+        assert s.query("SELECT b FROM t").rows == [(5,)]
+
+    def test_delete_range_drained(self, env):
+        storage, s = env
+        s.execute("CREATE TABLE t (a BIGINT PRIMARY KEY, b INT, KEY kb (b))")
+        s.execute("INSERT INTO t VALUES " +
+                  ",".join(f"({i}, {i})" for i in range(50)))
+        keys_before = storage.engine.num_keys()
+        s.execute("DROP TABLE t")
+        txn = storage.begin()
+        try:
+            assert len(Meta(txn).pending_delete_ranges()) == 1
+        finally:
+            txn.rollback()
+        stats = _gc(storage)
+        assert stats["delete_ranges"] == 1
+        txn = storage.begin()
+        try:
+            assert Meta(txn).pending_delete_ranges() == []
+        finally:
+            txn.rollback()
+        assert storage.engine.num_keys() < keys_before
+
+    def test_drop_index_range_drained(self, env):
+        storage, s = env
+        s.execute("CREATE TABLE t (a BIGINT PRIMARY KEY, b INT, KEY kb (b))")
+        s.execute("INSERT INTO t VALUES " +
+                  ",".join(f"({i}, {i})" for i in range(50)))
+        s.execute("DROP INDEX kb ON t")
+        stats = _gc(storage)
+        assert stats["delete_ranges"] == 1
+        # table data intact
+        assert len(s.query("SELECT * FROM t").rows) == 50
+
+
+class TestLockResolution:
+    def test_stale_lock_resolved(self, env):
+        storage, s = env
+        # dead writer: prewrite an hour-old txn, never commit
+        old_ts = compose_ts(physical_ms(storage.current_ts()) - 3_600_000)
+        txn = storage.begin(start_ts=old_ts)
+        txn.set(b"zz_orphan", b"v")
+        muts = txn.mutations()
+        from tidb_tpu.store.backoff import Backoffer
+        from tidb_tpu.store.txn import TwoPhaseCommitter
+        c = TwoPhaseCommitter(storage.shim, storage.region_cache,
+                              storage.oracle, storage.resolver, muts,
+                              old_ts, async_secondaries=False)
+        c._on_batches(Backoffer(5000), list(muts.keys()),
+                      c._prewrite_batch, primary_first=False)
+        stats = _gc(storage)
+        assert stats["resolved_locks"] >= 1
+        # the key is readable again (rolled back -> absent)
+        snap = storage.snapshot(storage.current_ts())
+        assert snap.get(b"zz_orphan") is None

@@ -225,9 +225,14 @@ class DDLWorker:
 
     def _step_rename_table(self, m: Meta, job: Job) -> bool:
         info = self._table(m, job)
+        new_name = job.args["new_name"]
+        new_db = job.args["new_schema_id"]
+        for t in m.list_tables(new_db):
+            if t.id != info.id and t.name.lower() == new_name.lower():
+                raise kv.KVError(f"table '{new_name}' exists")
         m.drop_table(job.schema_id, info.id)
-        info.name = job.args["new_name"]
-        m.create_table(job.args["new_schema_id"], info)
+        info.name = new_name
+        m.create_table(new_db, info)
         job.state = JobState.DONE
         return True
 

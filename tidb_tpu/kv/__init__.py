@@ -67,6 +67,11 @@ class RetryableError(KVError):
     """Base for errors the client may retry after backoff."""
 
 
+class GCTooEarlyError(KVError):
+    """Read snapshot is older than the GC safepoint (ref: safepoint.go;
+    ErrGCTooEarly) — its MVCC versions may already be pruned."""
+
+
 class SchemaChangedError(RetryableError):
     """The schema a txn planned against changed before its commit ts
     (ref: domain/schema_validator.go:35 + 2pc.go:653 checkSchemaValid).

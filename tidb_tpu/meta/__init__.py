@@ -192,17 +192,22 @@ class Meta:
 
     def add_delete_range(self, job_id: int, start: bytes, end: bytes) -> None:
         seq = self._bump(self.DR_SEQ_KEY)
+        # stamped with this txn's start ts: GC may only physically delete
+        # once the safepoint passes it (ref: gc_delete_range.ts column) —
+        # snapshots older than the drop can still read the data
         rec = json.dumps({"job": job_id, "start": start.hex(),
-                          "end": end.hex()}).encode()
+                          "end": end.hex(),
+                          "ts": self.txn.start_ts}).encode()
         self.txn.set(b"m_deleteRange/%020d" % seq, rec)
 
-    def pending_delete_ranges(self) -> list[tuple[bytes, int, bytes, bytes]]:
-        """-> [(queue_key, job_id, start, end)]"""
+    def pending_delete_ranges(self
+                              ) -> list[tuple[bytes, int, bytes, bytes, int]]:
+        """-> [(queue_key, job_id, start, end, ts)]"""
         out = []
         for k, v in self.txn.iter_range(b"m_deleteRange/", b"m_deleteRange0"):
             o = json.loads(v)
             out.append((k, o["job"], bytes.fromhex(o["start"]),
-                        bytes.fromhex(o["end"])))
+                        bytes.fromhex(o["end"]), o.get("ts", 0)))
         return out
 
     def remove_delete_range(self, queue_key: bytes) -> None:

@@ -271,12 +271,15 @@ class MVCCStore:
                                                inclusive=(True, False))):
                 del self._entries[k]
 
-    def gc(self, safepoint_ts: int) -> int:
-        """Drop versions no snapshot >= safepoint can see. Returns #pruned.
+    def gc(self, safepoint_ts: int, start: bytes = b"",
+           end: bytes = b"") -> int:
+        """Drop versions no snapshot >= safepoint can see, within
+        [start, end) (b"" = unbounded). Returns #pruned.
         Ref: gcworker/gc_worker.go doGC."""
         pruned = 0
         with self._mu:
-            for k in list(self._entries):
+            for k in list(self._entries.irange(start, end or None,
+                                               inclusive=(True, False))):
                 e = self._entries[k]
                 keep = []
                 seen_visible = False

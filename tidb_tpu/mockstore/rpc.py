@@ -126,8 +126,8 @@ class RPCShim:
                                 min(end, r.end) if r.end else end)
 
     def kv_gc(self, ctx: RegionCtx, safepoint: int):
-        self._check("GC", ctx)
-        return self.store.gc(safepoint)
+        r = self._check("GC", ctx)
+        return self.store.gc(safepoint, r.start, r.end)
 
     def split_region(self, ctx: RegionCtx, key: bytes):
         self._check("SplitRegion", ctx)

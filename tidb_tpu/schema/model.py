@@ -134,14 +134,18 @@ class TableInfo:
         return [c for c in self.columns if c.state == SchemaState.PUBLIC]
 
     def writable_columns(self) -> list[ColumnInfo]:
-        """Columns DML must fill (WRITE_ONLY+ states).
-        Ref: table/table.go:89 WritableCols."""
+        """Columns DML must fill (WRITE_ONLY and up — but NOT DELETE_REORG,
+        which sorts above WRITE_ONLY in the enum yet means the column is on
+        its way out). Ref: table/table.go:89 WritableCols excludes both
+        DeleteOnly and DeleteReorganization."""
         return [c for c in self.columns
-                if c.state >= SchemaState.WRITE_ONLY]
+                if c.state >= SchemaState.WRITE_ONLY
+                and c.state != SchemaState.DELETE_REORG]
 
     def writable_indexes(self) -> list[IndexInfo]:
         return [i for i in self.indexes
-                if i.state >= SchemaState.WRITE_ONLY]
+                if i.state >= SchemaState.WRITE_ONLY
+                and i.state != SchemaState.DELETE_REORG]
 
     def deletable_indexes(self) -> list[IndexInfo]:
         """Indexes that must see deletions (DELETE_ONLY+).

@@ -332,9 +332,11 @@ class Session:
             plan = self._planner().plan(stmt)
         except (PlanError, ResolveError) as e:
             raise SQLError(str(e)) from None
-        tinfo = getattr(plan, "table", None)
-        if tinfo is not None:   # schema validation scope (written tables)
-            self.txn.related_tables.add(tinfo.id)
+        from tidb_tpu.plan import physical as _ph
+        if isinstance(plan, (_ph.PhysInsert, _ph.PhysUpdate,
+                             _ph.PhysDelete)):
+            # schema validation scope: tables this txn WRITES
+            self.txn.related_tables.add(plan.table.id)
         ctx = ExecContext(self.storage, self.txn.start_ts, self.txn)
         exe = build_executor(plan)
         return exe.execute(ctx)

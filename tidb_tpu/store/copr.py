@@ -132,6 +132,7 @@ class CopClient(kv.Client):
 
     def send(self, req: CopRequest):
         """Yields CopResponses; unordered unless req.keep_order."""
+        self.storage.check_visibility(req.start_ts)
         tasks = self.cache.split_ranges_by_region(req.ranges)
         if not tasks:
             return

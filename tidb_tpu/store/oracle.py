@@ -13,7 +13,16 @@ import threading
 import time
 from concurrent.futures import Future, ThreadPoolExecutor
 
-__all__ = ["Oracle", "PDOracle", "LocalOracle"]
+__all__ = ["Oracle", "PDOracle", "LocalOracle", "physical_ms", "compose_ts"]
+
+
+def physical_ms(ts: int) -> int:
+    """Physical milliseconds of a hybrid timestamp."""
+    return ts >> 18
+
+
+def compose_ts(ms: int, logical: int = 0) -> int:
+    return (ms << 18) | logical
 
 
 class Oracle:

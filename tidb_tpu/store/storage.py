@@ -29,13 +29,25 @@ class MockStorage(kv.Storage):
         self.resolver = LockResolver(self.shim, self.region_cache, self.oracle)
         self.async_commit_secondaries = True
         self._client = None
+        self.safepoint = 0   # GC safepoint (ref: safepoint.go watcher)
 
     def begin(self, start_ts: int | None = None) -> KVTxn:
         return KVTxn(self, start_ts if start_ts is not None
                      else self.oracle.get_timestamp())
 
     def snapshot(self, ts: int) -> TxnSnapshot:
-        return TxnSnapshot(self.shim, self.region_cache, self.resolver, ts)
+        return TxnSnapshot(self.shim, self.region_cache, self.resolver, ts,
+                           storage=self)
+
+    def update_safepoint(self, sp: int) -> None:
+        self.safepoint = max(self.safepoint, sp)
+
+    def check_visibility(self, ts: int) -> None:
+        """Reject snapshots the GC may already have pruned under
+        (ref: tikvStore.CheckVisibility)."""
+        if ts < self.safepoint:
+            raise kv.GCTooEarlyError(
+                f"snapshot ts {ts} is below GC safepoint {self.safepoint}")
 
     def current_ts(self) -> int:
         return self.oracle.get_timestamp()

@@ -111,17 +111,21 @@ class TxnSnapshot(kv.Snapshot):
     Ref: snapshot.go tikvSnapshot."""
 
     def __init__(self, shim: RPCShim, cache: RegionCache, resolver: LockResolver,
-                 ts: int, isolation: IsolationLevel = IsolationLevel.SI):
+                 ts: int, isolation: IsolationLevel = IsolationLevel.SI,
+                 storage=None):
         self.shim = shim
         self.cache = cache
         self.resolver = resolver
         self.ts = ts
         self.isolation = isolation
+        self.storage = storage
 
     # -- retry wrapper -------------------------------------------------------
 
     def _with_retry(self, bo: Backoffer, key_for_route: bytes, fn):
         """fn(loc) with region-error and lock handling."""
+        if self.storage is not None:
+            self.storage.check_visibility(self.ts)
         while True:
             loc = self.cache.locate(key_for_route)
             try:
