@@ -1,0 +1,129 @@
+"""Minimal MySQL text-protocol client for server tests.
+
+Implements just enough of the client half of the wire protocol (handshake
+response 41, COM_QUERY, text resultset decoding) to exercise
+tidb_tpu.server hermetically — no external driver dependency.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+
+from tidb_tpu.server.packet import (PacketIO, read_lenenc_bytes,
+                                    read_lenenc_int)
+
+CLIENT_PROTOCOL_41 = 0x200
+CLIENT_SECURE_CONNECTION = 0x8000
+CLIENT_CONNECT_WITH_DB = 8
+CLIENT_PLUGIN_AUTH = 0x80000
+
+
+class MySQLError(Exception):
+    def __init__(self, code: int, msg: str):
+        super().__init__(f"({code}) {msg}")
+        self.code = code
+
+
+class MiniClient:
+    def __init__(self, host: str, port: int, db: str = "",
+                 user: str = "root"):
+        self.sock = socket.create_connection((host, port), timeout=10)
+        self.pkt = PacketIO(self.sock)
+        self._handshake(user, db)
+
+    def _handshake(self, user: str, db: str) -> None:
+        greeting = self.pkt.read_packet()
+        assert greeting[0] == 10, "expected protocol v10"
+        caps = CLIENT_PROTOCOL_41 | CLIENT_SECURE_CONNECTION \
+            | CLIENT_PLUGIN_AUTH
+        if db:
+            caps |= CLIENT_CONNECT_WITH_DB
+        resp = struct.pack("<I", caps)
+        resp += struct.pack("<I", 1 << 24)
+        resp += bytes([33]) + b"\0" * 23
+        resp += user.encode() + b"\0"
+        resp += bytes([0])                       # empty auth response
+        if db:
+            resp += db.encode() + b"\0"
+        resp += b"mysql_native_password\0"
+        self.pkt.write_packet(resp)
+        ok = self.pkt.read_packet()
+        if ok and ok[0] == 0xFF:
+            raise self._err(ok)
+
+    @staticmethod
+    def _err(pkt: bytes) -> MySQLError:
+        code = struct.unpack_from("<H", pkt, 1)[0]
+        return MySQLError(code, pkt[9:].decode("utf8", "replace"))
+
+    def _command(self, cmd: int, data: bytes) -> bytes:
+        self.pkt.reset_seq()
+        self.pkt.write_packet(bytes([cmd]) + data)
+        return self.pkt.read_packet()
+
+    def ping(self) -> None:
+        first = self._command(0x0E, b"")
+        if first[0] == 0xFF:
+            raise self._err(first)
+
+    def use(self, db: str) -> None:
+        first = self._command(0x02, db.encode())
+        if first[0] == 0xFF:
+            raise self._err(first)
+
+    def query(self, sql: str):
+        """-> (columns, rows) for resultsets, affected-rows int for OK."""
+        first = self._command(0x03, sql.encode())
+        if first[0] == 0xFF:
+            raise self._err(first)
+        if first[0] == 0x00:
+            affected, _ = read_lenenc_int(first, 1)
+            return affected
+        ncols, _ = read_lenenc_int(first, 0)
+        cols = []
+        for _ in range(ncols):
+            cols.append(self._parse_coldef(self.pkt.read_packet()))
+        eof = self.pkt.read_packet()
+        assert eof[0] == 0xFE
+        rows = []
+        while True:
+            pkt = self.pkt.read_packet()
+            if pkt[0] == 0xFE and len(pkt) < 9:
+                break
+            if pkt[0] == 0xFF:
+                raise self._err(pkt)
+            rows.append(self._parse_row(pkt, ncols))
+        return [c for c, _t in cols], rows
+
+    @staticmethod
+    def _parse_coldef(pkt: bytes) -> tuple[str, int]:
+        off = 0
+        for _ in range(4):                       # catalog schema table org
+            _v, off = read_lenenc_bytes(pkt, off)
+        name, off = read_lenenc_bytes(pkt, off)
+        _org, off = read_lenenc_bytes(pkt, off)
+        off += 1 + 2 + 4                         # 0x0c, charset, length
+        tp = pkt[off]
+        return name.decode(), tp
+
+    @staticmethod
+    def _parse_row(pkt: bytes, ncols: int) -> tuple:
+        out = []
+        off = 0
+        for _ in range(ncols):
+            if pkt[off] == 0xFB:
+                out.append(None)
+                off += 1
+            else:
+                v, off = read_lenenc_bytes(pkt, off)
+                out.append(v.decode())
+        return tuple(out)
+
+    def close(self) -> None:
+        try:
+            self.pkt.reset_seq()
+            self.pkt.write_packet(b"\x01")       # COM_QUIT
+        except OSError:
+            pass
+        self.sock.close()

@@ -284,7 +284,8 @@ class DDL:
                        args={"old_name": old_name,
                              "column": new.to_json()})
         if spec.tp == "rename":
-            if self._find_table(meta, db.id, spec.name) is not None:
+            existing = self._find_table(meta, db.id, spec.name)
+            if existing is not None and existing.id != t.id:
                 raise DDLError(f"table '{spec.name}' exists")
             return Job(tp=JobType.RENAME_TABLE, schema_id=db.id,
                        table_id=t.id,

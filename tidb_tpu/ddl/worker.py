@@ -110,9 +110,23 @@ class DDLWorker:
         else:
             m.update_job(job)
         txn.commit()
+        if job.finished:
+            self._seal_delete_ranges(job)
         if self.on_state_change is not None:
             self.on_state_change(job)
         return job
+
+    def _seal_delete_ranges(self, job: Job) -> None:
+        """Stamp the job's queued ranges with a ts acquired AFTER its final
+        txn committed — an upper bound on the drop's commit ts, so GC can
+        safely order the physical delete against the safepoint."""
+        txn = self.storage.begin()
+        try:
+            Meta(txn).seal_delete_ranges(job.id, txn.start_ts)
+            txn.commit()
+        except Exception:
+            if txn.valid:
+                txn.rollback()
 
     def _reload_head(self, job: Job) -> Job:
         txn = self.storage.begin()

@@ -192,13 +192,22 @@ class Meta:
 
     def add_delete_range(self, job_id: int, start: bytes, end: bytes) -> None:
         seq = self._bump(self.DR_SEQ_KEY)
-        # stamped with this txn's start ts: GC may only physically delete
-        # once the safepoint passes it (ref: gc_delete_range.ts column) —
-        # snapshots older than the drop can still read the data
+        # ts stays 0 until the job's txn COMMITS; the worker then seals the
+        # range with a fresh timestamp (>= the drop's commit ts). GC only
+        # drains sealed ranges whose seal ts <= safepoint, so snapshots
+        # that still see the pre-drop schema can still read the data
+        # (ref: gc_delete_range.ts, written after the job finishes)
         rec = json.dumps({"job": job_id, "start": start.hex(),
-                          "end": end.hex(),
-                          "ts": self.txn.start_ts}).encode()
+                          "end": end.hex(), "ts": 0}).encode()
         self.txn.set(b"m_deleteRange/%020d" % seq, rec)
+
+    def seal_delete_ranges(self, job_id: int, ts: int) -> None:
+        """Stamp a finished job's ranges as deletable once safepoint > ts."""
+        for k, v in self.txn.iter_range(b"m_deleteRange/", b"m_deleteRange0"):
+            o = json.loads(v)
+            if o["job"] == job_id and not o["ts"]:
+                o["ts"] = ts
+                self.txn.set(k, json.dumps(o).encode())
 
     def pending_delete_ranges(self
                               ) -> list[tuple[bytes, int, bytes, bytes, int]]:

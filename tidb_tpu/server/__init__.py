@@ -1,0 +1,314 @@
+"""MySQL wire protocol server.
+
+Reference: /root/reference/server/ — accept loop + connection tokens
+(server.go:234-295), handshake/auth + command dispatch (conn.go:401-610),
+textual resultset writer (conn.go:932 writeChunks), error packets.
+
+The compute path stays unchanged: each connection owns a Session over the
+shared storage; this layer only speaks the protocol. Auth accepts any
+credentials until the privilege subsystem lands (the reference checks
+mysql.user via privilege/privileges)."""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from decimal import Decimal
+
+from tidb_tpu.server.packet import (PacketIO, lenenc_bytes, lenenc_int,
+                                    lenenc_str, read_lenenc_bytes,
+                                    read_nullterm)
+from tidb_tpu.session import ResultSet, Session, SQLError
+from tidb_tpu.sqltypes import EvalType, TypeCode
+
+__all__ = ["Server"]
+
+SERVER_VERSION = "8.0.11-tidb-tpu-1.0"
+PROTOCOL_VERSION = 10
+CHARSET_UTF8MB4 = 33
+
+# capability bits (mysql/const.go)
+CLIENT_LONG_PASSWORD = 1
+CLIENT_FOUND_ROWS = 2
+CLIENT_LONG_FLAG = 4
+CLIENT_CONNECT_WITH_DB = 8
+CLIENT_PROTOCOL_41 = 0x200
+CLIENT_TRANSACTIONS = 0x2000
+CLIENT_SECURE_CONNECTION = 0x8000
+CLIENT_MULTI_STATEMENTS = 0x10000
+CLIENT_PLUGIN_AUTH = 0x80000
+CLIENT_PLUGIN_AUTH_LENENC = 0x200000
+
+SERVER_CAPS = (CLIENT_LONG_PASSWORD | CLIENT_FOUND_ROWS | CLIENT_LONG_FLAG
+               | CLIENT_CONNECT_WITH_DB | CLIENT_PROTOCOL_41
+               | CLIENT_TRANSACTIONS | CLIENT_SECURE_CONNECTION
+               | CLIENT_MULTI_STATEMENTS | CLIENT_PLUGIN_AUTH)
+
+SERVER_STATUS_AUTOCOMMIT = 0x0002
+
+# commands (mysql/const.go ComXxx)
+COM_QUIT = 0x01
+COM_INIT_DB = 0x02
+COM_QUERY = 0x03
+COM_FIELD_LIST = 0x04
+COM_PING = 0x0E
+
+ER_UNKNOWN = 1105
+
+
+class Server:
+    """Accept loop with a connection-token limiter (ref: server.go:234)."""
+
+    def __init__(self, storage, host: str = "127.0.0.1", port: int = 0,
+                 token_limit: int = 1000):
+        self.storage = storage
+        self._listener = socket.create_server((host, port))
+        self.addr = self._listener.getsockname()
+        self._tokens = threading.Semaphore(token_limit)
+        self._closing = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._conn_id = 0
+        self._conns: set = set()
+        self._mu = threading.Lock()
+
+    @property
+    def port(self) -> int:
+        return self.addr[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="mysql-accept")
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._closing.is_set():
+            try:
+                sock, _peer = self._listener.accept()
+            except OSError:
+                return   # listener closed
+            # token acquired in the ACCEPT loop so thread/socket count is
+            # actually bounded (ref: server.go:295 getToken before onConn)
+            self._tokens.acquire()
+            with self._mu:
+                self._conn_id += 1
+                cid = self._conn_id
+            t = threading.Thread(target=self._serve_conn, args=(sock, cid),
+                                 daemon=True, name=f"mysql-conn-{cid}")
+            t.start()
+
+    def _serve_conn(self, sock: socket.socket, conn_id: int) -> None:
+        conn = ClientConn(self, sock, conn_id)
+        with self._mu:
+            self._conns.add(conn)
+        try:
+            conn.run()
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            with self._mu:
+                self._conns.discard(conn)
+            conn.close()
+            self._tokens.release()
+
+    def close(self) -> None:
+        self._closing.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._mu:
+            conns = list(self._conns)
+        for c in conns:
+            # only unblock the socket; the connection thread owns the
+            # session and cleans it up in its finally block
+            c.shutdown()
+
+
+class ClientConn:
+    """One connection: handshake, then dispatch loop (ref: conn.go:401)."""
+
+    def __init__(self, server: Server, sock: socket.socket, conn_id: int):
+        self.server = server
+        self.sock = sock
+        self.pkt = PacketIO(sock)
+        self.conn_id = conn_id
+        self.session: Session | None = None
+        self.capabilities = 0
+        self._close_mu = threading.Lock()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def run(self) -> None:
+        self._handshake()
+        self.session = Session(self.server.storage)
+        while True:
+            self.pkt.reset_seq()
+            try:
+                payload = self.pkt.read_packet()
+            except ConnectionError:
+                return
+            if not payload:
+                continue
+            cmd, data = payload[0], payload[1:]
+            if cmd == COM_QUIT:
+                return
+            try:
+                self._dispatch(cmd, data)
+            except SQLError as e:
+                self._write_err(str(e))
+            except Exception as e:  # noqa: BLE001 - never kill the conn
+                self._write_err(f"internal error: {e}")
+
+    def shutdown(self) -> None:
+        """Unblock the connection thread's read; safe from any thread."""
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        with self._close_mu:
+            session, self.session = self.session, None
+        if session is not None:
+            session.close()
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    # -- handshake (conn.go writeInitialHandshake/readHandshakeResponse) ----
+
+    def _handshake(self) -> None:
+        salt = b"01234567" + b"890123456789"      # fixed: auth unchecked
+        pkt = bytes([PROTOCOL_VERSION])
+        pkt += SERVER_VERSION.encode() + b"\0"
+        pkt += struct.pack("<I", self.conn_id)
+        pkt += salt[:8] + b"\0"
+        pkt += struct.pack("<H", SERVER_CAPS & 0xFFFF)
+        pkt += bytes([CHARSET_UTF8MB4])
+        pkt += struct.pack("<H", SERVER_STATUS_AUTOCOMMIT)
+        pkt += struct.pack("<H", (SERVER_CAPS >> 16) & 0xFFFF)
+        pkt += bytes([21])                        # auth data length
+        pkt += b"\0" * 10
+        pkt += salt[8:] + b"\0"
+        pkt += b"mysql_native_password\0"
+        self.pkt.write_packet(pkt)
+
+        resp = self.pkt.read_packet()
+        caps = struct.unpack_from("<I", resp, 0)[0]
+        self.capabilities = caps
+        off = 4 + 4 + 1 + 23                      # caps, maxpkt, charset, fill
+        user, off = read_nullterm(resp, off)
+        if caps & CLIENT_PLUGIN_AUTH_LENENC:
+            _auth, off = read_lenenc_bytes(resp, off)
+        else:
+            alen = resp[off]
+            off += 1
+            _auth, off = resp[off:off + alen], off + alen
+        db = b""
+        if caps & CLIENT_CONNECT_WITH_DB and off < len(resp):
+            db, off = read_nullterm(resp, off)
+        self.user = user.decode()
+        self._write_ok(0, 0)
+        if db:
+            # select the startup database once the session exists
+            self._pending_db = db.decode()
+        else:
+            self._pending_db = None
+
+    # -- dispatch ------------------------------------------------------------
+
+    def _dispatch(self, cmd: int, data: bytes) -> None:
+        if self.session is not None and self._pending_db:
+            self.session.execute(f"USE `{self._pending_db}`")
+            self._pending_db = None
+        if cmd == COM_PING:
+            self._write_ok(0, 0)
+        elif cmd == COM_INIT_DB:
+            self.session.execute(f"USE `{data.decode()}`")
+            self._write_ok(0, 0)
+        elif cmd == COM_QUERY:
+            self._handle_query(data.decode())
+        elif cmd == COM_FIELD_LIST:
+            self._write_eof()
+        else:
+            self._write_err(f"unsupported command 0x{cmd:02x}")
+
+    def _handle_query(self, sql: str) -> None:
+        results = self.session.execute(sql)
+        # one response per query packet: the first resultset wins, else an
+        # OK carrying the last affected-rows count
+        rs = next((r for r in results if isinstance(r, ResultSet)), None)
+        if rs is not None:
+            self._write_resultset(rs)
+            return
+        affected = 0
+        for r in results:
+            if isinstance(r, int):
+                affected = r
+        self._write_ok(affected, 0)
+
+    # -- response writers (conn.go writeOK/writeError/writeResultset) -------
+
+    def _write_ok(self, affected: int, last_insert_id: int) -> None:
+        pkt = b"\x00" + lenenc_int(affected) + lenenc_int(last_insert_id)
+        pkt += struct.pack("<H", SERVER_STATUS_AUTOCOMMIT)
+        pkt += struct.pack("<H", 0)               # warnings
+        self.pkt.write_packet(pkt)
+
+    def _write_eof(self) -> None:
+        self.pkt.write_packet(
+            b"\xfe" + struct.pack("<H", 0)
+            + struct.pack("<H", SERVER_STATUS_AUTOCOMMIT))
+
+    def _write_err(self, msg: str, code: int = ER_UNKNOWN) -> None:
+        pkt = b"\xff" + struct.pack("<H", code) + b"#HY000"
+        pkt += msg.encode("utf8", "replace")
+        self.pkt.write_packet(pkt)
+
+    def _write_resultset(self, rs: ResultSet) -> None:
+        self.pkt.write_packet(lenenc_int(len(rs.columns)))
+        fts = getattr(rs, "field_types", None)
+        for i, name in enumerate(rs.columns):
+            self.pkt.write_packet(self._column_def(
+                name, fts[i] if fts else None))
+        self._write_eof()
+        for row in rs.rows:
+            self.pkt.write_packet(self._encode_row(row))
+        self._write_eof()
+
+    @staticmethod
+    def _column_def(name: str, ft) -> bytes:
+        tp = int(ft.tp) if ft is not None else int(TypeCode.VARCHAR)
+        flen = (ft.flen if ft is not None and ft.flen > 0 else 255)
+        dec = (ft.frac if ft is not None and 0 <= ft.frac <= 30 else 0)
+        pkt = lenenc_str("def")                   # catalog
+        pkt += lenenc_str("") * 3                 # schema, table, org_table
+        pkt += lenenc_str(name) + lenenc_str(name)
+        pkt += bytes([0x0C])
+        pkt += struct.pack("<H", CHARSET_UTF8MB4)
+        pkt += struct.pack("<I", flen)
+        pkt += bytes([tp])
+        pkt += struct.pack("<H", 0)               # flags
+        pkt += bytes([dec])
+        pkt += b"\0\0"
+        return pkt
+
+    @staticmethod
+    def _encode_row(row) -> bytes:
+        out = b""
+        for v in row:
+            if v is None:
+                out += b"\xfb"
+            elif isinstance(v, bytes):
+                out += lenenc_bytes(v)
+            elif isinstance(v, bool):
+                out += lenenc_str("1" if v else "0")
+            elif isinstance(v, float):
+                out += lenenc_str(repr(v))
+            elif isinstance(v, Decimal):
+                out += lenenc_str(str(v))
+            else:
+                out += lenenc_str(str(v))
+        return out

@@ -37,6 +37,7 @@ class SQLError(Exception):
 class ResultSet:
     columns: list[str]
     rows: list[tuple]
+    field_types: list | None = None   # FieldType per column (wire protocol)
 
     def __repr__(self):
         return f"ResultSet({self.columns}, {len(self.rows)} rows)"
@@ -277,7 +278,8 @@ class Session:
         rows = []
         for ch in chunks:
             rows.extend(_format_chunk(ch))
-        return ResultSet(columns=names, rows=rows)
+        return ResultSet(columns=names, rows=rows,
+                         field_types=[c.ft for c in plan.schema.cols])
 
     def _exec_union(self, stmt: ast.UnionStmt) -> ResultSet:
         results = [self._exec_query(s) for s in stmt.selects]
@@ -296,7 +298,8 @@ class Session:
                 rows = seen
         if stmt.limit is not None:
             rows = rows[stmt.offset:stmt.offset + stmt.limit]
-        return ResultSet(columns=results[0].columns, rows=rows)
+        return ResultSet(columns=results[0].columns, rows=rows,
+                         field_types=results[0].field_types)
 
     # -- DML -----------------------------------------------------------------
 

@@ -24,7 +24,7 @@ from concurrent.futures import ThreadPoolExecutor
 from tidb_tpu import kv
 from tidb_tpu.kv import GCTooEarlyError
 from tidb_tpu.meta import Meta
-from tidb_tpu.store.backoff import Backoffer
+from tidb_tpu.store.backoff import BO_REGION_MISS, Backoffer
 from tidb_tpu.store.oracle import compose_ts, physical_ms
 
 __all__ = ["GCWorker", "GCTooEarlyError", "DEFAULT_GC_LIFE_TIME_MS"]
@@ -135,12 +135,27 @@ class GCWorker:
 
     # -- phases --------------------------------------------------------------
 
-    def _each_region(self):
-        """Walk region descriptors left to right via the region cache."""
-        key = b""
+    def _region_rpc(self, key: bytes, fn):
+        """fn(loc) with the standard region-error retry discipline
+        (ref: region_request.go): invalidate + re-locate on stale epoch."""
+        bo = Backoffer(RESOLVE_MAX_BACKOFF)
         while True:
             loc = self.storage.region_cache.locate(key)
-            yield loc
+            try:
+                return loc, fn(loc)
+            except kv.NotLeaderError as e:
+                self.storage.region_cache.on_not_leader(e)
+                bo.backoff(BO_REGION_MISS, e)
+            except kv.RegionError as e:
+                self.storage.region_cache.invalidate(loc.region.id)
+                bo.backoff(BO_REGION_MISS, e)
+
+    def _each_region_rpc(self, fn):
+        """Run fn over every region left to right; yields results."""
+        key = b""
+        while True:
+            loc, out = self._region_rpc(key, fn)
+            yield loc, out
             if not loc.region.end:
                 return
             key = loc.region.end
@@ -150,8 +165,9 @@ class GCWorker:
         roll it forward/back before its intent becomes unreachable
         (ref: gc_worker.go:325 resolveLocks)."""
         n = 0
-        for loc in self._each_region():
-            locks = self.storage.shim.kv_scan_lock(loc.ctx, safepoint)
+        for _loc, locks in self._each_region_rpc(
+                lambda loc: self.storage.shim.kv_scan_lock(loc.ctx,
+                                                           safepoint)):
             if locks:
                 # every lock below the safepoint is gc_life_time old: its
                 # TTL has long expired, so resolve rolls it forward/back
@@ -168,16 +184,18 @@ class GCWorker:
         txn = self.storage.begin()
         try:
             pending = [r for r in Meta(txn).pending_delete_ranges()
-                       if r[4] <= safepoint]
+                       if 0 < r[4] <= safepoint]   # sealed + safepoint past
         finally:
             txn.rollback()
         for qkey, _job, start, end, _ts in pending:
             cur = start
             while True:
-                loc = self.storage.region_cache.locate(cur)
-                self.storage.shim.kv_delete_range(
-                    loc.ctx, max(cur, loc.region.start or cur),
-                    min(end, loc.region.end) if loc.region.end else end)
+                loc, _ = self._region_rpc(
+                    cur, lambda loc, cur=cur: self.storage.shim.
+                    kv_delete_range(
+                        loc.ctx, max(cur, loc.region.start or cur),
+                        min(end, loc.region.end) if loc.region.end
+                        else end))
                 if not loc.region.end or loc.region.end >= end:
                     break
                 cur = loc.region.end
@@ -192,12 +210,15 @@ class GCWorker:
 
     def _gc_regions(self, safepoint: int) -> int:
         """Region-parallel GC RPCs (ref: doGC gc_worker.go:482)."""
-        locs = list(self._each_region())
+        starts = [loc.region.start
+                  for loc, _ in self._each_region_rpc(lambda loc: None)]
         total = 0
         with ThreadPoolExecutor(max_workers=GC_CONCURRENCY,
                                 thread_name_prefix="gc") as pool:
-            for pruned in pool.map(
-                    lambda loc: self.storage.shim.kv_gc(loc.ctx, safepoint),
-                    locs):
+            for _loc, pruned in pool.map(
+                    lambda k: self._region_rpc(
+                        k, lambda loc: self.storage.shim.kv_gc(loc.ctx,
+                                                               safepoint)),
+                    starts):
                 total += int(pruned or 0)
         return total
