@@ -110,7 +110,7 @@ class DDLWorker:
         else:
             m.update_job(job)
         txn.commit()
-        if job.finished:
+        if job.finished and job.args.get("has_ranges"):
             self._seal_delete_ranges(job)
         if self.on_state_change is not None:
             self.on_state_change(job)
@@ -119,7 +119,9 @@ class DDLWorker:
     def _seal_delete_ranges(self, job: Job) -> None:
         """Stamp the job's queued ranges with a ts acquired AFTER its final
         txn committed — an upper bound on the drop's commit ts, so GC can
-        safely order the physical delete against the safepoint."""
+        safely order the physical delete against the safepoint. Best
+        effort: if this crashes, the GC worker re-seals orphaned ranges of
+        finished jobs (gcworker._drain_delete_ranges)."""
         txn = self.storage.begin()
         try:
             Meta(txn).seal_delete_ranges(job.id, txn.start_ts)
@@ -195,6 +197,7 @@ class DDLWorker:
         for t in m.list_tables(job.schema_id):
             lo, hi = tablecodec.table_prefix_range(t.id)
             m.add_delete_range(job.id, lo, hi)
+            job.args["has_ranges"] = True
         m.drop_database(job.schema_id)
         job.state = JobState.DONE
         return True
@@ -223,6 +226,7 @@ class DDLWorker:
             m.drop_table(job.schema_id, info.id)
             lo, hi = tablecodec.table_prefix_range(info.id)
             m.add_delete_range(job.id, lo, hi)
+            job.args["has_ranges"] = True
             job.state = JobState.DONE
         job.schema_state = int(info.state)
         return True
@@ -232,6 +236,7 @@ class DDLWorker:
         m.drop_table(job.schema_id, info.id)
         lo, hi = tablecodec.table_prefix_range(info.id)
         m.add_delete_range(job.id, lo, hi)
+        job.args["has_ranges"] = True
         info.id = job.args["new_table_id"]
         m.create_table(job.schema_id, info)
         job.state = JobState.DONE
@@ -367,6 +372,7 @@ class DDLWorker:
             info.indexes.remove(idx)
             prefix = tablecodec.index_prefix(info.id, idx.id)
             m.add_delete_range(job.id, prefix, codec.prefix_next(prefix))
+            job.args["has_ranges"] = True
             job.state = JobState.DONE
         job.schema_state = int(idx.state)
         m.update_table(job.schema_id, info)
@@ -387,6 +393,7 @@ class DDLWorker:
             info.indexes.remove(idx)
             prefix = tablecodec.index_prefix(info.id, idx.id)
             m.add_delete_range(job.id, prefix, codec.prefix_next(prefix))
+            job.args["has_ranges"] = True
             m.update_table(job.schema_id, info)
             job.state = JobState.CANCELLED
         job.schema_state = int(idx.state)

@@ -196,16 +196,19 @@ class Meta:
         # range with a fresh timestamp (>= the drop's commit ts). GC only
         # drains sealed ranges whose seal ts <= safepoint, so snapshots
         # that still see the pre-drop schema can still read the data
-        # (ref: gc_delete_range.ts, written after the job finishes)
+        # (ref: gc_delete_range.ts, written after the job finishes).
+        # Keyed by job id so sealing is a per-job prefix scan; GC re-seals
+        # orphans (job finished but seal crashed) so nothing leaks.
         rec = json.dumps({"job": job_id, "start": start.hex(),
                           "end": end.hex(), "ts": 0}).encode()
-        self.txn.set(b"m_deleteRange/%020d" % seq, rec)
+        self.txn.set(b"m_deleteRange/%020d/%020d" % (job_id, seq), rec)
 
     def seal_delete_ranges(self, job_id: int, ts: int) -> None:
         """Stamp a finished job's ranges as deletable once safepoint > ts."""
-        for k, v in self.txn.iter_range(b"m_deleteRange/", b"m_deleteRange0"):
+        prefix = b"m_deleteRange/%020d/" % job_id
+        for k, v in self.txn.iter_range(prefix, prefix[:-1] + b"0"):
             o = json.loads(v)
-            if o["job"] == job_id and not o["ts"]:
+            if not o["ts"]:
                 o["ts"] = ts
                 self.txn.set(k, json.dumps(o).encode())
 

@@ -1,0 +1,141 @@
+"""Native (C++) kernels, loaded via ctypes with graceful fallback.
+
+The shared library is compiled on first use with the system g++ (cached
+next to the source, keyed by source mtime) — no pybind11 or build step in
+the critical path; environments without a compiler simply run the pure-
+Python implementations. Ref: SURVEY.md §7 — the reference's storage-side
+hot loops live in Rust TiKV; this is our C++ equivalent layer.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+import numpy as np
+
+__all__ = ["lib", "decode_rows_native", "NATIVE_KIND_INT",
+           "NATIVE_KIND_FLOAT", "NATIVE_KIND_DECIMAL", "NATIVE_KIND_HANDLE"]
+
+NATIVE_KIND_INT = 0
+NATIVE_KIND_FLOAT = 1
+NATIVE_KIND_DECIMAL = 2
+NATIVE_KIND_HANDLE = 3
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> ctypes.CDLL | None:
+    src = Path(__file__).parent / "codec.cc"
+    build_dir = Path(__file__).parent / "_build"
+    so = build_dir / "codec.so"
+    try:
+        if not so.exists() or so.stat().st_mtime < src.stat().st_mtime:
+            build_dir.mkdir(exist_ok=True)
+            tmp = so.with_suffix(".so.tmp%d" % os.getpid())
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                 "-o", str(tmp), str(src)],
+                check=True, capture_output=True, timeout=120)
+            os.replace(tmp, so)
+        cdll = ctypes.CDLL(str(so))
+    except Exception:  # noqa: BLE001 - no compiler / load failure
+        return None
+    cdll.decode_rows.restype = ctypes.c_int
+    cdll.decode_rows.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_void_p),
+    ]
+    return cdll
+
+
+def lib() -> ctypes.CDLL | None:
+    """The native library, or None when unavailable."""
+    global _lib, _tried
+    if _tried:
+        return _lib
+    with _lock:
+        if not _tried:
+            _lib = _build()
+            _tried = True
+    return _lib
+
+
+def decode_rows_native(kvrows, col_specs):
+    """Batch-decode record (key, value) pairs into columnar arrays.
+
+    col_specs: list of (col_id, kind, frac, default_valid, default_value)
+    — kind NATIVE_KIND_*; for HANDLE the id/default are ignored.
+    Returns (datas, valids) lists of numpy arrays, or None when the native
+    path is unavailable or declined the input (caller uses the Python
+    decoder).
+    """
+    cdll = lib()
+    if cdll is None:
+        return None
+    n = len(kvrows)
+    keys = b"".join(k for k, _v in kvrows)
+    values = b"".join(v for _k, v in kvrows)
+    key_offs = np.zeros(n + 1, dtype=np.int64)
+    val_offs = np.zeros(n + 1, dtype=np.int64)
+    ko = vo = 0
+    for i, (k, v) in enumerate(kvrows):
+        ko += len(k)
+        vo += len(v)
+        key_offs[i + 1] = ko
+        val_offs[i + 1] = vo
+
+    ncols = len(col_specs)
+    col_ids = np.array([s[0] for s in col_specs], dtype=np.int64)
+    col_kind = np.array([s[1] for s in col_specs], dtype=np.uint8)
+    col_frac = np.array([max(0, s[2]) for s in col_specs], dtype=np.int32)
+    def_valid = np.array([1 if s[3] else 0 for s in col_specs],
+                         dtype=np.uint8)
+    def_int = np.zeros(ncols, dtype=np.int64)
+    def_float = np.zeros(ncols, dtype=np.float64)
+    for i, s in enumerate(col_specs):
+        if s[3] and s[4] is not None:
+            if s[1] == NATIVE_KIND_FLOAT:
+                def_float[i] = float(s[4])
+            else:
+                def_int[i] = int(s[4])
+        elif s[3] and s[4] is None:
+            def_valid[i] = 0   # default is NULL
+
+    datas = []
+    valids = []
+    out_ptrs = (ctypes.c_void_p * ncols)()
+    valid_ptrs = (ctypes.c_void_p * ncols)()
+    for i, s in enumerate(col_specs):
+        dt = np.float64 if s[1] == NATIVE_KIND_FLOAT else np.int64
+        d = np.zeros(n, dtype=dt)
+        m = np.zeros(n, dtype=np.uint8)
+        datas.append(d)
+        valids.append(m)
+        out_ptrs[i] = d.ctypes.data_as(ctypes.c_void_p)
+        valid_ptrs[i] = m.ctypes.data_as(ctypes.c_void_p)
+
+    rc = cdll.decode_rows(
+        values, val_offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        keys, key_offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n, ncols,
+        col_ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        col_kind.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        col_frac.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        def_valid.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        def_int.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        def_float.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        out_ptrs, valid_ptrs)
+    if rc != 0:
+        return None
+    return datas, [m.astype(bool) for m in valids]

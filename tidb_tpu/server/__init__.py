@@ -40,10 +40,12 @@ CLIENT_MULTI_STATEMENTS = 0x10000
 CLIENT_PLUGIN_AUTH = 0x80000
 CLIENT_PLUGIN_AUTH_LENENC = 0x200000
 
+# CLIENT_MULTI_STATEMENTS is deliberately NOT advertised: _handle_query
+# writes exactly one response per COM_QUERY (no MORE_RESULTS chaining yet)
 SERVER_CAPS = (CLIENT_LONG_PASSWORD | CLIENT_FOUND_ROWS | CLIENT_LONG_FLAG
                | CLIENT_CONNECT_WITH_DB | CLIENT_PROTOCOL_41
                | CLIENT_TRANSACTIONS | CLIENT_SECURE_CONNECTION
-               | CLIENT_MULTI_STATEMENTS | CLIENT_PLUGIN_AUTH)
+               | CLIENT_PLUGIN_AUTH)
 
 SERVER_STATUS_AUTOCOMMIT = 0x0002
 
@@ -70,6 +72,7 @@ class Server:
         self._thread: threading.Thread | None = None
         self._conn_id = 0
         self._conns: set = set()
+        self._conn_threads: set = set()
         self._mu = threading.Lock()
 
     @property
@@ -95,6 +98,8 @@ class Server:
                 cid = self._conn_id
             t = threading.Thread(target=self._serve_conn, args=(sock, cid),
                                  daemon=True, name=f"mysql-conn-{cid}")
+            with self._mu:
+                self._conn_threads.add(t)
             t.start()
 
     def _serve_conn(self, sock: socket.socket, conn_id: int) -> None:
@@ -103,11 +108,13 @@ class Server:
             self._conns.add(conn)
         try:
             conn.run()
-        except (ConnectionError, OSError):
-            pass
+        except (ConnectionError, OSError, ValueError, IndexError,
+                struct.error):
+            pass   # malformed/odd peers must not take the server down
         finally:
             with self._mu:
                 self._conns.discard(conn)
+                self._conn_threads.discard(threading.current_thread())
             conn.close()
             self._tokens.release()
 
@@ -119,10 +126,16 @@ class Server:
             pass
         with self._mu:
             conns = list(self._conns)
+            threads = list(self._conn_threads)
         for c in conns:
             # only unblock the socket; the connection thread owns the
             # session and cleans it up in its finally block
             c.shutdown()
+        # drain before the caller tears down shared state (the storage)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        for t in threads:
+            t.join(timeout=5)
 
 
 class ClientConn:

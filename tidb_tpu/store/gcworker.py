@@ -181,6 +181,7 @@ class GCWorker:
         safepoint has passed the drop itself — older snapshots may still
         legitimately read the data (ref: gc_worker.go:325 deleteRanges
         over mysql.gc_delete_range, filtered by its ts column)."""
+        self._reseal_orphans()
         txn = self.storage.begin()
         try:
             pending = [r for r in Meta(txn).pending_delete_ranges()
@@ -207,6 +208,26 @@ class GCWorker:
                 txn.rollback()
                 raise
         return len(pending)
+
+    def _reseal_orphans(self) -> None:
+        """Seal unsealed ranges whose DDL job already finished — covers a
+        worker that crashed between its final job txn and the seal, so no
+        dropped data leaks forever."""
+        txn = self.storage.begin()
+        try:
+            m = Meta(txn)
+            orphan_jobs = {job_id for _k, job_id, _s, _e, ts
+                           in m.pending_delete_ranges()
+                           if ts == 0 and m.history_job(job_id) is not None}
+            for job_id in orphan_jobs:
+                m.seal_delete_ranges(job_id, txn.start_ts)
+            if orphan_jobs:
+                txn.commit()
+            else:
+                txn.rollback()
+        except Exception:
+            if txn.valid:
+                txn.rollback()
 
     def _gc_regions(self, safepoint: int) -> int:
         """Region-parallel GC RPCs (ref: doGC gc_worker.go:482)."""

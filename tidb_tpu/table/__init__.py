@@ -328,15 +328,62 @@ def rows_to_chunk(fts: list[FieldType], rows: list[list]) -> Chunk:
     return Chunk(cols)
 
 
+def _kvrows_to_chunk_native(col_infos, kvrows,
+                            with_handle_col: int | None) -> Chunk | None:
+    """C++ batch decode straight into columnar buffers (native/codec.cc).
+    Handles fixed-width columns only; None -> caller uses the Python
+    loop (varlen columns, unusual encodings, no compiler)."""
+    from tidb_tpu.native import (NATIVE_KIND_DECIMAL, NATIVE_KIND_FLOAT,
+                                 NATIVE_KIND_HANDLE, NATIVE_KIND_INT,
+                                 decode_rows_native)
+    from tidb_tpu.sqltypes import new_int_field
+    ncols = len(col_infos) + (1 if with_handle_col is not None else 0)
+    specs = []
+    fts = []
+    src = 0
+    for j in range(ncols):
+        if with_handle_col is not None and j == with_handle_col:
+            specs.append((0, NATIVE_KIND_HANDLE, 0, False, None))
+            fts.append(new_int_field())
+            continue
+        ci = col_infos[src]
+        src += 1
+        et = ci.ft.eval_type
+        if et in (EvalType.INT, EvalType.DATETIME):
+            kind = NATIVE_KIND_INT
+        elif et == EvalType.REAL:
+            kind = NATIVE_KIND_FLOAT
+        elif et == EvalType.DECIMAL:
+            kind = NATIVE_KIND_DECIMAL
+        else:
+            return None   # varlen: python path
+        default = None
+        if ci.has_default and ci.default is not None:
+            default = encode_datum_for_col(ci.default, ci.ft)
+            if isinstance(default, tuple):
+                default = default[1]   # scaled int at the column's frac
+        specs.append((ci.id, kind, ci.ft.frac, ci.has_default, default))
+        fts.append(ci.ft)
+    out = decode_rows_native(kvrows, specs)
+    if out is None:
+        return None
+    datas, valids = out
+    return Chunk([Column(ft, d, v)
+                  for ft, d, v in zip(fts, datas, valids)])
+
+
 def kvrows_to_chunk(info: TableInfo, col_infos, kvrows,
                     with_handle_col: int | None = None) -> Chunk:
     """Decode raw (key, value) record pairs into a chunk of the requested
     columns. col_infos: list of ColumnInfo to emit, in order.
     with_handle_col: emit the row handle as an extra int column at this
     output position (DML readers need it to address rows).
-    This python loop is the row-decode hot path the native codec will
-    replace (ref: util/codec DecodeOneToChunk, codec.go:387)."""
+    Fast path: the C++ batch decoder (ref: util/codec DecodeOneToChunk,
+    codec.go:387 — and the Rust TiKV decode the reference leans on)."""
     from tidb_tpu.sqltypes import new_int_field
+    ch = _kvrows_to_chunk_native(col_infos, kvrows, with_handle_col)
+    if ch is not None:
+        return ch
     ncols = len(col_infos) + (1 if with_handle_col is not None else 0)
     rows = []
     for k, v in kvrows:
