@@ -127,6 +127,31 @@ class TestParity:
         _assert_chunks_equal(got, want)
         assert got.columns[0].get(0) == decimal.Decimal("123.45")
 
+    def test_decimal_downscale_rounds_half_away_from_zero(self):
+        # stored at frac 4, column declared frac 2: MySQL rounding, both
+        # signs, must match the Python path exactly
+        info = _mk_table([(new_decimal_field(14, 2), None, True)])
+        rows = [{1: (4, 1234567)}, {1: (4, -1234567)},
+                {1: (4, 1234550)}, {1: (4, -1234550)},
+                {1: (4, 1234449)}, {1: (1, -155)}]
+        kvrows = _encode_rows(info, rows)
+        got = kvrows_to_chunk(info, info.columns, kvrows, None)
+        want = _python_chunk(info, info.columns, kvrows, None)
+        _assert_chunks_equal(got, want)
+        assert list(got.columns[0].data) == [
+            12346, -12346, 12346, -12346, 12344, -1550]
+
+    def test_huge_frac_shift_falls_back(self):
+        # a >18-digit downscale would overflow pow10_i64: native declines,
+        # python divides exactly
+        info = _mk_table([(new_decimal_field(30, 0), None, True)])
+        rows = [{1: (20, 12345)}, {1: (0, 42)}]
+        kvrows = _encode_rows(info, rows)
+        got = kvrows_to_chunk(info, info.columns, kvrows, None)
+        want = _python_chunk(info, info.columns, kvrows, None)
+        _assert_chunks_equal(got, want)
+        assert list(got.columns[0].data) == [0, 42]
+
     def test_string_column_falls_back(self):
         info = _mk_table([(new_int_field(), None, True),
                           (new_string_field(), None, True)])

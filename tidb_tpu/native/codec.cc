@@ -215,10 +215,26 @@ int decode_rows(const uint8_t* values, const int64_t* val_offsets,
           out_valid[slot][r] = 1;
           if (col_kind[slot] == 2) {
             int want = col_frac[slot];
-            if (frac < want) scaled *= pow10_i64(want - frac);
-            else if (frac > want) scaled /= pow10_i64(frac - want);
+            // >18-digit shifts overflow int64: python path handles those
+            if (frac < want) {
+              if (want - frac > 18) return -1;
+              int64_t mul = pow10_i64(want - frac);
+              if (scaled > INT64_MAX / mul || scaled < INT64_MIN / mul)
+                return -1;
+              scaled *= mul;
+            } else if (frac > want) {
+              if (frac - want > 18) return -1;
+              // MySQL half-away-from-zero, matching _rescale_decimal
+              int64_t div = pow10_i64(frac - want);
+              int64_t q = scaled / div;
+              int64_t rem = scaled % div;
+              if (rem < 0) rem = -rem;
+              if (2 * rem >= div) q += (scaled >= 0) ? 1 : -1;
+              scaled = q;
+            }
             out_data[slot][r] = scaled;
           } else if (col_kind[slot] == 1) {
+            if (frac > 18) return -1;
             ((double*)out_data[slot])[r] =
                 (double)scaled / (double)pow10_i64(frac);
           } else {

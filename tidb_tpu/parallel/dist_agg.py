@@ -49,23 +49,75 @@ _MERGE = {"sum": jax.ops.segment_sum,
           "max": jax.ops.segment_max}
 
 
-class MeshAggKernel:
-    """Filter + group-by + aggregation, distributed over a ('dp','tp') mesh.
+def group_merge_program(xp, cols, mask, ln, offs, ti, group_exprs, aggs,
+                        C, ndev, tp):
+    """The shared traced body: local sort-based group tables, all_gather
+    merge over every mesh axis, tp-axis slice. `cols` is any virtual
+    column list (probe columns, or probe + gathered join payloads —
+    parallel/dist_join.py); expressions index into it."""
+    key_cols = [g.eval_xp(xp, cols, ln) for g in group_exprs]
+    h = _hash_keys(xp, key_cols, ln, seed=0x517CC1B727220A95)
+    h2 = _hash_keys(xp, key_cols, ln, seed=0x2545F4914F6CDD1D)
+    h = xp.where(mask, h, _SENTINEL_MASKED)
 
-    One compiled XLA program: per-shard local aggregation, all_gather of
-    the group tables across every mesh axis, re-reduction, and a tp-axis
-    slice of the merged state. Rows are sharded over the flattened mesh;
-    columns stay separate arrays so int64 keys keep exact bits.
-    """
+    uniq, inv = jnp.unique(h, size=C, fill_value=_FILL, return_inverse=True)
+    local_tot = _distinct_count(xp, h)
 
-    def __init__(self, mesh: Mesh, filter_expr: Expression | None,
-                 group_exprs: Sequence[Expression],
-                 aggs: Sequence[AggDesc], capacity: int = 4096):
+    lanes: list[tuple] = []  # (array[C], merge_op)
+    seg = lambda op, x: _MERGE[op](x, inv, num_segments=C)
+    lanes.append((seg("sum", mask.astype(jnp.int64)), "sum"))      # cnt
+    lanes.append((seg("min", xp.where(mask, h2, _I64_MAX)), "min"))
+    lanes.append((seg("max", xp.where(mask, h2, _I64_MIN)), "max"))
+    grep = seg("min", xp.where(mask, xp.arange(ln), ln))
+    ghas = seg("max", mask.astype(jnp.int64))
+    lanes.append((xp.where(ghas > 0, offs + grep, _BIG), "min"))   # rep
+    agg_lane_slices = []
+    for a in aggs:
+        ls = _agg_lanes(xp, a, cols, ln, mask, inv, C, offs=offs)
+        agg_lane_slices.append((len(lanes) - 4, len(ls)))
+        lanes.extend(ls)
+
+    # -- cross-chip merge: gather every shard's table, re-reduce -----------
+    # (single-device meshes skip the collectives entirely: some
+    # single-chip runtimes can't lower pmax/all_gather, and the local
+    # table already is the global table)
+    if ndev == 1:
+        return (uniq, *(l for l, _op in lanes[:4]),
+                tuple(tuple(lanes[4 + s + i][0] for i in range(w))
+                      for s, w in agg_lane_slices),
+                local_tot)
+    ax = ("dp", "tp")
+    all_uniq = lax.all_gather(uniq, ax, tiled=True)          # [ndev*C]
+    muniq, minv = jnp.unique(all_uniq, size=C, fill_value=_FILL,
+                             return_inverse=True)
+    gtot = _distinct_count(xp, all_uniq)
+    # gathered fill/sentinel slots can add up to 2 phantom values to
+    # gtot relative to a single table; they are excluded on the host
+    # via the live mask, and capacity is checked with slack for them
+    tot = xp.maximum(gtot, lax.pmax(local_tot, ax))
+    merged = []
+    for lane, op in lanes:
+        g = lax.all_gather(lane, ax, tiled=True)
+        merged.append(_MERGE[op](g, minv, num_segments=C))
+
+    # -- tp-sliced outputs (replicated over dp) ----------------------------
+    blk = C // tp
+    sl = lambda a: lax.dynamic_slice_in_dim(a, ti * blk, blk)
+    cnt, h2min, h2max, rep = merged[:4]
+    agg_out = tuple(
+        tuple(sl(merged[4 + start + i]) for i in range(width))
+        for start, width in agg_lane_slices)
+    return (sl(muniq), sl(cnt), sl(h2min), sl(h2max), sl(rep),
+            agg_out, tot)
+
+
+class MeshKernelBase:
+    """Shared mesh plumbing: capacity sizing, shard_map wrapper, probe
+    sharding, and the merged-table postprocess (capacity / collision
+    checks + live-group extraction)."""
+
+    def _setup_mesh(self, mesh: Mesh, capacity: int, n_extra_args: int = 0):
         self.mesh = mesh
-        self.filter_expr = filter_expr
-        self.group_exprs = list(group_exprs)
-        self.aggs = list(aggs)
-        _validate_device_exprs(filter_expr, self.group_exprs, self.aggs)
         self.ndev = mesh.devices.size
         self.tp = mesh.shape["tp"]
         # internal table size = requested capacity + 2 headroom slots for
@@ -76,7 +128,8 @@ class MeshAggKernel:
         self._C = self.capacity + 2
         self._C += (-self._C) % self.tp
         self._row_spec = P(("dp", "tp"))
-        kwargs = dict(mesh=mesh, in_specs=(self._row_spec, P()),
+        in_specs = (self._row_spec, P()) + (P(),) * n_extra_args
+        kwargs = dict(mesh=mesh, in_specs=in_specs,
                       out_specs=(P("tp"), P("tp"), P("tp"), P("tp"),
                                  P("tp"), P("tp"), P()))
         try:
@@ -85,86 +138,21 @@ class MeshAggKernel:
             shard = shard_map(self._kernel, check_rep=False, **kwargs)
         self._jit = jax.jit(shard)
 
-    # -- traced program ------------------------------------------------------
-
-    def _kernel(self, cols, nrows):
-        ln = cols[0][0].shape[0]
-        xp = jnp
-        C = self._C
-        di = lax.axis_index("dp")
-        ti = lax.axis_index("tp")
-        offs = (di * self.tp + ti).astype(jnp.int64) * ln
-        alive = (offs + xp.arange(ln)) < nrows
-        mask = runtime.filter_mask_xp(xp, self.filter_expr, cols, ln) & alive
-        key_cols = [g.eval_xp(xp, cols, ln) for g in self.group_exprs]
-        h = _hash_keys(xp, key_cols, ln, seed=0x517CC1B727220A95)
-        h2 = _hash_keys(xp, key_cols, ln, seed=0x2545F4914F6CDD1D)
-        h = xp.where(mask, h, _SENTINEL_MASKED)
-
-        uniq, inv = jnp.unique(h, size=C, fill_value=_FILL,
-                               return_inverse=True)
-        local_tot = _distinct_count(xp, h)
-
-        lanes: list[tuple] = []  # (array[C], merge_op)
-        seg = lambda op, x: _MERGE[op](x, inv, num_segments=C)
-        lanes.append((seg("sum", mask.astype(jnp.int64)), "sum"))      # cnt
-        lanes.append((seg("min", xp.where(mask, h2, _I64_MAX)), "min"))
-        lanes.append((seg("max", xp.where(mask, h2, _I64_MIN)), "max"))
-        grep = seg("min", xp.where(mask, xp.arange(ln), ln))
-        ghas = seg("max", mask.astype(jnp.int64))
-        lanes.append((xp.where(ghas > 0, offs + grep, _BIG), "min"))   # rep
-        agg_lane_slices = []
-        for a in self.aggs:
-            ls = _agg_lanes(xp, a, cols, ln, mask, inv, C, offs=offs)
-            agg_lane_slices.append((len(lanes) - 4, len(ls)))
-            lanes.extend(ls)
-
-        # -- cross-chip merge: gather every shard's table, re-reduce -------
-        # (single-device meshes skip the collectives entirely: some
-        # single-chip runtimes can't lower pmax/all_gather, and the local
-        # table already is the global table)
-        if self.ndev == 1:
-            return (uniq, *(l for l, _op in lanes[:4]),
-                    tuple(tuple(lanes[4 + s + i][0] for i in range(w))
-                          for s, w in agg_lane_slices),
-                    local_tot)
-        ax = ("dp", "tp")
-        all_uniq = lax.all_gather(uniq, ax, tiled=True)          # [ndev*C]
-        muniq, minv = jnp.unique(all_uniq, size=C, fill_value=_FILL,
-                                 return_inverse=True)
-        gtot = _distinct_count(xp, all_uniq)
-        # gathered fill/sentinel slots can add up to 2 phantom values to
-        # gtot relative to a single table; they are excluded on the host
-        # via the live mask, and capacity is checked with slack for them
-        tot = xp.maximum(gtot, lax.pmax(local_tot, ax))
-        merged = []
-        for lane, op in lanes:
-            g = lax.all_gather(lane, ax, tiled=True)
-            merged.append(_MERGE[op](g, minv, num_segments=C))
-
-        # -- tp-sliced outputs (replicated over dp) ------------------------
-        blk = C // self.tp
-        sl = lambda a: lax.dynamic_slice_in_dim(a, ti * blk, blk)
-        cnt, h2min, h2max, rep = merged[:4]
-        agg_out = tuple(
-            tuple(sl(merged[4 + start + i]) for i in range(width))
-            for start, width in agg_lane_slices)
-        return (sl(muniq), sl(cnt), sl(h2min), sl(h2max), sl(rep),
-                agg_out, tot)
-
-    # -- host driver ---------------------------------------------------------
-
-    def __call__(self, chunk: Chunk) -> GroupResult:
+    def _shard_probe(self, chunk: Chunk):
+        """-> (sharded device cols, padded shard length)."""
         n = chunk.num_rows
         ln = -(-max(n, 1) // self.ndev)
         ln += (-ln) % 8
         cols, _dicts = runtime.device_put_chunk(chunk, size=ln * self.ndev,
                                                 to_device=False)
         sh = NamedSharding(self.mesh, self._row_spec)
-        cols = [(jax.device_put(d, sh), jax.device_put(v, sh))
-                for d, v in cols]
-        uniq, cnt, h2min, h2max, rep, agg_out, tot = self._jit(
-            cols, jnp.int64(n))
+        return [(jax.device_put(d, sh), jax.device_put(v, sh))
+                for d, v in cols], ln
+
+    def _postprocess(self, outs):
+        """-> (gidx, rep_rows, lanes_at, counts) from the kernel outputs,
+        raising on capacity overflow or group-key hash collision."""
+        uniq, cnt, h2min, h2max, rep, agg_out, tot = outs
         uniq = np.asarray(uniq)
         cnt = np.asarray(cnt)
         # tot counts the masked sentinel / fill phantoms; _C holds >= 2
@@ -176,7 +164,48 @@ class MeshAggKernel:
         if bool(np.any(live & (np.asarray(h2min) != np.asarray(h2max)))):
             raise CollisionError("group key hash collision")
         gidx = np.flatnonzero(live)
+        rep_rows = np.asarray(rep)[gidx]
         lanes_at = [[np.asarray(l)[gidx] for l in ls] for ls in agg_out]
+        return gidx, rep_rows, lanes_at, cnt[gidx]
+
+
+class MeshAggKernel(MeshKernelBase):
+    """Filter + group-by + aggregation, distributed over a ('dp','tp') mesh.
+
+    One compiled XLA program: per-shard local aggregation, all_gather of
+    the group tables across every mesh axis, re-reduction, and a tp-axis
+    slice of the merged state. Rows are sharded over the flattened mesh;
+    columns stay separate arrays so int64 keys keep exact bits.
+    """
+
+    def __init__(self, mesh: Mesh, filter_expr: Expression | None,
+                 group_exprs: Sequence[Expression],
+                 aggs: Sequence[AggDesc], capacity: int = 4096):
+        self.filter_expr = filter_expr
+        self.group_exprs = list(group_exprs)
+        self.aggs = list(aggs)
+        _validate_device_exprs(filter_expr, self.group_exprs, self.aggs)
+        self._setup_mesh(mesh, capacity)
+
+    # -- traced program ------------------------------------------------------
+
+    def _kernel(self, cols, nrows):
+        ln = cols[0][0].shape[0]
+        xp = jnp
+        di = lax.axis_index("dp")
+        ti = lax.axis_index("tp")
+        offs = (di * self.tp + ti).astype(jnp.int64) * ln
+        alive = (offs + xp.arange(ln)) < nrows
+        mask = runtime.filter_mask_xp(xp, self.filter_expr, cols, ln) & alive
+        return group_merge_program(xp, cols, mask, ln, offs, ti,
+                                   self.group_exprs, self.aggs, self._C,
+                                   self.ndev, self.tp)
+
+    # -- host driver ---------------------------------------------------------
+
+    def __call__(self, chunk: Chunk) -> GroupResult:
+        cols, _ln = self._shard_probe(chunk)
+        outs = self._jit(cols, jnp.int64(chunk.num_rows))
+        gidx, rep_rows, lanes_at, counts = self._postprocess(outs)
         return finalize_group_result(chunk, self.group_exprs, self.aggs,
-                                     gidx, np.asarray(rep)[gidx], lanes_at,
-                                     cnt[gidx])
+                                     gidx, rep_rows, lanes_at, counts)

@@ -1,0 +1,281 @@
+"""Mesh-distributed star-join + aggregation pipeline.
+
+The reference executes Q3/Q5-shaped plans as a chain of HashJoinExecs
+(executor/join.go:37: build a hash table per join, probe row-at-a-time in
+goroutines) feeding a HashAggExec. On a TPU mesh the idiomatic program is
+one fused XLA computation per probe shard:
+
+    probe rows sharded over ('dp','tp')   [the fact table: lineitem]
+    build tables replicated on every chip [the dimension tables]
+    filter -> lookup chain -> group-by aggregate -> all_gather merge
+
+Each lookup is an O(log n) searchsorted against the dimension table's
+sorted key hashes plus an exact-bits verify — the join never materializes:
+matched rows flow straight into the aggregation, so HBM traffic is one
+pass over the probe shard. Build keys must be unique (dimension tables:
+customer, orders, nation, ...); the executor layer falls back to the
+host hash join otherwise. This is the "pmap-partitioned build/probe with
+psum/all_gather merge" shape of BASELINE.json configs 3-4.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
+
+from tidb_tpu.chunk import Chunk, Column
+from tidb_tpu.expression import AggDesc, AggFunc, Expression
+from tidb_tpu.ops import runtime
+from tidb_tpu.ops.hashagg import (_hash_keys, _key_bits,
+                                  _validate_device_exprs,
+                                  finalize_group_result)
+from tidb_tpu.parallel.dist_agg import MeshKernelBase, group_merge_program
+
+__all__ = ["LookupSpec", "MeshLookupAggKernel", "BuildError",
+           "host_lookup_agg"]
+
+_KEY_SEED = 0x9E6D55A3C1B70F27
+
+
+class BuildError(Exception):
+    """Build side unusable for the lookup kernel (dup/NULL keys, strings
+    in key columns, hash collision) — caller falls back to the host join."""
+
+
+@dataclass
+class LookupSpec:
+    """One dimension-table lookup in the chain.
+
+    key_exprs index the CURRENT virtual schema (probe columns, then the
+    payloads of earlier lookups, in order). build_key_offsets/payload
+    offsets index build_chunk's columns; payload columns are appended to
+    the virtual schema for later key_exprs / group_exprs / aggs."""
+
+    key_exprs: list
+    build_chunk: Chunk
+    build_key_offsets: list[int]
+    payload_offsets: list[int] = field(default_factory=list)
+
+
+class _BuildTable:
+    """Host-prepared replicated lookup table: sorted key hashes, exact key
+    bit lanes, payload lanes (strings dict-encoded for the device; original
+    values kept for host finalize)."""
+
+    def __init__(self, spec: LookupSpec):
+        ch = spec.build_chunk
+        keys = [ch.columns[o] for o in spec.build_key_offsets]
+        n = ch.num_rows
+        valid = np.ones(n, dtype=bool)
+        for k in keys:
+            valid &= np.asarray(k.valid)
+        if not valid.all():
+            # NULL join keys never match anything: drop them here
+            ch = ch.filter(valid)
+            keys = [ch.columns[o] for o in spec.build_key_offsets]
+            n = ch.num_rows
+        key_lanes = []
+        for k in keys:
+            if k.data.dtype == np.dtype(object):
+                raise BuildError("string build keys need the host join")
+            key_lanes.append((np.asarray(k.data),
+                              np.ones(n, dtype=bool)))
+        h = _hash_keys(np, key_lanes, n, seed=_KEY_SEED)
+        order = np.argsort(h, kind="stable")
+        hs = h[order]
+        if n > 1 and (hs[1:] == hs[:-1]).any():
+            # duplicate hash: either duplicate keys (not a dimension
+            # table) or a 2^-64 collision — both go to the host join
+            raise BuildError("duplicate build keys / hash collision")
+        self.chunk = ch                         # NULL-free build rows
+        self.n = n
+        self.h_sorted = hs
+        self.key_bits = [np.asarray(_key_bits(np, d))[order]
+                         for d, _v in key_lanes]
+        self.pay_data = []
+        self.pay_valid = []
+        for o in spec.payload_offsets:
+            c = ch.columns[o]
+            d = np.asarray(c.data)
+            if d.dtype == np.dtype(object):
+                codes = np.empty(n, dtype=np.int64)
+                seen: dict = {}
+                for i, v in enumerate(d):
+                    codes[i] = seen.setdefault(v, len(seen))
+                d = codes
+            self.pay_data.append(d[order])
+            self.pay_valid.append(np.asarray(c.valid)[order])
+        # host-side exact map for finalize / reference impl, keyed in the
+        # chunk-layer value domain (raw int64/float64; decimals scaled) to
+        # match host expression eval output
+        self.row_by_key = {}
+        for i in range(n):
+            kt = tuple(d[i].item() for d, _v in key_lanes)
+            self.row_by_key[kt] = i
+
+    def device_arrays(self):
+        return (jnp.asarray(self.h_sorted),
+                tuple(jnp.asarray(b) for b in self.key_bits),
+                tuple(jnp.asarray(d) for d in self.pay_data),
+                tuple(jnp.asarray(v) for v in self.pay_valid))
+
+
+class MeshLookupAggKernel(MeshKernelBase):
+    """filter -> unique-key lookup chain -> group-by agg over a mesh."""
+
+    def __init__(self, mesh: Mesh, filter_expr: Expression | None,
+                 lookups: Sequence[LookupSpec],
+                 group_exprs: Sequence[Expression],
+                 aggs: Sequence[AggDesc], capacity: int = 4096):
+        self.mesh = mesh
+        self.filter_expr = filter_expr
+        self.lookups = list(lookups)
+        self.group_exprs = list(group_exprs)
+        self.aggs = list(aggs)
+        _validate_device_exprs(filter_expr, self.group_exprs, self.aggs)
+        for lk in self.lookups:
+            _validate_device_exprs(None, lk.key_exprs, [])
+        self.builds = [_BuildTable(lk) for lk in self.lookups]
+        self._setup_mesh(mesh, capacity, n_extra_args=1)
+
+    # -- traced program ------------------------------------------------------
+
+    def _kernel(self, cols, nrows, builds):
+        ln = cols[0][0].shape[0]
+        xp = jnp
+        di = lax.axis_index("dp")
+        ti = lax.axis_index("tp")
+        offs = (di * self.tp + ti).astype(jnp.int64) * ln
+        alive = (offs + xp.arange(ln)) < nrows
+        mask = runtime.filter_mask_xp(xp, self.filter_expr, cols, ln) & alive
+
+        virt = list(cols)
+        for lk, b in zip(self.lookups, builds):
+            h_sorted, key_bits, pay_data, pay_valid = b
+            key_cols = [e.eval_xp(xp, virt, ln) for e in lk.key_exprs]
+            ph = _hash_keys(xp, key_cols, ln, seed=_KEY_SEED)
+            nb = h_sorted.shape[0]
+            pos = xp.searchsorted(h_sorted, ph)
+            cand = xp.clip(pos, 0, max(nb - 1, 0))
+            hit = mask
+            for d, v in key_cols:
+                hit = hit & v               # NULL keys match nothing
+            if nb == 0:
+                hit = hit & False
+            else:
+                hit = hit & (pos < nb) & (h_sorted[cand] == ph)
+                # exact verify: hash equality is not key equality
+                for (d, _v), bb in zip(key_cols, key_bits):
+                    hit = hit & (_key_bits(xp, d) == bb[cand])
+            mask = hit                      # inner join semantics
+            safe = xp.where(hit, cand, 0)
+            for d, v in zip(pay_data, pay_valid):
+                virt.append((d[safe], v[safe] & hit))
+
+        return group_merge_program(xp, virt, mask, ln, offs, ti,
+                                   self.group_exprs, self.aggs, self._C,
+                                   self.ndev, self.tp)
+
+    # -- host driver ---------------------------------------------------------
+
+    def __call__(self, probe: Chunk):
+        cols, _ln = self._shard_probe(probe)
+        rep_sh = NamedSharding(self.mesh, P())
+        builds = tuple(
+            jax.tree.map(lambda a: jax.device_put(a, rep_sh),
+                         b.device_arrays())
+            for b in self.builds)
+        outs = self._jit(cols, jnp.int64(probe.num_rows), builds)
+        gidx, rep_rows, lanes_at, counts = self._postprocess(outs)
+        return self._finalize(probe, gidx, rep_rows, lanes_at, counts)
+
+    def _finalize(self, probe: Chunk, gidx, rep_rows, lanes_at, counts):
+        """Re-run the lookup chain on the handful of representative rows
+        (and FIRST_ROW rows) host-side so group keys / first values come
+        back as exact original values, strings included."""
+        needed = set(int(r) for r in rep_rows)
+        for a, ls in zip(self.aggs, lanes_at):
+            if a.fn == AggFunc.FIRST_ROW:
+                for i, has in zip(ls[0], ls[1]):
+                    if has > 0:
+                        needed.add(int(i))
+        order = sorted(needed)
+        pos = {g: i for i, g in enumerate(order)}
+        mini = self._host_chain(probe.take(np.array(order, dtype=np.int64)))
+        rep_local = np.array([pos[int(r)] for r in rep_rows],
+                             dtype=np.int64)
+        fixed_lanes = []
+        for a, ls in zip(self.aggs, lanes_at):
+            if a.fn == AggFunc.FIRST_ROW:
+                idx = np.array([pos.get(int(i), 0) for i in ls[0]],
+                               dtype=np.int64)
+                fixed_lanes.append([idx, ls[1]])
+            else:
+                fixed_lanes.append(ls)
+        return finalize_group_result(mini, self.group_exprs, self.aggs,
+                                     gidx, rep_local, fixed_lanes, counts)
+
+    def _host_chain(self, mini: Chunk) -> Chunk:
+        """Append payload columns for the (matched) mini rows on the host,
+        with original (undecoded) build values."""
+        out_cols = list(mini.columns)
+        for lk, b in zip(self.lookups, self.builds):
+            virt = Chunk(out_cols)
+            n = virt.num_rows
+            keyvals = []
+            for e in lk.key_exprs:
+                d, v = e.eval(virt)
+                keyvals.append([None if not v[i] else
+                                (d[i].item() if hasattr(d[i], "item")
+                                 else d[i]) for i in range(n)])
+            rows = []
+            for i in range(n):
+                rows.append(b.row_by_key.get(
+                    tuple(kv[i] for kv in keyvals)))
+            for o in lk.payload_offsets:
+                src = b.chunk.columns[o]
+                vals = [None if r is None else src.get(r) for r in rows]
+                out_cols.append(Column.from_values(src.ft, vals))
+        return Chunk(out_cols)
+
+
+def host_lookup_agg(probe: Chunk, filter_expr, lookups: Sequence[LookupSpec],
+                    group_exprs, aggs):
+    """Pure-host reference implementation (ground truth for tests and the
+    dryrun cross-check)."""
+    from tidb_tpu.ops.hostagg import host_hash_agg
+    mask = runtime.eval_filter_host(filter_expr, probe)
+    ch = probe.filter(mask)
+    builds = [_BuildTable(lk) for lk in lookups]
+    cols = list(ch.columns)
+    for lk, b in zip(lookups, builds):
+        virt = Chunk(cols)
+        n = virt.num_rows
+        keyvals = []
+        for e in lk.key_exprs:
+            d, v = e.eval(virt)
+            keyvals.append([None if not v[i] else
+                            (d[i].item() if hasattr(d[i], "item") else d[i])
+                            for i in range(n)])
+        rows = np.empty(n, dtype=object)
+        keep = np.zeros(n, dtype=bool)
+        for i in range(n):
+            r = b.row_by_key.get(tuple(kv[i] for kv in keyvals))
+            rows[i] = r
+            keep[i] = r is not None
+        cols = [c.take(np.flatnonzero(keep)) for c in cols]
+        matched = [int(r) for r in rows[keep]]
+        for o in lk.payload_offsets:
+            src = b.chunk.columns[o]
+            cols.append(Column.from_values(
+                src.ft, [src.get(r) for r in matched]))
+    combined = Chunk(cols)
+    return host_hash_agg(combined, None, group_exprs, aggs)

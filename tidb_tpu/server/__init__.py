@@ -108,9 +108,8 @@ class Server:
             self._conns.add(conn)
         try:
             conn.run()
-        except (ConnectionError, OSError, ValueError, IndexError,
-                struct.error):
-            pass   # malformed/odd peers must not take the server down
+        except (ConnectionError, OSError):
+            pass   # peer went away; engine errors surface via ERR packets
         finally:
             with self._mu:
                 self._conns.discard(conn)
@@ -153,7 +152,10 @@ class ClientConn:
     # -- lifecycle -----------------------------------------------------------
 
     def run(self) -> None:
-        self._handshake()
+        try:
+            self._handshake()
+        except (ValueError, IndexError, struct.error):
+            return   # malformed handshake (port scanner / non-MySQL peer)
         self.session = Session(self.server.storage)
         while True:
             self.pkt.reset_seq()
