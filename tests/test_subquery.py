@@ -1,0 +1,127 @@
+"""Subquery tests: EXISTS / IN / scalar, correlated and uncorrelated.
+
+Ref model: executor tests for NestedLoopApplyExec + expression_rewriter
+subquery cases (executor/executor_test.go TestSubquery-style SQL).
+"""
+
+import pytest
+
+from tidb_tpu.session import Session, SQLError
+from tidb_tpu.store import new_mock_storage
+
+
+@pytest.fixture
+def tk():
+    storage = new_mock_storage()
+    storage.async_commit_secondaries = False
+    s = Session(storage)
+    s.execute("CREATE DATABASE test; USE test")
+    s.execute("CREATE TABLE t (a BIGINT PRIMARY KEY, b INT, c DOUBLE)")
+    s.execute("INSERT INTO t VALUES (1, 10, 1.5), (2, 20, 2.5), "
+              "(3, 30, 3.5), (4, NULL, 4.5)")
+    s.execute("CREATE TABLE u (x BIGINT PRIMARY KEY, y INT)")
+    s.execute("INSERT INTO u VALUES (1, 10), (2, 20), (5, NULL)")
+    yield s
+    s.close()
+    storage.close()
+
+
+def q(tk, sql):
+    return tk.query(sql).rows
+
+
+class TestUncorrelated:
+    def test_in_subquery(self, tk):
+        assert q(tk, "SELECT a FROM t WHERE b IN (SELECT y FROM u) "
+                     "ORDER BY a") == [(1,), (2,)]
+
+    def test_not_in_with_null_inner(self, tk):
+        # u.y contains NULL: NOT IN is never TRUE (three-valued logic)
+        assert q(tk, "SELECT a FROM t WHERE b NOT IN (SELECT y FROM u)") \
+            == []
+
+    def test_not_in_without_nulls(self, tk):
+        assert q(tk, "SELECT a FROM t WHERE b NOT IN "
+                     "(SELECT y FROM u WHERE y IS NOT NULL) ORDER BY a") \
+            == [(3,)]
+
+    def test_exists(self, tk):
+        assert q(tk, "SELECT a FROM t WHERE EXISTS (SELECT 1 FROM u "
+                     "WHERE x = 99) ORDER BY a") == []
+        rows = q(tk, "SELECT a FROM t WHERE EXISTS "
+                     "(SELECT 1 FROM u WHERE x = 1) ORDER BY a")
+        assert rows == [(1,), (2,), (3,), (4,)]
+
+    def test_scalar_compare(self, tk):
+        assert q(tk, "SELECT a FROM t WHERE b > (SELECT AVG(y) FROM u) "
+                     "ORDER BY a") == [(2,), (3,)]
+        # subquery on the left flips the comparison
+        assert q(tk, "SELECT a FROM t WHERE (SELECT MAX(y) FROM u) <= b "
+                     "ORDER BY a") == [(2,), (3,)]
+
+    def test_scalar_empty_is_null(self, tk):
+        assert q(tk, "SELECT a FROM t WHERE b = "
+                     "(SELECT y FROM u WHERE x = 99)") == []
+
+    def test_scalar_multi_row_errors(self, tk):
+        with pytest.raises(SQLError, match="more than 1 row"):
+            q(tk, "SELECT a FROM t WHERE b = (SELECT y FROM u)")
+
+
+class TestCorrelated:
+    def test_exists_correlated(self, tk):
+        rows = q(tk, "SELECT a FROM t WHERE EXISTS "
+                     "(SELECT 1 FROM u WHERE u.x = t.a) ORDER BY a")
+        assert rows == [(1,), (2,)]
+
+    def test_not_exists_correlated(self, tk):
+        rows = q(tk, "SELECT a FROM t WHERE NOT EXISTS "
+                     "(SELECT 1 FROM u WHERE u.x = t.a) ORDER BY a")
+        assert rows == [(3,), (4,)]
+
+    def test_in_correlated(self, tk):
+        rows = q(tk, "SELECT a FROM t WHERE b IN "
+                     "(SELECT y FROM u WHERE u.x = t.a) ORDER BY a")
+        assert rows == [(1,), (2,)]
+
+    def test_scalar_correlated(self, tk):
+        # Q17 shape: compare to a per-row aggregate of another table
+        rows = q(tk, "SELECT a FROM t WHERE c > "
+                     "(SELECT AVG(y) FROM u WHERE u.x = t.a) ORDER BY a")
+        # x=1: avg 10 -> 1.5 > 10 false; x=2: avg 20 -> 2.5 > 20 false
+        assert rows == []
+        rows = q(tk, "SELECT a FROM t WHERE b >= "
+                     "(SELECT MAX(y) FROM u WHERE u.x = t.a) ORDER BY a")
+        assert rows == [(1,), (2,)]
+
+    def test_correlated_with_aggregate_outer(self, tk):
+        # correlated filter under an aggregating outer query
+        rows = q(tk, "SELECT COUNT(*) FROM t WHERE EXISTS "
+                     "(SELECT 1 FROM u WHERE u.x = t.a)")
+        assert rows == [(2,)]
+
+    def test_q4_shape(self, tk):
+        """TPC-H Q4: grouped count over EXISTS-correlated filter."""
+        tk.execute("CREATE TABLE o (ok BIGINT PRIMARY KEY, pri VARCHAR(20))")
+        tk.execute("CREATE TABLE l (lk BIGINT PRIMARY KEY, lok BIGINT, "
+                   "cd INT, rd INT)")
+        tk.execute("INSERT INTO o VALUES (1,'HIGH'), (2,'LOW'), "
+                   "(3,'HIGH'), (4,'LOW')")
+        # line items: late (cd < rd) only for orders 1 and 2
+        tk.execute("INSERT INTO l VALUES (10, 1, 5, 9), (11, 2, 3, 4), "
+                   "(12, 3, 9, 5), (13, 4, 7, 2)")
+        rows = q(tk, "SELECT pri, COUNT(*) FROM o WHERE EXISTS ("
+                     "SELECT 1 FROM l WHERE l.lok = o.ok AND l.cd < l.rd"
+                     ") GROUP BY pri ORDER BY pri")
+        assert rows == [("HIGH", 1), ("LOW", 1)]
+
+
+class TestExplain:
+    def test_apply_in_explain(self, tk):
+        plan = "\n".join(r[0] for r in q(
+            tk, "EXPLAIN SELECT a FROM t WHERE EXISTS "
+                "(SELECT 1 FROM u WHERE u.x = t.a)"))
+        assert "Apply" in plan and "correlated" in plan
+        plan2 = "\n".join(r[0] for r in q(
+            tk, "EXPLAIN SELECT a FROM t WHERE b IN (SELECT y FROM u)"))
+        assert "Apply" in plan2 and "uncorrelated" in plan2

@@ -54,3 +54,17 @@ def test_q5(sess):
     for got, exp in zip(rows, want):
         assert got[0] == exp[0]
         _approx(got[1], exp[1])
+
+
+def test_q4(sess):
+    """EXISTS-correlated subquery through the apply executor."""
+    rows = sess.query(tpch.Q4).rows
+    want = tpch.truth_q4(sess._data)
+    assert rows == want
+
+
+def test_q6(sess):
+    rows = sess.query(tpch.Q6).rows
+    want = tpch.truth_q6(sess._data)
+    assert len(rows) == 1
+    _approx(rows[0][0], want)

@@ -23,6 +23,7 @@ NATIONS = [  # (name, region_idx)
     ("UNITED KINGDOM", 3), ("UNITED STATES", 1),
 ]
 SEGMENTS = ["AUTOMOBILE", "BUILDING", "FURNITURE", "MACHINERY", "HOUSEHOLD"]
+PRIORITIES = ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"]
 FLAGS = ["A", "N", "R"]
 STATUSES = ["F", "O"]
 
@@ -52,6 +53,7 @@ class TpchData:
         self.o_custkey = rng.integers(0, customers, orders)
         self.o_orderdate = rng.integers(0, 2405, orders)
         self.o_shippriority = np.zeros(orders, dtype=np.int64)
+        self.o_orderpriority = rng.integers(0, len(PRIORITIES), orders)
         # lineitem
         self.l_orderkey = rng.integers(0, orders, lineitems)
         self.l_suppkey = rng.integers(0, suppliers, lineitems)
@@ -75,7 +77,8 @@ CREATE TABLE customer (c_custkey BIGINT PRIMARY KEY,
                        c_nationkey BIGINT, c_mktsegment VARCHAR(10));
 CREATE TABLE supplier (s_suppkey BIGINT PRIMARY KEY, s_nationkey BIGINT);
 CREATE TABLE orders (o_orderkey BIGINT PRIMARY KEY, o_custkey BIGINT,
-                     o_orderdate DATE, o_shippriority BIGINT);
+                     o_orderdate DATE, o_shippriority BIGINT,
+                     o_orderpriority VARCHAR(15));
 CREATE TABLE lineitem (l_id BIGINT PRIMARY KEY, l_orderkey BIGINT,
                        l_suppkey BIGINT,
                        l_quantity DECIMAL(15,2),
@@ -112,7 +115,8 @@ def load(session, data: TpchData, batch=500):
                      for k in data.s_suppkey))
     ins("orders", ((str(k), str(data.o_custkey[k]),
                     f"'{_d(data.o_orderdate[k])}'",
-                    str(data.o_shippriority[k]))
+                    str(data.o_shippriority[k]),
+                    f"'{PRIORITIES[data.o_orderpriority[k]]}'")
                    for k in data.o_orderkey))
     n = len(data.l_orderkey)
     ins("lineitem", ((str(i), str(data.l_orderkey[i]),
@@ -247,3 +251,56 @@ def truth_q5(d: TpchData):
         nname = NATIONS[snat][0]
         rev[nname] = rev.get(nname, 0.0) + px
     return sorted(rev.items(), key=lambda t: -t[1])
+
+
+Q4 = """
+SELECT o_orderpriority, COUNT(*) AS order_count
+FROM orders
+WHERE o_orderdate >= DATE '1993-07-01'
+  AND o_orderdate < DATE '1993-10-01'
+  AND EXISTS (
+    SELECT 1 FROM lineitem
+    WHERE l_orderkey = o_orderkey AND l_commitdate < l_receiptdate)
+GROUP BY o_orderpriority
+ORDER BY o_orderpriority
+"""
+
+
+Q6 = """
+SELECT SUM(l_extendedprice * l_discount) AS revenue
+FROM lineitem
+WHERE l_shipdate >= DATE '1994-01-01'
+  AND l_shipdate < DATE '1995-01-01'
+  AND l_discount BETWEEN 0.05 AND 0.07
+  AND l_quantity < 24
+"""
+
+
+def truth_q4(d: TpchData):
+    lo = (datetime.date(1993, 7, 1) - _EPOCH).days
+    hi = (datetime.date(1993, 10, 1) - _EPOCH).days
+    late = set()
+    for i in range(len(d.l_orderkey)):
+        if d.l_commitdate[i] < d.l_receiptdate[i]:
+            late.add(int(d.l_orderkey[i]))
+    out = {}
+    for k in d.o_orderkey:
+        if lo <= d.o_orderdate[k] < hi and int(k) in late:
+            p = PRIORITIES[d.o_orderpriority[k]]
+            out[p] = out.get(p, 0) + 1
+    return sorted(out.items())
+
+
+def truth_q6(d: TpchData):
+    lo = (datetime.date(1994, 1, 1) - _EPOCH).days
+    hi = (datetime.date(1995, 1, 1) - _EPOCH).days
+    rev = 0.0
+    for i in range(len(d.l_orderkey)):
+        if not (lo <= d.l_shipdate[i] < hi):
+            continue
+        if not (5 <= d.l_discount[i] <= 7):
+            continue
+        if d.l_quantity[i] >= 24:
+            continue
+        rev += (d.l_extendedprice[i] / 100) * (d.l_discount[i] / 100)
+    return rev

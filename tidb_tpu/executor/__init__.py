@@ -925,7 +925,154 @@ class DeleteExec(Executor):
         return affected
 
 
+class ApplyExec(Executor):
+    """Correlated-subquery apply (ref: executor/join.go:447
+    NestedLoopApplyExec): per outer row, bind the correlated cells, run
+    the inner plan, and evaluate the EXISTS / IN / comparison predicate
+    as a filter over the outer rows. Uncorrelated inners run exactly once
+    and the predicate vectorizes over the whole chunk."""
+
+    def __init__(self, plan: ph.PhysApply):
+        self.plan = plan
+        self.schema = plan.schema
+        self.child = build_executor(plan.children[0])
+
+    def chunks(self, ctx: ExecContext):
+        plan = self.plan
+        cache = None            # uncorrelated: (vals, valid, has_rows)
+        for chunk in self.child.chunks(ctx):
+            n = chunk.num_rows
+            if n == 0:
+                continue
+            left = None
+            if plan.left is not None:
+                ld, lv = plan.left.eval(chunk)
+                left = (np.asarray(ld), np.asarray(lv))
+            if not plan.corr:
+                if cache is None:
+                    cache = self._run_inner(ctx,
+                                            first_only=plan.mode == "exists")
+                keep = self._vector_predicate(left, n, *cache)
+            else:
+                keep = np.zeros(n, dtype=bool)
+                for i in range(n):
+                    for oi, cell in plan.corr:
+                        c = chunk.columns[oi]
+                        cell.cell[0] = c.data[i]
+                        cell.cell[1] = bool(c.valid[i])
+                    vals, valid, has = self._run_inner(
+                        ctx, first_only=plan.mode == "exists")
+                    row_left = None if left is None else \
+                        (left[0][i:i + 1], left[1][i:i + 1])
+                    keep[i] = bool(self._vector_predicate(
+                        row_left, 1, vals, valid, has)[0])
+            yield chunk.filter(keep)
+
+    def _run_inner(self, ctx, first_only: bool):
+        """-> (first-column values, valid, has_rows)."""
+        exe = build_executor(self.plan.inner)
+        vals = []
+        valid = []
+        has = False
+        for ch in exe.chunks(ctx):
+            if ch.num_rows == 0:
+                continue
+            has = True
+            if first_only:
+                return None, None, True
+            c = ch.columns[0]
+            vals.append(np.asarray(c.data))
+            valid.append(np.asarray(c.valid))
+        if not vals:
+            return (np.empty(0), np.empty(0, dtype=bool), has)
+        return np.concatenate(vals), np.concatenate(valid), has
+
+    def _vector_predicate(self, left, n: int, vals, valid, has):
+        plan = self.plan
+        if plan.mode == "exists":
+            r = np.full(n, has, dtype=bool)
+            return ~r if plan.negated else r
+        if plan.mode == "cmp":
+            if not has or len(vals) == 0:
+                return np.zeros(n, dtype=bool)   # NULL -> filtered
+            if len(vals) > 1:
+                raise ExecError("Subquery returns more than 1 row")
+            return self._cmp_mask(left, n, vals, valid)
+        # IN / NOT IN with SQL three-valued logic
+        ld, lv = left
+        inner = vals[valid] if len(vals) else vals
+        has_null = bool((~valid).any()) if len(valid) else False
+        ld, inner = self._norm_in_sides(ld, inner)
+        if len(inner) and inner.dtype != np.dtype(object) and \
+                ld.dtype != np.dtype(object):
+            match = np.isin(ld, inner)
+        else:
+            pool = set(inner.tolist())
+            match = np.array([v in pool for v in ld], dtype=bool)
+        if plan.negated:
+            # NOT IN: TRUE only for valid left, no match, and no NULLs
+            # in the subquery result (else NULL)
+            if has_null:
+                return np.zeros(n, dtype=bool)
+            return lv & ~match
+        return lv & match
+
+    def _norm_in_sides(self, ld, inner):
+        """Bring both IN sides to one comparable representation (mirrors
+        HashJoinExec key normalization): decimals compare at a common
+        scale, mixed numeric compares as double."""
+        lft = self.plan.left.ft
+        ift = self.plan.inner.schema.cols[0].ft
+        let, iet = lft.eval_type, ift.eval_type
+        if np.dtype(object) in (getattr(ld, "dtype", None),
+                                getattr(inner, "dtype", None)):
+            return ld, inner
+        lfrac = lft.frac if let == EvalType.DECIMAL else 0
+        ifrac = ift.frac if iet == EvalType.DECIMAL else 0
+        if let == iet and lfrac == ifrac:
+            return ld, inner
+        def to_f(d, frac):
+            return np.asarray(d).astype(np.float64) / (10.0 ** frac)
+        return to_f(ld, lfrac), to_f(inner, ifrac)
+
+    def _cmp_mask(self, left, n: int, vals, valid):
+        plan = self.plan
+        if not bool(valid[0]):
+            return np.zeros(n, dtype=bool)       # NULL scalar
+        ld, lv = left
+        ift = plan.inner.schema.cols[0].ft
+        v = vals[0]
+        rhs_d = np.full(n, v, dtype=vals.dtype) if \
+            vals.dtype != np.dtype(object) else np.full(n, v, dtype=object)
+        # compare through the expression layer for type-correct semantics
+        lexpr = _ArrayExpr(plan.left.ft, ld, lv)
+        rexpr = _ArrayExpr(ift, rhs_d, np.ones(n, dtype=bool))
+        from tidb_tpu.expression.core import func as _f
+        d, vmask = _f(plan.cmp_op, lexpr, rexpr).eval_xp(np, [], n)
+        out = np.asarray(d).astype(bool) & np.asarray(vmask)
+        return ~out & np.asarray(vmask) if plan.negated else out
+
+
+class _ArrayExpr(Expression):
+    """Adapter: a precomputed (data, valid) pair as an Expression leaf."""
+
+    def __init__(self, ft, data, valid):
+        self.ft = ft
+        self._d = data
+        self._v = valid
+
+    def eval_xp(self, xp, cols, n):
+        return self._d, self._v
+
+    def columns_used(self):
+        return set()
+
+    def is_device_safe(self):
+        return False
+
+
 _BUILDERS = {
+    ph.PhysApply: ApplyExec,
     ph.PhysTableReader: TableReaderExec,
     ph.PhysIndexReader: IndexReaderExec,
     ph.PhysIndexLookUp: IndexLookUpExec,

@@ -143,6 +143,47 @@ class ColumnRef(Expression):
         return hash(("col", self.idx))
 
 
+class CorrelatedCol(Expression):
+    """A reference to an OUTER query's column inside a subquery plan
+    (ref: expression.CorrelatedColumn — a column whose value is bound per
+    outer row by the apply executor). `cell` is the shared mutable
+    [value, valid] slot the ApplyExec writes before each inner run; values
+    live in the chunk layer (raw int64/float64/str; decimals scaled)."""
+
+    def __init__(self, ft: FieldType, name: str = ""):
+        self.ft = ft
+        self.name = name
+        self.cell = [None, False]
+
+    def eval_xp(self, xp, cols, n):
+        import numpy as _np
+        v, valid = self.cell
+        dtype = np_dtype_for(self.ft.tp)
+        if not valid:
+            data = _np.zeros(n, dtype=dtype) if dtype != _np.dtype(object) \
+                else _np.full(n, "", dtype=object)
+            return xp.asarray(data) if dtype != _np.dtype(object) else data, \
+                (xp.zeros(n, dtype=bool) if xp is not _np
+                 else _np.zeros(n, dtype=bool))
+        if dtype == _np.dtype(object):
+            return _np.full(n, v, dtype=object), _np.ones(n, dtype=bool)
+        data = _np.full(n, v, dtype=dtype)
+        return xp.asarray(data), (xp.ones(n, dtype=bool) if xp is not _np
+                                  else _np.ones(n, dtype=bool))
+
+    def columns_used(self):
+        return set()            # references the OUTER plan, not this one
+
+    def map_columns(self, mapping):
+        return self
+
+    def is_device_safe(self):
+        return False            # rebound per outer row: host path only
+
+    def __repr__(self):
+        return f"corr({self.name or '?'})"
+
+
 @dataclass
 class Constant(Expression):
     value: Any

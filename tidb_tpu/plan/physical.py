@@ -19,8 +19,8 @@ from tidb_tpu.schema.model import ColumnInfo, IndexInfo, TableInfo
 __all__ = ["CopPlan", "PhysPlan", "PhysTableReader", "PhysIndexReader",
            "PhysIndexLookUp", "PhysPointGet", "PhysSelection",
            "PhysProjection", "PhysHashAgg", "PhysFinalAgg", "PhysHashJoin",
-           "PhysSort", "PhysLimit", "PhysTopN", "PhysInsert", "PhysUpdate",
-           "PhysDelete", "PhysValues"]
+           "PhysApply", "PhysSort", "PhysLimit", "PhysTopN", "PhysInsert",
+           "PhysUpdate", "PhysDelete", "PhysValues"]
 
 
 @dataclass
@@ -183,6 +183,28 @@ class PhysHashJoin(PhysPlan):
     def _explain_info(self):
         return (f" type:{self.join_type} lkeys:{self.left_keys!r} "
                 f"rkeys:{self.right_keys!r}")
+
+
+@dataclass
+class PhysApply(PhysPlan):
+    """Correlated-subquery apply: for each outer row, bind the correlated
+    cells and run the inner plan; the predicate decides whether the row
+    survives (ref: executor/join.go:447 NestedLoopApplyExec). With no
+    correlated cells the inner runs once and the predicate vectorizes
+    (the reference's uncorrelated EvalSubquery rewrite)."""
+
+    inner: "PhysPlan" = None
+    mode: str = "exists"           # exists | in | cmp
+    negated: bool = False
+    left: Optional[Expression] = None      # IN target / cmp left side
+    cmp_op: Optional[object] = None        # expression Op for cmp mode
+    corr: list = field(default_factory=list)   # [(outer_idx, CorrelatedCol)]
+
+    def _explain_info(self):
+        neg = "not " if self.negated else ""
+        corr = "correlated" if self.corr else "uncorrelated"
+        info = f" {neg}{self.mode} ({corr})"
+        return info + "\n" + self.inner.explain(2)
 
 
 @dataclass

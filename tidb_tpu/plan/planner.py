@@ -215,6 +215,12 @@ class Planner:
         if isinstance(plan, ph.PhysSelection):
             plan.cond = func(Op.AND, plan.cond, cond)
             return plan
+        if isinstance(plan, ph.PhysApply):
+            # sink plain predicates below the apply (same outer schema):
+            # the correlated inner then runs only for surviving rows
+            plan.children[0] = self._assign_cond(plan.children[0], cond,
+                                                 where_phase)
+            return plan
         return ph.PhysSelection(schema=plan.schema, children=[plan],
                                 cond=cond)
 
@@ -372,9 +378,13 @@ class Planner:
             return self._plan_select_no_from(stmt)
         plan = self.build_from(stmt.from_clause)
         # WHERE
-        r = Resolver(plan.schema)
         for c_ast in split_conjuncts(stmt.where):
-            plan = self._assign_cond(plan, r.resolve(c_ast),
+            applied = self._try_subquery_conjunct(plan, c_ast)
+            if applied is not None:
+                plan = applied
+                continue
+            plan = self._assign_cond(plan,
+                                     Resolver(plan.schema).resolve(c_ast),
                                      where_phase=True)
 
         has_agg = bool(stmt.group_by) or _contains_agg(stmt)
@@ -448,6 +458,74 @@ class Planner:
                              for n, e in zip(names, exprs)])
         vals = ph.PhysValues(schema=schema, rows=[exprs])
         return vals
+
+    # -- subquery conjuncts (ref: plan/expression_rewriter.go subquery
+    # handling + decorrelateSolver; here: apply-style, uncorrelated inner
+    # plans run once in the executor) -----------------------------------------
+
+    _CMP_OPS = {"=": Op.EQ, "<": Op.LT, "<=": Op.LE, ">": Op.GT,
+                ">=": Op.GE, "<>": Op.NE, "!=": Op.NE}
+
+    def _try_subquery_conjunct(self, plan: ph.PhysPlan, c_ast
+                               ) -> ph.PhysApply | None:
+        """Recognize EXISTS / IN (SELECT) / <cmp> (SELECT) conjuncts and
+        rewrite them to a PhysApply over `plan`. Returns None when the
+        conjunct contains no subquery (normal resolution proceeds)."""
+        negate = False
+        node = c_ast
+        while isinstance(node, ast.UnaryOp) and node.op == "NOT":
+            negate = not negate
+            node = node.operand
+
+        if isinstance(node, ast.ExistsSubquery):
+            inner, corr = self._plan_subquery(plan.schema, node.select)
+            return ph.PhysApply(schema=plan.schema, children=[plan],
+                                inner=inner, mode="exists",
+                                negated=negate != node.negated, corr=corr)
+
+        if isinstance(node, ast.InExpr) and \
+                isinstance(node.items, ast.SubqueryExpr):
+            inner, corr = self._plan_subquery(plan.schema,
+                                              node.items.select)
+            if len(inner.schema.cols) != 1:
+                raise PlanError("subquery must return 1 column for IN")
+            left = Resolver(plan.schema).resolve(node.expr)
+            return ph.PhysApply(schema=plan.schema, children=[plan],
+                                inner=inner, mode="in",
+                                negated=negate != node.negated,
+                                left=left, corr=corr)
+
+        if isinstance(node, ast.BinaryOp) and node.op in self._CMP_OPS:
+            lhs_sub = isinstance(node.left, ast.SubqueryExpr)
+            rhs_sub = isinstance(node.right, ast.SubqueryExpr)
+            if lhs_sub == rhs_sub:          # neither (or both: unsupported)
+                if lhs_sub:
+                    raise PlanError("subquery on both comparison sides")
+                return None
+            sub = node.left if lhs_sub else node.right
+            other = node.right if lhs_sub else node.left
+            op = self._CMP_OPS[node.op]
+            if lhs_sub:                     # flip: keep subquery on the right
+                op = {Op.LT: Op.GT, Op.LE: Op.GE, Op.GT: Op.LT,
+                      Op.GE: Op.LE}.get(op, op)
+            inner, corr = self._plan_subquery(plan.schema, sub.select)
+            if len(inner.schema.cols) != 1:
+                raise PlanError("scalar subquery must return 1 column")
+            left = Resolver(plan.schema).resolve(other)
+            return ph.PhysApply(schema=plan.schema, children=[plan],
+                                inner=inner, mode="cmp", negated=negate,
+                                left=left, cmp_op=op, corr=corr)
+        return None
+
+    def _plan_subquery(self, outer_schema: PlanSchema, sub_select):
+        """Plan an inner SELECT with the outer schema visible for
+        correlated column resolution."""
+        from tidb_tpu.plan.resolver import push_outer
+        with push_outer(outer_schema) as scope:
+            inner = Planner(self.ischema, self.db,
+                            stats_handle=self.stats).plan(sub_select)
+        corr = sorted(scope.cells.items())
+        return inner, corr
 
     # -- fields / projection -------------------------------------------------
 

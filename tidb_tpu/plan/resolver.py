@@ -22,6 +22,53 @@ class ResolveError(Exception):
     pass
 
 
+class ColumnAmbiguousError(ResolveError):
+    """Ambiguity is a hard error even when an outer scope could resolve
+    the name — never silently correlate an ambiguous column."""
+
+
+# ---------------------------------------------------------------------------
+# Outer-scope stack for correlated subqueries. While a subquery's plan is
+# being built, the outer plan's schema sits on this stack; any name that
+# fails to resolve locally is looked up outward and becomes a shared
+# CorrelatedCol cell the apply executor binds per outer row (ref:
+# expression_rewriter.go b.outerSchemas). Thread-local: each server
+# connection plans on its own thread.
+
+
+@dataclass
+class OuterScope:
+    schema: PlanSchema
+    cells: dict = field(default_factory=dict)   # outer_idx -> CorrelatedCol
+
+
+import threading as _threading
+
+_scopes_tls = _threading.local()
+
+
+def _outer_scopes() -> list:
+    stack = getattr(_scopes_tls, "stack", None)
+    if stack is None:
+        stack = _scopes_tls.stack = []
+    return stack
+
+
+class push_outer:
+    """Context manager exposing an outer schema to subquery resolution."""
+
+    def __init__(self, schema: PlanSchema):
+        self.scope = OuterScope(schema)
+
+    def __enter__(self) -> OuterScope:
+        _outer_scopes().append(self.scope)
+        return self.scope
+
+    def __exit__(self, *exc):
+        _outer_scopes().pop()
+        return False
+
+
 @dataclass
 class SchemaCol:
     name: str                 # lower column/alias name
@@ -42,7 +89,7 @@ class PlanSchema:
         if not hits:
             raise ResolveError(f"Unknown column '{name}'")
         if len(hits) > 1:
-            raise ResolveError(f"Column '{name}' is ambiguous")
+            raise ColumnAmbiguousError(f"Column '{name}' is ambiguous")
         return hits[0]
 
     def merge(self, other: "PlanSchema") -> "PlanSchema":
@@ -108,7 +155,24 @@ class Resolver:
         return const(v)
 
     def _r_ColName(self, e: ast.ColName) -> Expression:
-        idx = self.schema.find(e.name, e.table)
+        try:
+            idx = self.schema.find(e.name, e.table)
+        except ColumnAmbiguousError:
+            raise
+        except ResolveError:
+            for scope in reversed(_outer_scopes()):
+                try:
+                    oi = scope.schema.find(e.name, e.table)
+                except ResolveError:
+                    continue
+                cc = scope.cells.get(oi)
+                if cc is None:
+                    from tidb_tpu.expression.core import CorrelatedCol
+                    sc = scope.schema.cols[oi]
+                    cc = CorrelatedCol(sc.ft, name=sc.name)
+                    scope.cells[oi] = cc
+                return cc
+            raise
         sc = self.schema.cols[idx]
         return ColumnRef(idx, sc.ft, name=sc.name)
 

@@ -273,7 +273,10 @@ class Session:
             raise SQLError(str(e)) from None
         ctx = ExecContext(self.storage, self._read_ts(), self.txn)
         exe = build_executor(plan)
-        chunks = list(exe.chunks(ctx))
+        try:
+            chunks = list(exe.chunks(ctx))
+        except ExecError as e:
+            raise SQLError(str(e)) from None
         names = [c.name for c in plan.schema.cols]
         rows = []
         for ch in chunks:
