@@ -120,6 +120,127 @@ class MiniClient:
                 out.append(v.decode())
         return tuple(out)
 
+    # -- binary protocol (prepared statements) ------------------------------
+
+    def stmt_prepare(self, sql: str):
+        """-> (stmt_id, num_params)"""
+        first = self._command(0x16, sql.encode())
+        if first[0] == 0xFF:
+            raise self._err(first)
+        sid = struct.unpack_from("<I", first, 1)[0]
+        ncols = struct.unpack_from("<H", first, 5)[0]
+        nparams = struct.unpack_from("<H", first, 7)[0]
+        for _ in range(nparams):
+            self.pkt.read_packet()           # param definitions
+        if nparams:
+            self.pkt.read_packet()           # EOF
+        for _ in range(ncols):
+            self.pkt.read_packet()
+        if ncols:
+            self.pkt.read_packet()
+        return sid, nparams
+
+    def stmt_execute(self, sid: int, params=()):
+        """-> (columns, rows) or affected-rows int. Params typed by python
+        value: int -> LONGLONG, float -> DOUBLE, else VARCHAR."""
+        body = struct.pack("<IBI", sid, 0, 1)
+        n = len(params)
+        null_bitmap = bytearray((n + 7) // 8)
+        types = b""
+        values = b""
+        for i, p in enumerate(params):
+            if p is None:
+                null_bitmap[i // 8] |= 1 << (i % 8)
+                types += bytes([6, 0])       # MYSQL_TYPE_NULL
+            elif isinstance(p, int):
+                types += bytes([8, 0])       # LONGLONG
+                values += struct.pack("<q", p)
+            elif isinstance(p, float):
+                types += bytes([5, 0])       # DOUBLE
+                values += struct.pack("<d", p)
+            else:
+                types += bytes([15, 0])      # VARCHAR
+                raw = str(p).encode("utf8")
+                values += bytes([len(raw)]) if len(raw) < 251 else b""
+                if len(raw) >= 251:
+                    raise ValueError("long param strings unsupported here")
+                values += raw
+        if n:
+            body += bytes(null_bitmap) + b"\x01" + types + values
+        first = self._command(0x17, body)
+        if first[0] == 0xFF:
+            raise self._err(first)
+        if first[0] == 0x00:                 # OK packet (no resultset)
+            affected, _ = read_lenenc_int(first, 1)
+            return affected
+        ncols, _ = read_lenenc_int(first, 0)
+        cols = []
+        for _ in range(ncols):
+            cols.append(self._parse_coldef(self.pkt.read_packet()))
+        eof = self.pkt.read_packet()
+        assert eof[0] == 0xFE
+        rows = []
+        while True:
+            pkt = self.pkt.read_packet()
+            if pkt[0] == 0xFE and len(pkt) < 9:
+                break
+            if pkt[0] == 0xFF:
+                raise self._err(pkt)
+            rows.append(self._parse_binary_row(pkt, cols))
+        return [c for c, _t in cols], rows
+
+    def stmt_close(self, sid: int) -> None:
+        self.pkt.reset_seq()
+        self.pkt.write_packet(bytes([0x19]) + struct.pack("<I", sid))
+
+    @staticmethod
+    def _parse_binary_row(pkt: bytes, cols) -> tuple:
+        ncols = len(cols)
+        nb = (ncols + 9) // 8
+        bitmap = pkt[1:1 + nb]
+        off = 1 + nb
+        out = []
+        for i, (_name, tp) in enumerate(cols):
+            if bitmap[(i + 2) // 8] & (1 << ((i + 2) % 8)):
+                out.append(None)
+                continue
+            if tp == 8:                      # LONGLONG
+                out.append(struct.unpack_from("<q", pkt, off)[0])
+                off += 8
+            elif tp in (3, 9):               # LONG / INT24
+                out.append(struct.unpack_from("<i", pkt, off)[0])
+                off += 4
+            elif tp in (2, 13):
+                out.append(struct.unpack_from("<h", pkt, off)[0])
+                off += 2
+            elif tp == 1:
+                out.append(struct.unpack_from("<b", pkt, off)[0])
+                off += 1
+            elif tp == 5:                    # DOUBLE
+                out.append(struct.unpack_from("<d", pkt, off)[0])
+                off += 8
+            elif tp == 4:                    # FLOAT
+                out.append(struct.unpack_from("<f", pkt, off)[0])
+                off += 4
+            elif tp in (7, 10, 12):          # TIMESTAMP/DATE/DATETIME
+                ln = pkt[off]
+                off += 1
+                y = mo = d = h = mi = s = 0
+                if ln >= 4:
+                    y, mo, d = struct.unpack_from("<HBB", pkt, off)
+                if ln >= 7:
+                    h, mi, s = struct.unpack_from("<BBB", pkt, off + 4)
+                off += ln
+                if ln <= 4:
+                    out.append(f"{y:04d}-{mo:02d}-{d:02d}")
+                else:
+                    out.append(f"{y:04d}-{mo:02d}-{d:02d} "
+                               f"{h:02d}:{mi:02d}:{s:02d}")
+            else:                            # lenenc string
+                raw, off = read_lenenc_bytes(pkt, off)
+                out.append(raw.decode())
+        return tuple(out)
+
     def close(self) -> None:
         try:
             self.pkt.reset_seq()

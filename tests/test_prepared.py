@@ -1,0 +1,138 @@
+"""Prepared statements: session API, SQL PREPARE/EXECUTE, binary protocol,
+plan cache.
+
+Ref model: session.go:777-855 prepared stmt lifecycle, server/conn_stmt.go
+binary protocol, plan/cache.go + util/kvcache plan cache.
+"""
+
+import pytest
+
+from tests.mysql_client import MiniClient, MySQLError
+from tidb_tpu.server import Server
+from tidb_tpu.session import Session, SQLError
+from tidb_tpu.store import new_mock_storage
+
+
+@pytest.fixture
+def tk():
+    storage = new_mock_storage()
+    storage.async_commit_secondaries = False
+    s = Session(storage)
+    s.execute("CREATE DATABASE test; USE test")
+    s.execute("CREATE TABLE t (a BIGINT PRIMARY KEY, b INT, s VARCHAR(20))")
+    s.execute("INSERT INTO t VALUES (1, 10, 'x'), (2, 20, 'y'), "
+              "(3, 30, 'z')")
+    yield s
+    s.close()
+    storage.close()
+
+
+class TestSessionAPI:
+    def test_prepare_execute(self, tk):
+        sid, nparams = tk.prepare("SELECT b FROM t WHERE a = ?")
+        assert nparams == 1
+        assert tk.execute_prepared(sid, [2]).rows == [(20,)]
+        assert tk.execute_prepared(sid, [3]).rows == [(30,)]
+
+    def test_param_count_mismatch(self, tk):
+        sid, _ = tk.prepare("SELECT b FROM t WHERE a = ? AND b > ?")
+        with pytest.raises(SQLError, match="parameters"):
+            tk.execute_prepared(sid, [1])
+
+    def test_prepared_dml(self, tk):
+        sid, _ = tk.prepare("INSERT INTO t VALUES (?, ?, ?)")
+        assert tk.execute_prepared(sid, [4, 40, "w"]) == 1
+        assert tk.query("SELECT b FROM t WHERE a = 4").rows == [(40,)]
+
+    def test_deallocate(self, tk):
+        sid, _ = tk.prepare("SELECT 1")
+        tk.deallocate_prepared(sid)
+        with pytest.raises(SQLError, match="unknown prepared"):
+            tk.execute_prepared(sid, [])
+
+
+class TestSQLSyntax:
+    def test_prepare_execute_using(self, tk):
+        tk.execute("PREPARE ps FROM 'SELECT s FROM t WHERE a = ?'")
+        tk.execute("SET @k = 2")
+        assert tk.query("EXECUTE ps USING @k").rows == [("y",)]
+        tk.execute("SET @k = 1")
+        assert tk.query("EXECUTE ps USING @k").rows == [("x",)]
+        tk.execute("DEALLOCATE PREPARE ps")
+        with pytest.raises(SQLError):
+            tk.execute("EXECUTE ps USING @k")
+
+
+class TestPlanCache:
+    def test_identical_select_hits_cache(self, tk):
+        cache = tk.domain.plan_cache()
+        cache.clear()
+        sql = "SELECT b FROM t WHERE a = 2"
+        r1 = tk.query(sql).rows
+        m0 = cache.hits
+        r2 = tk.query(sql).rows
+        assert r1 == r2 == [(20,)]
+        assert cache.hits == m0 + 1
+
+    def test_cache_invalidated_by_ddl(self, tk):
+        cache = tk.domain.plan_cache()
+        sql = "SELECT b FROM t WHERE a = 2"
+        assert tk.query(sql).rows == [(20,)]
+        tk.execute("ALTER TABLE t ADD COLUMN c INT DEFAULT 5")
+        # schema version moved: new key, fresh plan, correct result
+        assert tk.query(sql).rows == [(20,)]
+        assert tk.query("SELECT c FROM t WHERE a = 2").rows == [(5,)]
+
+    def test_dml_visibility_not_broken_by_cache(self, tk):
+        sql = "SELECT COUNT(*) FROM t"
+        assert tk.query(sql).rows == [(3,)]
+        tk.execute("INSERT INTO t VALUES (9, 90, 'q')")
+        assert tk.query(sql).rows == [(4,)]
+
+
+class TestBinaryProtocol:
+    @pytest.fixture
+    def srv(self):
+        storage = new_mock_storage()
+        storage.async_commit_secondaries = False
+        server = Server(storage, port=0)
+        server.start()
+        boot = MiniClient("127.0.0.1", server.port)
+        boot.query("CREATE DATABASE test")
+        boot.use("test")
+        boot.query("CREATE TABLE t (a BIGINT PRIMARY KEY, b DOUBLE, "
+                   "s VARCHAR(20), d DATE)")
+        boot.query("INSERT INTO t VALUES (1, 1.5, 'x', '2024-03-01'), "
+                   "(2, 2.5, 'y', '2024-04-01'), (3, NULL, NULL, NULL)")
+        boot.close()
+        yield server
+        server.close()
+        storage.close()
+
+    def test_stmt_roundtrip(self, srv):
+        c = MiniClient("127.0.0.1", srv.port, db="test")
+        sid, nparams = c.stmt_prepare("SELECT a, b, s, d FROM t "
+                                      "WHERE a = ?")
+        assert nparams == 1
+        cols, rows = c.stmt_execute(sid, [1])
+        assert cols == ["a", "b", "s", "d"]
+        assert rows == [(1, 1.5, "x", "2024-03-01")]
+        cols, rows = c.stmt_execute(sid, [3])
+        assert rows == [(3, None, None, None)]
+        c.stmt_close(sid)
+        c.close()
+
+    def test_stmt_params_typed(self, srv):
+        c = MiniClient("127.0.0.1", srv.port, db="test")
+        sid, _ = c.stmt_prepare("SELECT a FROM t WHERE b > ? AND s = ?")
+        _cols, rows = c.stmt_execute(sid, [2.0, "y"])
+        assert rows == [(2,)]
+        c.close()
+
+    def test_stmt_dml(self, srv):
+        c = MiniClient("127.0.0.1", srv.port, db="test")
+        sid, _ = c.stmt_prepare("INSERT INTO t VALUES (?, ?, ?, ?)")
+        assert c.stmt_execute(sid, [7, 7.5, "w", "2024-05-01"]) == 1
+        _cols, rows = c.query("SELECT s FROM t WHERE a = 7")
+        assert rows == [("w",)]
+        c.close()

@@ -29,7 +29,7 @@ __all__ = [
     "AlterTableStmt", "AlterSpec", "TruncateTableStmt", "RenameTableStmt",
     "UseStmt", "BeginStmt", "CommitStmt", "RollbackStmt",
     "SetStmt", "VarAssignment", "ShowStmt", "ExplainStmt", "AnalyzeStmt",
-    "AdminStmt",
+    "AdminStmt", "PrepareStmt", "ExecuteStmt", "DeallocateStmt",
 ]
 
 
@@ -169,6 +169,9 @@ class DefaultExpr(ExprNode):
 @dataclass
 class ParamMarker(ExprNode):
     index: int = 0
+    # bound by the session before planning a prepared execution
+    value: object = None
+    bound: bool = False
 
 
 # ---------------------------------------------------------------------------
@@ -431,6 +434,23 @@ class ExplainStmt(StmtNode):
 @dataclass
 class AnalyzeStmt(StmtNode):
     tables: list = field(default_factory=list)
+
+
+@dataclass
+class PrepareStmt(StmtNode):
+    name: str = ""
+    sql: str = ""                  # the statement text to prepare
+
+
+@dataclass
+class ExecuteStmt(StmtNode):
+    name: str = ""
+    using: list = field(default_factory=list)   # user variable names
+
+
+@dataclass
+class DeallocateStmt(StmtNode):
+    name: str = ""
 
 
 @dataclass

@@ -56,6 +56,7 @@ KEYWORDS = {
     "GRANT", "REVOKE", "PRIVILEGES", "IDENTIFIED", "WITH", "OPTION",
     "FOR", "FORCE", "IGNORE", "LOW_PRIORITY", "HIGH_PRIORITY", "QUICK",
     "PARTITION", "TEMPORARY", "EXTENDED",
+    "PREPARE", "EXECUTE", "DEALLOCATE",
 }
 
 

@@ -170,6 +170,30 @@ class Parser:
                 return ast.ShowStmt(tp="columns", table=self.table_name())
             self.try_kw("EXTENDED")
             return ast.ExplainStmt(stmt=self.statement())
+        if kw == "PREPARE":
+            self.next()
+            name = self.ident()
+            self.expect_kw("FROM")
+            tok = self.next()
+            if tok.tp != TokenType.STRING:
+                raise ParseError("PREPARE requires a string literal")
+            return ast.PrepareStmt(name=name, sql=tok.val)
+        if kw == "EXECUTE":
+            self.next()
+            name = self.ident()
+            using = []
+            if self.try_kw("USING"):
+                while True:
+                    if not self.try_op("@"):
+                        raise ParseError("EXECUTE USING takes @variables")
+                    using.append("@" + self.ident())
+                    if not self.try_op(","):
+                        break
+            return ast.ExecuteStmt(name=name, using=using)
+        if kw == "DEALLOCATE":
+            self.next()
+            self.expect_kw("PREPARE")
+            return ast.DeallocateStmt(name=self.ident())
         if kw == "ANALYZE":
             self.next()
             self.expect_kw("TABLE")

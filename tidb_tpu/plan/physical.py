@@ -51,6 +51,7 @@ class PhysPlan:
     children: list = field(default_factory=list)
 
     est_rows = None   # CBO row estimate, set by the planner when stats exist
+    cacheable = True  # False when plan-time folds are volatile (NOW(), ...)
 
     def explain(self, depth: int = 0) -> str:
         name = type(self).__name__.replace("Phys", "")

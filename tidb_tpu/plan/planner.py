@@ -87,7 +87,11 @@ class Planner:
 
     def plan(self, stmt: ast.StmtNode) -> ph.PhysPlan:
         if isinstance(stmt, ast.SelectStmt):
-            return self._opt_access(self.plan_select(stmt))
+            from tidb_tpu.plan.resolver import reset_volatile, was_volatile
+            reset_volatile()
+            p = self._opt_access(self.plan_select(stmt))
+            p.cacheable = not was_volatile()
+            return p
         if isinstance(stmt, ast.InsertStmt):
             p = self.plan_insert(stmt)
             if p.source is not None:

@@ -54,6 +54,20 @@ def _outer_scopes() -> list:
     return stack
 
 
+def reset_volatile() -> None:
+    """Planner calls this before building; volatile folds (NOW(), ...)
+    mark the flag so the resulting plan is never cached."""
+    _scopes_tls.volatile = False
+
+
+def mark_volatile() -> None:
+    _scopes_tls.volatile = True
+
+
+def was_volatile() -> bool:
+    return getattr(_scopes_tls, "volatile", False)
+
+
 class push_outer:
     """Context manager exposing an outer schema to subquery resolution."""
 
@@ -163,6 +177,8 @@ class Resolver:
             for scope in reversed(_outer_scopes()):
                 try:
                     oi = scope.schema.find(e.name, e.table)
+                except ColumnAmbiguousError:
+                    raise   # ambiguity is a hard error at EVERY scope
                 except ResolveError:
                     continue
                 cc = scope.cells.get(oi)
@@ -297,6 +313,7 @@ class Resolver:
                                 st.new_date_field())
             return a
         if name == "NOW" or name == "CURRENT_TIMESTAMP":
+            mark_volatile()   # folded at plan time: such plans never cache
             return Constant(st.datetime_to_micros(_dt.datetime.now()),
                             st.new_datetime_field())
         if name == "DATABASE":
@@ -376,7 +393,10 @@ class Resolver:
         raise ResolveError("DEFAULT only valid in INSERT values")
 
     def _r_ParamMarker(self, e):
-        raise ResolveError("parameter markers resolve in prepared stmts")
+        if not e.bound:
+            raise ResolveError("unbound parameter marker (use EXECUTE "
+                               "with USING, or the binary protocol)")
+        return const(e.value)
 
     def _r_Star(self, e):
         raise ResolveError("* only valid in select list")

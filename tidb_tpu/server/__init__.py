@@ -55,6 +55,10 @@ COM_INIT_DB = 0x02
 COM_QUERY = 0x03
 COM_FIELD_LIST = 0x04
 COM_PING = 0x0E
+COM_STMT_PREPARE = 0x16
+COM_STMT_EXECUTE = 0x17
+COM_STMT_CLOSE = 0x19
+COM_STMT_RESET = 0x1A
 
 ER_UNKNOWN = 1105
 
@@ -137,6 +141,21 @@ class Server:
             t.join(timeout=5)
 
 
+def _binary_datetime(s: str) -> bytes:
+    """'YYYY-MM-DD[ HH:MM:SS[.ffffff]]' -> binary date/datetime value."""
+    date_part, _, time_part = s.partition(" ")
+    y, mo, d = (int(x) for x in date_part.split("-"))
+    if not time_part:
+        return bytes([4]) + struct.pack("<HBB", y, mo, d)
+    hms, _, frac = time_part.partition(".")
+    h, mi, sec = (int(x) for x in hms.split(":"))
+    if frac:
+        micros = int(frac.ljust(6, "0")[:6])
+        return bytes([11]) + struct.pack("<HBBBBBI", y, mo, d, h, mi, sec,
+                                         micros)
+    return bytes([7]) + struct.pack("<HBBBBB", y, mo, d, h, mi, sec)
+
+
 class ClientConn:
     """One connection: handshake, then dispatch loop (ref: conn.go:401)."""
 
@@ -148,6 +167,8 @@ class ClientConn:
         self.session: Session | None = None
         self.capabilities = 0
         self._close_mu = threading.Lock()
+        self._param_counts: dict[int, int] = {}   # stmt_id -> num params
+        self._param_types: dict[int, list] = {}   # stmt_id -> bound types
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -247,6 +268,17 @@ class ClientConn:
             self._handle_query(data.decode())
         elif cmd == COM_FIELD_LIST:
             self._write_eof()
+        elif cmd == COM_STMT_PREPARE:
+            self._handle_stmt_prepare(data.decode())
+        elif cmd == COM_STMT_EXECUTE:
+            self._handle_stmt_execute(data)
+        elif cmd == COM_STMT_CLOSE:
+            sid = struct.unpack_from("<I", data, 0)[0]
+            self.session.deallocate_prepared(sid)
+            self._param_counts.pop(sid, None)   # no response per protocol
+            self._param_types.pop(sid, None)
+        elif cmd == COM_STMT_RESET:
+            self._write_ok(0, 0)
         else:
             self._write_err(f"unsupported command 0x{cmd:02x}")
 
@@ -263,6 +295,145 @@ class ClientConn:
             if isinstance(r, int):
                 affected = r
         self._write_ok(affected, 0)
+
+    # -- prepared statements / binary protocol (conn_stmt.go) ----------------
+
+    def _handle_stmt_prepare(self, sql: str) -> None:
+        sid, nparams = self.session.prepare(sql)
+        self._param_counts[sid] = nparams
+        # COM_STMT_PREPARE_OK: column count deferred to execute time (the
+        # execute response always carries the column definitions)
+        pkt = b"\x00" + struct.pack("<I", sid)
+        pkt += struct.pack("<H", 0)              # num columns
+        pkt += struct.pack("<H", nparams)
+        pkt += b"\x00" + struct.pack("<H", 0)    # filler, warnings
+        self.pkt.write_packet(pkt)
+        if nparams:
+            for _ in range(nparams):
+                self.pkt.write_packet(self._column_def("?", None))
+            self._write_eof()
+
+    def _handle_stmt_execute(self, data: bytes) -> None:
+        sid = struct.unpack_from("<I", data, 0)[0]
+        nparams = self._param_counts.get(sid)
+        if nparams is None:
+            self._write_err(f"unknown statement handler {sid}")
+            return
+        params = self._decode_params(data, sid, nparams)
+        results = self.session.execute_prepared(sid, params)
+        rs = results if isinstance(results, ResultSet) else None
+        if rs is None:
+            self._write_ok(results if isinstance(results, int) else 0, 0)
+            return
+        self.pkt.write_packet(lenenc_int(len(rs.columns)))
+        fts = rs.field_types
+        for i, name in enumerate(rs.columns):
+            self.pkt.write_packet(self._column_def(
+                name, fts[i] if fts else None))
+        self._write_eof()
+        for row in rs.rows:
+            self.pkt.write_packet(self._encode_binary_row(row, fts))
+        self._write_eof()
+
+    def _decode_params(self, data: bytes, sid: int, nparams: int) -> list:
+        """Binary parameter values (conn_stmt.go parseStmtArgs). Types
+        arrive only when new_params_bound_flag is set; later executes
+        reuse the types cached per statement (boundParams semantics)."""
+        if nparams == 0:
+            return []
+        off = 4 + 1 + 4                      # stmt_id, flags, iterations
+        nb = (nparams + 7) // 8
+        null_bitmap = data[off:off + nb]
+        off += nb
+        new_bound = data[off]
+        off += 1
+        if new_bound:
+            types = []
+            for _ in range(nparams):
+                types.append((data[off], data[off + 1]))
+                off += 2
+            self._param_types[sid] = types
+        else:
+            types = self._param_types.get(sid)
+            if types is None:
+                raise SQLError("parameter types were never bound")
+        params: list = []
+        for i in range(nparams):
+            if null_bitmap[i // 8] & (1 << (i % 8)):
+                params.append(None)
+                continue
+            tp, flag = types[i]
+            unsigned = bool(flag & 0x80)
+            if tp in (int(TypeCode.LONGLONG),):
+                v = struct.unpack_from("<Q" if unsigned else "<q",
+                                       data, off)[0]
+                off += 8
+            elif tp in (int(TypeCode.LONG), int(TypeCode.INT24)):
+                v = struct.unpack_from("<I" if unsigned else "<i",
+                                       data, off)[0]
+                off += 4
+            elif tp in (int(TypeCode.SHORT), int(TypeCode.YEAR)):
+                v = struct.unpack_from("<H" if unsigned else "<h",
+                                       data, off)[0]
+                off += 2
+            elif tp == int(TypeCode.TINY):
+                v = data[off] if unsigned else \
+                    struct.unpack_from("<b", data, off)[0]
+                off += 1
+            elif tp == int(TypeCode.DOUBLE):
+                v = struct.unpack_from("<d", data, off)[0]
+                off += 8
+            elif tp == int(TypeCode.FLOAT):
+                v = struct.unpack_from("<f", data, off)[0]
+                off += 4
+            elif tp in (int(TypeCode.DATE), int(TypeCode.DATETIME),
+                        int(TypeCode.TIMESTAMP)):
+                ln = data[off]
+                off += 1
+                y = mo = d = h = mi = s = 0
+                if ln >= 4:
+                    y, mo, d = struct.unpack_from("<HBB", data, off)
+                if ln >= 7:
+                    h, mi, s = struct.unpack_from("<BBB", data, off + 4)
+                off += ln
+                v = f"{y:04d}-{mo:02d}-{d:02d} {h:02d}:{mi:02d}:{s:02d}"
+            else:                            # strings / decimals / blobs
+                raw, off = read_lenenc_bytes(data, off)
+                v = raw.decode("utf8", "replace")
+            params.append(v)
+        return params
+
+    @staticmethod
+    def _encode_binary_row(row, fts) -> bytes:
+        """Binary resultset row (conn.go writeBinaryRow)."""
+        ncols = len(row)
+        null_bitmap = bytearray((ncols + 9) // 8)
+        out = b""
+        for i, v in enumerate(row):
+            if v is None:
+                null_bitmap[(i + 2) // 8] |= 1 << ((i + 2) % 8)
+                continue
+            tp = int(fts[i].tp) if fts else int(TypeCode.VARCHAR)
+            # width follows the DECLARED column type (protocol rule)
+            if tp == int(TypeCode.LONGLONG):
+                out += struct.pack("<q", int(v))
+            elif tp in (int(TypeCode.LONG), int(TypeCode.INT24)):
+                out += struct.pack("<i", int(v))
+            elif tp in (int(TypeCode.SHORT), int(TypeCode.YEAR)):
+                out += struct.pack("<h", int(v))
+            elif tp == int(TypeCode.TINY):
+                out += struct.pack("<b", int(v))
+            elif tp == int(TypeCode.DOUBLE):
+                out += struct.pack("<d", float(v))
+            elif tp == int(TypeCode.FLOAT):
+                out += struct.pack("<f", float(v))
+            elif tp in (int(TypeCode.DATE), int(TypeCode.DATETIME),
+                        int(TypeCode.TIMESTAMP)):
+                out += _binary_datetime(str(v))
+            else:                            # varchar/char/blob/decimal
+                s = v if isinstance(v, bytes) else str(v).encode("utf8")
+                out += lenenc_bytes(s)
+        return b"\x00" + bytes(null_bitmap) + out
 
     # -- response writers (conn.go writeOK/writeError/writeResultset) -------
 

@@ -55,6 +55,7 @@ class Domain:
         self._schema: InfoSchema | None = None
         self._mu = threading.Lock()
         self._stats = None
+        self._plan_cache = None
 
     def stats_handle(self):
         """Lazy per-store stats cache (ref: statistics/handle.go:32)."""
@@ -62,6 +63,15 @@ class Domain:
             from tidb_tpu.statistics import StatsHandle
             self._stats = StatsHandle(self.storage)
         return self._stats
+
+    def plan_cache(self):
+        """Shared LRU of compiled SELECT plans keyed by (sql, db,
+        schema version, stats version) — ref: plan/cache.go + the
+        kvcache-backed plan cache wired in tidb-server/main.go:349."""
+        if self._plan_cache is None:
+            from tidb_tpu.util import LRUCache
+            self._plan_cache = LRUCache(200)
+        return self._plan_cache
 
     @classmethod
     def get(cls, storage) -> "Domain":
@@ -119,6 +129,8 @@ class Session:
         self.sys_vars: dict[str, object] = {"autocommit": 1,
                                             "sql_mode": "STRICT_TRANS_TABLES"}
         self._history: list[ast.StmtNode] = []  # stmt replay for retry
+        self._prepared: dict = {}               # id/name -> _Prepared
+        self._next_stmt_id = 0
 
     # -- public API ----------------------------------------------------------
 
@@ -127,9 +139,50 @@ class Session:
         ResultSet (queries) / int (affected rows) / None (commands)."""
         stmts = parse(sql)
         out = []
+        single = sql if len(stmts) == 1 else None
         for stmt in stmts:
-            out.append(self._run_stmt(stmt))
+            out.append(self._run_stmt(stmt, sql_text=single))
         return out
+
+    # -- prepared statements (ref: session.go:777-855 PrepareStmt /
+    # ExecutePreparedStmt; the binary protocol and SQL PREPARE share it) ----
+
+    def prepare(self, sql: str, name: str | None = None):
+        """-> (stmt_id, num_params). Parses once; EXECUTE binds the
+        collected parameter markers in order."""
+        stmts = parse(sql)
+        if len(stmts) != 1:
+            raise SQLError("can only prepare a single statement")
+        markers = ast_params(stmts[0])
+        self._next_stmt_id += 1
+        sid = self._next_stmt_id
+        p = _Prepared(stmt=stmts[0], markers=markers, sql=sql, sid=sid,
+                      name=name.lower() if name else None)
+        self._prepared[sid] = p
+        if p.name is not None:
+            self._prepared[p.name] = p
+        return sid, len(markers)
+
+    def execute_prepared(self, stmt_id, params=()):
+        p = self._prepared.get(stmt_id if not isinstance(stmt_id, str)
+                               else stmt_id.lower())
+        if p is None:
+            raise SQLError(f"unknown prepared statement {stmt_id!r}")
+        if len(params) != len(p.markers):
+            raise SQLError(f"expected {len(p.markers)} parameters, "
+                           f"got {len(params)}")
+        for m, v in zip(p.markers, params):
+            m.value = v
+            m.bound = True
+        return self._run_stmt(p.stmt)
+
+    def deallocate_prepared(self, stmt_id) -> None:
+        key = stmt_id.lower() if isinstance(stmt_id, str) else stmt_id
+        p = self._prepared.pop(key, None)
+        if p is not None:   # drop BOTH registrations
+            self._prepared.pop(p.sid, None)
+            if p.name is not None:
+                self._prepared.pop(p.name, None)
 
     def query(self, sql: str) -> ResultSet:
         res = self.execute(sql)
@@ -206,10 +259,20 @@ class Session:
 
     # -- dispatch ------------------------------------------------------------
 
-    def _run_stmt(self, stmt: ast.StmtNode):
+    def _run_stmt(self, stmt: ast.StmtNode, sql_text: str | None = None):
         t = type(stmt).__name__
         if isinstance(stmt, (ast.SelectStmt, ast.UnionStmt)):
-            return self._exec_query(stmt)
+            return self._exec_query(stmt, sql_text=sql_text)
+        if isinstance(stmt, ast.PrepareStmt):
+            self.prepare(stmt.sql, name=stmt.name)
+            return None
+        if isinstance(stmt, ast.ExecuteStmt):
+            # user variable names are case-insensitive in MySQL
+            params = [self.vars.get(v.lower()) for v in stmt.using]
+            return self.execute_prepared(stmt.name, params)
+        if isinstance(stmt, ast.DeallocateStmt):
+            self.deallocate_prepared(stmt.name)
+            return None
         if isinstance(stmt, (ast.InsertStmt, ast.UpdateStmt,
                              ast.DeleteStmt)):
             return self._exec_dml(stmt)
@@ -264,13 +327,23 @@ class Session:
         return Planner(self.domain.info_schema(), self.current_db,
                        stats_handle=self.domain.stats_handle())
 
-    def _exec_query(self, stmt) -> ResultSet:
+    def _exec_query(self, stmt, sql_text: str | None = None) -> ResultSet:
         if isinstance(stmt, ast.UnionStmt):
             return self._exec_union(stmt)
-        try:
-            plan = self._planner().plan(stmt)
-        except (PlanError, ResolveError) as e:
-            raise SQLError(str(e)) from None
+        plan = None
+        cache_key = None
+        if sql_text is not None and isinstance(stmt, ast.SelectStmt):
+            cache_key = (sql_text, self.current_db,
+                         self.domain.info_schema().version,
+                         self.domain.stats_handle().version)
+            plan = self.domain.plan_cache().get(cache_key)
+        if plan is None:
+            try:
+                plan = self._planner().plan(stmt)
+            except (PlanError, ResolveError) as e:
+                raise SQLError(str(e)) from None
+            if cache_key is not None and _plan_cacheable(plan):
+                self.domain.plan_cache().put(cache_key, plan)
         ctx = ExecContext(self.storage, self._read_ts(), self.txn)
         exe = build_executor(plan)
         try:
@@ -359,15 +432,20 @@ class Session:
                 e = r.resolve(a.value)
                 import numpy as np
                 d, v = e.eval_xp(np, [], 1)
-                val = None if not v[0] else (
-                    d[0].item() if hasattr(d[0], "item") else d[0])
+                if not v[0]:
+                    val = None
+                elif e.ft.eval_type == EvalType.DECIMAL:
+                    # chunk layer stores scaled ints: unscale for the var
+                    val = scaled_to_decimal(int(d[0]), e.ft.frac)
+                else:
+                    val = d[0].item() if hasattr(d[0], "item") else d[0]
             if a.is_system:
                 self.sys_vars[a.name.lower()] = val
                 if a.name.lower() == "autocommit":
                     self.autocommit = bool(int(val)) if val is not None \
                         else True
             else:
-                self.vars[a.name] = val
+                self.vars[a.name.lower()] = val
         return None
 
     def _exec_show(self, stmt: ast.ShowStmt) -> ResultSet:
@@ -464,6 +542,57 @@ class Session:
         plan = self._planner().plan(stmt.stmt)
         lines = plan.explain().split("\n")
         return ResultSet(["plan"], [(l,) for l in lines])
+
+
+@dataclass
+class _Prepared:
+    stmt: ast.StmtNode
+    markers: list          # ParamMarkers in occurrence order
+    sql: str
+    sid: int = 0
+    name: str | None = None
+
+
+def ast_params(node) -> list:
+    """Collect ParamMarker nodes of a statement in occurrence order."""
+    out = []
+    seen = set()
+
+    def walk(x):
+        if id(x) in seen:
+            return
+        seen.add(id(x))
+        if isinstance(x, ast.ParamMarker):
+            out.append(x)
+            return
+        if isinstance(x, (list, tuple)):
+            for item in x:
+                walk(item)
+            return
+        if hasattr(x, "__dataclass_fields__"):
+            for f in x.__dataclass_fields__:
+                walk(getattr(x, f))
+
+    walk(node)
+    return out
+
+
+def _plan_cacheable(plan) -> bool:
+    """Plans with correlated apply cells mutate during execution, and
+    plans with volatile plan-time folds (NOW()) go stale — never share
+    those via the cache."""
+    from tidb_tpu.plan import physical as _ph
+    if not plan.cacheable:
+        return False
+    if isinstance(plan, _ph.PhysApply) and plan.corr:
+        return False
+    for c in plan.children:
+        if not _plan_cacheable(c):
+            return False
+    inner = getattr(plan, "inner", None)
+    if inner is not None and not _plan_cacheable(inner):
+        return False
+    return True
 
 
 def _type_name(c) -> str:

@@ -621,6 +621,7 @@ class StatsHandle:
         self.storage = storage
         self._cache: dict[int, TableStats] = {}
         self._deltas: dict[int, int] = {}
+        self.version = 0     # bumped on save/drop; part of plan-cache keys
 
     def get(self, table_id: int) -> TableStats:
         ts = self._cache.get(table_id)
@@ -654,6 +655,7 @@ class StatsHandle:
             raise
         self._deltas.pop(ts.table_id, None)
         self._cache[ts.table_id] = ts
+        self.version += 1
 
     def drop(self, table_id: int) -> None:
         txn = self.storage.begin()
@@ -665,6 +667,7 @@ class StatsHandle:
             raise
         self._cache.pop(table_id, None)
         self._deltas.pop(table_id, None)
+        self.version += 1
 
     def invalidate(self) -> None:
         self._cache.clear()
