@@ -134,8 +134,10 @@ class MiniClient:
             self.pkt.read_packet()           # param definitions
         if nparams:
             self.pkt.read_packet()           # EOF
+        self.last_prepare_columns = []
         for _ in range(ncols):
-            self.pkt.read_packet()
+            self.last_prepare_columns.append(
+                self._parse_coldef(self.pkt.read_packet()))
         if ncols:
             self.pkt.read_packet()
         return sid, nparams

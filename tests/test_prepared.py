@@ -114,6 +114,9 @@ class TestBinaryProtocol:
         sid, nparams = c.stmt_prepare("SELECT a, b, s, d FROM t "
                                       "WHERE a = ?")
         assert nparams == 1
+        # prepare-time result metadata (standard drivers read it here)
+        assert [n for n, _t in c.last_prepare_columns] == \
+            ["a", "b", "s", "d"]
         cols, rows = c.stmt_execute(sid, [1])
         assert cols == ["a", "b", "s", "d"]
         assert rows == [(1, 1.5, "x", "2024-03-01")]

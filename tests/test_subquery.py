@@ -67,6 +67,28 @@ class TestUncorrelated:
         with pytest.raises(SQLError, match="more than 1 row"):
             q(tk, "SELECT a FROM t WHERE b = (SELECT y FROM u)")
 
+    def test_not_in_empty_set_keeps_null_left(self, tk):
+        # x NOT IN (empty set) is TRUE even when x is NULL (MySQL
+        # keeps the row); a=4 has b NULL and must appear
+        rows = q(tk, "SELECT a FROM t WHERE b NOT IN "
+                     "(SELECT y FROM u WHERE x = 99) ORDER BY a")
+        assert rows == [(1,), (2,), (3,), (4,)]
+
+    def test_volatile_outer_survives_subquery_planning(self, tk):
+        # a NOW() fold in the outer WHERE must mark the WHOLE plan
+        # non-cacheable even when a subquery is planned afterwards
+        # (nested Planner.plan resets the global volatile flag)
+        from tidb_tpu.parser import parse
+
+        stmt = parse("SELECT a FROM t WHERE c < NOW() AND "
+                     "EXISTS (SELECT 1 FROM u)")[0]
+        plan = tk._planner().plan(stmt)
+        assert plan.cacheable is False
+        # and the converse: no volatile fold -> still cacheable
+        stmt = parse("SELECT a FROM t WHERE c < 2.0 AND "
+                     "EXISTS (SELECT 1 FROM u)")[0]
+        assert tk._planner().plan(stmt).cacheable is True
+
 
 class TestCorrelated:
     def test_exists_correlated(self, tk):

@@ -1011,9 +1011,12 @@ class ApplyExec(Executor):
             match = np.array([v in pool for v in ld], dtype=bool)
         if plan.negated:
             # NOT IN: TRUE only for valid left, no match, and no NULLs
-            # in the subquery result (else NULL)
+            # in the subquery result (else NULL) — except the empty set,
+            # where x NOT IN () is TRUE even for NULL x
             if has_null:
                 return np.zeros(n, dtype=bool)
+            if len(inner) == 0:
+                return np.ones(n, dtype=bool)
             return lv & ~match
         return lv & match
 

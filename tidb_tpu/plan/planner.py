@@ -87,10 +87,19 @@ class Planner:
 
     def plan(self, stmt: ast.StmtNode) -> ph.PhysPlan:
         if isinstance(stmt, ast.SelectStmt):
-            from tidb_tpu.plan.resolver import reset_volatile, was_volatile
+            from tidb_tpu.plan.resolver import (mark_volatile,
+                                                reset_volatile, was_volatile)
+            # The volatile flag is process-global; a nested plan() (sub-
+            # query, derived table) must compute ITS cacheability from a
+            # clean flag, then leave "outer-so-far OR child" behind so an
+            # enclosing statement keeps any NOW()-style fold it already
+            # marked and inherits the child's volatility.
+            outer_volatile = was_volatile()
             reset_volatile()
             p = self._opt_access(self.plan_select(stmt))
             p.cacheable = not was_volatile()
+            if outer_volatile:
+                mark_volatile()
             return p
         if isinstance(stmt, ast.InsertStmt):
             p = self.plan_insert(stmt)

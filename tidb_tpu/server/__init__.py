@@ -301,16 +301,24 @@ class ClientConn:
     def _handle_stmt_prepare(self, sql: str) -> None:
         sid, nparams = self.session.prepare(sql)
         self._param_counts[sid] = nparams
-        # COM_STMT_PREPARE_OK: column count deferred to execute time (the
-        # execute response always carries the column definitions)
+        # COM_STMT_PREPARE_OK with real prepare-time column definitions:
+        # standard drivers (libmysqlclient, Connector/J) read result
+        # metadata here, not at execute time (conn_stmt.go).
+        names, fts = self.session.prepared_columns(sid)
+        ncols = len(names) if names else 0
         pkt = b"\x00" + struct.pack("<I", sid)
-        pkt += struct.pack("<H", 0)              # num columns
+        pkt += struct.pack("<H", ncols)
         pkt += struct.pack("<H", nparams)
         pkt += b"\x00" + struct.pack("<H", 0)    # filler, warnings
         self.pkt.write_packet(pkt)
         if nparams:
             for _ in range(nparams):
                 self.pkt.write_packet(self._column_def("?", None))
+            self._write_eof()
+        if ncols:
+            for i, name in enumerate(names):
+                self.pkt.write_packet(self._column_def(
+                    name, fts[i] if fts else None))
             self._write_eof()
 
     def _handle_stmt_execute(self, data: bytes) -> None:

@@ -163,9 +163,45 @@ class Session:
             self._prepared[p.name] = p
         return sid, len(markers)
 
+    def _lookup_prepared(self, stmt_id):
+        return self._prepared.get(stmt_id if not isinstance(stmt_id, str)
+                                  else stmt_id.lower())
+
+    def prepared_columns(self, stmt_id):
+        """Result-column metadata of a prepared statement at PREPARE time,
+        for the COM_STMT_PREPARE_OK response (standard MySQL drivers read
+        the prepare-time column definitions; ref server/conn_stmt.go).
+        Plans the SELECT with params bound to NULL — result column names
+        and types come from the schema, not the parameter values. Memoized
+        on the prepared statement (prepare-time metadata is a snapshot).
+        -> (names, field_types), or (None, None) for non-resultset stmts
+        or when planning with unbound params fails."""
+        p = self._lookup_prepared(stmt_id)
+        if p is None:
+            return (None, None)
+        if p.columns_meta is not None:
+            return p.columns_meta
+        sel = p.stmt
+        if isinstance(sel, ast.UnionStmt):
+            sel = sel.selects[0]     # UNION metadata = first branch's
+        if not isinstance(sel, ast.SelectStmt):
+            return (None, None)
+        saved = [(m.value, m.bound) for m in p.markers]
+        try:
+            for m in p.markers:
+                m.value, m.bound = None, True
+            plan = self._planner().plan(sel)
+            p.columns_meta = ([c.name for c in plan.schema.cols],
+                              [c.ft for c in plan.schema.cols])
+            return p.columns_meta
+        except Exception:
+            return (None, None)
+        finally:
+            for m, (v, b) in zip(p.markers, saved):
+                m.value, m.bound = v, b
+
     def execute_prepared(self, stmt_id, params=()):
-        p = self._prepared.get(stmt_id if not isinstance(stmt_id, str)
-                               else stmt_id.lower())
+        p = self._lookup_prepared(stmt_id)
         if p is None:
             raise SQLError(f"unknown prepared statement {stmt_id!r}")
         if len(params) != len(p.markers):
@@ -551,6 +587,7 @@ class _Prepared:
     sql: str
     sid: int = 0
     name: str | None = None
+    columns_meta: tuple | None = None   # memoized (names, field_types)
 
 
 def ast_params(node) -> list:
