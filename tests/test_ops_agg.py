@@ -195,3 +195,36 @@ def test_empty_chunk_and_no_match_filter():
     agg = HashAggregator(k.aggs)
     agg.update(k(ch))
     assert agg.results() == []
+
+
+def test_hashagg_exec_replans_capacity_overflow():
+    """>capacity distinct groups: HashAggExec re-plans the device kernel
+    with a larger table instead of losing the device path (the re-plan
+    promised by the kernel docstring)."""
+    from tidb_tpu.executor import HashAggExec
+    from tidb_tpu.plan.physical import PhysHashAgg
+    from tidb_tpu.plan.resolver import PlanSchema, SchemaCol
+
+    n, ngroups = 6000, 5000
+    rows = [(i % ngroups, i) for i in range(n)]
+    ch = Chunk.from_rows([INT, INT], rows)
+
+    class _Child:
+        schema = None
+
+        def chunks(self, ctx):
+            yield ch
+
+    plan = PhysHashAgg(
+        schema=PlanSchema([SchemaCol("g", "", INT),
+                           SchemaCol("s", "", st.new_int_field())]),
+        children=[None],
+        group_exprs=[col(0, INT)],
+        aggs=[AggDesc(AggFunc.SUM, col(1, INT))])
+    exe = HashAggExec.__new__(HashAggExec)
+    exe.plan, exe.schema, exe.child, exe._kernel = plan, plan.schema, \
+        _Child(), None
+    out = list(exe.chunks(None))[0]
+    assert out.num_rows == ngroups
+    # the kernel was re-planned (not abandoned) with a larger capacity
+    assert exe._kernel is not None and exe._kernel.capacity >= ngroups

@@ -372,7 +372,20 @@ class HashAggExec(Executor):
                         self._kernel = HashAggKernel(
                             None, self.plan.group_exprs, self.plan.aggs)
                     gr = self._kernel(chunk)
-                except (CapacityError, CollisionError, ValueError):
+                except CapacityError as e:
+                    # re-plan once with a larger device table (the re-plan
+                    # the kernel docstring promises), then host fallback
+                    needed = getattr(e, "needed", 0)
+                    cap = 1 << max(needed * 2 - 1, 1).bit_length()
+                    if needed and cap <= (1 << 20):
+                        try:
+                            self._kernel = HashAggKernel(
+                                None, self.plan.group_exprs,
+                                self.plan.aggs, capacity=cap)
+                            gr = self._kernel(chunk)
+                        except (CapacityError, CollisionError, ValueError):
+                            gr = None
+                except (CollisionError, ValueError):
                     gr = None
             if gr is None:
                 gr = host_hash_agg(chunk, None, self.plan.group_exprs,
@@ -1074,7 +1087,21 @@ class _ArrayExpr(Expression):
         return False
 
 
+def _mesh_agg_builder(plan):
+    from tidb_tpu.executor.mesh import MeshAggExec
+    return MeshAggExec(plan)
+
+
+def _mesh_lookup_agg_builder(plan):
+    from tidb_tpu.executor.mesh import MeshLookupAggExec
+    return MeshLookupAggExec(plan)
+
+
+from tidb_tpu.plan import mesh_route as _mr  # noqa: E402
+
 _BUILDERS = {
+    _mr.PhysMeshAgg: _mesh_agg_builder,
+    _mr.PhysMeshLookupAgg: _mesh_lookup_agg_builder,
     ph.PhysApply: ApplyExec,
     ph.PhysTableReader: TableReaderExec,
     ph.PhysIndexReader: IndexReaderExec,

@@ -1,0 +1,204 @@
+"""Executors for mesh-routed plans (plan/mesh_route.py).
+
+The reference's distributed aggregation pulls per-region partials onto one
+root goroutine (/root/reference/distsql/distsql.go:92 fan-in feeding
+executor/aggregate.go); here the heavy reduction happens ON the mesh
+(parallel/dist_agg.py, dist_join.py) and the host only merges the already
+tiny per-statement group tables and formats rows.
+
+Fallback contract: every mesh plan carries the original subtree; we
+delegate to it when no process mesh is active, when expressions fail
+device validation, on group-capacity overflow past the escalation cap,
+on hash collisions, or on non-unique dimension build keys."""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as np
+
+from tidb_tpu.chunk import Chunk, Column
+from tidb_tpu.ops.hashagg import CapacityError, CollisionError, HashAggregator
+from tidb_tpu.parallel import config
+from tidb_tpu.parallel.dist_agg import MeshAggKernel
+from tidb_tpu.parallel.dist_join import (BuildError, LookupSpec,
+                                         MeshLookupAggKernel)
+
+__all__ = ["MeshAggExec", "MeshLookupAggExec"]
+
+# Initial per-chip group-table capacity; on overflow the executor re-plans
+# the kernel once with 2x the observed distinct count (the re-plan the
+# single-chip kernel docstring promises), then falls back to the host.
+DEFAULT_CAPACITY = 4096
+MAX_CAPACITY = 1 << 20
+
+# kernel reuse across executions of cached plans: jit programs are per
+# (structure, capacity); keyed by plan object identity (the entry pins
+# the plan so its id cannot be recycled)
+_KERNELS: OrderedDict = OrderedDict()
+_KERNELS_CAP = 64
+
+
+def _kernel_cache_get(plan, capacity):
+    key = (config.mesh_generation(), id(plan), capacity)
+    hit = _KERNELS.get(key)
+    if hit is not None and hit[0] is plan:
+        _KERNELS.move_to_end(key)
+        return hit[1]
+    return None
+
+
+def _kernel_cache_put(plan, capacity, kernel) -> None:
+    gen = config.mesh_generation()
+    # kernels from older mesh generations can never be hit again; drop
+    # them now rather than pinning their replicated build tables
+    for k in [k for k in _KERNELS if k[0] != gen]:
+        del _KERNELS[k]
+    key = (gen, id(plan), capacity)
+    _KERNELS[key] = (plan, kernel)
+    _KERNELS.move_to_end(key)
+    while len(_KERNELS) > _KERNELS_CAP:
+        _KERNELS.popitem(last=False)
+
+
+def _concat_chunks(parts, schema) -> Chunk:
+    parts = [p for p in parts if p.num_rows]
+    if not parts:
+        return Chunk([Column.from_values(c.ft, []) for c in schema.cols])
+    big = parts[0]
+    for p in parts[1:]:
+        big = big.concat(p)
+    return big
+
+
+def _emit_results(plan, gr_or_none, executor_mod):
+    agg = HashAggregator(plan.aggs)
+    if gr_or_none is not None:
+        agg.update(gr_or_none)
+    results = agg.results()
+    if not plan.group_exprs and not results:
+        results = [((), [executor_mod._empty_agg_value(a)
+                         for a in plan.aggs])]
+    return executor_mod._agg_results_to_chunk(
+        plan.schema, plan.num_group_cols, plan.aggs, results)
+
+
+class _MeshExecBase:
+    def __init__(self, plan):
+        self.plan = plan
+        self.schema = plan.schema
+
+    def _fallback(self, ctx):
+        from tidb_tpu.executor import build_executor
+        return build_executor(self.plan.fallback).chunks(ctx)
+
+    def _run_with_escalation(self, make_kernel, run):
+        """Kernel-build + run with one capacity re-plan on overflow.
+        The successful capacity sticks to the plan so re-executions of a
+        cached plan start there instead of re-failing at the default.
+        -> GroupResult or None (caller falls back)."""
+        capacity = getattr(self.plan, "_mesh_capacity", DEFAULT_CAPACITY)
+        for _attempt in (0, 1):
+            try:
+                kernel = _kernel_cache_get(self.plan, capacity)
+                if kernel is None:
+                    kernel = make_kernel(capacity)
+                    _kernel_cache_put(self.plan, capacity, kernel)
+                out = run(kernel)
+                self.plan._mesh_capacity = capacity
+                return out
+            except CapacityError as e:
+                needed = getattr(e, "needed", None)
+                if needed is None:
+                    return None
+                capacity = 1 << max(needed * 2 - 1, 1).bit_length()
+                if capacity > MAX_CAPACITY:
+                    return None
+            except (CollisionError, BuildError, ValueError):
+                return None
+        return None
+
+
+class MeshAggExec(_MeshExecBase):
+    """Group-by aggregation on the device mesh (Q1 shape)."""
+
+    def chunks(self, ctx):
+        import tidb_tpu.executor as ex
+
+        mesh = config.active_mesh()
+        if mesh is None:
+            yield from self._fallback(ctx)
+            return
+        reader = ex.build_executor(self.plan.children[0])
+        big = _concat_chunks(list(reader.chunks(ctx)),
+                             self.plan.children[0].schema)
+
+        def make(capacity):
+            return MeshAggKernel(mesh, None, self.plan.group_exprs,
+                                 self.plan.aggs, capacity=capacity)
+
+        gr = None
+        if big.num_rows:
+            gr = self._run_with_escalation(make, lambda k: k(big))
+            if gr is None:
+                yield from self._fallback(ctx)
+                return
+        yield _emit_results(self.plan, gr, ex)
+
+
+class MeshLookupAggExec(_MeshExecBase):
+    """Star join + aggregation on the device mesh (Q3/Q5 shape)."""
+
+    def chunks(self, ctx):
+        import tidb_tpu.executor as ex
+
+        mesh = config.active_mesh()
+        if mesh is None:
+            yield from self._fallback(ctx)
+            return
+        plan = self.plan
+        try:
+            specs = []
+            for lk in plan.lookups:
+                bexec = ex.build_executor(lk.build_plan)
+                bchunk = _concat_chunks(list(bexec.chunks(ctx)),
+                                        lk.build_plan.schema)
+                specs.append(LookupSpec(
+                    key_exprs=lk.key_exprs, build_chunk=bchunk,
+                    build_key_offsets=lk.build_key_offsets,
+                    payload_offsets=lk.payload_offsets))
+            reader = ex.build_executor(plan.children[0])
+            probe = _concat_chunks(list(reader.chunks(ctx)),
+                                   plan.children[0].schema)
+        except BuildError:
+            yield from self._fallback(ctx)
+            return
+
+        def make(capacity):
+            k = MeshLookupAggKernel(mesh, plan.filter_expr, specs,
+                                    plan.group_exprs, plan.aggs,
+                                    capacity=capacity)
+            k.lookups = specs    # freshly built: skip the refresh rebuild
+            return k
+
+        def run(kernel):
+            self._refresh_builds(kernel, specs)
+            return kernel(probe)
+
+        gr = None
+        if probe.num_rows:
+            gr = self._run_with_escalation(make, run)
+            if gr is None:
+                yield from self._fallback(ctx)
+                return
+        yield _emit_results(plan, gr, ex)
+
+    @staticmethod
+    def _refresh_builds(kernel: MeshLookupAggKernel, specs) -> None:
+        """A cached kernel's traced program depends only on the lookup
+        STRUCTURE; the dimension data rides in as runtime arguments. Swap
+        in freshly built tables so re-executions see current data."""
+        from tidb_tpu.parallel.dist_join import _BuildTable
+        if kernel.lookups is not specs:
+            kernel.lookups = specs
+            kernel.builds = [_BuildTable(lk) for lk in specs]

@@ -275,8 +275,10 @@ class HashAggKernel:
             raise CollisionError("group key hash collision")
         live = (counts > 0) & (uniq != _SENTINEL_MASKED) & (uniq != _FILL)
         if int(nuniq) > self.capacity:
-            raise CapacityError(f"distinct groups {int(nuniq)} > capacity "
+            err = CapacityError(f"distinct groups {int(nuniq)} > capacity "
                                 f"{self.capacity}")
+            err.needed = int(nuniq)   # executors re-plan with 2x this
+            raise err
         gidx = np.flatnonzero(live)
         lanes_at = [[np.asarray(l)[gidx] for l in ls] for ls in lanes]
         return finalize_group_result(chunk, self.group_exprs, self.aggs,

@@ -16,5 +16,10 @@ psum_scatter) riding ICI — never host RPC.
 
 from tidb_tpu.parallel.mesh import build_mesh, default_axes
 from tidb_tpu.parallel.dist_agg import MeshAggKernel
+from tidb_tpu.parallel.config import (active_mesh, configure_mesh,
+                                      disable_mesh, enable_mesh,
+                                      mesh_generation)
 
-__all__ = ["build_mesh", "default_axes", "MeshAggKernel"]
+__all__ = ["build_mesh", "default_axes", "MeshAggKernel",
+           "active_mesh", "configure_mesh", "disable_mesh", "enable_mesh",
+           "mesh_generation"]

@@ -1,0 +1,44 @@
+"""Process-level mesh configuration.
+
+The reference wires its distributed execution through per-session
+concurrency knobs + the store's region topology (store/tikv/coprocessor.go
+fan-out); chip topology is the TPU analogue and is a process property:
+one device mesh serves every session in the process. The planner consults
+``active_mesh()`` when deciding to route qualifying plans to the mesh
+executors, and bumps ``mesh_generation()`` into the plan-cache key so
+cached plans never outlive a topology change.
+"""
+
+from __future__ import annotations
+
+from tidb_tpu.parallel.mesh import build_mesh
+
+__all__ = ["configure_mesh", "enable_mesh", "disable_mesh", "active_mesh",
+           "mesh_generation"]
+
+_mesh = None
+_generation = 0
+
+
+def configure_mesh(mesh) -> None:
+    """Install `mesh` (a jax.sharding.Mesh or None) as the process mesh."""
+    global _mesh, _generation
+    _mesh = mesh
+    _generation += 1
+
+
+def enable_mesh(n_devices: int | None = None) -> None:
+    """Build a ('dp','tp') mesh over the first n jax devices and install it."""
+    configure_mesh(build_mesh(n_devices))
+
+
+def disable_mesh() -> None:
+    configure_mesh(None)
+
+
+def active_mesh():
+    return _mesh
+
+
+def mesh_generation() -> int:
+    return _generation

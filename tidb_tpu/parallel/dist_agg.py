@@ -158,8 +158,10 @@ class MeshKernelBase:
         # tot counts the masked sentinel / fill phantoms; _C holds >= 2
         # headroom slots for them, so tot > _C means possible truncation
         if int(tot) > self._C:
-            raise CapacityError(
+            err = CapacityError(
                 f"distinct groups {int(tot)} > capacity {self.capacity}")
+            err.needed = int(tot)   # executors re-plan with 2x this
+            raise err
         live = (cnt > 0) & (uniq != _SENTINEL_MASKED) & (uniq != _FILL)
         if bool(np.any(live & (np.asarray(h2min) != np.asarray(h2max)))):
             raise CollisionError("group key hash collision")

@@ -96,7 +96,8 @@ class Planner:
             # marked and inherits the child's volatility.
             outer_volatile = was_volatile()
             reset_volatile()
-            p = self._opt_access(self.plan_select(stmt))
+            from tidb_tpu.plan.mesh_route import route_mesh
+            p = route_mesh(self._opt_access(self.plan_select(stmt)))
             p.cacheable = not was_volatile()
             if outer_volatile:
                 mark_volatile()

@@ -369,9 +369,11 @@ class Session:
         plan = None
         cache_key = None
         if sql_text is not None and isinstance(stmt, ast.SelectStmt):
+            from tidb_tpu.parallel import config as mesh_config
             cache_key = (sql_text, self.current_db,
                          self.domain.info_schema().version,
-                         self.domain.stats_handle().version)
+                         self.domain.stats_handle().version,
+                         mesh_config.mesh_generation())
             plan = self.domain.plan_cache().get(cache_key)
         if plan is None:
             try:
