@@ -105,3 +105,38 @@ class TestResults:
                             "_run_with_escalation", spy)
         rows = sess.query(tpch.Q1).rows
         assert len(rows) == 6 and calls
+
+
+class TestShuffleJoinSQL:
+    def test_duplicate_key_join_uses_shuffle(self, sess, mesh, monkeypatch):
+        """A join with duplicate keys on both sides cannot be a lookup
+        chain; with a mesh active HashJoinExec repartitions both sides
+        via the all_to_all shuffle kernel instead."""
+        from tidb_tpu import executor as ex
+        from tidb_tpu.parallel import shuffle_join as sj
+
+        sql = ("SELECT o_custkey, COUNT(*) FROM orders, lineitem "
+               "WHERE o_custkey = l_suppkey GROUP BY o_custkey "
+               "ORDER BY o_custkey")
+        e = _explain(sess, sql)
+        assert "MeshLookupAgg" not in e and "HashJoin" in e
+
+        parallel.disable_mesh()
+        try:
+            want = sess.query(sql).rows
+        finally:
+            parallel.enable_mesh(8)
+        assert want
+
+        monkeypatch.setattr(ex.HashJoinExec, "_DEVICE_MIN_BUILD", 64)
+        used = []
+        orig = sj.MeshShuffleJoinKernel.__call__
+
+        def spy(self, *a, **kw):
+            out = orig(self, *a, **kw)
+            used.append(1)   # count only a SUCCESSFUL mesh join
+            return out
+
+        monkeypatch.setattr(sj.MeshShuffleJoinKernel, "__call__", spy)
+        assert sess.query(sql).rows == want
+        assert used, "mesh shuffle kernel was not exercised"

@@ -182,6 +182,23 @@ class Chunk:
             return other
         return Chunk([a.concat(b) for a, b in zip(self.columns, other.columns)])
 
+    @staticmethod
+    def concat_all(chunks: list["Chunk"]) -> "Chunk | None":
+        """One-pass concatenation (pairwise .concat in a loop re-copies the
+        accumulated prefix per chunk — O(C^2) in chunk count)."""
+        chunks = [c for c in chunks if c.columns]
+        if not chunks:
+            return None
+        if len(chunks) == 1:
+            return chunks[0]
+        cols = []
+        for j, c0 in enumerate(chunks[0].columns):
+            cols.append(Column(
+                c0.ft,
+                np.concatenate([c.columns[j].data for c in chunks]),
+                np.concatenate([c.columns[j].valid for c in chunks])))
+        return Chunk(cols)
+
     def field_types(self) -> list[FieldType]:
         return [c.ft for c in self.columns]
 

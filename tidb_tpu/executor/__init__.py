@@ -593,21 +593,38 @@ class HashJoinExec(Executor):
         return self.plan.right_keys if exprs is self.plan.left_keys \
             else self.plan.left_keys
 
+    def _mesh_kernel(self, nb: int):
+        """A shuffle-join kernel when a multi-chip mesh is active and the
+        build side is big enough to be worth a repartition (ref: the
+        scaled-out form of executor/join.go's partitioned build)."""
+        from tidb_tpu.parallel import config as mesh_config
+        mesh = mesh_config.active_mesh()
+        if mesh is None or mesh.devices.size <= 1 or \
+                nb < self._DEVICE_MIN_BUILD:
+            return None
+        from tidb_tpu.parallel.shuffle_join import MeshShuffleJoinKernel
+        return MeshShuffleJoinKernel(mesh, len(self.plan.left_keys))
+
     def chunks(self, ctx):
         plan = self.plan
         if not plan.left_keys:
             yield from self._cross_join(ctx)
             return
-        build = None
-        for chunk in self.right.chunks(ctx):
-            build = chunk if build is None else build.concat(chunk)
+        build = Chunk.concat_all(list(self.right.chunks(ctx)))
         nb = build.num_rows if build is not None else 0
         enc = JoinKeyEncoder(len(plan.right_keys))
         bk = enc.fit_build(self._eval_keys(plan.right_keys, build)) \
             if nb else None
         btable = None  # lazy python-dict probe table for small chunks
         matched_build = np.zeros(nb, dtype=bool)
-        for chunk in self.left.chunks(ctx):
+        probe_iter = self.left.chunks(ctx)
+        mesh_kernel = self._mesh_kernel(nb)
+        if mesh_kernel is not None:
+            # shuffle join wants the whole probe side at once: each call
+            # is one all_to_all repartition of BOTH sides over the mesh
+            big = Chunk.concat_all(list(probe_iter))
+            probe_iter = [big] if big is not None else []
+        for chunk in probe_iter:
             n = chunk.num_rows
             if n == 0:
                 continue
@@ -621,7 +638,16 @@ class HashJoinExec(Executor):
                         yield out
                 continue
             pk = enc.transform_probe(self._eval_keys(plan.left_keys, chunk))
-            if n >= self._DEVICE_MIN_PROBE or nb >= self._DEVICE_MIN_BUILD:
+            if mesh_kernel is not None:
+                from tidb_tpu.parallel.shuffle_join import \
+                    ShuffleOverflowError
+                try:
+                    li, ri = mesh_kernel(pk, bk, nb, n)
+                except ShuffleOverflowError:
+                    # designed fallback: extreme hash skew exhausted the
+                    # repartition retry budget
+                    li, ri = self._kernel(bk, pk, nb, n)
+            elif n >= self._DEVICE_MIN_PROBE or nb >= self._DEVICE_MIN_BUILD:
                 li, ri = self._kernel(bk, pk, nb, n)
             else:
                 if btable is None:

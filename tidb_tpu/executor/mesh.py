@@ -62,12 +62,9 @@ def _kernel_cache_put(plan, capacity, kernel) -> None:
 
 
 def _concat_chunks(parts, schema) -> Chunk:
-    parts = [p for p in parts if p.num_rows]
-    if not parts:
+    big = Chunk.concat_all([p for p in parts if p.num_rows])
+    if big is None:
         return Chunk([Column.from_values(c.ft, []) for c in schema.cols])
-    big = parts[0]
-    for p in parts[1:]:
-        big = big.concat(p)
     return big
 
 

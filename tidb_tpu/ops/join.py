@@ -31,7 +31,8 @@ import jax.numpy as jnp
 from tidb_tpu.ops import runtime
 from tidb_tpu.ops.hashagg import _FILL, _SENTINEL_MASKED, _hash_keys
 
-__all__ = ["JoinKernel", "JoinOverflowError", "JoinKeyEncoder"]
+__all__ = ["JoinKernel", "JoinOverflowError", "JoinKeyEncoder",
+           "match_pairs"]
 
 # build-side dead rows hash to _SENTINEL_MASKED, probe-side to _FILL:
 # distinct values, and _hash_keys never produces either for live rows
@@ -93,6 +94,37 @@ class JoinKeyEncoder:
         return out
 
 
+def match_pairs(xp, hb, hp, bd_lanes, pd_lanes, out_cap):
+    """Sort-join matcher steps 2-4 (module docstring): build hashes `hb`
+    (dead rows = _DEAD_BUILD) vs probe hashes `hp` (dead = _DEAD_PROBE),
+    expanded into a static-capacity pair list with exact-key verification
+    over the raw data lanes. Shared by the single-chip kernel and the
+    per-partition stage of the mesh shuffle join
+    (parallel/shuffle_join.py). -> (li, ri, ok, total)."""
+    b_n = hb.shape[0]
+    p_n = hp.shape[0]
+    perm = xp.argsort(hb)
+    sb = hb[perm]
+    left = xp.searchsorted(sb, hp, side="left")
+    right = xp.searchsorted(sb, hp, side="right")
+    counts = xp.where(hp != _DEAD_PROBE, right - left, 0)
+    cum = xp.cumsum(counts)
+    total = cum[p_n - 1] if p_n else 0
+
+    k = xp.arange(out_cap)
+    li = xp.searchsorted(cum, k, side="right")
+    li_c = xp.clip(li, 0, p_n - 1)
+    start = cum[li_c] - counts[li_c]
+    pos = left[li_c] + (k - start)
+    ri = perm[xp.clip(pos, 0, b_n - 1)]
+    ok = k < xp.minimum(total, out_cap)
+    # exact key verification: candidates from colliding hashes are
+    # discarded here, making the join exact
+    for bd, pd in zip(bd_lanes, pd_lanes):
+        ok = ok & (bd[ri] == pd[li_c])
+    return li_c, ri, ok, total
+
+
 class JoinKernel:
     """Compiled pair matcher for one key-lane signature.
 
@@ -123,26 +155,8 @@ class JoinKernel:
             hb = xp.where(b_valid, hb, _DEAD_BUILD)
             hp = xp.where(p_valid, hp, _DEAD_PROBE)
 
-            perm = xp.argsort(hb)
-            sb = hb[perm]
-            left = xp.searchsorted(sb, hp, side="left")
-            right = xp.searchsorted(sb, hp, side="right")
-            counts = xp.where(p_valid, right - left, 0)
-            cum = xp.cumsum(counts)
-            total = cum[p_n - 1] if p_n else 0
-
-            k = xp.arange(out_cap)
-            li = xp.searchsorted(cum, k, side="right")
-            li_c = xp.clip(li, 0, p_n - 1)
-            start = cum[li_c] - counts[li_c]
-            pos = left[li_c] + (k - start)
-            ri = perm[xp.clip(pos, 0, b_n - 1)]
-            ok = k < xp.minimum(total, out_cap)
-            # exact key verification: candidates from colliding hashes
-            # are discarded here, making the join exact
-            for (bd, _bv), (pd, _pv) in zip(bkeys, pkeys):
-                ok = ok & (bd[ri] == pd[li_c])
-            return li_c, ri, ok, total
+            return match_pairs(xp, hb, hp, [d for d, _v in bkeys],
+                               [d for d, _v in pkeys], out_cap)
 
         return jax.jit(kernel)
 
