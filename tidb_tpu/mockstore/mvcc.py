@@ -68,6 +68,9 @@ class MVCCStore:
     def __init__(self):
         self._entries: SortedDict[bytes, _Entry] = SortedDict()
         self._mu = threading.RLock()
+        # bumped on EVERY state change (locks included): the columnar
+        # chunk cache (store/chunk_cache.py) keys its validity on it
+        self.data_version = 0
 
     # -- internal ------------------------------------------------------------
 
@@ -147,6 +150,7 @@ class MVCCStore:
                  start_ts: int, ttl_ms: int = 3000) -> None:
         """All-or-nothing lock acquisition. Ref: mvcc_leveldb.go Prewrite."""
         with self._mu:
+            self.data_version += 1
             for m in mutations:
                 e = self._entry(m.key)
                 if e.lock is not None:
@@ -169,6 +173,7 @@ class MVCCStore:
     def commit(self, keys: list[bytes], start_ts: int, commit_ts: int) -> None:
         """Ref: mvcc_leveldb.go Commit — idempotent for already-committed."""
         with self._mu:
+            self.data_version += 1
             for k in keys:
                 e = self._entries.get(k)
                 if e is None or e.lock is None or e.lock.start_ts != start_ts:
@@ -203,6 +208,7 @@ class MVCCStore:
     def rollback(self, keys: list[bytes], start_ts: int) -> None:
         """Ref: mvcc_leveldb.go Rollback; errors if already committed."""
         with self._mu:
+            self.data_version += 1
             for k in keys:
                 e = self._entry(k)
                 wt = self._find_txn_write(e, start_ts)
@@ -220,6 +226,7 @@ class MVCCStore:
         rolling back. Raises KeyLockedError if the lock is still alive.
         Ref: mvcc_leveldb.go Cleanup + lock_resolver.go getTxnStatus."""
         with self._mu:
+            self.data_version += 1
             e = self._entry(key)
             if e.lock is not None and e.lock.start_ts == start_ts:
                 if current_ts and physical_ms(current_ts) < \
@@ -253,6 +260,7 @@ class MVCCStore:
         """Commit (commit_ts > 0) or roll back every lock of txn start_ts in
         range. Ref: mvcc_leveldb.go ResolveLock."""
         with self._mu:
+            self.data_version += 1
             for k in list(self._entries.irange(start, end or None,
                                                inclusive=(True, False))):
                 e = self._entries[k]
@@ -267,6 +275,7 @@ class MVCCStore:
 
     def delete_range(self, start: bytes, end: bytes) -> None:
         with self._mu:
+            self.data_version += 1
             for k in list(self._entries.irange(start, end or None,
                                                inclusive=(True, False))):
                 del self._entries[k]
@@ -278,6 +287,7 @@ class MVCCStore:
         Ref: gcworker/gc_worker.go doGC."""
         pruned = 0
         with self._mu:
+            self.data_version += 1
             for k in list(self._entries.irange(start, end or None,
                                                inclusive=(True, False))):
                 e = self._entries[k]
