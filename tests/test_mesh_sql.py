@@ -129,6 +129,7 @@ class TestShuffleJoinSQL:
         assert want
 
         monkeypatch.setattr(ex.HashJoinExec, "_DEVICE_MIN_BUILD", 64)
+        monkeypatch.setattr(ex.HashJoinExec, "_DEVICE_MIN_PROBE", 64)
         used = []
         orig = sj.MeshShuffleJoinKernel.__call__
 
@@ -140,3 +141,63 @@ class TestShuffleJoinSQL:
         monkeypatch.setattr(sj.MeshShuffleJoinKernel, "__call__", spy)
         assert sess.query(sql).rows == want
         assert used, "mesh shuffle kernel was not exercised"
+
+    def test_small_probe_skips_shuffle(self, sess, mesh, monkeypatch):
+        """A tiny probe must NOT pay an all_to_all repartition even when
+        the build side qualifies (advisor r2): the join falls through to
+        the per-chunk single-chip paths."""
+        from tidb_tpu import executor as ex
+        from tidb_tpu.parallel import shuffle_join as sj
+
+        # n_regionkey is NOT unique-keyed, so this cannot become a
+        # MeshLookupAgg chain — it must stay a HashJoin
+        sql = ("SELECT n_name, COUNT(*) FROM nation, lineitem "
+               "WHERE n_regionkey = l_suppkey GROUP BY n_name "
+               "ORDER BY n_name")
+        e = _explain(sess, sql)
+        assert "MeshLookupAgg" not in e and "HashJoin" in e
+        # probe (left) = nation: far below _DEVICE_MIN_PROBE
+        monkeypatch.setattr(ex.HashJoinExec, "_DEVICE_MIN_BUILD", 64)
+        used = []
+        orig = sj.MeshShuffleJoinKernel.__call__
+
+        def spy(self, *a, **kw):
+            used.append(1)
+            return orig(self, *a, **kw)
+
+        monkeypatch.setattr(sj.MeshShuffleJoinKernel, "__call__", spy)
+        parallel.disable_mesh()
+        try:
+            want = sess.query(sql).rows
+        finally:
+            parallel.enable_mesh(8)
+        got = sess.query(sql).rows
+        assert got == want and want
+        assert not used, "small probe still paid the mesh shuffle"
+
+
+class TestMeshAggRawReaderSchema:
+    def test_stripped_reader_schema_matches_scan(self, sess, mesh):
+        """PhysMeshAgg.children[0] (the agg-stripped raw scan) must carry
+        the raw scan schema, not the agg output schema (advisor r2)."""
+        from tidb_tpu.plan.mesh_route import PhysMeshAgg
+
+        plan = sess.plan(tpch.Q1)
+
+        def find(p):
+            if isinstance(p, PhysMeshAgg):
+                return p
+            for c in p.children:
+                r = find(c)
+                if r is not None:
+                    return r
+            return None
+
+        node = find(plan)
+        assert node is not None, "Q1 did not route to MeshAgg"
+        raw = node.children[0]
+        assert len(raw.schema) == len(raw.cop.cols) + \
+            (1 if raw.cop.handle_col is not None else 0)
+        names = [c.name for c in raw.schema.cols]
+        assert names[:len(raw.cop.cols)] == \
+            [c.name.lower() for c in raw.cop.cols]

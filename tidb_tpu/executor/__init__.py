@@ -9,6 +9,8 @@ coprocessor fan-out (distsql.go:92).
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 from tidb_tpu import kv, tablecodec
@@ -30,6 +32,13 @@ __all__ = ["build_executor", "ExecError", "ExecContext"]
 
 class ExecError(kv.KVError):
     pass
+
+
+# shared shuffle-join kernels, keyed (mesh_generation, num_keys): the
+# shard_map program is shape-polymorphic, so one kernel serves every
+# query with the same key arity on the same mesh
+_SHUFFLE_KERNELS: dict = {}
+_SHUFFLE_KERNELS_LOCK = threading.Lock()
 
 
 class ExecContext:
@@ -458,41 +467,28 @@ class LimitExec(Executor):
                 return
 
 
-def _sort_key_rows(by, chunk):
-    """-> list of per-row sort key tuples handling NULLs (asc: NULLs first)."""
-    keycols = []
+def _sort_order(by, chunk) -> np.ndarray:
+    """-> int64 permutation ordering chunk rows by the sort items, fully
+    vectorized (no per-row Python objects — ref SURVEY §3.2's per-row
+    dispatch sin). NULLs first ascending / last descending (MySQL).
+
+    Every key column is dense-ranked via np.unique so DESC is a rank
+    negation that works uniformly for numerics and object (string)
+    columns; np.lexsort is stable, preserving input order on ties."""
+    n = chunk.num_rows
+    lex_keys = []
     for e, desc in by:
         d, v = e.eval(chunk)
-        keycols.append((d, v, desc))
-    keys = []
-    for i in range(chunk.num_rows):
-        parts = []
-        for d, v, desc in keycols:
-            null = not v[i]
-            val = d[i] if not null else None
-            parts.append((null, val, desc))
-        keys.append(_SortKey(parts))
-    return keys
-
-
-class _SortKey:
-    __slots__ = ("parts",)
-
-    def __init__(self, parts):
-        self.parts = parts
-
-    def __lt__(self, other):
-        for (n1, v1, desc), (n2, v2, _d) in zip(self.parts, other.parts):
-            if n1 != n2:
-                lt = n1  # NULL sorts first asc
-                return lt if not desc else not lt
-            if n1:
-                continue
-            if v1 == v2:
-                continue
-            lt = v1 < v2
-            return lt if not desc else not lt
-        return False
+        d, v = np.asarray(d), np.asarray(v, dtype=bool)
+        rank = np.full(n, -1, dtype=np.int64)   # NULL ranks below all values
+        if v.any():
+            _u, inv = np.unique(d[v], return_inverse=True)
+            rank[v] = inv
+        lex_keys.append(-rank if desc else rank)
+    if not lex_keys:
+        return np.arange(n, dtype=np.int64)
+    # np.lexsort treats its LAST key as primary
+    return np.lexsort(lex_keys[::-1]).astype(np.int64)
 
 
 class SortExec(Executor):
@@ -511,9 +507,7 @@ class SortExec(Executor):
             if whole is not None:
                 yield whole
             return
-        keys = _sort_key_rows(self.plan.by, whole)
-        order = sorted(range(len(keys)), key=lambda i: keys[i])
-        yield whole.take(np.array(order, dtype=np.int64))
+        yield whole.take(_sort_order(self.plan.by, whole))
 
 
 class TopNExec(Executor):
@@ -531,9 +525,7 @@ class TopNExec(Executor):
         for chunk in self.child.chunks(ctx):
             cand = chunk if best is None else best.concat(chunk)
             if cand.num_rows > 0:
-                keys = _sort_key_rows(self.plan.by, cand)
-                order = sorted(range(len(keys)), key=lambda i: keys[i])[:n]
-                best = cand.take(np.array(order, dtype=np.int64))
+                best = cand.take(_sort_order(self.plan.by, cand)[:n])
             else:
                 best = cand
         if best is None:
@@ -596,14 +588,24 @@ class HashJoinExec(Executor):
     def _mesh_kernel(self, nb: int):
         """A shuffle-join kernel when a multi-chip mesh is active and the
         build side is big enough to be worth a repartition (ref: the
-        scaled-out form of executor/join.go's partitioned build)."""
+        scaled-out form of executor/join.go's partitioned build). Cached
+        per (mesh generation, key arity) — the shard_map program costs
+        seconds of XLA compile and is shape-polymorphic across queries."""
         from tidb_tpu.parallel import config as mesh_config
         mesh = mesh_config.active_mesh()
         if mesh is None or mesh.devices.size <= 1 or \
                 nb < self._DEVICE_MIN_BUILD:
             return None
         from tidb_tpu.parallel.shuffle_join import MeshShuffleJoinKernel
-        return MeshShuffleJoinKernel(mesh, len(self.plan.left_keys))
+        key = (mesh_config.mesh_generation(), len(self.plan.left_keys))
+        with _SHUFFLE_KERNELS_LOCK:
+            kernel = _SHUFFLE_KERNELS.get(key)
+            if kernel is None:
+                for k in [k for k in _SHUFFLE_KERNELS if k[0] != key[0]]:
+                    _SHUFFLE_KERNELS.pop(k, None)
+                kernel = MeshShuffleJoinKernel(mesh, len(self.plan.left_keys))
+                _SHUFFLE_KERNELS[key] = kernel
+        return kernel
 
     def chunks(self, ctx):
         plan = self.plan
@@ -620,10 +622,23 @@ class HashJoinExec(Executor):
         probe_iter = self.left.chunks(ctx)
         mesh_kernel = self._mesh_kernel(nb)
         if mesh_kernel is not None:
-            # shuffle join wants the whole probe side at once: each call
-            # is one all_to_all repartition of BOTH sides over the mesh
-            big = Chunk.concat_all(list(probe_iter))
-            probe_iter = [big] if big is not None else []
+            # shuffle join wants the whole probe side at once (each call
+            # is one all_to_all repartition of BOTH sides over the mesh),
+            # but a small probe doesn't pay for the collective: buffer
+            # chunks until the probe proves big enough, else fall through
+            # to the per-chunk device/host paths
+            buffered, total = [], 0
+            for c in probe_iter:
+                buffered.append(c)
+                total += c.num_rows
+                if total >= self._DEVICE_MIN_PROBE:
+                    break
+            if total >= self._DEVICE_MIN_PROBE:
+                big = Chunk.concat_all(buffered + list(probe_iter))
+                probe_iter = [big] if big is not None else []
+            else:
+                mesh_kernel = None
+                probe_iter = iter(buffered)
         for chunk in probe_iter:
             n = chunk.num_rows
             if n == 0:

@@ -28,6 +28,8 @@ from dataclasses import dataclass, field, replace
 from tidb_tpu.expression import ColumnRef, Expression
 from tidb_tpu.expression.core import Op, ScalarFunc, func
 from tidb_tpu.plan import physical as ph
+from tidb_tpu.plan.resolver import PlanSchema, SchemaCol
+from tidb_tpu.sqltypes import new_int_field
 
 __all__ = ["PhysMeshAgg", "PhysMeshLookupAgg", "MeshLookupDesc",
            "route_mesh"]
@@ -117,7 +119,15 @@ def _try_mesh_agg(final: ph.PhysFinalAgg):
     if not _exprs_mesh_safe(cop.group_exprs, cop.aggs, None):
         return None
     raw_cop = replace(cop, group_exprs=None, aggs=None)
-    raw_reader = ph.PhysTableReader(schema=reader.schema, cop=raw_cop)
+    # the stripped reader yields the raw scan columns, not the agg output:
+    # give it a schema to match (advisor r2: children[0].schema must not lie)
+    raw_cols = [SchemaCol(c.name.lower(), cop.table.name.lower(), c.ft, c.id)
+                for c in raw_cop.cols]
+    if raw_cop.handle_col is not None:
+        raw_cols.insert(raw_cop.handle_col,
+                        SchemaCol("_handle", cop.table.name.lower(),
+                                  new_int_field()))
+    raw_reader = ph.PhysTableReader(schema=PlanSchema(raw_cols), cop=raw_cop)
     return PhysMeshAgg(schema=final.schema, children=[raw_reader],
                        group_exprs=list(cop.group_exprs),
                        aggs=list(cop.aggs),

@@ -227,6 +227,17 @@ class Session:
                 return r
         raise SQLError("statement returned no result set")
 
+    def plan(self, sql: str):
+        """Plan a single SELECT and return the physical plan (no
+        execution, no plan cache) — the programmatic EXPLAIN."""
+        stmts = parse(sql)
+        if len(stmts) != 1:
+            raise SQLError("plan() takes a single statement")
+        try:
+            return self._planner().plan(stmts[0])
+        except (PlanError, ResolveError) as e:
+            raise SQLError(str(e)) from None
+
     def close(self):
         if self.txn is not None:
             self.txn.rollback()
