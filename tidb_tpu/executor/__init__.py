@@ -21,6 +21,7 @@ from tidb_tpu.ops.hashagg import (CapacityError, CollisionError,
                                   GroupResult, HashAggKernel, HashAggregator)
 from tidb_tpu.ops.hostagg import host_hash_agg
 from tidb_tpu.ops.join import JoinKernel, JoinKeyEncoder
+from tidb_tpu.ops.streamagg import SegmentAggKernel
 from tidb_tpu.ops.runtime import eval_filter_host
 from tidb_tpu.plan import physical as ph
 from tidb_tpu.sqltypes import EvalType, FieldType, np_dtype_for
@@ -39,6 +40,24 @@ class ExecError(kv.KVError):
 # query with the same key arity on the same mesh
 _SHUFFLE_KERNELS: dict = {}
 _SHUFFLE_KERNELS_LOCK = threading.Lock()
+
+
+def _evict_stale_shuffle_kernels() -> None:
+    from tidb_tpu.parallel import config as mesh_config
+    gen = mesh_config.mesh_generation()
+    with _SHUFFLE_KERNELS_LOCK:
+        for k in [k for k in _SHUFFLE_KERNELS if k[0] != gen]:
+            _SHUFFLE_KERNELS.pop(k, None)
+
+
+def _register_mesh_listener() -> None:
+    # release compiled shard_map executables when the topology changes
+    # (incl. disable_mesh — no later join would otherwise evict them)
+    from tidb_tpu.parallel import config as mesh_config
+    mesh_config.on_topology_change(_evict_stale_shuffle_kernels)
+
+
+_register_mesh_listener()
 
 
 class ExecContext:
@@ -408,6 +427,55 @@ class HashAggExec(Executor):
                                     results)
 
 
+class StreamAggExec(Executor):
+    """Sort-based aggregation: order rows by the group keys, then
+    segment-reduce on device (ops/streamagg.py). Ref:
+    executor/aggregate.go:150-170 StreamAggExec — there the sorted input
+    comes from a child sort/index; here the sort itself is one vectorized
+    lexsort, and the reduce has NO capacity limit (num_segments = slice
+    rows), so arbitrarily many groups never overflow a device table."""
+
+    _SLICE = 1 << 17     # rows per device dispatch
+
+    def __init__(self, plan: ph.PhysStreamAgg):
+        self.plan = plan
+        self.schema = plan.schema
+        self.child = build_executor(plan.children[0])
+        self._kernel = None
+
+    def chunks(self, ctx):
+        agg = HashAggregator(self.plan.aggs)
+        whole = Chunk.concat_all(list(self.child.chunks(ctx)))
+        if whole is not None and whole.num_rows:
+            if not self.plan.sorted_input:
+                by = [(g, False) for g in self.plan.group_exprs]
+                whole = whole.take(_sort_order(by, whole))
+            use_device = all(not a.distinct for a in self.plan.aggs)
+            # slices keep device memory bounded; a group spanning two
+            # slices merges itself in the HashAggregator
+            for s in range(0, whole.num_rows, self._SLICE):
+                part = whole.slice(s, min(s + self._SLICE, whole.num_rows))
+                gr = None
+                if use_device and part.num_rows >= 2048:
+                    try:
+                        if self._kernel is None:
+                            self._kernel = SegmentAggKernel(
+                                self.plan.group_exprs, self.plan.aggs)
+                        gr = self._kernel(part)
+                    except (ValueError, NotImplementedError):
+                        use_device = False
+                if gr is None:
+                    gr = host_hash_agg(part, None, self.plan.group_exprs,
+                                       self.plan.aggs)
+                agg.update(gr)
+        results = agg.results()
+        if not self.plan.group_exprs and not results:
+            results = [((), [_empty_agg_value(a) for a in self.plan.aggs])]
+        yield _agg_results_to_chunk(self.schema,
+                                    len(self.plan.group_exprs),
+                                    self.plan.aggs, results)
+
+
 # ---------------------------------------------------------------------------
 # Row ops
 
@@ -470,29 +538,23 @@ class LimitExec(Executor):
 def _sort_order(by, chunk) -> np.ndarray:
     """-> int64 permutation ordering chunk rows by the sort items, fully
     vectorized (no per-row Python objects — ref SURVEY §3.2's per-row
-    dispatch sin). NULLs first ascending / last descending (MySQL).
-
-    Every key column is dense-ranked via np.unique so DESC is a rank
-    negation that works uniformly for numerics and object (string)
-    columns; np.lexsort is stable, preserving input order on ties."""
-    n = chunk.num_rows
-    lex_keys = []
+    dispatch sin). NULLs first ascending / last descending (MySQL)."""
+    from tidb_tpu.executor.extsort import order_from_keys
+    keys = []
     for e, desc in by:
         d, v = e.eval(chunk)
-        d, v = np.asarray(d), np.asarray(v, dtype=bool)
-        rank = np.full(n, -1, dtype=np.int64)   # NULL ranks below all values
-        if v.any():
-            _u, inv = np.unique(d[v], return_inverse=True)
-            rank[v] = inv
-        lex_keys.append(-rank if desc else rank)
-    if not lex_keys:
-        return np.arange(n, dtype=np.int64)
-    # np.lexsort treats its LAST key as primary
-    return np.lexsort(lex_keys[::-1]).astype(np.int64)
+        keys.append((d, v, desc))
+    return order_from_keys(keys, chunk.num_rows)
 
 
 class SortExec(Executor):
-    """In-memory sort (ref: executor/sort.go:35; external sort later)."""
+    """Sort with spill-to-disk (ref: executor/sort.go:35 in-memory path +
+    util/filesort/filesort.go:319 external path, unified): below
+    SPILL_ROWS everything is one in-memory lexsort; above it, full rows
+    spill to memory-mapped runs while the keys stay resident
+    (executor/extsort.py)."""
+
+    SPILL_ROWS = 1 << 20     # run size; sysvar tidb_tpu_sort_spill_rows
 
     def __init__(self, plan: ph.PhysSort):
         self.plan = plan
@@ -500,14 +562,23 @@ class SortExec(Executor):
         self.child = build_executor(plan.children[0])
 
     def chunks(self, ctx):
-        whole = None
-        for chunk in self.child.chunks(ctx):
-            whole = chunk if whole is None else whole.concat(chunk)
-        if whole is None or whole.num_rows == 0:
-            if whole is not None:
-                yield whole
-            return
-        yield whole.take(_sort_order(self.plan.by, whole))
+        from tidb_tpu.executor.extsort import SpillSorter
+        sorter = SpillSorter(self.plan.by, run_rows=self.SPILL_ROWS)
+        try:
+            empty = None
+            for chunk in self.child.chunks(ctx):
+                if chunk.num_rows == 0:
+                    empty = chunk
+                    continue
+                sorter.add(chunk)
+            n = 0
+            for out in sorter.sorted_chunks():
+                n += out.num_rows
+                yield out
+            if n == 0 and empty is not None:
+                yield empty
+        finally:
+            sorter.close()
 
 
 class TopNExec(Executor):
@@ -764,6 +835,207 @@ class HashJoinExec(Executor):
             if self.plan.other_cond is not None:
                 out = out.filter(eval_filter_host(self.plan.other_cond, out))
             yield out
+
+
+class MergeJoinExec(HashJoinExec):
+    """Streaming sorted-merge equi-join (ref: executor/merge_join.go:34).
+
+    Contract (planner-enforced): both children deliver rows ascending by
+    their single join key — pk-handle table scans arrive in handle order,
+    keep_order index readers in index order. The executor keeps only a
+    sliding window of the right side (rows whose key may still match a
+    future left chunk), so neither side is fully materialized: memory is
+    O(chunk + widest equal-key run). Matching is one vectorized
+    searchsorted per left chunk — the same sort-join shape as the device
+    kernel, minus the sort the inputs already paid."""
+
+    def __init__(self, plan: ph.PhysMergeJoin):
+        self.plan = plan
+        self.schema = plan.schema
+        self.left = build_executor(plan.children[0])
+        self.right = build_executor(plan.children[1])
+        self._kernel = None   # no device kernel: inputs are pre-sorted
+
+    def chunks(self, ctx):
+        plan = self.plan
+        right_iter = self.right.chunks(ctx)
+        window: Chunk | None = None    # right rows that may still match
+        right_done = False
+
+        def right_key(ch):
+            d, v = self._eval_keys(plan.right_keys, ch)[0]
+            return d, v
+
+        for chunk in self.left.chunks(ctx):
+            n = chunk.num_rows
+            if n == 0:
+                continue
+            lk, lv = self._eval_keys(plan.left_keys, chunk)[0]
+            has_valid = bool(np.any(lv))
+            lmax = lk[lv].max() if has_valid else None
+            # grow the window until its tail key exceeds this chunk's max
+            while not right_done and has_valid:
+                wd, wv = (right_key(window) if window is not None
+                          and window.num_rows else (None, None))
+                if wd is not None and len(wd) and wv[-1] and wd[-1] > lmax:
+                    break
+                nxt = next(right_iter, None)
+                if nxt is None:
+                    right_done = True
+                    break
+                window = nxt if window is None else window.concat(nxt)
+            if window is None or window.num_rows == 0:
+                li = ri = np.empty(0, np.int64)
+                unmatched = np.arange(n) if plan.join_type == "left" \
+                    else np.empty(0, np.int64)
+                out = self._emit(chunk, _empty_like_schema(
+                    self.plan.children[1].schema), li, ri, unmatched)
+                if out is not None and out.num_rows:
+                    yield out
+                continue
+            wd, wv = right_key(window)
+            val_idx = np.flatnonzero(wv)
+            wdv = wd[val_idx]
+            lo = np.searchsorted(wdv, lk, side="left")
+            hi = np.searchsorted(wdv, lk, side="right")
+            counts = np.where(lv, hi - lo, 0)
+            total = int(counts.sum())
+            li = np.repeat(np.arange(n), counts)
+            cs = np.concatenate(([0], np.cumsum(counts)[:-1]))
+            w = np.arange(total) - np.repeat(cs, counts)
+            ri = val_idx[np.repeat(lo, counts) + w] if total else \
+                np.empty(0, np.int64)
+            pair = None
+            if plan.other_cond is not None and len(li):
+                pair = self._gather(chunk, window, li, ri)
+                keep = eval_filter_host(plan.other_cond, pair)
+                li, ri = li[keep], ri[keep]
+                pair = pair.filter(keep)
+            unmatched = np.empty(0, np.int64)
+            if plan.join_type == "left":
+                m = np.zeros(n, dtype=bool)
+                m[li] = True
+                unmatched = np.flatnonzero(~m)
+            out = self._emit(chunk, window, li, ri, unmatched, pair=pair)
+            if out is not None and out.num_rows:
+                yield out
+            # slide: right rows strictly below this chunk's max key can
+            # never match again (left keys are non-decreasing)
+            if has_valid and window.num_rows:
+                keep = ~wv | (wd >= lmax)
+                if not keep.all():
+                    window = window.filter(keep)
+
+
+def _empty_like_schema(schema) -> Chunk:
+    cols = []
+    for sc in schema.cols:
+        dtype = np_dtype_for(sc.ft.tp)
+        data = np.empty(0, dtype=dtype if dtype != np.dtype(object)
+                        else object)
+        cols.append(Column(sc.ft, data, np.empty(0, dtype=bool)))
+    return Chunk(cols)
+
+
+class IndexJoinExec(HashJoinExec):
+    """Index nested-loop join (ref: executor/index_lookup_join.go:87).
+
+    Streams the outer side; per outer chunk, collects the distinct valid
+    join-key values and fetches ONLY the matching inner rows — via pk
+    point reads (batch_get) when the key is the handle, else via
+    synthesized point index ranges through the coprocessor. The fetched
+    inner batch then joins against the chunk with the standard pair
+    matcher. Never scans the inner table."""
+
+    def __init__(self, plan: ph.PhysIndexJoin):
+        self.plan = plan
+        self.schema = plan.schema
+        self.left = build_executor(plan.children[0])
+        self._kernel = JoinKernel(len(plan.left_keys))
+
+    def _fetch_inner(self, ctx, key_vals: np.ndarray) -> Chunk:
+        """Inner rows whose key is in key_vals (distinct, non-null)."""
+        from tidb_tpu import ranger as rg
+        icop = self.plan.children[1].cop
+        if _txn_is_dirty(ctx, icop.table.id):
+            # own writes must be visible: full union-store scan, then
+            # filter to the requested keys at the root (correct, slower)
+            reader = TableReaderExec(self.plan.children[1])
+            whole = Chunk.concat_all(list(reader.chunks(ctx)))
+            return whole if whole is not None else \
+                _empty_like_schema(self.plan.children[1].schema)
+        if self.plan.inner_index is None:
+            handles = [int(v) for v in key_vals]
+            snap = ctx.storage.snapshot(ctx.read_ts)
+            keys = [tablecodec.record_key(icop.table.id, h)
+                    for h in handles]
+            got = snap.batch_get(keys)
+            kvrows = [(k, got[k]) for k in keys if k in got]
+            chunk = kvrows_to_chunk(icop.table, icop.cols, kvrows,
+                                    icop.handle_col)
+            return exec_cop_plan(icop, chunk).chunk
+        ft = self.plan.right_keys[0].ft
+        ranges = [rg.DatumRange(low=[_index_datum(v, ft)],
+                                high=[_index_datum(v, ft)])
+                  for v in key_vals]
+        kv_ranges = rg.index_ranges_to_kv(icop.table.id,
+                                          self.plan.inner_index.id, ranges)
+        req = CopRequest(tp=ReqType.DAG, ranges=kv_ranges,
+                         plan=icop, start_ts=ctx.read_ts)
+        out = [resp.chunk for resp in ctx.storage.client().send(req)]
+        whole = Chunk.concat_all(out)
+        return whole if whole is not None else \
+            _empty_like_schema(self.plan.children[1].schema)
+
+    def chunks(self, ctx):
+        plan = self.plan
+        for chunk in self.left.chunks(ctx):
+            n = chunk.num_rows
+            if n == 0:
+                continue
+            kd, kv = plan.left_keys[0].eval(chunk)
+            kd, kv = np.asarray(kd), np.asarray(kv, dtype=bool)
+            vals = np.unique(kd[kv]) if kv.any() else kd[:0]
+            build = self._fetch_inner(ctx, vals) if len(vals) else \
+                _empty_like_schema(plan.children[1].schema)
+            nb = build.num_rows
+            if nb == 0:
+                if plan.join_type == "left":
+                    out = self._emit(chunk, build, np.empty(0, np.int64),
+                                     np.empty(0, np.int64), np.arange(n))
+                    if out is not None and out.num_rows:
+                        yield out
+                continue
+            enc = JoinKeyEncoder(len(plan.right_keys))  # fresh per batch
+            bk = enc.fit_build(self._eval_keys(plan.right_keys, build))
+            pk = enc.transform_probe(self._eval_keys(plan.left_keys, chunk))
+            li, ri = self._kernel(bk, pk, nb, n)
+            pair = None
+            if plan.other_cond is not None and len(li):
+                pair = self._gather(chunk, build, li, ri)
+                keep = eval_filter_host(plan.other_cond, pair)
+                li, ri = li[keep], ri[keep]
+                pair = pair.filter(keep)
+            unmatched = np.empty(0, np.int64)
+            if plan.join_type == "left":
+                m = np.zeros(n, dtype=bool)
+                m[li] = True
+                unmatched = np.flatnonzero(~m)
+            out = self._emit(chunk, build, li, ri, unmatched, pair=pair)
+            if out is not None and out.num_rows:
+                yield out
+
+
+def _index_datum(v, ft):
+    """numpy scalar -> the datum representation codec.encode_key expects
+    for an index column of FieldType ft."""
+    if ft.eval_type == EvalType.DECIMAL:
+        return (ft.frac, int(v))
+    if isinstance(v, (np.integer, int)):
+        return int(v)
+    if isinstance(v, (np.floating, float)):
+        return float(v)
+    return v
 
 
 # ---------------------------------------------------------------------------
@@ -1151,6 +1423,9 @@ _BUILDERS = {
     ph.PhysValues: ValuesExec,
     ph.PhysFinalAgg: FinalAggExec,
     ph.PhysHashAgg: HashAggExec,
+    ph.PhysStreamAgg: StreamAggExec,
+    ph.PhysMergeJoin: MergeJoinExec,
+    ph.PhysIndexJoin: IndexJoinExec,
     ph.PhysSelection: SelectionExec,
     ph.PhysProjection: ProjectionExec,
     ph.PhysLimit: LimitExec,

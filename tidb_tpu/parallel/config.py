@@ -14,10 +14,18 @@ from __future__ import annotations
 from tidb_tpu.parallel.mesh import build_mesh
 
 __all__ = ["configure_mesh", "enable_mesh", "disable_mesh", "active_mesh",
-           "mesh_generation"]
+           "mesh_generation", "on_topology_change"]
 
 _mesh = None
 _generation = 0
+_listeners: list = []
+
+
+def on_topology_change(fn) -> None:
+    """Register fn() to run after every mesh (re)configuration — kernel
+    caches keyed on the generation use this to release compiled programs
+    that can never be hit again (e.g. after disable_mesh)."""
+    _listeners.append(fn)
 
 
 def configure_mesh(mesh) -> None:
@@ -25,6 +33,8 @@ def configure_mesh(mesh) -> None:
     global _mesh, _generation
     _mesh = mesh
     _generation += 1
+    for fn in _listeners:
+        fn()
 
 
 def enable_mesh(n_devices: int | None = None) -> None:

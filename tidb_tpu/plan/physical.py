@@ -18,7 +18,8 @@ from tidb_tpu.schema.model import ColumnInfo, IndexInfo, TableInfo
 
 __all__ = ["CopPlan", "PhysPlan", "PhysTableReader", "PhysIndexReader",
            "PhysIndexLookUp", "PhysPointGet", "PhysSelection",
-           "PhysProjection", "PhysHashAgg", "PhysFinalAgg", "PhysHashJoin",
+           "PhysProjection", "PhysHashAgg", "PhysFinalAgg", "PhysStreamAgg",
+           "PhysHashJoin", "PhysMergeJoin", "PhysIndexJoin",
            "PhysApply", "PhysSort", "PhysLimit", "PhysTopN", "PhysInsert",
            "PhysUpdate", "PhysDelete", "PhysValues"]
 
@@ -175,6 +176,24 @@ class PhysFinalAgg(PhysPlan):
 
 
 @dataclass
+class PhysStreamAgg(PhysPlan):
+    """Sort-based aggregation: sort child rows by the group keys, then
+    segment-reduce on device (ref: executor/aggregate.go:150-170
+    StreamAggExec over sorted input). Chosen by the cost pass when the
+    estimated group cardinality would blow the hash kernel's device
+    table, or when the child already delivers key-contiguous rows
+    (sorted_input=True skips the sort)."""
+
+    group_exprs: list = field(default_factory=list)
+    aggs: list = field(default_factory=list)
+    sorted_input: bool = False
+
+    def _explain_info(self):
+        s = " sorted" if self.sorted_input else ""
+        return f"{s} group:{self.group_exprs!r} aggs:{self.aggs!r}"
+
+
+@dataclass
 class PhysHashJoin(PhysPlan):
     left_keys: list = field(default_factory=list)
     right_keys: list = field(default_factory=list)
@@ -184,6 +203,48 @@ class PhysHashJoin(PhysPlan):
     def _explain_info(self):
         return (f" type:{self.join_type} lkeys:{self.left_keys!r} "
                 f"rkeys:{self.right_keys!r}")
+
+
+@dataclass
+class PhysMergeJoin(PhysPlan):
+    """Sorted-merge equi-join (ref: executor/merge_join.go:34). Both
+    children deliver rows sorted ascending by their single join key (the
+    planner guarantees it: pk-handle table scans are key-ordered, and
+    index readers with keep_order deliver index order); the executor
+    streams both sides with a bounded window — no full build-side
+    materialization."""
+
+    left_keys: list = field(default_factory=list)   # single-expr today
+    right_keys: list = field(default_factory=list)
+    join_type: str = "inner"       # inner/left
+    other_cond: Optional[Expression] = None
+
+    def _explain_info(self):
+        return (f" type:{self.join_type} lkeys:{self.left_keys!r} "
+                f"rkeys:{self.right_keys!r}")
+
+
+@dataclass
+class PhysIndexJoin(PhysPlan):
+    """Index nested-loop join (ref: executor/index_lookup_join.go:87
+    IndexLookUpJoin): children = [outer, inner_reader]. The outer side
+    streams; for each outer batch the executor collects distinct join-key
+    values and fetches only the matching inner rows through the inner
+    table's index (or pk handle) — never scanning the inner table. The
+    inner reader's cop carries the inner scan schema + residual filters;
+    its ranges are synthesized per batch."""
+
+    left_keys: list = field(default_factory=list)   # exprs over outer schema
+    right_keys: list = field(default_factory=list)  # ColumnRefs, inner schema
+    inner_index: Optional[IndexInfo] = None     # None = pk-handle lookup
+    join_type: str = "inner"                    # inner/left
+    other_cond: Optional[Expression] = None     # over joined schema
+
+    def _explain_info(self):
+        via = self.inner_index.name if self.inner_index else "handle"
+        return (f" type:{self.join_type} "
+                f"inner:{self.children[1].cop.table.name} "
+                f"via:{via} okeys:{self.left_keys!r}")
 
 
 @dataclass
