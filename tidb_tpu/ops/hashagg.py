@@ -266,11 +266,11 @@ class HashAggKernel:
 
     def __call__(self, chunk: Chunk) -> GroupResult:
         cols, _dicts = runtime.device_put_chunk(chunk)
-        uniq, nuniq, collided, counts, rep, lanes = self._jit(
-            cols, chunk.num_rows)
-        uniq = np.asarray(uniq)
-        counts = np.asarray(counts)
-        rep = np.asarray(rep)
+        # ONE batched device->host transfer for the whole result pytree:
+        # per-array reads each pay full round-trip latency (the device may
+        # sit behind a network tunnel), a single device_get amortizes it
+        uniq, nuniq, collided, counts, rep, lanes = jax.device_get(
+            self._jit(cols, chunk.num_rows))
         if bool(collided):
             raise CollisionError("group key hash collision")
         live = (counts > 0) & (uniq != _SENTINEL_MASKED) & (uniq != _FILL)
@@ -280,7 +280,7 @@ class HashAggKernel:
             err.needed = int(nuniq)   # executors re-plan with 2x this
             raise err
         gidx = np.flatnonzero(live)
-        lanes_at = [[np.asarray(l)[gidx] for l in ls] for ls in lanes]
+        lanes_at = [[l[gidx] for l in ls] for ls in lanes]
         return finalize_group_result(chunk, self.group_exprs, self.aggs,
                                      gidx, rep[gidx], lanes_at, counts[gidx])
 
@@ -309,10 +309,9 @@ class ScalarAggKernel:
 
     def __call__(self, chunk: Chunk) -> GroupResult:
         cols, _ = runtime.device_put_chunk(chunk)
-        count, lanes = self._jit(cols, chunk.num_rows)
+        count, lanes = jax.device_get(self._jit(cols, chunk.num_rows))
         partials = []
         for a, ls in zip(self.aggs, lanes):
-            ls = [np.asarray(l) for l in ls]
             if a.fn == AggFunc.FIRST_ROW:
                 idx = ls[0]
                 hasv = ls[1] > 0
@@ -323,8 +322,7 @@ class ScalarAggKernel:
                     val = 0
                 ls = [np.array([val]), hasv.astype(np.int64)]
             partials.append(ls)
-        return GroupResult(keys=[()], partials=partials,
-                           counts=np.asarray(count))
+        return GroupResult(keys=[()], partials=partials, counts=count)
 
 
 class HashAggregator:

@@ -179,9 +179,14 @@ class JoinKernel:
             pk = [tuple(map(jnp.asarray, runtime.pad_column(d, v, pb)))
                   for d, v in probe_keys]
             li, ri, ok, total = prog(bk, pk, nb, np_)
-            total = int(total)
+            # scalar first: an overflow retry then discards the cap-sized
+            # index buffers without ever transferring them; the success
+            # path batches the three arrays into one device_get (per-array
+            # reads each pay full round-trip latency through the tunnel)
+            total = int(jax.device_get(total))
             if total > cap:
                 cap = runtime.bucket_size(total)
                 continue
-            sel = np.flatnonzero(np.asarray(ok))
-            return np.asarray(li)[sel], np.asarray(ri)[sel]
+            li, ri, ok = jax.device_get((li, ri, ok))
+            sel = np.flatnonzero(ok)
+            return li[sel], ri[sel]

@@ -80,12 +80,13 @@ class SegmentAggKernel:
 
     def __call__(self, chunk: Chunk) -> GroupResult:
         cols, _dicts = runtime.device_put_chunk(chunk)
-        nseg, counts, rep, lanes = self._jit(cols, chunk.num_rows)
+        # one batched device->host transfer (per-array reads pay full
+        # round-trip latency each; see HashAggKernel.__call__)
+        nseg, counts, rep, lanes = jax.device_get(
+            self._jit(cols, chunk.num_rows))
         nseg = int(nseg)
-        counts = np.asarray(counts)
-        rep = np.asarray(rep)
         gidx = np.arange(nseg)
-        lanes_at = [[np.asarray(l)[gidx] for l in ls] for ls in lanes]
+        lanes_at = [[l[gidx] for l in ls] for ls in lanes]
         return finalize_group_result(chunk, self.group_exprs, self.aggs,
                                      gidx, rep[gidx], lanes_at,
                                      counts[gidx])

@@ -152,9 +152,10 @@ class MeshKernelBase:
     def _postprocess(self, outs):
         """-> (gidx, rep_rows, lanes_at, counts) from the kernel outputs,
         raising on capacity overflow or group-key hash collision."""
-        uniq, cnt, h2min, h2max, rep, agg_out, tot = outs
-        uniq = np.asarray(uniq)
-        cnt = np.asarray(cnt)
+        # ONE batched device->host transfer for the whole output pytree
+        # (per-array reads each pay full round-trip latency; see
+        # ops/hashagg.py HashAggKernel.__call__)
+        uniq, cnt, h2min, h2max, rep, agg_out, tot = jax.device_get(outs)
         # tot counts the masked sentinel / fill phantoms; _C holds >= 2
         # headroom slots for them, so tot > _C means possible truncation
         if int(tot) > self._C:
@@ -163,11 +164,11 @@ class MeshKernelBase:
             err.needed = int(tot)   # executors re-plan with 2x this
             raise err
         live = (cnt > 0) & (uniq != _SENTINEL_MASKED) & (uniq != _FILL)
-        if bool(np.any(live & (np.asarray(h2min) != np.asarray(h2max)))):
+        if bool(np.any(live & (h2min != h2max))):
             raise CollisionError("group key hash collision")
         gidx = np.flatnonzero(live)
-        rep_rows = np.asarray(rep)[gidx]
-        lanes_at = [[np.asarray(l)[gidx] for l in ls] for ls in agg_out]
+        rep_rows = rep[gidx]
+        lanes_at = [[l[gidx] for l in ls] for ls in agg_out]
         return gidx, rep_rows, lanes_at, cnt[gidx]
 
 

@@ -180,9 +180,14 @@ class MeshShuffleJoinKernel:
                 prog = self._program(*key)
                 self._jits[key] = prog
             gl, gr, ok, totals, fl, fr = prog(lk, rk, np_, nb)
-            need_l = int(np.max(np.asarray(fl)))
-            need_r = int(np.max(np.asarray(fr)))
-            max_total = int(np.max(np.asarray(totals)))
+            # small control arrays first: an overflow retry then discards
+            # the cap-sized pair buffers without transferring them; the
+            # success path batches gl/gr/ok into one device_get (per-array
+            # reads each pay full round-trip latency through the tunnel)
+            totals, fl, fr = jax.device_get((totals, fl, fr))
+            need_l = int(np.max(fl))
+            need_r = int(np.max(fr))
+            max_total = int(np.max(totals))
             if need_l > cap_l:
                 cap_l = min(ls, runtime.bucket_size(need_l))
                 continue
@@ -192,7 +197,8 @@ class MeshShuffleJoinKernel:
             if max_total > out_cap:
                 out_cap = runtime.bucket_size(max_total)
                 continue
-            sel = np.flatnonzero(np.asarray(ok))
-            return (np.asarray(gl)[sel].astype(np.int64),
-                    np.asarray(gr)[sel].astype(np.int64))
+            gl, gr, ok = jax.device_get((gl, gr, ok))
+            sel = np.flatnonzero(ok)
+            return (gl[sel].astype(np.int64),
+                    gr[sel].astype(np.int64))
         raise ShuffleOverflowError("shuffle join retry budget exhausted")
