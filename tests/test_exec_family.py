@@ -254,8 +254,8 @@ class TestExternalSort:
             assert row[2] == s_[i]
 
     def test_sql_order_by_spills(self, sess, monkeypatch):
-        import tidb_tpu.executor as ex
-        monkeypatch.setattr(ex.SortExec, "SPILL_ROWS", 1024)
+        from tidb_tpu import config
+        monkeypatch.setitem(config._vals, "tidb_tpu_sort_spill_rows", 1024)
         spilled = []
         orig = SpillSorter._spill
 

@@ -40,3 +40,19 @@ __version__ = "0.1.0"
 import jax as _jax
 
 _jax.config.update("jax_enable_x64", True)
+
+# Persistent XLA compilation cache: operator kernels are compiled per
+# (program, shape-bucket) and identical HLO must never recompile — not
+# across kernel instances, not across processes. Large-batch programs
+# cost tens of seconds of XLA compile; this turns them into disk hits.
+import os as _os
+
+_cache_dir = _os.environ.get(
+    "TIDB_TPU_COMPILE_CACHE",
+    _os.path.join(_os.path.expanduser("~"), ".cache", "tidb_tpu_xla"))
+if _cache_dir and _cache_dir != "0":
+    try:
+        _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # older jax without the knobs
+        pass

@@ -1,0 +1,2 @@
+"""Benchmark harnesses (ref: /root/reference/cmd/benchdb — SQL workloads
+against a store — and BASELINE.md's measurement configs)."""

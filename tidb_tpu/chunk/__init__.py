@@ -110,7 +110,9 @@ class Column:
 class Chunk:
     """A batch of rows in columnar layout. Ref: util/chunk/chunk.go NewChunk."""
 
-    __slots__ = ("columns",)
+    # _dev_cache: memoized device-resident columns (ops/runtime.py
+    # device_put_chunk) — chunks are treated as immutable once built
+    __slots__ = ("columns", "_dev_cache")
 
     def __init__(self, columns: Sequence[Column]):
         self.columns = list(columns)

@@ -1,0 +1,118 @@
+"""Global runtime configuration: the sysvar registry.
+
+Reference: /root/reference/sessionctx/variable/sysvar.go (typed sysvar
+registry), config/config.go:29-52 (TOML config tree) and the concurrency
+knobs of sessionctx/variable/session.go:209-245. One flat registry serves
+all three roles here: every performance knob that used to be a hard-coded
+constant reads through it, `SET @@tidb_tpu_x = v` writes through it, and
+the server CLI seeds it from flags.
+
+Scope note: variables here are GLOBAL (process-wide), matching how the
+executors consume them; per-session shadowing can layer on top later.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+__all__ = ["get_var", "set_var", "all_vars", "device_enabled",
+           "chunk_cache_enabled", "cop_concurrency", "sort_spill_rows",
+           "UnknownVariableError"]
+
+
+class UnknownVariableError(Exception):
+    pass
+
+
+_BOOL, _INT = "bool", "int"
+
+# name -> (type, default). Bool vars store 0/1 like MySQL switches.
+_DEFS: dict[str, tuple[str, int]] = {
+    # master switch for single-chip device kernels; 0 = pure host numpy
+    # execution everywhere (the measured CPU baseline mode of bench.py)
+    "tidb_tpu_device": (_BOOL, 1),
+    # columnar region-chunk cache on the storage side (store/chunk_cache)
+    "tidb_tpu_chunk_cache": (_BOOL, 1),
+    # coprocessor fan-out worker count
+    # (ref: DistSQLScanConcurrency, sessionctx/variable/tidb_vars.go:115)
+    "tidb_tpu_cop_concurrency": (_INT, 10),
+    # SortExec spill threshold in rows (executor/extsort.py run size)
+    "tidb_tpu_sort_spill_rows": (_INT, 1 << 20),
+    # min chunk rows before an executor pays a device dispatch
+    "tidb_tpu_device_min_rows": (_INT, 2048),
+}
+
+_lock = threading.Lock()
+_vals: dict[str, int] = {}
+
+
+def _coerce(name: str, tp: str, value) -> int:
+    if isinstance(value, str):
+        v = value.strip().lower()
+        if tp == _BOOL and v in ("on", "true"):
+            return 1
+        if tp == _BOOL and v in ("off", "false"):
+            return 0
+        value = int(v)
+    iv = int(value)
+    if tp == _BOOL:
+        iv = 1 if iv else 0
+    return iv
+
+
+def _init() -> None:
+    """Defaults, overridable by environment (TIDB_TPU_DEVICE=0 etc.) so
+    benchmarks and CI can flip modes without code."""
+    for name, (tp, dflt) in _DEFS.items():
+        env = os.environ.get(name.upper())
+        _vals[name] = _coerce(name, tp, env) if env is not None else dflt
+
+
+_init()
+
+
+def is_known(name: str) -> bool:
+    return name.lower() in _DEFS
+
+
+def get_var(name: str) -> int:
+    try:
+        return _vals[name.lower()]
+    except KeyError:
+        raise UnknownVariableError(name) from None
+
+
+def set_var(name: str, value) -> None:
+    key = name.lower()
+    tp_dflt = _DEFS.get(key)
+    if tp_dflt is None:
+        raise UnknownVariableError(name)
+    with _lock:
+        _vals[key] = _coerce(key, tp_dflt[0], value)
+
+
+def all_vars() -> dict[str, int]:
+    return dict(_vals)
+
+
+# -- hot-path accessors (plain dict reads; no lock needed for int loads) ----
+
+def device_enabled() -> bool:
+    return bool(_vals["tidb_tpu_device"])
+
+
+def chunk_cache_enabled() -> bool:
+    return bool(_vals["tidb_tpu_chunk_cache"])
+
+
+def cop_concurrency() -> int:
+    return _vals["tidb_tpu_cop_concurrency"]
+
+
+def sort_spill_rows() -> int:
+    return _vals["tidb_tpu_sort_spill_rows"]
+
+
+def device_min_rows() -> int:
+    return _vals["tidb_tpu_device_min_rows"]

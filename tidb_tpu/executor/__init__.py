@@ -13,14 +13,15 @@ import threading
 
 import numpy as np
 
-from tidb_tpu import kv, tablecodec
+from tidb_tpu import config, kv, tablecodec
 from tidb_tpu.chunk import Chunk, Column
 from tidb_tpu.expression import AggDesc, AggFunc, Expression
 from tidb_tpu.kv import CopRequest, KVRange, ReqType
 from tidb_tpu.ops.hashagg import (CapacityError, CollisionError,
                                   GroupResult, HashAggKernel, HashAggregator)
 from tidb_tpu.ops.hostagg import host_hash_agg
-from tidb_tpu.ops.join import JoinKernel, JoinKeyEncoder
+from tidb_tpu.ops.join import (JoinKernel, JoinKeyEncoder,
+                               host_match_pairs)
 from tidb_tpu.ops.streamagg import SegmentAggKernel
 from tidb_tpu.ops.runtime import eval_filter_host
 from tidb_tpu.plan import physical as ph
@@ -383,7 +384,10 @@ class HashAggExec(Executor):
         self.plan = plan
         self.schema = plan.schema
         self.child = build_executor(plan.children[0])
-        self._kernel = None
+        # kernels live on the plan object: the plan cache shares plans
+        # across executions, so the jit program (and its XLA compile)
+        # outlives any one query run
+        self._kernel = getattr(plan, "_root_kernel", None)
 
     def chunks(self, ctx):
         agg = HashAggregator(self.plan.aggs)
@@ -394,11 +398,13 @@ class HashAggExec(Executor):
                 continue
             seen_any = True
             gr = None
-            if distinct_ok and chunk.num_rows >= 2048:
+            if distinct_ok and config.device_enabled() and \
+                    chunk.num_rows >= config.device_min_rows():
                 try:
                     if self._kernel is None:
                         self._kernel = HashAggKernel(
                             None, self.plan.group_exprs, self.plan.aggs)
+                        self.plan._root_kernel = self._kernel
                     gr = self._kernel(chunk)
                 except CapacityError as e:
                     # re-plan once with a larger device table (the re-plan
@@ -410,6 +416,7 @@ class HashAggExec(Executor):
                             self._kernel = HashAggKernel(
                                 None, self.plan.group_exprs,
                                 self.plan.aggs, capacity=cap)
+                            self.plan._root_kernel = self._kernel
                             gr = self._kernel(chunk)
                         except (CapacityError, CollisionError, ValueError):
                             gr = None
@@ -441,7 +448,7 @@ class StreamAggExec(Executor):
         self.plan = plan
         self.schema = plan.schema
         self.child = build_executor(plan.children[0])
-        self._kernel = None
+        self._kernel = getattr(plan, "_root_kernel", None)
 
     def chunks(self, ctx):
         agg = HashAggregator(self.plan.aggs)
@@ -450,17 +457,19 @@ class StreamAggExec(Executor):
             if not self.plan.sorted_input:
                 by = [(g, False) for g in self.plan.group_exprs]
                 whole = whole.take(_sort_order(by, whole))
-            use_device = all(not a.distinct for a in self.plan.aggs)
+            use_device = (config.device_enabled() and
+                          all(not a.distinct for a in self.plan.aggs))
             # slices keep device memory bounded; a group spanning two
             # slices merges itself in the HashAggregator
             for s in range(0, whole.num_rows, self._SLICE):
                 part = whole.slice(s, min(s + self._SLICE, whole.num_rows))
                 gr = None
-                if use_device and part.num_rows >= 2048:
+                if use_device and part.num_rows >= config.device_min_rows():
                     try:
                         if self._kernel is None:
                             self._kernel = SegmentAggKernel(
                                 self.plan.group_exprs, self.plan.aggs)
+                            self.plan._root_kernel = self._kernel
                         gr = self._kernel(part)
                     except (ValueError, NotImplementedError):
                         use_device = False
@@ -549,12 +558,10 @@ def _sort_order(by, chunk) -> np.ndarray:
 
 class SortExec(Executor):
     """Sort with spill-to-disk (ref: executor/sort.go:35 in-memory path +
-    util/filesort/filesort.go:319 external path, unified): below
-    SPILL_ROWS everything is one in-memory lexsort; above it, full rows
-    spill to memory-mapped runs while the keys stay resident
-    (executor/extsort.py)."""
-
-    SPILL_ROWS = 1 << 20     # run size; sysvar tidb_tpu_sort_spill_rows
+    util/filesort/filesort.go:319 external path, unified): below the
+    tidb_tpu_sort_spill_rows sysvar everything is one in-memory lexsort;
+    above it, full rows spill to memory-mapped runs while the keys stay
+    resident (executor/extsort.py)."""
 
     def __init__(self, plan: ph.PhysSort):
         self.plan = plan
@@ -563,7 +570,8 @@ class SortExec(Executor):
 
     def chunks(self, ctx):
         from tidb_tpu.executor.extsort import SpillSorter
-        sorter = SpillSorter(self.plan.by, run_rows=self.SPILL_ROWS)
+        sorter = SpillSorter(self.plan.by,
+                             run_rows=config.sort_spill_rows())
         try:
             empty = None
             for chunk in self.child.chunks(ctx):
@@ -618,8 +626,12 @@ class HashJoinExec(Executor):
         self.schema = plan.schema
         self.left = build_executor(plan.children[0])
         self.right = build_executor(plan.children[1])
-        self._kernel = JoinKernel(len(plan.left_keys)) \
-            if plan.left_keys else None
+        # shared via the plan object so the jit shape cache survives
+        # across executions of a cached plan
+        self._kernel = getattr(plan, "_join_kernel", None)
+        if self._kernel is None and plan.left_keys:
+            self._kernel = JoinKernel(len(plan.left_keys))
+            plan._join_kernel = self._kernel
 
     def _eval_keys(self, exprs, chunk):
         """-> [(data, valid)] with both sides brought to one comparable
@@ -665,7 +677,7 @@ class HashJoinExec(Executor):
         from tidb_tpu.parallel import config as mesh_config
         mesh = mesh_config.active_mesh()
         if mesh is None or mesh.devices.size <= 1 or \
-                nb < self._DEVICE_MIN_BUILD:
+                nb < self._DEVICE_MIN_BUILD or not config.device_enabled():
             return None
         from tidb_tpu.parallel.shuffle_join import MeshShuffleJoinKernel
         key = (mesh_config.mesh_generation(), len(self.plan.left_keys))
@@ -688,7 +700,6 @@ class HashJoinExec(Executor):
         enc = JoinKeyEncoder(len(plan.right_keys))
         bk = enc.fit_build(self._eval_keys(plan.right_keys, build)) \
             if nb else None
-        btable = None  # lazy python-dict probe table for small chunks
         matched_build = np.zeros(nb, dtype=bool)
         probe_iter = self.left.chunks(ctx)
         mesh_kernel = self._mesh_kernel(nb)
@@ -733,24 +744,14 @@ class HashJoinExec(Executor):
                     # designed fallback: extreme hash skew exhausted the
                     # repartition retry budget
                     li, ri = self._kernel(bk, pk, nb, n)
-            elif n >= self._DEVICE_MIN_PROBE or nb >= self._DEVICE_MIN_BUILD:
+            elif config.device_enabled() and \
+                    (n >= self._DEVICE_MIN_PROBE or
+                     nb >= self._DEVICE_MIN_BUILD):
                 li, ri = self._kernel(bk, pk, nb, n)
             else:
-                if btable is None:
-                    btable = {}
-                    for i in range(nb):
-                        if all(v[i] for _d, v in bk):
-                            k = tuple(d[i] for d, _v in bk)
-                            btable.setdefault(k, []).append(i)
-                li_l, ri_l = [], []
-                for i in range(n):
-                    if any(not v[i] for _d, v in pk):
-                        continue
-                    for r in btable.get(tuple(d[i] for d, _v in pk), ()):
-                        li_l.append(i)
-                        ri_l.append(r)
-                li = np.array(li_l, dtype=np.int64)
-                ri = np.array(ri_l, dtype=np.int64)
+                # small inputs / device disabled: the same sort-join,
+                # vectorized in numpy (no jit dispatch, dynamic shapes)
+                li, ri = host_match_pairs(bk, pk, nb, n)
             # other_cond filters pairs BEFORE unmatched detection, so a
             # probe row whose every match fails the condition re-enters
             # as unmatched (outer-join ON-clause semantics)

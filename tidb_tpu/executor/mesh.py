@@ -68,6 +68,41 @@ def _concat_chunks(parts, schema) -> Chunk:
     return big
 
 
+# Bounded registry of concat memos: each entry pins a table-sized host
+# chunk (and transitively its device copy), so unlike the row-bounded
+# ChunkCache these must be counted — a long-lived server executing many
+# distinct cached plans would otherwise pin one table copy per plan.
+_CONCATS: OrderedDict = OrderedDict()
+_CONCATS_CAP = 16
+
+
+def _concat_chunks_cached(holder, slot: str, parts, schema) -> Chunk:
+    """Concat memoized on `holder` (a plan node): when the storage chunk
+    cache serves the same per-region chunk objects again, the concatenated
+    table — and therefore its memoized device copy — is reused, so a hot
+    multi-region scan transfers zero bytes. Keyed by part identities; the
+    parts are pinned in the cache entry so ids cannot be recycled. The
+    global _CONCATS LRU bounds how many such table copies stay pinned."""
+    key = tuple(id(p) for p in parts)
+    cached = getattr(holder, slot, None)
+    if cached is not None and cached[0] == key:
+        reg_key = (id(holder), slot)
+        if reg_key in _CONCATS:
+            _CONCATS.move_to_end(reg_key)
+        return cached[2]
+    big = _concat_chunks(parts, schema)
+    if len(parts) > 1:     # single-part concat returns the cached chunk
+        setattr(holder, slot, (key, parts, big))
+        reg_key = (id(holder), slot)
+        _CONCATS[reg_key] = holder
+        _CONCATS.move_to_end(reg_key)
+        while len(_CONCATS) > _CONCATS_CAP:
+            _rk, h = _CONCATS.popitem(last=False)
+            if hasattr(h, _rk[1]):
+                delattr(h, _rk[1])
+    return big
+
+
 def _emit_results(plan, gr_or_none, executor_mod):
     agg = HashAggregator(plan.aggs)
     if gr_or_none is not None:
@@ -127,11 +162,13 @@ class MeshAggExec(_MeshExecBase):
             yield from self._fallback(ctx)
             return
         reader = ex.build_executor(self.plan.children[0])
-        big = _concat_chunks(list(reader.chunks(ctx)),
-                             self.plan.children[0].schema)
+        big = _concat_chunks_cached(self.plan, "_probe_cache",
+                                    list(reader.chunks(ctx)),
+                                    self.plan.children[0].schema)
 
         def make(capacity):
-            return MeshAggKernel(mesh, None, self.plan.group_exprs,
+            return MeshAggKernel(mesh, self.plan.filter_expr,
+                                 self.plan.group_exprs,
                                  self.plan.aggs, capacity=capacity)
 
         gr = None
@@ -158,28 +195,37 @@ class MeshLookupAggExec(_MeshExecBase):
             specs = []
             for lk in plan.lookups:
                 bexec = ex.build_executor(lk.build_plan)
-                bchunk = _concat_chunks(list(bexec.chunks(ctx)),
-                                        lk.build_plan.schema)
+                bchunk = _concat_chunks_cached(lk, "_chunk_cache",
+                                               list(bexec.chunks(ctx)),
+                                               lk.build_plan.schema)
                 specs.append(LookupSpec(
                     key_exprs=lk.key_exprs, build_chunk=bchunk,
                     build_key_offsets=lk.build_key_offsets,
                     payload_offsets=lk.payload_offsets))
             reader = ex.build_executor(plan.children[0])
-            probe = _concat_chunks(list(reader.chunks(ctx)),
-                                   plan.children[0].schema)
+            probe = _concat_chunks_cached(plan, "_probe_cache",
+                                          list(reader.chunks(ctx)),
+                                          plan.children[0].schema)
+            builds = [self._build_table(d, sp)
+                      for d, sp in zip(plan.lookups, specs)]
         except BuildError:
+            # non-unique / NULL-heavy dimension keys: host join fallback
             yield from self._fallback(ctx)
             return
 
         def make(capacity):
             k = MeshLookupAggKernel(mesh, plan.filter_expr, specs,
                                     plan.group_exprs, plan.aggs,
-                                    capacity=capacity)
+                                    capacity=capacity, builds=builds)
             k.lookups = specs    # freshly built: skip the refresh rebuild
             return k
 
         def run(kernel):
-            self._refresh_builds(kernel, specs)
+            if kernel.lookups is not specs:
+                # cached kernel: the traced program depends only on the
+                # lookup STRUCTURE; swap in the current tables
+                kernel.lookups = specs
+                kernel.builds = builds
             return kernel(probe)
 
         gr = None
@@ -191,11 +237,15 @@ class MeshLookupAggExec(_MeshExecBase):
         yield _emit_results(plan, gr, ex)
 
     @staticmethod
-    def _refresh_builds(kernel: MeshLookupAggKernel, specs) -> None:
-        """A cached kernel's traced program depends only on the lookup
-        STRUCTURE; the dimension data rides in as runtime arguments. Swap
-        in freshly built tables so re-executions see current data."""
+    def _build_table(desc, spec):
+        """Host build-table prep (sort, exact-bit lanes, device upload)
+        memoized on the plan's lookup descriptor: when the storage chunk
+        cache serves the same dimension chunk object again, the prepared
+        table (and its device copy) is reused as-is."""
         from tidb_tpu.parallel.dist_join import _BuildTable
-        if kernel.lookups is not specs:
-            kernel.lookups = specs
-            kernel.builds = [_BuildTable(lk) for lk in specs]
+        cached = getattr(desc, "_build_cache", None)
+        if cached is not None and cached[0] is spec.build_chunk:
+            return cached[1]
+        bt = _BuildTable(spec)
+        desc._build_cache = (spec.build_chunk, bt)
+        return bt

@@ -292,7 +292,7 @@ class CopRequest:
     ranges: list[KVRange]
     plan: object
     start_ts: int
-    concurrency: int = 10
+    concurrency: int = 0   # 0 = the tidb_tpu_cop_concurrency sysvar
     keep_order: bool = False
     desc: bool = False
     priority: Priority = Priority.NORMAL

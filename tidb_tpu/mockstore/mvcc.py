@@ -71,6 +71,17 @@ class MVCCStore:
         # bumped on EVERY state change (locks included): the columnar
         # chunk cache (store/chunk_cache.py) keys its validity on it
         self.data_version = 0
+        # newest commit_ts ever written: a scan snapshot at ts >= this sees
+        # the full current state, so its decoded chunk is safe to cache
+        # (an OLDER snapshot's scan must never populate the cache — newer
+        # readers would inherit its stale view)
+        self.max_commit_ts = 0
+        # keys currently holding a Percolator lock: lock VISIBILITY is
+        # per-reader-ts (a lock from a NEWER txn doesn't block an older
+        # snapshot's scan), so a fill made while any lock is pending could
+        # be served to a reader that must instead see KeyLockedError —
+        # the chunk-cache filler refuses to cache while this is nonempty
+        self._locked_keys: set = set()
 
     # -- internal ------------------------------------------------------------
 
@@ -144,6 +155,42 @@ class MVCCStore:
                         break
         return out
 
+    # -- offline ingest ------------------------------------------------------
+
+    def bulk_import(self, pairs, start_ts: int, commit_ts: int) -> int:
+        """Offline ingest of pre-encoded (key, value) pairs as committed
+        PUTs at `commit_ts`, bypassing the Percolator lock protocol — the
+        importer owns the target range (ref: util/kvencoder's standalone
+        KV-pair encoder for offline import, and TiKV's ingest-SST flow).
+        Keys already present get a new newest version; readers at a ts
+        below `commit_ts` keep seeing the old state. -> pairs ingested."""
+        n = 0
+        with self._mu:
+            self.data_version += 1
+            if commit_ts > self.max_commit_ts:
+                self.max_commit_ts = commit_ts
+            fresh = {}
+            for k, v in pairs:
+                e = self._entries.get(k)
+                if e is None:
+                    # fresh key: construct the whole entry in one go
+                    # (the common bulk-load case; avoids _entry dict probe)
+                    fresh[k] = _Entry(
+                        lock=None,
+                        writes=[(commit_ts, start_ts, WriteType.PUT)],
+                        data={start_ts: v})
+                else:
+                    if e.lock is not None:
+                        raise KeyLockedError(e.lock.info(k))
+                    e.data[start_ts] = v
+                    e.writes.insert(0, (commit_ts, start_ts, WriteType.PUT))
+                n += 1
+            if fresh:
+                # one bulk update: SortedDict sorts the new keys wholesale
+                # instead of per-item tree inserts
+                self._entries.update(fresh)
+        return n
+
     # -- percolator write protocol ------------------------------------------
 
     def prewrite(self, mutations: list[Mutation], primary: bytes,
@@ -169,6 +216,7 @@ class MVCCStore:
             for m in mutations:
                 e = self._entry(m.key)
                 e.lock = _Lock(primary, start_ts, ttl_ms, m.op, m.value)
+                self._locked_keys.add(m.key)
 
     def commit(self, keys: list[bytes], start_ts: int, commit_ts: int) -> None:
         """Ref: mvcc_leveldb.go Commit — idempotent for already-committed."""
@@ -187,6 +235,8 @@ class MVCCStore:
 
     def _commit_locked(self, key: bytes, e: _Entry, start_ts: int,
                        commit_ts: int) -> None:
+        if commit_ts > self.max_commit_ts:
+            self.max_commit_ts = commit_ts
         lock = e.lock
         if lock.op == MutationOp.PUT:
             e.data[start_ts] = lock.value
@@ -196,6 +246,7 @@ class MVCCStore:
         else:
             e.writes.insert(0, (commit_ts, start_ts, WriteType.LOCK))
         e.lock = None
+        self._locked_keys.discard(key)
 
     def _find_txn_write(self, e: Optional[_Entry], start_ts: int):
         if e is None:
@@ -216,6 +267,7 @@ class MVCCStore:
                     raise KVError(f"txn {start_ts} already committed on {k!r}")
                 if e.lock is not None and e.lock.start_ts == start_ts:
                     e.lock = None
+                    self._locked_keys.discard(k)
                 if wt is None:
                     # rollback record blocks a late prewrite from this txn
                     e.writes.insert(0, (start_ts, start_ts, WriteType.ROLLBACK))
@@ -233,6 +285,7 @@ class MVCCStore:
                         physical_ms(start_ts) + e.lock.ttl_ms:
                     raise KeyLockedError(e.lock.info(key))
                 e.lock = None
+                self._locked_keys.discard(key)
                 e.writes.insert(0, (start_ts, start_ts, WriteType.ROLLBACK))
                 return 0
             wt = self._find_txn_write(e, start_ts)
@@ -269,6 +322,7 @@ class MVCCStore:
                         self._commit_locked(k, e, start_ts, commit_ts)
                     else:
                         e.lock = None
+                        self._locked_keys.discard(k)
                         e.writes.insert(0, (start_ts, start_ts, WriteType.ROLLBACK))
 
     # -- maintenance ---------------------------------------------------------
@@ -278,6 +332,7 @@ class MVCCStore:
             self.data_version += 1
             for k in list(self._entries.irange(start, end or None,
                                                inclusive=(True, False))):
+                self._locked_keys.discard(k)
                 del self._entries[k]
 
     def gc(self, safepoint_ts: int, start: bytes = b"",

@@ -30,6 +30,138 @@ def host_hash_agg(chunk: Chunk, filter_expr: Expression | None,
                   group_exprs: list[Expression],
                   aggs: list[AggDesc]) -> GroupResult:
     mask = eval_filter_host(filter_expr, chunk)
+    if not any(a.distinct for a in aggs):
+        return _host_agg_vectorized(chunk, mask, group_exprs, aggs)
+    return _host_agg_rowloop(chunk, mask, group_exprs, aggs)
+
+
+def _lex_key(d: np.ndarray, v: np.ndarray):
+    """Sortable, NULL-safe lexsort lanes for one group column."""
+    if d.dtype == np.dtype(object):
+        # strings: convert to a fixed 'U' dtype once (C-speed compares)
+        s = np.where(v, d, "")
+        return [s.astype("U"), ~v]
+    safe = np.where(v, d, d.dtype.type(0))
+    return [safe, ~v]
+
+
+def _host_agg_vectorized(chunk: Chunk, mask, group_exprs, aggs
+                         ) -> GroupResult:
+    """Sort-based group-by, fully vectorized (np.lexsort + ufunc.reduceat):
+    the numpy mirror of the device segment-reduce kernel, and the measured
+    CPU baseline of bench.py — kept honest by being a real columnar
+    engine, not a per-row interpreter (the reference's chunk executor is
+    compiled Go; a Python row loop would flatter the device numbers)."""
+    live = np.flatnonzero(mask)
+    nlive = len(live)
+    gcols = [(d, v) for d, v in _eval_cols(group_exprs, chunk)]
+    if nlive == 0:
+        return GroupResult(keys=[], partials=[
+            _states_to_lanes(a, []) for a in aggs],
+            counts=np.zeros(0, dtype=np.int64))
+    lanes = []
+    for d, v in gcols:
+        lanes.extend(_lex_key(np.asarray(d)[live],
+                              np.asarray(v)[live]))
+    if lanes:
+        order = np.lexsort(lanes[::-1])   # first col is primary
+        sorted_lanes = [l[order] for l in lanes]
+        new = np.zeros(nlive, dtype=bool)
+        new[0] = True
+        for l in sorted_lanes:
+            new[1:] |= l[1:] != l[:-1]
+    else:
+        order = np.arange(nlive)
+        new = np.zeros(nlive, dtype=bool)
+        new[0] = True
+    starts = np.flatnonzero(new)
+    gid = np.cumsum(new) - 1
+    ngroups = len(starts)
+    rows = live[order]                    # original row index per position
+    counts = np.add.reduceat(np.ones(nlive, dtype=np.int64), starts)
+
+    # group keys from each segment's first row
+    rep = rows[starts]
+    keys_cols = []
+    for d, v in gcols:
+        dv, vv = np.asarray(d)[rep], np.asarray(v)[rep]
+        keys_cols.append([None if not vv[i] else
+                          (dv[i].item() if hasattr(dv[i], "item") else dv[i])
+                          for i in range(ngroups)])
+    keys = list(zip(*keys_cols)) if keys_cols else [()] * ngroups
+
+    partials = []
+    for a in aggs:
+        partials.append(_agg_lanes_vectorized(a, chunk, rows, starts, gid,
+                                              ngroups, counts))
+    return GroupResult(keys=keys, partials=partials, counts=counts)
+
+
+def _agg_lanes_vectorized(a: AggDesc, chunk, rows, starts, gid, ngroups,
+                          counts):
+    """One aggregate's partial lanes over sorted segments (layout matches
+    _states_to_lanes / the device kernel's finalized lanes)."""
+    fn = a.fn
+    if a.arg is None:     # COUNT(*)
+        return [counts.copy()]
+    d, v = a.arg.eval(chunk)
+    d, v = np.asarray(d)[rows], np.asarray(v)[rows]
+    has = (np.maximum.reduceat(v.astype(np.int64), starts)
+           if len(rows) else np.zeros(ngroups, dtype=np.int64))
+    if fn == AggFunc.COUNT:
+        return [np.add.reduceat(v.astype(np.int64), starts)]
+    if fn in (AggFunc.SUM, AggFunc.AVG):
+        if d.dtype == np.dtype(object):
+            # decimal/object sums fall back per-group (rare path)
+            sums = np.array([sum(int(x) for x, ok in
+                                 zip(d[s:e], v[s:e]) if ok)
+                             for s, e in _seg_bounds(starts, len(rows))],
+                            dtype=object)
+        else:
+            zero = d.dtype.type(0)
+            sums = np.add.reduceat(np.where(v, d, zero), starts)
+        if fn == AggFunc.SUM:
+            return [sums, has]
+        return [sums, np.add.reduceat(v.astype(np.int64), starts)]
+    if fn in (AggFunc.MIN, AggFunc.MAX):
+        red = np.minimum if fn == AggFunc.MIN else np.maximum
+        if d.dtype == np.dtype(object):
+            vals = []
+            for s, e in _seg_bounds(starts, len(rows)):
+                seg = [x for x, ok in zip(d[s:e], v[s:e]) if ok]
+                vals.append(red.reduce(seg) if seg else 0)
+            arr = np.array(vals, dtype=object)
+        elif d.dtype == np.float64:
+            ident = np.inf if fn == AggFunc.MIN else -np.inf
+            arr = red.reduceat(np.where(v, d, ident), starts)
+            arr = np.where(has > 0, arr, 0.0)
+        else:
+            ident = np.iinfo(np.int64).max if fn == AggFunc.MIN \
+                else np.iinfo(np.int64).min
+            arr = red.reduceat(np.where(v, d, ident), starts)
+            arr = np.where(has > 0, arr, 0)
+        return [arr, has]
+    if fn == AggFunc.FIRST_ROW:
+        n = len(rows)
+        pos = np.where(v, np.arange(n), n)
+        first = np.minimum.reduceat(pos, starts) if n else \
+            np.zeros(ngroups, dtype=np.int64)
+        idx = np.clip(first, 0, max(n - 1, 0))
+        vals = d[idx] if n else np.zeros(ngroups, dtype=np.int64)
+        if vals.dtype != np.dtype(object):
+            vals = np.where(has > 0, vals, 0)
+        return [vals, has]
+    raise NotImplementedError(fn)
+
+
+def _seg_bounds(starts, n):
+    ends = np.append(starts[1:], n)
+    return zip(starts, ends)
+
+
+def _host_agg_rowloop(chunk: Chunk, mask, group_exprs,
+                      aggs: list[AggDesc]) -> GroupResult:
+    """Row-at-a-time path for DISTINCT aggregates (set state per group)."""
     gcols = _eval_cols(group_exprs, chunk)
     acols = [(None, None) if a.arg is None else a.arg.eval(chunk)
              for a in aggs]
@@ -69,6 +201,9 @@ def host_hash_agg(chunk: Chunk, filter_expr: Expression | None,
 def host_scalar_agg(chunk: Chunk, filter_expr: Expression | None,
                     aggs: list[AggDesc]) -> GroupResult:
     mask = eval_filter_host(filter_expr, chunk)
+    if mask.any() and not any(a.distinct for a in aggs):
+        # one all-rows segment through the vectorized group-by
+        return _host_agg_vectorized(chunk, mask, [], aggs)
     acols = [(None, None) if a.arg is None else a.arg.eval(chunk)
              for a in aggs]
     states = [_init_state(a) for a in aggs]

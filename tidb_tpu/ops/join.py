@@ -125,6 +125,48 @@ def match_pairs(xp, hb, hp, bd_lanes, pd_lanes, out_cap):
     return li_c, ri, ok, total
 
 
+def host_match_pairs(build_keys, probe_keys, nb: int, np_: int):
+    """Vectorized numpy pair matcher — the same sort-join algorithm as the
+    device kernel, with dynamic shapes (free on the host). This is the
+    measured-baseline equivalent of the reference's compiled Go hash join
+    (executor/join.go:37): columnar and vectorized, no accelerator.
+    -> (li, ri) numpy index arrays of matching (probe, build) pairs."""
+    if nb == 0 or np_ == 0:
+        return np.empty(0, np.int64), np.empty(0, np.int64)
+    b_valid = np.ones(nb, dtype=bool)
+    for _d, v in build_keys:
+        b_valid &= v[:nb]
+    p_valid = np.ones(np_, dtype=bool)
+    for _d, v in probe_keys:
+        p_valid &= v[:np_]
+    hb = _hash_keys(np, [(d[:nb], v[:nb] & b_valid)
+                         for d, v in build_keys], nb,
+                    seed=0x9E3779B97F4A7C15)
+    hp = _hash_keys(np, [(d[:np_], v[:np_] & p_valid)
+                         for d, v in probe_keys], np_,
+                    seed=0x9E3779B97F4A7C15)
+    hb = np.where(b_valid, hb, _DEAD_BUILD)
+    hp = np.where(p_valid, hp, _DEAD_PROBE)
+    perm = np.argsort(hb, kind="stable")
+    sb = hb[perm]
+    left = np.searchsorted(sb, hp, side="left")
+    right = np.searchsorted(sb, hp, side="right")
+    counts = np.where(hp != _DEAD_PROBE, right - left, 0)
+    total = int(counts.sum())
+    if total == 0:
+        return np.empty(0, np.int64), np.empty(0, np.int64)
+    li = np.repeat(np.arange(np_, dtype=np.int64), counts)
+    # position within each probe row's candidate run
+    run_start = np.cumsum(counts) - counts
+    pos = left[li] + (np.arange(total, dtype=np.int64) - run_start[li])
+    ri = perm[pos]
+    # exact key verification discards hash-collision candidates
+    ok = np.ones(total, dtype=bool)
+    for (bd, _bv), (pd_, _pv) in zip(build_keys, probe_keys):
+        ok &= bd[:nb][ri] == pd_[:np_][li]
+    return li[ok], ri[ok]
+
+
 class JoinKernel:
     """Compiled pair matcher for one key-lane signature.
 

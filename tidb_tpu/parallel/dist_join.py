@@ -113,19 +113,34 @@ class _BuildTable:
                 d = codes
             self.pay_data.append(d[order])
             self.pay_valid.append(np.asarray(c.valid)[order])
-        # host-side exact map for finalize / reference impl, keyed in the
-        # chunk-layer value domain (raw int64/float64; decimals scaled) to
-        # match host expression eval output
-        self.row_by_key = {}
-        for i in range(n):
-            kt = tuple(d[i].item() for d, _v in key_lanes)
-            self.row_by_key[kt] = i
+        self._key_lanes = key_lanes
+        self._row_by_key = None
+        self._dev = None
 
-    def device_arrays(self):
-        return (jnp.asarray(self.h_sorted),
-                tuple(jnp.asarray(b) for b in self.key_bits),
-                tuple(jnp.asarray(d) for d in self.pay_data),
-                tuple(jnp.asarray(v) for v in self.pay_valid))
+    @property
+    def row_by_key(self) -> dict:
+        """Host-side exact map for finalize / reference impl, keyed in the
+        chunk-layer value domain (raw int64/float64; decimals scaled) to
+        match host expression eval output. Built lazily — the device path
+        only touches it for a handful of representative rows, and a large
+        dimension table (orders at SF>=1) costs seconds to enumerate."""
+        if self._row_by_key is None:
+            m = {}
+            for i in range(self.n):
+                m[tuple(d[i].item() for d, _v in self._key_lanes)] = i
+            self._row_by_key = m
+        return self._row_by_key
+
+    def device_arrays(self, sharding=None):
+        """Build lanes on device (replicated under `sharding`), memoized:
+        one batched device_put on first use, zero transfer when a cached
+        kernel re-executes against unchanged dimension data."""
+        key = id(sharding.mesh) if sharding is not None else None
+        if self._dev is None or self._dev[0] != key:
+            tree = (self.h_sorted, tuple(self.key_bits),
+                    tuple(self.pay_data), tuple(self.pay_valid))
+            self._dev = (key, jax.device_put(tree, sharding))
+        return self._dev[1]
 
 
 class MeshLookupAggKernel(MeshKernelBase):
@@ -134,7 +149,8 @@ class MeshLookupAggKernel(MeshKernelBase):
     def __init__(self, mesh: Mesh, filter_expr: Expression | None,
                  lookups: Sequence[LookupSpec],
                  group_exprs: Sequence[Expression],
-                 aggs: Sequence[AggDesc], capacity: int = 4096):
+                 aggs: Sequence[AggDesc], capacity: int = 4096,
+                 builds: list | None = None):
         self.mesh = mesh
         self.filter_expr = filter_expr
         self.lookups = list(lookups)
@@ -143,7 +159,8 @@ class MeshLookupAggKernel(MeshKernelBase):
         _validate_device_exprs(filter_expr, self.group_exprs, self.aggs)
         for lk in self.lookups:
             _validate_device_exprs(None, lk.key_exprs, [])
-        self.builds = [_BuildTable(lk) for lk in self.lookups]
+        self.builds = builds if builds is not None \
+            else [_BuildTable(lk) for lk in self.lookups]
         self._setup_mesh(mesh, capacity, n_extra_args=1)
 
     # -- traced program ------------------------------------------------------
@@ -189,10 +206,7 @@ class MeshLookupAggKernel(MeshKernelBase):
     def __call__(self, probe: Chunk):
         cols, _ln = self._shard_probe(probe)
         rep_sh = NamedSharding(self.mesh, P())
-        builds = tuple(
-            jax.tree.map(lambda a: jax.device_put(a, rep_sh),
-                         b.device_arrays())
-            for b in self.builds)
+        builds = tuple(b.device_arrays(rep_sh) for b in self.builds)
         outs = self._jit(cols, jnp.int64(probe.num_rows), builds)
         gidx, rep_rows, lanes_at, counts = self._postprocess(outs)
         return self._finalize(probe, gidx, rep_rows, lanes_at, counts)

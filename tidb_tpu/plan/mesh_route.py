@@ -44,6 +44,7 @@ class PhysMeshAgg(ph.PhysPlan):
     group_exprs: list = field(default_factory=list)
     aggs: list = field(default_factory=list)
     num_group_cols: int = 0
+    filter_expr: Expression = None   # device-safe filter lifted from the cop
     fallback: ph.PhysPlan = None
 
     def _explain_info(self):
@@ -119,6 +120,13 @@ def _try_mesh_agg(final: ph.PhysFinalAgg):
     if not _exprs_mesh_safe(cop.group_exprs, cop.aggs, None):
         return None
     raw_cop = replace(cop, group_exprs=None, aggs=None)
+    # lift a device-safe scan filter into the mesh kernel: the raw scan
+    # then serves identical (cacheable) chunks to every query and the
+    # filter runs fused on device instead of per-query host numpy
+    dev_filter = None
+    if raw_cop.filter is not None and raw_cop.filter.is_device_safe():
+        dev_filter = raw_cop.filter
+        raw_cop = replace(raw_cop, filter=None)
     # the stripped reader yields the raw scan columns, not the agg output:
     # give it a schema to match (advisor r2: children[0].schema must not lie)
     raw_cols = [SchemaCol(c.name.lower(), cop.table.name.lower(), c.ft, c.id)
@@ -132,6 +140,7 @@ def _try_mesh_agg(final: ph.PhysFinalAgg):
                        group_exprs=list(cop.group_exprs),
                        aggs=list(cop.aggs),
                        num_group_cols=final.num_group_cols,
+                       filter_expr=dev_filter,
                        fallback=final)
 
 

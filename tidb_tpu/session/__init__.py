@@ -489,6 +489,16 @@ class Session:
                 else:
                     val = d[0].item() if hasattr(d[0], "item") else d[0]
             if a.is_system:
+                from tidb_tpu import config
+                if config.is_known(a.name):
+                    # runtime knobs live in the global registry
+                    # (ref: sessionctx/variable/sysvar.go)
+                    try:
+                        config.set_var(a.name, val)
+                    except (TypeError, ValueError):
+                        raise SQLError(
+                            f"invalid value for @@{a.name}: {val!r}") \
+                            from None
                 self.sys_vars[a.name.lower()] = val
                 if a.name.lower() == "autocommit":
                     self.autocommit = bool(int(val)) if val is not None \
@@ -519,7 +529,10 @@ class Session:
             return ResultSet(["Field", "Type", "Null", "Key", "Default",
                               "Extra"], rows)
         if stmt.tp == "variables":
-            rows = sorted((k, str(v)) for k, v in self.sys_vars.items())
+            from tidb_tpu import config
+            merged = dict(config.all_vars())
+            merged.update(self.sys_vars)
+            rows = sorted((k, str(v)) for k, v in merged.items())
             if stmt.pattern:
                 import re
                 from tidb_tpu.expression.core import _like_to_regex

@@ -16,8 +16,11 @@ snapshot ts. It is served only when
     KeyLockedError for resolution exactly as an uncached read would), and
   * read_ts >= fill_ts (with no state change since the fill, any newer
     snapshot sees byte-identical data; an OLDER snapshot may not).
-Transaction-local dirty reads never reach the coprocessor path at all
-(executor TableReaderExec falls back to the union store).
+The filler must additionally guarantee fill_ts covers every commit in the
+store (store/copr.py checks MVCCStore.max_commit_ts): a long-running old
+snapshot's scan is correct for ITS ts but would poison newer readers if
+cached. Transaction-local dirty reads never reach the coprocessor path at
+all (executor TableReaderExec falls back to the union store).
 """
 
 from __future__ import annotations
@@ -41,7 +44,7 @@ class ChunkCache:
 
     @staticmethod
     def key(region, plan, s: bytes, e: bytes):
-        return (region.id, region.ver, plan.table.id,
+        return (region.id, region.version, plan.table.id,
                 plan.index.id if plan.index is not None else None,
                 tuple(c.id for c in plan.cols), plan.handle_col, s, e)
 

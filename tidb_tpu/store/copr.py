@@ -14,7 +14,7 @@ import queue
 import threading
 from concurrent.futures import ThreadPoolExecutor
 
-from tidb_tpu import kv, tablecodec
+from tidb_tpu import config, kv, tablecodec
 from tidb_tpu.kv import (CopRequest, CopResponse, KVRange, NotLeaderError,
                          RegionError, ReqType, ServerBusyError,
                          KeyLockedError)
@@ -35,9 +35,6 @@ DEFAULT_COP_CONCURRENCY = 10
 
 # storage-side scan batching; large batches amortize device dispatch
 COP_SCAN_BATCH = 65536
-
-# below this many rows the jit dispatch overhead beats the device win
-_DEVICE_MIN_ROWS = 2048
 
 _kernel_lock = threading.Lock()
 
@@ -62,7 +59,8 @@ def exec_cop_plan(plan: CopPlan, chunk) -> CopResponse:
         mask = eval_filter_host(plan.host_filter, chunk)
         chunk = chunk.filter(mask)
     if plan.is_agg:
-        use_device = chunk.num_rows >= _DEVICE_MIN_ROWS
+        use_device = (config.device_enabled() and
+                      chunk.num_rows >= config.device_min_rows())
         if use_device:
             try:
                 res = _agg_kernels(plan)(chunk)
@@ -83,7 +81,56 @@ def exec_cop_plan(plan: CopPlan, chunk) -> CopResponse:
 def cop_handler(storage):
     """Builds the storage-side handler closure installed into the RPC shim.
     Executes scan+filter+partial-agg for one region (cop_handler_dag.go's
-    role)."""
+    role). Unlimited scans are served through the storage node's columnar
+    chunk cache (store/chunk_cache.py — the TiFlash-columnar-replica
+    analogue): the KV scan + row decode runs once per engine state, and
+    repeated analytical reads go straight from decoded columns to the
+    device kernel."""
+
+    def _decode(plan: CopPlan, batch):
+        if plan.index is not None:
+            return index_kvrows_to_chunk(plan.table, plan.index,
+                                         plan.cols, batch,
+                                         handle_col=plan.handle_col)
+        return kvrows_to_chunk(plan.table, plan.cols, batch,
+                               with_handle_col=plan.handle_col)
+
+    def _cached_range_chunk(region: Region, plan: CopPlan, s: bytes,
+                            e: bytes, req: CopRequest):
+        """Whole-range decoded chunk with cache lookup/fill."""
+        from tidb_tpu.store.chunk_cache import ChunkCache
+        cache = storage.chunk_cache
+        key = ChunkCache.key(region, plan, s, e)
+        # sample the version BEFORE scanning: a write landing mid-scan
+        # bumps past it, so the filled entry can never serve stale data.
+        # A pending lock anywhere also vetoes caching: lock visibility is
+        # per-reader-ts, so a fill that legally skipped a newer txn's lock
+        # would hide the KeyLockedError a newer reader must hit.
+        dv = storage.engine.data_version
+        cacheable = not storage.engine._locked_keys
+        hit = cache.get(key, dv, req.start_ts)
+        if hit is not None:
+            return hit
+        parts = []
+        cur = s
+        while True:
+            batch = storage.engine.scan(cur, e, COP_SCAN_BATCH,
+                                        req.start_ts, req.isolation,
+                                        desc=False)
+            if not batch:
+                break
+            parts.append(_decode(plan, batch))
+            if len(batch) < COP_SCAN_BATCH:
+                break
+            cur = batch[-1][0] + b"\x00"
+        from tidb_tpu.chunk import Chunk
+        chunk = Chunk.concat_all(parts) if parts else _decode(plan, [])
+        # cache only fills whose snapshot covers every commit: an older
+        # snapshot's view is valid for ITS ts but must not become the
+        # cached truth for newer readers (see MVCCStore.max_commit_ts)
+        if cacheable and req.start_ts >= storage.engine.max_commit_ts:
+            cache.put(key, dv, req.start_ts, chunk)
+        return chunk
 
     def handle(region: Region, req: CopRequest) -> list[CopResponse]:
         plan: CopPlan = req.plan
@@ -91,6 +138,13 @@ def cop_handler(storage):
         s = max(rng.start, region.start)
         e = rng.end if not region.end else (
             min(rng.end, region.end) if rng.end else region.end)
+        use_cache = (plan.limit is None and config.chunk_cache_enabled()
+                     and getattr(storage, "chunk_cache", None) is not None)
+        if use_cache:
+            chunk = _cached_range_chunk(region, plan, s, e, req)
+            if chunk.num_rows == 0:
+                return []
+            return [exec_cop_plan(plan, chunk)]
         out = []
         cur = s
         remaining = plan.limit
@@ -99,14 +153,7 @@ def cop_handler(storage):
                                         req.isolation, desc=False)
             if not batch:
                 break
-            if plan.index is not None:
-                chunk = index_kvrows_to_chunk(plan.table, plan.index,
-                                              plan.cols, batch,
-                                              handle_col=plan.handle_col)
-            else:
-                chunk = kvrows_to_chunk(plan.table, plan.cols, batch,
-                                        with_handle_col=plan.handle_col)
-            resp = exec_cop_plan(plan, chunk)
+            resp = exec_cop_plan(plan, _decode(plan, batch))
             out.append(resp)
             if remaining is not None and not plan.is_agg:
                 remaining -= resp.chunk.num_rows
@@ -136,7 +183,8 @@ class CopClient(kv.Client):
         tasks = self.cache.split_ranges_by_region(req.ranges)
         if not tasks:
             return
-        concurrency = min(req.concurrency, len(tasks))
+        concurrency = min(req.concurrency or config.cop_concurrency(),
+                          len(tasks))
         if concurrency <= 1 or len(tasks) == 1:
             for loc, rng in tasks:
                 yield from self._run_task(req, rng)

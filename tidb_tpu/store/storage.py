@@ -30,6 +30,9 @@ class MockStorage(kv.Storage):
         self.async_commit_secondaries = True
         self._client = None
         self.safepoint = 0   # GC safepoint (ref: safepoint.go watcher)
+        # storage-node columnar cache for the coprocessor read path
+        from tidb_tpu.store.chunk_cache import ChunkCache
+        self.chunk_cache = ChunkCache()
 
     def begin(self, start_ts: int | None = None) -> KVTxn:
         return KVTxn(self, start_ts if start_ts is not None
