@@ -63,10 +63,21 @@ def _coerce(name: str, tp: str, value) -> int:
 
 def _init() -> None:
     """Defaults, overridable by environment (TIDB_TPU_DEVICE=0 etc.) so
-    benchmarks and CI can flip modes without code."""
+    benchmarks and CI can flip modes without code. Malformed values fail
+    fast with the offending variable named (not a bare int() traceback)."""
     for name, (tp, dflt) in _DEFS.items():
         env = os.environ.get(name.upper())
-        _vals[name] = _coerce(name, tp, env) if env is not None else dflt
+        if env is None:
+            _vals[name] = dflt
+            continue
+        try:
+            _vals[name] = _coerce(name, tp, env)
+        except ValueError:
+            raise ValueError(
+                f"invalid value for environment variable "
+                f"{name.upper()}={env!r} (expected "
+                f"{'on/off/true/false/0/1' if tp == _BOOL else 'an integer'})"
+            ) from None
 
 
 _init()

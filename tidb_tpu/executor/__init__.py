@@ -144,7 +144,8 @@ class TableReaderExec(Executor):
                 yield exec_cop_plan(cop, chunk).chunk
             return
         req = CopRequest(tp=ReqType.DAG, ranges=self._ranges(), plan=cop,
-                         start_ts=ctx.read_ts)
+                         start_ts=ctx.read_ts,
+                         keep_order=getattr(self.plan, "keep_order", False))
         remaining = cop.limit
         for resp in ctx.storage.client().send(req):
             ch = resp.chunk
@@ -966,27 +967,39 @@ class IndexJoinExec(HashJoinExec):
             return whole if whole is not None else \
                 _empty_like_schema(self.plan.children[1].schema)
         if self.plan.inner_index is None:
-            handles = [int(v) for v in key_vals]
-            snap = ctx.storage.snapshot(ctx.read_ts)
-            keys = [tablecodec.record_key(icop.table.id, h)
-                    for h in handles]
-            got = snap.batch_get(keys)
-            kvrows = [(k, got[k]) for k in keys if k in got]
-            chunk = kvrows_to_chunk(icop.table, icop.cols, kvrows,
-                                    icop.handle_col)
-            return exec_cop_plan(icop, chunk).chunk
+            return self._fetch_rows_by_handles(
+                ctx, icop, [int(v) for v in key_vals])
+        # secondary index: scan index entries for the key points to get
+        # handles, then batch-fetch the rows (the per-batch form of
+        # IndexLookUpExecutor, executor/distsql.go:524)
         ft = self.plan.right_keys[0].ft
         ranges = [rg.DatumRange(low=[_index_datum(v, ft)],
                                 high=[_index_datum(v, ft)])
                   for v in key_vals]
         kv_ranges = rg.index_ranges_to_kv(icop.table.id,
                                           self.plan.inner_index.id, ranges)
+        index_cols = [icop.table.col_by_name(c)
+                      for c in self.plan.inner_index.columns]
+        index_cop = ph.CopPlan(table=icop.table, cols=index_cols,
+                               handle_col=len(index_cols),
+                               index=self.plan.inner_index,
+                               ranges=kv_ranges)
         req = CopRequest(tp=ReqType.DAG, ranges=kv_ranges,
-                         plan=icop, start_ts=ctx.read_ts)
-        out = [resp.chunk for resp in ctx.storage.client().send(req)]
-        whole = Chunk.concat_all(out)
-        return whole if whole is not None else \
-            _empty_like_schema(self.plan.children[1].schema)
+                         plan=index_cop, start_ts=ctx.read_ts)
+        handles: list[int] = []
+        for resp in ctx.storage.client().send(req):
+            hc = resp.chunk.columns[len(index_cols)]
+            handles.extend(int(h) for h in hc.data[:resp.chunk.num_rows])
+        return self._fetch_rows_by_handles(ctx, icop, handles)
+
+    def _fetch_rows_by_handles(self, ctx, icop, handles) -> Chunk:
+        snap = ctx.storage.snapshot(ctx.read_ts)
+        keys = [tablecodec.record_key(icop.table.id, h) for h in handles]
+        got = snap.batch_get(keys)
+        kvrows = [(k, got[k]) for k in keys if k in got]
+        chunk = kvrows_to_chunk(icop.table, icop.cols, kvrows,
+                                icop.handle_col)
+        return exec_cop_plan(icop, chunk).chunk
 
     def chunks(self, ctx):
         plan = self.plan

@@ -164,8 +164,15 @@ class MVCCStore:
         KV-pair encoder for offline import, and TiKV's ingest-SST flow).
         Keys already present get a new newest version; readers at a ts
         below `commit_ts` keep seeing the old state. -> pairs ingested."""
+        pairs = list(pairs)
         n = 0
         with self._mu:
+            # validate-then-apply so the import is all-or-nothing: a lock
+            # discovered midway must not leave earlier pairs committed
+            for k, _v in pairs:
+                e = self._entries.get(k)
+                if e is not None and e.lock is not None:
+                    raise KeyLockedError(e.lock.info(k))
             self.data_version += 1
             if commit_ts > self.max_commit_ts:
                 self.max_commit_ts = commit_ts
@@ -180,8 +187,6 @@ class MVCCStore:
                         writes=[(commit_ts, start_ts, WriteType.PUT)],
                         data={start_ts: v})
                 else:
-                    if e.lock is not None:
-                        raise KeyLockedError(e.lock.info(k))
                     e.data[start_ts] = v
                     e.writes.insert(0, (commit_ts, start_ts, WriteType.PUT))
                 n += 1

@@ -145,7 +145,10 @@ class MeshKernelBase:
         n = chunk.num_rows
         ln = -(-max(n, 1) // self.ndev)
         ln += (-ln) % 8
-        key = ("shard", id(self.mesh), ln * self.ndev)
+        from tidb_tpu.parallel import config as mesh_config
+        # generation (not id(mesh)) keys the memo: a torn-down mesh's id
+        # can be recycled by a new Mesh object at the same address
+        key = ("shard", mesh_config.mesh_generation(), ln * self.ndev)
         hit = runtime.dev_cache_get(chunk, key)
         if hit is not None:
             return hit, ln

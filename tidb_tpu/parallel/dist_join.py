@@ -134,8 +134,10 @@ class _BuildTable:
     def device_arrays(self, sharding=None):
         """Build lanes on device (replicated under `sharding`), memoized:
         one batched device_put on first use, zero transfer when a cached
-        kernel re-executes against unchanged dimension data."""
-        key = id(sharding.mesh) if sharding is not None else None
+        kernel re-executes against unchanged dimension data. Keyed by the
+        mesh GENERATION (id(mesh) could be recycled after a reconfigure)."""
+        from tidb_tpu.parallel import config as mesh_config
+        key = mesh_config.mesh_generation() if sharding is not None else None
         if self._dev is None or self._dev[0] != key:
             tree = (self.h_sorted, tuple(self.key_bits),
                     tuple(self.pay_data), tuple(self.pay_valid))

@@ -69,9 +69,12 @@ class PhysPlan:
 @dataclass
 class PhysTableReader(PhysPlan):
     cop: CopPlan = None
+    keep_order: bool = False   # handle-ordered delivery (merge join feeds)
 
     def _explain_info(self):
         parts = [f" table:{self.cop.table.name}"]
+        if self.keep_order:
+            parts.append(" keep_order")
         if self.cop.filter is not None:
             parts.append(f" pushed_filter:{self.cop.filter!r}")
         if self.cop.host_filter is not None:

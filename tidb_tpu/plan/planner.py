@@ -97,7 +97,11 @@ class Planner:
             outer_volatile = was_volatile()
             reset_volatile()
             from tidb_tpu.plan.mesh_route import route_mesh
-            p = route_mesh(self._opt_access(self.plan_select(stmt)))
+            # mesh routing first: the fused mesh operators subsume the
+            # algorithm choice below (and handle capacity escalation
+            # themselves); the physical pass then optimizes what remains
+            p = self._opt_physical(route_mesh(
+                self._opt_access(self.plan_select(stmt))))
             p.cacheable = not was_volatile()
             if outer_volatile:
                 mark_volatile()
@@ -263,14 +267,14 @@ class Planner:
         cop = reader.cop
         info = cop.table
         conj = flatten_and(cop.filter) + flatten_and(cop.host_filter)
-        if not conj or cop.ranges is not None:
-            return reader
         st = self._tbl_stats(info)
         use_cbo = not st.pseudo
         if use_cbo:
             from tidb_tpu.statistics import selectivity
-            reader.est_rows = max(1, st.count) * selectivity(
-                st, conj, reader.schema.cols, info)
+            reader.est_rows = max(1, st.count) * (selectivity(
+                st, conj, reader.schema.cols, info) if conj else 1.0)
+        if not conj or cop.ranges is not None:
+            return reader
         off_by_name: dict[str, int] = {}
         for i, sc in enumerate(reader.schema.cols):
             off_by_name.setdefault(sc.name, i)
@@ -364,6 +368,192 @@ class Planner:
                                  table_cop=cop)
         out.est_rows = reader.est_rows
         return out
+
+    # -- physical algorithm selection ----------------------------------------
+    # (ref: plan/gen_physical_plans.go:114-417 join enumeration +
+    # plan/task.go:116-499 costing — collapsed to targeted rewrites costed
+    # with the same stats the access-path pass uses)
+
+    # beyond this many estimated groups, the sort-based StreamAgg beats
+    # the hash kernel's capacity-escalation / collision-fallback protocol
+    _STREAM_AGG_NDV = 1 << 16
+
+    def _opt_physical(self, plan: ph.PhysPlan) -> ph.PhysPlan:
+        """Post-pass choosing among physically-equivalent operators:
+        HashJoin vs MergeJoin vs IndexJoin, HashAgg vs StreamAgg."""
+        for i, c in enumerate(plan.children):
+            plan.children[i] = self._opt_physical(c)
+        if isinstance(plan, ph.PhysApply) and plan.inner is not None:
+            plan.inner = self._opt_physical(plan.inner)
+        if isinstance(plan, ph.PhysHashJoin):
+            return self._choose_join_algorithm(plan)
+        if isinstance(plan, ph.PhysHashAgg):
+            return self._choose_agg_algorithm(plan)
+        if isinstance(plan, ph.PhysFinalAgg):
+            return self._choose_final_agg(plan)
+        return plan
+
+    def _choose_join_algorithm(self, join: ph.PhysHashJoin) -> ph.PhysPlan:
+        """Cost the physically-equivalent algorithms and keep the cheapest:
+
+          index join: outer_rows x lookup factor (reads ONLY matching
+                      inner rows, point fetches pay the double-read tax)
+          merge join: outer_scan + inner_scan (both streams, no build)
+          hash join:  outer_scan + inner_scan + inner build
+
+        Rows come from the access pass's stats estimates; with pseudo
+        stats only the stats-free merge-vs-hash preference applies."""
+        if len(join.left_keys) != 1 or join.join_type not in (
+                "inner", "left"):
+            return join
+        left, right = join.children
+        outer_est = getattr(left, "est_rows", None)
+        inner_count = None
+        if isinstance(right, ph.PhysTableReader):
+            st = self._tbl_stats(right.cop.table)
+            if not st.pseudo:
+                inner_count = float(st.count)
+
+        merge_ok = (self._pk_ordered_reader(left, join.left_keys[0]) and
+                    self._pk_ordered_reader(right, join.right_keys[0]))
+        inner_idx = self._index_join_path(right, join.right_keys[0])
+        index_ok = (inner_idx is not False and outer_est is not None and
+                    inner_count is not None)
+
+        if index_ok:
+            index_cost = outer_est * self._LOOKUP_FACTOR
+            scan_cost = (outer_est or 0) + inner_count
+            if index_cost < scan_cost:
+                return ph.PhysIndexJoin(
+                    schema=join.schema, children=[left, right],
+                    left_keys=join.left_keys, right_keys=join.right_keys,
+                    inner_index=inner_idx, join_type=join.join_type,
+                    other_cond=join.other_cond)
+        if merge_ok:
+            # same scan volume as hash, minus the build materialization
+            left.keep_order = True
+            right.keep_order = True
+            return ph.PhysMergeJoin(
+                schema=join.schema, children=join.children,
+                left_keys=join.left_keys, right_keys=join.right_keys,
+                join_type=join.join_type, other_cond=join.other_cond)
+        return join
+
+    @staticmethod
+    def _pk_ordered_reader(plan, key: Expression) -> bool:
+        """Is `plan` a record scan whose rows arrive ordered by `key`
+        (= the pk-is-handle column)?"""
+        if not isinstance(plan, ph.PhysTableReader) or plan.cop.is_agg or \
+                plan.cop.limit is not None or plan.cop.index is not None:
+            return False
+        if not isinstance(key, ColumnRef):
+            return False
+        info = plan.cop.table
+        if not info.pk_is_handle or not info.pk_col_name:
+            return False
+        sc = plan.schema.cols[key.idx]
+        return sc.name == info.pk_col_name.lower()
+
+    @staticmethod
+    def _index_join_path(plan, right_key: Expression):
+        """Index (or None = pk handle) usable to point-fetch inner rows by
+        the join key; False when the inner side is not lookup-able."""
+        from tidb_tpu.schema.model import SchemaState
+        if not isinstance(plan, ph.PhysTableReader) or plan.cop.is_agg or \
+                plan.cop.limit is not None or plan.cop.index is not None or \
+                plan.cop.ranges is not None:
+            return False
+        if not isinstance(right_key, ColumnRef):
+            return False
+        info = plan.cop.table
+        name = plan.schema.cols[right_key.idx].name
+        if info.pk_is_handle and info.pk_col_name and \
+                name == info.pk_col_name.lower():
+            return None                      # pk-handle point lookups
+        for idx in info.indexes:
+            if idx.state == SchemaState.PUBLIC and \
+                    idx.columns[0].lower() == name:
+                return idx
+        return False
+
+    def _choose_agg_algorithm(self, agg: ph.PhysHashAgg) -> ph.PhysPlan:
+        if not agg.group_exprs or any(a.distinct for a in agg.aggs):
+            return agg
+        ndv = self._group_ndv_estimate(agg.children[0], agg.group_exprs)
+        if ndv is not None and ndv > self._STREAM_AGG_NDV:
+            return ph.PhysStreamAgg(
+                schema=agg.schema, children=agg.children,
+                group_exprs=agg.group_exprs, aggs=agg.aggs,
+                sorted_input=False)
+        return agg
+
+    def _choose_final_agg(self, fin: ph.PhysFinalAgg) -> ph.PhysPlan:
+        """A pushed-down partial agg with very many groups overflows the
+        storage-side hash kernel per chunk AND ships huge partial tables;
+        beyond the NDV threshold, scan raw and segment-reduce at the root
+        instead (StreamAgg has no capacity limit)."""
+        reader = fin.children[0]
+        if not isinstance(reader, ph.PhysTableReader) or \
+                not reader.cop.is_agg:
+            return fin
+        cop = reader.cop
+        if not cop.group_exprs or any(a.distinct for a in cop.aggs):
+            return fin
+        ndv = self._group_ndv_estimate(reader, cop.group_exprs)
+        if ndv is None or ndv <= self._STREAM_AGG_NDV:
+            return fin
+        from dataclasses import replace as _replace
+        raw = ph.PhysTableReader(
+            schema=reader.schema,
+            cop=_replace(cop, group_exprs=None, aggs=None))
+        raw.est_rows = reader.est_rows
+        return ph.PhysStreamAgg(schema=fin.schema, children=[raw],
+                                group_exprs=list(cop.group_exprs),
+                                aggs=list(cop.aggs), sorted_input=False)
+
+    def _group_ndv_estimate(self, child: ph.PhysPlan, group_exprs):
+        """Max per-column NDV of bare group columns, traced through the
+        child tree to base-table statistics; None when untraceable or
+        stats are pseudo (the decision then defaults to hash agg, whose
+        runtime escalation still protects correctness)."""
+        best = None
+        for g in group_exprs:
+            if not isinstance(g, ColumnRef):
+                continue
+            ndv = self._trace_col_ndv(child, g.idx)
+            if ndv is not None:
+                best = ndv if best is None else max(best, ndv)
+        return best
+
+    def _trace_col_ndv(self, plan: ph.PhysPlan, idx: int):
+        if isinstance(plan, (ph.PhysSelection, ph.PhysLimit, ph.PhysSort,
+                             ph.PhysTopN)):
+            return self._trace_col_ndv(plan.children[0], idx)
+        if isinstance(plan, (ph.PhysHashJoin, ph.PhysMergeJoin)):
+            nl = len(plan.children[0].schema)
+            if idx < nl:
+                return self._trace_col_ndv(plan.children[0], idx)
+            return self._trace_col_ndv(plan.children[1], idx - nl)
+        if isinstance(plan, ph.PhysIndexJoin):
+            nl = len(plan.children[0].schema)
+            if idx < nl:
+                return self._trace_col_ndv(plan.children[0], idx)
+            return self._trace_col_ndv(plan.children[1], idx - nl)
+        if isinstance(plan, ph.PhysProjection):
+            e = plan.exprs[idx]
+            if isinstance(e, ColumnRef):
+                return self._trace_col_ndv(plan.children[0], e.idx)
+            return None
+        if isinstance(plan, (ph.PhysTableReader, ph.PhysIndexReader)):
+            sc = plan.schema.cols[idx]
+            if not sc.col_id:
+                return None
+            stats = self._tbl_stats(plan.cop.table)
+            if stats.pseudo:
+                return None
+            cs = stats.columns.get(sc.col_id)
+            return cs.hist.ndv if cs is not None else None
+        return None
 
     def _point_get(self, reader: ph.PhysTableReader, handle, idx, values
                    ) -> ph.PhysPointGet:

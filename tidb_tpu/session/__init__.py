@@ -530,8 +530,10 @@ class Session:
                               "Extra"], rows)
         if stmt.tp == "variables":
             from tidb_tpu import config
-            merged = dict(config.all_vars())
-            merged.update(self.sys_vars)
+            # registry values win for its variables: they are process-
+            # global, so another session's SET must be visible here
+            merged = dict(self.sys_vars)
+            merged.update(config.all_vars())
             rows = sorted((k, str(v)) for k, v in merged.items())
             if stmt.pattern:
                 import re

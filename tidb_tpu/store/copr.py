@@ -28,10 +28,10 @@ from tidb_tpu.store.backoff import (BO_REGION_MISS, BO_SERVER_BUSY,
                                     BO_TXN_LOCK, Backoffer, COP_MAX_BACKOFF)
 from tidb_tpu.table import index_kvrows_to_chunk, kvrows_to_chunk
 
-__all__ = ["CopClient", "cop_handler", "DEFAULT_COP_CONCURRENCY"]
+__all__ = ["CopClient", "cop_handler"]
 
-# ref: DistSQLScanConcurrency default (sessionctx/variable/tidb_vars.go:115)
-DEFAULT_COP_CONCURRENCY = 10
+# fan-out width lives in the tidb_tpu_cop_concurrency sysvar (config.py;
+# ref: DistSQLScanConcurrency default, sessionctx/variable/tidb_vars.go:115)
 
 # storage-side scan batching; large batches amortize device dispatch
 COP_SCAN_BATCH = 65536
