@@ -7,11 +7,23 @@ tidb_tpu.server hermetically — no external driver dependency.
 
 from __future__ import annotations
 
+import hashlib
 import socket
 import struct
 
 from tidb_tpu.server.packet import (PacketIO, read_lenenc_bytes,
                                     read_lenenc_int)
+
+
+def native_scramble(password: str, salt: bytes) -> bytes:
+    """mysql_native_password client scramble:
+    SHA1(pwd) XOR SHA1(salt + SHA1(SHA1(pwd)))."""
+    if not password:
+        return b""
+    h1 = hashlib.sha1(password.encode()).digest()
+    h2 = hashlib.sha1(h1).digest()
+    mask = hashlib.sha1(salt + h2).digest()
+    return bytes(a ^ b for a, b in zip(h1, mask))
 
 CLIENT_PROTOCOL_41 = 0x200
 CLIENT_SECURE_CONNECTION = 0x8000
@@ -27,14 +39,28 @@ class MySQLError(Exception):
 
 class MiniClient:
     def __init__(self, host: str, port: int, db: str = "",
-                 user: str = "root"):
+                 user: str = "root", password: str = ""):
         self.sock = socket.create_connection((host, port), timeout=10)
         self.pkt = PacketIO(self.sock)
-        self._handshake(user, db)
+        self._handshake(user, db, password)
 
-    def _handshake(self, user: str, db: str) -> None:
+    @staticmethod
+    def _parse_salt(greeting: bytes) -> bytes:
+        # protocol v10: version\0, conn id (4), salt1 (8), \0, caps_lo (2),
+        # charset (1), status (2), caps_hi (2), auth len (1), 10 zeros,
+        # salt2 (12), \0
+        off = 1
+        off = greeting.index(b"\0", off) + 1     # server version
+        off += 4
+        salt1 = greeting[off:off + 8]
+        off += 8 + 1 + 2 + 1 + 2 + 2 + 1 + 10
+        salt2 = greeting[off:off + 12]
+        return salt1 + salt2
+
+    def _handshake(self, user: str, db: str, password: str) -> None:
         greeting = self.pkt.read_packet()
         assert greeting[0] == 10, "expected protocol v10"
+        auth = native_scramble(password, self._parse_salt(greeting))
         caps = CLIENT_PROTOCOL_41 | CLIENT_SECURE_CONNECTION \
             | CLIENT_PLUGIN_AUTH
         if db:
@@ -43,7 +69,7 @@ class MiniClient:
         resp += struct.pack("<I", 1 << 24)
         resp += bytes([33]) + b"\0" * 23
         resp += user.encode() + b"\0"
-        resp += bytes([0])                       # empty auth response
+        resp += bytes([len(auth)]) + auth
         if db:
             resp += db.encode() + b"\0"
         resp += b"mysql_native_password\0"

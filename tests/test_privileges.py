@@ -1,0 +1,217 @@
+"""Bootstrap, authentication, and privilege checks (ref: bootstrap.go,
+privilege/privileges/, session.go:928 Auth)."""
+
+import pytest
+
+from tidb_tpu.bootstrap import BOOTSTRAP_VERSION, bootstrap
+from tidb_tpu.privilege import (ALL_PRIVS, Priv, check_scramble,
+                                encode_password)
+from tidb_tpu.session import Session, SQLError
+from tidb_tpu.store.storage import new_mock_storage
+
+
+@pytest.fixture
+def store():
+    st = new_mock_storage()
+    bootstrap(st)
+    return st
+
+
+def root(store):
+    return Session(store, user="root", host="%")
+
+
+class TestBootstrap:
+    def test_idempotent(self, store):
+        bootstrap(store)
+        bootstrap(store)
+        s = root(store)
+        rows = s.query("SELECT variable_value FROM mysql.tidb "
+                       "WHERE variable_name = 'bootstrapped'").rows
+        assert rows == [(str(BOOTSTRAP_VERSION),)]
+        users = s.query("SELECT user, privs FROM mysql.user").rows
+        assert ("root", ALL_PRIVS) in users
+
+    def test_system_tables_exist(self, store):
+        s = root(store)
+        for t in ("user", "db", "tables_priv", "global_variables", "tidb"):
+            s.query(f"SELECT COUNT(*) FROM mysql.{t}")
+
+
+class TestPasswordHash:
+    def test_scramble_roundtrip(self):
+        import hashlib
+        pw, salt = "s3cret", b"A" * 20
+        stored = encode_password(pw)
+        h1 = hashlib.sha1(pw.encode()).digest()
+        h2 = hashlib.sha1(h1).digest()
+        mask = hashlib.sha1(salt + h2).digest()
+        scr = bytes(a ^ b for a, b in zip(h1, mask))
+        assert check_scramble(scr, salt, stored)
+        assert not check_scramble(scr, b"B" * 20, stored)
+        assert not check_scramble(b"x" * 20, salt, stored)
+        assert check_scramble(b"", salt, "")          # empty password
+        assert not check_scramble(b"", salt, stored)  # pw set, none given
+
+
+class TestAccounts:
+    def test_create_grant_revoke_drop(self, store):
+        r = root(store)
+        r.execute("CREATE DATABASE app")
+        r.execute("CREATE TABLE app.t (id BIGINT PRIMARY KEY, v BIGINT)")
+        r.execute("INSERT INTO app.t VALUES (1, 10)")
+        r.execute("CREATE USER 'alice'@'%' IDENTIFIED BY 'pw'")
+
+        alice = Session(store, user="alice", host="1.2.3.4")
+        with pytest.raises(SQLError, match="denied"):
+            alice.query("SELECT * FROM app.t")
+
+        r.execute("GRANT SELECT ON app.* TO 'alice'@'%'")
+        assert alice.query("SELECT v FROM app.t").rows == [(10,)]
+        with pytest.raises(SQLError, match="denied"):
+            alice.execute("INSERT INTO app.t VALUES (2, 20)")
+
+        r.execute("GRANT INSERT ON app.t TO 'alice'@'%'")
+        alice.execute("INSERT INTO app.t VALUES (2, 20)")
+
+        r.execute("REVOKE SELECT ON app.* FROM 'alice'@'%'")
+        with pytest.raises(SQLError, match="denied"):
+            alice.query("SELECT v FROM app.t")
+
+        r.execute("DROP USER 'alice'@'%'")
+        assert r.query("SELECT COUNT(*) FROM mysql.user "
+                       "WHERE user = 'alice'").rows == [(0,)]
+        # grant rows cleaned up too
+        assert r.query("SELECT COUNT(*) FROM mysql.tables_priv "
+                       "WHERE user = 'alice'").rows == [(0,)]
+
+    def test_join_requires_select_on_both(self, store):
+        r = root(store)
+        r.execute("CREATE DATABASE app; USE app")
+        r.execute("CREATE TABLE a (id BIGINT PRIMARY KEY)")
+        r.execute("CREATE TABLE b (id BIGINT PRIMARY KEY)")
+        r.execute("CREATE USER bob")
+        r.execute("GRANT SELECT ON app.a TO bob")
+        bob = Session(store, db="app", user="bob", host="h")
+        bob.query("SELECT * FROM a")
+        with pytest.raises(SQLError, match="denied"):
+            bob.query("SELECT * FROM a JOIN b ON a.id = b.id")
+
+    def test_non_superuser_cannot_grant(self, store):
+        r = root(store)
+        r.execute("CREATE USER carol")
+        carol = Session(store, user="carol", host="h")
+        with pytest.raises(SQLError, match="denied"):
+            carol.execute("CREATE USER dave")
+        with pytest.raises(SQLError, match="denied"):
+            carol.execute("GRANT SELECT ON *.* TO carol")
+
+    def test_ddl_privs(self, store):
+        r = root(store)
+        r.execute("CREATE DATABASE app")
+        r.execute("CREATE USER eve")
+        eve = Session(store, db="app", user="eve", host="h")
+        with pytest.raises(SQLError, match="denied"):
+            eve.execute("CREATE TABLE t (id BIGINT PRIMARY KEY)")
+        r.execute("GRANT CREATE, DROP ON app.* TO eve")
+        eve.execute("CREATE TABLE t (id BIGINT PRIMARY KEY)")
+        eve.execute("DROP TABLE t")
+
+    def test_grant_unknown_user_rejected(self, store):
+        with pytest.raises(SQLError, match="does not exist"):
+            root(store).execute("GRANT SELECT ON *.* TO ghost")
+
+    def test_drop_database_checks_target_db(self, store):
+        r = root(store)
+        r.execute("CREATE DATABASE db1; CREATE DATABASE db2")
+        r.execute("CREATE USER u")
+        r.execute("GRANT CREATE, DROP ON db1.* TO u")
+        u = Session(store, db="db1", user="u", host="h")
+        with pytest.raises(SQLError, match="denied"):
+            u.execute("DROP DATABASE db2")
+        u.execute("DROP DATABASE db1")   # allowed: grant scoped to db1
+
+    def test_grant_on_bare_star_is_current_db(self, store):
+        r = root(store)
+        r.execute("CREATE DATABASE db1; CREATE DATABASE secret")
+        r.execute("CREATE TABLE db1.t (id BIGINT PRIMARY KEY)")
+        r.execute("CREATE TABLE secret.s (id BIGINT PRIMARY KEY)")
+        r.execute("CREATE USER u")
+        r.execute("USE db1")
+        r.execute("GRANT SELECT ON * TO u")
+        u = Session(store, db="db1", user="u", host="h")
+        u.query("SELECT * FROM t")
+        with pytest.raises(SQLError, match="denied"):
+            u.query("SELECT * FROM secret.s")
+
+    def test_update_only_grant_suffices_without_where(self, store):
+        r = root(store)
+        r.execute("CREATE DATABASE db1")
+        r.execute("CREATE TABLE db1.t (id BIGINT PRIMARY KEY, a BIGINT)")
+        r.execute("INSERT INTO db1.t VALUES (1, 0)")
+        r.execute("CREATE USER w")
+        r.execute("GRANT UPDATE ON db1.t TO w")
+        w = Session(store, db="db1", user="w", host="h")
+        w.execute("UPDATE t SET a = 1")
+        with pytest.raises(SQLError, match="denied"):
+            w.execute("UPDATE t SET a = 2 WHERE id = 1")   # WHERE reads
+
+    def test_insert_select_from_target_needs_select(self, store):
+        r = root(store)
+        r.execute("CREATE DATABASE db1")
+        r.execute("CREATE TABLE db1.t (id BIGINT PRIMARY KEY, a BIGINT)")
+        r.execute("INSERT INTO db1.t VALUES (1, 5)")
+        r.execute("CREATE USER x")
+        r.execute("GRANT INSERT ON db1.t TO x")
+        x = Session(store, db="db1", user="x", host="h")
+        with pytest.raises(SQLError, match="denied"):
+            x.execute("INSERT INTO t SELECT id + 10, a FROM t")
+
+    def test_localhost_matches_loopback(self, store):
+        from tidb_tpu.privilege import _host_match
+        assert _host_match("localhost", "127.0.0.1")
+        assert _host_match("::1", "localhost")
+        assert not _host_match("localhost", "10.0.0.1")
+
+    def test_with_grant_option_rejected(self, store):
+        from tidb_tpu.session import SQLError
+        r = root(store)
+        r.execute("CREATE USER u")
+        with pytest.raises(Exception, match="GRANT OPTION"):
+            r.execute("GRANT SELECT ON *.* TO u WITH GRANT OPTION")
+
+
+class TestServerAuth:
+    def test_wrong_password_rejected_right_accepted(self):
+        from tidb_tpu.server import Server
+        from tests.mysql_client import MiniClient, MySQLError
+        st = new_mock_storage()
+        srv = Server(st)
+        srv.start()
+        try:
+            r = MiniClient("127.0.0.1", srv.port, user="root")
+            r.query("CREATE DATABASE app")
+            r.query("CREATE USER app IDENTIFIED BY 'hunter2'")
+            r.query("GRANT ALL ON app.* TO app")
+            r.close()
+
+            with pytest.raises(MySQLError) as ei:
+                MiniClient("127.0.0.1", srv.port, user="app",
+                           password="wrong")
+            assert ei.value.code == 1045
+
+            with pytest.raises(MySQLError) as ei:
+                MiniClient("127.0.0.1", srv.port, user="nobody")
+            assert ei.value.code == 1045
+
+            c = MiniClient("127.0.0.1", srv.port, db="app", user="app",
+                           password="hunter2")
+            c.query("CREATE TABLE t (id BIGINT PRIMARY KEY, v BIGINT)")
+            c.query("INSERT INTO t VALUES (1, 42)")
+            assert c.query("SELECT v FROM t")[1] == [("42",)]
+            # no grant outside app
+            with pytest.raises(MySQLError):
+                c.query("SELECT * FROM mysql.user")
+            c.close()
+        finally:
+            srv.close()

@@ -1,0 +1,83 @@
+"""First-run bootstrap: the `mysql` system catalog + root account.
+
+Reference: /root/reference/bootstrap.go:40-180 — DDL+DML creating
+mysql.user / db / tables_priv / GLOBAL_VARIABLES / tidb, versioned so
+upgrades can run incremental steps, executed once per store under a
+bootstrap guard. Grant rows here use a BIGINT privilege bitmask (see
+tidb_tpu/privilege.py) instead of per-priv enum columns.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from tidb_tpu.privilege import ALL_PRIVS
+
+__all__ = ["bootstrap", "BOOTSTRAP_VERSION"]
+
+BOOTSTRAP_VERSION = 1
+
+_DDL = [
+    "CREATE DATABASE IF NOT EXISTS mysql",
+    # id handles are implicit (no int pk): account rows are small
+    """CREATE TABLE IF NOT EXISTS mysql.user (
+        host VARCHAR(255), user VARCHAR(32),
+        authentication_string VARCHAR(64), privs BIGINT)""",
+    """CREATE TABLE IF NOT EXISTS mysql.db (
+        host VARCHAR(255), user VARCHAR(32), db VARCHAR(64),
+        privs BIGINT)""",
+    """CREATE TABLE IF NOT EXISTS mysql.tables_priv (
+        host VARCHAR(255), user VARCHAR(32), db VARCHAR(64),
+        table_name VARCHAR(64), privs BIGINT)""",
+    """CREATE TABLE IF NOT EXISTS mysql.global_variables (
+        variable_name VARCHAR(64), variable_value VARCHAR(1024))""",
+    """CREATE TABLE IF NOT EXISTS mysql.tidb (
+        variable_name VARCHAR(64), variable_value VARCHAR(1024),
+        comment VARCHAR(1024))""",
+]
+
+_lock = threading.Lock()
+
+
+def _bootstrapped_version(session) -> int:
+    if not session.domain.info_schema().has_db("mysql"):
+        return 0
+    try:
+        rows = session.query(
+            "SELECT variable_value FROM mysql.tidb "
+            "WHERE variable_name = 'bootstrapped'").rows
+    except Exception:  # noqa: BLE001 - partial earlier bootstrap
+        return 0
+    return int(rows[0][0]) if rows else 0
+
+
+def bootstrap(storage) -> None:
+    """Idempotent: creates system tables + root@% superuser on first run
+    (ref: bootstrap.go runInBootstrapSession / doDDLWorks / doDMLWorks)."""
+    from tidb_tpu.session import Session
+
+    with _lock:
+        session = Session(storage, internal=True)
+        try:
+            ver = _bootstrapped_version(session)
+            if ver >= BOOTSTRAP_VERSION:
+                return
+            for ddl in _DDL:
+                session.execute(ddl)
+            if not session.query(
+                    "SELECT user FROM mysql.user WHERE user = 'root'").rows:
+                session.execute(
+                    "INSERT INTO mysql.user VALUES "
+                    f"('%', 'root', '', {ALL_PRIVS})")
+            if ver == 0:
+                session.execute(
+                    "INSERT INTO mysql.tidb VALUES ('bootstrapped', "
+                    f"'{BOOTSTRAP_VERSION}', 'Bootstrap version. Do not "
+                    "delete.')")
+            else:
+                session.execute(
+                    "UPDATE mysql.tidb SET variable_value = "
+                    f"'{BOOTSTRAP_VERSION}' WHERE variable_name = "
+                    "'bootstrapped'")
+        finally:
+            session.close()

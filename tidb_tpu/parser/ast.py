@@ -457,3 +457,40 @@ class DeallocateStmt(StmtNode):
 class AdminStmt(StmtNode):
     tp: str = ""             # show_ddl / check_table
     tables: list = field(default_factory=list)
+
+
+# -- account management (ref: ast/misc.go CreateUserStmt/GrantStmt) ----------
+
+@dataclass
+class UserSpec:
+    user: str = ""
+    host: str = "%"
+    password: str | None = None    # IDENTIFIED BY (plaintext at parse time)
+
+
+@dataclass
+class CreateUserStmt(StmtNode):
+    users: list = field(default_factory=list)      # [UserSpec]
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropUserStmt(StmtNode):
+    users: list = field(default_factory=list)      # [UserSpec]
+    if_exists: bool = False
+
+
+@dataclass
+class GrantStmt(StmtNode):
+    privs: list = field(default_factory=list)      # upper priv names / "ALL"
+    db: str = "*"                                  # "*" = global
+    table: str = "*"                               # "*" = whole db
+    users: list = field(default_factory=list)      # [UserSpec]
+
+
+@dataclass
+class RevokeStmt(StmtNode):
+    privs: list = field(default_factory=list)
+    db: str = "*"
+    table: str = "*"
+    users: list = field(default_factory=list)

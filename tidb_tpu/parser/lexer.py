@@ -53,7 +53,7 @@ KEYWORDS = {
     "DESCRIBE", "ANALYZE", "ADMIN", "CHECK",
     "GLOBAL", "SESSION", "VARIABLES", "STATUS", "ENGINES", "ENGINE",
     "CHARSET", "COLLATE", "COLLATION", "COMMENT", "FIRST", "AFTER",
-    "GRANT", "REVOKE", "PRIVILEGES", "IDENTIFIED", "WITH", "OPTION",
+    "GRANT", "REVOKE", "PRIVILEGES", "IDENTIFIED", "WITH", "OPTION", "USER",
     "FOR", "FORCE", "IGNORE", "LOW_PRIORITY", "HIGH_PRIORITY", "QUICK",
     "PARTITION", "TEMPORARY", "EXTENDED",
     "PREPARE", "EXECUTE", "DEALLOCATE",

@@ -201,6 +201,10 @@ class Parser:
             while self.try_op(","):
                 tables.append(self.table_name())
             return ast.AnalyzeStmt(tables=tables)
+        if kw == "GRANT":
+            return self.grant_revoke(is_grant=True)
+        if kw == "REVOKE":
+            return self.grant_revoke(is_grant=False)
         if kw == "ADMIN":
             self.next()
             if self.try_kw("SHOW"):
@@ -483,6 +487,12 @@ class Parser:
 
     def create(self) -> ast.StmtNode:
         self.expect_kw("CREATE")
+        if self.try_kw("USER"):
+            ine = self._if_not_exists()
+            users = [self._user_spec(with_password=True)]
+            while self.try_op(","):
+                users.append(self._user_spec(with_password=True))
+            return ast.CreateUserStmt(users=users, if_not_exists=ine)
         if self.try_kw("DATABASE") or self.try_kw("SCHEMA"):
             ine = self._if_not_exists()
             return ast.CreateDatabaseStmt(name=self.ident(),
@@ -663,8 +673,90 @@ class Parser:
                 frac = 0
         return st.FieldType(tp, flags=flags, flen=flen, frac=frac)
 
+    # -- account management (ref: parser.y GrantStmt/CreateUserStmt) --------
+
+    def _user_spec(self, with_password: bool = False) -> ast.UserSpec:
+        """'name'[@'host'] [IDENTIFIED BY 'pw'] — name/host accept quoted
+        strings or bare identifiers."""
+        t = self.peek()
+        if t.tp == TokenType.STRING:
+            self.next()
+            name = t.val
+        else:
+            name = self.ident()
+        host = "%"
+        if self.try_op("@"):
+            t = self.peek()
+            if t.tp == TokenType.STRING:
+                self.next()
+                host = t.val
+            else:
+                host = self.ident()
+        spec = ast.UserSpec(user=name, host=host)
+        if with_password and self.try_kw("IDENTIFIED"):
+            self.expect_kw("BY")
+            t = self.next()
+            if t.tp != TokenType.STRING:
+                raise ParseError("IDENTIFIED BY takes a string literal", t)
+            spec.password = t.val
+        return spec
+
+    _PRIV_NAMES = {"SELECT", "INSERT", "UPDATE", "DELETE", "CREATE", "DROP",
+                   "ALTER", "INDEX"}
+
+    def grant_revoke(self, is_grant: bool) -> ast.StmtNode:
+        self.next()          # GRANT / REVOKE
+        privs = []
+        if self.try_kw("ALL"):
+            self.try_kw("PRIVILEGES")
+            privs.append("ALL")
+        else:
+            while True:
+                t = self.next()
+                name = t.val.upper()
+                if name not in self._PRIV_NAMES:
+                    raise ParseError(f"unknown privilege {t.val!r}", t)
+                privs.append(name)
+                if not self.try_op(","):
+                    break
+        self.expect_kw("ON")
+        # *.* (global) | * (current db) | db.* | db.tbl | tbl
+        if self.try_op("*"):
+            if self.try_op("."):
+                self.expect_op("*")
+                db = tbl = "*"           # *.*: global scope
+            else:
+                db, tbl = "", "*"        # bare *: current database (MySQL)
+        else:
+            first = self.ident()
+            if self.try_op("."):
+                db = first
+                if self.try_op("*"):
+                    tbl = "*"
+                else:
+                    tbl = self.ident()
+            else:
+                db, tbl = "", first      # current db at execution time
+        self.expect_kw("TO" if is_grant else "FROM")
+        users = [self._user_spec()]
+        while self.try_op(","):
+            users.append(self._user_spec())
+        if is_grant and self.try_kw("WITH"):
+            # reject rather than silently discard: accepting the syntax
+            # while dropping the capability would mislead administrators
+            raise ParseError("WITH GRANT OPTION is not supported",
+                             self.peek())
+        cls = ast.GrantStmt if is_grant else ast.RevokeStmt
+        return cls(privs=privs, db=db, table=tbl, users=users)
+
     def drop(self) -> ast.StmtNode:
         self.expect_kw("DROP")
+        if self.try_kw("USER"):
+            ie = self._if_exists()
+            users = [self._user_spec()]
+            while self.try_op(","):
+                users.append(self._user_spec())
+            return ast.DropUserStmt(users=users, if_exists=ie)
         if self.try_kw("DATABASE") or self.try_kw("SCHEMA"):
             ie = self._if_exists()
             return ast.DropDatabaseStmt(name=self.ident(), if_exists=ie)

@@ -5,12 +5,14 @@ Reference: /root/reference/server/ — accept loop + connection tokens
 textual resultset writer (conn.go:932 writeChunks), error packets.
 
 The compute path stays unchanged: each connection owns a Session over the
-shared storage; this layer only speaks the protocol. Auth accepts any
-credentials until the privilege subsystem lands (the reference checks
-mysql.user via privilege/privileges)."""
+shared storage; this layer only speaks the protocol. The handshake
+verifies mysql_native_password credentials against the mysql.user grant
+table (tidb_tpu/privilege.py; ref: privileges.go ConnectionVerification),
+bootstrapping the system catalog on first server start."""
 
 from __future__ import annotations
 
+import os
 import socket
 import struct
 import threading
@@ -61,6 +63,7 @@ COM_STMT_CLOSE = 0x19
 COM_STMT_RESET = 0x1A
 
 ER_UNKNOWN = 1105
+ER_ACCESS_DENIED = 1045
 
 
 class Server:
@@ -69,6 +72,8 @@ class Server:
     def __init__(self, storage, host: str = "127.0.0.1", port: int = 0,
                  token_limit: int = 1000):
         self.storage = storage
+        from tidb_tpu.bootstrap import bootstrap
+        bootstrap(storage)   # system catalog + root account (idempotent)
         self._listener = socket.create_server((host, port))
         self.addr = self._listener.getsockname()
         self._tokens = threading.Semaphore(token_limit)
@@ -174,10 +179,12 @@ class ClientConn:
 
     def run(self) -> None:
         try:
-            self._handshake()
+            if not self._handshake():
+                return   # auth failed (ERR already written)
         except (ValueError, IndexError, struct.error):
             return   # malformed handshake (port scanner / non-MySQL peer)
-        self.session = Session(self.server.storage)
+        self.session = Session(self.server.storage, user=self.user,
+                               host=self.peer_host)
         while True:
             self.pkt.reset_seq()
             try:
@@ -215,8 +222,9 @@ class ClientConn:
 
     # -- handshake (conn.go writeInitialHandshake/readHandshakeResponse) ----
 
-    def _handshake(self) -> None:
-        salt = b"01234567" + b"890123456789"      # fixed: auth unchecked
+    def _handshake(self) -> bool:
+        # 20-byte random salt; NUL bytes would truncate the wire encoding
+        salt = bytes(b % 255 + 1 for b in os.urandom(20))
         pkt = bytes([PROTOCOL_VERSION])
         pkt += SERVER_VERSION.encode() + b"\0"
         pkt += struct.pack("<I", self.conn_id)
@@ -237,21 +245,40 @@ class ClientConn:
         off = 4 + 4 + 1 + 23                      # caps, maxpkt, charset, fill
         user, off = read_nullterm(resp, off)
         if caps & CLIENT_PLUGIN_AUTH_LENENC:
-            _auth, off = read_lenenc_bytes(resp, off)
+            auth, off = read_lenenc_bytes(resp, off)
         else:
             alen = resp[off]
             off += 1
-            _auth, off = resp[off:off + alen], off + alen
+            auth, off = resp[off:off + alen], off + alen
         db = b""
         if caps & CLIENT_CONNECT_WITH_DB and off < len(resp):
             db, off = read_nullterm(resp, off)
         self.user = user.decode()
+        try:
+            self.peer_host = self.sock.getpeername()[0]
+        except OSError:
+            self.peer_host = "localhost"
+        # verify against mysql.user (ref: session.go:928 Auth ->
+        # privileges.go ConnectionVerification)
+        cache = self.session_domain().priv_cache()
+        if not cache.connection_verify(self.user, self.peer_host,
+                                       bytes(auth), salt):
+            self._write_err(
+                f"Access denied for user '{self.user}'@"
+                f"'{self.peer_host}' (using password: "
+                f"{'YES' if auth else 'NO'})", code=ER_ACCESS_DENIED)
+            return False
         self._write_ok(0, 0)
         if db:
             # select the startup database once the session exists
             self._pending_db = db.decode()
         else:
             self._pending_db = None
+        return True
+
+    def session_domain(self):
+        from tidb_tpu.session import Domain
+        return Domain.get(self.server.storage)
 
     # -- dispatch ------------------------------------------------------------
 

@@ -56,6 +56,14 @@ class Domain:
         self._mu = threading.Lock()
         self._stats = None
         self._plan_cache = None
+        self._priv = None
+
+    def priv_cache(self):
+        """Grant-table cache (ref: privilege/privileges/cache.go:104)."""
+        if self._priv is None:
+            from tidb_tpu.privilege import PrivilegeCache
+            self._priv = PrivilegeCache(self.storage)
+        return self._priv
 
     def stats_handle(self):
         """Lazy per-store stats cache (ref: statistics/handle.go:32)."""
@@ -119,10 +127,16 @@ class Domain:
 class Session:
     """Ref: session.go Session iface (:62-86)."""
 
-    def __init__(self, storage, db: str = ""):
+    def __init__(self, storage, db: str = "", user: str = "root",
+                 host: str = "%", internal: bool = False):
         self.storage = storage
         self.domain = Domain.get(storage)
         self.current_db = db
+        self.user = user
+        self.host = host
+        # internal sessions (bootstrap, privilege loader, background
+        # workers) bypass privilege checks — ref: ExecRestrictedSQL
+        self.internal = internal
         self.txn: kv.Transaction | None = None
         self.autocommit = True
         self.vars: dict[str, object] = {}
@@ -308,6 +322,10 @@ class Session:
 
     def _run_stmt(self, stmt: ast.StmtNode, sql_text: str | None = None):
         t = type(stmt).__name__
+        self._check_privileges(stmt)
+        if isinstance(stmt, (ast.CreateUserStmt, ast.DropUserStmt,
+                             ast.GrantStmt, ast.RevokeStmt)):
+            return self._exec_account(stmt)
         if isinstance(stmt, (ast.SelectStmt, ast.UnionStmt)):
             return self._exec_query(stmt, sql_text=sql_text)
         if isinstance(stmt, ast.PrepareStmt):
@@ -367,6 +385,178 @@ class Session:
         if isinstance(stmt, ast.AdminStmt):
             return ResultSet(columns=["info"], rows=[])
         raise SQLError(f"unsupported statement {t}")
+
+    # -- privileges (ref: privilege/privileges/privileges.go:56
+    # RequestVerification, wired at plan time via visitInfo in the
+    # reference's optimizer, plan/optimizer.go:73-77) ------------------------
+
+    def _check_privileges(self, stmt) -> None:
+        if self.internal:
+            return
+        from tidb_tpu.privilege import Priv
+        ischema = self.domain.info_schema()
+        if not ischema.has_db("mysql"):
+            return   # bootstrap-less library mode: no grant tables yet
+        cache = self.domain.priv_cache()
+
+        def deny(what: str):
+            raise SQLError(
+                f"{what} command denied to user '{self.user}'@"
+                f"'{self.host}'")
+
+        def need(db: str, table: str, want: int, what: str):
+            if not cache.request_verification(self.user, self.host,
+                                              (db or "").lower(),
+                                              (table or "").lower(), want):
+                deny(what)
+
+        if isinstance(stmt, (ast.CreateUserStmt, ast.DropUserStmt)):
+            need("", "", Priv.CREATE_USER, "CREATE USER")
+            return
+        if isinstance(stmt, (ast.GrantStmt, ast.RevokeStmt)):
+            need("", "", Priv.GRANT, "GRANT")
+            return
+        if isinstance(stmt, (ast.SelectStmt, ast.UnionStmt,
+                             ast.AnalyzeStmt)):
+            for db, tbl in _referenced_tables(stmt):
+                need(db or self.current_db, tbl, Priv.SELECT, "SELECT")
+            return
+        if isinstance(stmt, (ast.InsertStmt, ast.UpdateStmt,
+                             ast.DeleteStmt)):
+            want, what = {
+                ast.InsertStmt: (Priv.INSERT, "INSERT"),
+                ast.UpdateStmt: (Priv.UPDATE, "UPDATE"),
+                ast.DeleteStmt: (Priv.DELETE, "DELETE"),
+            }[type(stmt)]
+            target = stmt.table
+            tdb = ((target.db or self.current_db) if
+                   isinstance(target, ast.TableSource) else
+                   self.current_db)
+            tname = (target.name.lower()
+                     if isinstance(target, ast.TableSource) else "")
+            need(tdb, tname, want, what)
+            # reading columns needs SELECT: a WHERE on the target (MySQL
+            # checks column reads; a bare UPDATE t SET a=1 needs none)
+            if getattr(stmt, "where", None) is not None:
+                need(tdb, tname, Priv.SELECT, "SELECT")
+            # every table READ by the statement needs SELECT — including
+            # the target itself when INSERT ... SELECT reads from it
+            select_src = getattr(stmt, "select", None)
+            for db, tbl in _referenced_tables(select_src):
+                need(db or self.current_db, tbl, Priv.SELECT, "SELECT")
+            return
+        if isinstance(stmt, (ast.CreateDatabaseStmt, ast.DropDatabaseStmt)):
+            # check against the TARGET database, not the session's current
+            want = Priv.CREATE if isinstance(stmt, ast.CreateDatabaseStmt) \
+                else Priv.DROP
+            need(stmt.name, "", want, "DDL")
+            return
+        ddl_privs = {ast.CreateTableStmt: Priv.CREATE,
+                     ast.CreateIndexStmt: Priv.INDEX,
+                     ast.DropTableStmt: Priv.DROP,
+                     ast.DropIndexStmt: Priv.INDEX,
+                     ast.AlterTableStmt: Priv.ALTER,
+                     ast.TruncateTableStmt: Priv.DROP,
+                     ast.RenameTableStmt: Priv.ALTER}
+        want = ddl_privs.get(type(stmt))
+        if want is not None:
+            for db, tbl in _referenced_tables(stmt) or [("", "")]:
+                need(db or self.current_db, tbl, want, "DDL")
+        # SHOW / SET / EXPLAIN / txn control / prepared mgmt: unchecked
+        # (EXPLAIN checks happen when the prepared/inner stmt runs)
+
+    # -- account management (ref: executor/grant.go, executor/simple.go
+    # CREATE USER / DROP USER) ------------------------------------------------
+
+    def _account_session(self) -> "Session":
+        return Session(self.storage, db="mysql", internal=True)
+
+    def _exec_account(self, stmt):
+        from tidb_tpu.privilege import (ALL_PRIVS, PRIV_BY_NAME,
+                                        encode_password)
+        s = self._account_session()
+        try:
+            if isinstance(stmt, ast.CreateUserStmt):
+                for u in stmt.users:
+                    exists = s.query(
+                        "SELECT user FROM mysql.user WHERE user = "
+                        f"'{_q(u.user)}' AND host = '{_q(u.host)}'").rows
+                    if exists:
+                        if stmt.if_not_exists:
+                            continue
+                        raise SQLError(f"user '{u.user}'@'{u.host}' "
+                                       "already exists")
+                    auth = encode_password(u.password or "")
+                    s.execute("INSERT INTO mysql.user VALUES "
+                              f"('{_q(u.host)}', '{_q(u.user)}', "
+                              f"'{auth}', 0)")
+            elif isinstance(stmt, ast.DropUserStmt):
+                for u in stmt.users:
+                    exists = s.query(
+                        "SELECT user FROM mysql.user WHERE user = "
+                        f"'{_q(u.user)}' AND host = '{_q(u.host)}'").rows
+                    if not exists and not stmt.if_exists:
+                        raise SQLError(f"user '{u.user}'@'{u.host}' "
+                                       "does not exist")
+                    cond = (f"user = '{_q(u.user)}' AND "
+                            f"host = '{_q(u.host)}'")
+                    s.execute(f"DELETE FROM mysql.user WHERE {cond}")
+                    s.execute(f"DELETE FROM mysql.db WHERE {cond}")
+                    s.execute(
+                        f"DELETE FROM mysql.tables_priv WHERE {cond}")
+            else:
+                is_grant = isinstance(stmt, ast.GrantStmt)
+                bits = 0
+                for p in stmt.privs:
+                    bits |= PRIV_BY_NAME[p]
+                db = stmt.db if stmt.db != "" else self.current_db
+                if not db:
+                    raise SQLError("No database selected")
+                for u in stmt.users:
+                    if not s.query(
+                            "SELECT user FROM mysql.user WHERE user = "
+                            f"'{_q(u.user)}' AND host = "
+                            f"'{_q(u.host)}'").rows:
+                        raise SQLError(
+                            f"user '{u.user}'@'{u.host}' does not exist")
+                    self._apply_grant(s, u, db.lower(), stmt.table.lower(),
+                                      bits, is_grant)
+        finally:
+            s.close()
+        self.domain.priv_cache().invalidate()
+        return None
+
+    @staticmethod
+    def _apply_grant(s: "Session", u, db: str, table: str, bits: int,
+                     is_grant: bool) -> None:
+        cond = f"user = '{_q(u.user)}' AND host = '{_q(u.host)}'"
+        if db == "*":                     # global level -> mysql.user
+            tbl, cond2, ins = "mysql.user", cond, None
+        elif table == "*":                # db level -> mysql.db
+            tbl = "mysql.db"
+            cond2 = cond + f" AND db = '{_q(db)}'"
+            ins = (f"INSERT INTO mysql.db VALUES ('{_q(u.host)}', "
+                   f"'{_q(u.user)}', '{_q(db)}', {{privs}})")
+        else:                             # table level -> mysql.tables_priv
+            tbl = "mysql.tables_priv"
+            cond2 = cond + (f" AND db = '{_q(db)}' AND table_name = "
+                            f"'{_q(table)}'")
+            ins = (f"INSERT INTO mysql.tables_priv VALUES ('{_q(u.host)}',"
+                   f" '{_q(u.user)}', '{_q(db)}', '{_q(table)}', "
+                   "{privs}")
+            ins += ")"
+        rows = s.query(f"SELECT privs FROM {tbl} WHERE {cond2}").rows
+        cur = int(rows[0][0]) if rows else 0
+        new = (cur | bits) if is_grant else (cur & ~bits)
+        if rows:
+            if new == cur:
+                return
+            if new == 0 and tbl != "mysql.user":
+                s.execute(f"DELETE FROM {tbl} WHERE {cond2}")
+            else:
+                s.execute(f"UPDATE {tbl} SET privs = {new} WHERE {cond2}")
+        elif is_grant and ins is not None:
+            s.execute(ins.format(privs=new))
 
     # -- queries -------------------------------------------------------------
 
@@ -616,6 +806,41 @@ class _Prepared:
     sid: int = 0
     name: str | None = None
     columns_meta: tuple | None = None   # memoized (names, field_types)
+
+
+def _q(s: str) -> str:
+    """Escape a string literal for the internal account SQL."""
+    return str(s).replace("\\", "\\\\").replace("'", "\\'")
+
+
+def _referenced_tables(stmt) -> list[tuple[str, str]]:
+    """(db, table) pairs of every TableSource in the statement tree
+    (subqueries included) — the privilege-check surface."""
+    out: list[tuple[str, str]] = []
+    seen: set[int] = set()
+
+    def walk(x):
+        if id(x) in seen or x is None:
+            return
+        seen.add(id(x))
+        if isinstance(x, ast.TableSource):
+            out.append(((x.db or "").lower(), x.name.lower()))
+            return
+        if isinstance(x, (list, tuple)):
+            for item in x:
+                walk(item)
+            return
+        if hasattr(x, "__dataclass_fields__"):
+            for f in x.__dataclass_fields__:
+                walk(getattr(x, f))
+
+    walk(stmt)
+    # dedupe, keep order
+    uniq = []
+    for p in out:
+        if p not in uniq:
+            uniq.append(p)
+    return uniq
 
 
 def ast_params(node) -> list:
