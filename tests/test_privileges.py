@@ -215,3 +215,56 @@ class TestServerAuth:
             c.close()
         finally:
             srv.close()
+
+
+class TestReviewRegressions:
+    def test_dml_subquery_requires_select(self, store):
+        r = root(store)
+        r.execute("CREATE DATABASE db1; CREATE DATABASE sec")
+        r.execute("CREATE TABLE db1.t (id BIGINT PRIMARY KEY, a BIGINT)")
+        r.execute("CREATE TABLE sec.s (id BIGINT PRIMARY KEY, v BIGINT)")
+        r.execute("INSERT INTO db1.t VALUES (1, 0)")
+        r.execute("INSERT INTO sec.s VALUES (1, 7)")
+        r.execute("CREATE USER u")
+        r.execute("GRANT UPDATE, DELETE, SELECT ON db1.* TO u")
+        u = Session(store, db="db1", user="u", host="h")
+        with pytest.raises(SQLError, match="denied"):
+            u.execute("DELETE FROM t WHERE id IN (SELECT id FROM sec.s)")
+
+    def test_set_global_requires_super(self, store):
+        r = root(store)
+        r.execute("CREATE USER u")
+        u = Session(store, user="u", host="h")
+        with pytest.raises(SQLError, match="denied"):
+            u.execute("SET GLOBAL tidb_tpu_cop_concurrency = 3")
+        u.execute("SET @@tidb_tpu_device = 1")   # session-level ok
+
+    def test_partial_grant_failure_still_invalidates_cache(self, store):
+        r = root(store)
+        r.execute("CREATE DATABASE db1")
+        r.execute("CREATE TABLE db1.t (id BIGINT PRIMARY KEY)")
+        r.execute("CREATE USER alice")
+        alice = Session(store, db="db1", user="alice", host="h")
+        with pytest.raises(SQLError, match="denied"):
+            alice.query("SELECT * FROM t")   # cache now loaded
+        with pytest.raises(SQLError, match="does not exist"):
+            r.execute("GRANT SELECT ON db1.* TO alice, ghost")
+        # alice's grant committed before the error; cache must see it
+        alice.query("SELECT * FROM t")
+
+    def test_create_user_redacted_in_processlist_log(self, store, caplog):
+        import logging
+        from tidb_tpu import config
+        r = root(store)
+        old = config.get_var("tidb_tpu_slow_query_ms")
+        config.set_var("tidb_tpu_slow_query_ms", 0)
+        try:
+            with caplog.at_level(logging.WARNING,
+                                 logger="tidb_tpu.slow_query"):
+                r.execute("CREATE USER leaky IDENTIFIED BY 'hunter2'")
+            assert not any("hunter2" in rec.getMessage()
+                           for rec in caplog.records)
+            assert any("redacted" in rec.getMessage()
+                       for rec in caplog.records)
+        finally:
+            config.set_var("tidb_tpu_slow_query_ms", old)

@@ -1,0 +1,121 @@
+"""Server process plane: CLI entry, status API, metrics, slow-query log,
+SHOW PROCESSLIST, SET GLOBAL persistence (ref: tidb-server/main.go,
+server/http_status.go, util/logutil slow log)."""
+
+import json
+import logging
+import subprocess
+import sys
+import urllib.request
+
+import pytest
+
+from tidb_tpu import config, metrics
+from tidb_tpu.server import Server
+from tidb_tpu.server.status import StatusServer
+from tidb_tpu.session import Session
+from tidb_tpu.store.storage import new_mock_storage
+
+from tests.mysql_client import MiniClient
+
+
+def test_cli_starts_serves_and_stops():
+    """Launch `python -m tidb_tpu` as a real process, connect with the
+    wire client, run SQL, SIGTERM it."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tidb_tpu", "--port", "0", "--no-status",
+         "--no-mesh", "--log-level", "info"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd="/root/repo", env={"PYTHONPATH": "/root/repo",
+                               "PATH": "/usr/bin:/bin",
+                               "JAX_PLATFORMS": "cpu",
+                               "HOME": "/root"})
+    port = None
+    try:
+        for _ in range(600):
+            line = proc.stdout.readline()
+            if "MySQL protocol on" in line:
+                port = int(line.rsplit(":", 1)[1])
+                break
+        assert port, "server did not report its port"
+        c = MiniClient("127.0.0.1", port, user="root")
+        c.query("CREATE DATABASE d")
+        c.query("CREATE TABLE d.t (id BIGINT PRIMARY KEY)")
+        c.query("INSERT INTO d.t VALUES (1), (2)")
+        assert c.query("SELECT COUNT(*) FROM d.t")[1] == [("2",)]
+        c.close()
+    finally:
+        proc.terminate()
+        assert proc.wait(timeout=20) == 0
+
+
+def test_status_endpoint_and_metrics():
+    st = new_mock_storage()
+    srv = Server(st)
+    srv.start()
+    status = StatusServer(st, srv)
+    status.start()
+    try:
+        c = MiniClient("127.0.0.1", srv.port, user="root")
+        c.query("SELECT 1")
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{status.port}/status") as r:
+            body = json.load(r)
+        assert body["version"]
+        assert body["regions"] >= 1
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{status.port}/metrics") as r:
+            text = r.read().decode()
+        assert "tidb_tpu_queries_total" in text
+        assert "tidb_tpu_query_duration_seconds_bucket" in text
+        c.close()
+    finally:
+        status.close()
+        srv.close()
+
+
+def test_slow_query_log(caplog):
+    st = new_mock_storage()
+    s = Session(st)
+    s.execute("CREATE DATABASE d; USE d")
+    s.execute("CREATE TABLE t (id BIGINT PRIMARY KEY)")
+    old = config.get_var("tidb_tpu_slow_query_ms")
+    config.set_var("tidb_tpu_slow_query_ms", 0)   # everything is slow
+    try:
+        with caplog.at_level(logging.WARNING, logger="tidb_tpu.slow_query"):
+            s.query("SELECT COUNT(*) FROM t")
+        assert any("slow query" in r.message for r in caplog.records)
+    finally:
+        config.set_var("tidb_tpu_slow_query_ms", old)
+    assert metrics.snapshot().get("tidb_tpu_slow_queries_total", 0) >= 1
+
+
+def test_show_processlist():
+    st = new_mock_storage()
+    s = Session(st, user="alice", host="somewhere")
+    s.execute("CREATE DATABASE d; USE d")
+    r = s.query("SHOW PROCESSLIST")
+    assert r.columns[:3] == ["Id", "User", "Host"]
+    me = [row for row in r.rows if row[0] == s.session_id]
+    assert me and me[0][1] == "alice"
+    assert me[0][7] and "PROCESSLIST" in me[0][7]   # own query visible
+
+
+def test_set_global_persists_and_reloads():
+    from tidb_tpu.bootstrap import bootstrap, load_global_variables
+    st = new_mock_storage()
+    bootstrap(st)
+    s = Session(st)
+    old = config.get_var("tidb_tpu_cop_concurrency")
+    try:
+        s.execute("SET GLOBAL tidb_tpu_cop_concurrency = 7")
+        rows = Session(st, internal=True).query(
+            "SELECT variable_value FROM mysql.global_variables WHERE "
+            "variable_name = 'tidb_tpu_cop_concurrency'").rows
+        assert rows == [("7",)]
+        # simulate a fresh process: reset then reload from the table
+        config.set_var("tidb_tpu_cop_concurrency", old)
+        load_global_variables(st)
+        assert config.get_var("tidb_tpu_cop_concurrency") == 7
+    finally:
+        config.set_var("tidb_tpu_cop_concurrency", old)

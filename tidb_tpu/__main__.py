@@ -1,0 +1,124 @@
+"""tidb-tpu server process: `python -m tidb_tpu [flags]`.
+
+Reference: /root/reference/tidb-server/main.go:127-152 — flag/config
+merge, store open, bootstrap, MySQL wire server + HTTP status server,
+signal-driven graceful close. Config precedence: built-in defaults <
+TIDB_TPU_* environment < --config TOML file < explicit CLI flags.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+
+
+def _apply_config_file(path: str) -> dict:
+    """TOML config tree (ref: config/config.go:29). Returns the flat
+    {sysvar_name: value} dict of the [variables] table plus top-level
+    server keys."""
+    import tomllib
+    with open(path, "rb") as f:
+        return tomllib.load(f)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tidb_tpu", description="TPU-native HTAP SQL server")
+    # None defaults distinguish "flag given" from "use config/default":
+    # precedence is defaults < env < config file < explicit flags
+    p.add_argument("--host", default=None)
+    p.add_argument("-P", "--port", type=int, default=None)
+    p.add_argument("--status-port", type=int, default=None)
+    p.add_argument("--no-status", action="store_true",
+                   help="disable the HTTP status server")
+    p.add_argument("--config", help="TOML config file")
+    p.add_argument("--mesh", type=int, default=None, metavar="N",
+                   help="enable an N-device mesh (default: all devices)")
+    p.add_argument("--no-mesh", action="store_true")
+    p.add_argument("--token-limit", type=int, default=1000,
+                   help="max concurrent connections (ref: TokenLimit)")
+    p.add_argument("--log-level", default="info")
+    p.add_argument("--slow-threshold-ms", type=int, default=None)
+    p.add_argument("--set", action="append", default=[], metavar="VAR=V",
+                   help="set a tidb_tpu_* sysvar (repeatable)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=getattr(logging, args.log_level.upper(), logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s %(message)s")
+    log = logging.getLogger("tidb_tpu.server")
+
+    from tidb_tpu import config
+    if args.config:
+        tree = _apply_config_file(args.config)
+        for k, v in (tree.get("variables") or {}).items():
+            config.set_var(k, v)
+        # explicit CLI flags beat the file (main.go:257 overrideConfig)
+        if args.host is None:
+            args.host = tree.get("host")
+        if args.port is None and "port" in tree:
+            args.port = int(tree["port"])
+        if args.status_port is None and "status_port" in tree:
+            args.status_port = int(tree["status_port"])
+    args.host = args.host or "127.0.0.1"
+    args.port = 4000 if args.port is None else args.port
+    args.status_port = 10080 if args.status_port is None \
+        else args.status_port
+    if args.slow_threshold_ms is not None:
+        config.set_var("tidb_tpu_slow_query_ms", args.slow_threshold_ms)
+    for kv in args.set:
+        name, _, val = kv.partition("=")
+        config.set_var(name, val)
+
+    from tidb_tpu.parallel import config as mesh_config
+    if args.no_mesh:
+        mesh_config.disable_mesh()
+    else:
+        try:
+            mesh_config.enable_mesh(args.mesh)
+            mesh = mesh_config.active_mesh()
+            log.info("device mesh: %s", mesh.devices.shape
+                     if mesh is not None else None)
+        except Exception as e:  # noqa: BLE001 - no devices is survivable
+            log.warning("mesh unavailable (%s); host execution only", e)
+
+    from tidb_tpu.server import Server
+    from tidb_tpu.server.status import StatusServer
+    from tidb_tpu.store.storage import new_mock_storage
+
+    storage = new_mock_storage()
+    server = Server(storage, host=args.host, port=args.port,
+                    token_limit=args.token_limit)
+    server.start()
+    log.info("MySQL protocol on %s:%d", args.host, server.port)
+    status = None
+    if not args.no_status:
+        status = StatusServer(storage, server, host=args.host,
+                              port=args.status_port)
+        status.start()
+        log.info("status API on %s:%d", args.host, status.port)
+
+    stop = threading.Event()
+
+    def _on_signal(_sig, _frm):
+        stop.set()
+
+    signal.signal(signal.SIGINT, _on_signal)
+    signal.signal(signal.SIGTERM, _on_signal)
+    stop.wait()
+    log.info("shutting down")
+    if status is not None:
+        status.close()
+    server.close()
+    storage.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,7 +13,7 @@ import threading
 
 from tidb_tpu.privilege import ALL_PRIVS
 
-__all__ = ["bootstrap", "BOOTSTRAP_VERSION"]
+__all__ = ["bootstrap", "load_global_variables", "BOOTSTRAP_VERSION"]
 
 BOOTSTRAP_VERSION = 1
 
@@ -49,6 +49,28 @@ def _bootstrapped_version(session) -> int:
     except Exception:  # noqa: BLE001 - partial earlier bootstrap
         return 0
     return int(rows[0][0]) if rows else 0
+
+
+def load_global_variables(storage) -> None:
+    """Apply persisted SET GLOBAL values to the process config registry
+    (ref: session.go:1166 loading GLOBAL_VARIABLES at session start)."""
+    from tidb_tpu import config
+    from tidb_tpu.session import Session
+
+    s = Session(storage, internal=True)
+    try:
+        if not s.domain.info_schema().has_db("mysql"):
+            return
+        for name, value in s.query(
+                "SELECT variable_name, variable_value "
+                "FROM mysql.global_variables").rows:
+            if config.is_known(name):
+                try:
+                    config.set_var(name, value)
+                except (TypeError, ValueError):
+                    pass   # stale row with an invalid value: ignore
+    finally:
+        s.close()
 
 
 def bootstrap(storage) -> None:

@@ -41,6 +41,9 @@ _DEFS: dict[str, tuple[str, int]] = {
     "tidb_tpu_sort_spill_rows": (_INT, 1 << 20),
     # min chunk rows before an executor pays a device dispatch
     "tidb_tpu_device_min_rows": (_INT, 2048),
+    # statements at/above this wall time land in the slow-query log
+    # (ref: config.Log.SlowThreshold, default 300ms)
+    "tidb_tpu_slow_query_ms": (_INT, 300),
 }
 
 _lock = threading.Lock()

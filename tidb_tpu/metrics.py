@@ -1,0 +1,100 @@
+"""In-process metrics registry with Prometheus text exposition.
+
+Reference: the per-package metrics.go files (10 of them — parse/compile/
+execute histograms at session.go:682,739,755, 2PC action durations, cop
+task counts, backoff totals). No client library dependency: counters and
+histograms are plain atomics-under-lock, and /metrics on the status
+server renders the standard text format scrapers consume.
+"""
+
+from __future__ import annotations
+
+import threading
+
+__all__ = ["counter", "histogram", "expose", "snapshot",
+           "QUERY_DURATIONS", "QUERIES_TOTAL", "SLOW_QUERIES",
+           "CONNECTIONS", "COP_TASKS", "QUERY_ERRORS"]
+
+_lock = threading.Lock()
+_counters: dict[tuple[str, tuple], float] = {}
+_histograms: dict[str, "_Hist"] = {}
+
+_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0)
+
+
+class _Hist:
+    __slots__ = ("buckets", "counts", "total", "sum")
+
+    def __init__(self):
+        self.buckets = _BUCKETS
+        self.counts = [0] * (len(_BUCKETS) + 1)
+        self.total = 0
+        self.sum = 0.0
+
+    def observe(self, v: float) -> None:
+        i = 0
+        for i, b in enumerate(self.buckets):
+            if v <= b:
+                break
+        else:
+            i = len(self.buckets)
+        self.counts[i] += 1
+        self.total += 1
+        self.sum += v
+
+
+def counter(name: str, labels: dict | None = None, inc: float = 1) -> None:
+    key = (name, tuple(sorted((labels or {}).items())))
+    with _lock:
+        _counters[key] = _counters.get(key, 0) + inc
+
+
+def histogram(name: str, value: float) -> None:
+    with _lock:
+        h = _histograms.get(name)
+        if h is None:
+            h = _histograms[name] = _Hist()
+        h.observe(value)
+
+
+def snapshot() -> dict:
+    """Plain dict of counter values (tests / status JSON)."""
+    with _lock:
+        out = {}
+        for (name, labels), v in _counters.items():
+            key = name if not labels else \
+                name + "{" + ",".join(f'{k}="{val}"'
+                                      for k, val in labels) + "}"
+            out[key] = v
+        for name, h in _histograms.items():
+            out[name + "_count"] = h.total
+            out[name + "_sum"] = round(h.sum, 6)
+        return out
+
+
+def expose() -> str:
+    """Prometheus text exposition format."""
+    lines = []
+    with _lock:
+        for (name, labels), v in sorted(_counters.items()):
+            lbl = "{" + ",".join(f'{k}="{val}"' for k, val in labels) + "}" \
+                if labels else ""
+            lines.append(f"{name}{lbl} {v}")
+        for name, h in sorted(_histograms.items()):
+            acc = 0
+            for b, c in zip(h.buckets, h.counts):
+                acc += c
+                lines.append(f'{name}_bucket{{le="{b}"}} {acc}')
+            lines.append(f'{name}_bucket{{le="+Inf"}} {h.total}')
+            lines.append(f"{name}_count {h.total}")
+            lines.append(f"{name}_sum {h.sum}")
+    return "\n".join(lines) + "\n"
+
+
+# metric names (one place, mirroring the reference's metric families)
+QUERY_DURATIONS = "tidb_tpu_query_duration_seconds"
+QUERIES_TOTAL = "tidb_tpu_queries_total"
+SLOW_QUERIES = "tidb_tpu_slow_queries_total"
+CONNECTIONS = "tidb_tpu_connections_total"
+COP_TASKS = "tidb_tpu_cop_tasks_total"
+QUERY_ERRORS = "tidb_tpu_query_errors_total"

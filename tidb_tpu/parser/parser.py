@@ -889,6 +889,7 @@ class Parser:
             s.is_global = True
         else:
             self.try_kw("SESSION")
+        self.try_kw("FULL")
         if self.try_kw("DATABASES") or self.try_kw("SCHEMA"):
             s.tp = "databases"
         elif self.try_kw("TABLES"):
@@ -905,6 +906,10 @@ class Parser:
             s.table = self.table_name()
         elif self.try_kw("VARIABLES"):
             s.tp = "variables"
+        elif self.peek().tp == TokenType.IDENT and \
+                self.peek().val.upper() == "PROCESSLIST":
+            self.next()
+            s.tp = "processlist"
         elif self.try_kw("STATUS"):
             s.tp = "status"
         elif self.try_kw("ENGINES"):

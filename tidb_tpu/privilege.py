@@ -33,11 +33,12 @@ class Priv:
     INDEX = 1 << 7
     CREATE_USER = 1 << 8
     GRANT = 1 << 9
+    SUPER = 1 << 10          # SET GLOBAL etc. (system administration)
 
 
 ALL_PRIVS = (Priv.SELECT | Priv.INSERT | Priv.UPDATE | Priv.DELETE |
              Priv.CREATE | Priv.DROP | Priv.ALTER | Priv.INDEX |
-             Priv.CREATE_USER | Priv.GRANT)
+             Priv.CREATE_USER | Priv.GRANT | Priv.SUPER)
 
 PRIV_BY_NAME = {"SELECT": Priv.SELECT, "INSERT": Priv.INSERT,
                 "UPDATE": Priv.UPDATE, "DELETE": Priv.DELETE,

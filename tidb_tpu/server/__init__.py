@@ -72,8 +72,9 @@ class Server:
     def __init__(self, storage, host: str = "127.0.0.1", port: int = 0,
                  token_limit: int = 1000):
         self.storage = storage
-        from tidb_tpu.bootstrap import bootstrap
+        from tidb_tpu.bootstrap import bootstrap, load_global_variables
         bootstrap(storage)   # system catalog + root account (idempotent)
+        load_global_variables(storage)
         self._listener = socket.create_server((host, port))
         self.addr = self._listener.getsockname()
         self._tokens = threading.Semaphore(token_limit)

@@ -1,0 +1,63 @@
+"""HTTP status server: /status, /metrics (ref: server/http_status.go —
+the :10080 admin API; Prometheus text on /metrics)."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from tidb_tpu import __version__, metrics
+
+__all__ = ["StatusServer"]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "tidb-tpu-status"
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    def do_GET(self):  # noqa: N802 - stdlib API
+        if self.path == "/metrics":
+            body = metrics.expose().encode()
+            ctype = "text/plain; version=0.0.4"
+        elif self.path in ("/", "/status"):
+            st = self.server.ctx_storage
+            body = json.dumps({
+                "version": __version__,
+                "connections": len(getattr(self.server.ctx_server,
+                                           "_conns", ())),
+                "regions": len(st.cluster._regions),
+                "metrics": metrics.snapshot(),
+            }, indent=2).encode()
+            ctype = "application/json"
+        else:
+            self.send_error(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class StatusServer:
+    def __init__(self, storage, sql_server=None, host: str = "127.0.0.1",
+                 port: int = 0):
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.ctx_storage = storage
+        self._httpd.ctx_server = sql_server
+        self.port = self._httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="status-http")
+        self._thread.start()
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -9,7 +9,10 @@ retry on commit conflict replays the statement history
 
 from __future__ import annotations
 
+import logging
 import threading
+import time
+import weakref
 from dataclasses import dataclass, field
 
 from tidb_tpu import kv
@@ -27,6 +30,16 @@ from tidb_tpu.sqltypes import (EvalType, TypeCode, format_datetime,
 __all__ = ["Session", "ResultSet", "Domain", "SQLError"]
 
 COMMIT_RETRY_LIMIT = 10  # ref: tidb.go:109 commitRetryLimit
+
+# dedicated slow-query logger (ref: util/logutil/log.go:228-248 separate
+# slow-query log file; executor/adapter.go:353 emit site)
+slow_log = logging.getLogger("tidb_tpu.slow_query")
+
+# live sessions for SHOW PROCESSLIST (ref: util.SessionManager backing
+# SHOW PROCESSLIST in the server package)
+_SESSIONS: "weakref.WeakSet[Session]" = weakref.WeakSet()
+_session_seq = 0
+_session_seq_lock = threading.Lock()
 
 
 class SQLError(Exception):
@@ -145,6 +158,15 @@ class Session:
         self._history: list[ast.StmtNode] = []  # stmt replay for retry
         self._prepared: dict = {}               # id/name -> _Prepared
         self._next_stmt_id = 0
+        global _session_seq
+        with _session_seq_lock:
+            _session_seq += 1
+            self.session_id = _session_seq
+            self.created_at = time.time()
+            self.current_sql: str | None = None  # for SHOW PROCESSLIST
+            self._stmt_start = 0.0
+            if not internal:
+                _SESSIONS.add(self)
 
     # -- public API ----------------------------------------------------------
 
@@ -155,8 +177,38 @@ class Session:
         out = []
         single = sql if len(stmts) == 1 else None
         for stmt in stmts:
-            out.append(self._run_stmt(stmt, sql_text=single))
+            out.append(self._timed_stmt(stmt, sql, sql_text=single))
         return out
+
+    def _timed_stmt(self, stmt, sql: str, sql_text: str | None):
+        """Statement lifecycle wrapper: processlist state, duration
+        metrics, slow-query log (ref: ExecStmt adapter, adapter.go:189 +
+        slow-log emit at :353)."""
+        from tidb_tpu import config, metrics
+        # auth statements never expose credentials in the processlist or
+        # the slow log (the reference redacts before logging)
+        if isinstance(stmt, ast.CreateUserStmt):
+            sql = "<redacted: CREATE USER>"
+        self.current_sql = sql
+        self._stmt_start = time.perf_counter()
+        kind = type(stmt).__name__.removesuffix("Stmt").lower()
+        try:
+            res = self._run_stmt(stmt, sql_text=sql_text)
+        except Exception:
+            metrics.counter(metrics.QUERY_ERRORS)
+            raise
+        finally:
+            dur = time.perf_counter() - self._stmt_start
+            metrics.counter(metrics.QUERIES_TOTAL, {"type": kind})
+            metrics.histogram(metrics.QUERY_DURATIONS, dur)
+            if not self.internal and \
+                    dur * 1000 >= config.get_var("tidb_tpu_slow_query_ms"):
+                metrics.counter(metrics.SLOW_QUERIES)
+                slow_log.warning(
+                    "slow query: %.3fs user=%s db=%s sql=%s",
+                    dur, self.user, self.current_db, sql[:2048])
+            self.current_sql = None
+        return res
 
     # -- prepared statements (ref: session.go:777-855 PrepareStmt /
     # ExecutePreparedStmt; the binary protocol and SQL PREPARE share it) ----
@@ -439,11 +491,25 @@ class Session:
             # checks column reads; a bare UPDATE t SET a=1 needs none)
             if getattr(stmt, "where", None) is not None:
                 need(tdb, tname, Priv.SELECT, "SELECT")
-            # every table READ by the statement needs SELECT — including
-            # the target itself when INSERT ... SELECT reads from it
-            select_src = getattr(stmt, "select", None)
-            for db, tbl in _referenced_tables(select_src):
+            # every OTHER table the statement touches is a read — this
+            # walks the WHOLE tree, so subqueries in WHERE / SET / VALUES
+            # and INSERT ... SELECT sources all require SELECT
+            for db, tbl in _referenced_tables(stmt):
+                db = (db or self.current_db).lower()
+                if db == tdb.lower() and tbl == tname:
+                    continue
+                need(db, tbl, Priv.SELECT, "SELECT")
+            # INSERT ... SELECT reading the target itself still needs
+            # SELECT on it (skipped by the loop above)
+            for db, tbl in _referenced_tables(getattr(stmt, "select",
+                                                      None)):
                 need(db or self.current_db, tbl, Priv.SELECT, "SELECT")
+            return
+        if isinstance(stmt, ast.SetStmt):
+            if any(getattr(a, "is_global", False)
+                   for a in stmt.assignments):
+                # SET GLOBAL mutates process-wide state and persists
+                need("", "", Priv.SUPER, "SUPER (SET GLOBAL)")
             return
         if isinstance(stmt, (ast.CreateDatabaseStmt, ast.DropDatabaseStmt)):
             # check against the TARGET database, not the session's current
@@ -523,7 +589,9 @@ class Session:
                                       bits, is_grant)
         finally:
             s.close()
-        self.domain.priv_cache().invalidate()
+            # ALWAYS invalidate: a mid-loop error may follow committed
+            # writes (autocommit per internal statement)
+            self.domain.priv_cache().invalidate()
         return None
 
     @staticmethod
@@ -689,6 +757,8 @@ class Session:
                         raise SQLError(
                             f"invalid value for @@{a.name}: {val!r}") \
                             from None
+                if getattr(a, "is_global", False):
+                    self._persist_global_var(a.name.lower(), val)
                 self.sys_vars[a.name.lower()] = val
                 if a.name.lower() == "autocommit":
                     self.autocommit = bool(int(val)) if val is not None \
@@ -696,6 +766,24 @@ class Session:
             else:
                 self.vars[a.name.lower()] = val
         return None
+
+    def _persist_global_var(self, name: str, val) -> None:
+        """SET GLOBAL persists into mysql.global_variables (ref:
+        session.go:588-640 SetGlobalSysVar) when the catalog exists."""
+        if not self.domain.info_schema().has_db("mysql"):
+            return
+        s = Session(self.storage, db="mysql", internal=True)
+        try:
+            cond = f"variable_name = '{_q(name)}'"
+            if s.query("SELECT variable_name FROM mysql.global_variables "
+                       f"WHERE {cond}").rows:
+                s.execute("UPDATE mysql.global_variables SET "
+                          f"variable_value = '{_q(str(val))}' WHERE {cond}")
+            else:
+                s.execute("INSERT INTO mysql.global_variables VALUES "
+                          f"('{_q(name)}', '{_q(str(val))}')")
+        finally:
+            s.close()
 
     def _exec_show(self, stmt: ast.ShowStmt) -> ResultSet:
         ischema = self.domain.info_schema()
@@ -731,6 +819,21 @@ class Session:
                 rx = re.compile(_like_to_regex(stmt.pattern))
                 rows = [r for r in rows if rx.fullmatch(r[0])]
             return ResultSet(["Variable_name", "Value"], rows)
+        if stmt.tp == "processlist":
+            rows = []
+            now = time.time()
+            with _session_seq_lock:   # adds are serialized with snapshot
+                live = list(_SESSIONS)
+            for s in sorted(live, key=lambda x: x.session_id):
+                sql = s.current_sql
+                rows.append((s.session_id, s.user, s.host,
+                             s.current_db or None,
+                             "Query" if sql else "Sleep",
+                             int(now - s.created_at),
+                             "" if sql else None,
+                             (sql or "")[:100] or None))
+            return ResultSet(["Id", "User", "Host", "db", "Command",
+                              "Time", "State", "Info"], rows)
         if stmt.tp == "create_table":
             db = stmt.table.db or self.current_db
             t = ischema.table(db, stmt.table.name)

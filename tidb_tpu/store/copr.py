@@ -183,6 +183,8 @@ class CopClient(kv.Client):
         tasks = self.cache.split_ranges_by_region(req.ranges)
         if not tasks:
             return
+        from tidb_tpu import metrics
+        metrics.counter(metrics.COP_TASKS, inc=len(tasks))
         concurrency = min(req.concurrency or config.cop_concurrency(),
                           len(tasks))
         if concurrency <= 1 or len(tasks) == 1:
