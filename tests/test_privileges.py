@@ -237,7 +237,14 @@ class TestReviewRegressions:
         u = Session(store, user="u", host="h")
         with pytest.raises(SQLError, match="denied"):
             u.execute("SET GLOBAL tidb_tpu_cop_concurrency = 3")
-        u.execute("SET @@tidb_tpu_device = 1")   # session-level ok
+        # registry vars are process-wide here: session syntax needs SUPER
+        with pytest.raises(SQLError, match="denied"):
+            u.execute("SET @@tidb_tpu_device = 1")
+        u.execute("SET @myvar = 1")              # user variables are free
+        u.execute("SET @@sql_mode = ''")          # plain session sysvar ok
+        # SUPER alone (not ALL) is grantable and unlocks SET GLOBAL
+        r.execute("GRANT SUPER ON *.* TO u")
+        u.execute("SET GLOBAL tidb_tpu_cop_concurrency = 10")
 
     def test_partial_grant_failure_still_invalidates_cache(self, store):
         r = root(store)
@@ -268,3 +275,47 @@ class TestReviewRegressions:
                        for rec in caplog.records)
         finally:
             config.set_var("tidb_tpu_slow_query_ms", old)
+
+    def test_update_subquery_on_target_needs_select(self, store):
+        r = root(store)
+        r.execute("CREATE DATABASE db1")
+        r.execute("CREATE TABLE db1.t (id BIGINT PRIMARY KEY, a BIGINT)")
+        r.execute("INSERT INTO db1.t VALUES (1, 5)")
+        r.execute("CREATE USER w2")
+        r.execute("GRANT UPDATE ON db1.t TO w2")
+        w = Session(store, db="db1", user="w2", host="h")
+        with pytest.raises(SQLError, match="denied"):
+            w.execute("UPDATE t SET a = (SELECT MAX(a) FROM t)")
+
+    def test_batch_create_user_redacted(self, store, caplog):
+        import logging
+        from tidb_tpu import config
+        r = root(store)
+        old = config.get_var("tidb_tpu_slow_query_ms")
+        config.set_var("tidb_tpu_slow_query_ms", 0)
+        try:
+            with caplog.at_level(logging.WARNING,
+                                 logger="tidb_tpu.slow_query"):
+                r.execute("CREATE DATABASE batchy; "
+                          "CREATE USER leak2 IDENTIFIED BY 'hunter3'")
+            assert not any("hunter3" in rec.getMessage()
+                           for rec in caplog.records)
+        finally:
+            config.set_var("tidb_tpu_slow_query_ms", old)
+
+    def test_bootstrap_v2_upgrade_regrants_root(self, store):
+        from tidb_tpu.bootstrap import BOOTSTRAP_VERSION, bootstrap
+        from tidb_tpu.privilege import ALL_PRIVS
+        r = root(store)
+        # simulate a v1 store: strip SUPER from root, set version back
+        s = Session(store, internal=True)
+        s.execute(f"UPDATE mysql.user SET privs = {ALL_PRIVS & ~Priv.SUPER}"
+                  " WHERE user = 'root'")
+        s.execute("UPDATE mysql.tidb SET variable_value = '1' "
+                  "WHERE variable_name = 'bootstrapped'")
+        s.close()
+        store.chunk_cache.clear()
+        bootstrap(store)
+        rows = Session(store, internal=True).query(
+            "SELECT privs FROM mysql.user WHERE user = 'root'").rows
+        assert rows == [(ALL_PRIVS,)]

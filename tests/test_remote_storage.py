@@ -1,0 +1,195 @@
+"""Out-of-process storage (store/remote.py): the SQL layer over sockets.
+
+Ref: store/tikv/client.go (conn pool), region_request.go (network-error
+retry), and the reference's defining stateless-SQL-over-RPC shape."""
+
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from tidb_tpu.session import Session
+from tidb_tpu.store.remote import RemoteStorage, StorageServer, connect
+
+
+@pytest.fixture
+def server():
+    srv = StorageServer()
+    srv.start()
+    yield srv
+    srv.close()
+
+
+@pytest.fixture
+def sess(server):
+    st = connect("127.0.0.1", server.port)
+    s = Session(st)
+    s.execute("CREATE DATABASE d")
+    s.execute("USE d")
+    yield s
+    s.close()
+    st.close()
+
+
+class TestRemoteSQL:
+    def test_ddl_dml_query(self, sess):
+        sess.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, v BIGINT, "
+                     "s VARCHAR(10))")
+        sess.execute("INSERT INTO t VALUES " + ",".join(
+            f"({i},{i * 3},'s{i % 5}')" for i in range(500)))
+        r = sess.query("SELECT s, COUNT(*), SUM(v) FROM t GROUP BY s "
+                       "ORDER BY s")
+        assert len(r.rows) == 5
+        assert sum(x[1] for x in r.rows) == 500
+        sess.execute("UPDATE t SET v = 0 WHERE id < 100")
+        assert sess.query("SELECT SUM(v) FROM t").rows[0][0] == \
+            sum(i * 3 for i in range(100, 500))
+        sess.execute("DELETE FROM t WHERE id >= 400")
+        assert sess.query("SELECT COUNT(*) FROM t").rows[0][0] == 400
+
+    def test_joins_and_index(self, sess):
+        sess.execute("CREATE TABLE a (id BIGINT PRIMARY KEY, k BIGINT)")
+        sess.execute("CREATE TABLE b (id BIGINT PRIMARY KEY, lbl "
+                     "VARCHAR(8))")
+        sess.execute("CREATE INDEX ik ON a (k)")
+        sess.execute("INSERT INTO b VALUES " + ",".join(
+            f"({i},'L{i}')" for i in range(20)))
+        sess.execute("INSERT INTO a VALUES " + ",".join(
+            f"({i},{i % 20})" for i in range(300)))
+        r = sess.query("SELECT b.lbl, COUNT(*) FROM a JOIN b "
+                       "ON a.k = b.id GROUP BY b.lbl")
+        assert len(r.rows) == 20
+        r2 = sess.query("SELECT id FROM a WHERE k = 3 ORDER BY id")
+        assert [x[0] for x in r2.rows] == list(range(3, 300, 20))
+
+    def test_txn_conflict_and_isolation(self, server):
+        st1 = connect("127.0.0.1", server.port)
+        st2 = connect("127.0.0.1", server.port)
+        s1, s2 = Session(st1), Session(st2)
+        s1.execute("CREATE DATABASE d; USE d")
+        s1.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, v BIGINT)")
+        s1.execute("INSERT INTO t VALUES (1, 0)")
+        s2.execute("USE d")
+        s2.execute("BEGIN")
+        assert s2.query("SELECT v FROM t").rows == [(0,)]
+        s1.execute("UPDATE t SET v = 5 WHERE id = 1")
+        assert s2.query("SELECT v FROM t").rows == [(0,)]   # snapshot
+        s2.execute("COMMIT")
+        assert s2.query("SELECT v FROM t").rows == [(5,)]
+        # optimistic conflict replay
+        s1.execute("BEGIN")
+        s1.execute("UPDATE t SET v = v + 1 WHERE id = 1")
+        s2.execute("UPDATE t SET v = v + 10 WHERE id = 1")
+        s1.execute("COMMIT")
+        assert s1.query("SELECT v FROM t").rows == [(16,)]
+        for s, st in ((s1, st1), (s2, st2)):
+            s.close()
+            st.close()
+
+    def test_bulk_load_and_region_split(self, server, sess):
+        from tidb_tpu.table import Table, bulkload
+        sess.execute("CREATE TABLE big (id BIGINT PRIMARY KEY, v BIGINT)")
+        tbl = Table(sess.domain.info_schema().table("d", "big"),
+                    sess.storage)
+        n = 20000
+        bulkload.bulk_load(sess.storage, tbl, {
+            "id": np.arange(n, dtype=np.int64),
+            "v": np.arange(n, dtype=np.int64) % 97})
+        sess.storage.cluster.split_table(tbl.info.id, 4, max_handle=n)
+        r = sess.query("SELECT COUNT(*), SUM(v) FROM big")
+        assert r.rows[0] == (n, int((np.arange(n) % 97).sum()))
+
+    def test_connection_failure_retries_transparently(self, server, sess):
+        sess.execute("CREATE TABLE t (id BIGINT PRIMARY KEY)")
+        sess.execute("INSERT INTO t VALUES (1), (2)")
+        assert sess.query("SELECT COUNT(*) FROM t").rows == [(2,)]
+        # sever every pooled connection behind the client's back
+        for c in list(sess.storage.rpc._pool):
+            c.sock.shutdown(socket.SHUT_RDWR)
+        assert sess.query("SELECT COUNT(*) FROM t").rows == [(2,)]
+
+
+class TestProcessBoundary:
+    def _free_port(self):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def _spawn(self, port, snapshot):
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tidb_tpu.store.remote",
+             "--port", str(port), "--snapshot", snapshot],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            cwd="/root/repo", env={"PYTHONPATH": "/root/repo",
+                                   "PATH": "/usr/bin:/bin",
+                                   "JAX_PLATFORMS": "cpu",
+                                   "HOME": "/root"})
+        line = proc.stdout.readline()
+        assert "storage listening" in line, line
+        return proc
+
+    def test_kill_and_restart_with_snapshot(self, tmp_path):
+        """The reference's stateless-SQL property: storage goes away and
+        comes back; the SQL layer's session keeps working."""
+        port = self._free_port()
+        snap = str(tmp_path / "store.snap")
+        proc = self._spawn(port, snap)
+        try:
+            st = connect("127.0.0.1", port)
+            s = Session(st)
+            s.execute("CREATE DATABASE d; USE d")
+            s.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, v BIGINT)")
+            s.execute("INSERT INTO t VALUES (1, 10), (2, 20)")
+            assert s.query("SELECT SUM(v) FROM t").rows == [(30,)]
+
+            # graceful stop persists the snapshot
+            proc.send_signal(signal.SIGTERM)
+            assert proc.wait(timeout=20) == 0
+            proc = self._spawn(port, snap)
+
+            # SAME session object: reads and writes continue
+            assert s.query("SELECT SUM(v) FROM t").rows == [(30,)]
+            s.execute("INSERT INTO t VALUES (3, 12)")
+            assert s.query("SELECT SUM(v) FROM t").rows == [(42,)]
+            s.close()
+            st.close()
+        finally:
+            proc.terminate()
+            proc.wait(timeout=20)
+
+
+class TestTpchOverRemote:
+    def test_tpch_queries_through_the_wire(self, server):
+        """VERDICT acceptance: the TPC-H suite passes with storage
+        out-of-process."""
+        from tests import tpch
+        st = connect("127.0.0.1", server.port)
+        s = Session(st)
+        s.execute("CREATE DATABASE tpch; USE tpch")
+        d = tpch.TpchData()
+        tpch.load(s, d)
+        for q, truth in ((tpch.Q1, tpch.truth_q1), (tpch.Q3, tpch.truth_q3),
+                         (tpch.Q5, tpch.truth_q5), (tpch.Q4, tpch.truth_q4),
+                         (tpch.Q6, tpch.truth_q6)):
+            got = s.query(q).rows
+            want = truth(d)
+            if q is tpch.Q6:
+                assert float(got[0][0]) == pytest.approx(want)
+                continue
+            assert len(got) == len(want), (len(got), len(want))
+            for g, w in zip(got, want):
+                for x, y in zip(g, w):
+                    if isinstance(y, float):
+                        # decimal AVG columns round at the column scale
+                        assert float(x) == pytest.approx(y, rel=1e-4,
+                                                         abs=1e-6)
+                    else:
+                        assert str(x) == str(y) or x == y
+        s.close()
+        st.close()

@@ -15,7 +15,7 @@ from tidb_tpu.privilege import ALL_PRIVS
 
 __all__ = ["bootstrap", "load_global_variables", "BOOTSTRAP_VERSION"]
 
-BOOTSTRAP_VERSION = 1
+BOOTSTRAP_VERSION = 2   # v2: SUPER added to ALL_PRIVS (root re-granted)
 
 _DDL = [
     "CREATE DATABASE IF NOT EXISTS mysql",
@@ -91,6 +91,12 @@ def bootstrap(storage) -> None:
                 session.execute(
                     "INSERT INTO mysql.user VALUES "
                     f"('%', 'root', '', {ALL_PRIVS})")
+            elif ver < 2:
+                # upgradeToVer2: SUPER joined ALL_PRIVS — re-grant root
+                # (ref: bootstrap.go's versioned upgradeToVerN steps)
+                session.execute(
+                    f"UPDATE mysql.user SET privs = {ALL_PRIVS} "
+                    "WHERE user = 'root' AND host = '%'")
             if ver == 0:
                 session.execute(
                     "INSERT INTO mysql.tidb VALUES ('bootstrapped', "

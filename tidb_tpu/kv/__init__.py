@@ -92,6 +92,11 @@ class KeyLockedError(RetryableError):
         super().__init__(f"key locked by txn {lock.start_ts}")
         self.lock = lock
 
+    def __reduce__(self):
+        # errors with non-message ctor args must rebuild from them (they
+        # cross the storage-process RPC boundary, store/remote.py)
+        return (KeyLockedError, (self.lock,))
+
 
 class WriteConflictError(RetryableError):
     def __init__(self, key: bytes, start_ts: int, conflict_ts: int):
@@ -99,6 +104,10 @@ class WriteConflictError(RetryableError):
         self.key = key
         self.start_ts = start_ts
         self.conflict_ts = conflict_ts
+
+    def __reduce__(self):
+        return (WriteConflictError,
+                (self.key, self.start_ts, self.conflict_ts))
 
 
 class TxnAbortedError(KVError):
@@ -120,11 +129,17 @@ class NotLeaderError(RegionError):
         self.region_id = region_id
         self.leader_store = leader_store
 
+    def __reduce__(self):
+        return (NotLeaderError, (self.region_id, self.leader_store))
+
 
 class EpochNotMatchError(RegionError):
     def __init__(self, region_id: int):
         super().__init__(f"region {region_id}: epoch not match")
         self.region_id = region_id
+
+    def __reduce__(self):
+        return (EpochNotMatchError, (self.region_id,))
 
 
 class ServerBusyError(RetryableError):

@@ -54,6 +54,15 @@ class Cluster:
         self._tso_physical = 0
         self._tso_logical = 0
 
+    def __getstate__(self):
+        d = self.__dict__.copy()
+        d.pop("_mu", None)
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self._mu = threading.RLock()
+
     # -- ids / tso -----------------------------------------------------------
 
     def alloc_id(self) -> int:

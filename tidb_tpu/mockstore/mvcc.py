@@ -83,6 +83,17 @@ class MVCCStore:
         # the chunk-cache filler refuses to cache while this is nonempty
         self._locked_keys: set = set()
 
+    # engines snapshot to disk for the out-of-process storage node's
+    # restart path (store/remote.py); locks are recreated on load
+    def __getstate__(self):
+        d = self.__dict__.copy()
+        d.pop("_mu", None)
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self._mu = threading.RLock()
+
     # -- internal ------------------------------------------------------------
 
     def _entry(self, key: bytes) -> _Entry:

@@ -702,7 +702,7 @@ class Parser:
         return spec
 
     _PRIV_NAMES = {"SELECT", "INSERT", "UPDATE", "DELETE", "CREATE", "DROP",
-                   "ALTER", "INDEX"}
+                   "ALTER", "INDEX", "SUPER"}
 
     def grant_revoke(self, is_grant: bool) -> ast.StmtNode:
         self.next()          # GRANT / REVOKE

@@ -176,6 +176,12 @@ class Session:
         stmts = parse(sql)
         out = []
         single = sql if len(stmts) == 1 else None
+        # auth statements never expose credentials in the processlist or
+        # the slow log (the reference redacts before logging) — the WHOLE
+        # batch text is redacted if any statement in it carries one
+        if any(isinstance(s, ast.CreateUserStmt) for s in stmts):
+            sql = "<redacted: batch containing CREATE USER>" \
+                if len(stmts) > 1 else "<redacted: CREATE USER>"
         for stmt in stmts:
             out.append(self._timed_stmt(stmt, sql, sql_text=single))
         return out
@@ -183,12 +189,12 @@ class Session:
     def _timed_stmt(self, stmt, sql: str, sql_text: str | None):
         """Statement lifecycle wrapper: processlist state, duration
         metrics, slow-query log (ref: ExecStmt adapter, adapter.go:189 +
-        slow-log emit at :353)."""
+        slow-log emit at :353). Internal bookkeeping sessions skip the
+        instrumentation entirely — their catalog lookups are not client
+        queries and would pollute the metrics."""
         from tidb_tpu import config, metrics
-        # auth statements never expose credentials in the processlist or
-        # the slow log (the reference redacts before logging)
-        if isinstance(stmt, ast.CreateUserStmt):
-            sql = "<redacted: CREATE USER>"
+        if self.internal:
+            return self._run_stmt(stmt, sql_text=sql_text)
         self.current_sql = sql
         self._stmt_start = time.perf_counter()
         kind = type(stmt).__name__.removesuffix("Stmt").lower()
@@ -201,8 +207,7 @@ class Session:
             dur = time.perf_counter() - self._stmt_start
             metrics.counter(metrics.QUERIES_TOTAL, {"type": kind})
             metrics.histogram(metrics.QUERY_DURATIONS, dur)
-            if not self.internal and \
-                    dur * 1000 >= config.get_var("tidb_tpu_slow_query_ms"):
+            if dur * 1000 >= config.get_var("tidb_tpu_slow_query_ms"):
                 metrics.counter(metrics.SLOW_QUERIES)
                 slow_log.warning(
                     "slow query: %.3fs user=%s db=%s sql=%s",
@@ -481,9 +486,9 @@ class Session:
                 ast.DeleteStmt: (Priv.DELETE, "DELETE"),
             }[type(stmt)]
             target = stmt.table
-            tdb = ((target.db or self.current_db) if
+            tdb = (((target.db or self.current_db) or "") if
                    isinstance(target, ast.TableSource) else
-                   self.current_db)
+                   (self.current_db or ""))
             tname = (target.name.lower()
                      if isinstance(target, ast.TableSource) else "")
             need(tdb, tname, want, what)
@@ -491,24 +496,24 @@ class Session:
             # checks column reads; a bare UPDATE t SET a=1 needs none)
             if getattr(stmt, "where", None) is not None:
                 need(tdb, tname, Priv.SELECT, "SELECT")
-            # every OTHER table the statement touches is a read — this
-            # walks the WHOLE tree, so subqueries in WHERE / SET / VALUES
-            # and INSERT ... SELECT sources all require SELECT
-            for db, tbl in _referenced_tables(stmt):
-                db = (db or self.current_db).lower()
-                if db == tdb.lower() and tbl == tname:
-                    continue
-                need(db, tbl, Priv.SELECT, "SELECT")
-            # INSERT ... SELECT reading the target itself still needs
-            # SELECT on it (skipped by the loop above)
-            for db, tbl in _referenced_tables(getattr(stmt, "select",
-                                                      None)):
+            # every table in a READ position needs SELECT — the target
+            # included when subqueries in WHERE / SET / VALUES / ON
+            # DUPLICATE or an INSERT ... SELECT source read from it
+            read_positions = [getattr(stmt, "where", None),
+                              getattr(stmt, "select", None),
+                              getattr(stmt, "values", None),
+                              getattr(stmt, "assignments", None),
+                              getattr(stmt, "on_duplicate", None)]
+            for db, tbl in _referenced_tables(read_positions):
                 need(db or self.current_db, tbl, Priv.SELECT, "SELECT")
             return
         if isinstance(stmt, ast.SetStmt):
-            if any(getattr(a, "is_global", False)
+            from tidb_tpu import config
+            if any(getattr(a, "is_global", False) or
+                   (a.is_system and config.is_known(a.name))
                    for a in stmt.assignments):
-                # SET GLOBAL mutates process-wide state and persists
+                # SET GLOBAL — and any assignment to a registry variable,
+                # which is process-wide here — mutates shared state
                 need("", "", Priv.SUPER, "SUPER (SET GLOBAL)")
             return
         if isinstance(stmt, (ast.CreateDatabaseStmt, ast.DropDatabaseStmt)):

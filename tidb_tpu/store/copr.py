@@ -174,7 +174,9 @@ class CopClient(kv.Client):
         self.storage = storage
         self.cache = storage.region_cache
         self.shim = storage.shim
-        if self.shim._cop_handler is None:
+        # remote shims execute the coprocessor in the storage process and
+        # have no installable handler surface
+        if getattr(self.shim, "_cop_handler", "remote") is None:
             self.shim.install_cop_handler(cop_handler(storage))
 
     def send(self, req: CopRequest):
