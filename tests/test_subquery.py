@@ -140,10 +140,16 @@ class TestCorrelated:
 
 class TestExplain:
     def test_apply_in_explain(self, tk):
+        # correlated-equality EXISTS now decorrelates to a semi join
         plan = "\n".join(r[0] for r in q(
             tk, "EXPLAIN SELECT a FROM t WHERE EXISTS "
                 "(SELECT 1 FROM u WHERE u.x = t.a)"))
-        assert "Apply" in plan and "correlated" in plan
+        assert "semi" in plan and "Apply" not in plan
+        # non-equality correlation still runs through the apply path
+        plan_ne = "\n".join(r[0] for r in q(
+            tk, "EXPLAIN SELECT a FROM t WHERE EXISTS "
+                "(SELECT 1 FROM u WHERE u.x > t.a)"))
+        assert "Apply" in plan_ne and "correlated" in plan_ne
         plan2 = "\n".join(r[0] for r in q(
             tk, "EXPLAIN SELECT a FROM t WHERE b IN (SELECT y FROM u)"))
         assert "Apply" in plan2 and "uncorrelated" in plan2

@@ -734,6 +734,8 @@ class HashJoinExec(Executor):
                                      np.arange(n))
                     if out is not None:
                         yield out
+                elif plan.join_type == "anti":
+                    yield chunk            # nothing can match: all survive
                 continue
             pk = enc.transform_probe(self._eval_keys(plan.left_keys, chunk))
             if mesh_kernel is not None:
@@ -762,6 +764,14 @@ class HashJoinExec(Executor):
                 keep = eval_filter_host(plan.other_cond, pair)
                 li, ri = li[keep], ri[keep]
                 pair = pair.filter(keep)
+            if plan.join_type in ("semi", "anti"):
+                # (anti-)semi join: emit probe rows by match existence,
+                # never the joined width (ref: the semi-join family of
+                # plan/gen_physical_plans.go; decorrelated EXISTS/IN)
+                m = np.zeros(n, dtype=bool)
+                m[li] = True
+                yield chunk.filter(m if plan.join_type == "semi" else ~m)
+                continue
             matched_build[ri] = True
             unmatched = np.empty(0, np.int64)
             if plan.join_type == "left":

@@ -200,7 +200,9 @@ class PhysStreamAgg(PhysPlan):
 class PhysHashJoin(PhysPlan):
     left_keys: list = field(default_factory=list)
     right_keys: list = field(default_factory=list)
-    join_type: str = "inner"       # inner/left/right
+    # inner/left/right, plus semi/anti (decorrelated EXISTS/IN: emit
+    # probe rows by match existence, never the joined width)
+    join_type: str = "inner"
     other_cond: Optional[Expression] = None
 
     def _explain_info(self):

@@ -682,13 +682,26 @@ class Planner:
             node = node.operand
 
         if isinstance(node, ast.ExistsSubquery):
+            anti = negate != node.negated
+            dec = self._try_decorrelate(plan, node.select, anti,
+                                        in_expr=None)
+            if dec is not None:
+                return dec
             inner, corr = self._plan_subquery(plan.schema, node.select)
             return ph.PhysApply(schema=plan.schema, children=[plan],
                                 inner=inner, mode="exists",
-                                negated=negate != node.negated, corr=corr)
+                                negated=anti, corr=corr)
 
         if isinstance(node, ast.InExpr) and \
                 isinstance(node.items, ast.SubqueryExpr):
+            neg = negate != node.negated
+            if not neg:
+                # positive IN only: NOT IN has three-valued NULL
+                # semantics an anti join would get wrong
+                dec = self._try_decorrelate(plan, node.items.select,
+                                            anti=False, in_expr=node.expr)
+                if dec is not None:
+                    return dec
             inner, corr = self._plan_subquery(plan.schema,
                                               node.items.select)
             if len(inner.schema.cols) != 1:
@@ -696,7 +709,7 @@ class Planner:
             left = Resolver(plan.schema).resolve(node.expr)
             return ph.PhysApply(schema=plan.schema, children=[plan],
                                 inner=inner, mode="in",
-                                negated=negate != node.negated,
+                                negated=neg,
                                 left=left, corr=corr)
 
         if isinstance(node, ast.BinaryOp) and node.op in self._CMP_OPS:
@@ -720,6 +733,101 @@ class Planner:
                                 inner=inner, mode="cmp", negated=negate,
                                 left=left, cmp_op=op, corr=corr)
         return None
+
+    def _try_decorrelate(self, plan: ph.PhysPlan, sub_select,
+                         anti: bool, in_expr) -> ph.PhysPlan | None:
+        """Rewrite a correlated EXISTS / positive IN subquery into a
+        (anti-)semi hash join (ref: decorrelateSolver, plan/optimizer.go:
+        42-50): correlated equalities in the subquery WHERE become join
+        keys, the remainder stays as the inner filter. Returns None when
+        the shape doesn't qualify — the caller falls back to PhysApply.
+        """
+        if not isinstance(sub_select, ast.SelectStmt) or \
+                sub_select.from_clause is None or sub_select.group_by or \
+                sub_select.having is not None or \
+                sub_select.limit is not None or _contains_agg(sub_select):
+            # scalar aggregates change EXISTS/IN cardinality (one row
+            # ALWAYS exists; IN compares against a per-group value): the
+            # join rewrite cannot express them
+            return None
+        conjs = split_conjuncts(sub_select.where)
+        if not any(isinstance(c, ast.BinaryOp) and c.op == "="
+                   for c in conjs):
+            return None   # no equality: nothing can become a join key
+        # classify WHERE conjuncts: outer_expr = inner_expr pairs peel
+        # off as join keys
+        try:
+            inner_from = Planner(self.ischema, self.db,
+                                 stats_handle=self.stats).build_from(
+                sub_select.from_clause)
+        except (PlanError, ResolveError):
+            return None
+        corr_pairs: list[tuple] = []    # (outer ast, inner ast)
+        residual: list = []
+
+        def resolves(schema, e_ast) -> bool:
+            try:
+                Resolver(schema).resolve(e_ast)
+                return True
+            except (ResolveError, PlanError):
+                return False
+
+        for c in conjs:
+            if isinstance(c, ast.BinaryOp) and c.op == "=":
+                li = resolves(inner_from.schema, c.left)
+                ri = resolves(inner_from.schema, c.right)
+                lo = resolves(plan.schema, c.left)
+                ro = resolves(plan.schema, c.right)
+                if not li and lo and ri:
+                    corr_pairs.append((c.left, c.right))
+                    continue
+                if not ri and ro and li:
+                    corr_pairs.append((c.right, c.left))
+                    continue
+            residual.append(c)
+        if not corr_pairs:
+            return None
+
+        # rebuilt subquery: the IN value column (the subquery's own select
+        # item) plus the inner join-key columns become the select list;
+        # the correlated equalities are gone
+        fields = []
+        if in_expr is not None:
+            if len(sub_select.fields) != 1 or \
+                    isinstance(sub_select.fields[0].expr, ast.Star):
+                return None
+            fields.append(sub_select.fields[0])
+        for i, (_o, inner_ast) in enumerate(corr_pairs):
+            fields.append(ast.SelectField(expr=inner_ast, alias=f"_k{i}"))
+        where = None
+        for c in residual:
+            where = c if where is None else \
+                ast.BinaryOp(op="AND", left=where, right=c)
+        mod = ast.SelectStmt(fields=fields,
+                             from_clause=sub_select.from_clause,
+                             where=where)
+        try:
+            # no outer scope: any REMAINING correlation fails resolution
+            # here and we fall back to the apply path
+            inner_plan = Planner(self.ischema, self.db,
+                                 stats_handle=self.stats).plan(mod)
+        except (PlanError, ResolveError):
+            return None
+        r = Resolver(plan.schema)
+        try:
+            left_keys = ([r.resolve(in_expr)] if in_expr is not None
+                         else [])
+            left_keys += [r.resolve(o) for o, _i in corr_pairs]
+        except (ResolveError, PlanError):
+            return None
+        right_keys = [ColumnRef(i, c.ft)
+                      for i, c in enumerate(inner_plan.schema.cols)]
+        if len(left_keys) != len(right_keys):
+            return None
+        return ph.PhysHashJoin(schema=plan.schema,
+                               children=[plan, inner_plan],
+                               left_keys=left_keys, right_keys=right_keys,
+                               join_type="anti" if anti else "semi")
 
     def _plan_subquery(self, outer_schema: PlanSchema, sub_select):
         """Plan an inner SELECT with the outer schema visible for
