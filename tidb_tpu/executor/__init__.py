@@ -146,6 +146,9 @@ class TableReaderExec(Executor):
         req = CopRequest(tp=ReqType.DAG, ranges=self._ranges(), plan=cop,
                          start_ts=ctx.read_ts,
                          keep_order=getattr(self.plan, "keep_order", False))
+        if cop.feedback is not None and cop.limit is None:
+            yield from self._chunks_with_feedback(ctx, req)
+            return
         remaining = cop.limit
         for resp in ctx.storage.client().send(req):
             ch = resp.chunk
@@ -156,6 +159,23 @@ class TableReaderExec(Executor):
                     ch = ch.slice(0, remaining)
                 remaining -= ch.num_rows
             yield ch
+
+    def _chunks_with_feedback(self, ctx, req):
+        """Stream the scan while counting actual rows; report the range's
+        true cardinality to the stats handle afterwards (ref:
+        statistics/update.go:88 QueryFeedback collection at the reader)."""
+        cop = self.plan.cop
+        actual = 0
+        for resp in ctx.storage.client().send(req):
+            actual += resp.chunk.num_rows
+            yield resp.chunk
+        col_id, dranges = cop.feedback
+        try:
+            from tidb_tpu.session import Domain
+            Domain.get(ctx.storage).stats_handle().feedback_range(
+                cop.table.id, col_id, dranges, actual)
+        except Exception:   # noqa: BLE001 - feedback must never fail reads
+            pass
 
     def _decode_rows(self, rows):
         cop = self.plan.cop

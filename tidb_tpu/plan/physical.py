@@ -40,6 +40,9 @@ class CopPlan:
     limit: Optional[int] = None             # only when no aggs
     desc: bool = False
     index: Optional[IndexInfo] = None       # index scan: decode index keys
+    # (col_id, DatumRanges) of a pure pk-range scan: the reader reports
+    # actual row counts back to the stats handle (query feedback)
+    feedback: Optional[tuple] = None
 
     @property
     def is_agg(self) -> bool:

@@ -295,6 +295,13 @@ class Planner:
                                                    path.ranges[0].low[0],
                                                    None, None)
                         cop.ranges = kvr
+                        # when the ranges encode EVERY conjunct, the scan's
+                        # actual row count is exactly the range count ->
+                        # feed it back to the pk histogram
+                        if len(path.consumed) == len(conj) and \
+                                not cop.is_agg and use_cbo:
+                            pk_col = info.col_by_name(info.pk_col_name)
+                            cop.feedback = (pk_col.id, path.ranges)
                         return reader
 
         # 2. secondary-index paths (non-agg readers only: agg pushdown to

@@ -75,6 +75,8 @@ class Server:
         from tidb_tpu.bootstrap import bootstrap, load_global_variables
         bootstrap(storage)   # system catalog + root account (idempotent)
         load_global_variables(storage)
+        from tidb_tpu.session import Domain
+        Domain.get(storage).start_stats_worker()
         self._listener = socket.create_server((host, port))
         self.addr = self._listener.getsockname()
         self._tokens = threading.Semaphore(token_limit)
@@ -129,6 +131,8 @@ class Server:
 
     def close(self) -> None:
         self._closing.set()
+        from tidb_tpu.session import Domain
+        Domain.get(self.storage).stop_stats_worker()
         try:
             self._listener.close()
         except OSError:

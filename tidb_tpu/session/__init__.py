@@ -78,6 +78,57 @@ class Domain:
             self._priv = PrivilegeCache(self.storage)
         return self._priv
 
+    # -- auto analyze (ref: statistics/handle.go auto-analyze +
+    # RunAutoAnalyze wiring, tidb-server/main.go:341) -------------------------
+
+    def auto_analyze_tick(self) -> list[int]:
+        """Analyze every table whose DML delta crossed the ratio; returns
+        the analyzed table ids. Called by the background stats worker and
+        directly by tests."""
+        from tidb_tpu.statistics import analyze_table
+        handle = self.stats_handle()
+        done = []
+        for tid in handle.pending_tables():
+            located = self.info_schema().table_by_id(tid)
+            if located is None:
+                handle._deltas.pop(tid, None)   # dropped table
+                continue
+            _db, info = located
+            try:
+                stats = analyze_table(self.storage,
+                                      self.storage.current_ts(), info)
+                handle.save(stats)
+                done.append(tid)
+            except Exception:  # noqa: BLE001 - next tick retries
+                continue
+        return done
+
+    def start_stats_worker(self, interval: float = 30.0) -> None:
+        """Idempotent background auto-analyze loop."""
+        with self._mu:
+            if getattr(self, "_stats_stop", None) is not None:
+                return
+            self._stats_stop = threading.Event()
+            stop = self._stats_stop
+
+        def loop():
+            while not stop.wait(interval):
+                try:
+                    self.auto_analyze_tick()
+                except Exception:  # noqa: BLE001 - keep ticking
+                    pass
+
+        t = threading.Thread(target=loop, daemon=True,
+                             name="stats-auto-analyze")
+        t.start()
+
+    def stop_stats_worker(self) -> None:
+        with self._mu:
+            stop = getattr(self, "_stats_stop", None)
+            self._stats_stop = None
+        if stop is not None:
+            stop.set()
+
     def stats_handle(self):
         """Lazy per-store stats cache (ref: statistics/handle.go:32)."""
         if self._stats is None:

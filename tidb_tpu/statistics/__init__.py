@@ -22,6 +22,7 @@ from __future__ import annotations
 import hashlib
 import json
 import math
+import threading
 from bisect import bisect_left
 from dataclasses import dataclass, field
 
@@ -622,6 +623,8 @@ class StatsHandle:
         self._cache: dict[int, TableStats] = {}
         self._deltas: dict[int, int] = {}
         self.version = 0     # bumped on save/drop; part of plan-cache keys
+        # serializes histogram feedback writers (executor threads)
+        self._fb_mu = threading.Lock()
 
     def get(self, table_id: int) -> TableStats:
         ts = self._cache.get(table_id)
@@ -685,3 +688,75 @@ class StatsHandle:
         base = max(1, ts.count)
         return self._deltas.get(table_id, 0) / base >= \
             self.AUTO_ANALYZE_RATIO
+
+    def pending_tables(self) -> list[int]:
+        """Table ids with uncollected DML deltas (auto-analyze candidates,
+        ref: statistics/update.go:135 + handle.go auto-analyze tick).
+        Loads persisted stats first so a fresh process doesn't full-
+        analyze a huge table over a one-row delta."""
+        out = []
+        for tid in list(self._deltas):
+            self.get(tid)   # populate _cache from storage if persisted
+            if self.need_auto_analyze(tid):
+                out.append(tid)
+        return out
+
+    # -- query feedback (ref: statistics/update.go:88 QueryFeedback) ---------
+
+    FEEDBACK_MIN_FACTOR = 0.2
+    FEEDBACK_MAX_FACTOR = 5.0
+    FEEDBACK_DEADBAND = 0.25   # |factor-1| below this: estimate is fine
+
+    def feedback_range(self, table_id: int, col_id: int, dranges,
+                       actual: int) -> None:
+        """A pure range scan observed `actual` rows where the histogram
+        estimated otherwise: rescale the overlapped buckets so future
+        estimates track reality. In-memory only (like the reference's
+        feedback before its periodic dump); version-bumped so cached
+        plans re-cost."""
+        ts = self._cache.get(table_id)
+        if ts is None or ts.pseudo:
+            return
+        cs = ts.columns.get(col_id)
+        if cs is None or not cs.hist.uppers:
+            return
+        with self._fb_mu:   # one feedback writer at a time
+            est = ts.col_ranges_row_count(col_id, dranges)
+            factor = (actual + 1.0) / (est + 1.0)
+            factor = min(self.FEEDBACK_MAX_FACTOR,
+                         max(self.FEEDBACK_MIN_FACTOR, factor))
+            if abs(factor - 1.0) < self.FEEDBACK_DEADBAND:
+                return
+            hist = cs.hist
+            touched = set()
+            for r in dranges:
+                lo = r.low[0] if r.low and not r.low_unbounded else None
+                hi = r.high[0] if r.high and not r.high_unbounded else None
+                if isinstance(lo, tuple):
+                    lo = lo[1]
+                if isinstance(hi, tuple):
+                    hi = hi[1]
+                i0 = 0 if lo is None else hist._locate(lo)
+                i1 = hist.num_buckets - 1 if hi is None \
+                    else hist._locate(hi)
+                for i in range(max(0, i0),
+                               min(hist.num_buckets - 1, i1) + 1):
+                    touched.add(i)
+            if not touched:
+                return
+            incr = [hist._bucket_count(i) for i in range(hist.num_buckets)]
+            new_repeats = list(hist.repeats)
+            for i in touched:
+                incr[i] = int(round(incr[i] * factor))
+                new_repeats[i] = int(round(new_repeats[i] * factor))
+            new_counts = []
+            run = 0
+            for v in incr:
+                run += v
+                new_counts.append(run)
+            # build-then-swap: concurrent READERS always see internally
+            # consistent (monotonic) arrays
+            hist.repeats = new_repeats
+            hist.counts = new_counts
+            hist.total = run
+            self.version += 1

@@ -206,10 +206,31 @@ class CopClient(kv.Client):
                 results.put(exc)
 
         if req.keep_order:
-            # ordered: run tasks serially per index, emit in order
-            # (simple serial fallback; parallel-ordered later)
-            for _loc, rng in tasks:
-                yield from self._run_task(req, rng)
+            # ordered at FULL concurrency: tasks run in parallel, results
+            # drain strictly in task (range) order — the per-task
+            # response-channel design of coprocessor.go:342-457. A
+            # sliding window of `concurrency` in-flight tasks bounds both
+            # memory and wasted work when the consumer stops early.
+            from collections import deque
+            pool = ThreadPoolExecutor(max_workers=concurrency,
+                                      thread_name_prefix="cop-ord")
+            try:
+                it = iter(tasks)
+                window: deque = deque()
+                for _ in range(concurrency):
+                    nxt = next(it, None)
+                    if nxt is None:
+                        break
+                    window.append(pool.submit(self._run_task, req, nxt[1]))
+                while window:
+                    f = window.popleft()
+                    nxt = next(it, None)
+                    if nxt is not None:
+                        window.append(pool.submit(self._run_task, req,
+                                                  nxt[1]))
+                    yield from f.result()
+            finally:
+                pool.shutdown(wait=False, cancel_futures=True)
             return
         buckets = [tasks[i::concurrency] for i in range(concurrency)]
         pool = ThreadPoolExecutor(max_workers=concurrency,
