@@ -1,0 +1,208 @@
+"""UNION as a real chunk operator, ADMIN CHECK TABLE index-consistency
+scans, and INFORMATION_SCHEMA virtual tables."""
+
+import numpy as np
+import pytest
+
+from tidb_tpu import codec, tablecodec
+from tidb_tpu.session import Session, SQLError
+from tidb_tpu.store.storage import new_mock_storage
+
+
+@pytest.fixture
+def sess():
+    st = new_mock_storage()
+    s = Session(st)
+    s.execute("CREATE DATABASE d")
+    s.execute("USE d")
+    yield s
+    s.close()
+
+
+class TestUnionExec:
+    def _setup(self, sess):
+        sess.execute("CREATE TABLE a (id BIGINT PRIMARY KEY, v BIGINT)")
+        sess.execute("CREATE TABLE b (id BIGINT PRIMARY KEY, v BIGINT)")
+        sess.execute("INSERT INTO a VALUES (1,10),(2,20),(3,30)")
+        sess.execute("INSERT INTO b VALUES (7,20),(8,30),(9,40)")
+
+    def test_union_all_and_distinct(self, sess):
+        self._setup(sess)
+        txt = sess.plan("SELECT v FROM a UNION ALL SELECT v FROM b"
+                        ).explain()
+        assert "Union" in txt, txt
+        r = sess.query("SELECT v FROM a UNION ALL SELECT v FROM b")
+        assert sorted(x[0] for x in r.rows) == [10, 20, 20, 30, 30, 40]
+        r2 = sess.query("SELECT v FROM a UNION SELECT v FROM b")
+        assert sorted(x[0] for x in r2.rows) == [10, 20, 30, 40]
+        # DISTINCT runs through HashAgg, not a python set
+        t2 = sess.plan("SELECT v FROM a UNION SELECT v FROM b").explain()
+        assert "HashAgg" in t2, t2
+
+    def test_union_order_limit(self, sess):
+        self._setup(sess)
+        r = sess.query("SELECT v FROM a UNION ALL SELECT v FROM b "
+                       "ORDER BY v DESC LIMIT 3")
+        assert [x[0] for x in r.rows] == [40, 30, 30]
+
+    def test_mixed_all_distinct_mysql_rule(self, sess):
+        self._setup(sess)
+        # the DISTINCT union dedups everything to its left; the trailing
+        # ALL branch appends raw
+        r = sess.query("SELECT v FROM a UNION SELECT v FROM b "
+                       "UNION ALL SELECT v FROM b")
+        got = sorted(x[0] for x in r.rows)
+        assert got == [10, 20, 20, 30, 30, 40, 40]
+
+    def test_type_widening(self, sess):
+        sess.execute("CREATE TABLE c (id BIGINT PRIMARY KEY, "
+                     "d DECIMAL(8,2))")
+        sess.execute("INSERT INTO c VALUES (1, 1.50)")
+        self._setup(sess)
+        r = sess.query("SELECT v FROM a UNION ALL SELECT d FROM c")
+        vals = sorted(float(x[0]) for x in r.rows)
+        assert vals == [1.5, 10.0, 20.0, 30.0]
+
+    def test_union_large_cardinality(self, sess):
+        from tidb_tpu.table import Table, bulkload
+        sess.execute("CREATE TABLE big1 (id BIGINT PRIMARY KEY, "
+                     "v BIGINT)")
+        sess.execute("CREATE TABLE big2 (id BIGINT PRIMARY KEY, "
+                     "v BIGINT)")
+        n = 30000
+        for name, off in (("big1", 0), ("big2", n // 2)):
+            tbl = Table(sess.domain.info_schema().table("d", name),
+                        sess.storage)
+            bulkload.bulk_load(sess.storage, tbl, {
+                "id": np.arange(n, dtype=np.int64),
+                "v": np.arange(off, off + n, dtype=np.int64)})
+        r = sess.query("SELECT COUNT(*) FROM (SELECT v FROM big1 UNION "
+                       "SELECT v FROM big2) u")
+        assert r.rows[0][0] == n + n // 2
+
+
+class TestAdminCheck:
+    def test_consistent_table_passes(self, sess):
+        sess.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, k BIGINT)")
+        sess.execute("CREATE INDEX ik ON t (k)")
+        sess.execute("INSERT INTO t VALUES " + ",".join(
+            f"({i},{i % 5})" for i in range(200)))
+        r = sess.execute("ADMIN CHECK TABLE t")[0]
+        assert r.rows == [("check passed",)]
+
+    def test_missing_index_entry_detected(self, sess):
+        sess.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, k BIGINT)")
+        sess.execute("CREATE INDEX ik ON t (k)")
+        sess.execute("INSERT INTO t VALUES (1, 7), (2, 8)")
+        info = sess.domain.info_schema().table("d", "t")
+        idx = info.indexes[0]
+        # surgically delete one index entry behind SQL's back
+        ik = tablecodec.index_key(info.id, idx.id, [7], handle=1)
+        txn = sess.storage.begin()
+        txn.delete(ik)
+        txn.commit()
+        sess.storage.chunk_cache.clear()
+        with pytest.raises(SQLError, match="admin check"):
+            sess.execute("ADMIN CHECK TABLE t")
+
+    def test_dangling_index_entry_detected(self, sess):
+        sess.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, k BIGINT)")
+        sess.execute("CREATE INDEX ik ON t (k)")
+        sess.execute("INSERT INTO t VALUES (1, 7)")
+        info = sess.domain.info_schema().table("d", "t")
+        idx = info.indexes[0]
+        ik = tablecodec.index_key(info.id, idx.id, [9], handle=99)
+        txn = sess.storage.begin()
+        txn.set(ik, b"0")
+        txn.commit()
+        with pytest.raises(SQLError, match="admin check"):
+            sess.execute("ADMIN CHECK TABLE t")
+
+    def test_admin_show_ddl(self, sess):
+        r = sess.execute("ADMIN SHOW DDL")[0]
+        assert r.columns[0] == "SCHEMA_VER"
+        assert r.rows[0][0] >= 1
+
+
+class TestInformationSchema:
+    def test_schemata_tables_columns(self, sess):
+        sess.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, "
+                     "name VARCHAR(20), amt DECIMAL(10,2))")
+        sess.execute("CREATE INDEX iname ON t (name)")
+        r = sess.query("SELECT schema_name FROM "
+                       "information_schema.schemata ORDER BY schema_name")
+        names = [x[0] for x in r.rows]
+        assert "d" in names and "information_schema" in names
+        r2 = sess.query(
+            "SELECT table_name FROM information_schema.tables "
+            "WHERE table_schema = 'd'")
+        assert [x[0] for x in r2.rows] == ["t"]
+        r3 = sess.query(
+            "SELECT column_name, data_type, column_key FROM "
+            "information_schema.columns WHERE table_name = 't' "
+            "ORDER BY ordinal_position")
+        assert r3.rows == [("id", "bigint", "PRI"),
+                           ("name", "varchar", ""),
+                           ("amt", "decimal", "")]
+        r4 = sess.query(
+            "SELECT index_name, column_name FROM "
+            "information_schema.statistics WHERE table_name = 't' "
+            "AND index_name <> 'PRIMARY'")
+        assert r4.rows == [("iname", "name")]
+
+    def test_use_and_world_readable(self, sess):
+        from tidb_tpu.bootstrap import bootstrap
+        bootstrap(sess.storage)
+        sess.execute("CREATE USER nobody")
+        nb = Session(sess.storage, user="nobody", host="h")
+        nb.execute("USE information_schema")
+        r = nb.query("SELECT COUNT(*) FROM schemata")
+        assert r.rows[0][0] >= 2
+        nb.close()
+
+    def test_unknown_memtable_errors(self, sess):
+        with pytest.raises(SQLError, match="information_schema"):
+            sess.query("SELECT * FROM information_schema.nope")
+
+
+class TestReviewRegressions:
+    def test_parenthesized_union_branch(self, sess):
+        sess.execute("CREATE TABLE a (id BIGINT PRIMARY KEY, v BIGINT)")
+        sess.execute("INSERT INTO a VALUES (1,10),(2,20)")
+        r = sess.query("(SELECT v FROM a UNION SELECT v FROM a) "
+                       "UNION ALL SELECT v FROM a")
+        assert sorted(x[0] for x in r.rows) == [10, 10, 20, 20]
+
+    def test_parenthesized_branch_keeps_its_limit(self, sess):
+        sess.execute("CREATE TABLE a (id BIGINT PRIMARY KEY, v BIGINT)")
+        sess.execute("INSERT INTO a VALUES (1,10),(2,20)")
+        r = sess.query("SELECT v FROM a UNION ALL "
+                       "(SELECT v FROM a ORDER BY v DESC LIMIT 1)")
+        assert sorted(x[0] for x in r.rows) == [10, 20, 20]
+
+    def test_mixed_string_numeric_union(self, sess):
+        r = sess.query("SELECT 1 UNION ALL SELECT 'abc'")
+        assert sorted(str(x[0]) for x in r.rows) == ["1", "abc"]
+
+    def test_show_tables_in_information_schema(self, sess):
+        sess.execute("USE information_schema")
+        r = sess.query("SHOW TABLES")
+        assert ("tables",) in r.rows and ("columns",) in r.rows
+        with pytest.raises(SQLError):
+            sess.query("SHOW TABLES FROM no_such_db")
+
+    def test_stale_value_index_entry_detected(self, sess):
+        sess.execute("USE d")
+        sess.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, k BIGINT)")
+        sess.execute("CREATE INDEX ik ON t (k)")
+        sess.execute("INSERT INTO t VALUES (1, 7)")
+        info = sess.domain.info_schema().table("d", "t")
+        idx = info.indexes[0]
+        # swap the index entry for a stale value: counts still match
+        txn = sess.storage.begin()
+        txn.delete(tablecodec.index_key(info.id, idx.id, [7], handle=1))
+        txn.set(tablecodec.index_key(info.id, idx.id, [8], handle=1), b"0")
+        txn.commit()
+        sess.storage.chunk_cache.clear()
+        with pytest.raises(SQLError, match="admin check"):
+            sess.execute("ADMIN CHECK TABLE t")

@@ -1456,6 +1456,62 @@ def _mesh_lookup_agg_builder(plan):
 
 from tidb_tpu.plan import mesh_route as _mr  # noqa: E402
 
+class UnionExec(Executor):
+    """UNION ALL over chunk streams: children run in order, their chunks
+    pass through with columns coerced to the union's output types
+    (numeric widening; names from the first branch). DISTINCT is a
+    HashAgg the planner layers on top — no row-level Python dedup."""
+
+    def __init__(self, plan: ph.PhysUnion):
+        self.plan = plan
+        self.schema = plan.schema
+        self.children = [build_executor(c) for c in plan.children]
+
+    @staticmethod
+    def _coerce(c: Column, ft) -> Column:
+        d, src = c.data, c.ft
+        if ft.eval_type == EvalType.STRING and \
+                src.eval_type != EvalType.STRING:
+            # mixed string/numeric union: MySQL coerces to string
+            from tidb_tpu.sqltypes import (format_datetime,
+                                           scaled_to_decimal)
+            if src.eval_type == EvalType.DECIMAL:
+                vals = [str(scaled_to_decimal(int(x), src.frac))
+                        for x in d]
+            elif src.eval_type == EvalType.DATETIME:
+                vals = [format_datetime(int(x), src.tp) for x in d]
+            elif d.dtype == np.float64:
+                vals = [repr(float(x)) for x in d]
+            else:
+                vals = [str(int(x)) for x in d]
+            return Column(ft, np.array(vals, dtype=object),
+                          c.valid.copy())
+        if ft.eval_type == EvalType.DECIMAL:
+            if src.eval_type == EvalType.DECIMAL:
+                if ft.frac > src.frac:
+                    d = d.astype(np.int64) * np.int64(
+                        10 ** (ft.frac - src.frac))
+            elif src.eval_type == EvalType.INT:
+                d = d.astype(np.int64) * np.int64(10 ** ft.frac)
+        elif ft.eval_type == EvalType.REAL:
+            if src.eval_type == EvalType.DECIMAL:
+                d = d.astype(np.float64) / (10.0 ** src.frac)
+            elif d.dtype != np.float64 and d.dtype != np.dtype(object):
+                d = d.astype(np.float64)
+        else:
+            want = np_dtype_for(ft.tp)
+            if d.dtype != want:
+                d = d.astype(want)
+        return Column(ft, d, c.valid.copy())
+
+    def chunks(self, ctx):
+        fts = [c.ft for c in self.schema.cols]
+        for child in self.children:
+            for chunk in child.chunks(ctx):
+                yield Chunk([self._coerce(c, ft)
+                             for c, ft in zip(chunk.columns, fts)])
+
+
 _BUILDERS = {
     _mr.PhysMeshAgg: _mesh_agg_builder,
     _mr.PhysMeshLookupAgg: _mesh_lookup_agg_builder,
@@ -1464,6 +1520,7 @@ _BUILDERS = {
     ph.PhysIndexReader: IndexReaderExec,
     ph.PhysIndexLookUp: IndexLookUpExec,
     ph.PhysPointGet: PointGetExec,
+    ph.PhysUnion: UnionExec,
     ph.PhysValues: ValuesExec,
     ph.PhysFinalAgg: FinalAggExec,
     ph.PhysHashAgg: HashAggExec,

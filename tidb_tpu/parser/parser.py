@@ -239,12 +239,26 @@ class Parser:
             u.order_by = self.by_list()
         if self.try_kw("LIMIT"):
             u.limit, u.offset = self.limit_clause()
+        # MySQL: a trailing ORDER BY / LIMIT binds to the WHOLE union, not
+        # the final branch (select_core consumed it while parsing the
+        # last SELECT) — hoist it up when the union carries none
+        last = selects[-1]
+        if not u.order_by and u.limit is None and \
+                isinstance(last, ast.SelectStmt) and \
+                not getattr(last, "_parenthesized", False) and \
+                (last.order_by or last.limit is not None):
+            u.order_by, last.order_by = last.order_by, []
+            u.limit, u.offset = last.limit, last.offset
+            last.limit, last.offset = None, 0
         return u
 
     def select_core(self) -> ast.SelectStmt:
         if self.try_op("("):
             s = self.select_or_union()
             self.expect_op(")")
+            # parenthesized branches keep their own ORDER BY / LIMIT
+            # (select_or_union's union-level hoist must skip them)
+            s._parenthesized = True
             return s
         self.expect_kw("SELECT")
         s = ast.SelectStmt()

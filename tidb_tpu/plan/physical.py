@@ -312,6 +312,17 @@ class PhysValues(PhysPlan):
 
 
 @dataclass
+class PhysUnion(PhysPlan):
+    """UNION ALL of the children's chunk streams (column types unified to
+    the schema's; DISTINCT is a HashAgg grouped on every column layered
+    on top by the planner — ref: executor/union handling via builder.go
+    UnionExec)."""
+
+    def _explain_info(self):
+        return f" branches:{len(self.children)}"
+
+
+@dataclass
 class PhysInsert(PhysPlan):
     table: TableInfo = None
     columns: list = field(default_factory=list)     # column names, in order

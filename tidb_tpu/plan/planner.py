@@ -86,7 +86,7 @@ class Planner:
     # -- entry ---------------------------------------------------------------
 
     def plan(self, stmt: ast.StmtNode) -> ph.PhysPlan:
-        if isinstance(stmt, ast.SelectStmt):
+        if isinstance(stmt, (ast.SelectStmt, ast.UnionStmt)):
             from tidb_tpu.plan.resolver import (mark_volatile,
                                                 reset_volatile, was_volatile)
             # The volatile flag is process-global; a nested plan() (sub-
@@ -100,8 +100,10 @@ class Planner:
             # mesh routing first: the fused mesh operators subsume the
             # algorithm choice below (and handle capacity escalation
             # themselves); the physical pass then optimizes what remains
-            p = self._opt_physical(route_mesh(
-                self._opt_access(self.plan_select(stmt))))
+            built = self.plan_union(stmt) \
+                if isinstance(stmt, ast.UnionStmt) \
+                else self.plan_select(stmt)
+            p = self._opt_physical(route_mesh(self._opt_access(built)))
             p.cacheable = not was_volatile()
             if outer_volatile:
                 mark_volatile()
@@ -132,7 +134,10 @@ class Planner:
         except SchemaError as e:
             raise PlanError(str(e)) from None
 
-    def build_reader(self, ts: ast.TableSource) -> ph.PhysTableReader:
+    def build_reader(self, ts: ast.TableSource) -> ph.PhysPlan:
+        db = (ts.db or self.db).lower()
+        if db == "information_schema":
+            return self._build_memtable(ts)
         _db, info = self._table_info(ts)
         cols = info.public_columns()
         schema = PlanSchema([
@@ -141,11 +146,90 @@ class Planner:
         cop = ph.CopPlan(table=info, cols=list(cols))
         return ph.PhysTableReader(schema=schema, cop=cop)
 
+    # -- INFORMATION_SCHEMA virtual tables (ref: infoschema/tables.go) -------
+
+    _MEMTABLES = ("schemata", "tables", "columns", "statistics")
+
+    def _build_memtable(self, ts: ast.TableSource) -> ph.PhysValues:
+        """Serve catalog metadata as constant rows computed from the
+        current schema snapshot (the TableScanExec-over-memtable role of
+        executor.go:803-912 + infoschema/tables.go)."""
+        from tidb_tpu.schema.model import SchemaState
+        from tidb_tpu.sqltypes import (new_int_field, new_string_field)
+        name = ts.name.lower()
+        alias = ts.ref_name.lower()
+        sf, intf = new_string_field(64), new_int_field()
+
+        def mk(cols_spec, rows):
+            schema = PlanSchema([SchemaCol(n, alias, ft)
+                                 for n, ft in cols_spec])
+            const_rows = []
+            for r in rows:
+                exprs = []
+                for v, (_n, ft) in zip(r, cols_spec):
+                    exprs.append(Constant(v, ft))
+                const_rows.append(exprs)
+            return ph.PhysValues(schema=schema, rows=const_rows)
+
+        isch = self.ischema
+        if name == "schemata":
+            return mk([("catalog_name", sf), ("schema_name", sf)],
+                      [("def", d) for d in
+                       ["information_schema"] + isch.db_names()])
+        if name == "tables":
+            rows = []
+            for d in isch.db_names():
+                for t in isch.table_names(d):
+                    info = isch.table(d, t)
+                    rows.append(("def", d, t, "BASE TABLE", info.id))
+            return mk([("table_catalog", sf), ("table_schema", sf),
+                       ("table_name", sf), ("table_type", sf),
+                       ("tidb_table_id", intf)], rows)
+        if name == "columns":
+            rows = []
+            for d in isch.db_names():
+                for t in isch.table_names(d):
+                    info = isch.table(d, t)
+                    for pos, c in enumerate(info.public_columns(), 1):
+                        key = "PRI" if (info.pk_is_handle and
+                                        c.name == info.pk_col_name) else ""
+                        rows.append((d, t, c.name.lower(), pos,
+                                     _type_word(c.ft),
+                                     "NO" if c.ft.not_null else "YES",
+                                     key))
+            return mk([("table_schema", sf), ("table_name", sf),
+                       ("column_name", sf), ("ordinal_position", intf),
+                       ("data_type", sf), ("is_nullable", sf),
+                       ("column_key", sf)], rows)
+        if name == "statistics":
+            rows = []
+            for d in isch.db_names():
+                for t in isch.table_names(d):
+                    info = isch.table(d, t)
+                    if info.pk_is_handle and info.pk_col_name:
+                        rows.append((d, t, 0, "PRIMARY", 1,
+                                     info.pk_col_name.lower()))
+                    for idx in info.indexes:
+                        if idx.state != SchemaState.PUBLIC:
+                            continue
+                        for seq, cn in enumerate(idx.columns, 1):
+                            rows.append((d, t, 0 if idx.unique else 1,
+                                         idx.name.lower(), seq,
+                                         cn.lower()))
+            return mk([("table_schema", sf), ("table_name", sf),
+                       ("non_unique", intf), ("index_name", sf),
+                       ("seq_in_index", intf), ("column_name", sf)], rows)
+        raise PlanError(
+            f"Unknown table 'information_schema.{ts.name}' "
+            f"(available: {', '.join(self._MEMTABLES)})")
+
     def build_from(self, node) -> ph.PhysPlan:
         if isinstance(node, ast.TableSource):
             return self.build_reader(node)
         if isinstance(node, ast.SubqueryTable):
-            sub = self.plan_select(node.select)
+            sub = self.plan_union(node.select) \
+                if isinstance(node.select, ast.UnionStmt) \
+                else self.plan_select(node.select)
             alias = node.alias.lower()
             schema = PlanSchema([
                 SchemaCol(c.name, alias, c.ft) for c in sub.schema.cols])
@@ -656,6 +740,66 @@ class Planner:
         return ph.PhysProjection(schema=out_schema, children=[plan],
                                  exprs=proj_exprs)
 
+    # -- UNION ---------------------------------------------------------------
+
+    def plan_union(self, stmt: ast.UnionStmt) -> ph.PhysPlan:
+        """UNION as a real operator tree (ref: builder.go UnionExec):
+        branches stream through PhysUnion; MySQL's mixed ALL/DISTINCT
+        rule applies — a DISTINCT union dedups everything to its left —
+        via one HashAgg grouped on every output column."""
+        sels = [self.plan_union(s) if isinstance(s, ast.UnionStmt)
+                else self.plan_select(s) for s in stmt.selects]
+        width = len(sels[0].schema)
+        for s in sels[1:]:
+            if len(s.schema) != width:
+                raise PlanError(
+                    "The used SELECT statements have a different number "
+                    "of columns")
+        out_cols = []
+        for i in range(width):
+            fts = [s.schema.cols[i].ft for s in sels]
+            out_cols.append(SchemaCol(sels[0].schema.cols[i].name, "",
+                                      _union_ft(fts)))
+        out_schema = PlanSchema(out_cols)
+
+        def union_of(children):
+            return ph.PhysUnion(schema=out_schema, children=list(children))
+
+        distinct_idx = [i for i, a in enumerate(stmt.alls) if not a]
+        if distinct_idx:
+            k = distinct_idx[-1] + 2     # branches covered by the dedup
+            head = union_of(sels[:k])
+            gexprs = [ColumnRef(i, c.ft) for i, c in enumerate(out_cols)]
+            dedup = ph.PhysHashAgg(schema=out_schema, children=[head],
+                                   group_exprs=gexprs, aggs=[])
+            plan = union_of([dedup] + sels[k:]) if k < len(sels) else dedup
+        else:
+            plan = union_of(sels)
+
+        if stmt.order_by:
+            by = []
+            for bi in stmt.order_by:
+                target = bi.expr
+                if isinstance(target, ast.Literal) and \
+                        isinstance(target.value, int) and \
+                        1 <= target.value <= width:
+                    oi = target.value - 1
+                elif isinstance(target, ast.ColName) and not target.table:
+                    oi = out_schema.find(target.name.lower())
+                else:
+                    raise PlanError("UNION ORDER BY must name output "
+                                    "columns")
+                by.append((ColumnRef(oi, out_cols[oi].ft), bi.desc))
+            if stmt.limit is not None:
+                return ph.PhysTopN(schema=out_schema, children=[plan],
+                                   by=by, count=stmt.limit,
+                                   offset=stmt.offset)
+            plan = ph.PhysSort(schema=out_schema, children=[plan], by=by)
+        elif stmt.limit is not None:
+            plan = ph.PhysLimit(schema=out_schema, children=[plan],
+                                count=stmt.limit, offset=stmt.offset)
+        return plan
+
     def _plan_select_no_from(self, stmt: ast.SelectStmt) -> ph.PhysPlan:
         r = Resolver(PlanSchema([]))
         exprs, names = [], []
@@ -1046,6 +1190,37 @@ class Planner:
     def plan_delete(self, stmt: ast.DeleteStmt) -> ph.PhysDelete:
         info, reader = self._plan_writable_reader(stmt.table, stmt.where)
         return ph.PhysDelete(table=info, reader=reader)
+
+
+def _type_word(ft) -> str:
+    from tidb_tpu.sqltypes import TypeCode
+    return {TypeCode.LONGLONG: "bigint", TypeCode.LONG: "int",
+            TypeCode.DOUBLE: "double", TypeCode.NEWDECIMAL: "decimal",
+            TypeCode.VARCHAR: "varchar", TypeCode.STRING: "char",
+            TypeCode.DATE: "date", TypeCode.DATETIME: "datetime",
+            TypeCode.TIMESTAMP: "timestamp"}.get(ft.tp, "unknown")
+
+
+def _union_ft(fts):
+    """Unified output type of one UNION column position: numeric widening
+    (int < decimal < real); any other mix coerces to string (MySQL)."""
+    from tidb_tpu.sqltypes import (EvalType, new_decimal_field,
+                                   new_double_field, new_string_field)
+    ets = [ft.eval_type for ft in fts]
+    if all(e == ets[0] for e in ets):
+        if ets[0] == EvalType.DECIMAL:
+            frac = max(ft.frac for ft in fts)
+            flen = max(ft.flen for ft in fts)
+            return new_decimal_field(flen, frac)
+        return fts[0]
+    numeric = {EvalType.INT, EvalType.REAL, EvalType.DECIMAL}
+    if all(e in numeric for e in ets):
+        if EvalType.REAL in ets:
+            return new_double_field()
+        frac = max(ft.frac for ft in fts
+                   if ft.eval_type == EvalType.DECIMAL)
+        return new_decimal_field(30, frac)
+    return new_string_field(255)
 
 
 def _contains_agg(stmt: ast.SelectStmt) -> bool:

@@ -15,7 +15,7 @@ import time
 import weakref
 from dataclasses import dataclass, field
 
-from tidb_tpu import kv
+from tidb_tpu import kv, tablecodec
 from tidb_tpu.executor import (ExecContext, ExecError, build_executor)
 from tidb_tpu.ddl import DDLExecutor
 from tidb_tpu.meta import Meta
@@ -23,7 +23,7 @@ from tidb_tpu.parser import ParseError, ast, parse
 from tidb_tpu.plan import Planner
 from tidb_tpu.plan.planner import PlanError
 from tidb_tpu.plan.resolver import ResolveError
-from tidb_tpu.schema.infoschema import InfoSchema
+from tidb_tpu.schema.infoschema import InfoSchema, SchemaError
 from tidb_tpu.sqltypes import (EvalType, TypeCode, format_datetime,
                                scaled_to_decimal)
 
@@ -467,7 +467,8 @@ class Session:
             return None
         if isinstance(stmt, ast.UseStmt):
             ischema = self.domain.info_schema()
-            if not ischema.has_db(stmt.db):
+            if stmt.db.lower() != "information_schema" and \
+                    not ischema.has_db(stmt.db):
                 raise SQLError(f"Unknown database '{stmt.db}'")
             self.current_db = stmt.db
             return None
@@ -491,8 +492,71 @@ class Session:
         if isinstance(stmt, ast.AnalyzeStmt):
             return self._exec_analyze(stmt)
         if isinstance(stmt, ast.AdminStmt):
-            return ResultSet(columns=["info"], rows=[])
+            return self._exec_admin(stmt)
         raise SQLError(f"unsupported statement {t}")
+
+    # -- ADMIN (ref: util/admin/admin.go:42 GetDDLInfo, :231
+    # CheckRecordAndIndex / CheckIndicesCount) -------------------------------
+
+    def _exec_admin(self, stmt: ast.AdminStmt) -> ResultSet:
+        if stmt.tp == "show_ddl":
+            txn = self.storage.begin()
+            try:
+                m = Meta(txn)
+                ver = m.schema_version()
+            finally:
+                txn.rollback()
+            return ResultSet(["SCHEMA_VER", "OWNER", "SELF_ID"],
+                             [(ver, "self", "self")])
+        if stmt.tp != "check_table":
+            return ResultSet(columns=["info"], rows=[])
+        from tidb_tpu import codec as _codec
+        from tidb_tpu.schema.model import SchemaState
+        snap = self.storage.snapshot(self.storage.current_ts())
+        for ts in stmt.tables:
+            info = self._resolve_table(ts)
+            lo, hi = tablecodec.table_prefix_range(info.id)
+            rp = tablecodec.record_prefix(info.id)
+            rows: dict[int, dict] = {}            # handle -> {col_id: datum}
+            actual: dict[int, set] = {}           # idx_id -> {(key, value)}
+            for k, v in snap.iter_range(lo, hi):
+                if k.startswith(rp):
+                    h = tablecodec.decode_record_key(k)[1]
+                    rows[h] = tablecodec.decode_row(v)
+                    continue
+                try:
+                    _tid, iid, _suffix = tablecodec.decode_index_key(k)
+                except ValueError:
+                    continue
+                actual.setdefault(iid, set()).add((k, v))
+            for idx in info.indexes:
+                if idx.state != SchemaState.PUBLIC:
+                    continue
+                # expected entries recomputed from the ROW VALUES, so
+                # stale-value index corruption is caught, not just
+                # count/handle drift (ref: admin.go CheckRecordAndIndex)
+                expect: set = set()
+                col_ids = [info.col_by_name(c).id for c in idx.columns]
+                for h, rowvals in rows.items():
+                    vals = [rowvals.get(cid) for cid in col_ids]
+                    if idx.unique and all(x is not None for x in vals):
+                        expect.add((
+                            tablecodec.index_key(info.id, idx.id, vals),
+                            _codec.encode_int(h)))
+                    else:
+                        expect.add((
+                            tablecodec.index_key(info.id, idx.id, vals,
+                                                 handle=h), b"0"))
+                got = actual.get(idx.id, set())
+                if got != expect:
+                    missing = len(expect - got)
+                    extra = len(got - expect)
+                    raise SQLError(
+                        f"admin check table {info.name} index "
+                        f"{idx.name}: {missing} missing and {extra} "
+                        f"unexpected index entries")
+        return ResultSet(columns=["info"],
+                         rows=[("check passed",)])
 
     # -- privileges (ref: privilege/privileges/privileges.go:56
     # RequestVerification, wired at plan time via visitInfo in the
@@ -527,7 +591,10 @@ class Session:
         if isinstance(stmt, (ast.SelectStmt, ast.UnionStmt,
                              ast.AnalyzeStmt)):
             for db, tbl in _referenced_tables(stmt):
-                need(db or self.current_db, tbl, Priv.SELECT, "SELECT")
+                db = (db or self.current_db or "").lower()
+                if db == "information_schema":
+                    continue   # catalog metadata is world-readable
+                need(db, tbl, Priv.SELECT, "SELECT")
             return
         if isinstance(stmt, (ast.InsertStmt, ast.UpdateStmt,
                              ast.DeleteStmt)):
@@ -689,11 +756,10 @@ class Session:
                        stats_handle=self.domain.stats_handle())
 
     def _exec_query(self, stmt, sql_text: str | None = None) -> ResultSet:
-        if isinstance(stmt, ast.UnionStmt):
-            return self._exec_union(stmt)
         plan = None
         cache_key = None
-        if sql_text is not None and isinstance(stmt, ast.SelectStmt):
+        if sql_text is not None and isinstance(stmt, (ast.SelectStmt,
+                                                      ast.UnionStmt)):
             from tidb_tpu.parallel import config as mesh_config
             cache_key = (sql_text, self.current_db,
                          self.domain.info_schema().version,
@@ -719,26 +785,6 @@ class Session:
             rows.extend(_format_chunk(ch))
         return ResultSet(columns=names, rows=rows,
                          field_types=[c.ft for c in plan.schema.cols])
-
-    def _exec_union(self, stmt: ast.UnionStmt) -> ResultSet:
-        results = [self._exec_query(s) for s in stmt.selects]
-        rows = list(results[0].rows)
-        for i, r in enumerate(results[1:]):
-            if len(r.columns) != len(results[0].columns):
-                raise SQLError("UNION column count mismatch")
-            rows.extend(r.rows)
-            if not stmt.alls[i]:
-                seen = []
-                dedup = set()
-                for row in rows:
-                    if row not in dedup:
-                        dedup.add(row)
-                        seen.append(row)
-                rows = seen
-        if stmt.limit is not None:
-            rows = rows[stmt.offset:stmt.offset + stmt.limit]
-        return ResultSet(columns=results[0].columns, rows=rows,
-                         field_types=results[0].field_types)
 
     # -- DML -----------------------------------------------------------------
 
@@ -848,8 +894,16 @@ class Session:
                              [(n,) for n in ischema.db_names()])
         if stmt.tp == "tables":
             db = stmt.db or self.current_db
+            if db.lower() == "information_schema":
+                from tidb_tpu.plan.planner import Planner as _P
+                return ResultSet([f"Tables_in_{db}"],
+                                 [(n,) for n in _P._MEMTABLES])
+            try:
+                names = ischema.table_names(db)
+            except SchemaError as e:
+                raise SQLError(str(e)) from None
             return ResultSet([f"Tables_in_{db}"],
-                             [(n,) for n in ischema.table_names(db)])
+                             [(n,) for n in names])
         if stmt.tp == "columns":
             db = stmt.table.db or self.current_db
             t = ischema.table(db, stmt.table.name)
