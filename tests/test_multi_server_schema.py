@@ -1,0 +1,156 @@
+"""Multi-server schema plane: lease-based DDL owner election, schema
+version publication + convergence, cross-server DDL execution (ref:
+owner/manager.go:40-53, ddl/syncer.go:58-78, domain/schema_validator.go).
+
+Two RemoteStorage clients to one storage process = two SQL servers with
+independent Domains — the reference's multi-tidb-server topology."""
+
+import time
+
+import pytest
+
+from tidb_tpu.owner import OwnerManager
+from tidb_tpu.session import Domain, Session
+from tidb_tpu.store.remote import StorageServer, connect
+from tidb_tpu.store.storage import new_mock_storage
+
+
+class TestOwnerElection:
+    def test_single_campaigner_wins_and_renews(self):
+        st = new_mock_storage()
+        a = OwnerManager(st, lease_ms=200)
+        assert a.campaign()
+        assert a.is_owner()
+        assert a.campaign()          # renewal
+
+    def test_second_campaigner_loses_until_lease_expires(self):
+        st = new_mock_storage()
+        a = OwnerManager(st, lease_ms=150)
+        b = OwnerManager(st, lease_ms=150)
+        assert a.campaign()
+        assert not b.campaign()
+        assert b.owner_id() == a.id
+        time.sleep(0.2)              # lease expires
+        assert b.campaign()
+        assert b.is_owner() and not a.is_owner()
+
+    def test_resign_hands_over(self):
+        st = new_mock_storage()
+        a = OwnerManager(st, lease_ms=10_000)
+        b = OwnerManager(st, lease_ms=10_000)
+        assert a.campaign()
+        a.resign()
+        assert b.campaign()
+
+
+class TestTwoServers:
+    @pytest.fixture
+    def cluster(self):
+        srv = StorageServer()
+        srv.start()
+        st_a = connect("127.0.0.1", srv.port)
+        st_b = connect("127.0.0.1", srv.port)
+        sa, sb = Session(st_a), Session(st_b)
+        dom_a, dom_b = sa.domain, sb.domain
+        dom_a.SCHEMA_LEASE_MS = dom_b.SCHEMA_LEASE_MS = 300
+        yield sa, sb, dom_a, dom_b
+        dom_a.stop_schema_worker()
+        dom_b.stop_schema_worker()
+        sa.close()
+        sb.close()
+        st_a.close()
+        st_b.close()
+        srv.close()
+
+    def test_ddl_on_b_runs_on_owner_a_and_is_visible(self, cluster):
+        sa, sb, dom_a, dom_b = cluster
+        # A becomes the standing owner with a live worker loop
+        dom_a.start_schema_worker(interval=0.05)
+        deadline = time.time() + 2
+        while not dom_a.ddl_owner().is_owner() and time.time() < deadline:
+            time.sleep(0.02)
+        assert dom_a.ddl_owner().is_owner()
+        # DDL submitted on B: B loses the campaign, the job runs on A's
+        # worker, B waits for history and proceeds
+        sb.execute("CREATE DATABASE d")
+        sb.execute("CREATE TABLE d.t (id BIGINT PRIMARY KEY, v BIGINT)")
+        sb.execute("INSERT INTO d.t VALUES (1, 5)")
+        assert sb.query("SELECT v FROM d.t").rows == [(5,)]
+        # visible on A within the lease window (fresh snapshot read)
+        assert sa.query("SELECT v FROM d.t").rows == [(5,)]
+
+    def test_owner_failover(self, cluster):
+        sa, sb, dom_a, dom_b = cluster
+        dom_a.start_schema_worker(interval=0.05)
+        deadline = time.time() + 2
+        while not dom_a.ddl_owner().is_owner() and time.time() < deadline:
+            time.sleep(0.02)
+        sb.execute("CREATE DATABASE d1")
+        # A dies (worker stopped, lease expires) -> B's next DDL campaigns
+        # and runs locally
+        dom_a.stop_schema_worker()
+        time.sleep(0.4)
+        sb.execute("CREATE DATABASE d2")
+        assert sb.domain.ddl_owner().is_owner()
+        names = [r[0] for r in sb.query("SHOW DATABASES").rows]
+        assert "d1" in names and "d2" in names
+
+    def test_schema_version_publication_and_convergence(self, cluster):
+        sa, sb, dom_a, dom_b = cluster
+        sa.execute("CREATE DATABASE seed")   # version > 0
+        dom_b.publish_schema_version()
+        vers = dom_a.live_schema_versions()
+        assert dom_b.ddl_owner().id in vers
+        # B is up to date -> convergence immediate
+        assert dom_a.wait_schema_convergence(
+            dom_b.info_schema().version, timeout_ms=300)
+        # a lagging live publisher (stale version, unexpired lease) bounds
+        # the owner's wait at the cap instead of hanging
+        import json
+        key = Domain.SCHEMA_SYNC_PREFIX + b"laggard"
+        txn = sa.storage.begin()
+        txn.set(key, json.dumps(
+            {"ver": 0, "expiry": int(time.time() * 1000) + 60_000}
+        ).encode())
+        txn.commit()
+        t0 = time.time()
+        ok = dom_a.wait_schema_convergence(
+            dom_a.info_schema().version, timeout_ms=250)
+        assert not ok and time.time() - t0 >= 0.2
+        # the laggard catches up -> convergence succeeds
+        txn = sa.storage.begin()
+        txn.set(key, json.dumps(
+            {"ver": dom_a.info_schema().version,
+             "expiry": int(time.time() * 1000) + 60_000}).encode())
+        txn.commit()
+        assert dom_a.wait_schema_convergence(
+            dom_a.info_schema().version, timeout_ms=300)
+        txn = sa.storage.begin()
+        txn.delete(key)
+        txn.commit()
+
+    def test_txn_straddling_version_bump_detected(self, cluster):
+        """Commit-time schema validation notices the concurrent DDL; the
+        session replays the statement history against the fresh schema
+        (ref: session.go doCommitWithRetry). A replay that can no longer
+        apply (the column is gone) surfaces as an error; one that can
+        (column added) commits consistently under the new schema."""
+        sa, sb, dom_a, dom_b = cluster
+        sa.execute("CREATE DATABASE d; USE d")
+        sa.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, v BIGINT, "
+                   "w BIGINT)")
+        sa.execute("INSERT INTO t VALUES (1, 1, 1)")
+        sb.execute("USE d")
+        sb.execute("BEGIN")
+        sb.execute("UPDATE t SET w = 2 WHERE id = 1")
+        sa.execute("ALTER TABLE d.t DROP COLUMN w")
+        from tidb_tpu.session import SQLError
+        from tidb_tpu import kv
+        with pytest.raises((SQLError, kv.KVError)):
+            sb.execute("COMMIT")
+        # the add-column variant: replay succeeds under the new schema
+        sb.execute("BEGIN")
+        sb.execute("UPDATE t SET v = 9 WHERE id = 1")
+        sa.execute("ALTER TABLE d.t ADD COLUMN extra BIGINT")
+        sb.execute("COMMIT")
+        assert sb.query("SELECT v, extra FROM t").rows == [(9, None)]

@@ -48,10 +48,17 @@ class DDLWorker:
 
     # -- driving -------------------------------------------------------------
 
-    def run_job(self, job_id: int) -> Job:
-        """Run queue steps until job_id finishes; raise if cancelled."""
+    def run_job(self, job_id: int, between_steps=None) -> Job:
+        """Run queue steps until job_id finishes; raise if cancelled.
+        `between_steps()` (owner-lease renewal + per-version convergence,
+        tidb_tpu/session Domain) runs after every transition; returning
+        False means ownership was lost — stop stepping (the new owner's
+        worker continues the job) and report the job as-is."""
         while True:
             job = self.run_one_step()
+            if job is not None and between_steps is not None and \
+                    not between_steps():
+                return job
             if job is None:
                 # queue empty: the job must be in history
                 txn = self.storage.begin()

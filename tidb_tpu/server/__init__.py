@@ -77,6 +77,7 @@ class Server:
         load_global_variables(storage)
         from tidb_tpu.session import Domain
         Domain.get(storage).start_stats_worker()
+        Domain.get(storage).start_schema_worker()
         self._listener = socket.create_server((host, port))
         self.addr = self._listener.getsockname()
         self._tokens = threading.Semaphore(token_limit)
@@ -133,6 +134,7 @@ class Server:
         self._closing.set()
         from tidb_tpu.session import Domain
         Domain.get(self.storage).stop_stats_worker()
+        Domain.get(self.storage).stop_schema_worker()
         try:
             self._listener.close()
         except OSError:

@@ -70,6 +70,9 @@ class Domain:
         self._stats = None
         self._plan_cache = None
         self._priv = None
+        self._ddl_owner = None
+        self._schema_stop = None
+        self._stats_stop = None
 
     def priv_cache(self):
         """Grant-table cache (ref: privilege/privileges/cache.go:104)."""
@@ -77,6 +80,128 @@ class Domain:
             from tidb_tpu.privilege import PrivilegeCache
             self._priv = PrivilegeCache(self.storage)
         return self._priv
+
+    # -- multi-server schema plane (ref: owner/manager.go election,
+    # ddl/syncer.go version publication, domain/domain.go reload loop) -------
+
+    SCHEMA_SYNC_PREFIX = b"m_schema_sync_"
+    SCHEMA_LEASE_MS = 2000
+
+    def ddl_owner(self):
+        """This domain's DDL election participant (lazy singleton)."""
+        with self._mu:
+            if self._ddl_owner is None:
+                from tidb_tpu.owner import OwnerManager
+                self._ddl_owner = OwnerManager(
+                    self.storage, lease_ms=self.SCHEMA_LEASE_MS)
+            return self._ddl_owner
+
+    def schema_worker_running(self) -> bool:
+        return self._schema_stop is not None
+
+    def publish_schema_version(self) -> None:
+        """Advertise this server's loaded schema version (ref:
+        ddl/syncer.go:58 UpdateSelfVersion): a lease-stamped sync record
+        the DDL owner polls for convergence."""
+        ver = self.info_schema().version
+        key = self.SCHEMA_SYNC_PREFIX + self.ddl_owner().id.encode()
+        import json as _json
+        expiry = int(time.time() * 1000) + 2 * self.SCHEMA_LEASE_MS
+        txn = self.storage.begin()
+        try:
+            txn.set(key, _json.dumps({"ver": ver,
+                                      "expiry": expiry}).encode())
+            txn.commit()
+        except kv.KVError as e:
+            # the record expires in 2x lease, so the owner would treat
+            # this server as dead — say so rather than vanish silently
+            logging.getLogger("tidb_tpu.domain").warning(
+                "schema version publish failed: %s", e)
+            if getattr(txn, "valid", False):
+                txn.rollback()
+
+    def live_schema_versions(self) -> dict[str, int]:
+        """Unexpired published versions by server id (ref: syncer.go
+        OwnerCheckAllVersions reading etcd)."""
+        import json as _json
+        from tidb_tpu import codec as _codec
+        now = int(time.time() * 1000)
+        out: dict[str, int] = {}
+        snap = self.storage.snapshot(self.storage.current_ts())
+        end = _codec.prefix_next(self.SCHEMA_SYNC_PREFIX)
+        for k, v in snap.iter_range(self.SCHEMA_SYNC_PREFIX, end):
+            try:
+                o = _json.loads(v)
+                if int(o["expiry"]) > now:
+                    out[k[len(self.SCHEMA_SYNC_PREFIX):].decode()] = \
+                        int(o["ver"])
+            except (ValueError, KeyError):
+                continue
+        return out
+
+    def wait_schema_convergence(self, target_ver: int,
+                                timeout_ms: int | None = None) -> bool:
+        """Block until every live server published >= target_ver, capped
+        at 2x lease (dead servers expire out; ref: ddl_worker's
+        waitSchemaChanged + 2*lease convergence rule, ddl/ddl.go)."""
+        deadline = time.time() + (timeout_ms or
+                                  2 * self.SCHEMA_LEASE_MS) / 1000.0
+        me = self.ddl_owner().id
+        while True:
+            vers = self.live_schema_versions()
+            lagging = [s for s, v in vers.items()
+                       if s != me and v < target_ver]
+            if not lagging:
+                return True
+            if time.time() >= deadline:
+                return False
+            time.sleep(0.02)
+
+    def schema_worker_tick(self) -> None:
+        """One maintenance beat: campaign for DDL ownership, drain the job
+        queue when owner, reload + publish the schema version."""
+        owner = self.ddl_owner()
+        from tidb_tpu.ddl.worker import DDLWorker
+        worker = DDLWorker(self.storage)
+        # re-campaign EVERY step: long drains (backfills, convergence
+        # waits) must renew the lease or stop when ownership moves
+        while owner.campaign():
+            try:
+                job = worker.run_one_step()
+            except kv.RetryableError:
+                break    # a competing stepper raced us: yield to it
+            if job is None:
+                break
+            self.wait_schema_convergence(self.info_schema().version)
+        self.publish_schema_version()
+
+    def start_schema_worker(self, interval: float | None = None) -> None:
+        """Background reload/election/DDL loop (ref: domain.go:320
+        loadSchemaInLoop + ddl owner worker)."""
+        with self._mu:
+            if self._schema_stop is not None:
+                return
+            self._schema_stop = threading.Event()
+            stop = self._schema_stop
+        tick = interval if interval is not None \
+            else self.SCHEMA_LEASE_MS / 2000.0
+
+        def loop():
+            while not stop.wait(tick):
+                try:
+                    self.schema_worker_tick()
+                except Exception:  # noqa: BLE001 - keep the loop alive
+                    pass
+
+        threading.Thread(target=loop, daemon=True,
+                         name="schema-worker").start()
+
+    def stop_schema_worker(self) -> None:
+        with self._mu:
+            stop = self._schema_stop
+            self._schema_stop = None
+        if stop is not None:
+            stop.set()
 
     # -- auto analyze (ref: statistics/handle.go auto-analyze +
     # RunAutoAnalyze wiring, tidb-server/main.go:341) -------------------------
@@ -106,7 +231,7 @@ class Domain:
     def start_stats_worker(self, interval: float = 30.0) -> None:
         """Idempotent background auto-analyze loop."""
         with self._mu:
-            if getattr(self, "_stats_stop", None) is not None:
+            if self._stats_stop is not None:
                 return
             self._stats_stop = threading.Event()
             stop = self._stats_stop
@@ -124,7 +249,7 @@ class Domain:
 
     def stop_stats_worker(self) -> None:
         with self._mu:
-            stop = getattr(self, "_stats_stop", None)
+            stop = self._stats_stop
             self._stats_stop = None
         if stop is not None:
             stop.set()
@@ -459,7 +584,8 @@ class Session:
             dropped = self._dropped_table_ids(stmt)
             from tidb_tpu.ddl import DDLError
             try:
-                DDLExecutor(self.storage).execute(stmt, self.current_db)
+                DDLExecutor(self.storage).execute(stmt, self.current_db,
+                                                  domain=self.domain)
             except DDLError as e:
                 raise SQLError(str(e)) from None
             for tid in dropped:
