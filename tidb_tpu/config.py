@@ -18,7 +18,7 @@ import threading
 
 __all__ = ["get_var", "set_var", "all_vars", "device_enabled",
            "chunk_cache_enabled", "cop_concurrency", "sort_spill_rows",
-           "UnknownVariableError"]
+           "device_min_rows", "stream_rows", "UnknownVariableError"]
 
 
 class UnknownVariableError(Exception):
@@ -41,6 +41,13 @@ _DEFS: dict[str, tuple[str, int]] = {
     "tidb_tpu_sort_spill_rows": (_INT, 1 << 20),
     # min chunk rows before an executor pays a device dispatch
     "tidb_tpu_device_min_rows": (_INT, 2048),
+    # streaming threshold for mesh/device operators: probe sides larger
+    # than this never materialize whole on the host — they feed the
+    # kernels in ≤stream_rows super-batches, double-buffered so the
+    # host→HBM transfer of batch i+1 overlaps batch i's readback
+    # (BASELINE config 5; ref: the bounded producer/consumer channels of
+    # distsql/distsql.go:92-98)
+    "tidb_tpu_stream_rows": (_INT, 1 << 18),
     # statements at/above this wall time land in the slow-query log
     # (ref: config.Log.SlowThreshold, default 300ms)
     "tidb_tpu_slow_query_ms": (_INT, 300),
@@ -130,3 +137,7 @@ def sort_spill_rows() -> int:
 
 def device_min_rows() -> int:
     return _vals["tidb_tpu_device_min_rows"]
+
+
+def stream_rows() -> int:
+    return _vals["tidb_tpu_stream_rows"]

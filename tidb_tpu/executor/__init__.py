@@ -23,7 +23,7 @@ from tidb_tpu.ops.hostagg import host_hash_agg
 from tidb_tpu.ops.join import (JoinKernel, JoinKeyEncoder,
                                host_match_pairs)
 from tidb_tpu.ops.streamagg import SegmentAggKernel
-from tidb_tpu.ops.runtime import eval_filter_host
+from tidb_tpu.ops.runtime import eval_filter_host, super_batches
 from tidb_tpu.plan import physical as ph
 from tidb_tpu.sqltypes import EvalType, FieldType, np_dtype_for
 from tidb_tpu.store.copr import exec_cop_plan
@@ -473,31 +473,53 @@ class StreamAggExec(Executor):
 
     def chunks(self, ctx):
         agg = HashAggregator(self.plan.aggs)
-        whole = Chunk.concat_all(list(self.child.chunks(ctx)))
-        if whole is not None and whole.num_rows:
-            if not self.plan.sorted_input:
-                by = [(g, False) for g in self.plan.group_exprs]
-                whole = whole.take(_sort_order(by, whole))
-            use_device = (config.device_enabled() and
-                          all(not a.distinct for a in self.plan.aggs))
-            # slices keep device memory bounded; a group spanning two
-            # slices merges itself in the HashAggregator
-            for s in range(0, whole.num_rows, self._SLICE):
-                part = whole.slice(s, min(s + self._SLICE, whole.num_rows))
-                gr = None
-                if use_device and part.num_rows >= config.device_min_rows():
-                    try:
-                        if self._kernel is None:
-                            self._kernel = SegmentAggKernel(
-                                self.plan.group_exprs, self.plan.aggs)
-                            self.plan._root_kernel = self._kernel
-                        gr = self._kernel(part)
-                    except (ValueError, NotImplementedError):
-                        use_device = False
-                if gr is None:
-                    gr = host_hash_agg(part, None, self.plan.group_exprs,
-                                       self.plan.aggs)
-                agg.update(gr)
+        use_device = (config.device_enabled() and
+                      all(not a.distinct for a in self.plan.aggs))
+
+        # batches keep host+device memory bounded; a group spanning two
+        # batches merges itself in the HashAggregator
+        def feed(part: Chunk) -> None:
+            nonlocal use_device
+            gr = None
+            if use_device and part.num_rows >= config.device_min_rows():
+                try:
+                    if self._kernel is None:
+                        self._kernel = SegmentAggKernel(
+                            self.plan.group_exprs, self.plan.aggs)
+                        self.plan._root_kernel = self._kernel
+                    gr = self._kernel(part)
+                except (ValueError, NotImplementedError):
+                    use_device = False
+            if gr is None:
+                gr = host_hash_agg(part, None, self.plan.group_exprs,
+                                   self.plan.aggs)
+            agg.update(gr)
+
+        if self.plan.sorted_input:
+            # already key-ordered (pk scan / keep_order index): pure
+            # streaming, the whole input is never materialized
+            for part in super_batches([], self.child.chunks(ctx),
+                                       self._SLICE):
+                feed(part)
+        else:
+            # needs its own ordering pass: the spill sorter keeps row
+            # memory O(run + block) however large the input
+            # (executor/extsort.py), then yields globally ordered blocks
+            from tidb_tpu.executor.extsort import SpillSorter
+            by = [(g, False) for g in self.plan.group_exprs]
+            sorter = SpillSorter(by, run_rows=config.sort_spill_rows(),
+                                 block_rows=self._SLICE)
+            try:
+                for chunk in self.child.chunks(ctx):
+                    sorter.add(chunk)
+                for part in sorter.sorted_chunks():
+                    # a non-spilled tail comes back as one chunk: re-slice
+                    # so device dispatches stay bounded
+                    for s in range(0, part.num_rows, self._SLICE):
+                        feed(part.slice(s, min(s + self._SLICE,
+                                               part.num_rows)))
+            finally:
+                sorter.close()
         results = agg.results()
         if not self.plan.group_exprs and not results:
             results = [((), [_empty_agg_value(a) for a in self.plan.aggs])]
@@ -725,11 +747,14 @@ class HashJoinExec(Executor):
         probe_iter = self.left.chunks(ctx)
         mesh_kernel = self._mesh_kernel(nb)
         if mesh_kernel is not None:
-            # shuffle join wants the whole probe side at once (each call
-            # is one all_to_all repartition of BOTH sides over the mesh),
-            # but a small probe doesn't pay for the collective: buffer
-            # chunks until the probe proves big enough, else fall through
-            # to the per-chunk device/host paths
+            # each shuffle-join call is one all_to_all repartition of both
+            # sides over the mesh, so probe chunks are re-batched into
+            # large super-batches — but never the whole table: past
+            # tidb_tpu_stream_rows per batch the collective is amortized
+            # and host memory stays bounded (the build side's device
+            # transfer is memoized across batches). A small probe doesn't
+            # pay for the collective at all: fall through to the
+            # per-chunk device/host paths
             buffered, total = [], 0
             for c in probe_iter:
                 buffered.append(c)
@@ -737,8 +762,9 @@ class HashJoinExec(Executor):
                 if total >= self._DEVICE_MIN_PROBE:
                     break
             if total >= self._DEVICE_MIN_PROBE:
-                big = Chunk.concat_all(buffered + list(probe_iter))
-                probe_iter = [big] if big is not None else []
+                probe_iter = super_batches(
+                    buffered, probe_iter,
+                    max(config.stream_rows(), self._DEVICE_MIN_PROBE))
             else:
                 mesh_kernel = None
                 probe_iter = iter(buffered)

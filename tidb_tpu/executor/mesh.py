@@ -13,18 +13,38 @@ on hash collisions, or on non-unique dimension build keys."""
 
 from __future__ import annotations
 
+import itertools
 from collections import OrderedDict
 
 import numpy as np
 
+from tidb_tpu import config as sysconf
 from tidb_tpu.chunk import Chunk, Column
 from tidb_tpu.ops.hashagg import CapacityError, CollisionError, HashAggregator
+from tidb_tpu.ops.hostagg import host_hash_agg
+from tidb_tpu.ops.runtime import super_batches
 from tidb_tpu.parallel import config
 from tidb_tpu.parallel.dist_agg import MeshAggKernel
 from tidb_tpu.parallel.dist_join import (BuildError, LookupSpec,
-                                         MeshLookupAggKernel)
+                                         MeshLookupAggKernel,
+                                         host_lookup_agg)
 
-__all__ = ["MeshAggExec", "MeshLookupAggExec"]
+__all__ = ["MeshAggExec", "MeshLookupAggExec", "stream_stats",
+           "reset_stream_stats"]
+
+# Streaming telemetry (tests + metrics assert bounded buffering and that
+# the double-buffered overlap actually happened).
+_STREAM_STATS = {"streams": 0, "batches": 0, "host_batches": 0,
+                 "max_batch_rows": 0, "overlapped_launches": 0}
+
+
+def stream_stats() -> dict:
+    return dict(_STREAM_STATS)
+
+
+def reset_stream_stats() -> None:
+    for k in _STREAM_STATS:
+        _STREAM_STATS[k] = 0
 
 # Initial per-chip group-table capacity; on overflow the executor re-plans
 # the kernel once with 2x the observed distinct count (the re-plan the
@@ -103,16 +123,20 @@ def _concat_chunks_cached(holder, slot: str, parts, schema) -> Chunk:
     return big
 
 
-def _emit_results(plan, gr_or_none, executor_mod):
-    agg = HashAggregator(plan.aggs)
-    if gr_or_none is not None:
-        agg.update(gr_or_none)
+def _emit_agg(plan, agg, executor_mod):
     results = agg.results()
     if not plan.group_exprs and not results:
         results = [((), [executor_mod._empty_agg_value(a)
                          for a in plan.aggs])]
     return executor_mod._agg_results_to_chunk(
         plan.schema, plan.num_group_cols, plan.aggs, results)
+
+
+def _emit_results(plan, gr_or_none, executor_mod):
+    agg = HashAggregator(plan.aggs)
+    if gr_or_none is not None:
+        agg.update(gr_or_none)
+    return _emit_agg(plan, agg, executor_mod)
 
 
 class _MeshExecBase:
@@ -150,6 +174,87 @@ class _MeshExecBase:
                 return None
         return None
 
+    def _stream_groups(self, batches, get_kernel, host_batch,
+                       agg: HashAggregator) -> None:
+        """Double-buffered streaming aggregation: batch i+1's host→HBM
+        transfer and kernel dispatch are issued (asynchronously) BEFORE
+        batch i's blocking readback, so transfer/compute/readback overlap
+        (BASELINE config 5). Per-batch recovery: capacity overflow
+        re-plans the kernel and re-runs only that batch (group merging is
+        associative — already-merged batches stay valid); collisions or
+        non-device expressions aggregate that batch on the host."""
+        _STREAM_STATS["streams"] += 1
+        capacity = getattr(self.plan, "_mesh_capacity", DEFAULT_CAPACITY)
+        try:
+            kernel = get_kernel(capacity)
+        except (ValueError, BuildError):
+            kernel = None
+
+        def finish(pkernel, outs, batch):
+            nonlocal kernel, capacity
+            try:
+                return pkernel.finish(outs, batch)
+            except CapacityError as e:
+                needed = getattr(e, "needed", None)
+                while needed is not None:
+                    cap2 = 1 << max(needed * 2 - 1, 1).bit_length()
+                    if cap2 > MAX_CAPACITY:
+                        break
+                    capacity = cap2
+                    try:
+                        kernel = get_kernel(capacity)
+                        gr = kernel.finish(
+                            kernel.launch(batch, bucket=True), batch)
+                        self.plan._mesh_capacity = capacity
+                        return gr
+                    except CapacityError as e2:
+                        needed = getattr(e2, "needed", None)
+                    except (CollisionError, BuildError, ValueError):
+                        break
+            except (CollisionError, BuildError, ValueError):
+                pass
+            _STREAM_STATS["host_batches"] += 1
+            return host_batch(batch)
+
+        pending = None          # (kernel, in-flight outs, batch)
+        for batch in batches:
+            _STREAM_STATS["batches"] += 1
+            _STREAM_STATS["max_batch_rows"] = max(
+                _STREAM_STATS["max_batch_rows"], batch.num_rows)
+            outs = None
+            launch_kernel = kernel     # finish() may rebind `kernel` on a
+            if launch_kernel is not None:   # capacity re-plan; outs must be
+                try:                        # read back by their own kernel
+                    outs = launch_kernel.launch(batch, bucket=True)
+                    if pending is not None:
+                        _STREAM_STATS["overlapped_launches"] += 1
+                except (ValueError, CollisionError, BuildError):
+                    outs = None
+            if pending is not None:
+                agg.update(finish(*pending))
+                pending = None
+            if outs is not None:
+                pending = (launch_kernel, outs, batch)
+            else:
+                _STREAM_STATS["host_batches"] += 1
+                agg.update(host_batch(batch))
+        if pending is not None:
+            agg.update(finish(*pending))
+        if kernel is not None:
+            self.plan._mesh_capacity = capacity
+
+    def _buffer_probe(self, it, limit):
+        """Pull chunks until the probe proves larger than `limit`.
+        -> (buffered parts, total rows, exhausted?)."""
+        parts, total = [], 0
+        for c in it:
+            if c.num_rows:
+                parts.append(c)
+                total += c.num_rows
+            if total > limit:
+                return parts, total, False
+        return parts, total, True
+
 
 class MeshAggExec(_MeshExecBase):
     """Group-by aggregation on the device mesh (Q1 shape)."""
@@ -161,23 +266,46 @@ class MeshAggExec(_MeshExecBase):
         if mesh is None:
             yield from self._fallback(ctx)
             return
-        reader = ex.build_executor(self.plan.children[0])
-        big = _concat_chunks_cached(self.plan, "_probe_cache",
-                                    list(reader.chunks(ctx)),
-                                    self.plan.children[0].schema)
+        plan = self.plan
+        schema = plan.children[0].schema
+        reader = ex.build_executor(plan.children[0])
+        it = reader.chunks(ctx)
+        limit = sysconf.stream_rows()
+        parts, total, exhausted = self._buffer_probe(it, limit)
 
         def make(capacity):
-            return MeshAggKernel(mesh, self.plan.filter_expr,
-                                 self.plan.group_exprs,
-                                 self.plan.aggs, capacity=capacity)
+            return MeshAggKernel(mesh, plan.filter_expr, plan.group_exprs,
+                                 plan.aggs, capacity=capacity)
 
+        if not exhausted:
+            # probe larger than the streaming threshold: never materialize
+            # it — feed the kernel ≤limit-row super-batches, double-buffered
+            def get_kernel(capacity):
+                k = _kernel_cache_get(plan, capacity)
+                if k is None:
+                    k = make(capacity)
+                    _kernel_cache_put(plan, capacity, k)
+                return k
+
+            agg = HashAggregator(plan.aggs)
+            self._stream_groups(
+                super_batches(parts, it, limit), get_kernel,
+                lambda b: host_hash_agg(b, plan.filter_expr,
+                                        plan.group_exprs, plan.aggs),
+                agg)
+            yield _emit_agg(plan, agg, ex)
+            return
+
+        # small probe: whole-table path, memoized so hot re-executions of
+        # a cached plan transfer zero bytes
+        big = _concat_chunks_cached(plan, "_probe_cache", parts, schema)
         gr = None
         if big.num_rows:
             gr = self._run_with_escalation(make, lambda k: k(big))
             if gr is None:
                 yield from self._fallback(ctx)
                 return
-        yield _emit_results(self.plan, gr, ex)
+        yield _emit_results(plan, gr, ex)
 
 
 class MeshLookupAggExec(_MeshExecBase):
@@ -202,10 +330,6 @@ class MeshLookupAggExec(_MeshExecBase):
                     key_exprs=lk.key_exprs, build_chunk=bchunk,
                     build_key_offsets=lk.build_key_offsets,
                     payload_offsets=lk.payload_offsets))
-            reader = ex.build_executor(plan.children[0])
-            probe = _concat_chunks_cached(plan, "_probe_cache",
-                                          list(reader.chunks(ctx)),
-                                          plan.children[0].schema)
             builds = [self._build_table(d, sp)
                       for d, sp in zip(plan.lookups, specs)]
         except BuildError:
@@ -220,17 +344,46 @@ class MeshLookupAggExec(_MeshExecBase):
             k.lookups = specs    # freshly built: skip the refresh rebuild
             return k
 
-        def run(kernel):
+        def refresh(kernel):
             if kernel.lookups is not specs:
                 # cached kernel: the traced program depends only on the
                 # lookup STRUCTURE; swap in the current tables
                 kernel.lookups = specs
                 kernel.builds = builds
-            return kernel(probe)
+            return kernel
 
+        reader = ex.build_executor(plan.children[0])
+        it = reader.chunks(ctx)
+        limit = sysconf.stream_rows()
+        parts, total, exhausted = self._buffer_probe(it, limit)
+
+        if not exhausted:
+            # fact side larger than the streaming threshold: feed the
+            # lookup-chain kernel in super-batches; dimension tables stay
+            # resident on device across batches (device-memoized builds)
+            def get_kernel(capacity):
+                k = _kernel_cache_get(plan, capacity)
+                if k is None:
+                    k = make(capacity)
+                    _kernel_cache_put(plan, capacity, k)
+                return refresh(k)
+
+            agg = HashAggregator(plan.aggs)
+            self._stream_groups(
+                super_batches(parts, it, limit), get_kernel,
+                lambda b: host_lookup_agg(b, plan.filter_expr, specs,
+                                          plan.group_exprs, plan.aggs,
+                                          builds=builds),
+                agg)
+            yield _emit_agg(plan, agg, ex)
+            return
+
+        probe = _concat_chunks_cached(plan, "_probe_cache", parts,
+                                      plan.children[0].schema)
         gr = None
         if probe.num_rows:
-            gr = self._run_with_escalation(make, run)
+            gr = self._run_with_escalation(
+                make, lambda kernel: refresh(kernel)(probe))
             if gr is None:
                 yield from self._fallback(ctx)
                 return

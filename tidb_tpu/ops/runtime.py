@@ -18,9 +18,37 @@ from tidb_tpu.chunk import Chunk, dict_encode
 from tidb_tpu.expression import Expression
 
 __all__ = ["bucket_size", "pad_column", "device_put_chunk",
-           "eval_filter_host", "MIN_BUCKET"]
+           "eval_filter_host", "super_batches", "MIN_BUCKET"]
 
 MIN_BUCKET = 1024
+
+
+def super_batches(first_parts, rest, limit: int):
+    """Re-batch a chunk stream into ~limit-row super-batches: device
+    dispatches stay large while host memory stays O(limit) — the
+    TPU-sized form of the reference's bounded chunk channels
+    (distsql/distsql.go:92). Oversize chunks are sliced so one storage
+    chunk cannot break the memory bound."""
+    import itertools
+    buf, total = [], 0
+    for c in itertools.chain(first_parts, rest):
+        start = 0
+        while start < c.num_rows:
+            take = min(c.num_rows - start, limit - total)
+            piece = c if (start == 0 and take == c.num_rows) \
+                else c.slice(start, start + take)
+            buf.append(piece)
+            total += take
+            start += take
+            if total >= limit:
+                big = Chunk.concat_all(buf)
+                if big is not None:
+                    yield big
+                buf, total = [], 0
+    if buf:
+        big = Chunk.concat_all(buf)
+        if big is not None:
+            yield big
 
 
 def bucket_size(n: int) -> int:

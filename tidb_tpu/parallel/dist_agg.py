@@ -138,13 +138,17 @@ class MeshKernelBase:
             shard = shard_map(self._kernel, check_rep=False, **kwargs)
         self._jit = jax.jit(shard)
 
-    def _shard_probe(self, chunk: Chunk):
+    def _shard_probe(self, chunk: Chunk, bucket: bool = False):
         """-> (sharded device cols, padded shard length). The sharded
         transfer is memoized on the chunk (keyed by mesh + padded size):
-        cached storage chunks stay resident across re-executions."""
+        cached storage chunks stay resident across re-executions.
+        bucket=True pads the shard length to a power-of-two bucket so a
+        stream of similar-sized super-batches reuses one compiled shape."""
         n = chunk.num_rows
         ln = -(-max(n, 1) // self.ndev)
         ln += (-ln) % 8
+        if bucket:
+            ln = runtime.bucket_size(ln)
         from tidb_tpu.parallel import config as mesh_config
         # generation (not id(mesh)) keys the memo: a torn-down mesh's id
         # can be recycled by a new Mesh object at the same address
@@ -216,9 +220,19 @@ class MeshAggKernel(MeshKernelBase):
 
     # -- host driver ---------------------------------------------------------
 
-    def __call__(self, chunk: Chunk) -> GroupResult:
-        cols, _ln = self._shard_probe(chunk)
-        outs = self._jit(cols, jnp.int64(chunk.num_rows))
+    def launch(self, chunk: Chunk, bucket: bool = False):
+        """Asynchronous half: host→HBM transfer + kernel dispatch. Returns
+        an opaque in-flight handle; nothing blocks, so the caller can
+        overlap the next batch's transfer with this batch's readback
+        (the double-buffered streaming of executor/mesh.py)."""
+        cols, _ln = self._shard_probe(chunk, bucket=bucket)
+        return self._jit(cols, jnp.int64(chunk.num_rows))
+
+    def finish(self, outs, chunk: Chunk) -> GroupResult:
+        """Blocking half: one batched device→host readback + host tail."""
         gidx, rep_rows, lanes_at, counts = self._postprocess(outs)
         return finalize_group_result(chunk, self.group_exprs, self.aggs,
                                      gidx, rep_rows, lanes_at, counts)
+
+    def __call__(self, chunk: Chunk) -> GroupResult:
+        return self.finish(self.launch(chunk), chunk)

@@ -205,13 +205,21 @@ class MeshLookupAggKernel(MeshKernelBase):
 
     # -- host driver ---------------------------------------------------------
 
-    def __call__(self, probe: Chunk):
-        cols, _ln = self._shard_probe(probe)
+    def launch(self, probe: Chunk, bucket: bool = False):
+        """Asynchronous half: host→HBM transfer + kernel dispatch (see
+        MeshAggKernel.launch). Build tables are device-memoized by
+        _BuildTable.device_arrays, so per-batch launches re-send nothing."""
+        cols, _ln = self._shard_probe(probe, bucket=bucket)
         rep_sh = NamedSharding(self.mesh, P())
         builds = tuple(b.device_arrays(rep_sh) for b in self.builds)
-        outs = self._jit(cols, jnp.int64(probe.num_rows), builds)
+        return self._jit(cols, jnp.int64(probe.num_rows), builds)
+
+    def finish(self, outs, probe: Chunk):
         gidx, rep_rows, lanes_at, counts = self._postprocess(outs)
         return self._finalize(probe, gidx, rep_rows, lanes_at, counts)
+
+    def __call__(self, probe: Chunk):
+        return self.finish(self.launch(probe), probe)
 
     def _finalize(self, probe: Chunk, gidx, rep_rows, lanes_at, counts):
         """Re-run the lookup chain on the handful of representative rows
@@ -264,13 +272,16 @@ class MeshLookupAggKernel(MeshKernelBase):
 
 
 def host_lookup_agg(probe: Chunk, filter_expr, lookups: Sequence[LookupSpec],
-                    group_exprs, aggs):
-    """Pure-host reference implementation (ground truth for tests and the
-    dryrun cross-check)."""
+                    group_exprs, aggs, builds=None):
+    """Pure-host reference implementation (ground truth for tests, the
+    dryrun cross-check, and the per-batch fallback of the streaming mesh
+    path — which passes its prebuilt `builds` so dimension hash tables
+    are not rebuilt per batch)."""
     from tidb_tpu.ops.hostagg import host_hash_agg
     mask = runtime.eval_filter_host(filter_expr, probe)
     ch = probe.filter(mask)
-    builds = [_BuildTable(lk) for lk in lookups]
+    if builds is None:
+        builds = [_BuildTable(lk) for lk in lookups]
     cols = list(ch.columns)
     for lk, b in zip(lookups, builds):
         virt = Chunk(cols)
