@@ -1,0 +1,158 @@
+"""LOAD DATA INFILE + SPLIT TABLE (ref: executor/write.go:1373 LoadData;
+store/tikv/split_region.go:29 manual region split)."""
+
+from decimal import Decimal
+
+import pytest
+
+from tidb_tpu.session import Session, SQLError
+from tidb_tpu.table import DupKeyError
+from tidb_tpu.store.storage import new_mock_storage
+
+
+@pytest.fixture
+def sess():
+    s = Session(new_mock_storage())
+    s.execute("CREATE DATABASE d")
+    s.execute("USE d")
+    s.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, name VARCHAR(32), "
+              "price DECIMAL(10,2), qty BIGINT, dt DATETIME)")
+    yield s
+    s.close()
+
+
+def _write(tmp_path, name, content):
+    p = tmp_path / name
+    p.write_text(content, encoding="utf-8")
+    return str(p)
+
+
+class TestLoadData:
+    def test_csv_with_header_nulls_types(self, sess, tmp_path):
+        path = _write(tmp_path, "t.csv",
+                      'id,name,price,qty,dt\n'
+                      '1,"alpha",12.50,7,2024-01-02 03:04:05\n'
+                      '2,"beta, inc",0.99,\\N,2024-06-30 00:00:00\n'
+                      '3,gamma,100,0,2024-12-31 23:59:59\n')
+        [n] = sess.execute(
+            f"LOAD DATA INFILE '{path}' INTO TABLE t "
+            f"FIELDS TERMINATED BY ',' ENCLOSED BY '\"' "
+            f"LINES TERMINATED BY '\\n' IGNORE 1 LINES")
+        assert n == 3
+        rows = sess.query("SELECT id, name, price, qty FROM t "
+                          "ORDER BY id").rows
+        assert rows[0][:3] == (1, "alpha", Decimal("12.50"))
+        assert rows[1][1] == "beta, inc"       # enclosed comma survives
+        assert rows[1][3] is None              # \N is NULL
+        assert rows[2][2] == Decimal("100.00")  # rescaled to frac 2
+        assert sess.query("SELECT COUNT(*) FROM t WHERE "
+                          "dt = '2024-01-02 03:04:05'").rows == [(1,)]
+
+    def test_tab_defaults_and_column_list(self, sess, tmp_path):
+        path = _write(tmp_path, "t.tsv", "10\tx\n11\ty\n")
+        [n] = sess.execute(
+            f"LOAD DATA INFILE '{path}' INTO TABLE t (id, name)")
+        assert n == 2
+        assert sess.query("SELECT name, price FROM t WHERE id=11").rows \
+            == [("y", None)]
+
+    def test_dup_modes(self, sess, tmp_path):
+        sess.execute("INSERT INTO t (id, name) VALUES (1, 'old')")
+        path = _write(tmp_path, "dup.tsv", "1\tnew\n2\tfresh\n")
+        with pytest.raises(DupKeyError):
+            sess.execute(
+                f"LOAD DATA INFILE '{path}' INTO TABLE t (id, name)")
+        # statement atomicity: the failed load wrote nothing
+        assert sess.query("SELECT COUNT(*) FROM t").rows == [(1,)]
+        [n] = sess.execute(
+            f"LOAD DATA INFILE '{path}' IGNORE INTO TABLE t (id, name)")
+        assert n == 1
+        assert sess.query("SELECT name FROM t WHERE id=1").rows \
+            == [("old",)]
+        sess.execute(
+            f"LOAD DATA INFILE '{path}' REPLACE INTO TABLE t (id, name)")
+        assert sess.query("SELECT name FROM t WHERE id=1").rows \
+            == [("new",)]
+
+    def test_local_implies_ignore_and_escapes(self, sess, tmp_path):
+        sess.execute("INSERT INTO t (id, name) VALUES (5, 'keep')")
+        path = _write(tmp_path, "esc.tsv", "5\tx\n6\ta\\tb\n")
+        [n] = sess.execute(
+            f"LOAD DATA LOCAL INFILE '{path}' INTO TABLE t (id, name)")
+        assert n == 1
+        assert sess.query("SELECT name FROM t WHERE id=5").rows \
+            == [("keep",)]
+        assert sess.query("SELECT name FROM t WHERE id=6").rows \
+            == [("a\tb",)]
+
+    def test_missing_file(self, sess):
+        with pytest.raises(SQLError):
+            sess.execute("LOAD DATA INFILE '/nonexistent/x' INTO TABLE t")
+
+    def test_in_explicit_txn_rolls_back(self, sess, tmp_path):
+        path = _write(tmp_path, "txn.tsv", "100\tz\n")
+        sess.execute("BEGIN")
+        sess.execute(f"LOAD DATA INFILE '{path}' INTO TABLE t (id, name)")
+        assert sess.query("SELECT COUNT(*) FROM t WHERE id=100").rows \
+            == [(1,)]
+        sess.execute("ROLLBACK")
+        assert sess.query("SELECT COUNT(*) FROM t WHERE id=100").rows \
+            == [(0,)]
+
+
+class TestSplitTable:
+    def test_split_at(self, sess):
+        sess.execute("INSERT INTO t (id, name) VALUES (1,'a'), (500,'b'), "
+                     "(1500,'c')")
+        before = len(sess.storage.cluster.all_regions())
+        rs = sess.query("SPLIT TABLE t AT (1000)")
+        assert rs.rows == [(1,)]
+        assert len(sess.storage.cluster.all_regions()) == before + 1
+        # reads still correct across the new boundary
+        assert sess.query("SELECT COUNT(*) FROM t").rows == [(3,)]
+
+    def test_split_regions(self, sess):
+        before = len(sess.storage.cluster.all_regions())
+        rs = sess.query("SPLIT TABLE t REGIONS 4")
+        assert rs.rows == [(3,)]
+        assert len(sess.storage.cluster.all_regions()) == before + 3
+
+    def test_split_bad_arg(self, sess):
+        with pytest.raises(SQLError):
+            sess.query("SPLIT TABLE t AT ('abc')")
+
+
+class TestSplitRerun:
+    def test_split_regions_rerun_is_noop(self, sess):
+        assert sess.query("SPLIT TABLE t REGIONS 4").rows == [(3,)]
+        # same boundaries again: nothing new, NO error
+        assert sess.query("SPLIT TABLE t REGIONS 4").rows == [(0,)]
+
+    def test_split_missing_table(self, sess):
+        with pytest.raises(SQLError):
+            sess.query("SPLIT TABLE nope REGIONS 2")
+
+
+class TestLoadDataPrivilege:
+    def test_nonlocal_needs_super_local_needs_insert(self, tmp_path):
+        from tidb_tpu.bootstrap import bootstrap
+        from tidb_tpu.store.storage import new_mock_storage
+        st = new_mock_storage()
+        bootstrap(st)
+        r = Session(st, user="root", host="%")
+        r.execute("CREATE DATABASE d")
+        r.execute("CREATE TABLE d.t (id BIGINT PRIMARY KEY)")
+        r.execute("CREATE USER 'bob'@'%' IDENTIFIED BY 'pw'")
+        r.execute("GRANT INSERT ON d.t TO 'bob'@'%'")
+        path = str(tmp_path / "f.tsv")
+        (tmp_path / "f.tsv").write_text("7\n")
+        bob = Session(st, user="bob", host="%", db="d")
+        # server-side file read is gated like MySQL's FILE privilege
+        with pytest.raises(SQLError, match="denied"):
+            bob.execute(f"LOAD DATA INFILE '{path}' INTO TABLE t (id)")
+        # LOCAL form only needs INSERT on the table
+        [n] = bob.execute(f"LOAD DATA LOCAL INFILE '{path}' "
+                          f"INTO TABLE t (id)")
+        assert n == 1
+        bob.close()
+        r.close()

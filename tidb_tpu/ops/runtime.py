@@ -30,6 +30,7 @@ def super_batches(first_parts, rest, limit: int):
     (distsql/distsql.go:92). Oversize chunks are sliced so one storage
     chunk cannot break the memory bound."""
     import itertools
+    limit = max(int(limit), 1)    # a 0/negative sysvar must not hang
     buf, total = [], 0
     for c in itertools.chain(first_parts, rest):
         start = 0

@@ -30,6 +30,7 @@ __all__ = [
     "UseStmt", "BeginStmt", "CommitStmt", "RollbackStmt",
     "SetStmt", "VarAssignment", "ShowStmt", "ExplainStmt", "AnalyzeStmt",
     "AdminStmt", "PrepareStmt", "ExecuteStmt", "DeallocateStmt",
+    "LoadDataStmt", "SplitTableStmt",
 ]
 
 
@@ -457,6 +458,33 @@ class DeallocateStmt(StmtNode):
 class AdminStmt(StmtNode):
     tp: str = ""             # show_ddl / check_table
     tables: list = field(default_factory=list)
+
+
+@dataclass
+class LoadDataStmt(StmtNode):
+    """LOAD DATA [LOCAL] INFILE (ref: ast/dml.go LoadDataStmt,
+    executor/write.go:1373 LoadData)."""
+    path: str = ""
+    local: bool = False
+    table: TableSource = None
+    columns: list = field(default_factory=list)   # [str]; empty = all
+    fields_terminated: str = "\t"
+    fields_enclosed: str = ""                     # "" = none
+    fields_escaped: str = "\\"
+    lines_starting: str = ""
+    lines_terminated: str = "\n"
+    ignore_lines: int = 0
+    dup_mode: str = "error"                       # error / ignore / replace
+
+
+@dataclass
+class SplitTableStmt(StmtNode):
+    """SPLIT TABLE t AT (v)[,(v)...] | SPLIT TABLE t REGIONS n
+    (ref: store/tikv/split_region.go:29 SplitRegion RPC; mocktikv
+    cluster.go:276 Split/SplitTable)."""
+    table: TableSource = None
+    at_values: list = field(default_factory=list)   # [ExprNode literals]
+    regions: int = 0                                # REGIONS n form
 
 
 # -- account management (ref: ast/misc.go CreateUserStmt/GrantStmt) ----------

@@ -59,6 +59,15 @@ KEYWORDS = {
     "PREPARE", "EXECUTE", "DEALLOCATE",
 }
 
+# Words with meaning only inside LOAD DATA / SPLIT TABLE clauses. They
+# stay ordinary identifiers everywhere else (reserving them would break
+# queries using e.g. `data` or `at` as column/alias names); the parser
+# matches them by value via try_word/expect_word.
+NON_RESERVED = {
+    "LOAD", "DATA", "INFILE", "TERMINATED", "ENCLOSED", "ESCAPED",
+    "LINES", "OPTIONALLY", "STARTING", "SPLIT", "AT", "REGIONS", "LOCAL",
+}
+
 
 @dataclass
 class Token:

@@ -89,6 +89,20 @@ class Parser:
         if not self.try_kw(kw):
             raise ParseError(f"expected {kw}", self.peek())
 
+    # non-reserved words (lexer.NON_RESERVED): keyword meaning only in
+    # LOAD DATA / SPLIT TABLE clauses, plain identifiers elsewhere
+    def try_word(self, *words: str) -> bool:
+        t = self.peek()
+        if t.tp in (TokenType.IDENT, TokenType.KEYWORD) and \
+                t.val.upper() in words:
+            self.next()
+            return True
+        return False
+
+    def expect_word(self, word: str) -> None:
+        if not self.try_word(word):
+            raise ParseError(f"expected {word}", self.peek())
+
     def try_op(self, op: str) -> bool:
         t = self.peek()
         if t.tp == TokenType.OP and t.val == op:
@@ -116,6 +130,10 @@ class Parser:
 
     def statement(self) -> ast.StmtNode:
         t = self.peek()
+        if t.tp == TokenType.IDENT and t.val.upper() in ("LOAD", "SPLIT"):
+            # non-reserved statement heads (see lexer.NON_RESERVED)
+            return self.load_data() if t.val.upper() == "LOAD" \
+                else self.split_table()
         if t.tp != TokenType.KEYWORD and not (t.tp == TokenType.OP and
                                               t.val == "("):
             raise ParseError("expected statement", t)
@@ -219,6 +237,94 @@ class Parser:
                 tables.append(self.table_name())
             return ast.AdminStmt(tp="check_table", tables=tables)
         raise ParseError("unsupported statement", t)
+
+    # -- LOAD DATA / SPLIT ---------------------------------------------------
+
+    def _str_lit(self) -> str:
+        tok = self.next()
+        if tok.tp != TokenType.STRING:
+            raise ParseError("expected string literal", tok)
+        return tok.val
+
+    def load_data(self) -> ast.LoadDataStmt:
+        """LOAD DATA [LOCAL] INFILE 'p' [REPLACE|IGNORE] INTO TABLE t
+        [FIELDS ...] [LINES ...] [IGNORE n LINES] [(cols)]
+        (ref: parser.y LoadDataStmt; executor/write.go:1373)."""
+        self.expect_word("LOAD")
+        self.expect_word("DATA")
+        stmt = ast.LoadDataStmt()
+        stmt.local = self.try_word("LOCAL")
+        self.expect_word("INFILE")
+        stmt.path = self._str_lit()
+        if self.try_kw("REPLACE"):
+            stmt.dup_mode = "replace"
+        elif self.try_kw("IGNORE"):
+            stmt.dup_mode = "ignore"
+        elif stmt.local:
+            stmt.dup_mode = "ignore"   # MySQL: LOCAL implies IGNORE
+        self.expect_kw("INTO")
+        self.expect_kw("TABLE")
+        stmt.table = self.table_name()
+        if self.try_kw("FIELDS", "COLUMNS"):
+            while True:
+                if self.try_word("TERMINATED"):
+                    self.expect_kw("BY")
+                    stmt.fields_terminated = self._str_lit()
+                elif self.try_word("OPTIONALLY"):
+                    self.expect_word("ENCLOSED")
+                    self.expect_kw("BY")
+                    stmt.fields_enclosed = self._str_lit()
+                elif self.try_word("ENCLOSED"):
+                    self.expect_kw("BY")
+                    stmt.fields_enclosed = self._str_lit()
+                elif self.try_word("ESCAPED"):
+                    self.expect_kw("BY")
+                    stmt.fields_escaped = self._str_lit()
+                else:
+                    break
+        if self.try_word("LINES"):
+            while True:
+                if self.try_word("STARTING"):
+                    self.expect_kw("BY")
+                    stmt.lines_starting = self._str_lit()
+                elif self.try_word("TERMINATED"):
+                    self.expect_kw("BY")
+                    stmt.lines_terminated = self._str_lit()
+                else:
+                    break
+        if self.try_kw("IGNORE"):
+            tok = self.next()
+            if tok.tp != TokenType.INT:
+                raise ParseError("IGNORE requires a row count", tok)
+            stmt.ignore_lines = int(tok.val)
+            self.expect_word("LINES")
+        if self.try_op("("):
+            while True:
+                stmt.columns.append(self.ident())
+                if not self.try_op(","):
+                    break
+            self.expect_op(")")
+        return stmt
+
+    def split_table(self) -> ast.SplitTableStmt:
+        """SPLIT TABLE t AT (v)[,(v)...] | SPLIT TABLE t REGIONS n."""
+        self.expect_word("SPLIT")
+        self.expect_kw("TABLE")
+        stmt = ast.SplitTableStmt(table=self.table_name())
+        if self.try_word("AT"):
+            while True:
+                self.expect_op("(")
+                stmt.at_values.append(self.expr())
+                self.expect_op(")")
+                if not self.try_op(","):
+                    break
+        else:
+            self.expect_word("REGIONS")
+            tok = self.next()
+            if tok.tp != TokenType.INT:
+                raise ParseError("REGIONS requires a count", tok)
+            stmt.regions = int(tok.val)
+        return stmt
 
     # -- SELECT --------------------------------------------------------------
 

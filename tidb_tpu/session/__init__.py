@@ -572,8 +572,10 @@ class Session:
             self.deallocate_prepared(stmt.name)
             return None
         if isinstance(stmt, (ast.InsertStmt, ast.UpdateStmt,
-                             ast.DeleteStmt)):
+                             ast.DeleteStmt, ast.LoadDataStmt)):
             return self._exec_dml(stmt)
+        if isinstance(stmt, ast.SplitTableStmt):
+            return self._exec_split_table(stmt)
         if isinstance(stmt, (ast.CreateDatabaseStmt, ast.CreateTableStmt,
                              ast.CreateIndexStmt, ast.DropTableStmt,
                              ast.DropDatabaseStmt, ast.DropIndexStmt,
@@ -722,12 +724,20 @@ class Session:
                     continue   # catalog metadata is world-readable
                 need(db, tbl, Priv.SELECT, "SELECT")
             return
+        if isinstance(stmt, ast.SplitTableStmt):
+            need("", "", Priv.SUPER, "SPLIT TABLE")
+            return
+        if isinstance(stmt, ast.LoadDataStmt) and not stmt.local:
+            # server-side file read: gated like MySQL's global FILE priv
+            # (SUPER here) so table INSERT alone can't read server files
+            need("", "", Priv.SUPER, "LOAD DATA INFILE (FILE)")
         if isinstance(stmt, (ast.InsertStmt, ast.UpdateStmt,
-                             ast.DeleteStmt)):
+                             ast.DeleteStmt, ast.LoadDataStmt)):
             want, what = {
                 ast.InsertStmt: (Priv.INSERT, "INSERT"),
                 ast.UpdateStmt: (Priv.UPDATE, "UPDATE"),
                 ast.DeleteStmt: (Priv.DELETE, "DELETE"),
+                ast.LoadDataStmt: (Priv.INSERT, "LOAD DATA"),
             }[type(stmt)]
             target = stmt.table
             tdb = (((target.db or self.current_db) or "") if
@@ -942,6 +952,8 @@ class Session:
         return n
 
     def _exec_dml_in_txn(self, stmt) -> int:
+        if isinstance(stmt, ast.LoadDataStmt):
+            return self._load_data_in_txn(stmt)
         try:
             plan = self._planner().plan(stmt)
         except (PlanError, ResolveError) as e:
@@ -954,6 +966,56 @@ class Session:
         ctx = ExecContext(self.storage, self.txn.start_ts, self.txn)
         exe = build_executor(plan)
         return exe.execute(ctx)
+
+    # -- LOAD DATA (ref: executor/write.go:1373 LoadDataExec) ----------------
+
+    def _load_data_in_txn(self, stmt: ast.LoadDataStmt) -> int:
+        from tidb_tpu.executor.loaddata import (RowsInsertExec,
+                                                convert_fields, parse_lines,
+                                                read_text_chunks)
+        info = self._resolve_table_or_err(stmt.table)
+        col_names = [c.lower() for c in stmt.columns] \
+            or [c.name.lower() for c in info.public_columns()]
+        try:
+            f = open(stmt.path, "r", encoding="utf-8", newline="")
+        except OSError as e:
+            raise SQLError(f"Can't get stat of '{stmt.path}': {e}") from None
+        with f:
+            self.txn.related_tables.add(info.id)
+            ctx = ExecContext(self.storage, self.txn.start_ts, self.txn)
+            rows = (convert_fields(info, col_names, fields)
+                    for fields in parse_lines(read_text_chunks(f), stmt))
+            return RowsInsertExec(info, rows, stmt.dup_mode).execute(ctx)
+
+    # -- SPLIT TABLE (ref: store/tikv/split_region.go:29; mocktikv
+    # cluster.go:276 Split/SplitTable) ---------------------------------------
+
+    def _exec_split_table(self, stmt: ast.SplitTableStmt) -> ResultSet:
+        info = self._resolve_table_or_err(stmt.table)
+        cluster = getattr(self.storage, "cluster", None)
+        if cluster is None:
+            raise SQLError("storage does not support region split")
+        if stmt.regions:
+            # evenly spaced handles (ref: cluster.go SplitTable), split
+            # one-by-one so re-running on existing boundaries is a no-op
+            max_handle = 1 << 20
+            span = max(max_handle // stmt.regions, 1)
+            handles = [span * i for i in range(1, stmt.regions)]
+        else:
+            handles = []
+            for e in stmt.at_values:
+                if not isinstance(e, ast.Literal) or \
+                        not isinstance(e.value, int):
+                    raise SQLError("SPLIT TABLE AT takes integer literals")
+                handles.append(int(e.value))
+        done = 0
+        for h in handles:
+            try:
+                cluster.split(tablecodec.record_key(info.id, h))
+                done += 1
+            except ValueError:       # already a region boundary
+                pass
+        return ResultSet(["TOTAL_SPLIT_REGION"], [(done,)])
 
     # -- SET / SHOW / EXPLAIN ------------------------------------------------
 
@@ -1086,6 +1148,13 @@ class Session:
         ischema = self.domain.info_schema()
         db = (getattr(ts, "db", "") or self.current_db)
         return ischema.table(db, ts.name)
+
+    def _resolve_table_or_err(self, ts):
+        from tidb_tpu.schema.infoschema import SchemaError
+        try:
+            return self._resolve_table(ts)
+        except SchemaError:
+            raise SQLError(f"Table '{ts.name}' doesn't exist") from None
 
     def _exec_analyze(self, stmt: ast.AnalyzeStmt):
         """ANALYZE TABLE: full-scan stats build + persist (ref:
