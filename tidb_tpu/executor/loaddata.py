@@ -45,20 +45,24 @@ def _unescape(s: str, esc: str) -> str | None:
     return "".join(out)
 
 
-def _split_lines(chunks, lt: str, enc: str, esc: str):
+def _split_lines(chunks, lt: str, ft: str, enc: str, esc: str):
     """Logical lines from a stream of text chunks: a terminator inside an
     enclosed field or behind the escape character does not end the row,
-    and a terminator/escape pair straddling a chunk boundary is handled
-    by holding back a small tail until more text arrives. Memory is
-    O(chunk + current line). Event scanning is find-based (one regex
-    alternation), not per-character."""
-    toks = [t for t in {esc, enc, lt} if t]
+    and a token straddling a chunk boundary is handled by holding back a
+    small tail until more text arrives. Memory is O(chunk + current
+    line). Event scanning is find-based (one regex alternation), not
+    per-character. An enclosure opens only at field start (line start or
+    right after a field terminator) — a stray quote mid-field is a
+    literal, exactly as in MySQL's parser."""
+    toks = [t for t in {esc, enc, lt, ft} if t]
     pat = re.compile("|".join(re.escape(t)
                               for t in sorted(toks, key=len, reverse=True)))
-    hold = max(len(lt), 2) - 1     # esc needs 1 lookahead, lt len(lt)-1
+    # longest token minus one, plus one char of escape/quote lookahead
+    hold = max(len(lt), len(ft), 2) - 1
     buf = ""
     cur: list[str] = []
     in_enc = False
+    field_start = True
     it = iter(chunks)
     final = False
     while True:
@@ -67,39 +71,58 @@ def _split_lines(chunks, lt: str, enc: str, esc: str):
                 buf += next(it)
             except StopIteration:
                 final = True
-        # tokens starting before `limit` always fit inside buf (hold
-        # covers the longest token minus one plus the escape lookahead)
+        # tokens starting before `limit` always fit inside buf
         limit = len(buf) if final else max(len(buf) - hold, 0)
         i = 0
         while i < limit:
             m = pat.search(buf, i)
             if m is None or m.start() >= limit:
-                cur.append(buf[i:limit])
+                if limit > i:
+                    cur.append(buf[i:limit])
+                    field_start = False
                 i = limit
                 break
             j = m.start()
             tok = m.group()
             if j > i:
                 cur.append(buf[i:j])
+                field_start = False
                 i = j
             if esc and buf.startswith(esc, j):
                 if j + len(esc) < len(buf):
                     cur.append(buf[j:j + len(esc) + 1])
                     i = j + len(esc) + 1
+                    field_start = False
                     continue
                 break              # lone escape at the end: literal tail
             if enc and tok == enc:
-                in_enc = not in_enc
+                if in_enc:
+                    if j + len(enc) < len(buf) and \
+                            buf.startswith(enc, j + len(enc)):
+                        cur.append(enc + enc)   # doubled quote: literal
+                        i = j + 2 * len(enc)
+                        continue
+                    in_enc = False
+                elif field_start:
+                    in_enc = True
                 cur.append(enc)
                 i = j + len(enc)
+                field_start = False
+                continue
+            if in_enc:             # ft/lt inside an enclosure: literal
+                cur.append(tok)
+                i = j + len(tok)
+                continue
+            if ft and tok == ft:   # longer tokens win the alternation
+                cur.append(ft)
+                i = j + len(ft)
+                field_start = True
                 continue
             # tok == lt
             i = j + len(lt)
-            if in_enc:
-                cur.append(lt)
-                continue
             yield "".join(cur)
             cur = []
+            field_start = True
         buf = buf[i:]
         if final:
             break
@@ -162,7 +185,7 @@ def parse_lines(text, stmt):
     enc = stmt.fields_enclosed
     esc = stmt.fields_escaped
     chunks = [text] if isinstance(text, str) else text
-    for li, line in enumerate(_split_lines(chunks, lt, enc, esc)):
+    for li, line in enumerate(_split_lines(chunks, lt, ft, enc, esc)):
         if li < stmt.ignore_lines:
             continue
         if stmt.lines_starting:

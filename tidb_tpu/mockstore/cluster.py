@@ -135,14 +135,22 @@ class Cluster:
             return left, right
 
     def split_table(self, table_id: int, count: int,
-                    max_handle: int = 1 << 20) -> None:
+                    max_handle: int = 1 << 20) -> int:
         """Split a table's record range into `count` regions at evenly spaced
-        handles in [0, max_handle). Ref: cluster.go SplitTable."""
+        handles in [0, max_handle); boundaries that already exist are
+        skipped, so a re-run is a no-op. -> number of new splits.
+        Ref: cluster.go SplitTable."""
         if count <= 1:
-            return
-        span = max_handle // count
+            return 0
+        span = max(max_handle // count, 1)
+        done = 0
         for i in range(1, count):
-            self.split(tablecodec.record_key(table_id, span * i))
+            try:
+                self.split(tablecodec.record_key(table_id, span * i))
+                done += 1
+            except ValueError:       # already a region boundary
+                pass
+        return done
 
     def split_keys(self, keys: list[bytes]) -> None:
         for k in keys:

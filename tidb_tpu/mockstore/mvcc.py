@@ -383,3 +383,92 @@ class MVCCStore:
     def num_keys(self) -> int:
         with self._mu:
             return len(self._entries)
+
+    # -- raw (non-transactional) namespace -----------------------------------
+    # Ref: store/tikv/rawkv.go — TiKV keeps raw keys in a separate column
+    # family; here a separate sorted map, invisible to MVCC readers.
+
+    @property
+    def _rawmap(self):
+        raw = self.__dict__.get("_raw")
+        if raw is None:          # engines unpickled from older snapshots
+            raw = self.__dict__["_raw"] = SortedDict()
+        return raw
+
+    def raw_get(self, key: bytes) -> Optional[bytes]:
+        with self._mu:
+            return self._rawmap.get(key)
+
+    def raw_batch_get(self, keys: list[bytes]) -> dict:
+        with self._mu:
+            raw = self._rawmap
+            return {k: raw[k] for k in keys if k in raw}
+
+    def raw_put(self, key: bytes, value: bytes) -> None:
+        with self._mu:
+            self._rawmap[key] = value
+
+    def raw_batch_put(self, pairs: list[tuple]) -> None:
+        with self._mu:
+            self._rawmap.update(dict(pairs))
+
+    def raw_delete(self, key: bytes) -> None:
+        with self._mu:
+            self._rawmap.pop(key, None)
+
+    def raw_scan(self, start: bytes, end: bytes,
+                 limit: int) -> list[tuple]:
+        with self._mu:
+            raw = self._rawmap
+            out = []
+            for k in raw.irange(start, end or None,
+                                inclusive=(True, False)):
+                out.append((k, raw[k]))
+                if len(out) >= limit:
+                    break
+            return out
+
+    def raw_delete_range(self, start: bytes, end: bytes) -> None:
+        with self._mu:
+            raw = self._rawmap
+            for k in list(raw.irange(start, end or None,
+                                     inclusive=(True, False))):
+                del raw[k]
+
+    # -- MVCC forensics (ref: server/region_handler.go:73-91 MvccGetByKey /
+    # MvccGetByStartTs; mocktikv rpc.go MvccGetByKey) -------------------------
+
+    def mvcc_by_key(self, key: bytes) -> dict:
+        """Every version of one key: pending lock + write column entries
+        with their values."""
+        with self._mu:
+            e = self._entries.get(key)
+            if e is None:
+                return {"key": key, "lock": None, "writes": []}
+            lock = None
+            if e.lock is not None:
+                lock = {"start_ts": e.lock.start_ts,
+                        "primary": e.lock.primary,
+                        "op": e.lock.op.name,
+                        "ttl_ms": e.lock.ttl_ms}
+            writes = [{"commit_ts": cts, "start_ts": sts, "type": wt.name,
+                       "value": e.data.get(sts)}
+                      for cts, sts, wt in e.writes]
+            return {"key": key, "lock": lock, "writes": writes}
+
+    def mvcc_by_start_ts(self, start_ts: int, start: bytes = b"",
+                         end: bytes = b"", limit: int = 256) -> list:
+        """Keys a transaction touched (committed writes, pending locks)."""
+        with self._mu:
+            out = []
+            for k in self._entries.irange(start, end or None,
+                                          inclusive=(True, False)):
+                e = self._entries[k]
+                hit = (e.lock is not None and
+                       e.lock.start_ts == start_ts) or \
+                    any(sts == start_ts for _cts, sts, _wt in e.writes)
+                if hit:
+                    out.append((k, self.mvcc_by_key(k)))
+                    if len(out) >= limit:
+                        break
+            return out

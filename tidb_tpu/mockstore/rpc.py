@@ -133,6 +133,50 @@ class RPCShim:
         self._check("SplitRegion", ctx)
         return self.cluster.split(key)
 
+    # -- raw KV (ref: tikvrpc.go Raw* commands; rawkv.go client) -------------
+
+    def raw_get(self, ctx: RegionCtx, key: bytes):
+        self._check("RawGet", ctx)
+        return self.store.raw_get(key)
+
+    def raw_batch_get(self, ctx: RegionCtx, keys: list[bytes]):
+        r = self._check("RawBatchGet", ctx)
+        self._check_keys_in(r, keys)
+        return self.store.raw_batch_get(keys)
+
+    def raw_put(self, ctx: RegionCtx, key: bytes, value: bytes):
+        self._check("RawPut", ctx)
+        self.store.raw_put(key, value)
+
+    def raw_batch_put(self, ctx: RegionCtx, pairs: list[tuple]):
+        r = self._check("RawBatchPut", ctx)
+        self._check_keys_in(r, [k for k, _v in pairs])
+        self.store.raw_batch_put(pairs)
+
+    def raw_delete(self, ctx: RegionCtx, key: bytes):
+        self._check("RawDelete", ctx)
+        self.store.raw_delete(key)
+
+    def raw_scan(self, ctx: RegionCtx, start: bytes, end: bytes,
+                 limit: int):
+        r = self._check("RawScan", ctx)
+        end = min(end, r.end) if (end and r.end) else (end or r.end)
+        return self.store.raw_scan(max(start, r.start), end, limit)
+
+    def raw_delete_range(self, ctx: RegionCtx, start: bytes, end: bytes):
+        r = self._check("RawDeleteRange", ctx)
+        end = min(end, r.end) if (end and r.end) else (end or r.end)
+        self.store.raw_delete_range(max(start, r.start), end)
+
+    # -- MVCC forensics (debug API, no region ctx: ref
+    # server/region_handler.go MvccGetByKey/MvccGetByStartTs) ----------------
+
+    def mvcc_by_key(self, key: bytes):
+        return self.store.mvcc_by_key(key)
+
+    def mvcc_by_start_ts(self, start_ts: int, **kw):
+        return self.store.mvcc_by_start_ts(start_ts, **kw)
+
     def coprocessor(self, ctx: RegionCtx, req):
         """Executes a pushed-down subplan against this region's data.
         Handler installed by tidb_tpu.store.copr (set at storage build time

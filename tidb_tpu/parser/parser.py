@@ -14,7 +14,8 @@ import decimal
 
 from tidb_tpu import sqltypes as st
 from tidb_tpu.parser import ast
-from tidb_tpu.parser.lexer import Lexer, Token, TokenType
+from tidb_tpu.parser.lexer import (Lexer, NON_RESERVED, Token,
+                                   TokenType)
 
 __all__ = ["parse", "parse_one", "ParseError"]
 
@@ -92,6 +93,10 @@ class Parser:
     # non-reserved words (lexer.NON_RESERVED): keyword meaning only in
     # LOAD DATA / SPLIT TABLE clauses, plain identifiers elsewhere
     def try_word(self, *words: str) -> bool:
+        unknown = [w for w in words if w not in NON_RESERVED]
+        if unknown:   # programming-error guard: keep the registry honest
+            raise ParseError(
+                f"internal: {unknown} missing from lexer.NON_RESERVED")
         t = self.peek()
         if t.tp in (TokenType.IDENT, TokenType.KEYWORD) and \
                 t.val.upper() in words:

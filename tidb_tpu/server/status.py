@@ -1,5 +1,9 @@
-"""HTTP status server: /status, /metrics (ref: server/http_status.go —
-the :10080 admin API; Prometheus text on /metrics)."""
+"""HTTP status server: /status, /metrics, and the region/MVCC debug API.
+
+Ref: server/http_status.go (the :10080 admin API; Prometheus text on
+/metrics) and server/region_handler.go:73-91 (table regions, MVCC
+forensics by key and by start_ts — the tools an operator uses to answer
+"which region holds row X?" and "what did txn T touch?")."""
 
 from __future__ import annotations
 
@@ -7,9 +11,36 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from tidb_tpu import __version__, metrics
+from tidb_tpu import __version__, metrics, tablecodec
 
 __all__ = ["StatusServer"]
+
+
+def _hex(b: bytes) -> str:
+    return b.hex()
+
+
+def _region_json(r) -> dict:
+    return {"id": r.id, "start_key": _hex(r.start), "end_key": _hex(r.end),
+            "version": r.version, "conf_ver": r.conf_ver,
+            "leader_store": r.leader_store,
+            "peer_stores": list(r.peer_stores)}
+
+
+def _jsonable(v):
+    if isinstance(v, bytes):
+        return _hex(v)
+    if isinstance(v, dict):
+        return {k: _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    return v
+
+
+def _all_regions(storage) -> list:
+    cluster = storage.cluster
+    fn = getattr(cluster, "all_regions", None)
+    return fn() if fn is not None else []
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -18,28 +49,94 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # quiet
         pass
 
-    def do_GET(self):  # noqa: N802 - stdlib API
-        if self.path == "/metrics":
-            body = metrics.expose().encode()
-            ctype = "text/plain; version=0.0.4"
-        elif self.path in ("/", "/status"):
-            st = self.server.ctx_storage
-            body = json.dumps({
-                "version": __version__,
-                "connections": len(getattr(self.server.ctx_server,
-                                           "_conns", ())),
-                "regions": len(st.cluster._regions),
-                "metrics": metrics.snapshot(),
-            }, indent=2).encode()
-            ctype = "application/json"
-        else:
-            self.send_error(404)
-            return
-        self.send_response(200)
-        self.send_header("Content-Type", ctype)
+    # -- route helpers -------------------------------------------------------
+
+    def _json(self, obj, code: int = 200) -> None:
+        body = json.dumps(obj, indent=2).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+
+    def _table_info(self, db: str, name: str):
+        from tidb_tpu.session import Domain
+        dom = Domain.get(self.server.ctx_storage)
+        return dom.info_schema().table(db, name)
+
+    def _table_regions(self, db: str, name: str):
+        info = self._table_info(db, name)
+        lo, hi = tablecodec.table_prefix_range(info.id)
+        out = []
+        for r in _all_regions(self.server.ctx_storage):
+            if (not r.end or r.end > lo) and (not hi or r.start < hi):
+                out.append(_region_json(r))
+        return {"table": f"{db}.{name}", "table_id": info.id,
+                "record_prefix": _hex(tablecodec.record_prefix(info.id)),
+                "regions": out}
+
+    def _mvcc_key(self, db: str, name: str, handle: int):
+        info = self._table_info(db, name)
+        key = tablecodec.record_key(info.id, handle)
+        st = self.server.ctx_storage
+        out = st.shim.mvcc_by_key(key)
+        out = _jsonable(out)
+        out["table"] = f"{db}.{name}"
+        out["handle"] = handle
+        return out
+
+    # -- dispatch ------------------------------------------------------------
+
+    def do_GET(self):  # noqa: N802 - stdlib API
+        st = self.server.ctx_storage
+        parts = [p for p in self.path.split("/") if p]
+        try:
+            if self.path == "/metrics":
+                body = metrics.expose().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            if self.path in ("/", "/status"):
+                self._json({
+                    "version": __version__,
+                    "connections": len(getattr(self.server.ctx_server,
+                                               "_conns", ())),
+                    "regions": len(_all_regions(st)),
+                    "metrics": metrics.snapshot(),
+                })
+                return
+            if parts == ["regions"]:
+                self._json([_region_json(r) for r in _all_regions(st)])
+                return
+            if len(parts) == 2 and parts[0] == "regions":
+                rid = int(parts[1])
+                for r in _all_regions(st):
+                    if r.id == rid:
+                        self._json(_region_json(r))
+                        return
+                self._json({"error": f"no region {rid}"}, 404)
+                return
+            if len(parts) == 4 and parts[0] == "tables" \
+                    and parts[3] == "regions":
+                self._json(self._table_regions(parts[1], parts[2]))
+                return
+            if len(parts) == 5 and parts[:2] == ["mvcc", "key"]:
+                self._json(self._mvcc_key(parts[2], parts[3],
+                                          int(parts[4])))
+                return
+            if len(parts) == 3 and parts[:2] == ["mvcc", "txn"]:
+                hits = st.shim.mvcc_by_start_ts(int(parts[2]))
+                self._json([{"key": _hex(k), "mvcc": _jsonable(m)}
+                            for k, m in hits])
+                return
+        except Exception as e:  # noqa: BLE001 - debug API reports errors
+            self._json({"error": str(e)}, 500)
+            return
+        self.send_error(404)
 
 
 class StatusServer:

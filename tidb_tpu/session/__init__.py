@@ -996,25 +996,19 @@ class Session:
         if cluster is None:
             raise SQLError("storage does not support region split")
         if stmt.regions:
-            # evenly spaced handles (ref: cluster.go SplitTable), split
-            # one-by-one so re-running on existing boundaries is a no-op
-            max_handle = 1 << 20
-            span = max(max_handle // stmt.regions, 1)
-            handles = [span * i for i in range(1, stmt.regions)]
+            done = cluster.split_table(info.id, stmt.regions)
         else:
-            handles = []
+            done = 0
             for e in stmt.at_values:
                 if not isinstance(e, ast.Literal) or \
                         not isinstance(e.value, int):
                     raise SQLError("SPLIT TABLE AT takes integer literals")
-                handles.append(int(e.value))
-        done = 0
-        for h in handles:
-            try:
-                cluster.split(tablecodec.record_key(info.id, h))
-                done += 1
-            except ValueError:       # already a region boundary
-                pass
+                try:
+                    cluster.split(
+                        tablecodec.record_key(info.id, int(e.value)))
+                    done += 1
+                except ValueError:   # already a region boundary
+                    pass
         return ResultSet(["TOTAL_SPLIT_REGION"], [(done,)])
 
     # -- SET / SHOW / EXPLAIN ------------------------------------------------

@@ -46,7 +46,12 @@ _STATUS_ERR = 1
 # commands safe to re-send after an indeterminate failure
 _IDEMPOTENT = {"kv_get", "kv_batch_get", "kv_scan", "kv_scan_lock",
                "coprocessor", "region_by_key", "tso", "kv_cleanup",
-               "snapshot_batch_get", "ping", "regions_snapshot"}
+               "snapshot_batch_get", "ping", "regions_snapshot",
+               # raw ops are idempotent by definition (no MVCC, repeat
+               # puts/deletes converge); mvcc_* are pure reads
+               "raw_get", "raw_batch_get", "raw_scan", "raw_put",
+               "raw_batch_put", "raw_delete", "raw_delete_range",
+               "mvcc_by_key", "mvcc_by_start_ts"}
 
 MAX_CONNS = 16   # ref: client.go:37 MaxConnectionCount
 
@@ -292,6 +297,9 @@ class _RemotePD:
     def tso(self) -> int:
         return self.client.call("tso")
 
+    def all_regions(self):
+        return self.client.call("regions_snapshot")
+
     # test/benchmark topology control
     def split(self, key: bytes):
         return self.client.call("split", key)
@@ -309,8 +317,8 @@ class _RemoteShim:
         self.client = client
 
     def __getattr__(self, name: str):
-        if name.startswith("kv_") or name in ("coprocessor",
-                                              "split_region"):
+        if name.startswith(("kv_", "raw_", "mvcc_")) or \
+                name in ("coprocessor", "split_region"):
             def call(*args, **kwargs):
                 return self.client.call(name, *args, **kwargs)
             return call
