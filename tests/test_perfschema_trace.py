@@ -1,0 +1,132 @@
+"""PERFORMANCE_SCHEMA statement events + statement tracing (ref:
+perfschema/const.go:120-298; the OpenTracing spans of session.go:692)."""
+
+import logging
+
+import pytest
+
+from tidb_tpu import perfschema, trace
+from tidb_tpu.session import Session
+from tidb_tpu.store.storage import new_mock_storage
+
+
+@pytest.fixture
+def sess():
+    perfschema.reset()
+    s = Session(new_mock_storage())
+    s.execute("CREATE DATABASE d")
+    s.execute("USE d")
+    s.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, v BIGINT)")
+    s.execute("INSERT INTO t VALUES (1, 10), (2, 20), (3, 30)")
+    yield s
+    s.close()
+
+
+class TestStatementEvents:
+    def test_history_records_phases(self, sess):
+        sess.query("SELECT SUM(v) FROM t")
+        rows = sess.query(
+            "SELECT sql_text, state, timer_wait_ns, parse_ns, plan_ns, "
+            "exec_ns FROM performance_schema.events_statements_history "
+            "ORDER BY event_id").rows
+        # the SELECT SUM itself is in-flight, not yet in history
+        assert any("INSERT INTO t" in r[0] for r in rows)
+        done = [r for r in rows if "SUM(v)" in r[0]]
+        assert done and done[0][1] == "completed"
+        _sql, _state, wait, parse, plan, execute = done[0]
+        assert wait > 0 and parse > 0
+        assert plan > 0 and execute > 0
+        assert plan + execute <= wait
+
+    def test_commit_phase_recorded(self, sess):
+        sess.execute("BEGIN")
+        sess.execute("INSERT INTO t VALUES (9, 90)")
+        sess.execute("COMMIT")
+        rows = sess.query(
+            "SELECT sql_text, commit_ns FROM "
+            "performance_schema.events_statements_history").rows
+        commits = [r for r in rows if r[0] == "COMMIT"]
+        assert commits and commits[-1][1] > 0
+
+    def test_error_state(self, sess):
+        with pytest.raises(Exception):
+            sess.query("SELECT * FROM does_not_exist")
+        rows = sess.query(
+            "SELECT sql_text, state, error FROM "
+            "performance_schema.events_statements_history").rows
+        bad = [r for r in rows if "does_not_exist" in r[0]]
+        assert bad and bad[-1][1] == "error" and bad[-1][2]
+
+    def test_current_shows_running_statement(self, sess):
+        rows = sess.query(
+            "SELECT thread_id, state, sql_text FROM "
+            "performance_schema.events_statements_current").rows
+        me = [r for r in rows if r[0] == sess.session_id]
+        # this very query is the session's current event
+        assert me and me[0][1] == "running"
+        assert "events_statements_current" in me[0][2]
+
+    def test_rows_sent(self, sess):
+        sess.query("SELECT * FROM t")
+        rows = sess.query(
+            "SELECT sql_text, rows_sent FROM "
+            "performance_schema.events_statements_history").rows
+        sel = [r for r in rows if r[0] == "SELECT * FROM t"]
+        assert sel and sel[-1][1] == 3
+
+    def test_show_tables_and_use(self, sess):
+        sess.execute("USE performance_schema")
+        rows = sess.query("SHOW TABLES").rows
+        assert ("events_statements_history",) in rows
+        sess.execute("USE d")
+
+    def test_internal_sessions_invisible(self, sess):
+        rows = sess.query(
+            "SELECT COUNT(*) FROM "
+            "performance_schema.events_statements_current "
+            "WHERE thread_id <> %d" % sess.session_id).rows
+        assert rows == [(0,)]
+
+
+class TestTrace:
+    def test_span_tree_shape(self):
+        root = trace.begin("statement")
+        with trace.span("plan"):
+            pass
+        with trace.span("execute"):
+            with trace.span("cop"):
+                pass
+        trace.end(root)
+        names = [c.name for c in root.children]
+        assert names == ["plan", "execute"]
+        assert root.children[1].children[0].name == "cop"
+        assert trace.phase_ns(root, "plan") > 0
+        assert root.duration_ns >= sum(c.duration_ns
+                                       for c in root.children)
+
+    def test_worker_thread_spans_detached(self):
+        import threading
+        root = trace.begin("statement")
+        seen = []
+
+        def worker():
+            with trace.span("inner") as s:
+                seen.append(s)
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        trace.end(root)
+        # a span opened on another thread never attaches to this root
+        assert root.children == [] and seen
+
+    def test_trace_log_sysvar(self, sess, caplog):
+        from tidb_tpu import config
+        config.set_var("tidb_tpu_trace_log", 1)
+        try:
+            with caplog.at_level(logging.INFO, logger="tidb_tpu.trace"):
+                sess.query("SELECT COUNT(*) FROM t")
+            assert any("trace for" in r.message for r in caplog.records)
+            assert any("execute" in r.message for r in caplog.records)
+        finally:
+            config.set_var("tidb_tpu_trace_log", 0)

@@ -51,6 +51,9 @@ _DEFS: dict[str, tuple[str, int]] = {
     # statements at/above this wall time land in the slow-query log
     # (ref: config.Log.SlowThreshold, default 300ms)
     "tidb_tpu_slow_query_ms": (_INT, 300),
+    # emit every statement's span tree to the tidb_tpu.trace logger
+    # (ref: the OpenTracing spans of session.go:692 / compiler.go:34)
+    "tidb_tpu_trace_log": (_BOOL, 0),
 }
 
 _lock = threading.Lock()

@@ -45,7 +45,8 @@ def _unescape(s: str, esc: str) -> str | None:
     return "".join(out)
 
 
-def _split_lines(chunks, lt: str, ft: str, enc: str, esc: str):
+def _split_lines(chunks, lt: str, ft: str, enc: str, esc: str,
+                 starting: str = ""):
     """Logical lines from a stream of text chunks: a terminator inside an
     enclosed field or behind the escape character does not end the row,
     and a token straddling a chunk boundary is handled by holding back a
@@ -53,16 +54,20 @@ def _split_lines(chunks, lt: str, ft: str, enc: str, esc: str):
     line). Event scanning is find-based (one regex alternation), not
     per-character. An enclosure opens only at field start (line start or
     right after a field terminator) — a stray quote mid-field is a
-    literal, exactly as in MySQL's parser."""
+    literal, exactly as in MySQL's parser. With LINES STARTING BY, text
+    up to the prefix is skipped RAW (quotes there carry no meaning) and
+    prefix-less lines are dropped whole."""
     toks = [t for t in {esc, enc, lt, ft} if t]
     pat = re.compile("|".join(re.escape(t)
                               for t in sorted(toks, key=len, reverse=True)))
-    # longest token minus one, plus one char of escape/quote lookahead
-    hold = max(len(lt), len(ft), 2) - 1
+    # longest token minus one, plus one char of escape/quote lookahead;
+    # a straddling line prefix needs its own length of held-back tail
+    hold = max(len(lt), len(ft), len(starting) + 1, 2) - 1
     buf = ""
     cur: list[str] = []
     in_enc = False
     field_start = True
+    skipping = bool(starting)      # before the line prefix
     it = iter(chunks)
     final = False
     while True:
@@ -75,6 +80,23 @@ def _split_lines(chunks, lt: str, ft: str, enc: str, esc: str):
         limit = len(buf) if final else max(len(buf) - hold, 0)
         i = 0
         while i < limit:
+            if skipping:
+                p = buf.find(starting, i, limit + len(starting) - 1)
+                if p >= limit:
+                    p = -1         # starts in the held-back tail: wait
+                l_ = buf.find(lt, i, limit + len(lt) - 1)
+                if l_ >= limit:
+                    l_ = -1
+                if 0 <= p and (l_ < 0 or p < l_):
+                    i = p + len(starting)
+                    skipping = False
+                    field_start = True
+                    continue
+                if 0 <= l_:        # prefix-less line: drop it whole
+                    i = l_ + len(lt)
+                    continue
+                i = limit          # no event yet: discard scanned text
+                break
             m = pat.search(buf, i)
             if m is None or m.start() >= limit:
                 if limit > i:
@@ -123,10 +145,11 @@ def _split_lines(chunks, lt: str, ft: str, enc: str, esc: str):
             yield "".join(cur)
             cur = []
             field_start = True
+            skipping = bool(starting)
         buf = buf[i:]
         if final:
             break
-    if cur or buf:
+    if not skipping and (cur or buf):
         cur.append(buf)
         yield "".join(cur)
 
@@ -185,14 +208,10 @@ def parse_lines(text, stmt):
     enc = stmt.fields_enclosed
     esc = stmt.fields_escaped
     chunks = [text] if isinstance(text, str) else text
-    for li, line in enumerate(_split_lines(chunks, lt, ft, enc, esc)):
+    for li, line in enumerate(_split_lines(chunks, lt, ft, enc, esc,
+                                           stmt.lines_starting or "")):
         if li < stmt.ignore_lines:
             continue
-        if stmt.lines_starting:
-            at = line.find(stmt.lines_starting)
-            if at < 0:
-                continue          # MySQL skips lines without the prefix
-            line = line[at + len(stmt.lines_starting):]
         if not line:
             continue
         yield _split_fields(line, ft, enc, esc)

@@ -1,0 +1,83 @@
+"""PERFORMANCE_SCHEMA statement events.
+
+Reference: /root/reference/perfschema/const.go:120-298 — the
+events_statements_current / events_statements_history virtual tables.
+Process-wide: a per-session current-event slot plus a bounded history
+ring; every non-internal statement records its SQL, wall time, phase
+breakdown (parse/plan/execute/commit, from the trace span tree), row
+count and error state. Served as memtables by the planner, exactly like
+INFORMATION_SCHEMA."""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+__all__ = ["stmt_begin", "stmt_end", "current_events", "history_events",
+           "HISTORY_CAP"]
+
+HISTORY_CAP = 1024
+
+_lock = threading.Lock()
+_history: deque = deque(maxlen=HISTORY_CAP)
+_current: dict[int, dict] = {}       # session_id -> live event
+_event_seq = 0
+
+
+def stmt_begin(session_id: int, sql: str) -> dict:
+    global _event_seq
+    with _lock:
+        _event_seq += 1
+        ev = {
+            "thread_id": session_id,
+            "event_id": _event_seq,
+            "sql_text": sql[:1024],
+            "state": "running",
+            "timer_start_us": int(time.time() * 1e6),
+            "timer_wait_ns": 0,
+            "parse_ns": 0, "plan_ns": 0, "exec_ns": 0, "commit_ns": 0,
+            "rows": 0,
+            "error": None,
+        }
+        _current[session_id] = ev
+        return ev
+
+
+def stmt_end(ev: dict, root=None, rows: int = 0,
+             error: str | None = None) -> None:
+    from tidb_tpu import trace
+    with _lock:
+        ev["state"] = "error" if error else "completed"
+        ev["error"] = error and error[:256]
+        ev["rows"] = rows
+        if root is not None:
+            ev["timer_wait_ns"] = root.duration_ns
+            for phase in ("parse", "plan", "execute", "commit"):
+                key = ("exec" if phase == "execute" else phase) + "_ns"
+                ev[key] = trace.phase_ns(root, phase)
+        _history.append(dict(ev))
+
+
+def session_closed(session_id: int) -> None:
+    with _lock:
+        _current.pop(session_id, None)
+
+
+def current_events() -> list[dict]:
+    with _lock:
+        return [dict(ev) for _sid, ev in sorted(_current.items())]
+
+
+def history_events() -> list[dict]:
+    with _lock:
+        return [dict(ev) for ev in _history]
+
+
+def reset() -> None:
+    """Test hook."""
+    global _event_seq
+    with _lock:
+        _history.clear()
+        _current.clear()
+        _event_seq = 0

@@ -138,6 +138,8 @@ class Planner:
         db = (ts.db or self.db).lower()
         if db == "information_schema":
             return self._build_memtable(ts)
+        if db == "performance_schema":
+            return self._build_perfschema(ts)
         _db, info = self._table_info(ts)
         cols = info.public_columns()
         schema = PlanSchema([
@@ -222,6 +224,46 @@ class Planner:
         raise PlanError(
             f"Unknown table 'information_schema.{ts.name}' "
             f"(available: {', '.join(self._MEMTABLES)})")
+
+    # -- PERFORMANCE_SCHEMA virtual tables (ref: perfschema/const.go:120-298
+    # events_statements_current / events_statements_history) -----------------
+
+    _PERF_TABLES = ("events_statements_current",
+                    "events_statements_history")
+
+    def _build_perfschema(self, ts: ast.TableSource) -> ph.PhysValues:
+        from tidb_tpu import perfschema
+        from tidb_tpu.sqltypes import new_int_field, new_string_field
+        name = ts.name.lower()
+        alias = ts.ref_name.lower()
+        if name not in self._PERF_TABLES:
+            raise PlanError(
+                f"Unknown table 'performance_schema.{ts.name}' "
+                f"(available: {', '.join(self._PERF_TABLES)})")
+        events = perfschema.current_events() \
+            if name == "events_statements_current" \
+            else perfschema.history_events()
+        sf, intf = new_string_field(1024), new_int_field()
+        cols_spec = [("thread_id", intf), ("event_id", intf),
+                     ("sql_text", sf), ("state", sf),
+                     ("timer_start_us", intf), ("timer_wait_ns", intf),
+                     ("parse_ns", intf), ("plan_ns", intf),
+                     ("exec_ns", intf), ("commit_ns", intf),
+                     ("rows_sent", intf), ("error", sf)]
+        schema = PlanSchema([SchemaCol(n, alias, ft)
+                             for n, ft in cols_spec])
+        rows = []
+        for ev in events:
+            rows.append([Constant(v, ft) for v, (_n, ft) in zip(
+                (ev["thread_id"], ev["event_id"], ev["sql_text"],
+                 ev["state"], ev["timer_start_us"], ev["timer_wait_ns"],
+                 ev["parse_ns"], ev["plan_ns"], ev["exec_ns"],
+                 ev["commit_ns"], ev["rows"], ev["error"]), cols_spec)])
+        pv = ph.PhysValues(schema=schema, rows=rows)
+        # events change per statement with no schema-version bump: a
+        # cached plan would serve a frozen snapshot forever
+        pv.cacheable = False
+        return pv
 
     def build_from(self, node) -> ph.PhysPlan:
         if isinstance(node, ast.TableSource):

@@ -349,7 +349,10 @@ class Session:
     def execute(self, sql: str):
         """Execute semicolon-separated statements; returns a list of
         ResultSet (queries) / int (affected rows) / None (commands)."""
+        t0 = time.perf_counter_ns()
         stmts = parse(sql)
+        # batch parse cost is attributed evenly across its statements
+        self._parse_ns = (time.perf_counter_ns() - t0) // max(len(stmts), 1)
         out = []
         single = sql if len(stmts) == 1 else None
         # auth statements never expose credentials in the processlist or
@@ -368,21 +371,40 @@ class Session:
         slow-log emit at :353). Internal bookkeeping sessions skip the
         instrumentation entirely — their catalog lookups are not client
         queries and would pollute the metrics."""
-        from tidb_tpu import config, metrics
+        from tidb_tpu import config, metrics, perfschema, trace
         if self.internal:
             return self._run_stmt(stmt, sql_text=sql_text)
         self.current_sql = sql
         self._stmt_start = time.perf_counter()
         kind = type(stmt).__name__.removesuffix("Stmt").lower()
+        ev = perfschema.stmt_begin(self.session_id, sql)
+        root = trace.begin("statement", type=kind)
+        # parse happened batch-wide before dispatch: record this
+        # statement's share as a pre-closed phase span, and back-date the
+        # root so timer_wait covers it (phases must sum <= total)
+        pspan = trace.Span("parse")
+        pspan.start_ns = root.start_ns - getattr(self, "_parse_ns", 0)
+        pspan.end_ns = root.start_ns
+        root.start_ns = pspan.start_ns
+        root.children.append(pspan)
+        err: str | None = None
+        res = None
         try:
             res = self._run_stmt(stmt, sql_text=sql_text)
-        except Exception:
+        except Exception as e:
             metrics.counter(metrics.QUERY_ERRORS)
+            err = str(e)
             raise
         finally:
+            trace.end(root)
             dur = time.perf_counter() - self._stmt_start
             metrics.counter(metrics.QUERIES_TOTAL, {"type": kind})
             metrics.histogram(metrics.QUERY_DURATIONS, dur)
+            nrows = len(res.rows) if isinstance(res, ResultSet) else \
+                (res if isinstance(res, int) else 0)
+            perfschema.stmt_end(ev, root=root, rows=nrows, error=err)
+            if config.get_var("tidb_tpu_trace_log"):
+                trace.log_tree(root, sql)
             if dur * 1000 >= config.get_var("tidb_tpu_slow_query_ms"):
                 metrics.counter(metrics.SLOW_QUERIES)
                 slow_log.warning(
@@ -457,7 +479,14 @@ class Session:
         for m, v in zip(p.markers, params):
             m.value = v
             m.bound = True
-        return self._run_stmt(p.stmt)
+        if self.current_sql is not None:
+            # SQL-level EXECUTE: already inside this statement's
+            # _timed_stmt frame — don't double-record
+            return self._run_stmt(p.stmt)
+        # binary-protocol COM_STMT_EXECUTE: full instrumentation (events,
+        # spans, metrics, slow log), parse cost paid at prepare time
+        self._parse_ns = 0
+        return self._timed_stmt(p.stmt, p.sql, sql_text=None)
 
     def deallocate_prepared(self, stmt_id) -> None:
         key = stmt_id.lower() if isinstance(stmt_id, str) else stmt_id
@@ -486,6 +515,9 @@ class Session:
             raise SQLError(str(e)) from None
 
     def close(self):
+        from tidb_tpu import perfschema
+        if not self.internal:
+            perfschema.session_closed(self.session_id)
         if self.txn is not None:
             self.txn.rollback()
             self.txn = None
@@ -513,37 +545,43 @@ class Session:
         """Commit with optimistic retry: on retryable conflict, replay the
         txn's statement history at a fresh ts (ref: session.go:287
         doCommitWithRetry + retry :393)."""
+        from tidb_tpu import trace
         txn = self.txn
         self.txn = None
         if txn is None:
             return
         history = self._history
         self._history = []
-        try:
-            txn.commit()
-            return
-        except kv.UndeterminedError:
-            raise
-        except kv.RetryableError as first_err:
-            last = first_err
-            for _ in range(COMMIT_RETRY_LIMIT):
-                retry_txn = self.storage.begin()
-                self._attach_schema_checker(retry_txn)
-                try:
-                    self.txn = retry_txn
-                    for stmt in history:
-                        self._exec_dml_in_txn(stmt)
-                    self.txn = None
-                    retry_txn.commit()
-                    return
-                except kv.RetryableError as e:
-                    self.txn = None
-                    last = e
-                except Exception:
-                    self.txn = None
-                    retry_txn.rollback()
-                    raise
-            raise last
+        # one span covers first attempt AND replay retries: commit_ns must
+        # reflect the slow, conflicted commits most of all
+        with trace.span("commit") as cspan:
+            try:
+                txn.commit()
+                return
+            except kv.UndeterminedError:
+                raise
+            except kv.RetryableError as first_err:
+                last = first_err
+                for _ in range(COMMIT_RETRY_LIMIT):
+                    cspan.tags["retries"] = \
+                        cspan.tags.get("retries", 0) + 1
+                    retry_txn = self.storage.begin()
+                    self._attach_schema_checker(retry_txn)
+                    try:
+                        self.txn = retry_txn
+                        for stmt in history:
+                            self._exec_dml_in_txn(stmt)
+                        self.txn = None
+                        retry_txn.commit()
+                        return
+                    except kv.RetryableError as e:
+                        self.txn = None
+                        last = e
+                    except Exception:
+                        self.txn = None
+                        retry_txn.rollback()
+                        raise
+                raise last
 
     def _rollback(self):
         if self.txn is not None:
@@ -595,7 +633,8 @@ class Session:
             return None
         if isinstance(stmt, ast.UseStmt):
             ischema = self.domain.info_schema()
-            if stmt.db.lower() != "information_schema" and \
+            if stmt.db.lower() not in ("information_schema",
+                                       "performance_schema") and \
                     not ischema.has_db(stmt.db):
                 raise SQLError(f"Unknown database '{stmt.db}'")
             self.current_db = stmt.db
@@ -720,7 +759,7 @@ class Session:
                              ast.AnalyzeStmt)):
             for db, tbl in _referenced_tables(stmt):
                 db = (db or self.current_db or "").lower()
-                if db == "information_schema":
+                if db in ("information_schema", "performance_schema"):
                     continue   # catalog metadata is world-readable
                 need(db, tbl, Priv.SELECT, "SELECT")
             return
@@ -892,6 +931,7 @@ class Session:
                        stats_handle=self.domain.stats_handle())
 
     def _exec_query(self, stmt, sql_text: str | None = None) -> ResultSet:
+        from tidb_tpu import trace
         plan = None
         cache_key = None
         if sql_text is not None and isinstance(stmt, (ast.SelectStmt,
@@ -903,16 +943,19 @@ class Session:
                          mesh_config.mesh_generation())
             plan = self.domain.plan_cache().get(cache_key)
         if plan is None:
-            try:
-                plan = self._planner().plan(stmt)
-            except (PlanError, ResolveError) as e:
-                raise SQLError(str(e)) from None
+            with trace.span("plan", cached=False):
+                try:
+                    plan = self._planner().plan(stmt)
+                except (PlanError, ResolveError) as e:
+                    raise SQLError(str(e)) from None
             if cache_key is not None and _plan_cacheable(plan):
                 self.domain.plan_cache().put(cache_key, plan)
         ctx = ExecContext(self.storage, self._read_ts(), self.txn)
         exe = build_executor(plan)
         try:
-            chunks = list(exe.chunks(ctx))
+            with trace.span("execute",
+                            executor=type(exe).__name__):
+                chunks = list(exe.chunks(ctx))
         except ExecError as e:
             raise SQLError(str(e)) from None
         names = [c.name for c in plan.schema.cols]
@@ -952,12 +995,15 @@ class Session:
         return n
 
     def _exec_dml_in_txn(self, stmt) -> int:
+        from tidb_tpu import trace
         if isinstance(stmt, ast.LoadDataStmt):
-            return self._load_data_in_txn(stmt)
-        try:
-            plan = self._planner().plan(stmt)
-        except (PlanError, ResolveError) as e:
-            raise SQLError(str(e)) from None
+            with trace.span("execute", executor="LoadData"):
+                return self._load_data_in_txn(stmt)
+        with trace.span("plan"):
+            try:
+                plan = self._planner().plan(stmt)
+            except (PlanError, ResolveError) as e:
+                raise SQLError(str(e)) from None
         from tidb_tpu.plan import physical as _ph
         if isinstance(plan, (_ph.PhysInsert, _ph.PhysUpdate,
                              _ph.PhysDelete)):
@@ -965,7 +1011,8 @@ class Session:
             self.txn.related_tables.add(plan.table.id)
         ctx = ExecContext(self.storage, self.txn.start_ts, self.txn)
         exe = build_executor(plan)
-        return exe.execute(ctx)
+        with trace.span("execute", executor=type(exe).__name__):
+            return exe.execute(ctx)
 
     # -- LOAD DATA (ref: executor/write.go:1373 LoadDataExec) ----------------
 
@@ -1080,6 +1127,10 @@ class Session:
                 from tidb_tpu.plan.planner import Planner as _P
                 return ResultSet([f"Tables_in_{db}"],
                                  [(n,) for n in _P._MEMTABLES])
+            if db.lower() == "performance_schema":
+                from tidb_tpu.plan.planner import Planner as _P
+                return ResultSet([f"Tables_in_{db}"],
+                                 [(n,) for n in _P._PERF_TABLES])
             try:
                 names = ischema.table_names(db)
             except SchemaError as e:

@@ -1,0 +1,108 @@
+"""Lightweight statement tracing: span trees per statement.
+
+Reference: the OpenTracing spans threaded through the reference stack —
+dispatch (server/conn.go:559), session.Execute (session.go:692), Compile
+(executor/compiler.go:34), runStmt (tidb.go:156), TSO wait
+(session.go:1198-1206). Here spans are in-process structures: each
+non-internal statement runs under a root span, phases annotate
+themselves via the `span()` context manager, and the finished tree feeds
+PERFORMANCE_SCHEMA statement events (perfschema.py) and, when
+tidb_tpu_trace_log is on, the log.
+
+Thread-local: spans opened on worker threads attach to nothing rather
+than corrupting another statement's tree (the coprocessor fan-out's
+per-task work is aggregated by its dispatching span instead)."""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import threading
+import time
+
+__all__ = ["begin", "end", "span", "current_root", "phase_ns"]
+
+log = logging.getLogger("tidb_tpu.trace")
+
+_tl = threading.local()
+
+
+class Span:
+    __slots__ = ("name", "tags", "start_ns", "end_ns", "children")
+
+    def __init__(self, name: str, tags: dict | None = None):
+        self.name = name
+        self.tags = tags or {}
+        self.start_ns = time.perf_counter_ns()
+        self.end_ns = 0
+        self.children: list[Span] = []
+
+    @property
+    def duration_ns(self) -> int:
+        return (self.end_ns or time.perf_counter_ns()) - self.start_ns
+
+    def to_dict(self) -> dict:
+        d = {"name": self.name, "duration_ns": self.duration_ns}
+        if self.tags:
+            d["tags"] = dict(self.tags)
+        if self.children:
+            d["children"] = [c.to_dict() for c in self.children]
+        return d
+
+
+def begin(name: str, **tags) -> Span:
+    """Open a root span for the current thread's statement."""
+    root = Span(name, tags)
+    _tl.cur = root
+    return root
+
+
+def end(root: Span) -> Span:
+    root.end_ns = time.perf_counter_ns()
+    if getattr(_tl, "cur", None) is root:
+        _tl.cur = None
+    return root
+
+
+def current_root():
+    return getattr(_tl, "cur", None)
+
+
+@contextlib.contextmanager
+def span(name: str, **tags):
+    """Child span under the thread's current span; a no-op (still timed,
+    but unattached) when no trace is active — internal sessions and
+    worker threads pay one thread-local read."""
+    parent = getattr(_tl, "cur", None)
+    s = Span(name, tags)
+    if parent is not None:
+        parent.children.append(s)
+        _tl.cur = s
+    try:
+        yield s
+    finally:
+        s.end_ns = time.perf_counter_ns()
+        if parent is not None:
+            _tl.cur = parent
+
+
+def phase_ns(root: Span | None, name: str) -> int:
+    """Sum of top-level child spans with `name` (a statement's parse /
+    plan / execute / commit phase totals)."""
+    if root is None:
+        return 0
+    return sum(c.duration_ns for c in root.children if c.name == name)
+
+
+def log_tree(root: Span, sql: str) -> None:
+    parts: list[str] = []
+
+    def walk(s: Span, depth: int) -> None:
+        parts.append("%s%s %.3fms %s" % (
+            "  " * depth, s.name, s.duration_ns / 1e6,
+            s.tags if s.tags else ""))
+        for c in s.children:
+            walk(c, depth + 1)
+
+    walk(root, 0)
+    log.info("trace for %r:\n%s", sql[:256], "\n".join(parts))
