@@ -1,0 +1,193 @@
+"""Builtin function breadth (expression/builtins.py registry; ref:
+expression/builtin_math.go, builtin_string.go, builtin_time.go,
+builtin_encryption.go). Expected values follow MySQL semantics."""
+
+import math
+
+import pytest
+
+from tidb_tpu.session import Session, SQLError
+from tidb_tpu.store.storage import new_mock_storage
+
+
+@pytest.fixture(scope="module")
+def sess():
+    s = Session(new_mock_storage())
+    s.execute("CREATE DATABASE d")
+    s.execute("USE d")
+    s.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, x DOUBLE, "
+              "s VARCHAR(40), d DATETIME)")
+    s.execute("INSERT INTO t VALUES "
+              "(1, 2.0, 'hello world', '2024-03-15 10:30:45'),"
+              "(2, -9.5, 'a,b,c', '2024-12-31 23:59:59'),"
+              "(3, 0.25, NULL, NULL)")
+    yield s
+    s.close()
+
+
+def one(sess, expr, where="id=1"):
+    return sess.query(f"SELECT {expr} FROM t WHERE {where}").rows[0][0]
+
+
+class TestMath:
+    @pytest.mark.parametrize("expr,want", [
+        ("SIN(x)", math.sin(2.0)), ("COS(x)", math.cos(2.0)),
+        ("TAN(x)", math.tan(2.0)), ("COT(x)", 1 / math.tan(2.0)),
+        ("ATAN(x)", math.atan(2.0)), ("ATAN(1, 1)", math.pi / 4),
+        ("ATAN2(1, 1)", math.pi / 4), ("LOG(x)", math.log(2.0)),
+        ("LOG(10, 100)", 2.0), ("LOG10(100)", 2.0),
+        ("PI()", math.pi), ("DEGREES(PI())", 180.0),
+        ("RADIANS(180)", math.pi), ("TRUNCATE(1.999, 1)", 1.9),
+        ("TRUNCATE(-1.999, 1)", -1.9),
+    ])
+    def test_value(self, sess, expr, want):
+        assert one(sess, expr) == pytest.approx(want, rel=1e-12)
+
+    def test_asin_domain_error_is_null(self, sess):
+        assert one(sess, "ASIN(x)") is None      # ASIN(2.0)
+
+    def test_crc32_conv_bin_oct_hex(self, sess):
+        assert one(sess, "CRC32('MySQL')") == 3259397556
+        assert one(sess, "CONV('a', 16, 2)") == "1010"
+        assert one(sess, "CONV(6, 10, 2)") == "110"
+        assert one(sess, "BIN(12)") == "1100"
+        assert one(sess, "OCT(12)") == "14"
+        assert one(sess, "HEX(255)") == "FF"
+        assert one(sess, "HEX('abc')") == "616263"
+        assert one(sess, "UNHEX('4D7953514C')") == "MySQL"
+
+    def test_rand(self, sess):
+        v = one(sess, "RAND()")
+        assert 0.0 <= v < 1.0
+        assert one(sess, "RAND(5)") == one(sess, "RAND(5)")
+
+
+class TestString:
+    @pytest.mark.parametrize("expr,want", [
+        ("CHAR_LENGTH(s)", 11), ("BIT_LENGTH('abc')", 24),
+        ("LPAD('hi', 4, '?')", "??hi"), ("RPAD('hi', 4, '?')", "hi??"),
+        ("LPAD('hello', 3, '?')", "hel"),
+        ("REPEAT('ab', 3)", "ababab"), ("REVERSE('abc')", "cba"),
+        ("SPACE(3)", "   "), ("STRCMP('b', 'a')", 1),
+        ("STRCMP('a', 'b')", -1), ("STRCMP('a', 'a')", 0),
+        ("LOCATE('world', s)", 7), ("LOCATE('xyz', s)", 0),
+        ("LOCATE('o', s, 6)", 8),
+        ("LTRIM('  x ')", "x "), ("RTRIM(' x  ')", " x"),
+        ("QUOTE("
+         "'don''t')", "'don\\'t'"),
+        ("SUBSTRING_INDEX('www.mysql.com', '.', 2)", "www.mysql"),
+        ("SUBSTRING_INDEX('www.mysql.com', '.', -2)", "mysql.com"),
+        ("FIND_IN_SET('b', 'a,b,c')", 2),
+        ("FIND_IN_SET('z', 'a,b,c')", 0),
+        ("ELT(1, 'ej', 'heja')", "ej"),
+        ("FIELD('ej', 'Hej', 'ej', 'Heja')", 2),
+        ("MID(s, 1, 5)", "hello"),
+    ])
+    def test_value(self, sess, expr, want):
+        assert one(sess, expr) == want
+
+    def test_concat_ws_skips_nulls(self, sess):
+        assert one(sess, "CONCAT_WS(',', 'a', NULL, 'b')") == "a,b"
+        assert one(sess, "CONCAT_WS(NULL, 'a', 'b')") is None
+
+
+class TestCompare:
+    def test_greatest_least(self, sess):
+        assert one(sess, "GREATEST(2, 0)") == 2
+        assert one(sess, "GREATEST(34.0, 3.0, 5.0, 767.0)") == 767.0
+        assert one(sess, "LEAST('B', 'A', 'C')") == "A"
+        assert one(sess, "GREATEST(x, 0)", "id=2") == 0.0
+
+    def test_isnull_nullif(self, sess):
+        assert one(sess, "ISNULL(s)", "id=3") == 1
+        assert one(sess, "ISNULL(s)") == 0
+        assert one(sess, "NULLIF(1, 1)") is None
+        assert one(sess, "NULLIF(1, 2)") == 1
+
+
+class TestTime:
+    # 2024-03-15 is a Friday, day 75, Q1, week 10 (mode 0)
+    @pytest.mark.parametrize("expr,want", [
+        ("DAYOFWEEK(d)", 6), ("WEEKDAY(d)", 4), ("DAYOFYEAR(d)", 75),
+        ("QUARTER(d)", 1), ("WEEK(d)", 10), ("YEARWEEK(d)", 202410),
+        ("MONTHNAME(d)", "March"), ("DAYNAME(d)", "Friday"),
+        ("TO_DAYS(d)", 739325),
+        ("UNIX_TIMESTAMP(d)", 1710498645),
+        ("MICROSECOND(d)", 0),
+        ("DATE_FORMAT(d, '%Y-%m-%d')", "2024-03-15"),
+        ("DATE_FORMAT(d, '%W %M %Y')", "Friday March 2024"),
+        ("DATE_FORMAT(d, '%H:%i:%s')", "10:30:45"),
+    ])
+    def test_value(self, sess, expr, want):
+        assert one(sess, expr) == want
+
+    def test_last_day_from_unixtime(self, sess):
+        assert one(sess, "LAST_DAY(d)") == "2024-03-31 00:00:00"
+        assert one(sess, "FROM_UNIXTIME(1710498645)") == \
+            "2024-03-15 10:30:45"
+
+    def test_leap_quarter_edges(self, sess):
+        assert one(sess, "DAYOFYEAR(d)", "id=2") == 366   # 2024 is leap
+        assert one(sess, "QUARTER(d)", "id=2") == 4
+        assert one(sess, "DAYNAME(d)", "id=2") == "Tuesday"
+
+
+class TestCrypto:
+    def test_digests(self, sess):
+        assert one(sess, "MD5('abc')") == \
+            "900150983cd24fb0d6963f7d28e17f72"
+        assert one(sess, "SHA1('abc')") == \
+            "a9993e364706816aba3e25717850c26c9cd0d89d"
+        assert one(sess, "SHA2('abc', 256)") == (
+            "ba7816bf8f01cfea414140de5dae2223"
+            "b00361a396177a9cb410ff61f20015ad")
+        assert one(sess, "SHA2('abc', 1)") is None   # bad bit width
+
+
+class TestNullsAndErrors:
+    @pytest.mark.parametrize("expr", [
+        "SIN(d)", "REVERSE(s)", "DAYOFWEEK(d)", "MD5(s)",
+        "DATE_FORMAT(d, '%Y')", "LPAD(s, 3, 'x')",
+    ])
+    def test_null_propagates(self, sess, expr):
+        assert one(sess, expr, "id=3") is None
+
+    def test_arity_errors(self, sess):
+        with pytest.raises(SQLError):
+            sess.query("SELECT SIN() FROM t")
+        with pytest.raises(SQLError):
+            sess.query("SELECT LPAD('a') FROM t")
+        with pytest.raises(SQLError):
+            sess.query("SELECT NO_SUCH_FN(1) FROM t")
+
+    def test_generic_in_where_and_group(self, sess):
+        # builtins compose with filters, grouping, and core ops
+        r = sess.query("SELECT QUARTER(d), COUNT(*) FROM t "
+                       "WHERE d IS NOT NULL AND DAYOFWEEK(d) > 0 "
+                       "GROUP BY QUARTER(d) ORDER BY 1").rows
+        assert r == [(1, 1), (4, 1)]
+
+
+class TestRemotePushdown:
+    def test_generic_filter_over_storage_rpc(self):
+        """GENERIC specs pickle by name across the storage RPC (host
+        filters ride inside the pushed cop plan)."""
+        from tidb_tpu.store.remote import StorageServer, connect
+        srv = StorageServer()
+        srv.start()
+        st = connect("127.0.0.1", srv.port)
+        try:
+            s = Session(st)
+            s.execute("CREATE DATABASE r")
+            s.execute("USE r")
+            s.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, "
+                      "s VARCHAR(10))")
+            s.execute("INSERT INTO t VALUES (1,'abc'), (2,'wxyz')")
+            assert s.query("SELECT id FROM t WHERE CHAR_LENGTH(s) = 3"
+                           ).rows == [(1,)]
+            assert s.query("SELECT id FROM t WHERE SIN(id) < 0.9"
+                           ).rows == [(1,)]
+            s.close()
+        finally:
+            st.close()
+            srv.close()

@@ -46,7 +46,7 @@ def _unescape(s: str, esc: str) -> str | None:
 
 
 def _split_lines(chunks, lt: str, ft: str, enc: str, esc: str,
-                 starting: str = ""):
+                 starting: str = "", ignore_lines: int = 0):
     """Logical lines from a stream of text chunks: a terminator inside an
     enclosed field or behind the escape character does not end the row,
     and a token straddling a chunk boundary is handled by holding back a
@@ -80,13 +80,20 @@ def _split_lines(chunks, lt: str, ft: str, enc: str, esc: str,
         limit = len(buf) if final else max(len(buf) - hold, 0)
         i = 0
         while i < limit:
+            if ignore_lines > 0:
+                # IGNORE n LINES skips PHYSICAL lines — raw terminator
+                # scan, before any prefix/enclosure semantics (MySQL's
+                # READ_INFO::next_line does the same)
+                l_ = buf.find(lt, i, limit + len(lt) - 1)
+                if l_ < 0:
+                    i = limit
+                    break
+                i = l_ + len(lt)
+                ignore_lines -= 1
+                continue
             if skipping:
                 p = buf.find(starting, i, limit + len(starting) - 1)
-                if p >= limit:
-                    p = -1         # starts in the held-back tail: wait
                 l_ = buf.find(lt, i, limit + len(lt) - 1)
-                if l_ >= limit:
-                    l_ = -1
                 if 0 <= p and (l_ < 0 or p < l_):
                     i = p + len(starting)
                     skipping = False
@@ -149,7 +156,7 @@ def _split_lines(chunks, lt: str, ft: str, enc: str, esc: str,
         buf = buf[i:]
         if final:
             break
-    if not skipping and (cur or buf):
+    if not skipping and ignore_lines <= 0 and (cur or buf):
         cur.append(buf)
         yield "".join(cur)
 
@@ -208,10 +215,9 @@ def parse_lines(text, stmt):
     enc = stmt.fields_enclosed
     esc = stmt.fields_escaped
     chunks = [text] if isinstance(text, str) else text
-    for li, line in enumerate(_split_lines(chunks, lt, ft, enc, esc,
-                                           stmt.lines_starting or "")):
-        if li < stmt.ignore_lines:
-            continue
+    for line in _split_lines(chunks, lt, ft, enc, esc,
+                             stmt.lines_starting or "",
+                             stmt.ignore_lines):
         if not line:
             continue
         yield _split_fields(line, ft, enc, esc)

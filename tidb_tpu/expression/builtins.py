@@ -1,0 +1,583 @@
+"""Builtin scalar function registry — the breadth families.
+
+Reference: /root/reference/expression/builtin_math.go, builtin_string.go,
+builtin_time.go, builtin_encryption.go, builtin_compare.go (the builtin
+families that make up most of the reference's 40.9k expression LoC).
+The high-traffic TPC-H operators live as first-class Ops in core.py with
+device (XLA) paths; everything here is the long tail: registered by name
+in one table, evaluated whole-column on the host (numpy), with a handful
+of pure-numeric ones marked device-safe (none yet: GENERIC builtins
+always take the host path; promote hot ones to core Ops when needed).
+
+Each FnSpec:
+  * arity check at resolve time (min/max args);
+  * result typing (`ret`: fixed eval kind or a callable over arg exprs);
+  * `fn(args, argv, n)` whole-column evaluator -> (data, valid) where
+    argv is [(data, valid)] numpy pairs;
+  * rows with any NULL argument are NULL unless `null_through=False`
+    (CONCAT_WS-style functions handle NULLs themselves).
+"""
+
+from __future__ import annotations
+
+import calendar
+import datetime as _dt
+import hashlib
+import math
+import zlib
+from dataclasses import dataclass
+from typing import Callable
+
+import numpy as np
+
+from tidb_tpu.sqltypes import (micros_to_datetime, new_datetime_field,
+                               new_double_field, new_int_field,
+                               new_string_field)
+
+__all__ = ["REGISTRY", "FnSpec", "lookup"]
+
+_US_PER_DAY = 86_400_000_000
+
+
+@dataclass(frozen=True)
+class FnSpec:
+    name: str
+    min_args: int
+    max_args: int
+    ret: object                  # "int"|"real"|"string"|"datetime"|"first"|callable
+    fn: Callable
+    device_safe: bool = False
+    volatile: bool = False
+    null_through: bool = True    # NULL in -> NULL out, row-wise
+
+    def result_ft(self, args):
+        if callable(self.ret):
+            return self.ret(args)
+        return {"int": new_int_field, "real": new_double_field,
+                "string": lambda: new_string_field(),
+                "datetime": new_datetime_field,
+                "first": lambda: args[0].ft}[self.ret]()
+
+    def __hash__(self):
+        return hash(self.name)
+
+    def __reduce__(self):
+        # registry fns are closures; pickle by NAME and rehydrate from
+        # the registry, so expressions holding a spec cross the storage
+        # RPC (host_filter pushdown to the out-of-process coprocessor)
+        return (_restore_spec, (self.name,))
+
+
+def _restore_spec(name: str) -> "FnSpec":
+    return REGISTRY[name]
+
+
+REGISTRY: dict[str, FnSpec] = {}
+
+
+def _reg(name, min_args, max_args, ret, fn, **kw):
+    REGISTRY[name] = FnSpec(name, min_args, max_args, ret, fn, **kw)
+
+
+def lookup(name: str) -> FnSpec | None:
+    return REGISTRY.get(name)
+
+
+# -- helpers -----------------------------------------------------------------
+
+def _s(x) -> str:
+    return x if isinstance(x, str) else (
+        x.decode() if isinstance(x, bytes) else str(x))
+
+
+def _valid_all(argv, n):
+    v = np.ones(n, dtype=bool)
+    for _d, av in argv:
+        v = v & av
+    return v
+
+
+def _vec(fn, valid, n, *arrs, dtype=object):
+    out = np.empty(n, dtype=dtype)
+    fill = "" if dtype == object else 0
+    for i in range(n):
+        out[i] = fn(*(a[i] for a in arrs)) if valid[i] else fill
+    return out
+
+
+def _num(argv):
+    return [np.asarray(d, dtype=np.float64) for d, _v in argv]
+
+
+def _host_str(name):
+    """Decorator: register a host string fn over row scalars."""
+    def deco(f):
+        return f
+    return deco
+
+
+def _dtarr(d):
+    """epoch-micros int64 -> numpy datetime64[us] (vectorized calendar)."""
+    return np.asarray(d, dtype=np.int64).view("datetime64[us]")
+
+
+# -- math (builtin_math.go) --------------------------------------------------
+
+def _unary_math(mfn):
+    def fn(args, argv, n):
+        (d,) = _num(argv)
+        with np.errstate(all="ignore"):
+            out = mfn(d)
+        v = _valid_all(argv, n) & np.isfinite(out)
+        return np.where(v, out, 0.0), v
+    return fn
+
+
+for _name, _m in [("SIN", np.sin), ("COS", np.cos), ("TAN", np.tan),
+                  ("ASIN", np.arcsin), ("ACOS", np.arccos),
+                  ("LOG10", np.log10), ("RADIANS", np.radians),
+                  ("DEGREES", np.degrees)]:
+    _reg(_name, 1, 1, "real", _unary_math(_m))
+
+
+def _cot(args, argv, n):
+    (d,) = _num(argv)
+    with np.errstate(all="ignore"):
+        out = 1.0 / np.tan(d)
+    v = _valid_all(argv, n) & np.isfinite(out)
+    return np.where(v, out, 0.0), v
+
+
+_reg("COT", 1, 1, "real", _cot)
+
+
+def _atan(args, argv, n):
+    nums = _num(argv)
+    out = np.arctan2(nums[0], nums[1]) if len(nums) == 2 \
+        else np.arctan(nums[0])
+    return out, _valid_all(argv, n)
+
+
+_reg("ATAN", 1, 2, "real", _atan)
+_reg("ATAN2", 2, 2, "real",
+     lambda a, argv, n: (np.arctan2(*_num(argv)), _valid_all(argv, n)))
+
+
+def _log(args, argv, n):
+    nums = _num(argv)
+    with np.errstate(all="ignore"):
+        if len(nums) == 2:          # LOG(b, x)
+            out = np.log(nums[1]) / np.log(nums[0])
+        else:
+            out = np.log(nums[0])
+    v = _valid_all(argv, n) & np.isfinite(out)
+    return np.where(v, out, 0.0), v
+
+
+_reg("LOG", 1, 2, "real", _log)
+_reg("PI", 0, 0, "real",
+     lambda a, argv, n: (np.full(n, math.pi), np.ones(n, dtype=bool)))
+
+
+def _truncate(args, argv, n):
+    from tidb_tpu.sqltypes import EvalType
+    (xd, xv), (dd, dv) = argv
+    v = xv & dv
+    if args[0].ft.eval_type == EvalType.INT:
+        # negative D zeroes low digits; D >= 0 is identity
+        p = np.power(10.0, -np.minimum(np.asarray(dd, np.int64), 0))
+        out = (np.asarray(xd, np.int64) // p.astype(np.int64)) * \
+            p.astype(np.int64)
+        return out, v
+    x = np.asarray(xd, np.float64)
+    if args[0].ft.eval_type == EvalType.DECIMAL:
+        x = x / (10.0 ** max(args[0].ft.frac, 0))   # unscale
+    p = np.power(10.0, np.asarray(dd, np.float64))
+    return np.trunc(x * p) / p, v
+
+
+_reg("TRUNCATE", 2, 2,
+     lambda args: args[0].ft if args[0].ft.eval_type.name == "INT"
+     else new_double_field(), _truncate)
+
+
+def _crc32(args, argv, n):
+    d, v = argv[0]
+    return _vec(lambda x: zlib.crc32(_s(x).encode()), v, n, d,
+                dtype=np.int64), v
+
+
+_reg("CRC32", 1, 1, "int", _crc32)
+
+
+def _rand(args, argv, n):
+    if argv:
+        seed = int(argv[0][0][0]) if len(argv[0][0]) else 0
+        rng = np.random.RandomState(seed & 0x7FFFFFFF)
+    else:
+        rng = np.random
+    return rng.random_sample(n), np.ones(n, dtype=bool)
+
+
+_reg("RAND", 0, 1, "real", _rand, volatile=True)
+
+
+def _conv_base(args, argv, n):
+    (xd, xv), (fd, fv), (td, tv) = argv
+    v = xv & fv & tv
+
+    def one(x, f, t):
+        try:
+            val = int(_s(x), int(f))
+        except ValueError:
+            return ""
+        t = int(t)
+        if val == 0:
+            return "0"
+        digits = "0123456789ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+        neg, val = val < 0, abs(val)
+        out = []
+        while val:
+            out.append(digits[val % t])
+            val //= t
+        return ("-" if neg else "") + "".join(reversed(out))
+
+    return _vec(one, v, n, xd, fd, td), v
+
+
+_reg("CONV", 3, 3, "string", _conv_base)
+_reg("BIN", 1, 1, "string",
+     lambda a, argv, n: (_vec(lambda x: format(int(x), "b"),
+                              argv[0][1], n, argv[0][0]), argv[0][1]))
+_reg("OCT", 1, 1, "string",
+     lambda a, argv, n: (_vec(lambda x: format(int(x), "o"),
+                              argv[0][1], n, argv[0][0]), argv[0][1]))
+
+
+def _hex(args, argv, n):
+    from tidb_tpu.sqltypes import EvalType
+    d, v = argv[0]
+    if args[0].ft.eval_type == EvalType.STRING:
+        return _vec(lambda x: _s(x).encode().hex().upper(), v, n, d), v
+    return _vec(lambda x: format(int(x), "X"), v, n, d), v
+
+
+_reg("HEX", 1, 1, "string", _hex)
+
+
+def _unhex(args, argv, n):
+    d, v = argv[0]
+
+    def one(x):
+        try:
+            return bytes.fromhex(_s(x)).decode("utf-8", "replace")
+        except ValueError:
+            return None
+
+    out = _vec(one, v, n, d)
+    v2 = v & np.array([out[i] is not None for i in range(n)], dtype=bool)
+    out = np.where(v2, out, "")
+    return out, v2
+
+
+_reg("UNHEX", 1, 1, "string", _unhex)
+
+
+# -- strings (builtin_string.go) ---------------------------------------------
+
+def _sfn(name, min_a, max_a, pyfn, ret="string", **kw):
+    def fn(args, argv, n):
+        v = _valid_all(argv, n)
+        dtype = np.int64 if ret == "int" else object
+        out = _vec(pyfn, v, n, *[d for d, _v in argv], dtype=dtype)
+        return out, v
+    _reg(name, min_a, max_a, ret, fn, **kw)
+
+
+_sfn("CHAR_LENGTH", 1, 1, lambda x: len(_s(x)), ret="int")
+_sfn("CHARACTER_LENGTH", 1, 1, lambda x: len(_s(x)), ret="int")
+_sfn("BIT_LENGTH", 1, 1, lambda x: len(_s(x).encode()) * 8, ret="int")
+_sfn("LPAD", 3, 3,
+     lambda x, k, p: _s(x)[:int(k)] if len(_s(x)) >= int(k)
+     else ((_s(p) * int(k))[:int(k) - len(_s(x))] + _s(x)
+           if _s(p) else _s(x)[:int(k)]))
+_sfn("RPAD", 3, 3,
+     lambda x, k, p: _s(x)[:int(k)] if len(_s(x)) >= int(k)
+     else (_s(x) + (_s(p) * int(k))[:int(k) - len(_s(x))]
+           if _s(p) else _s(x)[:int(k)]))
+_sfn("REPEAT", 2, 2, lambda x, k: _s(x) * max(int(k), 0))
+_sfn("REVERSE", 1, 1, lambda x: _s(x)[::-1])
+_sfn("SPACE", 1, 1, lambda k: " " * max(int(k), 0))
+_sfn("STRCMP", 2, 2,
+     lambda a, b: (_s(a) > _s(b)) - (_s(a) < _s(b)), ret="int")
+_sfn("LOCATE", 2, 3,
+     lambda sub, x, pos=1: (_s(x).find(_s(sub), max(int(pos) - 1, 0)) + 1)
+     if int(pos) > 0 else 0, ret="int")
+_sfn("POSITION", 2, 2,
+     lambda sub, x: _s(x).find(_s(sub)) + 1, ret="int")
+_sfn("LTRIM", 1, 1, lambda x: _s(x).lstrip(" "))
+_sfn("RTRIM", 1, 1, lambda x: _s(x).rstrip(" "))
+_sfn("QUOTE", 1, 1,
+     lambda x: "'" + _s(x).replace("\\", "\\\\").replace("'", "\\'") + "'")
+_sfn("SUBSTRING_INDEX", 3, 3,
+     lambda x, d, k: (_s(d).join(_s(x).split(_s(d))[:int(k)])
+                      if int(k) >= 0
+                      else _s(d).join(_s(x).split(_s(d))[int(k):]))
+     if _s(d) else "")
+_sfn("FIND_IN_SET", 2, 2,
+     lambda x, lst: (_s(lst).split(",").index(_s(x)) + 1
+                     if _s(x) in _s(lst).split(",") else 0), ret="int")
+
+
+def _concat_ws(args, argv, n):
+    sep_d, sep_v = argv[0]
+    out = np.empty(n, dtype=object)
+    v = sep_v.copy()
+    for i in range(n):
+        if not sep_v[i]:
+            out[i] = ""
+            continue
+        parts = [_s(d[i]) for d, av in argv[1:] if av[i]]
+        out[i] = _s(sep_d[i]).join(parts)
+    return out, v
+
+
+_reg("CONCAT_WS", 2, 64, "string", _concat_ws, null_through=False)
+
+
+def _elt(args, argv, n):
+    kd, kv = argv[0]
+    out = np.empty(n, dtype=object)
+    v = np.zeros(n, dtype=bool)
+    for i in range(n):
+        out[i] = ""
+        if not kv[i]:
+            continue
+        k = int(kd[i])
+        if 1 <= k < len(argv):
+            d, av = argv[k]
+            if av[i]:
+                out[i] = _s(d[i])
+                v[i] = True
+    return out, v
+
+
+_reg("ELT", 2, 64, "string", _elt, null_through=False)
+
+
+def _field(args, argv, n):
+    xd, xv = argv[0]
+    out = np.zeros(n, dtype=np.int64)
+    for i in range(n):
+        if not xv[i]:
+            continue
+        for k in range(1, len(argv)):
+            d, av = argv[k]
+            if av[i] and _s(d[i]) == _s(xd[i]):
+                out[i] = k
+                break
+    return out, np.ones(n, dtype=bool)
+
+
+_reg("FIELD", 2, 64, "int", _field, null_through=False)
+
+
+# -- greatest/least (builtin_compare.go) -------------------------------------
+
+def _minmax(is_max):
+    def fn(args, argv, n):
+        from tidb_tpu.sqltypes import EvalType
+        v = _valid_all(argv, n)
+        if any(a.ft.eval_type == EvalType.STRING for a in args):
+            pick = max if is_max else min
+            out = _vec(lambda *xs: pick(_s(x) for x in xs), v, n,
+                       *[d for d, _ in argv])
+            return out, v
+        red = np.maximum if is_max else np.minimum
+        out = np.asarray(argv[0][0])
+        for d, _av in argv[1:]:
+            out = red(out, np.asarray(d))
+        return out, v
+    return fn
+
+
+def _minmax_ft(args):
+    from tidb_tpu.expression.core import ScalarFunc
+    f = ScalarFunc.__new__(ScalarFunc)
+    f.args = list(args)
+    return f._merge_types(args)
+
+
+_reg("GREATEST", 2, 64, _minmax_ft, _minmax(True))
+_reg("LEAST", 2, 64, _minmax_ft, _minmax(False))
+
+
+# -- date/time (builtin_time.go); all on epoch-micros int64 ------------------
+
+def _days(argv):
+    return np.asarray(argv[0][0], dtype=np.int64) // _US_PER_DAY
+
+
+def _ifn(name, min_a, max_a, fn, ret="int", **kw):
+    _reg(name, min_a, max_a, ret, fn, **kw)
+
+
+_ifn("DAYOFWEEK", 1, 1,
+     lambda a, argv, n: ((((_days(argv) + 4) % 7) + 1),
+                         _valid_all(argv, n)))
+_ifn("WEEKDAY", 1, 1,
+     lambda a, argv, n: ((_days(argv) + 3) % 7, _valid_all(argv, n)))
+_ifn("TO_DAYS", 1, 1,
+     lambda a, argv, n: (_days(argv) + 719528, _valid_all(argv, n)))
+_ifn("UNIX_TIMESTAMP", 0, 1,
+     lambda a, argv, n: (
+         (np.asarray(argv[0][0], np.int64) // 1_000_000,
+          _valid_all(argv, n)) if argv else
+         (np.full(n, int(_dt.datetime.now().timestamp()), np.int64),
+          np.ones(n, dtype=bool))),
+      volatile=True)
+_ifn("MICROSECOND", 1, 1,
+     lambda a, argv, n: (np.asarray(argv[0][0], np.int64) % 1_000_000,
+                         _valid_all(argv, n)))
+
+
+def _from_unixtime(args, argv, n):
+    d, v = argv[0]
+    return np.asarray(d, np.int64) * 1_000_000, v
+
+
+_reg("FROM_UNIXTIME", 1, 1, "datetime", _from_unixtime)
+
+
+def _cal_int(extract):
+    def fn(args, argv, n):
+        v = _valid_all(argv, n)
+        dt = _dtarr(np.where(v, argv[0][0], 0))
+        return extract(dt).astype(np.int64), v
+    return fn
+
+
+_reg("DAYOFYEAR", 1, 1, "int", _cal_int(
+    lambda dt: (dt.astype("datetime64[D]") -
+                dt.astype("datetime64[Y]").astype("datetime64[D]")) /
+    np.timedelta64(1, "D") + 1))
+_reg("QUARTER", 1, 1, "int", _cal_int(
+    lambda dt: (dt.astype("datetime64[M]").astype(np.int64) % 12) // 3 + 1))
+_reg("WEEK", 1, 2, "int", _cal_int(
+    # mode 0: week 0-53, Sunday-first (the MySQL default)
+    lambda dt: ((dt.astype("datetime64[D]") -
+                 dt.astype("datetime64[Y]").astype("datetime64[D]"))
+                .astype(np.int64) +
+                ((dt.astype("datetime64[Y]").astype("datetime64[D]")
+                  .astype(np.int64) + 4) % 7)) // 7))
+_reg("YEARWEEK", 1, 1, "int", _cal_int(
+    lambda dt: (dt.astype("datetime64[Y]").astype(np.int64) + 1970) * 100 +
+    ((dt.astype("datetime64[D]") -
+      dt.astype("datetime64[Y]").astype("datetime64[D]")).astype(np.int64) +
+     ((dt.astype("datetime64[Y]").astype("datetime64[D]")
+       .astype(np.int64) + 4) % 7)) // 7))
+
+_MONTHS = ["January", "February", "March", "April", "May", "June", "July",
+           "August", "September", "October", "November", "December"]
+_DAYS_OF_WEEK = ["Monday", "Tuesday", "Wednesday", "Thursday", "Friday",
+                 "Saturday", "Sunday"]
+
+
+def _monthname(args, argv, n):
+    v = _valid_all(argv, n)
+    m = _dtarr(np.where(v, argv[0][0], 0)).astype(
+        "datetime64[M]").astype(np.int64) % 12
+    return np.array([_MONTHS[i] for i in m], dtype=object), v
+
+
+def _dayname(args, argv, n):
+    v = _valid_all(argv, n)
+    wd = (_days(argv) + 3) % 7
+    return np.array([_DAYS_OF_WEEK[i] for i in wd], dtype=object), v
+
+
+_reg("MONTHNAME", 1, 1, "string", _monthname)
+_reg("DAYNAME", 1, 1, "string", _dayname)
+
+
+def _last_day(args, argv, n):
+    d, v = argv[0]
+
+    def one(us):
+        dt = micros_to_datetime(int(us))
+        last = calendar.monthrange(dt.year, dt.month)[1]
+        return int(_dt.datetime(dt.year, dt.month, last)
+                   .replace(tzinfo=_dt.timezone.utc).timestamp() * 1e6)
+
+    return _vec(one, v, n, d, dtype=np.int64), v
+
+
+_reg("LAST_DAY", 1, 1, "datetime", _last_day)
+
+# MySQL DATE_FORMAT specifier -> strftime (the common subset)
+_FMT_MAP = {"%Y": "%Y", "%y": "%y", "%m": "%m", "%c": "%-m", "%d": "%d",
+            "%e": "%-d", "%H": "%H", "%k": "%-H", "%h": "%I", "%i": "%M",
+            "%s": "%S", "%S": "%S", "%f": "%f", "%p": "%p", "%W": "%A",
+            "%a": "%a", "%b": "%b", "%M": "%B", "%j": "%j", "%%": "%%",
+            "%T": "%H:%M:%S"}
+
+
+def _mysql_fmt_to_strftime(fmt: str) -> str:
+    out = []
+    i = 0
+    while i < len(fmt):
+        if fmt[i] == "%" and i + 1 < len(fmt):
+            spec = fmt[i:i + 2]
+            out.append(_FMT_MAP.get(spec, spec[1]))
+            i += 2
+        else:
+            out.append(fmt[i])
+            i += 1
+    return "".join(out)
+
+
+def _date_format(args, argv, n):
+    (dd, dv), (fd, fv) = argv
+    v = dv & fv
+
+    def one(us, fmt):
+        py = _mysql_fmt_to_strftime(_s(fmt))
+        return micros_to_datetime(int(us)).strftime(py.replace("%-", "%"))
+
+    return _vec(one, v, n, dd, fd), v
+
+
+_reg("DATE_FORMAT", 2, 2, "string", _date_format)
+
+
+# -- crypto / checksum (builtin_encryption.go) -------------------------------
+
+def _digest(algo):
+    def fn(args, argv, n):
+        d, v = argv[0]
+        return _vec(lambda x: algo(_s(x).encode()).hexdigest(),
+                    v, n, d), v
+    return fn
+
+
+_reg("MD5", 1, 1, "string", _digest(hashlib.md5))
+_reg("SHA1", 1, 1, "string", _digest(hashlib.sha1))
+_reg("SHA", 1, 1, "string", _digest(hashlib.sha1))
+
+
+def _sha2(args, argv, n):
+    (xd, xv), (bd, bv) = argv
+    v = xv & bv
+    algos = {0: hashlib.sha256, 224: hashlib.sha224, 256: hashlib.sha256,
+             384: hashlib.sha384, 512: hashlib.sha512}
+
+    def one(x, bits):
+        a = algos.get(int(bits))
+        return a(_s(x).encode()).hexdigest() if a else None
+
+    out = _vec(one, v, n, xd, bd)
+    v2 = v & np.array([out[i] is not None for i in range(n)], dtype=bool)
+    return np.where(v2, out, ""), v2
+
+
+_reg("SHA2", 2, 2, "string", _sha2)

@@ -66,6 +66,9 @@ class Op(Enum):
     # cast
     CAST_INT = "cast_int"; CAST_REAL = "cast_real"; CAST_DECIMAL = "cast_decimal"
     CAST_STRING = "cast_string"
+    # registry-dispatched long-tail builtins (expression/builtins.py);
+    # extra = FnSpec
+    GENERIC = "generic"
 
 
 class Expression:
@@ -282,6 +285,8 @@ class ScalarFunc(Expression):
 
     def _infer_type(self) -> FieldType:
         op = self.op
+        if op == Op.GENERIC:
+            return self.extra.result_ft(self.args)
         if op in _CMP or op in _LOGIC or op in (Op.IS_NULL, Op.IS_NOT_NULL,
                                                 Op.IN, Op.LIKE):
             return new_int_field()
@@ -371,6 +376,11 @@ class ScalarFunc(Expression):
                 return folded
         argv = [a.eval_xp(xp, cols, n) for a in self.args]
 
+        if op == Op.GENERIC:
+            if xp is not np:
+                raise RuntimeError(
+                    f"builtin {self.extra.name} is host-only")
+            return self.extra.fn(self.args, argv, n)
         if op in _LOGIC:
             return _eval_logic(xp, op, argv, n)
         if op == Op.IS_NULL:
@@ -521,6 +531,8 @@ class ScalarFunc(Expression):
         return f
 
     def is_device_safe(self):
+        if self.op == Op.GENERIC:
+            return False
         if self.op in _STRING_OPS or self.op == Op.CAST_STRING:
             return False
         if self.op == Op.IN and self.args[0].ft.eval_type == EvalType.STRING:

@@ -126,6 +126,7 @@ _FUNC_OPS = {
     "DAYOFMONTH": Op.DAY, "HOUR": Op.HOUR, "MINUTE": Op.MINUTE,
     "SECOND": Op.SECOND, "DATEDIFF": Op.DATEDIFF,
     "IF": Op.IF, "IFNULL": Op.IFNULL, "COALESCE": Op.COALESCE,
+    "MID": Op.SUBSTRING,
 }
 
 _AGG_MAP = {"COUNT": AggFunc.COUNT, "SUM": AggFunc.SUM, "AVG": AggFunc.AVG,
@@ -318,9 +319,29 @@ class Resolver:
                             st.new_datetime_field())
         if name == "DATABASE":
             raise ResolveError("DATABASE() resolves in the session layer")
+        if name == "ISNULL":
+            if len(e.args) != 1:
+                raise ResolveError("Incorrect parameter count for ISNULL")
+            return func(Op.IS_NULL, self.resolve(e.args[0]))
+        if name == "NULLIF":
+            if len(e.args) != 2:
+                raise ResolveError("Incorrect parameter count for NULLIF")
+            # NULLIF(a,b) == CASE WHEN a=b THEN NULL ELSE a END
+            a = self.resolve(e.args[0])
+            b = self.resolve(e.args[1])
+            return func(Op.CASE, func(Op.EQ, a, b),
+                        Constant(None, a.ft), a)
         op = _FUNC_OPS.get(name)
         if op is None:
-            raise ResolveError(f"unsupported function {name}")
+            from tidb_tpu.expression.builtins import lookup
+            spec = lookup(name)
+            if spec is None:
+                raise ResolveError(f"unsupported function {name}")
+            if not (spec.min_args <= len(e.args) <= spec.max_args):
+                raise ResolveError(
+                    f"Incorrect parameter count for {name}")
+            args = [self.resolve(a) for a in e.args]
+            return func(Op.GENERIC, *args, extra=spec)
         args = [self.resolve(a) for a in e.args]
         return func(op, *args)
 

@@ -373,7 +373,13 @@ class Session:
         queries and would pollute the metrics."""
         from tidb_tpu import config, metrics, perfschema, trace
         if self.internal:
-            return self._run_stmt(stmt, sql_text=sql_text)
+            # internal catalog work must neither appear in perfschema nor
+            # attach spans to the enclosing client statement's trace
+            token = trace.detach()
+            try:
+                return self._run_stmt(stmt, sql_text=sql_text)
+            finally:
+                trace.restore(token)
         self.current_sql = sql
         self._stmt_start = time.perf_counter()
         kind = type(stmt).__name__.removesuffix("Stmt").lower()

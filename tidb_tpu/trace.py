@@ -68,6 +68,19 @@ def current_root():
     return getattr(_tl, "cur", None)
 
 
+def detach():
+    """Suspend the thread's trace (internal bookkeeping sessions run
+    inside a client statement but must not pollute its phase breakdown).
+    -> token for restore()."""
+    cur = getattr(_tl, "cur", None)
+    _tl.cur = None
+    return cur
+
+
+def restore(token) -> None:
+    _tl.cur = token
+
+
 @contextlib.contextmanager
 def span(name: str, **tags):
     """Child span under the thread's current span; a no-op (still timed,
