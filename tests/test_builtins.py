@@ -56,6 +56,12 @@ class TestMath:
         assert one(sess, "HEX('abc')") == "616263"
         assert one(sess, "UNHEX('4D7953514C')") == "MySQL"
 
+    def test_truncate_toward_zero_and_twos_complement(self, sess):
+        assert one(sess, "TRUNCATE(-199, -1)") == -190
+        assert one(sess, "TRUNCATE(199, -2)") == 100
+        assert one(sess, "HEX(-1)") == "F" * 16
+        assert one(sess, "BIN(-1)") == "1" * 64
+
     def test_rand(self, sess):
         v = one(sess, "RAND()")
         assert 0.0 <= v < 1.0
@@ -85,6 +91,10 @@ class TestString:
     ])
     def test_value(self, sess, expr, want):
         assert one(sess, expr) == want
+
+    def test_pad_negative_length_is_null(self, sess):
+        assert one(sess, "LPAD('hi', -1, '?')") is None
+        assert one(sess, "RPAD('hi', -1, '?')") is None
 
     def test_concat_ws_skips_nulls(self, sess):
         assert one(sess, "CONCAT_WS(',', 'a', NULL, 'b')") == "a,b"
@@ -120,6 +130,18 @@ class TestTime:
     ])
     def test_value(self, sess, expr, want):
         assert one(sess, expr) == want
+
+    def test_week_modes_and_yearweek_rollback(self, sess):
+        assert one(sess, "WEEK('2024-01-01')") == 0
+        assert one(sess, "WEEK('2024-01-01', 1)") == 1
+        assert one(sess, "WEEK('2024-01-01', 3)") == 1
+        assert one(sess, "WEEK('2019-12-30', 1)") == 53
+        assert one(sess, "YEARWEEK('2024-01-01')") == 202353
+
+    def test_string_datetime_literals(self, sess):
+        assert one(sess, "DAYNAME('2024-03-15')") == "Friday"
+        assert one(sess, "LAST_DAY('2024-02-10')") == \
+            "2024-02-29 00:00:00"
 
     def test_last_day_from_unixtime(self, sess):
         assert one(sess, "LAST_DAY(d)") == "2024-03-31 00:00:00"

@@ -1,0 +1,135 @@
+"""TxStructure: typed string/hash/list structures on a KV txn (ref:
+structure/structure.go:49, string.go:24, hash.go:46, list.go)."""
+
+import pytest
+
+from tidb_tpu.store.storage import new_mock_storage
+from tidb_tpu.structure import TxStructure
+
+
+@pytest.fixture
+def txn():
+    st = new_mock_storage()
+    t = st.begin()
+    yield t
+    if getattr(t, "valid", True):
+        try:
+            t.rollback()
+        except Exception:
+            pass
+    st.close()
+
+
+@pytest.fixture
+def s(txn):
+    return TxStructure(txn, prefix=b"x")
+
+
+class TestString:
+    def test_set_get_inc(self, s):
+        assert s.get(b"k") is None
+        s.set(b"k", b"v")
+        assert s.get(b"k") == b"v"
+        assert s.inc(b"n") == 1
+        assert s.inc(b"n", 5) == 6
+        assert s.get_int(b"n") == 6
+        s.clear(b"n")
+        assert s.get_int(b"n") == 0
+
+
+class TestHash:
+    def test_ops_and_order(self, s):
+        s.hset(b"h", b"b", b"2")
+        s.hset(b"h", b"a", b"1")
+        s.hset(b"h", b"c", b"3")
+        assert s.hget(b"h", b"a") == b"1"
+        assert s.hget(b"h", b"z") is None
+        assert s.hgetall(b"h") == [(b"a", b"1"), (b"b", b"2"),
+                                   (b"c", b"3")]
+        assert s.hlen(b"h") == 3
+        s.hdel(b"h", b"b")
+        assert s.hlen(b"h") == 2
+        s.hclear(b"h")
+        assert s.hgetall(b"h") == []
+
+    def test_keys_disjoint(self, s):
+        # same name as string/hash/list: three separate objects
+        s.set(b"k", b"sv")
+        s.hset(b"k", b"f", b"hv")
+        s.rpush(b"k", b"lv")
+        assert s.get(b"k") == b"sv"
+        assert s.hget(b"k", b"f") == b"hv"
+        assert s.lindex(b"k", 0) == b"lv"
+
+    def test_prefix_scan(self, s):
+        s.hset(b"h", b"j1/a", b"1")
+        s.hset(b"h", b"j1/b", b"2")
+        s.hset(b"h", b"j2/a", b"3")
+        assert s.hscan_prefix(b"h", b"j1/") == [(b"j1/a", b"1"),
+                                                (b"j1/b", b"2")]
+
+
+class TestList:
+    def test_push_pop(self, s):
+        s.rpush(b"l", b"1", b"2")
+        s.lpush(b"l", b"0")
+        assert s.llen(b"l") == 3
+        assert s.litems(b"l") == [b"0", b"1", b"2"]
+        assert s.lindex(b"l", 0) == b"0"
+        assert s.lindex(b"l", -1) == b"2"
+        assert s.lindex(b"l", 9) is None
+        assert s.lpop(b"l") == b"0"
+        assert s.rpop(b"l") == b"2"
+        assert s.lpop(b"l") == b"1"
+        assert s.lpop(b"l") is None
+        assert s.llen(b"l") == 0
+
+    def test_lset_lrem(self, s):
+        s.rpush(b"l", b"a", b"b", b"c", b"d")
+        s.lset(b"l", 1, b"B")
+        assert s.litems(b"l") == [b"a", b"B", b"c", b"d"]
+        s.lrem_at(b"l", 1)
+        assert s.litems(b"l") == [b"a", b"c", b"d"]
+        s.lrem_at(b"l", 2)
+        assert s.litems(b"l") == [b"a", b"c"]
+        with pytest.raises(IndexError):
+            s.lset(b"l", 5, b"x")
+
+    def test_txn_atomicity(self, txn):
+        """Structure writes commit with the txn (the whole point)."""
+        st = txn.storage if hasattr(txn, "storage") else None
+        s = TxStructure(txn, prefix=b"x")
+        s.rpush(b"q", b"job1")
+        s.inc(b"ver")
+        txn.commit()
+        if st is None:
+            return
+        t2 = st.begin()
+        s2 = TxStructure(t2, prefix=b"x")
+        assert s2.litems(b"q") == [b"job1"]
+        assert s2.get_int(b"ver") == 1
+        t2.rollback()
+
+
+class TestMetaOnStructure:
+    def test_job_queue_fifo_update_finish(self):
+        from tidb_tpu.ddl.job import Job, JobState
+        from tidb_tpu.meta import Meta
+        st = new_mock_storage()
+        txn = st.begin()
+        m = Meta(txn)
+        j1 = Job(id=m.gen_global_id())
+        j2 = Job(id=m.gen_global_id())
+        m.enqueue_job(j1)
+        m.enqueue_job(j2)
+        assert m.first_job().id == j1.id
+        j1.state = JobState.RUNNING
+        m.update_job(j1)
+        assert m.first_job().state == JobState.RUNNING
+        m.finish_job(j1)
+        assert m.first_job().id == j2.id
+        assert m.history_job(j1.id).id == j1.id
+        m.finish_job(j2)
+        assert m.first_job() is None
+        txn.rollback()
+        st.close()

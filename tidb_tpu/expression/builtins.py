@@ -14,8 +14,8 @@ Each FnSpec:
   * result typing (`ret`: fixed eval kind or a callable over arg exprs);
   * `fn(args, argv, n)` whole-column evaluator -> (data, valid) where
     argv is [(data, valid)] numpy pairs;
-  * rows with any NULL argument are NULL unless `null_through=False`
-    (CONCAT_WS-style functions handle NULLs themselves).
+  * NULL handling is each fn's own job: most AND their args' validity
+    masks; CONCAT_WS/ELT/FIELD implement MySQL's special NULL rules.
 """
 
 from __future__ import annotations
@@ -46,9 +46,6 @@ class FnSpec:
     max_args: int
     ret: object                  # "int"|"real"|"string"|"datetime"|"first"|callable
     fn: Callable
-    device_safe: bool = False
-    volatile: bool = False
-    null_through: bool = True    # NULL in -> NULL out, row-wise
 
     def result_ft(self, args):
         if callable(self.ret):
@@ -109,16 +106,25 @@ def _num(argv):
     return [np.asarray(d, dtype=np.float64) for d, _v in argv]
 
 
-def _host_str(name):
-    """Decorator: register a host string fn over row scalars."""
-    def deco(f):
-        return f
-    return deco
+def _micros(d) -> np.ndarray:
+    """Datetime arg -> epoch-micros int64; string datetime literals (and
+    object columns) parse with MySQL semantics."""
+    arr = np.asarray(d)
+    if arr.dtype == object:
+        from tidb_tpu.sqltypes import parse_datetime
+        out = np.zeros(len(arr), dtype=np.int64)
+        for i, x in enumerate(arr):
+            if x is None or x == "":
+                continue
+            out[i] = int(x) if isinstance(x, (int, np.integer)) \
+                else parse_datetime(_s(x))
+        return out
+    return arr.astype(np.int64)
 
 
 def _dtarr(d):
-    """epoch-micros int64 -> numpy datetime64[us] (vectorized calendar)."""
-    return np.asarray(d, dtype=np.int64).view("datetime64[us]")
+    """epoch-micros -> numpy datetime64[us] (vectorized calendar)."""
+    return _micros(d).view("datetime64[us]")
 
 
 # -- math (builtin_math.go) --------------------------------------------------
@@ -184,10 +190,11 @@ def _truncate(args, argv, n):
     (xd, xv), (dd, dv) = argv
     v = xv & dv
     if args[0].ft.eval_type == EvalType.INT:
-        # negative D zeroes low digits; D >= 0 is identity
-        p = np.power(10.0, -np.minimum(np.asarray(dd, np.int64), 0))
-        out = (np.asarray(xd, np.int64) // p.astype(np.int64)) * \
-            p.astype(np.int64)
+        # negative D zeroes low digits TOWARD zero; D >= 0 is identity
+        p = np.power(10, -np.minimum(np.asarray(dd, np.int64), 0)
+                     ).astype(np.int64)
+        x = np.asarray(xd, np.int64)
+        out = np.sign(x) * ((np.abs(x) // p) * p)
         return out, v
     x = np.asarray(xd, np.float64)
     if args[0].ft.eval_type == EvalType.DECIMAL:
@@ -219,7 +226,7 @@ def _rand(args, argv, n):
     return rng.random_sample(n), np.ones(n, dtype=bool)
 
 
-_reg("RAND", 0, 1, "real", _rand, volatile=True)
+_reg("RAND", 0, 1, "real", _rand)
 
 
 def _conv_base(args, argv, n):
@@ -246,11 +253,13 @@ def _conv_base(args, argv, n):
 
 
 _reg("CONV", 3, 3, "string", _conv_base)
+# negatives render as 64-bit two's complement, as MySQL does
+_U64 = (1 << 64) - 1
 _reg("BIN", 1, 1, "string",
-     lambda a, argv, n: (_vec(lambda x: format(int(x), "b"),
+     lambda a, argv, n: (_vec(lambda x: format(int(x) & _U64, "b"),
                               argv[0][1], n, argv[0][0]), argv[0][1]))
 _reg("OCT", 1, 1, "string",
-     lambda a, argv, n: (_vec(lambda x: format(int(x), "o"),
+     lambda a, argv, n: (_vec(lambda x: format(int(x) & _U64, "o"),
                               argv[0][1], n, argv[0][0]), argv[0][1]))
 
 
@@ -259,7 +268,7 @@ def _hex(args, argv, n):
     d, v = argv[0]
     if args[0].ft.eval_type == EvalType.STRING:
         return _vec(lambda x: _s(x).encode().hex().upper(), v, n, d), v
-    return _vec(lambda x: format(int(x), "X"), v, n, d), v
+    return _vec(lambda x: format(int(x) & _U64, "X"), v, n, d), v
 
 
 _reg("HEX", 1, 1, "string", _hex)
@@ -297,14 +306,28 @@ def _sfn(name, min_a, max_a, pyfn, ret="string", **kw):
 _sfn("CHAR_LENGTH", 1, 1, lambda x: len(_s(x)), ret="int")
 _sfn("CHARACTER_LENGTH", 1, 1, lambda x: len(_s(x)), ret="int")
 _sfn("BIT_LENGTH", 1, 1, lambda x: len(_s(x).encode()) * 8, ret="int")
-_sfn("LPAD", 3, 3,
-     lambda x, k, p: _s(x)[:int(k)] if len(_s(x)) >= int(k)
-     else ((_s(p) * int(k))[:int(k) - len(_s(x))] + _s(x)
-           if _s(p) else _s(x)[:int(k)]))
-_sfn("RPAD", 3, 3,
-     lambda x, k, p: _s(x)[:int(k)] if len(_s(x)) >= int(k)
-     else (_s(x) + (_s(p) * int(k))[:int(k) - len(_s(x))]
-           if _s(p) else _s(x)[:int(k)]))
+def _pad(left: bool):
+    def fn(args, argv, n):
+        (xd, xv), (kd, kv), (pd_, pv) = argv
+        v = xv & kv & pv
+        k = np.asarray(kd, np.int64)
+        v = v & (k >= 0)              # negative length is NULL in MySQL
+
+        def one(x, k, p):
+            x, p, k = _s(x), _s(p), int(k)
+            if len(x) >= k:
+                return x[:k]
+            if not p:
+                return x[:k]
+            pad = (p * k)[:k - len(x)]
+            return pad + x if left else x + pad
+
+        return _vec(one, v, n, xd, kd, pd_), v
+    return fn
+
+
+_reg("LPAD", 3, 3, "string", _pad(True))
+_reg("RPAD", 3, 3, "string", _pad(False))
 _sfn("REPEAT", 2, 2, lambda x, k: _s(x) * max(int(k), 0))
 _sfn("REVERSE", 1, 1, lambda x: _s(x)[::-1])
 _sfn("SPACE", 1, 1, lambda k: " " * max(int(k), 0))
@@ -342,7 +365,7 @@ def _concat_ws(args, argv, n):
     return out, v
 
 
-_reg("CONCAT_WS", 2, 64, "string", _concat_ws, null_through=False)
+_reg("CONCAT_WS", 2, 64, "string", _concat_ws)
 
 
 def _elt(args, argv, n):
@@ -362,7 +385,7 @@ def _elt(args, argv, n):
     return out, v
 
 
-_reg("ELT", 2, 64, "string", _elt, null_through=False)
+_reg("ELT", 2, 64, "string", _elt)
 
 
 def _field(args, argv, n):
@@ -379,7 +402,7 @@ def _field(args, argv, n):
     return out, np.ones(n, dtype=bool)
 
 
-_reg("FIELD", 2, 64, "int", _field, null_through=False)
+_reg("FIELD", 2, 64, "int", _field)
 
 
 # -- greatest/least (builtin_compare.go) -------------------------------------
@@ -415,7 +438,7 @@ _reg("LEAST", 2, 64, _minmax_ft, _minmax(False))
 # -- date/time (builtin_time.go); all on epoch-micros int64 ------------------
 
 def _days(argv):
-    return np.asarray(argv[0][0], dtype=np.int64) // _US_PER_DAY
+    return _micros(argv[0][0]) // _US_PER_DAY
 
 
 def _ifn(name, min_a, max_a, fn, ret="int", **kw):
@@ -431,13 +454,13 @@ _ifn("TO_DAYS", 1, 1,
      lambda a, argv, n: (_days(argv) + 719528, _valid_all(argv, n)))
 _ifn("UNIX_TIMESTAMP", 0, 1,
      lambda a, argv, n: (
-         (np.asarray(argv[0][0], np.int64) // 1_000_000,
+         (_micros(argv[0][0]) // 1_000_000,
           _valid_all(argv, n)) if argv else
          (np.full(n, int(_dt.datetime.now().timestamp()), np.int64),
           np.ones(n, dtype=bool))),
-      volatile=True)
+)
 _ifn("MICROSECOND", 1, 1,
-     lambda a, argv, n: (np.asarray(argv[0][0], np.int64) % 1_000_000,
+     lambda a, argv, n: (_micros(argv[0][0]) % 1_000_000,
                          _valid_all(argv, n)))
 
 
@@ -463,19 +486,66 @@ _reg("DAYOFYEAR", 1, 1, "int", _cal_int(
     np.timedelta64(1, "D") + 1))
 _reg("QUARTER", 1, 1, "int", _cal_int(
     lambda dt: (dt.astype("datetime64[M]").astype(np.int64) % 12) // 3 + 1))
-_reg("WEEK", 1, 2, "int", _cal_int(
-    # mode 0: week 0-53, Sunday-first (the MySQL default)
-    lambda dt: ((dt.astype("datetime64[D]") -
-                 dt.astype("datetime64[Y]").astype("datetime64[D]"))
-                .astype(np.int64) +
-                ((dt.astype("datetime64[Y]").astype("datetime64[D]")
-                  .astype(np.int64) + 4) % 7)) // 7))
-_reg("YEARWEEK", 1, 1, "int", _cal_int(
-    lambda dt: (dt.astype("datetime64[Y]").astype(np.int64) + 1970) * 100 +
-    ((dt.astype("datetime64[D]") -
-      dt.astype("datetime64[Y]").astype("datetime64[D]")).astype(np.int64) +
-     ((dt.astype("datetime64[Y]").astype("datetime64[D]")
-       .astype(np.int64) + 4) % 7)) // 7))
+def _week0(d: _dt.date) -> int:
+    """MySQL WEEK mode 0: Sunday-first, 0-53 (days before the year's
+    first Sunday are week 0)."""
+    jan1 = _dt.date(d.year, 1, 1)
+    first_sunday = jan1 + _dt.timedelta((6 - jan1.weekday()) % 7)
+    if d < first_sunday:
+        return 0
+    return (d - first_sunday).days // 7 + 1
+
+
+def _to_us(x) -> int:
+    if isinstance(x, (int, np.integer)):
+        return int(x)
+    from tidb_tpu.sqltypes import parse_datetime
+    return parse_datetime(_s(x))
+
+
+def _week(args, argv, n):
+    mode = 0
+    if len(argv) == 2:
+        md = argv[1][0]
+        mode = int(md[0]) if len(md) else 0
+    if mode not in (0, 1, 3):
+        from tidb_tpu.executor import ExecError
+        raise ExecError(f"unsupported WEEK mode {mode}")
+    v = _valid_all(argv[:1], n)
+
+    def one(us):
+        d = micros_to_datetime(_to_us(us)).date()
+        if mode == 0:
+            return _week0(d)
+        iso_y, iso_w, _ = d.isocalendar()
+        if mode == 3:                 # ISO 8601: 1-53
+            return iso_w
+        # mode 1: Monday-first, 0-53, no rollover across years
+        if iso_y < d.year:
+            return 0
+        if iso_y > d.year:            # Dec tail of the NEXT iso year
+            return (d - _dt.timedelta(7)).isocalendar()[1] + 1
+        return iso_w
+
+    return _vec(one, v, n, argv[0][0], dtype=np.int64), v
+
+
+def _yearweek(args, argv, n):
+    v = _valid_all(argv, n)
+
+    def one(us):
+        d = micros_to_datetime(_to_us(us)).date()
+        w = _week0(d)
+        if w == 0:                    # belongs to the prior year's tail
+            prev = _dt.date(d.year - 1, 12, 31)
+            return (d.year - 1) * 100 + _week0(prev)
+        return d.year * 100 + w
+
+    return _vec(one, v, n, argv[0][0], dtype=np.int64), v
+
+
+_reg("WEEK", 1, 2, "int", _week)
+_reg("YEARWEEK", 1, 1, "int", _yearweek)
 
 _MONTHS = ["January", "February", "March", "April", "May", "June", "July",
            "August", "September", "October", "November", "December"]
@@ -504,7 +574,7 @@ def _last_day(args, argv, n):
     d, v = argv[0]
 
     def one(us):
-        dt = micros_to_datetime(int(us))
+        dt = micros_to_datetime(_to_us(us))
         last = calendar.monthrange(dt.year, dt.month)[1]
         return int(_dt.datetime(dt.year, dt.month, last)
                    .replace(tzinfo=_dt.timezone.utc).timestamp() * 1e6)
@@ -542,7 +612,8 @@ def _date_format(args, argv, n):
 
     def one(us, fmt):
         py = _mysql_fmt_to_strftime(_s(fmt))
-        return micros_to_datetime(int(us)).strftime(py.replace("%-", "%"))
+        return micros_to_datetime(_to_us(us)).strftime(
+            py.replace("%-", "%"))
 
     return _vec(one, v, n, dd, fd), v
 

@@ -1,18 +1,15 @@
 """Schema metadata on the KV plane.
 
-Reference: /root/reference/meta/meta.go:55-178 over structure/ (TxStructure
-hashes). Layout under the "m" prefix:
+Reference: /root/reference/meta/meta.go:55-178, layered on structure/
+TxStructure exactly as the reference is: databases live in one "DBs"
+hash (dbID -> DBInfo json), each database's tables in a "DB:{id}" hash
+(tableID -> TableInfo), counters in strings, the DDL job queue in a
+list, DDL history in a hash (meta.go:443-457 EnQueue/DeQueue/history).
+Every op runs inside the caller's transaction so metadata mutations
+commit atomically with schema version bumps.
 
-    m_nextID                   -> global id allocator
-    m_schemaVersion            -> global schema version counter
-    m_dbs/{dbID}               -> DBInfo json
-    m_db/{dbID}/{tableID}      -> TableInfo json
-    m_autoid/{tableID}         -> auto-increment base
-    m_ddljobs / m_ddlhistory   -> DDL job queues (ddl module)
-
-All keys sort after table-data keys ("m" > "t" is false — "m" < "t", so the
-meta range precedes table ranges; either way they are disjoint).
-"""
+All structure keys live under the "m" namespace, disjoint from table
+data ("t..." keys)."""
 
 from __future__ import annotations
 
@@ -20,26 +17,17 @@ import json
 
 from tidb_tpu import kv
 from tidb_tpu.schema.model import DBInfo, TableInfo
+from tidb_tpu.structure import TxStructure
 
 __all__ = ["Meta", "MetaError"]
-
-_PREFIX = b"m_"
 
 
 class MetaError(Exception):
     pass
 
 
-def _db_key(db_id: int) -> bytes:
-    return b"m_dbs/%020d" % db_id
-
-
-def _table_key(db_id: int, table_id: int) -> bytes:
-    return b"m_db/%020d/%020d" % (db_id, table_id)
-
-
-def _table_prefix(db_id: int) -> bytes:
-    return b"m_db/%020d/" % db_id
+def _f(n: int) -> bytes:
+    return b"%020d" % n
 
 
 class Meta:
@@ -47,180 +35,170 @@ class Meta:
     meta op set runs in its caller's txn for atomicity with schema version
     bumps)."""
 
-    NEXT_ID_KEY = b"m_nextID"
-    SCHEMA_VERSION_KEY = b"m_schemaVersion"
+    NEXT_ID_KEY = b"NextGlobalID"
+    SCHEMA_VERSION_KEY = b"SchemaVersion"
+    DBS_KEY = b"DBs"
+    JOB_LIST_KEY = b"DDLJobList"
+    JOB_HISTORY_KEY = b"DDLJobHistory"
+    SCHEMA_DIFF_KEY = b"SchemaDiffs"
+    DELETE_RANGE_KEY = b"DeleteRanges"
 
     def __init__(self, txn: kv.Transaction):
         self.txn = txn
+        self.t = TxStructure(txn, prefix=b"m")
 
     # -- id allocation -------------------------------------------------------
 
-    def _bump(self, key: bytes, step: int = 1) -> int:
-        raw = self.txn.get(key)
-        cur = int(raw) if raw else 0
-        cur += step
-        self.txn.set(key, b"%d" % cur)
-        return cur
-
     def gen_global_id(self) -> int:
-        return self._bump(self.NEXT_ID_KEY)
+        return self.t.inc(self.NEXT_ID_KEY)
 
     def gen_schema_version(self) -> int:
         """Ref: meta.go:177 GenSchemaVersion."""
-        return self._bump(self.SCHEMA_VERSION_KEY)
+        return self.t.inc(self.SCHEMA_VERSION_KEY)
 
     def schema_version(self) -> int:
-        raw = self.txn.get(self.SCHEMA_VERSION_KEY)
-        return int(raw) if raw else 0
+        return self.t.get_int(self.SCHEMA_VERSION_KEY)
 
     # -- auto increment ------------------------------------------------------
 
     def gen_auto_id(self, table_id: int, step: int) -> tuple[int, int]:
         """Allocate [base+1, base+step]; returns (first, last).
         Ref: meta/autoid batched allocator (autoid.go:36-46)."""
-        key = b"m_autoid/%020d" % table_id
-        raw = self.txn.get(key)
-        base = int(raw) if raw else 0
-        self.txn.set(key, b"%d" % (base + step))
-        return base + 1, base + step
+        last = self.t.inc(b"AutoID:" + _f(table_id), step)
+        return last - step + 1, last
 
     def rebase_auto_id(self, table_id: int, at_least: int) -> None:
-        key = b"m_autoid/%020d" % table_id
-        raw = self.txn.get(key)
-        base = int(raw) if raw else 0
-        if at_least > base:
-            self.txn.set(key, b"%d" % at_least)
+        key = b"AutoID:" + _f(table_id)
+        if at_least > self.t.get_int(key):
+            self.t.set(key, b"%d" % at_least)
 
-    # -- databases -----------------------------------------------------------
+    # -- databases (ref: meta.go mDBs hash) ----------------------------------
 
     def create_database(self, db: DBInfo) -> None:
-        key = _db_key(db.id)
-        if self.txn.get(key) is not None:
+        if self.t.hget(self.DBS_KEY, _f(db.id)) is not None:
             raise MetaError(f"db {db.id} already exists")
-        self.txn.set(key, db.dumps())
+        self.t.hset(self.DBS_KEY, _f(db.id), db.dumps())
 
     def drop_database(self, db_id: int) -> None:
-        self.txn.delete(_db_key(db_id))
-        for k, _ in list(self.txn.iter_range(_table_prefix(db_id),
-                                             _table_prefix(db_id + 1))):
-            self.txn.delete(k)
+        self.t.hdel(self.DBS_KEY, _f(db_id))
+        self.t.hclear(b"DB:" + _f(db_id))
 
     def get_database(self, db_id: int) -> DBInfo | None:
-        raw = self.txn.get(_db_key(db_id))
+        raw = self.t.hget(self.DBS_KEY, _f(db_id))
         return DBInfo.loads(raw) if raw else None
 
     def list_databases(self) -> list[DBInfo]:
-        out = []
-        for _k, v in self.txn.iter_range(b"m_dbs/", b"m_dbs0"):
-            out.append(DBInfo.loads(v))
-        return out
+        return [DBInfo.loads(v) for _f_, v in self.t.hgetall(self.DBS_KEY)]
 
-    # -- tables --------------------------------------------------------------
+    # -- tables (ref: meta.go mDBPrefix hash per db) -------------------------
 
     def create_table(self, db_id: int, tbl: TableInfo) -> None:
         if self.get_database(db_id) is None:
             raise MetaError(f"db {db_id} does not exist")
-        key = _table_key(db_id, tbl.id)
-        if self.txn.get(key) is not None:
+        if self.t.hget(b"DB:" + _f(db_id), _f(tbl.id)) is not None:
             raise MetaError(f"table {tbl.id} already exists")
-        self.txn.set(key, tbl.dumps())
+        self.t.hset(b"DB:" + _f(db_id), _f(tbl.id), tbl.dumps())
 
     def update_table(self, db_id: int, tbl: TableInfo) -> None:
-        self.txn.set(_table_key(db_id, tbl.id), tbl.dumps())
+        self.t.hset(b"DB:" + _f(db_id), _f(tbl.id), tbl.dumps())
 
     def drop_table(self, db_id: int, table_id: int) -> None:
-        self.txn.delete(_table_key(db_id, table_id))
+        self.t.hdel(b"DB:" + _f(db_id), _f(table_id))
 
     def get_table(self, db_id: int, table_id: int) -> TableInfo | None:
-        raw = self.txn.get(_table_key(db_id, table_id))
+        raw = self.t.hget(b"DB:" + _f(db_id), _f(table_id))
         return TableInfo.loads(raw) if raw else None
 
     def list_tables(self, db_id: int) -> list[TableInfo]:
-        out = []
-        for _k, v in self.txn.iter_range(_table_prefix(db_id),
-                                         _table_prefix(db_id + 1)):
-            out.append(TableInfo.loads(v))
-        return out
+        return [TableInfo.loads(v)
+                for _f_, v in self.t.hgetall(b"DB:" + _f(db_id))]
 
     # -- DDL job queue (ref: meta.go:443-457 EnQueue/DeQueue/history) --------
 
-    JOB_SEQ_KEY = b"m_ddlJobSeq"
-
-    @staticmethod
-    def _job_key(seq: int) -> bytes:
-        return b"m_ddlJobQ/%020d" % seq
+    JOB_SEQ_KEY = b"DDLJobSeq"
 
     def enqueue_job(self, job) -> None:
-        seq = self._bump(self.JOB_SEQ_KEY)
-        job.seq = seq
-        self.txn.set(self._job_key(seq), job.dumps())
+        job.seq = self.t.inc(self.JOB_SEQ_KEY)
+        self.t.rpush(self.JOB_LIST_KEY, job.dumps())
 
     def first_job(self):
         from tidb_tpu.ddl.job import Job
-        for _k, v in self.txn.iter_range(b"m_ddlJobQ/", b"m_ddlJobQ0"):
-            return Job.loads(v)
+        raw = self.t.lindex(self.JOB_LIST_KEY, 0)
+        return Job.loads(raw) if raw else None
+
+    def _job_index(self, job) -> int | None:
+        from tidb_tpu.ddl.job import Job
+        for i, raw in enumerate(self.t.litems(self.JOB_LIST_KEY)):
+            if Job.loads(raw).seq == job.seq:
+                return i
         return None
 
     def update_job(self, job) -> None:
-        self.txn.set(self._job_key(job.seq), job.dumps())
+        i = self._job_index(job)
+        if i is None:
+            raise MetaError(f"job seq {job.seq} not in queue")
+        self.t.lset(self.JOB_LIST_KEY, i, job.dumps())
 
     def finish_job(self, job) -> None:
         """Move from queue to history (ref: job to history queue)."""
-        self.txn.delete(self._job_key(job.seq))
-        self.txn.set(b"m_ddlHist/%020d" % job.id, job.dumps())
+        i = self._job_index(job)
+        if i is not None:
+            self.t.lrem_at(self.JOB_LIST_KEY, i)
+        self.t.hset(self.JOB_HISTORY_KEY, _f(job.id), job.dumps())
 
     def history_job(self, job_id: int):
         from tidb_tpu.ddl.job import Job
-        raw = self.txn.get(b"m_ddlHist/%020d" % job_id)
+        raw = self.t.hget(self.JOB_HISTORY_KEY, _f(job_id))
         return Job.loads(raw) if raw else None
 
     # -- schema diffs (ref: model.SchemaDiff; consumed by the schema
     # validator and incremental infoschema reload) ---------------------------
 
     def set_schema_diff(self, version: int, table_ids: list[int]) -> None:
-        self.txn.set(b"m_schemaDiff/%020d" % version,
-                     json.dumps(table_ids).encode())
+        self.t.hset(self.SCHEMA_DIFF_KEY, _f(version),
+                    json.dumps(table_ids).encode())
 
     def schema_diff(self, version: int) -> list[int] | None:
-        raw = self.txn.get(b"m_schemaDiff/%020d" % version)
+        raw = self.t.hget(self.SCHEMA_DIFF_KEY, _f(version))
         return json.loads(raw) if raw else None
 
     # -- delete-range queue (ref: ddl/delete_range.go:51 inserts into
     # mysql.gc_delete_range; drained by the GC worker) -----------------------
 
-    DR_SEQ_KEY = b"m_drSeq"
+    DR_SEQ_KEY = b"DeleteRangeSeq"
 
     def add_delete_range(self, job_id: int, start: bytes, end: bytes) -> None:
-        seq = self._bump(self.DR_SEQ_KEY)
+        seq = self.t.inc(self.DR_SEQ_KEY)
         # ts stays 0 until the job's txn COMMITS; the worker then seals the
         # range with a fresh timestamp (>= the drop's commit ts). GC only
         # drains sealed ranges whose seal ts <= safepoint, so snapshots
         # that still see the pre-drop schema can still read the data
         # (ref: gc_delete_range.ts, written after the job finishes).
-        # Keyed by job id so sealing is a per-job prefix scan; GC re-seals
-        # orphans (job finished but seal crashed) so nothing leaks.
+        # Fields are job-prefixed so sealing is a per-job prefix scan; GC
+        # re-seals orphans (job finished but seal crashed) so nothing leaks.
         rec = json.dumps({"job": job_id, "start": start.hex(),
                           "end": end.hex(), "ts": 0}).encode()
-        self.txn.set(b"m_deleteRange/%020d/%020d" % (job_id, seq), rec)
+        self.t.hset(self.DELETE_RANGE_KEY, _f(job_id) + b"/" + _f(seq), rec)
 
     def seal_delete_ranges(self, job_id: int, ts: int) -> None:
         """Stamp a finished job's ranges as deletable once safepoint > ts."""
-        prefix = b"m_deleteRange/%020d/" % job_id
-        for k, v in self.txn.iter_range(prefix, prefix[:-1] + b"0"):
+        for f, v in self.t.hscan_prefix(self.DELETE_RANGE_KEY,
+                                        _f(job_id) + b"/"):
             o = json.loads(v)
             if not o["ts"]:
                 o["ts"] = ts
-                self.txn.set(k, json.dumps(o).encode())
+                self.t.hset(self.DELETE_RANGE_KEY, f,
+                            json.dumps(o).encode())
 
     def pending_delete_ranges(self
                               ) -> list[tuple[bytes, int, bytes, bytes, int]]:
-        """-> [(queue_key, job_id, start, end, ts)]"""
+        """-> [(queue_field, job_id, start, end, ts)]"""
         out = []
-        for k, v in self.txn.iter_range(b"m_deleteRange/", b"m_deleteRange0"):
+        for f, v in self.t.hgetall(self.DELETE_RANGE_KEY):
             o = json.loads(v)
-            out.append((k, o["job"], bytes.fromhex(o["start"]),
+            out.append((f, o["job"], bytes.fromhex(o["start"]),
                         bytes.fromhex(o["end"]), o.get("ts", 0)))
         return out
 
-    def remove_delete_range(self, queue_key: bytes) -> None:
-        self.txn.delete(queue_key)
+    def remove_delete_range(self, queue_field: bytes) -> None:
+        self.t.hdel(self.DELETE_RANGE_KEY, queue_field)
