@@ -138,6 +138,9 @@ class TestTime:
         assert one(sess, "WEEK('2019-12-30', 1)") == 53
         assert one(sess, "YEARWEEK('2024-01-01')") == 202353
 
+    def test_week_null_mode_is_null(self, sess):
+        assert one(sess, "WEEK('2024-01-01', NULL)") is None
+
     def test_string_datetime_literals(self, sess):
         assert one(sess, "DAYNAME('2024-03-15')") == "Friday"
         assert one(sess, "LAST_DAY('2024-02-10')") == \

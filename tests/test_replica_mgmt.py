@@ -1,0 +1,108 @@
+"""Replica/partition management: store failure, leader failover,
+replica repair, leader balancing (ref: region_request.go onSendFail
+store failover; PD's balance schedulers; SURVEY §2.7-6)."""
+
+import pytest
+
+from tidb_tpu.kv import StoreUnavailableError
+from tidb_tpu.session import Session
+from tidb_tpu.store.storage import new_mock_storage
+
+
+@pytest.fixture
+def storage():
+    st = new_mock_storage(num_stores=3)
+    yield st
+    st.close()
+
+
+class TestFailover:
+    def test_reads_and_writes_survive_leader_store_death(self, storage):
+        t = storage.begin()
+        t.set(b"k1", b"v1")
+        t.commit()
+        region = storage.cluster.region_by_key(b"k1")
+        storage.region_cache.locate(b"k1")          # cache old leader
+        storage.cluster.drop_store(region.leader_store)
+        # read: client hits the dead store, reloads, follows new leader
+        assert storage.begin().get(b"k1") == b"v1"
+        t2 = storage.begin()
+        t2.set(b"k2", b"v2")
+        t2.commit()
+        assert storage.begin().get(b"k2") == b"v2"
+
+    def test_new_leader_is_surviving_peer(self, storage):
+        region = storage.cluster.region_by_key(b"k")
+        old_leader = region.leader_store
+        storage.cluster.drop_store(old_leader)
+        r2 = storage.cluster.region_by_key(b"k")
+        assert r2.leader_store != old_leader
+        assert old_leader not in r2.peer_stores
+        assert r2.conf_ver > region.conf_ver      # peer set changed
+
+    def test_replica_repair_after_drop(self, storage):
+        extra = storage.cluster.add_store()
+        region = storage.cluster.region_by_key(b"k")
+        assert extra not in region.peer_stores
+        n_before = len(region.peer_stores)
+        storage.cluster.drop_store(region.peer_stores[0])
+        r2 = storage.cluster.region_by_key(b"k")
+        # replication factor restored using the spare store
+        assert len(r2.peer_stores) == n_before
+        assert extra in r2.peer_stores
+
+    def test_dead_store_rpc_raises_store_unavailable(self, storage):
+        loc = storage.region_cache.locate(b"k")
+        storage.cluster.stores[loc.ctx.store_id].dropped = True
+        with pytest.raises(StoreUnavailableError):
+            storage.shim.kv_get(loc.ctx, b"k", storage.current_ts())
+
+    def test_sql_survives_failover_mid_session(self, storage):
+        s = Session(storage)
+        s.execute("CREATE DATABASE d")
+        s.execute("USE d")
+        s.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, v BIGINT)")
+        s.execute("INSERT INTO t VALUES " + ",".join(
+            f"({i},{i})" for i in range(200)))
+        s.query("SPLIT TABLE t REGIONS 4")
+        assert s.query("SELECT COUNT(*) FROM t").rows == [(200,)]
+        # kill whichever store leads the table's first region
+        region = storage.cluster.region_by_key(b"t")
+        storage.cluster.drop_store(region.leader_store)
+        assert s.query("SELECT COUNT(*), SUM(v) FROM t").rows == \
+            [(200, sum(range(200)))]
+        s.execute("INSERT INTO t VALUES (999, 999)")
+        assert s.query("SELECT v FROM t WHERE id=999").rows == [(999,)]
+        s.close()
+
+
+class TestBalance:
+    def test_balance_leaders_evens_counts(self, storage):
+        for i in range(1, 12):
+            storage.cluster.split(b"k%02d" % i)
+        counts = storage.cluster.leader_counts()
+        assert max(counts.values()) - min(counts.values()) > 1
+        moved = storage.cluster.balance_leaders()
+        assert moved > 0
+        counts = storage.cluster.leader_counts()
+        assert max(counts.values()) - min(counts.values()) <= 1
+        # reads still route correctly after the transfers
+        assert storage.begin().get(b"k05") is None
+
+    def test_balance_idempotent(self, storage):
+        storage.cluster.balance_leaders()
+        assert storage.cluster.balance_leaders() == 0
+
+    def test_leader_transfer_keeps_epoch(self, storage):
+        """Leadership is not part of the region epoch: a cached ctx only
+        sees NotLeader (with the new leader), never EpochNotMatch."""
+        sid = storage.cluster.add_store()
+        region = storage.cluster.region_by_key(b"k")
+        t = storage.begin()
+        t.set(b"k", b"v")
+        t.commit()
+        storage.region_cache.locate(b"k")
+        storage.cluster.change_leader(region.id, sid)
+        r2 = storage.cluster.region_by_key(b"k")
+        assert r2.version == region.version
+        assert storage.begin().get(b"k") == b"v"

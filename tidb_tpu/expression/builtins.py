@@ -504,16 +504,13 @@ def _to_us(x) -> int:
 
 
 def _week(args, argv, n):
-    mode = 0
-    if len(argv) == 2:
-        md = argv[1][0]
-        mode = int(md[0]) if len(md) else 0
-    if mode not in (0, 1, 3):
-        from tidb_tpu.executor import ExecError
-        raise ExecError(f"unsupported WEEK mode {mode}")
-    v = _valid_all(argv[:1], n)
+    v = _valid_all(argv, n)           # NULL date OR NULL mode -> NULL
 
-    def one(us):
+    def one(us, m=0):
+        mode = int(m)
+        if mode not in (0, 1, 3):
+            from tidb_tpu.executor import ExecError
+            raise ExecError(f"unsupported WEEK mode {mode}")
         d = micros_to_datetime(_to_us(us)).date()
         if mode == 0:
             return _week0(d)
@@ -527,7 +524,8 @@ def _week(args, argv, n):
             return (d - _dt.timedelta(7)).isocalendar()[1] + 1
         return iso_w
 
-    return _vec(one, v, n, argv[0][0], dtype=np.int64), v
+    arrs = [argv[0][0]] + ([argv[1][0]] if len(argv) == 2 else [])
+    return _vec(one, v, n, *arrs, dtype=np.int64), v
 
 
 def _yearweek(args, argv, n):

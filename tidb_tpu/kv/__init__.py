@@ -142,6 +142,20 @@ class EpochNotMatchError(RegionError):
         return (EpochNotMatchError, (self.region_id,))
 
 
+class StoreUnavailableError(RegionError):
+    """The targeted store is down (connection refused / dropped peer).
+    A RegionError so clients invalidate + re-route exactly like the
+    reference's store failover (region_request.go onSendFail)."""
+
+    def __init__(self, region_id: int, store_id: int):
+        super().__init__(f"region {region_id}: store {store_id} down")
+        self.region_id = region_id
+        self.store_id = store_id
+
+    def __reduce__(self):
+        return (StoreUnavailableError, (self.region_id, self.store_id))
+
+
 class ServerBusyError(RetryableError):
     pass
 

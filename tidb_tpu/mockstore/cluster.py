@@ -169,10 +169,97 @@ class Cluster:
             self._regions[left_start] = merged
 
     def change_leader(self, region_id: int, store_id: int) -> None:
+        """Leadership is NOT part of the region epoch (TiKV semantics):
+        a transfer changes no version, clients just follow NotLeader."""
         with self._mu:
             for start, r in self._regions.items():
                 if r.id == region_id:
+                    peers, bump = r.peer_stores, r.conf_ver
+                    if store_id not in peers:
+                        peers = peers + (store_id,)
+                        bump += 1    # peer membership change IS epoch
                     self._regions[start] = replace(
-                        r, leader_store=store_id, conf_ver=r.conf_ver + 1)
+                        r, leader_store=store_id, peer_stores=peers,
+                        conf_ver=bump)
                     return
             raise ValueError(f"no region {region_id}")
+
+    # -- replica/partition management (the PD role; ref: region_request.go
+    # store failover client-side, PD balance schedulers server-side) ---------
+
+    def live_stores(self) -> list[int]:
+        with self._mu:
+            return [sid for sid, s in self.stores.items() if not s.dropped]
+
+    def store_is_up(self, store_id: int) -> bool:
+        with self._mu:
+            s = self.stores.get(store_id)
+            return s is not None and not s.dropped
+
+    def drop_store(self, store_id: int) -> None:
+        """Take a store down: every region it led elects a surviving
+        peer, and under-replicated regions get a replacement replica on
+        a live store (conf change -> conf_ver bump, exactly what a peer
+        membership change means)."""
+        with self._mu:
+            st = self.stores.get(store_id)
+            if st is None:
+                raise ValueError(f"no store {store_id}")
+            st.dropped = True
+            live = [sid for sid, s in self.stores.items() if not s.dropped]
+            if not live:
+                return               # total outage: nothing to elect
+            for start, r in list(self._regions.items()):
+                if store_id not in r.peer_stores and \
+                        r.leader_store != store_id:
+                    continue
+                peers = tuple(p for p in r.peer_stores if p != store_id)
+                spare = [sid for sid in live if sid not in peers]
+                if len(peers) < len(r.peer_stores) and spare:
+                    peers = peers + (spare[0],)   # repair replication
+                if not peers:
+                    peers = (live[0],)
+                leader = r.leader_store
+                if leader == store_id or leader not in peers:
+                    leader = peers[0]
+                self._regions[start] = replace(
+                    r, leader_store=leader, peer_stores=peers,
+                    conf_ver=r.conf_ver + 1)
+
+    def leader_counts(self) -> dict[int, int]:
+        with self._mu:
+            out = {sid: 0 for sid, s in self.stores.items()
+                   if not s.dropped}
+            for r in self._regions.values():
+                if r.leader_store in out:
+                    out[r.leader_store] += 1
+            return out
+
+    def balance_leaders(self) -> int:
+        """One PD balance-leader pass: transfer leaders from the most-
+        loaded live store to the least-loaded until counts differ by at
+        most one. -> number of transfers."""
+        moved = 0
+        while True:
+            with self._mu:
+                counts = self.leader_counts()
+                if len(counts) < 2:
+                    return moved
+                hi = max(counts, key=counts.get)
+                lo = min(counts, key=counts.get)
+                if counts[hi] - counts[lo] <= 1:
+                    return moved
+                victim = None
+                for start, r in self._regions.items():
+                    if r.leader_store == hi:
+                        victim = (start, r)
+                        break
+                if victim is None:
+                    return moved
+                start, r = victim
+                peers = r.peer_stores if lo in r.peer_stores \
+                    else r.peer_stores + (lo,)
+                bump = r.conf_ver + (0 if lo in r.peer_stores else 1)
+                self._regions[start] = replace(
+                    r, leader_store=lo, peer_stores=peers, conf_ver=bump)
+            moved += 1

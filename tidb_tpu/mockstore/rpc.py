@@ -17,7 +17,7 @@ from typing import Callable, Optional
 
 from tidb_tpu.kv import (EpochNotMatchError, IsolationLevel, KVError,
                          Mutation, NotLeaderError, RegionError,
-                         ServerBusyError)
+                         ServerBusyError, StoreUnavailableError)
 from tidb_tpu.mockstore.cluster import Cluster, Region
 from tidb_tpu.mockstore.mvcc import MVCCStore
 
@@ -52,6 +52,11 @@ class RPCShim:
     def _check(self, cmd: str, ctx: RegionCtx) -> Region:
         if self.inject is not None:
             self.inject(cmd, ctx)
+        if not self.cluster.store_is_up(ctx.store_id):
+            # the address the client dialed is dead: connection-level
+            # failure (ref: region_request.go onSendFail -> retry other
+            # peers after a region reload)
+            raise StoreUnavailableError(ctx.region_id, ctx.store_id)
         region = self.cluster.region_by_id(ctx.region_id)
         if region is None:
             raise EpochNotMatchError(ctx.region_id)
