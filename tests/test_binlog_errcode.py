@@ -1,0 +1,122 @@
+"""Binlog change capture (ref: sessionctx/binloginfo, 2pc.go:664) and
+MySQL error-code classification on the wire (ref: mysql/errcode.go,
+terror/terror.go:152)."""
+
+import pytest
+
+from tidb_tpu import binlog, errcode
+from tidb_tpu.session import Session
+from tidb_tpu.store.storage import new_mock_storage
+
+
+class TestBinlog:
+    @pytest.fixture
+    def env(self):
+        st = new_mock_storage()
+        pump = binlog.MemoryPump()
+        st.binlog_pump = pump
+        s = Session(st)
+        s.execute("CREATE DATABASE d")
+        s.execute("USE d")
+        s.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, v BIGINT)")
+        yield st, pump, s
+        s.close()
+        st.close()
+
+    def test_dml_produces_ordered_events(self, env):
+        st, pump, s = env
+        before = len(pump.events())
+        s.execute("INSERT INTO t VALUES (1, 10), (2, 20)")
+        s.execute("UPDATE t SET v = 11 WHERE id = 1")
+        s.execute("DELETE FROM t WHERE id = 2")
+        evs = pump.events()[before:]
+        assert len(evs) == 3
+        # commit order is ts order, each event has both timestamps
+        cts = [e.commit_ts for e in evs]
+        assert cts == sorted(cts)
+        assert all(e.commit_ts > e.start_ts for e in evs)
+
+    def test_row_level_decode(self, env):
+        st, pump, s = env
+        info = s.domain.info_schema().table("d", "t")
+        s.execute("INSERT INTO t VALUES (7, 70)")
+        ins = binlog.decode_row_events(pump.events()[-1])
+        puts = [r for r in ins if r.op == "PUT"]
+        assert puts and puts[0].table_id == info.id
+        assert puts[0].handle == 7
+        assert 70 in puts[0].values.values()
+        s.execute("DELETE FROM t WHERE id = 7")
+        dels = binlog.decode_row_events(pump.events()[-1])
+        assert any(r.op == "DELETE" and r.handle == 7 for r in dels)
+
+    def test_rolled_back_txn_emits_nothing(self, env):
+        st, pump, s = env
+        before = len(pump.events())
+        s.execute("BEGIN")
+        s.execute("INSERT INTO t VALUES (9, 90)")
+        s.execute("ROLLBACK")
+        assert len(pump.events()) == before
+
+    def test_subscriber_and_since_filter(self, env):
+        st, pump, s = env
+        got = []
+        pump.subscribe(got.append)
+        s.execute("INSERT INTO t VALUES (5, 50)")
+        assert len(got) == 1
+        cts = got[0].commit_ts
+        s.execute("INSERT INTO t VALUES (6, 60)")
+        later = pump.events(since_commit_ts=cts)
+        assert len(later) == 1 and later[0].commit_ts > cts
+
+    def test_no_pump_no_overhead(self):
+        st = new_mock_storage()
+        s = Session(st)
+        s.execute("CREATE DATABASE d; USE d")
+        s.execute("CREATE TABLE t (id BIGINT PRIMARY KEY)")
+        s.execute("INSERT INTO t VALUES (1)")   # must not blow up
+        assert s.query("SELECT COUNT(*) FROM t").rows == [(1,)]
+        s.close()
+
+
+class TestErrcode:
+    def test_classify_typed(self):
+        from tidb_tpu.parser import ParseError
+        from tidb_tpu.schema.infoschema import SchemaError
+        from tidb_tpu.table import DupKeyError
+        assert errcode.classify(DupKeyError("dup"))[0] == 1062
+        code, state, msg = errcode.classify(ParseError("bad"))
+        assert (code, state) == (1064, "42000") and "syntax" in msg
+        assert errcode.classify(
+            SchemaError("Unknown database 'x'"))[0] == 1049
+        assert errcode.classify(
+            SchemaError("Table 'x' doesn't exist"))[0] == 1146
+
+    def test_classify_by_message(self):
+        from tidb_tpu.session import SQLError
+        assert errcode.classify(
+            SQLError("SELECT command denied to user"))[0] == 1142
+        assert errcode.classify(
+            SQLError("Unknown column 'q'"))[0] == 1054
+        assert errcode.classify(SQLError("???"))[0] == errcode.ER_UNKNOWN
+
+    def test_wire_codes(self):
+        from mysql_client import MiniClient, MySQLError
+        from tidb_tpu.server import Server
+        st = new_mock_storage()
+        srv = Server(st, port=0)
+        srv.start()
+        c = MiniClient("127.0.0.1", srv.port, user="root")
+        c.query("CREATE DATABASE d")
+        c.query("CREATE TABLE d.t (id BIGINT PRIMARY KEY)")
+        c.query("INSERT INTO d.t VALUES (1)")
+        with pytest.raises(MySQLError) as ei:
+            c.query("INSERT INTO d.t VALUES (1)")
+        assert ei.value.code == 1062
+        with pytest.raises(MySQLError) as ei:
+            c.query("SELECT * FROM d.nope")
+        assert ei.value.code == 1146
+        with pytest.raises(MySQLError) as ei:
+            c.query("SELEKT 1")
+        assert ei.value.code == 1064
+        c.close()
+        srv.close()

@@ -1,0 +1,123 @@
+"""MySQL error-code catalog and exception classification.
+
+Reference: /root/reference/mysql/errcode.go (the code constants),
+mysql/errname.go, terror/terror.go:152 (error class -> MySQL code
+mapping surfaced on the wire). The server's ERR packet carries
+(errno, sqlstate, message); classify() maps the framework's typed
+exceptions onto the right pair so MySQL clients and drivers see
+standard codes (1062 duplicate key, 1146 missing table, ...)."""
+
+from __future__ import annotations
+
+import re
+
+__all__ = ["classify", "ER_UNKNOWN"]
+
+# -- the catalog (subset the engine can actually raise) ----------------------
+
+ER_DUP_ENTRY = 1062
+ER_NO_SUCH_TABLE = 1146
+ER_BAD_DB_ERROR = 1049
+ER_DB_CREATE_EXISTS = 1007
+ER_TABLE_EXISTS_ERROR = 1050
+ER_PARSE_ERROR = 1064
+ER_ACCESS_DENIED_ERROR = 1045
+ER_TABLEACCESS_DENIED_ERROR = 1142
+ER_BAD_FIELD_ERROR = 1054
+ER_NON_UNIQ_ERROR = 1052          # ambiguous column
+ER_UNKNOWN_SYSTEM_VARIABLE = 1193
+ER_LOCK_WAIT_TIMEOUT = 1205
+ER_LOCK_DEADLOCK = 1213
+ER_NO_DB_ERROR = 1046
+ER_WRONG_VALUE_COUNT = 1136
+ER_TRUNCATED_WRONG_VALUE = 1292
+ER_DATA_TOO_LONG = 1406
+ER_BAD_NULL_ERROR = 1048
+ER_UNKNOWN = 1105
+
+_SQLSTATE = {
+    ER_DUP_ENTRY: "23000",
+    ER_BAD_NULL_ERROR: "23000",
+    ER_NO_SUCH_TABLE: "42S02",
+    ER_BAD_DB_ERROR: "42000",
+    ER_DB_CREATE_EXISTS: "HY000",
+    ER_TABLE_EXISTS_ERROR: "42S01",
+    ER_PARSE_ERROR: "42000",
+    ER_ACCESS_DENIED_ERROR: "28000",
+    ER_TABLEACCESS_DENIED_ERROR: "42000",
+    ER_BAD_FIELD_ERROR: "42S22",
+    ER_NON_UNIQ_ERROR: "23000",
+    ER_UNKNOWN_SYSTEM_VARIABLE: "HY000",
+    ER_LOCK_WAIT_TIMEOUT: "HY000",
+    ER_LOCK_DEADLOCK: "40001",
+    ER_NO_DB_ERROR: "3D000",
+    ER_WRONG_VALUE_COUNT: "21S01",
+    ER_TRUNCATED_WRONG_VALUE: "22007",
+    ER_DATA_TOO_LONG: "22001",
+    ER_UNKNOWN: "HY000",
+}
+
+# message-shape fallbacks for SQLError strings raised deep in the stack
+_PATTERNS = [
+    (re.compile(r"Unknown database", re.I), ER_BAD_DB_ERROR),
+    (re.compile(r"doesn't exist|Unknown table", re.I), ER_NO_SUCH_TABLE),
+    (re.compile(r"already exists", re.I), ER_TABLE_EXISTS_ERROR),
+    (re.compile(r"Unknown column", re.I), ER_BAD_FIELD_ERROR),
+    (re.compile(r"ambiguous", re.I), ER_NON_UNIQ_ERROR),
+    (re.compile(r"denied", re.I), ER_TABLEACCESS_DENIED_ERROR),
+    (re.compile(r"Unknown system variable|unknown variable", re.I),
+     ER_UNKNOWN_SYSTEM_VARIABLE),
+    (re.compile(r"No database selected", re.I), ER_NO_DB_ERROR),
+    (re.compile(r"parameter count|column count", re.I),
+     ER_WRONG_VALUE_COUNT),
+    (re.compile(r"cannot be null", re.I), ER_BAD_NULL_ERROR),
+    (re.compile(r"incorrect value", re.I), ER_TRUNCATED_WRONG_VALUE),
+]
+
+
+def _is_sql_layer(exc: BaseException) -> bool:
+    from tidb_tpu import kv
+    from tidb_tpu.session import SQLError
+    return isinstance(exc, (SQLError, kv.KVError))
+
+
+def classify(exc: BaseException) -> tuple[int, str, str]:
+    """exception -> (errno, sqlstate, message) for the wire ERR packet."""
+    from tidb_tpu import kv
+    from tidb_tpu.parser import ParseError
+    from tidb_tpu.schema.infoschema import SchemaError
+    from tidb_tpu.table import DupKeyError
+
+    msg = str(exc)
+    code = None
+    if isinstance(exc, DupKeyError):
+        code = ER_DUP_ENTRY
+    elif isinstance(exc, ParseError):
+        code = ER_PARSE_ERROR
+        msg = f"You have an error in your SQL syntax; {msg}"
+    elif isinstance(exc, SchemaError):
+        code = ER_BAD_DB_ERROR if "database" in msg.lower() \
+            else ER_NO_SUCH_TABLE
+    elif isinstance(exc, kv.KeyLockedError):
+        code = ER_LOCK_WAIT_TIMEOUT
+    elif isinstance(exc, kv.WriteConflictError):
+        code = ER_LOCK_DEADLOCK
+    else:
+        try:
+            from tidb_tpu.config import UnknownVariableError
+            if isinstance(exc, UnknownVariableError):
+                code = ER_UNKNOWN_SYSTEM_VARIABLE
+                msg = f"Unknown system variable '{msg}'"
+        except ImportError:
+            pass
+    if code is None and _is_sql_layer(exc):
+        # message patterns apply ONLY to SQL-layer errors; an arbitrary
+        # internal exception must surface as ER_UNKNOWN ("internal
+        # error"), never masquerade as a user mistake
+        for pat, c in _PATTERNS:
+            if pat.search(msg):
+                code = c
+                break
+    if code is None:
+        code = ER_UNKNOWN
+    return code, _SQLSTATE.get(code, "HY000"), msg

@@ -21,7 +21,8 @@ __all__ = [
     "IsolationLevel", "Priority", "ReqType",
     "KVError", "KeyLockedError", "WriteConflictError", "TxnAbortedError",
     "RegionError", "NotFoundError", "RetryableError", "ServerBusyError",
-    "EpochNotMatchError", "NotLeaderError", "UndeterminedError",
+    "EpochNotMatchError", "NotLeaderError", "StoreUnavailableError",
+    "UndeterminedError",
     "LockInfo", "Mutation", "MutationOp",
     "MemBuffer", "UnionStore", "Snapshot", "Transaction", "Storage",
     "KVRange", "CopRequest", "CopResponse", "Client",

@@ -217,8 +217,6 @@ class Cluster:
                 spare = [sid for sid in live if sid not in peers]
                 if len(peers) < len(r.peer_stores) and spare:
                     peers = peers + (spare[0],)   # repair replication
-                if not peers:
-                    peers = (live[0],)
                 leader = r.leader_store
                 if leader == store_id or leader not in peers:
                     leader = peers[0]
@@ -249,17 +247,16 @@ class Cluster:
                 lo = min(counts, key=counts.get)
                 if counts[hi] - counts[lo] <= 1:
                     return moved
+                # leadership-only operation: transfer within the
+                # existing peer set (membership changes are drop_store's
+                # job, as in PD's balance-leader scheduler)
                 victim = None
                 for start, r in self._regions.items():
-                    if r.leader_store == hi:
+                    if r.leader_store == hi and lo in r.peer_stores:
                         victim = (start, r)
                         break
                 if victim is None:
                     return moved
                 start, r = victim
-                peers = r.peer_stores if lo in r.peer_stores \
-                    else r.peer_stores + (lo,)
-                bump = r.conf_ver + (0 if lo in r.peer_stores else 1)
-                self._regions[start] = replace(
-                    r, leader_store=lo, peer_stores=peers, conf_ver=bump)
+                self._regions[start] = replace(r, leader_store=lo)
             moved += 1

@@ -205,10 +205,14 @@ class ClientConn:
                 return
             try:
                 self._dispatch(cmd, data)
-            except SQLError as e:
-                self._write_err(str(e))
             except Exception as e:  # noqa: BLE001 - never kill the conn
-                self._write_err(f"internal error: {e}")
+                # typed errors carry standard MySQL codes on the wire
+                # (ref: terror.go:152 error-class -> code mapping)
+                from tidb_tpu.errcode import ER_UNKNOWN, classify
+                code, state, msg = classify(e)
+                if code == ER_UNKNOWN and not isinstance(e, SQLError):
+                    msg = f"internal error: {msg}"
+                self._write_err(msg, code=code, sqlstate=state)
 
     def shutdown(self) -> None:
         """Unblock the connection thread's read; safe from any thread."""
@@ -490,8 +494,10 @@ class ClientConn:
             b"\xfe" + struct.pack("<H", 0)
             + struct.pack("<H", SERVER_STATUS_AUTOCOMMIT))
 
-    def _write_err(self, msg: str, code: int = ER_UNKNOWN) -> None:
-        pkt = b"\xff" + struct.pack("<H", code) + b"#HY000"
+    def _write_err(self, msg: str, code: int = ER_UNKNOWN,
+                   sqlstate: str = "HY000") -> None:
+        pkt = b"\xff" + struct.pack("<H", code) + b"#" + \
+            sqlstate.encode()[:5].ljust(5, b"0")
         pkt += msg.encode("utf8", "replace")
         self.pkt.write_packet(pkt)
 

@@ -482,6 +482,17 @@ class KVTxn(kv.Transaction):
         try:
             committer.execute()
             self.committed = True
+            pump = getattr(self.storage, "binlog_pump", None)
+            if pump is not None:
+                # change capture on commit success (ref: binloginfo pump
+                # hook, 2pc.go:664 — prewrite payload + commit record,
+                # collapsed into one event here). Sinks never fail txns.
+                from tidb_tpu.binlog import make_event
+                try:
+                    pump.write(make_event(self.start_ts,
+                                          committer.commit_ts, muts))
+                except Exception:   # noqa: BLE001
+                    pass
         finally:
             if not self.storage.async_commit_secondaries:
                 committer.close()
