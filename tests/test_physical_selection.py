@@ -178,3 +178,58 @@ class TestStreamAgg:
         r = sess.query(q)
         assert len(r.rows) == n // 2
         assert all(row[1] == 2 for row in r.rows[:50])
+
+
+class TestIndexJoinDirtyTxn:
+    """Own writes visible through point lookups — never a whole-table
+    inner scan (the former fallback; verdict r3 weak #7)."""
+
+    def _setup(self, sess):
+        TestIndexJoin._setup(self, sess)
+
+    def test_dirty_pk_inner_sees_own_writes(self, sess, monkeypatch):
+        self._setup(sess)
+        from tidb_tpu import executor as ex
+        full_scans = []
+        orig = ex.TableReaderExec.chunks
+        monkeypatch.setattr(
+            ex.TableReaderExec, "chunks",
+            lambda self, ctx: full_scans.append(self.plan.cop.table.name)
+            or orig(self, ctx))
+        q = ("SELECT small.k, big.v FROM small JOIN big "
+             "ON small.k = big.id WHERE small.grp = 3")
+        sess.execute("BEGIN")
+        sess.execute("UPDATE big SET v = -1 WHERE id = 3")
+        sess.execute("DELETE FROM big WHERE id = 43")
+        sess.execute("INSERT INTO small VALUES (20001, 3)")
+        sess.execute("INSERT INTO big VALUES (20001, 777)")
+        rows = sorted(sess.query(q).rows)
+        sess.execute("ROLLBACK")
+        want = sorted([(i, i * 7) for i in range(200)
+                       if i % 40 == 3 and i not in (3, 43)] +
+                      [(3, -1), (20001, 777)])
+        assert rows == want
+        # the dirty inner path must not have scanned table `big`
+        assert "big" not in full_scans, full_scans
+
+    def test_dirty_secondary_index_inner(self, sess):
+        self._setup(sess)
+        sess.execute("CREATE TABLE dim (pk BIGINT PRIMARY KEY, "
+                     "code BIGINT, lbl BIGINT)")
+        sess.execute("CREATE INDEX icode ON dim (code)")
+        sess.execute("INSERT INTO dim VALUES " + ",".join(
+            f"({i},{i % 500},{i})" for i in range(5000)))
+        sess.execute("ANALYZE TABLE dim")
+        q = ("SELECT small.k, dim.lbl FROM small JOIN dim "
+             "ON small.k = dim.code WHERE small.grp = 2")
+        txt = _plan_text(sess, q)
+        assert "IndexJoin" in txt
+        sess.execute("BEGIN")
+        sess.execute("INSERT INTO dim VALUES (9001, 2, 424242)")
+        sess.execute("DELETE FROM dim WHERE pk = 2")   # code 2, lbl 2
+        rows = sorted(sess.query(q).rows)
+        sess.execute("ROLLBACK")
+        want = sorted([(i, j) for i in range(200) if i % 40 == 2
+                       for j in range(5000)
+                       if j % 500 == i and j != 2] + [(2, 424242)])
+        assert rows == want

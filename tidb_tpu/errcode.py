@@ -24,6 +24,9 @@ ER_PARSE_ERROR = 1064
 ER_ACCESS_DENIED_ERROR = 1045
 ER_TABLEACCESS_DENIED_ERROR = 1142
 ER_BAD_FIELD_ERROR = 1054
+ER_DUP_FIELDNAME = 1060
+ER_DUP_KEYNAME = 1061
+ER_CANNOT_USER = 1396
 ER_NON_UNIQ_ERROR = 1052          # ambiguous column
 ER_UNKNOWN_SYSTEM_VARIABLE = 1193
 ER_LOCK_WAIT_TIMEOUT = 1205
@@ -46,6 +49,9 @@ _SQLSTATE = {
     ER_ACCESS_DENIED_ERROR: "28000",
     ER_TABLEACCESS_DENIED_ERROR: "42000",
     ER_BAD_FIELD_ERROR: "42S22",
+    ER_DUP_FIELDNAME: "42S21",
+    ER_DUP_KEYNAME: "42000",
+    ER_CANNOT_USER: "HY000",
     ER_NON_UNIQ_ERROR: "23000",
     ER_UNKNOWN_SYSTEM_VARIABLE: "HY000",
     ER_LOCK_WAIT_TIMEOUT: "HY000",
@@ -61,7 +67,13 @@ _SQLSTATE = {
 _PATTERNS = [
     (re.compile(r"Unknown database", re.I), ER_BAD_DB_ERROR),
     (re.compile(r"doesn't exist|Unknown table", re.I), ER_NO_SUCH_TABLE),
-    (re.compile(r"already exists", re.I), ER_TABLE_EXISTS_ERROR),
+    (re.compile(r"database '[^']*' (already )?exists", re.I),
+     ER_DB_CREATE_EXISTS),
+    (re.compile(r"index '[^']*' (already )?exists", re.I), ER_DUP_KEYNAME),
+    (re.compile(r"column '[^']*' (already )?exists", re.I),
+     ER_DUP_FIELDNAME),
+    (re.compile(r"user .* (already )?exists", re.I), ER_CANNOT_USER),
+    (re.compile(r"(already )?exists", re.I), ER_TABLE_EXISTS_ERROR),
     (re.compile(r"Unknown column", re.I), ER_BAD_FIELD_ERROR),
     (re.compile(r"ambiguous", re.I), ER_NON_UNIQ_ERROR),
     (re.compile(r"denied", re.I), ER_TABLEACCESS_DENIED_ERROR),
@@ -96,7 +108,10 @@ def classify(exc: BaseException) -> tuple[int, str, str]:
         code = ER_PARSE_ERROR
         msg = f"You have an error in your SQL syntax; {msg}"
     elif isinstance(exc, SchemaError):
-        code = ER_BAD_DB_ERROR if "database" in msg.lower() \
+        # infoschema raises exactly "Unknown database '<db>'" for a bad
+        # db; anything else is a missing table (whose NAME may contain
+        # the word "database")
+        code = ER_BAD_DB_ERROR if msg.startswith("Unknown database") \
             else ER_NO_SUCH_TABLE
     elif isinstance(exc, kv.KeyLockedError):
         code = ER_LOCK_WAIT_TIMEOUT

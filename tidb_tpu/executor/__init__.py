@@ -1012,19 +1012,18 @@ class IndexJoinExec(HashJoinExec):
         self._kernel = JoinKernel(len(plan.left_keys))
 
     def _fetch_inner(self, ctx, key_vals: np.ndarray) -> Chunk:
-        """Inner rows whose key is in key_vals (distinct, non-null)."""
+        """Inner rows whose key is in key_vals (distinct, non-null).
+        Under a dirty txn, the SAME point lookups run through the union
+        store (membuffer overlay) instead of the snapshot, so own writes
+        are visible without ever scanning the whole inner table."""
         from tidb_tpu import ranger as rg
         icop = self.plan.children[1].cop
-        if _txn_is_dirty(ctx, icop.table.id):
-            # own writes must be visible: full union-store scan, then
-            # filter to the requested keys at the root (correct, slower)
-            reader = TableReaderExec(self.plan.children[1])
-            whole = Chunk.concat_all(list(reader.chunks(ctx)))
-            return whole if whole is not None else \
-                _empty_like_schema(self.plan.children[1].schema)
+        dirty = _txn_is_dirty(ctx, icop.table.id)
         if self.plan.inner_index is None:
-            return self._fetch_rows_by_handles(
-                ctx, icop, [int(v) for v in key_vals])
+            handles = [int(v) for v in key_vals]
+            if dirty:
+                return self._dirty_rows_by_handles(ctx, icop, handles)
+            return self._fetch_rows_by_handles(ctx, icop, handles)
         # secondary index: scan index entries for the key points to get
         # handles, then batch-fetch the rows (the per-batch form of
         # IndexLookUpExecutor, executor/distsql.go:524)
@@ -1036,6 +1035,18 @@ class IndexJoinExec(HashJoinExec):
                                           self.plan.inner_index.id, ranges)
         index_cols = [icop.table.col_by_name(c)
                       for c in self.plan.inner_index.columns]
+        if dirty:
+            # point index ranges through the union store: dirty index
+            # entries (and tombstones) shadow the snapshot's
+            from tidb_tpu.table import index_kvrows_to_chunk
+            rows = []
+            for rng in kv_ranges:
+                rows.extend(ctx.txn.iter_range(rng.start, rng.end))
+            ich = index_kvrows_to_chunk(icop.table, self.plan.inner_index,
+                                        index_cols, rows, len(index_cols))
+            hc = ich.columns[len(index_cols)]
+            handles = [int(h) for h in hc.data[:ich.num_rows]]
+            return self._dirty_rows_by_handles(ctx, icop, handles)
         index_cop = ph.CopPlan(table=icop.table, cols=index_cols,
                                handle_col=len(index_cols),
                                index=self.plan.inner_index,
@@ -1053,6 +1064,36 @@ class IndexJoinExec(HashJoinExec):
         keys = [tablecodec.record_key(icop.table.id, h) for h in handles]
         got = snap.batch_get(keys)
         kvrows = [(k, got[k]) for k in keys if k in got]
+        chunk = kvrows_to_chunk(icop.table, icop.cols, kvrows,
+                                icop.handle_col)
+        return exec_cop_plan(icop, chunk).chunk
+
+    def _dirty_rows_by_handles(self, ctx, icop, handles) -> Chunk:
+        """Point reads with the membuffer overlaid on ONE batched
+        snapshot read: own inserts appear, own deletes vanish, and the
+        clean majority of keys costs a single batch_get instead of
+        per-key round trips."""
+        keys = [tablecodec.record_key(icop.table.id, h)
+                for h in dict.fromkeys(int(h) for h in handles)]
+        membuf = ctx.txn.us.membuf
+        dirty_vals = {}
+        clean = []
+        for k in keys:
+            v = membuf.get(k)
+            if v is None:
+                clean.append(k)
+            else:
+                dirty_vals[k] = v
+        got = ctx.txn.snapshot.batch_get(clean) if clean else {}
+        kvrows = []
+        for k in keys:
+            v = dirty_vals.get(k)
+            if v is None:
+                v = got.get(k)
+            elif v is kv._TOMBSTONE:     # own delete shadows the snapshot
+                continue
+            if v is not None:
+                kvrows.append((k, v))
         chunk = kvrows_to_chunk(icop.table, icop.cols, kvrows,
                                 icop.handle_col)
         return exec_cop_plan(icop, chunk).chunk

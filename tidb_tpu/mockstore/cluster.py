@@ -234,29 +234,37 @@ class Cluster:
             return out
 
     def balance_leaders(self) -> int:
-        """One PD balance-leader pass: transfer leaders from the most-
-        loaded live store to the least-loaded until counts differ by at
-        most one. -> number of transfers."""
+        """One PD balance-leader pass: move leaders from overloaded to
+        underloaded live stores, leadership-only (transfers stay within
+        each region's existing peer set — membership changes are
+        drop_store's job, as in PD's balance-leader scheduler). Best
+        effort: converges to a spread of <=1 wherever peer sets allow,
+        and stops when no permitted transfer improves the balance.
+        -> number of transfers."""
         moved = 0
         while True:
             with self._mu:
                 counts = self.leader_counts()
-                if len(counts) < 2:
+                if len(counts) < 2 or \
+                        max(counts.values()) - min(counts.values()) <= 1:
                     return moved
-                hi = max(counts, key=counts.get)
-                lo = min(counts, key=counts.get)
-                if counts[hi] - counts[lo] <= 1:
-                    return moved
-                # leadership-only operation: transfer within the
-                # existing peer set (membership changes are drop_store's
-                # job, as in PD's balance-leader scheduler)
-                victim = None
-                for start, r in self._regions.items():
-                    if r.leader_store == hi and lo in r.peer_stores:
-                        victim = (start, r)
+                by_load = sorted(counts, key=counts.get)
+                done = False
+                for hi in reversed(by_load):
+                    for lo in by_load:
+                        if counts[hi] - counts[lo] <= 1:
+                            break
+                        for start, r in self._regions.items():
+                            if r.leader_store == hi and \
+                                    lo in r.peer_stores:
+                                self._regions[start] = replace(
+                                    r, leader_store=lo)
+                                done = True
+                                break
+                        if done:
+                            break
+                    if done:
                         break
-                if victim is None:
-                    return moved
-                start, r = victim
-                self._regions[start] = replace(r, leader_store=lo)
+                if not done:
+                    return moved     # no permitted transfer remains
             moved += 1

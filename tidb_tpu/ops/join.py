@@ -167,40 +167,52 @@ def host_match_pairs(build_keys, probe_keys, nb: int, np_: int):
     return li[ok], ri[ok]
 
 
-class JoinKernel:
-    """Compiled pair matcher for one key-lane signature.
+# Module-level program memo: the traced matcher depends only on out_cap
+# (shapes, dtypes and key arity are jit's own cache key). Executors build
+# a fresh JoinKernel per query execution — a per-instance cache would
+# re-trace and re-compile the identical program on EVERY query (~300ms
+# per join). Capacities are power-of-two buckets, so this stays small.
+_PROGRAMS: dict[int, object] = {}
 
-    One instance per join plan; jit programs are cached per
-    (build_bucket, probe_bucket, out_cap) shape triple."""
+
+def _matcher_program(out_cap: int):
+    prog = _PROGRAMS.get(out_cap)
+    if prog is not None:
+        return prog
+
+    def kernel(bkeys, pkeys, nb, np_):
+        xp = jnp
+        b_n = bkeys[0][0].shape[0]
+        p_n = pkeys[0][0].shape[0]
+        b_alive = (xp.arange(b_n) < nb)
+        p_alive = (xp.arange(p_n) < np_)
+        b_valid = b_alive
+        for _d, v in bkeys:
+            b_valid = b_valid & v
+        p_valid = p_alive
+        for _d, v in pkeys:
+            p_valid = p_valid & v
+        hb = _hash_keys(xp, [(d, v & b_valid) for d, v in bkeys],
+                        b_n, seed=0x9E3779B97F4A7C15)
+        hp = _hash_keys(xp, [(d, v & p_valid) for d, v in pkeys],
+                        p_n, seed=0x9E3779B97F4A7C15)
+        hb = xp.where(b_valid, hb, _DEAD_BUILD)
+        hp = xp.where(p_valid, hp, _DEAD_PROBE)
+
+        return match_pairs(xp, hb, hp, [d for d, _v in bkeys],
+                           [d for d, _v in pkeys], out_cap)
+
+    prog = jax.jit(kernel)
+    _PROGRAMS[out_cap] = prog
+    return prog
+
+
+class JoinKernel:
+    """Pair matcher for one key-lane signature; compiled programs are
+    shared process-wide (see _matcher_program)."""
 
     def __init__(self, num_keys: int):
         self.num_keys = num_keys
-        self._jits: dict = {}
-
-    def _program(self, out_cap: int):
-        def kernel(bkeys, pkeys, nb, np_):
-            xp = jnp
-            b_n = bkeys[0][0].shape[0]
-            p_n = pkeys[0][0].shape[0]
-            b_alive = (xp.arange(b_n) < nb)
-            p_alive = (xp.arange(p_n) < np_)
-            b_valid = b_alive
-            for _d, v in bkeys:
-                b_valid = b_valid & v
-            p_valid = p_alive
-            for _d, v in pkeys:
-                p_valid = p_valid & v
-            hb = _hash_keys(xp, [(d, v & b_valid) for d, v in bkeys],
-                            b_n, seed=0x9E3779B97F4A7C15)
-            hp = _hash_keys(xp, [(d, v & p_valid) for d, v in pkeys],
-                            p_n, seed=0x9E3779B97F4A7C15)
-            hb = xp.where(b_valid, hb, _DEAD_BUILD)
-            hp = xp.where(p_valid, hp, _DEAD_PROBE)
-
-            return match_pairs(xp, hb, hp, [d for d, _v in bkeys],
-                               [d for d, _v in pkeys], out_cap)
-
-        return jax.jit(kernel)
 
     def __call__(self, build_keys, probe_keys, nb: int, np_: int,
                  out_cap: int | None = None):
@@ -211,11 +223,7 @@ class JoinKernel:
         pb = runtime.bucket_size(max(np_, 1))
         cap = out_cap or runtime.bucket_size(max(np_ * 2, 1024))
         while True:
-            key = (bb, pb, cap)
-            prog = self._jits.get(key)
-            if prog is None:
-                prog = self._program(cap)
-                self._jits[key] = prog
+            prog = _matcher_program(cap)
             bk = [tuple(map(jnp.asarray, runtime.pad_column(d, v, bb)))
                   for d, v in build_keys]
             pk = [tuple(map(jnp.asarray, runtime.pad_column(d, v, pb)))

@@ -62,8 +62,8 @@ COM_STMT_EXECUTE = 0x17
 COM_STMT_CLOSE = 0x19
 COM_STMT_RESET = 0x1A
 
-ER_UNKNOWN = 1105
-ER_ACCESS_DENIED = 1045
+from tidb_tpu.errcode import (ER_ACCESS_DENIED_ERROR as ER_ACCESS_DENIED,
+                              ER_UNKNOWN, classify)
 
 
 class Server:
@@ -208,7 +208,6 @@ class ClientConn:
             except Exception as e:  # noqa: BLE001 - never kill the conn
                 # typed errors carry standard MySQL codes on the wire
                 # (ref: terror.go:152 error-class -> code mapping)
-                from tidb_tpu.errcode import ER_UNKNOWN, classify
                 code, state, msg = classify(e)
                 if code == ER_UNKNOWN and not isinstance(e, SQLError):
                     msg = f"internal error: {msg}"
@@ -277,7 +276,8 @@ class ClientConn:
             self._write_err(
                 f"Access denied for user '{self.user}'@"
                 f"'{self.peer_host}' (using password: "
-                f"{'YES' if auth else 'NO'})", code=ER_ACCESS_DENIED)
+                f"{'YES' if auth else 'NO'})", code=ER_ACCESS_DENIED,
+                sqlstate="28000")
             return False
         self._write_ok(0, 0)
         if db:
