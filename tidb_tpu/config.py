@@ -46,8 +46,13 @@ _DEFS: dict[str, tuple[str, int]] = {
     # kernels in ≤stream_rows super-batches, double-buffered so the
     # host→HBM transfer of batch i+1 overlaps batch i's readback
     # (BASELINE config 5; ref: the bounded producer/consumer channels of
-    # distsql/distsql.go:92-98)
-    "tidb_tpu_stream_rows": (_INT, 1 << 18),
+    # distsql/distsql.go:92-98). The default is deliberately high:
+    # below it, whole tables stay memoized/resident in HBM and hot
+    # re-executions transfer ZERO bytes (the analytics fast path);
+    # streaming trades that residency for bounded host memory, so it
+    # should engage only when tables genuinely outgrow memory. Lower it
+    # per deployment (SET tidb_tpu_stream_rows = ...) to cap footprint.
+    "tidb_tpu_stream_rows": (_INT, 1 << 23),
     # statements at/above this wall time land in the slow-query log
     # (ref: config.Log.SlowThreshold, default 300ms)
     "tidb_tpu_slow_query_ms": (_INT, 300),

@@ -1037,7 +1037,12 @@ class IndexJoinExec(HashJoinExec):
                       for c in self.plan.inner_index.columns]
         if dirty:
             # point index ranges through the union store: dirty index
-            # entries (and tombstones) shadow the snapshot's
+            # entries (and tombstones) shadow the snapshot's. One range
+            # scan per distinct key (bounded by the outer chunk's
+            # distinct count); batching the snapshot side through the
+            # coprocessor would need tombstone matching by raw index key
+            # (unique-index tombstones carry no handle), so the simple
+            # union scan wins until dirty index joins prove hot
             from tidb_tpu.table import index_kvrows_to_chunk
             rows = []
             for rng in kv_ranges:

@@ -753,9 +753,21 @@ class Parser:
 
     def field_type(self) -> st.FieldType:
         t = self.next()
-        if t.tp != TokenType.KEYWORD:
+        # ENUM is deliberately NOT a reserved word (matching MySQL);
+        # type names arrive as IDENT or KEYWORD alike
+        if t.tp not in (TokenType.KEYWORD, TokenType.IDENT):
             raise ParseError("expected type", t)
-        name = t.val
+        name = t.val.upper()
+        if name in ("ENUM", "SET"):
+            # ENUM('a','b',...) / SET('a','b',...)
+            self.expect_op("(")
+            elems = [self._str_lit()]
+            while self.try_op(","):
+                elems.append(self._str_lit())
+            self.expect_op(")")
+            TC = st.TypeCode
+            return st.FieldType(TC.ENUM if name == "ENUM" else TC.SET,
+                                elems=tuple(elems))
         # two-word type names are consumed up front, before length/flags
         if name == "DOUBLE":
             self.try_kw("PRECISION")

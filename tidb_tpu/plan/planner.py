@@ -1240,7 +1240,8 @@ def _type_word(ft) -> str:
             TypeCode.DOUBLE: "double", TypeCode.NEWDECIMAL: "decimal",
             TypeCode.VARCHAR: "varchar", TypeCode.STRING: "char",
             TypeCode.DATE: "date", TypeCode.DATETIME: "datetime",
-            TypeCode.TIMESTAMP: "timestamp"}.get(ft.tp, "unknown")
+            TypeCode.TIMESTAMP: "timestamp", TypeCode.ENUM: "enum",
+            TypeCode.SET: "set"}.get(ft.tp, "unknown")
 
 
 def _union_ft(fts):

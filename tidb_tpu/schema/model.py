@@ -45,6 +45,7 @@ class ColumnInfo:
         return {
             "id": self.id, "name": self.name, "offset": self.offset,
             "tp": int(self.ft.tp), "flags": self.ft.flags,
+            "elems": list(self.ft.elems),
             "flen": self.ft.flen, "frac": self.ft.frac,
             "default": _jsonable(self.default),
             "has_default": self.has_default,
@@ -56,7 +57,8 @@ class ColumnInfo:
     def from_json(d: dict) -> "ColumnInfo":
         return ColumnInfo(
             id=d["id"], name=d["name"], offset=d["offset"],
-            ft=FieldType(TypeCode(d["tp"]), d["flags"], d["flen"], d["frac"]),
+            ft=FieldType(TypeCode(d["tp"]), d["flags"], d["flen"],
+                         d["frac"], elems=tuple(d.get("elems") or ())),
             default=_unjsonable(d.get("default")),
             has_default=d.get("has_default", False),
             auto_increment=d.get("auto_increment", False),

@@ -1356,6 +1356,10 @@ def _type_name(c) -> str:
              TypeCode.DATETIME: "datetime",
              TypeCode.TIMESTAMP: "timestamp",
              TypeCode.DURATION: "time", TypeCode.YEAR: "year"}
+    if ft.tp in (TypeCode.ENUM, TypeCode.SET):
+        kind = "enum" if ft.tp == TypeCode.ENUM else "set"
+        members = ",".join(f"'{e}'" for e in ft.elems)
+        return f"{kind}({members})"
     return names.get(ft.tp, "unknown")
 
 

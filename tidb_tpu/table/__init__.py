@@ -19,8 +19,9 @@ import numpy as np
 from tidb_tpu import codec, kv, tablecodec
 from tidb_tpu.chunk import Chunk, Column
 from tidb_tpu.schema.model import IndexInfo, SchemaState, TableInfo
-from tidb_tpu.sqltypes import (EvalType, FieldType, decimal_to_scaled,
-                               np_dtype_for, scaled_to_decimal)
+from tidb_tpu.sqltypes import (EvalType, FieldType, TypeCode,
+                               decimal_to_scaled, np_dtype_for,
+                               scaled_to_decimal)
 
 __all__ = ["Table", "DupKeyError", "encode_datum_for_col",
            "decode_datum_for_col", "rows_to_chunk", "kvrows_to_chunk"]
@@ -29,6 +30,44 @@ __all__ = ["Table", "DupKeyError", "encode_datum_for_col",
 class DupKeyError(kv.KVError):
     def __init__(self, key_desc: str):
         super().__init__(f"Duplicate entry for key '{key_desc}'")
+
+
+def _normalize_enum_set(v, ft: FieldType):
+    """ENUM: member string (or 1-based ordinal) -> the member, validated.
+    SET: comma list (or bitmask) -> members deduped in definition order.
+    Values are STORED as their member strings (a documented departure
+    from MySQL's ordinal storage: comparisons/sorts here are by string,
+    not by member index). Ref: types/enum.go, types/set.go."""
+    elems = ft.elems
+    if ft.tp == TypeCode.ENUM:
+        if isinstance(v, (int,)) and not isinstance(v, bool):
+            if not (1 <= v <= len(elems)):
+                raise kv.KVError(f"invalid enum ordinal {v}")
+            return elems[v - 1]
+        sv = v if isinstance(v, str) else str(v)
+        for e in elems:
+            if e.lower() == sv.lower():
+                return e
+        raise kv.KVError(f"invalid enum value {sv!r} "
+                         f"(members: {', '.join(elems)})")
+    # SET
+    if isinstance(v, int) and not isinstance(v, bool):
+        if not (0 <= v < 1 << len(elems)):
+            raise kv.KVError(f"invalid set bitmask {v}")
+        return ",".join(e for i, e in enumerate(elems) if v >> i & 1)
+    sv = v if isinstance(v, str) else str(v)
+    if sv == "":
+        return ""
+    chosen = []
+    for part in sv.split(","):
+        hit = next((e for e in elems
+                    if e.lower() == part.strip().lower()), None)
+        if hit is None:
+            raise kv.KVError(f"invalid set member {part!r} "
+                             f"(members: {', '.join(elems)})")
+        if hit not in chosen:
+            chosen.append(hit)
+    return ",".join(e for e in elems if e in chosen)
 
 
 def encode_datum_for_col(v, ft: FieldType):
@@ -43,6 +82,8 @@ def encode_datum_for_col(v, ft: FieldType):
             frac, scaled = v
             return (ft.frac, _rescale_decimal(scaled, frac, ft.frac))
         return (ft.frac, decimal_to_scaled(v, ft.frac))
+    if ft.tp in (TypeCode.ENUM, TypeCode.SET):
+        return _normalize_enum_set(v, ft)
     if ft.eval_type == EvalType.STRING:
         return v if isinstance(v, (str, bytes)) else str(v)
     if isinstance(v, tuple):      # decimal datum into a non-decimal column
