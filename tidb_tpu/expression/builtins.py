@@ -650,3 +650,276 @@ def _sha2(args, argv, n):
 
 
 _reg("SHA2", 2, 2, "string", _sha2)
+
+
+# -- JSON (ref: types/json/binary.go; expression/builtin_json.go) ------------
+# Documents live as canonical compact text; functions parse per row.
+
+import json as _json
+
+
+class _PathError(ValueError):
+    pass
+
+
+def _parse_path(path: str) -> list:
+    """'$.a.b[0]' -> ['a', 'b', 0]. Subset: member access and array
+    index (no wildcards/ranges)."""
+    p = path.strip()
+    if not p.startswith("$"):
+        raise _PathError(f"Invalid JSON path expression: {path!r}")
+    out: list = []
+    i = 1
+    n = len(p)
+    while i < n:
+        c = p[i]
+        if c == ".":
+            i += 1
+            if i < n and p[i] == '"':
+                j = p.find('"', i + 1)
+                if j < 0:
+                    raise _PathError(f"Invalid JSON path: {path!r}")
+                out.append(p[i + 1:j])
+                i = j + 1
+                continue
+            j = i
+            while j < n and (p[j].isalnum() or p[j] == "_"):
+                j += 1
+            if j == i:
+                raise _PathError(f"Invalid JSON path: {path!r}")
+            out.append(p[i:j])
+            i = j
+        elif c == "[":
+            j = p.find("]", i)
+            if j < 0:
+                raise _PathError(f"Invalid JSON path: {path!r}")
+            out.append(int(p[i + 1:j]))
+            i = j + 1
+        else:
+            raise _PathError(f"Invalid JSON path: {path!r}")
+    return out
+
+
+def _walk(doc, steps):
+    """-> (found, value)."""
+    cur = doc
+    for s in steps:
+        if isinstance(s, int):
+            if not isinstance(cur, list) or not (0 <= s < len(cur)):
+                return False, None
+            cur = cur[s]
+        else:
+            if not isinstance(cur, dict) or s not in cur:
+                return False, None
+            cur = cur[s]
+    return True, cur
+
+
+def _jload(x):
+    return _json.loads(_s(x))
+
+
+def _jdump(v) -> str:
+    return _json.dumps(v, separators=(",", ":"))
+
+
+def _json_extract(args, argv, n):
+    v = _valid_all(argv, n)
+    out = np.empty(n, dtype=object)
+    ok = np.zeros(n, dtype=bool)
+    for i in range(n):
+        out[i] = ""
+        if not v[i]:
+            continue
+        doc = _jload(argv[0][0][i])
+        hits = []
+        for pd_, _pv in argv[1:]:
+            found, val = _walk(doc, _parse_path(_s(pd_[i])))
+            if found:
+                hits.append(val)
+        if not hits:
+            continue            # no match -> NULL (MySQL)
+        ok[i] = True
+        # one path -> the value; several -> wrapped in an array
+        out[i] = _jdump(hits[0] if len(argv) == 2 else hits)
+    return out, ok
+
+
+def _json_ft(args):
+    from tidb_tpu.sqltypes import FieldType, TypeCode
+    return FieldType(TypeCode.JSON)
+
+
+_reg("JSON_EXTRACT", 2, 16, _json_ft, _json_extract)
+
+
+def _json_unquote(args, argv, n):
+    d, v = argv[0]
+
+    def one(x):
+        s = _s(x)
+        if s.startswith('"') and s.endswith('"') and len(s) >= 2:
+            try:
+                u = _json.loads(s)
+                if isinstance(u, str):
+                    return u
+            except ValueError:
+                pass
+        return s
+
+    return _vec(one, v, n, d), v
+
+
+_reg("JSON_UNQUOTE", 1, 1, "string", _json_unquote)
+
+
+def _json_type(args, argv, n):
+    d, v = argv[0]
+    names = {dict: "OBJECT", list: "ARRAY", str: "STRING", bool: "BOOLEAN",
+             int: "INTEGER", float: "DOUBLE", type(None): "NULL"}
+    return _vec(lambda x: names[type(_jload(x))], v, n, d), v
+
+
+_reg("JSON_TYPE", 1, 1, "string", _json_type)
+
+
+def _json_valid(args, argv, n):
+    d, v = argv[0]
+
+    def one(x):
+        try:
+            _jload(x)
+            return 1
+        except ValueError:
+            return 0
+
+    return _vec(one, v, n, d, dtype=np.int64), v
+
+
+_reg("JSON_VALID", 1, 1, "int", _json_valid)
+
+
+def _json_length(args, argv, n):
+    v = _valid_all(argv, n)
+    out = np.zeros(n, dtype=np.int64)
+    ok = np.zeros(n, dtype=bool)
+    for i in range(n):
+        if not v[i]:
+            continue
+        doc = _jload(argv[0][0][i])
+        if len(argv) == 2:
+            found, doc = _walk(doc, _parse_path(_s(argv[1][0][i])))
+            if not found:
+                continue
+        ok[i] = True
+        out[i] = len(doc) if isinstance(doc, (dict, list)) else 1
+    return out, ok
+
+
+_reg("JSON_LENGTH", 1, 2, "int", _json_length)
+
+
+def _json_keys(args, argv, n):
+    d, v = argv[0]
+    out = np.empty(n, dtype=object)
+    ok = np.zeros(n, dtype=bool)
+    for i in range(n):
+        out[i] = ""
+        if not v[i]:
+            continue
+        doc = _jload(d[i])
+        if isinstance(doc, dict):
+            out[i] = _jdump(list(doc.keys()))
+            ok[i] = True
+    return out, ok
+
+
+_reg("JSON_KEYS", 1, 1, _json_ft, _json_keys)
+
+
+def _json_contains_value(hay, needle) -> bool:
+    """MySQL containment: a candidate array is contained in a target
+    array iff EVERY candidate element is contained in some target
+    element; a non-array candidate iff SOME element contains it; object
+    containment is per-key; scalars compare with numeric coercion."""
+    if isinstance(hay, list):
+        if isinstance(needle, list):
+            return all(_json_contains_value(hay, e) for e in needle)
+        return any(_json_contains_value(e, needle) for e in hay)
+    if isinstance(hay, dict):
+        if isinstance(needle, dict):
+            return all(k in hay and _json_contains_value(hay[k], nv)
+                       for k, nv in needle.items())
+        return False
+    if isinstance(needle, (list, dict)):
+        return False
+    if isinstance(hay, bool) != isinstance(needle, bool):
+        return False
+    if isinstance(hay, (int, float)) and isinstance(needle, (int, float)):
+        return float(hay) == float(needle)
+    return hay == needle
+
+
+def _json_contains(args, argv, n):
+    v = _valid_all(argv, n)
+
+    def one(doc, cand, *path):
+        d = _jload(doc)
+        if path:
+            found, d = _walk(d, _parse_path(_s(path[0])))
+            if not found:
+                return 0
+        return 1 if _json_contains_value(d, _jload(cand)) else 0
+
+    return _vec(one, v, n, *[a[0] for a in argv], dtype=np.int64), v
+
+
+_reg("JSON_CONTAINS", 2, 3, "int", _json_contains)
+
+
+def _json_array(args, argv, n):
+    out = np.empty(n, dtype=object)
+    for i in range(n):
+        vals = []
+        for (d, av), a in zip(argv, args):
+            vals.append(_arg_to_json(d[i], av[i], a))
+        out[i] = _jdump(vals)
+    return out, np.ones(n, dtype=bool)
+
+
+def _json_object(args, argv, n):
+    if len(argv) % 2:
+        from tidb_tpu.executor import ExecError
+        raise ExecError("JSON_OBJECT needs an even number of arguments")
+    out = np.empty(n, dtype=object)
+    for i in range(n):
+        obj = {}
+        for k in range(0, len(argv), 2):
+            (kd, kv_), (vd, vv) = argv[k], argv[k + 1]
+            if not kv_[i]:
+                from tidb_tpu.executor import ExecError
+                raise ExecError("JSON_OBJECT key cannot be NULL")
+            obj[_s(kd[i])] = _arg_to_json(vd[i], vv[i], args[k + 1])
+        out[i] = _jdump(obj)
+    return out, np.ones(n, dtype=bool)
+
+
+def _arg_to_json(x, valid, expr):
+    from tidb_tpu.sqltypes import EvalType, TypeCode
+    if not valid:
+        return None
+    if expr.ft.tp == TypeCode.JSON:
+        return _jload(x)
+    et = expr.ft.eval_type
+    if et == EvalType.INT:
+        return int(x)
+    if et == EvalType.REAL:
+        return float(x)
+    if et == EvalType.DECIMAL:
+        from tidb_tpu.sqltypes import scaled_to_decimal
+        return float(scaled_to_decimal(int(x), max(expr.ft.frac, 0)))
+    return _s(x)
+
+
+_reg("JSON_ARRAY", 0, 32, _json_ft, _json_array)
+_reg("JSON_OBJECT", 0, 32, _json_ft, _json_object)

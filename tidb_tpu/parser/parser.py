@@ -798,7 +798,7 @@ class Parser:
             "BLOB": TC.BLOB, "BINARY": TC.STRING,
             "DATE": TC.DATE, "DATETIME": TC.DATETIME,
             "TIMESTAMP": TC.TIMESTAMP, "TIME": TC.DURATION,
-            "YEAR": TC.YEAR,
+            "YEAR": TC.YEAR, "JSON": TC.JSON,
         }
         if name not in mapping:
             raise ParseError(f"unsupported type {name}", t)

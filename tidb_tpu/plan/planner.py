@@ -1241,7 +1241,8 @@ def _type_word(ft) -> str:
             TypeCode.VARCHAR: "varchar", TypeCode.STRING: "char",
             TypeCode.DATE: "date", TypeCode.DATETIME: "datetime",
             TypeCode.TIMESTAMP: "timestamp", TypeCode.ENUM: "enum",
-            TypeCode.SET: "set"}.get(ft.tp, "unknown")
+            TypeCode.SET: "set",
+            TypeCode.JSON: "json"}.get(ft.tp, "unknown")
 
 
 def _union_ft(fts):

@@ -221,7 +221,30 @@ class Resolver:
         a = self.resolve(e.left)
         b = self.resolve(e.right)
         a, b = self._coerce_time(a, b)
+        a, b = self._coerce_enum_set(a, b)
         return func(op, a, b)
+
+    @staticmethod
+    def _coerce_enum_set(a: Expression, b: Expression):
+        """A string constant compared against an ENUM/SET column
+        normalizes to the member's stored spelling (writes accept
+        members case-insensitively, so reads must too; an unknown
+        member stays as-is and simply matches nothing)."""
+        from tidb_tpu.sqltypes import TypeCode
+
+        def fix(col, const):
+            if col.ft.tp in (TypeCode.ENUM, TypeCode.SET) and \
+                    isinstance(const, Constant) and \
+                    isinstance(const.value, str):
+                from tidb_tpu.table import _normalize_enum_set
+                try:
+                    return Constant(_normalize_enum_set(const.value,
+                                                        col.ft), const.ft)
+                except Exception:   # noqa: BLE001 - unknown member
+                    return const
+            return const
+
+        return fix(b, a), fix(a, b)
 
     def _r_UnaryOp(self, e: ast.UnaryOp) -> Expression:
         a = self.resolve(e.operand)

@@ -1355,7 +1355,8 @@ def _type_name(c) -> str:
              TypeCode.BLOB: "text", TypeCode.DATE: "date",
              TypeCode.DATETIME: "datetime",
              TypeCode.TIMESTAMP: "timestamp",
-             TypeCode.DURATION: "time", TypeCode.YEAR: "year"}
+             TypeCode.DURATION: "time", TypeCode.YEAR: "year",
+             TypeCode.JSON: "json"}
     if ft.tp in (TypeCode.ENUM, TypeCode.SET):
         kind = "enum" if ft.tp == TypeCode.ENUM else "set"
         members = ",".join(f"'{e}'" for e in ft.elems)
@@ -1380,6 +1381,9 @@ def _format_chunk(ch) -> list[tuple]:
                 row.append(scaled_to_decimal(int(v), c.ft.frac))
             elif et == EvalType.DATETIME:
                 row.append(format_datetime(int(v), c.ft.tp))
+            elif isinstance(v, bytes) and c.ft.tp == TypeCode.JSON:
+                # JSON text reaches clients as str; BLOB bytes stay raw
+                row.append(v.decode("utf8", "replace"))
             elif hasattr(v, "item"):
                 row.append(v.item())
             else:

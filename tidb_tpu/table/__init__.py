@@ -84,6 +84,17 @@ def encode_datum_for_col(v, ft: FieldType):
         return (ft.frac, decimal_to_scaled(v, ft.frac))
     if ft.tp in (TypeCode.ENUM, TypeCode.SET):
         return _normalize_enum_set(v, ft)
+    if ft.tp == TypeCode.JSON:
+        # canonical compact text (ref: types/json/binary.go stores a
+        # binary form; text keeps the column host-side and printable)
+        import json as _json
+        if isinstance(v, (bytes, str)):
+            try:
+                return _json.dumps(_json.loads(v), separators=(",", ":"))
+            except ValueError:
+                raise kv.KVError(
+                    f"Invalid JSON text: {str(v)[:64]!r}") from None
+        return _json.dumps(v, separators=(",", ":"))
     if ft.eval_type == EvalType.STRING:
         return v if isinstance(v, (str, bytes)) else str(v)
     if isinstance(v, tuple):      # decimal datum into a non-decimal column
