@@ -1,0 +1,58 @@
+"""benchkv throughput tool (ref: cmd/benchkv/main.go:122-140,
+cmd/benchraw) and thread-leak detection (ref: util/testleak)."""
+
+import pytest
+
+from tidb_tpu.benchmarks import benchkv
+from tidb_tpu.store.storage import new_mock_storage
+from tidb_tpu.util import testleak
+
+
+class TestBenchKV:
+    @pytest.mark.parametrize("mode", ["txn", "raw"])
+    def test_modes(self, mode):
+        st = new_mock_storage()
+        st.cluster.split(b"bench_w0_k00000500")
+        out = benchkv.run(st, mode=mode, keys=1000, batch=100)
+        assert out["metric"] == f"benchkv_{mode}_ops_per_sec"
+        assert out["value"] > 0
+        st.close()
+
+    def test_workers_parallel(self):
+        st = new_mock_storage()
+        out = benchkv.run(st, mode="txn", keys=300, batch=50, workers=4)
+        assert out["workers"] == 4
+        # all four workers' keys landed
+        t = st.begin()
+        for w in range(4):
+            assert t.get(b"bench_w%d_k%08d" % (w, 0)) is not None
+        t.rollback()
+        st.close()
+
+    def test_cli(self, capsys):
+        assert benchkv.main(["--keys", "200", "--batch", "50"]) == 0
+        import json
+        out = json.loads(capsys.readouterr().out)
+        assert out["value"] > 0
+
+
+class TestLeakCheck:
+    def test_clean_workload_leaks_nothing(self):
+        before = testleak.snapshot()
+        st = new_mock_storage()
+        benchkv.run(st, mode="txn", keys=200, batch=50, workers=2)
+        st.close()
+        assert testleak.check(before) == []
+
+    def test_detects_a_leak(self):
+        import threading
+        before = testleak.snapshot()
+        stop = threading.Event()
+        t = threading.Thread(target=stop.wait, name="leaky-worker",
+                             daemon=True)
+        t.start()
+        leaked = testleak.check(before, timeout=0.2)
+        assert "leaky-worker" in leaked
+        stop.set()
+        t.join()
+        assert testleak.check(before) == []

@@ -112,6 +112,21 @@ class TestJSONComposition:
         assert one(sess, "JSON_CONTAINS('[1,2,3]', '2')") == 1
 
 
+class TestJSONEdge:
+    def test_decimal_into_json_is_a_number(self, sess):
+        sess.execute("CREATE TABLE j (id BIGINT PRIMARY KEY, doc JSON)")
+        sess.execute("INSERT INTO j VALUES (1, 1.5)")
+        assert sess.query("SELECT doc FROM j").rows == [("1.5",)]
+
+    def test_json_compares_as_text(self, sess):
+        assert sess.query("SELECT id FROM t WHERE doc = '[1,2,3]'"
+                          ).rows == [(2,)]
+
+    def test_bad_path_is_clean_error(self, sess):
+        with pytest.raises(Exception, match="Invalid JSON path"):
+            sess.query("SELECT JSON_EXTRACT(doc, '$[*]') FROM t")
+
+
 class TestEnumCIRead:
     def test_reads_match_any_member_spelling(self, sess):
         sess.execute("CREATE TABLE e (id BIGINT PRIMARY KEY, "
@@ -122,3 +137,8 @@ class TestEnumCIRead:
                               f"'{spelling}'").rows == [(1,)]
         assert sess.query("SELECT id FROM e WHERE sz = 'bogus'"
                           ).rows == []
+        # IN and BETWEEN normalize members like = does
+        assert sess.query("SELECT id FROM e WHERE sz IN ('LARGE')"
+                          ).rows == [(1,)]
+        assert sess.query("SELECT id FROM e WHERE sz BETWEEN "
+                          "'Large' AND 'large'").rows == [(1,)]

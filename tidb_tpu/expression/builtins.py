@@ -662,7 +662,11 @@ class _PathError(ValueError):
     pass
 
 
-def _parse_path(path: str) -> list:
+import functools
+
+
+@functools.lru_cache(maxsize=1024)
+def _parse_path(path: str) -> tuple:
     """'$.a.b[0]' -> ['a', 'b', 0]. Subset: member access and array
     index (no wildcards/ranges)."""
     p = path.strip()
@@ -693,11 +697,14 @@ def _parse_path(path: str) -> list:
             j = p.find("]", i)
             if j < 0:
                 raise _PathError(f"Invalid JSON path: {path!r}")
-            out.append(int(p[i + 1:j]))
+            idx_s = p[i + 1:j].strip()
+            if not idx_s.isdigit():      # no wildcards/negatives/last
+                raise _PathError(f"Invalid JSON path: {path!r}")
+            out.append(int(idx_s))
             i = j + 1
         else:
             raise _PathError(f"Invalid JSON path: {path!r}")
-    return out
+    return tuple(out)
 
 
 def _walk(doc, steps):
@@ -724,6 +731,7 @@ def _jdump(v) -> str:
 
 
 def _json_extract(args, argv, n):
+    from tidb_tpu.executor import ExecError
     v = _valid_all(argv, n)
     out = np.empty(n, dtype=object)
     ok = np.zeros(n, dtype=bool)
@@ -750,7 +758,19 @@ def _json_ft(args):
     return FieldType(TypeCode.JSON)
 
 
-_reg("JSON_EXTRACT", 2, 16, _json_ft, _json_extract)
+def _wrap_path_errors(fn):
+    """Malformed path arguments surface as clean SQL errors, never raw
+    int()/parse tracebacks."""
+    def wrapped(args, argv, n):
+        from tidb_tpu.executor import ExecError
+        try:
+            return fn(args, argv, n)
+        except _PathError as e:
+            raise ExecError(str(e)) from None
+    return wrapped
+
+
+_reg("JSON_EXTRACT", 2, 16, _json_ft, _wrap_path_errors(_json_extract))
 
 
 def _json_unquote(args, argv, n):
@@ -816,7 +836,7 @@ def _json_length(args, argv, n):
     return out, ok
 
 
-_reg("JSON_LENGTH", 1, 2, "int", _json_length)
+_reg("JSON_LENGTH", 1, 2, "int", _wrap_path_errors(_json_length))
 
 
 def _json_keys(args, argv, n):
@@ -874,7 +894,8 @@ def _json_contains(args, argv, n):
     return _vec(one, v, n, *[a[0] for a in argv], dtype=np.int64), v
 
 
-_reg("JSON_CONTAINS", 2, 3, "int", _json_contains)
+_reg("JSON_CONTAINS", 2, 3, "int",
+     _wrap_path_errors(_json_contains))
 
 
 def _json_array(args, argv, n):

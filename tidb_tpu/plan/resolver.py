@@ -225,6 +225,19 @@ class Resolver:
         return func(op, a, b)
 
     @staticmethod
+    def _normalize_enum_const(col_ft, value):
+        """-> normalized member spelling, or the value unchanged."""
+        from tidb_tpu.sqltypes import TypeCode
+        if col_ft.tp in (TypeCode.ENUM, TypeCode.SET) and \
+                isinstance(value, str):
+            from tidb_tpu.table import _normalize_enum_set
+            try:
+                return _normalize_enum_set(value, col_ft)
+            except Exception:   # noqa: BLE001 - unknown member
+                return value
+        return value
+
+    @staticmethod
     def _coerce_enum_set(a: Expression, b: Expression):
         """A string constant compared against an ENUM/SET column
         normalizes to the member's stored spelling (writes accept
@@ -275,7 +288,7 @@ class Resolver:
                     ors = cmp_ if ors is None else func(Op.OR, ors, cmp_)
                 return func(Op.NOT, ors) if e.negated else ors
             _, r = self._coerce_time(target, r)
-            vals.append(r.value)
+            vals.append(self._normalize_enum_const(target.ft, r.value))
         out = func(Op.IN, target, extra=vals)
         return func(Op.NOT, out) if e.negated else out
 
@@ -285,6 +298,8 @@ class Resolver:
         hi = self.resolve(e.high)
         x1, lo = self._coerce_time(x, lo)
         x2, hi = self._coerce_time(x, hi)
+        _, lo = self._coerce_enum_set(x1, lo)
+        _, hi = self._coerce_enum_set(x2, hi)
         r = func(Op.AND, func(Op.GE, x1, lo), func(Op.LE, x2, hi))
         return func(Op.NOT, r) if e.negated else r
 

@@ -21,6 +21,8 @@ __all__ = ["XServer"]
 CON_CAPABILITIES_GET = 1
 CON_CLOSE = 3
 
+MAX_FRAME = 1 << 16     # nothing legitimate is bigger on this skeleton
+
 # server message types (Mysqlx.ServerMessages.Type)
 SV_OK = 0
 SV_ERROR = 1
@@ -63,6 +65,8 @@ class XServer:
                 if hdr is None:
                     return
                 length, tp = struct.unpack("<IB", hdr)
+                if length > MAX_FRAME:   # don't buffer attacker-sized frames
+                    return
                 payload = self._read_exact(conn, length - 1) \
                     if length > 1 else b""
                 if payload is None:

@@ -31,14 +31,38 @@ from collections import OrderedDict
 __all__ = ["ChunkCache"]
 
 
-class ChunkCache:
-    """LRU over decoded region chunks, bounded by total cached rows."""
+def _chunk_bytes(chunk) -> int:
+    """Estimated host footprint: numpy buffers at their real size, object
+    (string) columns at pointer + payload length."""
+    total = 0
+    for c in chunk.columns:
+        data = c.data
+        if getattr(data, "dtype", None) is not None and \
+            data.dtype != object:
+            total += data.nbytes
+        else:
+            total += 8 * len(data)
+            total += sum(len(x) for x in data
+                         if isinstance(x, (str, bytes)))
+        total += len(c.valid)          # bool mask
+    return total
 
-    def __init__(self, max_rows: int = 1 << 24):
-        self.max_rows = max_rows
+
+class ChunkCache:
+    """LRU over decoded region chunks, bounded by estimated BYTES (rows
+    alone under-count wide/string layouts by orders of magnitude).
+
+    The budget must hold every layout a hot analytical mix scans —
+    entries are keyed per column layout, so one table queried three ways
+    costs three entries. Undersizing is silent but expensive: each
+    evicted layout re-decodes AND re-uploads to HBM every execution
+    (device chunks are memoized on the cached chunk objects)."""
+
+    def __init__(self, max_bytes: int = 4 << 30):
+        self.max_bytes = max_bytes
         self._mu = threading.Lock()
         self._entries: OrderedDict = OrderedDict()
-        self._rows = 0
+        self._bytes = 0
         self.hits = 0
         self.misses = 0
 
@@ -54,7 +78,7 @@ class ChunkCache:
             if ent is None:
                 self.misses += 1
                 return None
-            fill_version, fill_ts, chunk = ent
+            fill_version, fill_ts, chunk = ent[0], ent[1], ent[2]
             if fill_version != data_version or read_ts < fill_ts:
                 self.misses += 1
                 return None
@@ -63,19 +87,20 @@ class ChunkCache:
             return chunk
 
     def put(self, key, data_version: int, fill_ts: int, chunk) -> None:
-        if chunk.num_rows > self.max_rows:
+        size = _chunk_bytes(chunk)
+        if size > self.max_bytes:
             return
         with self._mu:
             old = self._entries.pop(key, None)
             if old is not None:
-                self._rows -= old[2].num_rows
-            self._entries[key] = (data_version, fill_ts, chunk)
-            self._rows += chunk.num_rows
-            while self._rows > self.max_rows and self._entries:
-                _k, (_v, _t, ch) = self._entries.popitem(last=False)
-                self._rows -= ch.num_rows
+                self._bytes -= old[3]
+            self._entries[key] = (data_version, fill_ts, chunk, size)
+            self._bytes += size
+            while self._bytes > self.max_bytes and self._entries:
+                _k, (_v, _t, _ch, sz) = self._entries.popitem(last=False)
+                self._bytes -= sz
 
     def clear(self) -> None:
         with self._mu:
             self._entries.clear()
-            self._rows = 0
+            self._bytes = 0

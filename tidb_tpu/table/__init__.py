@@ -88,6 +88,9 @@ def encode_datum_for_col(v, ft: FieldType):
         # canonical compact text (ref: types/json/binary.go stores a
         # binary form; text keeps the column host-side and printable)
         import json as _json
+        if isinstance(v, tuple):       # decimal datum -> a JSON number
+            frac, scaled = v
+            v = float(scaled_to_decimal(scaled, frac))
         if isinstance(v, (bytes, str)):
             try:
                 return _json.dumps(_json.loads(v), separators=(",", ":"))
@@ -136,7 +139,10 @@ def decode_datum_for_col(v, ft: FieldType):
     if ft.eval_type == EvalType.DECIMAL:
         frac, scaled = v
         return _rescale_decimal(scaled, frac, ft.frac)
-    if ft.eval_type == EvalType.STRING and isinstance(v, bytes):
+    if ft.eval_type in (EvalType.STRING, EvalType.JSON) and \
+            isinstance(v, bytes):
+        # JSON text decodes here too: filters/joins on JSON columns must
+        # see str, not bytes (presentation is too late)
         try:
             return v.decode("utf8")
         except UnicodeDecodeError:
