@@ -206,3 +206,13 @@ class TestReviewRegressions:
         sess.storage.chunk_cache.clear()
         with pytest.raises(SQLError, match="admin check"):
             sess.execute("ADMIN CHECK TABLE t")
+
+
+class TestAdminShowDDLJobs:
+    def test_history_listed(self, sess):
+        sess.execute("CREATE TABLE jt (id BIGINT PRIMARY KEY)")
+        rs = sess.query("ADMIN SHOW DDL JOBS")
+        assert rs.columns[:2] == ["JOB_ID", "JOB_TYPE"]
+        hist = [r for r in rs.rows if r[6] == "history"]
+        assert any(r[1] == "create table" for r in hist)
+        assert all(r[4] == "done" for r in hist)

@@ -51,13 +51,22 @@ def run(storage, mode: str = "txn", keys: int = 10000, batch: int = 100,
     if workers == 1:
         fn(storage, keys, batch, 0)
     else:
-        ts = [threading.Thread(target=fn,
-                               args=(storage, keys, batch, w))
+        errors: list[BaseException] = []
+
+        def guarded(w: int) -> None:
+            try:
+                fn(storage, keys, batch, w)
+            except BaseException as e:  # noqa: BLE001 - re-raised below
+                errors.append(e)
+
+        ts = [threading.Thread(target=guarded, args=(w,))
               for w in range(workers)]
         for t in ts:
             t.start()
         for t in ts:
             t.join()
+        if errors:       # a failed worker must fail the benchmark
+            raise errors[0]
     dt = time.perf_counter() - t0
     total_ops = keys * workers * 2          # one write + one read per key
     return {"metric": f"benchkv_{mode}_ops_per_sec",

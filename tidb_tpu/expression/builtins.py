@@ -731,7 +731,6 @@ def _jdump(v) -> str:
 
 
 def _json_extract(args, argv, n):
-    from tidb_tpu.executor import ExecError
     v = _valid_all(argv, n)
     out = np.empty(n, dtype=object)
     ok = np.zeros(n, dtype=bool)

@@ -234,6 +234,10 @@ class Parser:
                 if self.peek().tp == TokenType.IDENT and \
                         self.peek().val.upper() == "DDL":
                     self.next()
+                    if self.peek().tp == TokenType.IDENT and \
+                            self.peek().val.upper() == "JOBS":
+                        self.next()
+                        return ast.AdminStmt(tp="show_ddl_jobs")
                 return ast.AdminStmt(tp="show_ddl")
             self.expect_kw("CHECK")
             self.expect_kw("TABLE")

@@ -246,15 +246,11 @@ class Resolver:
         from tidb_tpu.sqltypes import TypeCode
 
         def fix(col, const):
-            if col.ft.tp in (TypeCode.ENUM, TypeCode.SET) and \
-                    isinstance(const, Constant) and \
+            if isinstance(const, Constant) and \
                     isinstance(const.value, str):
-                from tidb_tpu.table import _normalize_enum_set
-                try:
-                    return Constant(_normalize_enum_set(const.value,
-                                                        col.ft), const.ft)
-                except Exception:   # noqa: BLE001 - unknown member
-                    return const
+                norm = Resolver._normalize_enum_const(col.ft, const.value)
+                if norm != const.value:
+                    return Constant(norm, const.ft)
             return const
 
         return fix(b, a), fix(a, b)
@@ -284,6 +280,7 @@ class Resolver:
                 ors = None
                 for item2 in e.items:
                     t2, r2 = self._coerce_time(target, self.resolve(item2))
+                    _, r2 = self._coerce_enum_set(t2, r2)
                     cmp_ = func(Op.EQ, t2, r2)
                     ors = cmp_ if ors is None else func(Op.OR, ors, cmp_)
                 return func(Op.NOT, ors) if e.negated else ors

@@ -681,6 +681,30 @@ class Session:
                 txn.rollback()
             return ResultSet(["SCHEMA_VER", "OWNER", "SELF_ID"],
                              [(ver, "self", "self")])
+        if stmt.tp == "show_ddl_jobs":
+            # queue front-to-back, then recent history (ref: the ADMIN
+            # SHOW DDL JOBS surface over meta's job queue/history)
+            from tidb_tpu.ddl.job import Job
+            txn = self.storage.begin()
+            try:
+                m = Meta(txn)
+                rows = []
+                for raw in m.t.litems(Meta.JOB_LIST_KEY):
+                    j = Job.loads(raw)
+                    rows.append((j.id, j.tp.value, j.schema_id,
+                                 j.table_id, j.state.value,
+                                 int(j.schema_state), "queue"))
+                hist = m.t.hgetall(Meta.JOB_HISTORY_KEY)
+                for _f, raw in sorted(hist, reverse=True)[:16]:
+                    j = Job.loads(raw)
+                    rows.append((j.id, j.tp.value, j.schema_id,
+                                 j.table_id, j.state.value,
+                                 int(j.schema_state), "history"))
+            finally:
+                txn.rollback()
+            return ResultSet(["JOB_ID", "JOB_TYPE", "SCHEMA_ID",
+                              "TABLE_ID", "STATE", "SCHEMA_STATE",
+                              "SOURCE"], rows)
         if stmt.tp != "check_table":
             return ResultSet(columns=["info"], rows=[])
         from tidb_tpu import codec as _codec
