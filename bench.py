@@ -94,11 +94,36 @@ def _kernel_micro() -> float:
     return chunk.num_rows * iters / dt
 
 
+def _probe_devices(timeout_s: int = 120) -> bool:
+    """True if jax.devices() answers within timeout in a THROWAWAY
+    subprocess. A dead chip tunnel makes any jax call in-process hang
+    unrecoverably, so the probe must be expendable."""
+    import subprocess
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.devices(); print('ok')"],
+            timeout=timeout_s, capture_output=True, text=True)
+        return "ok" in r.stdout
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+
+
 def main() -> None:
     sf = float(os.environ.get("BENCH_SF", "1.0"))
     iters = int(os.environ.get("BENCH_ITERS", "5"))
     host_iters = int(os.environ.get("BENCH_HOST_ITERS", "2"))
     regions = int(os.environ.get("BENCH_REGIONS", "4"))
+
+    device_fallback = None
+    if os.environ.get("BENCH_SKIP_PROBE", "0") != "1" and \
+            not _probe_devices():
+        # chip tunnel down: measure CPU-XLA vs numpy rather than hang
+        print("[bench] device probe timed out; falling back to CPU XLA",
+              file=sys.stderr, flush=True)
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        device_fallback = "cpu (chip tunnel unavailable)"
 
     from tidb_tpu import config
     from tidb_tpu.benchmarks import tpch
@@ -125,6 +150,8 @@ def main() -> None:
 
     detail: dict = {"sf": sf, "iters": iters, "rows_loaded": total_rows,
                     "load_secs": round(load_secs, 1)}
+    if device_fallback:
+        detail["device_platform_fallback"] = device_fallback
     speedups = []
     device_rps = []
 
