@@ -23,7 +23,8 @@ value = geometric mean over Q1/Q3/Q5 of end-to-end input rows/sec on the
 device path; vs_baseline = geomean of per-query device/host speedups.
 
 Env knobs: BENCH_SF (default 1.0), BENCH_ITERS (5), BENCH_HOST_ITERS (2),
-BENCH_REGIONS (4), BENCH_KERNEL_MICRO (1).
+BENCH_REGIONS (4), BENCH_KERNEL_MICRO (1), BENCH_SKIP_PROBE (0; 1 skips
+the 120s device-liveness probe and trusts the default platform).
 """
 
 from __future__ import annotations

@@ -69,6 +69,37 @@ def split_device_host(cond: Expression | None):
     return and_all(dev), and_all(host)
 
 
+class _JoinGeometry:
+    """Shared bookkeeping for one inner-join tree: leaf offsets in the
+    concatenated schema, per-condition leaf sets, per-leaf size
+    estimates (0 is a real estimate — an empty side should lead)."""
+
+    BIG = 1 << 40      # leaves with no estimate order last
+
+    def __init__(self, leaves, conds):
+        self.leaves = leaves
+        self.conds = conds
+        self.offs = []
+        at = 0
+        for lf in leaves:
+            self.offs.append(at)
+            at += len(lf.schema)
+        self.size = []
+        for lf in leaves:
+            est = getattr(lf, "est_rows", None)
+            self.size.append(self.BIG if est is None else est)
+        self.cond_leaves = [
+            frozenset(self.leaf_of(i) for i in c.columns_used())
+            for c in conds]
+
+    def leaf_of(self, idx: int) -> int:
+        for li in range(len(self.leaves)):
+            if self.offs[li] <= idx < \
+                    self.offs[li] + len(self.leaves[li].schema):
+                return li
+        raise PlanError("column outside join leaves")
+
+
 class Planner:
     def __init__(self, infoschema: InfoSchema, current_db: str,
                  stats_handle=None):
@@ -103,7 +134,12 @@ class Planner:
             built = self.plan_union(stmt) \
                 if isinstance(stmt, ast.UnionStmt) \
                 else self.plan_select(stmt)
-            p = self._opt_physical(route_mesh(self._opt_access(built)))
+            # mesh routing first: its fused star-join pipeline matches
+            # the ORIGINAL join shapes (and already orders dims itself);
+            # greedy reorder then improves whatever stays on the
+            # per-operator path
+            p = self._opt_physical(self._reorder_joins(
+                route_mesh(self._opt_access(built))))
             p.cacheable = not was_volatile()
             if outer_volatile:
                 mark_volatile()
@@ -510,6 +546,153 @@ class Planner:
     # beyond this many estimated groups, the sort-based StreamAgg beats
     # the hash kernel's capacity-escalation / collision-fallback protocol
     _STREAM_AGG_NDV = 1 << 16
+
+    # -- join reordering (ref: plan/join_reorder.go greedy solver over
+    # estimated cardinalities; runs after access-path optimization so
+    # leaf est_rows reflect pushed filters) ----------------------------------
+
+    def _reorder_joins(self, plan: ph.PhysPlan) -> ph.PhysPlan:
+        """Greedy reorder of MAXIMAL inner-join trees: seed with the
+        smallest leaf that participates in a join condition, repeatedly
+        attach the smallest connected leaf (cross joins last). The
+        rebuilt tree is left-deep with the smaller input of every join
+        as the hash build side, and a column projection restores the
+        original output order so nothing downstream notices."""
+        if not (isinstance(plan, ph.PhysHashJoin) and
+                plan.join_type == "inner"):
+            for i, c in enumerate(plan.children):
+                plan.children[i] = self._reorder_joins(c)
+            if isinstance(plan, ph.PhysApply) and plan.inner is not None:
+                plan.inner = self._reorder_joins(plan.inner)
+            return plan
+        leaves, conds = self._collect_inner_tree(plan)
+        new_leaves = [self._reorder_joins(lf) for lf in leaves]
+        geo = _JoinGeometry(new_leaves, conds)
+        order = self._greedy_order(geo) if len(new_leaves) > 2 else None
+        if (order is None or order == list(range(len(new_leaves)))) and \
+                all(a is b for a, b in zip(new_leaves, leaves)):
+            return plan
+        return self._rebuild_join_tree(
+            plan, geo, order or list(range(len(new_leaves))))
+
+    def _collect_inner_tree(self, p: ph.PhysPlan):
+        """-> (leaves, conds) with every condition expressed over the
+        concatenated leaf schema in ORIGINAL leaf order. Compound
+        other_conds split into conjuncts so each applies (and can become
+        a join key) at the earliest join covering its leaves."""
+        if isinstance(p, ph.PhysHashJoin) and p.join_type == "inner":
+            lleaves, lconds = self._collect_inner_tree(p.children[0])
+            rleaves, rconds = self._collect_inner_tree(p.children[1])
+            lw = sum(len(x.schema) for x in lleaves)
+            conds = list(lconds)
+            for c in rconds:
+                conds.append(c.map_columns(
+                    {i: i + lw for i in c.columns_used()}))
+            for lk, rk in zip(p.left_keys, p.right_keys):
+                rk2 = rk.map_columns(
+                    {i: i + lw for i in rk.columns_used()})
+                conds.append(func(Op.EQ, lk, rk2))
+            conds.extend(flatten_and(p.other_cond))
+            return lleaves + rleaves, conds
+        return [p], []
+
+    def _greedy_order(self, geo: "_JoinGeometry") -> list[int] | None:
+        n = len(geo.leaves)
+        # seed must participate in a join condition — seeding with a
+        # disconnected (cross-joined) leaf would multiply every later
+        # join by its cardinality
+        in_conds = set().union(*geo.cond_leaves) if geo.cond_leaves \
+            else set()
+        if not in_conds:
+            return None             # pure cross product: keep as written
+        placed = [min(in_conds, key=lambda i: geo.size[i])]
+        remaining = set(range(n)) - set(placed)
+        while remaining:
+            connected = [i for i in remaining
+                         if any(i in cl and cl - {i} <= set(placed)
+                                for cl in geo.cond_leaves)]
+            pool = connected or sorted(remaining)
+            nxt = min(pool, key=lambda i: geo.size[i])
+            placed.append(nxt)
+            remaining.discard(nxt)
+        return placed
+
+    def _rebuild_join_tree(self, orig: ph.PhysHashJoin,
+                           geo: "_JoinGeometry",
+                           order: list[int]) -> ph.PhysPlan:
+        leaves, offs = geo.leaves, geo.offs
+        n = len(leaves)
+        width = sum(len(lf.schema) for lf in leaves)
+        pending = list(zip(geo.conds, geo.cond_leaves))
+        # cur_pos: original global index -> index in acc's CURRENT schema
+        # (child orientation varies per join, so positions are tracked
+        # dynamically rather than precomputed)
+        first = order[0]
+        acc = leaves[first]
+        acc_set = {first}
+        acc_est = geo.size[first]
+        cur_pos = {offs[first] + k: k
+                   for k in range(len(leaves[first].schema))}
+        for pos in range(1, n):
+            li = order[pos]
+            leaf = leaves[li]
+            leaf_w = len(leaf.schema)
+            leaf_est = geo.size[li]
+            # the smaller input becomes the hash BUILD side (right);
+            # the bigger streams as the probe (left)
+            leaf_right = acc_est >= leaf_est
+            acc_w = len(acc.schema)
+            if leaf_right:
+                children = [acc, leaf]
+                schema = acc.schema.merge(leaf.schema)
+                leaf_base, nw = acc_w, acc_w
+            else:
+                children = [leaf, acc]
+                schema = leaf.schema.merge(acc.schema)
+                cur_pos = {g: p + leaf_w for g, p in cur_pos.items()}
+                leaf_base, nw = 0, leaf_w
+            for k in range(leaf_w):
+                cur_pos[offs[li] + k] = leaf_base + k
+            join = ph.PhysHashJoin(schema=schema, children=children,
+                                   join_type="inner")
+            here = acc_set | {li}
+            rest = []
+            for c, cl in pending:
+                if not (cl <= here and (li in cl or pos == n - 1)):
+                    rest.append((c, cl))
+                    continue
+                c2 = c.map_columns({i: cur_pos[i]
+                                    for i in c.columns_used()})
+                if isinstance(c2, ScalarFunc) and c2.op == Op.EQ:
+                    a, b = c2.args
+                    ua, ub = a.columns_used(), b.columns_used()
+                    if ua and ub and all(i < nw for i in ua) and \
+                            all(i >= nw for i in ub):
+                        join.left_keys.append(a)
+                        join.right_keys.append(b.map_columns(
+                            {i: i - nw for i in ub}))
+                        continue
+                    if ua and ub and all(i < nw for i in ub) and \
+                            all(i >= nw for i in ua):
+                        join.left_keys.append(b)
+                        join.right_keys.append(a.map_columns(
+                            {i: i - nw for i in ua}))
+                        continue
+                join.other_cond = c2 if join.other_cond is None else \
+                    func(Op.AND, join.other_cond, c2)
+            pending = rest
+            acc = join
+            acc_set = here
+            # FK-join heuristic: the fact side dominates the intermediate
+            acc_est = max(acc_est, leaf_est)
+        # restore the original column order for everything above
+        exprs = [ColumnRef(cur_pos[i], orig.schema.cols[i].ft,
+                           name=orig.schema.cols[i].name)
+                 for i in range(width)]
+        out = ph.PhysProjection(schema=orig.schema, children=[acc],
+                                exprs=exprs)
+        out.est_rows = getattr(orig, "est_rows", None)
+        return out
 
     def _opt_physical(self, plan: ph.PhysPlan) -> ph.PhysPlan:
         """Post-pass choosing among physically-equivalent operators:
