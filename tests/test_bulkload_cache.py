@@ -138,11 +138,18 @@ class TestChunkCacheMVCC:
 
 class TestConfigSysvars:
     def test_set_and_show(self, sess):
+        g0 = config.cop_concurrency()
         sess.execute("SET @@tidb_tpu_cop_concurrency = 3")
-        assert config.cop_concurrency() == 3
-        sess.execute("SET @@tidb_tpu_cop_concurrency = 10")
+        # session scope shadows; the process registry is untouched
+        assert config.cop_concurrency() == g0
         rows = dict(sess.query("SHOW VARIABLES LIKE 'tidb_tpu%'").rows)
-        assert rows["tidb_tpu_cop_concurrency"] == "10"
+        assert rows["tidb_tpu_cop_concurrency"] == "3"
+        sess.execute("SET GLOBAL tidb_tpu_cop_concurrency = 10")
+        assert config.cop_concurrency() == 10
+        # session value still wins in this session's view
+        rows = dict(sess.query("SHOW VARIABLES LIKE 'tidb_tpu%'").rows)
+        assert rows["tidb_tpu_cop_concurrency"] == "3"
+        config.set_var("tidb_tpu_cop_concurrency", g0)
 
     def test_device_switch_changes_path_not_results(self, sess):
         sess.execute("CREATE TABLE t (a BIGINT PRIMARY KEY, b BIGINT)")

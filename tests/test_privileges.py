@@ -237,9 +237,12 @@ class TestReviewRegressions:
         u = Session(store, user="u", host="h")
         with pytest.raises(SQLError, match="denied"):
             u.execute("SET GLOBAL tidb_tpu_cop_concurrency = 3")
-        # registry vars are process-wide here: session syntax needs SUPER
-        with pytest.raises(SQLError, match="denied"):
-            u.execute("SET @@tidb_tpu_device = 1")
+        # session-scope SET of a registry var shadows per session and is
+        # free; the process registry must stay untouched
+        from tidb_tpu import config
+        g0 = config.cop_concurrency()
+        u.execute("SET @@tidb_tpu_cop_concurrency = 3")
+        assert config.cop_concurrency() == g0
         u.execute("SET @myvar = 1")              # user variables are free
         u.execute("SET @@sql_mode = ''")          # plain session sysvar ok
         # SUPER alone (not ALL) is grantable and unlocks SET GLOBAL

@@ -7,8 +7,12 @@ all three roles here: every performance knob that used to be a hard-coded
 constant reads through it, `SET @@tidb_tpu_x = v` writes through it, and
 the server CLI seeds it from flags.
 
-Scope note: variables here are GLOBAL (process-wide), matching how the
-executors consume them; per-session shadowing can layer on top later.
+Scope: the registry is GLOBAL (process-wide); sessions shadow it with a
+thread-local overlay installed for the duration of each statement
+(`session_overlay`, ref: sessionctx/variable SessionVars layering over
+globals). Reads on the session's thread see the session values; the
+coprocessor fan-out re-installs the overlay inside its pool workers
+(store/copr.py) so per-session knobs apply uniformly there too.
 """
 
 from __future__ import annotations
@@ -16,9 +20,10 @@ from __future__ import annotations
 import os
 import threading
 
-__all__ = ["get_var", "set_var", "all_vars", "device_enabled",
-           "chunk_cache_enabled", "cop_concurrency", "sort_spill_rows",
-           "device_min_rows", "stream_rows", "UnknownVariableError"]
+__all__ = ["get_var", "set_var", "all_vars", "coerce", "session_overlay",
+           "current_overlay", "device_enabled", "chunk_cache_enabled",
+           "cop_concurrency", "sort_spill_rows", "device_min_rows",
+           "stream_rows", "UnknownVariableError"]
 
 
 class UnknownVariableError(Exception):
@@ -101,15 +106,62 @@ def _init() -> None:
 _init()
 
 
+_tls = threading.local()
+
+
+def _read(key: str) -> int:
+    ov = getattr(_tls, "overlay", None)
+    if ov is not None and key in ov:
+        return ov[key]
+    return _vals[key]
+
+
+def current_overlay() -> dict:
+    """This thread's effective session overlay (for propagating into
+    worker threads: wrap their work in session_overlay(...))."""
+    return dict(getattr(_tls, "overlay", None) or {})
+
+
+class session_overlay:
+    """Shadow registry values on THIS thread for a statement's duration
+    (per-session SET). Nests: inner overlays win, outers restore."""
+
+    def __init__(self, vars: dict):
+        self.vars = {k.lower(): v for k, v in vars.items()
+                     if k.lower() in _DEFS}
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = getattr(_tls, "overlay", None)
+        merged = dict(self._prev) if self._prev else {}
+        merged.update(self.vars)
+        _tls.overlay = merged
+        return self
+
+    def __exit__(self, *exc):
+        _tls.overlay = self._prev
+        return False
+
+
 def is_known(name: str) -> bool:
     return name.lower() in _DEFS
 
 
+def coerce(name: str, value) -> int:
+    """Validate + normalize a value for a known variable (raises
+    UnknownVariableError / ValueError)."""
+    key = name.lower()
+    tp_dflt = _DEFS.get(key)
+    if tp_dflt is None:
+        raise UnknownVariableError(name)
+    return _coerce(key, tp_dflt[0], value)
+
+
 def get_var(name: str) -> int:
-    try:
-        return _vals[name.lower()]
-    except KeyError:
-        raise UnknownVariableError(name) from None
+    key = name.lower()
+    if key not in _DEFS:
+        raise UnknownVariableError(name)
+    return _read(key)
 
 
 def set_var(name: str, value) -> None:
@@ -122,30 +174,35 @@ def set_var(name: str, value) -> None:
 
 
 def all_vars() -> dict[str, int]:
-    return dict(_vals)
+    """Effective values on this thread (session overlay applied)."""
+    out = dict(_vals)
+    ov = getattr(_tls, "overlay", None)
+    if ov:
+        out.update(ov)
+    return out
 
 
-# -- hot-path accessors (plain dict reads; no lock needed for int loads) ----
+# -- hot-path accessors (dict reads; no lock needed for int loads) ----------
 
 def device_enabled() -> bool:
-    return bool(_vals["tidb_tpu_device"])
+    return bool(_read("tidb_tpu_device"))
 
 
 def chunk_cache_enabled() -> bool:
-    return bool(_vals["tidb_tpu_chunk_cache"])
+    return bool(_read("tidb_tpu_chunk_cache"))
 
 
 def cop_concurrency() -> int:
-    return _vals["tidb_tpu_cop_concurrency"]
+    return _read("tidb_tpu_cop_concurrency")
 
 
 def sort_spill_rows() -> int:
-    return _vals["tidb_tpu_sort_spill_rows"]
+    return _read("tidb_tpu_sort_spill_rows")
 
 
 def device_min_rows() -> int:
-    return _vals["tidb_tpu_device_min_rows"]
+    return _read("tidb_tpu_device_min_rows")
 
 
 def stream_rows() -> int:
-    return _vals["tidb_tpu_stream_rows"]
+    return _read("tidb_tpu_stream_rows")

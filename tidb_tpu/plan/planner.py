@@ -685,6 +685,8 @@ class Planner:
             acc_set = here
             # FK-join heuristic: the fact side dominates the intermediate
             acc_est = max(acc_est, leaf_est)
+            join.est_rows = acc_est if acc_est < _JoinGeometry.BIG \
+                else None
         # restore the original column order for everything above
         exprs = [ColumnRef(cur_pos[i], orig.schema.cols[i].ft,
                            name=orig.schema.cols[i].name)

@@ -385,6 +385,8 @@ class Session:
         kind = type(stmt).__name__.removesuffix("Stmt").lower()
         ev = perfschema.stmt_begin(self.session_id, sql)
         root = trace.begin("statement", type=kind)
+        overlay = {k: v for k, v in self.sys_vars.items()
+                   if config.is_known(k)}
         # parse happened batch-wide before dispatch: record this
         # statement's share as a pre-closed phase span, and back-date the
         # root so timer_wait covers it (phases must sum <= total)
@@ -396,7 +398,13 @@ class Session:
         err: str | None = None
         res = None
         try:
-            res = self._run_stmt(stmt, sql_text=sql_text)
+            with config.session_overlay(overlay):
+                try:
+                    res = self._run_stmt(stmt, sql_text=sql_text)
+                finally:
+                    # effective (session-shadowed) slow-log/trace knobs
+                    slow_ms = config.get_var("tidb_tpu_slow_query_ms")
+                    trace_on = config.get_var("tidb_tpu_trace_log")
         except Exception as e:
             metrics.counter(metrics.QUERY_ERRORS)
             err = str(e)
@@ -409,9 +417,9 @@ class Session:
             nrows = len(res.rows) if isinstance(res, ResultSet) else \
                 (res if isinstance(res, int) else 0)
             perfschema.stmt_end(ev, root=root, rows=nrows, error=err)
-            if config.get_var("tidb_tpu_trace_log"):
+            if trace_on:
                 trace.log_tree(root, sql)
-            if dur * 1000 >= config.get_var("tidb_tpu_slow_query_ms"):
+            if dur * 1000 >= slow_ms:
                 metrics.counter(metrics.SLOW_QUERIES)
                 slow_log.warning(
                     "slow query: %.3fs user=%s db=%s sql=%s",
@@ -831,12 +839,10 @@ class Session:
                 need(db or self.current_db, tbl, Priv.SELECT, "SELECT")
             return
         if isinstance(stmt, ast.SetStmt):
-            from tidb_tpu import config
-            if any(getattr(a, "is_global", False) or
-                   (a.is_system and config.is_known(a.name))
+            if any(getattr(a, "is_global", False)
                    for a in stmt.assignments):
-                # SET GLOBAL — and any assignment to a registry variable,
-                # which is process-wide here — mutates shared state
+                # only GLOBAL mutates shared state; session-scope SET of
+                # registry variables shadows per session and is free
                 need("", "", Priv.SUPER, "SUPER (SET GLOBAL)")
             return
         if isinstance(stmt, (ast.CreateDatabaseStmt, ast.DropDatabaseStmt)):
@@ -1110,20 +1116,25 @@ class Session:
             if a.is_system:
                 from tidb_tpu import config
                 if config.is_known(a.name):
-                    # runtime knobs live in the global registry
-                    # (ref: sessionctx/variable/sysvar.go)
+                    # registry knobs (ref: sessionctx/variable/sysvar.go):
+                    # GLOBAL writes the process registry; session scope
+                    # shadows it via a per-statement overlay
                     try:
-                        config.set_var(a.name, val)
+                        val = config.coerce(a.name, val)
                     except (TypeError, ValueError):
                         raise SQLError(
                             f"invalid value for @@{a.name}: {val!r}") \
                             from None
+                    if getattr(a, "is_global", False):
+                        config.set_var(a.name, val)
                 if getattr(a, "is_global", False):
+                    # GLOBAL never touches the session scope (MySQL)
                     self._persist_global_var(a.name.lower(), val)
-                self.sys_vars[a.name.lower()] = val
-                if a.name.lower() == "autocommit":
-                    self.autocommit = bool(int(val)) if val is not None \
-                        else True
+                else:
+                    self.sys_vars[a.name.lower()] = val
+                    if a.name.lower() == "autocommit":
+                        self.autocommit = bool(int(val)) \
+                            if val is not None else True
             else:
                 self.vars[a.name.lower()] = val
         return None
@@ -1181,10 +1192,10 @@ class Session:
                               "Extra"], rows)
         if stmt.tp == "variables":
             from tidb_tpu import config
-            # registry values win for its variables: they are process-
-            # global, so another session's SET must be visible here
-            merged = dict(self.sys_vars)
-            merged.update(config.all_vars())
+            # all_vars() already applies this thread's session overlay;
+            # non-registry session sysvars layer on top
+            merged = dict(config.all_vars())
+            merged.update(self.sys_vars)
             rows = sorted((k, str(v)) for k, v in merged.items())
             if stmt.pattern:
                 import re

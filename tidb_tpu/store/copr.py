@@ -189,6 +189,14 @@ class CopClient(kv.Client):
         metrics.counter(metrics.COP_TASKS, inc=len(tasks))
         concurrency = min(req.concurrency or config.cop_concurrency(),
                           len(tasks))
+        # the session's sysvar overlay is thread-local: capture it here
+        # and re-install inside every pool worker so per-session knobs
+        # (device on/off, cache) apply uniformly across the fan-out
+        overlay = config.current_overlay()
+
+        def run_task(rq, rng):
+            with config.session_overlay(overlay):
+                return list(self._run_task(rq, rng))
         if concurrency <= 1 or len(tasks) == 1:
             for loc, rng in tasks:
                 yield from self._run_task(req, rng)
@@ -198,9 +206,10 @@ class CopClient(kv.Client):
 
         def worker(task_list):
             try:
-                for _loc, rng in task_list:
-                    for resp in self._run_task(req, rng):
-                        results.put(resp)
+                with config.session_overlay(overlay):
+                    for _loc, rng in task_list:
+                        for resp in self._run_task(req, rng):
+                            results.put(resp)
                 results.put(done)
             except Exception as exc:  # noqa: BLE001
                 results.put(exc)
@@ -221,12 +230,12 @@ class CopClient(kv.Client):
                     nxt = next(it, None)
                     if nxt is None:
                         break
-                    window.append(pool.submit(self._run_task, req, nxt[1]))
+                    window.append(pool.submit(run_task, req, nxt[1]))
                 while window:
                     f = window.popleft()
                     nxt = next(it, None)
                     if nxt is not None:
-                        window.append(pool.submit(self._run_task, req,
+                        window.append(pool.submit(run_task, req,
                                                   nxt[1]))
                     yield from f.result()
             finally:
