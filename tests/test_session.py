@@ -292,3 +292,27 @@ class TestMultiRegion:
         assert tk.execute("UPDATE t SET v = 0 WHERE id > 90")[0] == 10
         assert q(tk, "SELECT SUM(v) FROM t") == [(50500 - sum(
             i * 10 for i in range(91, 101)),)]
+
+
+class TestDecimalPrecisionGuards:
+    """Decimals are scaled int64 (18-digit documented limit): wide
+    declarations fail at DDL and out-of-range values fail at write —
+    never silent truncation or wraparound."""
+
+    def test_wide_precision_rejected_at_ddl(self, tk):
+        from tidb_tpu.session import SQLError
+        with pytest.raises(SQLError, match="exceeds the supported"):
+            tk.execute("CREATE TABLE wd (id BIGINT PRIMARY KEY, "
+                       "amt DECIMAL(38, 10))")
+        with pytest.raises(SQLError, match="scale"):
+            tk.execute("CREATE TABLE wd (id BIGINT PRIMARY KEY, "
+                       "amt DECIMAL(6, 8))")
+
+    def test_out_of_range_value_rejected(self, tk):
+        tk.execute("CREATE TABLE dg (id BIGINT PRIMARY KEY, "
+                   "amt DECIMAL(8, 2))")
+        with pytest.raises(Exception, match="Out of range"):
+            tk.execute("INSERT INTO dg VALUES (1, 12345678901.25)")
+        tk.execute("INSERT INTO dg VALUES (1, 123456.78)")
+        assert str(tk.query("SELECT amt FROM dg").rows[0][0]) == \
+            "123456.78"

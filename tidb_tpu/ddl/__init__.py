@@ -287,6 +287,7 @@ class DDL:
     def _alter_spec_job(self, meta: Meta, db, t: TableInfo, spec):
         if spec.tp == "add_column":
             cd = spec.column
+            _check_column_type(cd)
             if t.col_by_name(cd.name) is not None:
                 raise DDLError(f"column '{cd.name}' exists")
             default = None
@@ -359,6 +360,24 @@ class DDL:
 DDLExecutor = DDL
 
 
+MAX_DECIMAL_DIGITS = 18   # decimals are scaled int64 (documented limit;
+                          # ref MyDecimal goes to 65 via bignum lanes)
+
+
+def _check_column_type(cd) -> None:
+    from tidb_tpu.sqltypes import TypeCode
+    if cd.ft.tp == TypeCode.NEWDECIMAL:
+        if cd.ft.flen > MAX_DECIMAL_DIGITS:
+            raise DDLError(
+                f"column '{cd.name}': DECIMAL({cd.ft.flen},{cd.ft.frac}) "
+                f"exceeds the supported precision "
+                f"({MAX_DECIMAL_DIGITS} digits); values are scaled int64")
+        if cd.ft.frac > cd.ft.flen:
+            raise DDLError(
+                f"column '{cd.name}': scale {cd.ft.frac} > "
+                f"precision {cd.ft.flen}")
+
+
 def build_table_info(meta: Meta, stmt: ast.CreateTableStmt) -> TableInfo:
     info = TableInfo(id=meta.gen_global_id(), name=stmt.table.name)
     names = set()
@@ -366,6 +385,7 @@ def build_table_info(meta: Meta, stmt: ast.CreateTableStmt) -> TableInfo:
         if cd.name.lower() in names:
             raise DDLError(f"duplicate column '{cd.name}'")
         names.add(cd.name.lower())
+        _check_column_type(cd)
         default = _const_default(cd) if cd.has_default else None
         info.columns.append(ColumnInfo(
             id=i + 1, name=cd.name, offset=i, ft=cd.ft, default=default,

@@ -80,8 +80,15 @@ def encode_datum_for_col(v, ft: FieldType):
         # column MUST share the column frac or index ranges break
         if isinstance(v, tuple):
             frac, scaled = v
-            return (ft.frac, _rescale_decimal(scaled, frac, ft.frac))
-        return (ft.frac, decimal_to_scaled(v, ft.frac))
+            out = (ft.frac, _rescale_decimal(scaled, frac, ft.frac))
+        else:
+            out = (ft.frac, decimal_to_scaled(v, ft.frac))
+        if ft.flen > 0 and abs(out[1]) >= 10 ** min(ft.flen, 18):
+            # MySQL strict mode: out-of-range decimal is an error, never
+            # a silently stored wider value
+            raise kv.KVError(
+                f"Out of range value for DECIMAL({ft.flen},{ft.frac})")
+        return out
     if ft.tp in (TypeCode.ENUM, TypeCode.SET):
         return _normalize_enum_set(v, ft)
     if ft.tp == TypeCode.JSON:
