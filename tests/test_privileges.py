@@ -322,3 +322,40 @@ class TestReviewRegressions:
         rows = Session(store, internal=True).query(
             "SELECT privs FROM mysql.user WHERE user = 'root'").rows
         assert rows == [(ALL_PRIVS,)]
+
+
+class TestShowVariants:
+    def test_show_index_grants_status(self, store):
+        r = root(store)
+        r.execute("CREATE DATABASE sv")
+        r.execute("CREATE TABLE sv.t (id BIGINT PRIMARY KEY, v BIGINT)")
+        r.execute("CREATE INDEX iv ON sv.t (v)")
+        idx = r.query("SHOW INDEX FROM sv.t").rows
+        assert ("t", 0, "PRIMARY", 1, "id", "BTREE") in idx
+        assert ("t", 1, "iv", 1, "v", "BTREE") in idx
+        r.execute("CREATE USER showme IDENTIFIED BY 'x'")
+        r.execute("GRANT SELECT ON sv.t TO showme")
+        g = [x[0] for x in r.query("SHOW GRANTS FOR showme").rows]
+        assert any("GRANT SELECT ON `sv`.`t`" in x for x in g), g
+        own = [x[0] for x in r.query("SHOW GRANTS").rows]
+        assert any("ALL PRIVILEGES" in x for x in own), own
+        st = dict(r.query("SHOW STATUS").rows)
+        assert st, "status should expose counters"
+        assert r.query("SHOW ENGINES").rows[0][1] == "DEFAULT"
+        r.close()
+
+    def test_show_grants_forms_and_access(self, store):
+        r = root(store)
+        r.execute("CREATE USER nosy IDENTIFIED BY 'x'")
+        own = [x[0] for x in
+               r.query("SHOW GRANTS FOR CURRENT_USER").rows]
+        assert any("ALL PRIVILEGES" in x for x in own)
+        quoted = [x[0] for x in
+                  r.query("SHOW GRANTS FOR 'nosy'@'%'").rows]
+        assert any("USAGE" in x for x in quoted)
+        nosy = Session(store, user="nosy", host="%")
+        with pytest.raises(SQLError, match="denied"):
+            nosy.query("SHOW GRANTS FOR root")
+        assert nosy.query("SHOW GRANTS").rows    # own grants always ok
+        nosy.close()
+        r.close()

@@ -1045,6 +1045,27 @@ class Parser:
             s.tp = "columns"
             self.expect_kw("FROM")
             s.table = self.table_name()
+        elif self.try_kw("INDEX", "KEY"):
+            s.tp = "index"
+            self.try_kw("FROM", "IN")
+            s.table = self.table_name()
+        elif self.peek().tp == TokenType.IDENT and \
+                self.peek().val.upper() in ("INDEXES", "KEYS"):
+            self.next()
+            s.tp = "index"
+            self.try_kw("FROM", "IN")
+            s.table = self.table_name()
+        elif self.peek().tp == TokenType.IDENT and \
+                self.peek().val.upper() == "GRANTS":
+            self.next()
+            s.tp = "grants"
+            if self.try_kw("FOR"):
+                if self.peek().val.upper() == "CURRENT_USER":
+                    self.next()
+                    if self.try_op("("):
+                        self.expect_op(")")
+                else:
+                    s.pattern = self._user_spec().user
         elif self.try_kw("VARIABLES"):
             s.tp = "variables"
         elif self.peek().tp == TokenType.IDENT and \

@@ -161,6 +161,36 @@ class PrivilegeCache:
                 privs |= p
         return privs
 
+    def describe_grants(self, user: str) -> list[str]:
+        """GRANT statements reconstructing the user's privileges (ref:
+        privileges.go ShowGrants)."""
+        self._ensure()
+
+        def names(p: int) -> str:
+            if p & ALL_PRIVS == ALL_PRIVS:
+                return "ALL PRIVILEGES"
+            display = dict(PRIV_BY_NAME)
+            display.pop("ALL", None)
+            # bits with multi-word display names (not in the GRANT-able
+            # name map)
+            display["CREATE USER"] = Priv.CREATE_USER
+            display["GRANT OPTION"] = Priv.GRANT
+            got = [n for n, bit in display.items() if p & bit]
+            return ", ".join(got) if got else "USAGE"
+
+        out = []
+        for pat, _a, p in self._users.get(user, ()):
+            out.append(f"GRANT {names(p)} ON *.* TO '{user}'@'{pat}'")
+        for u, pat, d, p in self._dbs:
+            if u == user:
+                out.append(
+                    f"GRANT {names(p)} ON `{d}`.* TO '{user}'@'{pat}'")
+        for u, pat, d, t, p in self._tables:
+            if u == user:
+                out.append(f"GRANT {names(p)} ON `{d}`.`{t}` "
+                           f"TO '{user}'@'{pat}'")
+        return out
+
     def request_verification(self, user: str, host: str, db: str,
                              table: str, want: int) -> bool:
         return (self.effective_privs(user, host, db, table) & want) == want

@@ -1226,6 +1226,57 @@ class Session:
             return ResultSet(["Table", "Create Table"],
                              [(t.name,
                                f"CREATE TABLE `{t.name}` (\n  {cols}\n)")])
+        if stmt.tp == "index":
+            from tidb_tpu.schema.model import SchemaState
+            t = self._resolve_table_or_err(stmt.table)
+            rows = []
+            if t.pk_is_handle and t.pk_col_name:
+                rows.append((t.name, 0, "PRIMARY", 1,
+                             t.pk_col_name.lower(), "BTREE"))
+            for idx in t.indexes:
+                if idx.state != SchemaState.PUBLIC:
+                    continue
+                for seq, cn in enumerate(idx.columns, 1):
+                    rows.append((t.name, 0 if idx.unique else 1,
+                                 idx.name.lower(), seq, cn.lower(),
+                                 "BTREE"))
+            return ResultSet(["Table", "Non_unique", "Key_name",
+                              "Seq_in_index", "Column_name",
+                              "Index_type"], rows)
+        if stmt.tp == "status":
+            from tidb_tpu import metrics
+            rows = sorted((k, str(v))
+                          for k, v in metrics.snapshot().items())
+            return ResultSet(["Variable_name", "Value"], rows)
+        if stmt.tp == "engines":
+            return ResultSet(
+                ["Engine", "Support", "Comment"],
+                [("tidb-tpu", "DEFAULT",
+                  "MVCC KV with XLA analytical executors")])
+        if stmt.tp == "collation":
+            return ResultSet(["Collation", "Charset", "Default"],
+                             [("utf8_bin", "utf8", "Yes")])
+        if stmt.tp == "grants":
+            user = stmt.pattern or self.user or ""
+            if user != (self.user or "") and not self.internal:
+                # viewing ANOTHER account's grants needs catalog access
+                # (MySQL: SELECT on the mysql schema)
+                from tidb_tpu.privilege import Priv
+                cache0 = self.domain.priv_cache()
+                ischema0 = self.domain.info_schema()
+                if ischema0.has_db("mysql") and not \
+                        cache0.request_verification(
+                            self.user, self.host, "mysql", "",
+                            Priv.SELECT):
+                    raise SQLError(
+                        f"SHOW GRANTS denied to user '{self.user}'@"
+                        f"'{self.host}'")
+            cache = self.domain.priv_cache()
+            grants = cache.describe_grants(user)
+            if not grants:
+                grants = [f"GRANT USAGE ON *.* TO '{user}'@'%'"]
+            return ResultSet([f"Grants for {user}"],
+                             [(g,) for g in grants])
         return ResultSet(["info"], [])
 
     # -- ANALYZE / stats -----------------------------------------------------
