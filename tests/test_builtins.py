@@ -216,3 +216,53 @@ class TestRemotePushdown:
         finally:
             st.close()
             srv.close()
+
+
+class TestGroupConcat:
+    """GROUP_CONCAT through the partial/final protocol (host-only agg;
+    ref: expression/aggregation concat)."""
+
+    @pytest.fixture
+    def gsess(self):
+        s = Session(new_mock_storage())
+        s.execute("CREATE DATABASE gc")
+        s.execute("USE gc")
+        s.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, g BIGINT, "
+                  "name VARCHAR(10), n BIGINT)")
+        s.execute("INSERT INTO t VALUES (1,1,'a',10),(2,1,'b',20),"
+                  "(3,2,'c',30),(4,2,NULL,40)")
+        yield s
+        s.close()
+
+    def test_basic_and_separator(self, gsess):
+        assert gsess.query("SELECT g, GROUP_CONCAT(name) FROM t "
+                           "GROUP BY g ORDER BY g").rows == \
+            [(1, "a,b"), (2, "c")]
+        assert gsess.query("SELECT GROUP_CONCAT(name SEPARATOR '-') "
+                           "FROM t WHERE g = 1").rows == [("a-b",)]
+
+    def test_numeric_distinct_null(self, gsess):
+        assert gsess.query("SELECT GROUP_CONCAT(n) FROM t").rows == \
+            [("10,20,30,40",)]
+        assert gsess.query("SELECT GROUP_CONCAT(DISTINCT g) FROM t"
+                           ).rows == [("1,2",)]
+        assert gsess.query("SELECT GROUP_CONCAT(name) FROM t "
+                           "WHERE name IS NULL").rows == [(None,)]
+
+    def test_display_formatting(self, gsess):
+        gsess.execute("CREATE TABLE fmt (id BIGINT PRIMARY KEY, "
+                      "amt DECIMAL(5,2), dt DATETIME, x DOUBLE)")
+        gsess.execute("INSERT INTO fmt VALUES "
+                      "(1, 12.34, '2024-01-02 03:04:05', 10), "
+                      "(2, 5.60, '2024-06-07 08:09:10', 2.5)")
+        r = gsess.query("SELECT GROUP_CONCAT(amt), GROUP_CONCAT(dt), "
+                        "GROUP_CONCAT(x) FROM fmt").rows
+        assert r == [("12.34,5.60",
+                      "2024-01-02 03:04:05,2024-06-07 08:09:10",
+                      "10,2.5")]
+
+    def test_partials_merge_across_regions(self, gsess):
+        gsess.query("SPLIT TABLE t REGIONS 3")
+        assert gsess.query("SELECT g, GROUP_CONCAT(name) FROM t "
+                           "GROUP BY g ORDER BY g").rows == \
+            [(1, "a,b"), (2, "c")]

@@ -41,6 +41,7 @@ class AggFunc(Enum):
     BIT_AND = "bit_and"
     BIT_OR = "bit_or"
     BIT_XOR = "bit_xor"
+    GROUP_CONCAT = "group_concat"
 
 
 @dataclass
@@ -49,6 +50,7 @@ class AggDesc:
     arg: Expression | None  # None for COUNT(*)
     distinct: bool = False
     name: str = ""
+    sep: str = ","          # GROUP_CONCAT separator
 
     @property
     def result_ft(self) -> FieldType:
@@ -56,6 +58,9 @@ class AggDesc:
             return new_int_field()
         if self.fn in (AggFunc.BIT_AND, AggFunc.BIT_OR, AggFunc.BIT_XOR):
             return new_int_field()
+        if self.fn == AggFunc.GROUP_CONCAT:
+            from tidb_tpu.sqltypes import new_string_field
+            return new_string_field()
         aft = self.arg.ft
         if self.fn == AggFunc.AVG:
             if aft.eval_type == EvalType.DECIMAL:

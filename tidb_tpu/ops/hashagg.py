@@ -167,6 +167,8 @@ def _validate_device_exprs(filter_expr, group_exprs, aggs) -> None:
             raise ValueError(f"group expr {g!r} computes over a varlen "
                              "column; pre-project it on the host")
     for a in aggs:
+        if a.fn == AggFunc.GROUP_CONCAT:
+            raise ValueError("GROUP_CONCAT aggregates on the host")
         if a.arg is not None and not a.arg.is_device_safe():
             # FIRST_ROW only needs a row index on device, so a bare string
             # ColumnRef is fine (value gathered host-side); computed string
@@ -365,6 +367,12 @@ class HashAggregator:
                 elif fn == AggFunc.FIRST_ROW:
                     if cur[1] == 0 and lanes[1][gi] > 0:
                         cur[0], cur[1] = lanes[0][gi], 1
+                elif fn == AggFunc.GROUP_CONCAT:
+                    if lanes[1][gi] > 0:
+                        if cur[1] > 0:
+                            cur[0] = cur[0] + agg.sep + lanes[0][gi]
+                        else:
+                            cur[0], cur[1] = lanes[0][gi], 1
 
     def results(self) -> list[tuple[tuple, list]]:
         """-> [(key, [final agg values])] with AVG finalized; SUM/AVG of
@@ -390,7 +398,8 @@ class HashAggregator:
                             int(cur[0]) * (10 ** extra) / int(cur[1]))))
                     else:
                         vals.append(float(cur[0]) / float(cur[1]))
-                elif fn in (AggFunc.MIN, AggFunc.MAX, AggFunc.FIRST_ROW):
+                elif fn in (AggFunc.MIN, AggFunc.MAX, AggFunc.FIRST_ROW,
+                            AggFunc.GROUP_CONCAT):
                     vals.append(None if cur[1] == 0 else cur[0])
                 else:
                     raise NotImplementedError(fn)

@@ -141,6 +141,15 @@ def _agg_lanes_vectorized(a: AggDesc, chunk, rows, starts, gid, ngroups,
             arr = red.reduceat(np.where(v, d, ident), starts)
             arr = np.where(has > 0, arr, 0)
         return [arr, has]
+    if fn == AggFunc.GROUP_CONCAT:
+        vals, hasv = [], []
+        for s, e in _seg_bounds(starts, len(rows)):
+            parts = [_display_str(x, a.arg.ft)
+                     for x, ok in zip(d[s:e], v[s:e]) if ok]
+            hasv.append(1 if parts else 0)
+            vals.append(a.sep.join(parts) if parts else "")
+        return [np.array(vals, dtype=object),
+                np.array(hasv, dtype=np.int64)]
     if fn == AggFunc.FIRST_ROW:
         n = len(rows)
         pos = np.where(v, np.arange(n), n)
@@ -152,6 +161,24 @@ def _agg_lanes_vectorized(a: AggDesc, chunk, rows, starts, gid, ngroups,
             vals = np.where(has > 0, vals, 0)
         return [vals, has]
     raise NotImplementedError(fn)
+
+
+def _display_str(v, ft) -> str:
+    """Chunk-layer value -> its SQL display text (GROUP_CONCAT
+    concatenates DISPLAY values, not internal encodings: scaled decimal
+    ints and epoch-micros datetimes must format like SELECT would)."""
+    from tidb_tpu.sqltypes import (EvalType, format_datetime,
+                                   scaled_to_decimal)
+    et = ft.eval_type
+    if et == EvalType.DECIMAL:
+        return str(scaled_to_decimal(int(v), max(ft.frac, 0)))
+    if et == EvalType.DATETIME:
+        return format_datetime(int(v), ft.tp)
+    if isinstance(v, float):
+        return str(int(v)) if v == int(v) else str(v)
+    if isinstance(v, bytes):
+        return v.decode("utf8", "replace")
+    return str(v)
 
 
 def _seg_bounds(starts, n):
@@ -253,6 +280,8 @@ def _update_state(a: AggDesc, st, col, i):
     elif fn == AggFunc.FIRST_ROW:
         if st.get("first") is None:
             st["first"] = val
+    elif fn == AggFunc.GROUP_CONCAT:
+        st.setdefault("parts", []).append(_display_str(val, a.arg.ft))
     else:
         raise NotImplementedError(fn)
 
@@ -283,6 +312,11 @@ def _states_to_lanes(a: AggDesc, sts: list[dict]):
             if any(isinstance(v, (str, bytes)) for v in vals) else \
             np.asarray(vals)
         return [arr, np.array(has, dtype=np.int64)]
+    if fn == AggFunc.GROUP_CONCAT:
+        has = [1 if s.get("parts") else 0 for s in sts]
+        vals = [a.sep.join(s.get("parts", [])) for s in sts]
+        return [np.array(vals, dtype=object),
+                np.array(has, dtype=np.int64)]
     if fn == AggFunc.FIRST_ROW:
         has = [0 if s.get("first") is None else 1 for s in sts]
         vals = [s.get("first") if has[i] else 0

@@ -96,6 +96,7 @@ class AggregateCall(ExprNode):
     args: list = field(default_factory=list)   # empty for COUNT(*)
     distinct: bool = False
     star: bool = False
+    sep: str = ","           # GROUP_CONCAT ... SEPARATOR '...'
 
 
 @dataclass

@@ -1065,7 +1065,8 @@ class Parser:
                     if self.try_op("("):
                         self.expect_op(")")
                 else:
-                    s.pattern = self._user_spec().user
+                    spec = self._user_spec()
+                    s.pattern = f"{spec.user}@{spec.host}"
         elif self.try_kw("VARIABLES"):
             s.tp = "variables"
         elif self.peek().tp == TokenType.IDENT and \
@@ -1420,8 +1421,15 @@ class Parser:
             args = [self.expr()]
             while self.try_op(","):
                 args.append(self.expr())
+            sep = ","
+            if name == "GROUP_CONCAT" and \
+                    self.peek().tp == TokenType.IDENT and \
+                    self.peek().val.upper() == "SEPARATOR":
+                self.next()
+                sep = self._str_lit()
             self.expect_op(")")
-            return ast.AggregateCall(name=name, args=args, distinct=distinct)
+            return ast.AggregateCall(name=name, args=args,
+                                     distinct=distinct, sep=sep)
         args = []
         if not self.try_op(")"):
             # DATE_ADD(d, INTERVAL n DAY)

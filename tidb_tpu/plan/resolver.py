@@ -132,7 +132,8 @@ _FUNC_OPS = {
 _AGG_MAP = {"COUNT": AggFunc.COUNT, "SUM": AggFunc.SUM, "AVG": AggFunc.AVG,
             "MIN": AggFunc.MIN, "MAX": AggFunc.MAX,
             "BIT_AND": AggFunc.BIT_AND, "BIT_OR": AggFunc.BIT_OR,
-            "BIT_XOR": AggFunc.BIT_XOR}
+            "BIT_XOR": AggFunc.BIT_XOR,
+            "GROUP_CONCAT": AggFunc.GROUP_CONCAT}
 
 _BIN_OPS = {"+": Op.PLUS, "-": Op.MINUS, "*": Op.MUL, "/": Op.DIV,
             "DIV": Op.INTDIV, "%": Op.MOD, "MOD": Op.MOD,
@@ -428,7 +429,8 @@ class Resolver:
             if len(e.args) != 1:
                 raise ResolveError(f"{name} takes one argument")
             arg = self.resolve(e.args[0])
-        desc = AggDesc(fn, arg, distinct=e.distinct)
+        desc = AggDesc(fn, arg, distinct=e.distinct,
+                       sep=getattr(e, "sep", ","))
         # reuse identical agg (same fn/arg repr)
         for i, d in enumerate(self.aggs):
             if repr(d) == repr(desc):

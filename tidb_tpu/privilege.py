@@ -161,10 +161,15 @@ class PrivilegeCache:
                 privs |= p
         return privs
 
-    def describe_grants(self, user: str) -> list[str]:
-        """GRANT statements reconstructing the user's privileges (ref:
-        privileges.go ShowGrants)."""
+    def describe_grants(self, user: str,
+                        host: str | None = None) -> list[str]:
+        """GRANT statements reconstructing one ACCOUNT's privileges
+        (ref: privileges.go ShowGrants). host filters to that exact host
+        pattern; None lists every host variant of the name."""
         self._ensure()
+
+        def want(pat: str) -> bool:
+            return host is None or pat == host
 
         def names(p: int) -> str:
             if p & ALL_PRIVS == ALL_PRIVS:
@@ -180,13 +185,15 @@ class PrivilegeCache:
 
         out = []
         for pat, _a, p in self._users.get(user, ()):
-            out.append(f"GRANT {names(p)} ON *.* TO '{user}'@'{pat}'")
+            if want(pat):
+                out.append(
+                    f"GRANT {names(p)} ON *.* TO '{user}'@'{pat}'")
         for u, pat, d, p in self._dbs:
-            if u == user:
+            if u == user and want(pat):
                 out.append(
                     f"GRANT {names(p)} ON `{d}`.* TO '{user}'@'{pat}'")
         for u, pat, d, t, p in self._tables:
-            if u == user:
+            if u == user and want(pat):
                 out.append(f"GRANT {names(p)} ON `{d}`.`{t}` "
                            f"TO '{user}'@'{pat}'")
         return out

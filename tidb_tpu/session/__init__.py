@@ -1257,8 +1257,11 @@ class Session:
             return ResultSet(["Collation", "Charset", "Default"],
                              [("utf8_bin", "utf8", "Yes")])
         if stmt.tp == "grants":
-            user = stmt.pattern or self.user or ""
-            if user != (self.user or "") and not self.internal:
+            target = stmt.pattern or (self.user or "")
+            user, _, host = target.partition("@")
+            is_self = user == (self.user or "") and \
+                (not host or host == (self.host or ""))
+            if not is_self and not self.internal:
                 # viewing ANOTHER account's grants needs catalog access
                 # (MySQL: SELECT on the mysql schema)
                 from tidb_tpu.privilege import Priv
@@ -1272,7 +1275,7 @@ class Session:
                         f"SHOW GRANTS denied to user '{self.user}'@"
                         f"'{self.host}'")
             cache = self.domain.priv_cache()
-            grants = cache.describe_grants(user)
+            grants = cache.describe_grants(user, host or None)
             if not grants:
                 grants = [f"GRANT USAGE ON *.* TO '{user}'@'%'"]
             return ResultSet([f"Grants for {user}"],
