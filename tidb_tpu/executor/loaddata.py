@@ -209,18 +209,110 @@ def _split_fields(line: str, ft: str, enc: str, esc: str) -> list:
 def parse_lines(text, stmt):
     """Split file text (a str, or an iterable of str chunks) into rows of
     fields (str, or None for \\N). Honors LINES STARTING/TERMINATED,
-    FIELDS TERMINATED/ENCLOSED/ESCAPED and IGNORE n LINES."""
+    FIELDS TERMINATED/ENCLOSED/ESCAPED and IGNORE n LINES.
+
+    Regular single-byte-separator inputs scan through the native C++
+    loader (tidb_tpu/native/loadscan.cc) with row-aligned fallback to
+    this module's general scanner on anything irregular."""
     lt = stmt.lines_terminated or "\n"
     ft = stmt.fields_terminated or "\t"
     enc = stmt.fields_enclosed
     esc = stmt.fields_escaped
     chunks = [text] if isinstance(text, str) else text
+    if (len(lt.encode()) == 1 and len(ft.encode()) == 1 and
+            len(enc.encode()) <= 1 and len(esc.encode()) <= 1 and
+            enc != esc and not stmt.lines_starting):
+        native = _parse_lines_native(chunks, stmt, lt, ft, enc, esc)
+        if native is not None:
+            yield from native
+            return
     for line in _split_lines(chunks, lt, ft, enc, esc,
                              stmt.lines_starting or "",
                              stmt.ignore_lines):
         if not line:
             continue
         yield _split_fields(line, ft, enc, esc)
+
+
+def _parse_lines_native(chunks, stmt, lt, ft, enc, esc):
+    """Generator over rows via the C++ scanner, or None when the native
+    library is unavailable. Streams with a row-aligned carry buffer;
+    irregular remainders (and a stalled scan) run the general Python
+    scanner instead."""
+    from tidb_tpu.native import scan_rows_native
+    probe = scan_rows_native(b"", ft.encode(), lt.encode(),
+                            enc.encode(), esc.encode(), 0)
+    if probe is None:
+        return None
+
+    def gen():
+        import itertools
+        ftb, ltb = ft.encode(), lt.encode()
+        encb, escb = enc.encode(), esc.encode()
+        carry = b""
+        ignore = stmt.ignore_lines
+        it = iter(chunks)
+        final = False
+        while not final:
+            chunk = next(it, None)
+            if chunk is None:
+                final = True
+            else:
+                carry += chunk.encode("utf8")
+                if len(carry) < (1 << 16):
+                    continue
+            # IGNORE n LINES: strip physical lines in the buffer first
+            while ignore > 0:
+                at = carry.find(ltb)
+                if at < 0:
+                    break
+                carry = carry[at + 1:]
+                ignore -= 1
+            if ignore > 0:
+                if not final:
+                    continue
+                carry = b""       # the whole tail is an ignored line
+                break
+            if not carry:
+                continue
+            res = scan_rows_native(carry, ftb, ltb, encb, escb, 0,
+                                   final_chunk=final)
+            consumed, rowoff, fs, fe, fl = res
+            for r in range(len(rowoff) - 1):
+                lo, hi = int(rowoff[r]), int(rowoff[r + 1])
+                if hi - lo == 1 and fs[lo] == fe[lo] and fl[lo] == 0:
+                    continue       # empty line (matches the host scanner)
+                fields = []
+                for j in range(lo, hi):
+                    if fl[j] & 4:
+                        fields.append(None)
+                        continue
+                    sv = carry[int(fs[j]):int(fe[j])].decode(
+                        "utf8", "replace")
+                    if fl[j] & 2 and enc:
+                        sv = sv.replace(enc + enc, enc)
+                    if fl[j] & 1 and esc:
+                        sv = _unescape(sv, esc)
+                    fields.append(sv)
+                yield fields
+            if consumed == 0 and (final or len(carry) > (1 << 20)):
+                # irregular head the C scanner cannot progress past:
+                # the general scanner takes the whole remainder
+                rest = carry.decode("utf8", "replace")
+                tail = itertools.chain(
+                    [rest], (c for c in it if c is not None))
+                for line in _split_lines(tail, lt, ft, enc, esc, "", 0):
+                    if line:
+                        yield _split_fields(line, ft, enc, esc)
+                return
+            carry = carry[consumed:]
+        if carry:
+            for line in _split_lines([carry.decode("utf8", "replace")],
+                                     lt, ft, enc, esc, "", 0):
+                if line:
+                    yield _split_fields(line, ft, enc, esc)
+
+    return gen()
 
 
 def convert_fields(info, col_names: list[str], fields: list) -> dict:

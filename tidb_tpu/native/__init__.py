@@ -17,8 +17,9 @@ from pathlib import Path
 
 import numpy as np
 
-__all__ = ["lib", "decode_rows_native", "NATIVE_KIND_INT",
-           "NATIVE_KIND_FLOAT", "NATIVE_KIND_DECIMAL", "NATIVE_KIND_HANDLE"]
+__all__ = ["lib", "decode_rows_native", "scan_rows_native",
+           "NATIVE_KIND_INT", "NATIVE_KIND_FLOAT", "NATIVE_KIND_DECIMAL",
+           "NATIVE_KIND_HANDLE"]
 
 NATIVE_KIND_INT = 0
 NATIVE_KIND_FLOAT = 1
@@ -30,10 +31,12 @@ _lib = None
 _tried = False
 
 
-def _build() -> ctypes.CDLL | None:
-    src = Path(__file__).parent / "codec.cc"
+def _compile(name: str) -> ctypes.CDLL | None:
+    """Build native/<name>.cc into _build/<name>.so (mtime-cached) and
+    load it; None when no compiler / load failure."""
+    src = Path(__file__).parent / f"{name}.cc"
     build_dir = Path(__file__).parent / "_build"
-    so = build_dir / "codec.so"
+    so = build_dir / f"{name}.so"
     try:
         if not so.exists() or so.stat().st_mtime < src.stat().st_mtime:
             build_dir.mkdir(exist_ok=True)
@@ -43,8 +46,14 @@ def _build() -> ctypes.CDLL | None:
                  "-o", str(tmp), str(src)],
                 check=True, capture_output=True, timeout=120)
             os.replace(tmp, so)
-        cdll = ctypes.CDLL(str(so))
+        return ctypes.CDLL(str(so))
     except Exception:  # noqa: BLE001 - no compiler / load failure
+        return None
+
+
+def _build() -> ctypes.CDLL | None:
+    cdll = _compile("codec")
+    if cdll is None:
         return None
     cdll.decode_rows.restype = ctypes.c_int
     cdll.decode_rows.argtypes = [
@@ -57,6 +66,73 @@ def _build() -> ctypes.CDLL | None:
         ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_void_p),
     ]
     return cdll
+
+
+def _build_loadscan() -> ctypes.CDLL | None:
+    cdll = _compile("loadscan")
+    if cdll is None:
+        return None
+    cdll.scan_rows.restype = ctypes.c_int64
+    P64 = ctypes.POINTER(ctypes.c_int64)
+    P8 = ctypes.POINTER(ctypes.c_uint8)
+    cdll.scan_rows.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64,
+        ctypes.c_uint8, ctypes.c_uint8, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int64, ctypes.c_int32,
+        P64, P64, P8, P64, ctypes.c_int64, ctypes.c_int64, P64, P64,
+    ]
+    return cdll
+
+
+_scan_lock = threading.Lock()
+_scan_lib = None
+_scan_tried = False
+
+
+def _loadscan_lib() -> ctypes.CDLL | None:
+    global _scan_lib, _scan_tried
+    if _scan_tried:
+        return _scan_lib
+    with _scan_lock:
+        if not _scan_tried:
+            _scan_lib = _build_loadscan()
+            _scan_tried = True
+    return _scan_lib
+
+
+def scan_rows_native(data: bytes, ft: bytes, lt: bytes, enc: bytes,
+                     esc: bytes, ignore_lines: int,
+                     final_chunk: bool = True):
+    """Scan LOAD DATA text into field spans.
+
+    -> (consumed_bytes, rowoff int64[nr+1], fstart, fend, fflags) or
+    None when the native scanner is unavailable. consumed < len(data)
+    means the caller must run the general scanner on the remainder."""
+    cdll = _loadscan_lib()
+    if cdll is None:
+        return None
+    n = len(data)
+    # upper bounds: every separator byte could open a field/row
+    max_fields = data.count(ft) + data.count(lt) + 2
+    max_rows = data.count(lt) + 2
+    fstart = np.empty(max_fields, dtype=np.int64)
+    fend = np.empty(max_fields, dtype=np.int64)
+    fflags = np.empty(max_fields, dtype=np.uint8)
+    rowoff = np.zeros(max_rows + 1, dtype=np.int64)
+    out = np.zeros(2, dtype=np.int64)
+    P64 = ctypes.POINTER(ctypes.c_int64)
+    P8 = ctypes.POINTER(ctypes.c_uint8)
+    consumed = cdll.scan_rows(
+        data, n, ft[0], lt[0],
+        enc[0] if enc else -1, esc[0] if esc else -1,
+        ignore_lines, 1 if final_chunk else 0,
+        fstart.ctypes.data_as(P64), fend.ctypes.data_as(P64),
+        fflags.ctypes.data_as(P8), rowoff.ctypes.data_as(P64),
+        max_fields, max_rows,
+        out[0:].ctypes.data_as(P64), out[1:].ctypes.data_as(P64))
+    nr, nf = int(out[0]), int(out[1])
+    return (int(consumed), rowoff[:nr + 1], fstart[:nf], fend[:nf],
+            fflags[:nf])
 
 
 def lib() -> ctypes.CDLL | None:

@@ -126,10 +126,11 @@ def _agg_lanes_vectorized(a: AggDesc, chunk, rows, starts, gid, ngroups,
     if fn in (AggFunc.MIN, AggFunc.MAX):
         red = np.minimum if fn == AggFunc.MIN else np.maximum
         if d.dtype == np.dtype(object):
+            pick = min if fn == AggFunc.MIN else max  # strings: python
             vals = []
             for s, e in _seg_bounds(starts, len(rows)):
                 seg = [x for x, ok in zip(d[s:e], v[s:e]) if ok]
-                vals.append(red.reduce(seg) if seg else 0)
+                vals.append(pick(seg) if seg else 0)
             arr = np.array(vals, dtype=object)
         elif d.dtype == np.float64:
             ident = np.inf if fn == AggFunc.MIN else -np.inf
