@@ -99,8 +99,16 @@ int64_t scan_rows(const uint8_t* t, int64_t n,
         fstart[nf] = s; fend[nf] = e; fflags[nf] = flags; nf++;
 
         // ---- separator after the field ----
-        if (i >= n || t[i] == lt) {
-            if (i < n) i++;                           // consume lt
+        if (i >= n) {
+            // buffer ended mid-row: only a FINAL buffer may treat EOF
+            // as the row terminator; otherwise the partial row carries
+            // into the next chunk
+            if (!final_chunk) BAIL(row_begin);
+            if (nr >= max_rows) BAIL(row_begin);
+            rowoff[++nr] = nf;
+            row_begin = i;
+        } else if (t[i] == lt) {
+            i++;
             if (nr >= max_rows) BAIL(row_begin);
             rowoff[++nr] = nf;
             row_begin = i;
