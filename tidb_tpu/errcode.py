@@ -36,11 +36,15 @@ ER_WRONG_VALUE_COUNT = 1136
 ER_TRUNCATED_WRONG_VALUE = 1292
 ER_DATA_TOO_LONG = 1406
 ER_BAD_NULL_ERROR = 1048
+ER_QUERY_INTERRUPTED = 1317
+ER_NO_SUCH_THREAD = 1094
 ER_UNKNOWN = 1105
 
 _SQLSTATE = {
     ER_DUP_ENTRY: "23000",
     ER_BAD_NULL_ERROR: "23000",
+    ER_QUERY_INTERRUPTED: "70100",
+    ER_NO_SUCH_THREAD: "HY000",
     ER_NO_SUCH_TABLE: "42S02",
     ER_BAD_DB_ERROR: "42000",
     ER_DB_CREATE_EXISTS: "HY000",
@@ -83,6 +87,8 @@ _PATTERNS = [
     (re.compile(r"parameter count|column count", re.I),
      ER_WRONG_VALUE_COUNT),
     (re.compile(r"cannot be null", re.I), ER_BAD_NULL_ERROR),
+    (re.compile(r"interrupted", re.I), ER_QUERY_INTERRUPTED),
+    (re.compile(r"Unknown thread id", re.I), ER_NO_SUCH_THREAD),
     (re.compile(r"incorrect value", re.I), ER_TRUNCATED_WRONG_VALUE),
 ]
 

@@ -62,13 +62,21 @@ _register_mesh_listener()
 
 
 class ExecContext:
-    """What executors need from the session: storage, the read ts, and the
-    active transaction (for writes and dirty reads)."""
+    """What executors need from the session: storage, the read ts, the
+    active transaction (for writes and dirty reads), and an interrupt
+    probe (KILL QUERY; ref: the Go ctx cancellation threaded through
+    executors)."""
 
-    def __init__(self, storage, read_ts: int, txn=None):
+    def __init__(self, storage, read_ts: int, txn=None,
+                 interrupted=None):
         self.storage = storage
         self.read_ts = read_ts
         self.txn = txn   # kv transaction or None (autocommit read)
+        self.interrupted = interrupted
+
+    def check_interrupt(self) -> None:
+        if self.interrupted is not None and self.interrupted():
+            raise ExecError("Query execution was interrupted")
 
 
 class Executor:
@@ -134,6 +142,7 @@ class TableReaderExec(Executor):
         req = CopRequest(tp=ReqType.DAG, ranges=self._ranges(), plan=cop,
                          start_ts=ctx.read_ts)
         for resp in ctx.storage.client().send(req):
+            ctx.check_interrupt()
             yield resp.chunk
 
     def chunks(self, ctx: ExecContext):
@@ -151,6 +160,7 @@ class TableReaderExec(Executor):
             return
         remaining = cop.limit
         for resp in ctx.storage.client().send(req):
+            ctx.check_interrupt()
             ch = resp.chunk
             if remaining is not None:
                 if remaining <= 0:
@@ -167,6 +177,7 @@ class TableReaderExec(Executor):
         cop = self.plan.cop
         actual = 0
         for resp in ctx.storage.client().send(req):
+            ctx.check_interrupt()
             actual += resp.chunk.num_rows
             yield resp.chunk
         col_id, dranges = cop.feedback

@@ -30,7 +30,7 @@ __all__ = [
     "UseStmt", "BeginStmt", "CommitStmt", "RollbackStmt",
     "SetStmt", "VarAssignment", "ShowStmt", "ExplainStmt", "AnalyzeStmt",
     "AdminStmt", "PrepareStmt", "ExecuteStmt", "DeallocateStmt",
-    "LoadDataStmt", "SplitTableStmt",
+    "LoadDataStmt", "SplitTableStmt", "KillStmt",
 ]
 
 
@@ -476,6 +476,14 @@ class LoadDataStmt(StmtNode):
     lines_terminated: str = "\n"
     ignore_lines: int = 0
     dup_mode: str = "error"                       # error / ignore / replace
+
+
+@dataclass
+class KillStmt(StmtNode):
+    """KILL [TIDB] [CONNECTION | QUERY] id (ref: ast/misc.go:341
+    KillStmt — query_only leaves the connection intact)."""
+    conn_id: int = 0
+    query_only: bool = False
 
 
 @dataclass

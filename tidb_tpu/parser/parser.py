@@ -135,10 +135,15 @@ class Parser:
 
     def statement(self) -> ast.StmtNode:
         t = self.peek()
-        if t.tp == TokenType.IDENT and t.val.upper() in ("LOAD", "SPLIT"):
+        if t.tp == TokenType.IDENT and \
+                t.val.upper() in ("LOAD", "SPLIT", "KILL"):
             # non-reserved statement heads (see lexer.NON_RESERVED)
-            return self.load_data() if t.val.upper() == "LOAD" \
-                else self.split_table()
+            head = t.val.upper()
+            if head == "LOAD":
+                return self.load_data()
+            if head == "SPLIT":
+                return self.split_table()
+            return self.kill_stmt()
         if t.tp != TokenType.KEYWORD and not (t.tp == TokenType.OP and
                                               t.val == "("):
             raise ParseError("expected statement", t)
@@ -314,6 +319,20 @@ class Parser:
                     break
             self.expect_op(")")
         return stmt
+
+    def kill_stmt(self) -> ast.KillStmt:
+        """KILL [TIDB] [CONNECTION | QUERY] <id>."""
+        self.expect_word("KILL")
+        self.try_word("TIDB")
+        query_only = False
+        if self.try_word("QUERY"):
+            query_only = True
+        else:
+            self.try_word("CONNECTION")
+        tok = self.next()
+        if tok.tp != TokenType.INT:
+            raise ParseError("KILL requires a connection id", tok)
+        return ast.KillStmt(conn_id=int(tok.val), query_only=query_only)
 
     def split_table(self) -> ast.SplitTableStmt:
         """SPLIT TABLE t AT (v)[,(v)...] | SPLIT TABLE t REGIONS n."""

@@ -192,6 +192,9 @@ class ClientConn:
             return   # malformed handshake (port scanner / non-MySQL peer)
         self.session = Session(self.server.storage, user=self.user,
                                host=self.peer_host)
+        # KILL CONNECTION unblocks this conn's read and ends the loop
+        # (ref: server.go:333 Kill -> cancel + close)
+        self.session.kill_hook = self.shutdown
         while True:
             self.pkt.reset_seq()
             try:

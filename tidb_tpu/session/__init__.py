@@ -341,6 +341,8 @@ class Session:
             self.created_at = time.time()
             self.current_sql: str | None = None  # for SHOW PROCESSLIST
             self._stmt_start = 0.0
+            self.killed = False           # KILL QUERY flag (cooperative)
+            self.kill_hook = None         # server sets: closes the conn
             if not internal:
                 _SESSIONS.add(self)
 
@@ -382,6 +384,7 @@ class Session:
                 trace.restore(token)
         self.current_sql = sql
         self._stmt_start = time.perf_counter()
+        self.killed = False   # a kill that landed while idle is a no-op
         kind = type(stmt).__name__.removesuffix("Stmt").lower()
         ev = perfschema.stmt_begin(self.session_id, sql)
         root = trace.begin("statement", type=kind)
@@ -419,6 +422,7 @@ class Session:
             perfschema.stmt_end(ev, root=root, rows=nrows, error=err)
             if trace_on:
                 trace.log_tree(root, sql)
+            self.killed = False
             if dur * 1000 >= slow_ms:
                 metrics.counter(metrics.SLOW_QUERIES)
                 slow_log.warning(
@@ -628,6 +632,8 @@ class Session:
             return self._exec_dml(stmt)
         if isinstance(stmt, ast.SplitTableStmt):
             return self._exec_split_table(stmt)
+        if isinstance(stmt, ast.KillStmt):
+            return self._exec_kill(stmt)
         if isinstance(stmt, (ast.CreateDatabaseStmt, ast.CreateTableStmt,
                              ast.CreateIndexStmt, ast.DropTableStmt,
                              ast.DropDatabaseStmt, ast.DropIndexStmt,
@@ -804,6 +810,8 @@ class Session:
         if isinstance(stmt, ast.SplitTableStmt):
             need("", "", Priv.SUPER, "SPLIT TABLE")
             return
+        if isinstance(stmt, ast.KillStmt):
+            return   # target resolved ONCE in _exec_kill (no TOCTOU)
         if isinstance(stmt, ast.LoadDataStmt) and not stmt.local:
             # server-side file read: gated like MySQL's global FILE priv
             # (SUPER here) so table INSERT alone can't read server files
@@ -986,12 +994,18 @@ class Session:
                     raise SQLError(str(e)) from None
             if cache_key is not None and _plan_cacheable(plan):
                 self.domain.plan_cache().put(cache_key, plan)
-        ctx = ExecContext(self.storage, self._read_ts(), self.txn)
+        ctx = ExecContext(self.storage, self._read_ts(), self.txn,
+                          interrupted=lambda: self.killed)
         exe = build_executor(plan)
         try:
             with trace.span("execute",
                             executor=type(exe).__name__):
-                chunks = list(exe.chunks(ctx))
+                chunks = []
+                for ch in exe.chunks(ctx):
+                    if self.killed:   # KILL QUERY: cooperative check
+                        raise SQLError(
+                            "Query execution was interrupted")
+                    chunks.append(ch)
         except ExecError as e:
             raise SQLError(str(e)) from None
         names = [c.name for c in plan.schema.cols]
@@ -1045,10 +1059,14 @@ class Session:
                              _ph.PhysDelete)):
             # schema validation scope: tables this txn WRITES
             self.txn.related_tables.add(plan.table.id)
-        ctx = ExecContext(self.storage, self.txn.start_ts, self.txn)
+        ctx = ExecContext(self.storage, self.txn.start_ts, self.txn,
+                          interrupted=lambda: self.killed)
         exe = build_executor(plan)
-        with trace.span("execute", executor=type(exe).__name__):
-            return exe.execute(ctx)
+        try:
+            with trace.span("execute", executor=type(exe).__name__):
+                return exe.execute(ctx)
+        except ExecError as e:
+            raise SQLError(str(e)) from None
 
     # -- LOAD DATA (ref: executor/write.go:1373 LoadDataExec) ----------------
 
@@ -1069,6 +1087,36 @@ class Session:
             rows = (convert_fields(info, col_names, fields)
                     for fields in parse_lines(read_text_chunks(f), stmt))
             return RowsInsertExec(info, rows, stmt.dup_mode).execute(ctx)
+
+    # -- KILL (ref: ast/misc.go:341 KillStmt; server.go:333 Kill) ------------
+
+    def _exec_kill(self, stmt: ast.KillStmt) -> None:
+        with _session_seq_lock:
+            live = list(_SESSIONS)
+        target = next((s for s in live
+                       if s.session_id == stmt.conn_id), None)
+        if target is None:
+            raise SQLError(f"Unknown thread id: {stmt.conn_id}")
+        # privilege check on the RESOLVED target (the pre-exec check
+        # would race a new connection claiming the id)
+        if target.user != self.user and not self.internal:
+            from tidb_tpu.privilege import Priv
+            ischema = self.domain.info_schema()
+            if ischema.has_db("mysql") and not \
+                    self.domain.priv_cache().request_verification(
+                        self.user, self.host, "", "", Priv.SUPER):
+                raise SQLError(
+                    f"KILL command denied to user "
+                    f"'{self.user}'@'{self.host}'")
+        target.killed = True
+        if not stmt.query_only:
+            hook = target.kill_hook
+            if hook is not None:
+                try:
+                    hook()            # server closes the connection
+                except Exception:     # noqa: BLE001
+                    pass
+        return None
 
     # -- SPLIT TABLE (ref: store/tikv/split_region.go:29; mocktikv
     # cluster.go:276 Split/SplitTable) ---------------------------------------
