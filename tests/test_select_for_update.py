@@ -1,0 +1,79 @@
+"""SELECT ... FOR UPDATE (ref: executor/executor.go:389 SelectLockExec;
+Txn.LockKeys): row keys lock in the txn, commit conflicts if another
+txn wrote them, and optimistic replay is disabled for such txns."""
+
+import pytest
+
+from tidb_tpu import kv
+from tidb_tpu.session import Session, SQLError
+from tidb_tpu.store.storage import new_mock_storage
+
+
+@pytest.fixture
+def env():
+    st = new_mock_storage()
+    a = Session(st)
+    a.execute("CREATE DATABASE d")
+    a.execute("USE d")
+    a.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, v BIGINT)")
+    a.execute("INSERT INTO t VALUES (1, 10), (2, 20), (3, 30)")
+    b = Session(st, db="d")
+    yield a, b
+    a.close()
+    b.close()
+
+
+class TestForUpdate:
+    def test_conflict_detected_no_silent_replay(self, env):
+        a, b = env
+        a.execute("BEGIN")
+        assert a.query("SELECT v FROM t WHERE id = 1 FOR UPDATE"
+                       ).rows == [(10,)]
+        b.execute("UPDATE t SET v = 99 WHERE id = 1")
+        a.execute("INSERT INTO t VALUES (9, 90)")
+        # a plain txn would replay its history; FOR UPDATE must NOT
+        with pytest.raises((SQLError, kv.KVError)):
+            a.execute("COMMIT")
+        # b's write survives, a's insert did not
+        assert b.query("SELECT v FROM t WHERE id = 1").rows == [(99,)]
+        assert b.query("SELECT COUNT(*) FROM t WHERE id = 9"
+                       ).rows == [(0,)]
+
+    def test_clean_commit_and_lock_only_txn(self, env):
+        a, b = env
+        a.execute("BEGIN")
+        a.query("SELECT v FROM t WHERE id = 2 FOR UPDATE")
+        a.execute("UPDATE t SET v = 21 WHERE id = 2")
+        a.execute("COMMIT")
+        assert b.query("SELECT v FROM t WHERE id = 2").rows == [(21,)]
+        # pure-lock txn: LOCK mutations commit without touching data
+        a.execute("BEGIN")
+        a.query("SELECT v FROM t WHERE id = 2 FOR UPDATE")
+        a.execute("COMMIT")
+        assert b.query("SELECT v FROM t WHERE id = 2").rows == [(21,)]
+
+    def test_unwritten_rows_not_locked(self, env):
+        a, b = env
+        a.execute("BEGIN")
+        a.query("SELECT v FROM t WHERE id = 1 FOR UPDATE")
+        b.execute("UPDATE t SET v = 111 WHERE id = 3")  # different row
+        a.execute("UPDATE t SET v = 11 WHERE id = 1")
+        a.execute("COMMIT")                              # no conflict
+        assert b.query("SELECT v FROM t ORDER BY id").rows == \
+            [(11,), (20,), (111,)]
+
+    def test_joins_refused_loudly(self, env):
+        """Silently taking no locks would break the FOR UPDATE promise
+        (the reference no-ops; we choose the honest error)."""
+        a, _b = env
+        a.execute("BEGIN")
+        with pytest.raises(SQLError, match="single-table"):
+            a.query("SELECT x.v FROM t x, t y WHERE x.id = y.id "
+                    "AND x.id = 1 FOR UPDATE")
+        a.execute("ROLLBACK")
+
+    def test_autocommit_for_update_without_txn(self, env):
+        a, _b = env
+        # outside a txn FOR UPDATE reads normally (nothing to hold)
+        assert a.query("SELECT v FROM t WHERE id = 1 FOR UPDATE"
+                       ).rows == [(10,)]

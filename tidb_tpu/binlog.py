@@ -74,10 +74,15 @@ class MemoryPump:
 
 
 def make_event(start_ts: int, commit_ts: int,
-               mutations: dict[bytes, Mutation]) -> BinlogEvent:
+               mutations: dict[bytes, Mutation]):
+    """-> BinlogEvent, or None when nothing changed (a FOR UPDATE txn's
+    LOCK mutations are concurrency control, not data changes — CDC
+    consumers must never see phantom rows for them)."""
     muts = tuple(sorted(
         (m.op.name, k, m.value if m.op == MutationOp.PUT else None)
-        for k, m in mutations.items()))
+        for k, m in mutations.items() if m.op != MutationOp.LOCK))
+    if not muts:
+        return None
     return BinlogEvent(start_ts=start_ts, commit_ts=commit_ts,
                        mutations=muts)
 

@@ -579,6 +579,11 @@ class Session:
             except kv.UndeterminedError:
                 raise
             except kv.RetryableError as first_err:
+                if getattr(txn, "for_update", False):
+                    # FOR UPDATE promised the read rows stayed put:
+                    # replaying silently would break that promise
+                    # (ref: session.go retry disabled when ForUpdate)
+                    raise
                 last = first_err
                 for _ in range(COMMIT_RETRY_LIMIT):
                     cspan.tags["retries"] = \
@@ -1008,6 +1013,11 @@ class Session:
                     chunks.append(ch)
         except ExecError as e:
             raise SQLError(str(e)) from None
+        if getattr(stmt, "for_update", False) and self.txn is not None:
+            try:
+                self._lock_rows_for_update(stmt)
+            except ExecError as e:
+                raise SQLError(str(e)) from None
         names = [c.name for c in plan.schema.cols]
         rows = []
         for ch in chunks:
@@ -1068,6 +1078,36 @@ class Session:
         except ExecError as e:
             raise SQLError(str(e)) from None
 
+    def _lock_rows_for_update(self, stmt) -> None:
+        """SELECT ... FOR UPDATE inside a txn: lock every row the scan
+        MATCHES (ref: executor/executor.go:389 SelectLockExec — keys
+        buffered in the txn, conflict-checked at commit). Locks the full
+        WHERE match even under LIMIT — stricter than the rows returned,
+        like InnoDB locking every scanned row — via a second scan of the
+        filter (the result plan may be an agg/projection with no
+        handles)."""
+        src = stmt.from_clause
+        if not isinstance(src, ast.TableSource):
+            # silently taking no locks would break the FOR UPDATE
+            # promise — refuse loudly (the reference no-ops when no
+            # handle exists; we choose the honest error)
+            raise SQLError(
+                "FOR UPDATE is only supported on single-table queries")
+        try:
+            info, reader = self._planner()._plan_writable_reader(
+                src, stmt.where)
+        except (PlanError, ResolveError) as e:
+            raise SQLError(str(e)) from None
+        self.txn.related_tables.add(info.id)
+        ctx = ExecContext(self.storage, self.txn.start_ts, self.txn,
+                          interrupted=lambda: self.killed)
+        exe = build_executor(reader)
+        for chunk in exe.chunks(ctx):
+            hc = chunk.columns[-1]
+            for i in range(chunk.num_rows):
+                self.txn.lock_key(tablecodec.record_key(
+                    info.id, int(hc.data[i])))
+
     # -- LOAD DATA (ref: executor/write.go:1373 LoadDataExec) ----------------
 
     def _load_data_in_txn(self, stmt: ast.LoadDataStmt) -> int:
@@ -1083,10 +1123,17 @@ class Session:
             raise SQLError(f"Can't get stat of '{stmt.path}': {e}") from None
         with f:
             self.txn.related_tables.add(info.id)
-            ctx = ExecContext(self.storage, self.txn.start_ts, self.txn)
-            rows = (convert_fields(info, col_names, fields)
-                    for fields in parse_lines(read_text_chunks(f), stmt))
-            return RowsInsertExec(info, rows, stmt.dup_mode).execute(ctx)
+            ctx = ExecContext(self.storage, self.txn.start_ts, self.txn,
+                              interrupted=lambda: self.killed)
+
+            def rows():
+                for i, fields in enumerate(
+                        parse_lines(read_text_chunks(f), stmt)):
+                    if i % 1024 == 0:
+                        ctx.check_interrupt()
+                    yield convert_fields(info, col_names, fields)
+
+            return RowsInsertExec(info, rows(), stmt.dup_mode).execute(ctx)
 
     # -- KILL (ref: ast/misc.go:341 KillStmt; server.go:333 Kill) ------------
 

@@ -440,6 +440,8 @@ class KVTxn(kv.Transaction):
         # SchemaLeaseChecker, kv/kv.go:38; checked at 2pc.go:653)
         self.schema_checker = None
         self.related_tables: set[int] = set()
+        self.lock_keys: set[bytes] = set()   # SELECT ... FOR UPDATE
+        self.for_update = False              # disables optimistic replay
 
     def get(self, key: bytes) -> Optional[bytes]:
         return self.us.get(key)
@@ -456,6 +458,13 @@ class KVTxn(kv.Transaction):
     def presume_not_exists(self, key: bytes) -> None:
         self.us.presumed_not_exists.add(key)
 
+    def lock_key(self, key: bytes) -> None:
+        """SELECT ... FOR UPDATE: buffer a prewrite-only LOCK on the row
+        key (ref: Txn.LockKeys, executor/executor.go:389 SelectLockExec).
+        Commit conflicts if another txn wrote the key after start_ts."""
+        self.lock_keys.add(key)
+        self.for_update = True
+
     def mutations(self) -> dict[bytes, Mutation]:
         """Walk the membuffer into 2PC mutations (ref: 2pc.go:118-158)."""
         muts: dict[bytes, Mutation] = {}
@@ -464,6 +473,9 @@ class KVTxn(kv.Transaction):
                 muts[k] = Mutation(MutationOp.DELETE, k)
             else:
                 muts[k] = Mutation(MutationOp.PUT, k, v)
+        for k in self.lock_keys:
+            if k not in muts:     # a real write supersedes the lock
+                muts[k] = Mutation(MutationOp.LOCK, k)
         return muts
 
     def commit(self) -> None:
@@ -489,8 +501,10 @@ class KVTxn(kv.Transaction):
                 # collapsed into one event here). Sinks never fail txns.
                 from tidb_tpu.binlog import make_event
                 try:
-                    pump.write(make_event(self.start_ts,
-                                          committer.commit_ts, muts))
+                    ev = make_event(self.start_ts, committer.commit_ts,
+                                    muts)
+                    if ev is not None:
+                        pump.write(ev)
                 except Exception:   # noqa: BLE001
                     pass
         finally:
