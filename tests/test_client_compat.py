@@ -1,0 +1,67 @@
+"""Client-preamble compatibility: the statements stock MySQL clients
+and drivers send on connect (ref: expression/builtin_info.go VERSION/
+USER/DATABASE/CONNECTION_ID; SET NAMES handling in the server)."""
+
+import pytest
+
+from tidb_tpu.session import Session, SQLError
+from tidb_tpu.store.storage import new_mock_storage
+
+
+@pytest.fixture
+def sess():
+    s = Session(new_mock_storage(), user="root", host="localhost")
+    yield s
+    s.close()
+
+
+class TestPreamble:
+    @pytest.mark.parametrize("q,want", [
+        ("SELECT @@version_comment", [("tidb-tpu",)]),
+        ("SELECT @@autocommit", [(1,)]),
+        ("SELECT @@session.autocommit", [(1,)]),
+        ("SELECT @@max_allowed_packet", [(67108864,)]),
+        ("SELECT VERSION()", [("8.0.11-tidb-tpu-1.0",)]),
+        ("SELECT USER()", [("root@localhost",)]),
+        ("SELECT CURRENT_USER()", [("root@localhost",)]),
+        ("SELECT DATABASE()", [(None,)]),
+    ])
+    def test_select_forms(self, sess, q, want):
+        assert sess.query(q).rows == want
+
+    def test_set_names_and_charset(self, sess):
+        sess.execute("SET NAMES utf8mb4")
+        sess.execute("SET NAMES utf8 COLLATE utf8_bin")
+        sess.execute("SET CHARACTER SET latin1")
+        rows = dict(sess.query(
+            "SHOW VARIABLES LIKE 'character_set_client'").rows)
+        assert rows["character_set_client"] == "latin1"
+
+    def test_connection_id_and_database_follow_session(self, sess):
+        assert sess.query("SELECT CONNECTION_ID()").rows == \
+            [(sess.session_id,)]
+        sess.execute("CREATE DATABASE d")
+        sess.execute("USE d")
+        assert sess.query("SELECT DATABASE()").rows == [("d",)]
+
+    def test_user_vars_in_expressions(self, sess):
+        sess.execute("SET @x = 41")
+        assert sess.query("SELECT @x + 1").rows == [(42,)]
+        assert sess.query("SELECT @undefined").rows == [(None,)]
+
+    def test_sysvar_in_where(self, sess):
+        sess.execute("CREATE DATABASE d")
+        sess.execute("USE d")
+        sess.execute("CREATE TABLE t (id BIGINT PRIMARY KEY)")
+        sess.execute("INSERT INTO t VALUES (1), (2)")
+        assert sess.query("SELECT id FROM t WHERE id = @@autocommit"
+                          ).rows == [(1,)]
+
+    def test_unknown_sysvar_errors(self, sess):
+        with pytest.raises(SQLError, match="Unknown system variable"):
+            sess.query("SELECT @@no_such_var")
+
+    def test_session_scoped_value_reflected(self, sess):
+        sess.execute("SET @@tidb_tpu_cop_concurrency = 4")
+        assert sess.query("SELECT @@tidb_tpu_cop_concurrency"
+                          ).rows == [(4,)]

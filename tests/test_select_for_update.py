@@ -72,6 +72,28 @@ class TestForUpdate:
                     "AND x.id = 1 FOR UPDATE")
         a.execute("ROLLBACK")
 
+    def test_nested_for_update_refused(self, env):
+        a, _b = env
+        a.execute("BEGIN")
+        with pytest.raises(SQLError, match="single-table"):
+            a.query("SELECT v FROM t UNION "
+                    "SELECT v FROM t FOR UPDATE")
+        with pytest.raises(SQLError, match="single-table"):
+            a.query("SELECT * FROM (SELECT v FROM t FOR UPDATE) x")
+        assert a.query("SELECT 1 FOR UPDATE").rows == [(1,)]  # no-op
+        a.execute("ROLLBACK")
+
+    def test_autocommit_off_starts_txn(self, env):
+        a, _b = env
+        a.execute("SET @@autocommit = 0")
+        try:
+            assert a.txn is None
+            a.query("SELECT v FROM t WHERE id = 1 FOR UPDATE")
+            assert a.txn is not None and a.txn.lock_keys
+            a.execute("ROLLBACK")
+        finally:
+            a.execute("SET @@autocommit = 1")
+
     def test_autocommit_for_update_without_txn(self, env):
         a, _b = env
         # outside a txn FOR UPDATE reads normally (nothing to hold)

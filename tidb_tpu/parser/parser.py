@@ -1012,6 +1012,30 @@ class Parser:
     def set_stmt(self) -> ast.SetStmt:
         self.expect_kw("SET")
         stmt = ast.SetStmt()
+        # client-preamble forms: SET NAMES cs [COLLATE c] / SET CHARACTER
+        # SET cs — recorded as plain session sysvars
+        if self.peek().tp == TokenType.IDENT and \
+                self.peek().val.upper() == "NAMES":
+            self.next()
+            cs = self.ident() if self.peek().tp != TokenType.STRING \
+                else self.next().val
+            if self.try_kw("COLLATE"):
+                self.ident()
+            for n in ("character_set_client", "character_set_results",
+                      "character_set_connection"):
+                stmt.assignments.append(ast.VarAssignment(
+                    name=n, is_system=True, value=ast.Literal(cs)))
+            return stmt
+        if self.peek().tp in (TokenType.IDENT, TokenType.KEYWORD) and \
+                self.peek().val.upper() == "CHARACTER":
+            self.next()
+            self.expect_kw("SET")
+            cs = self.ident() if self.peek().tp != TokenType.STRING \
+                else self.next().val
+            stmt.assignments.append(ast.VarAssignment(
+                name="character_set_client", is_system=True,
+                value=ast.Literal(cs)))
+            return stmt
         while True:
             va = ast.VarAssignment(name="")
             if self.try_kw("GLOBAL"):

@@ -621,7 +621,9 @@ class Session:
                              ast.GrantStmt, ast.RevokeStmt)):
             return self._exec_account(stmt)
         if isinstance(stmt, (ast.SelectStmt, ast.UnionStmt)):
-            return self._exec_query(stmt, sql_text=sql_text)
+            stmt, folded = self._fold_session_exprs(stmt)
+            return self._exec_query(
+                stmt, sql_text=None if folded else sql_text)
         if isinstance(stmt, ast.PrepareStmt):
             self.prepare(stmt.sql, name=stmt.name)
             return None
@@ -634,6 +636,7 @@ class Session:
             return None
         if isinstance(stmt, (ast.InsertStmt, ast.UpdateStmt,
                              ast.DeleteStmt, ast.LoadDataStmt)):
+            stmt, _ = self._fold_session_exprs(stmt)
             return self._exec_dml(stmt)
         if isinstance(stmt, ast.SplitTableStmt):
             return self._exec_split_table(stmt)
@@ -981,6 +984,11 @@ class Session:
 
     def _exec_query(self, stmt, sql_text: str | None = None) -> ResultSet:
         from tidb_tpu import trace
+        if getattr(stmt, "for_update", False) and self.txn is None and \
+                not self.autocommit:
+            # autocommit=0: the SELECT starts the transaction, so the
+            # locks actually hold until COMMIT (MySQL semantics)
+            self._begin_txn()
         plan = None
         cache_key = None
         if sql_text is not None and isinstance(stmt, (ast.SelectStmt,
@@ -1018,6 +1026,7 @@ class Session:
                 self._lock_rows_for_update(stmt)
             except ExecError as e:
                 raise SQLError(str(e)) from None
+        self._check_nested_for_update(stmt)
         names = [c.name for c in plan.schema.cols]
         rows = []
         for ch in chunks:
@@ -1078,6 +1087,113 @@ class Session:
         except ExecError as e:
             raise SQLError(str(e)) from None
 
+    # session-context expressions (ref: expression/builtin_info.go
+    # VERSION/USER/DATABASE/CONNECTION_ID; sessionctx sysvar reads) ----------
+
+    _SESSION_FUNCS = ("VERSION", "USER", "SESSION_USER", "SYSTEM_USER",
+                      "CURRENT_USER", "CONNECTION_ID", "DATABASE",
+                      "SCHEMA")
+    _CLIENT_SYSVAR_DEFAULTS = {
+        "version_comment": "tidb-tpu",
+        "character_set_client": "utf8mb4",
+        "character_set_results": "utf8mb4",
+        "character_set_connection": "utf8mb4",
+        "collation_connection": "utf8mb4_bin",
+        "collation_server": "utf8mb4_bin",
+        "max_allowed_packet": 67108864,
+        "wait_timeout": 28800,
+        "interactive_timeout": 28800,
+        "lower_case_table_names": 1,
+        "time_zone": "SYSTEM",
+        "tx_isolation": "REPEATABLE-READ",
+        "transaction_isolation": "REPEATABLE-READ",
+    }
+
+    def _session_expr_value(self, e):
+        """-> (handled, value) for @@vars / @vars / session funcs."""
+        from tidb_tpu import config
+        if isinstance(e, ast.VariableExpr):
+            if not e.is_system:
+                return True, self.vars.get(
+                    "@" + e.name.lstrip("@").lower())
+            name = e.name.lower()
+            if name in self.sys_vars and not e.is_global:
+                return True, self.sys_vars[name]
+            if config.is_known(name):
+                return True, config.get_var(name)
+            if name == "version":
+                from tidb_tpu.server import SERVER_VERSION
+                return True, SERVER_VERSION
+            if name in self._CLIENT_SYSVAR_DEFAULTS:
+                return True, self._CLIENT_SYSVAR_DEFAULTS[name]
+            raise SQLError(f"Unknown system variable '{e.name}'")
+        if isinstance(e, ast.FuncCall) and \
+                e.name.upper() in self._SESSION_FUNCS and not e.args:
+            n = e.name.upper()
+            if n == "VERSION":
+                from tidb_tpu.server import SERVER_VERSION
+                return True, SERVER_VERSION
+            if n in ("USER", "SESSION_USER", "SYSTEM_USER",
+                     "CURRENT_USER"):
+                return True, f"{self.user}@{self.host}"
+            if n == "CONNECTION_ID":
+                return True, self.session_id
+            return True, self.current_db or None   # DATABASE/SCHEMA
+        return False, None
+
+    def _fold_session_exprs(self, node):
+        """Rebuild the AST with session-context expressions folded to
+        literals (persistent: shared prepared-statement trees are never
+        mutated). -> (node, changed)."""
+        import dataclasses
+        changed = False
+
+        def walk(x):
+            nonlocal changed
+            if isinstance(x, ast.ExprNode):
+                handled, val = self._session_expr_value(x)
+                if handled:
+                    changed = True
+                    return ast.Literal(val)
+            if dataclasses.is_dataclass(x) and isinstance(x, ast.Node):
+                updates = {}
+                for f in dataclasses.fields(x):
+                    v = getattr(x, f.name)
+                    nv = walk(v)
+                    if nv is not v:
+                        updates[f.name] = nv
+                return dataclasses.replace(x, **updates) if updates else x
+            if isinstance(x, list):
+                out = [walk(v) for v in x]
+                return out if any(a is not b for a, b in zip(out, x)) \
+                    else x
+            if isinstance(x, tuple):
+                out = tuple(walk(v) for v in x)
+                return out if any(a is not b for a, b in zip(out, x)) \
+                    else x
+            return x
+
+        return walk(node), changed
+
+    def _check_nested_for_update(self, stmt) -> None:
+        """FOR UPDATE buried in a UNION branch, derived table or
+        subquery would silently take no locks — refuse loudly."""
+        import dataclasses
+
+        def walk(x, top):
+            if isinstance(x, ast.SelectStmt) and not top and \
+                    x.for_update:
+                raise SQLError("FOR UPDATE is only supported on "
+                               "single-table queries")
+            if dataclasses.is_dataclass(x) and isinstance(x, ast.Node):
+                for f in dataclasses.fields(x):
+                    walk(getattr(x, f.name), False)
+            elif isinstance(x, (list, tuple)):
+                for v in x:
+                    walk(v, False)
+
+        walk(stmt, isinstance(stmt, ast.SelectStmt))
+
     def _lock_rows_for_update(self, stmt) -> None:
         """SELECT ... FOR UPDATE inside a txn: lock every row the scan
         MATCHES (ref: executor/executor.go:389 SelectLockExec — keys
@@ -1087,6 +1203,8 @@ class Session:
         filter (the result plan may be an agg/projection with no
         handles)."""
         src = stmt.from_clause
+        if src is None:
+            return                # SELECT 1 FOR UPDATE: nothing to lock
         if not isinstance(src, ast.TableSource):
             # silently taking no locks would break the FOR UPDATE
             # promise — refuse loudly (the reference no-ops when no
