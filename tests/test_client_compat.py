@@ -65,3 +65,36 @@ class TestPreamble:
         sess.execute("SET @@tidb_tpu_cop_concurrency = 4")
         assert sess.query("SELECT @@tidb_tpu_cop_concurrency"
                           ).rows == [(4,)]
+
+
+class TestDoFlush:
+    def test_do_evaluates_and_discards(self, sess):
+        assert sess.execute("DO 1 + 1, SQRT(4)") == [None]
+        with pytest.raises(SQLError):
+            sess.execute("DO NO_SUCH_FN(1)")
+
+    def test_flush(self, sess):
+        assert sess.execute("FLUSH PRIVILEGES; FLUSH STATUS; "
+                            "FLUSH TABLES") == [None, None, None]
+        with pytest.raises(SQLError, match="unsupported FLUSH"):
+            sess.execute("FLUSH LOGS")
+
+    def test_flush_privileges_reloads_grants(self):
+        from tidb_tpu.bootstrap import bootstrap
+        from tidb_tpu.privilege import Priv
+        st = new_mock_storage()
+        bootstrap(st)
+        r = Session(st, user="root", host="%")
+        r.execute("CREATE USER fp IDENTIFIED BY 'x'")
+        r.execute("CREATE DATABASE d")
+        r.execute("CREATE TABLE d.t (id BIGINT PRIMARY KEY)")
+        u = Session(st, user="fp", host="%")
+        with pytest.raises(SQLError):
+            u.query("SELECT * FROM d.t")
+        # out-of-band grant-table edit: visible after FLUSH PRIVILEGES
+        r.execute("INSERT INTO mysql.tables_priv VALUES "
+                  f"('%', 'fp', 'd', 't', {Priv.SELECT})")
+        r.execute("FLUSH PRIVILEGES")
+        assert u.query("SELECT * FROM d.t").rows == []
+        u.close()
+        r.close()

@@ -30,7 +30,7 @@ __all__ = [
     "UseStmt", "BeginStmt", "CommitStmt", "RollbackStmt",
     "SetStmt", "VarAssignment", "ShowStmt", "ExplainStmt", "AnalyzeStmt",
     "AdminStmt", "PrepareStmt", "ExecuteStmt", "DeallocateStmt",
-    "LoadDataStmt", "SplitTableStmt", "KillStmt",
+    "LoadDataStmt", "SplitTableStmt", "KillStmt", "DoStmt", "FlushStmt",
 ]
 
 
@@ -476,6 +476,20 @@ class LoadDataStmt(StmtNode):
     lines_terminated: str = "\n"
     ignore_lines: int = 0
     dup_mode: str = "error"                       # error / ignore / replace
+
+
+@dataclass
+class DoStmt(StmtNode):
+    """DO expr[, ...]: evaluate and discard (ref: ast/misc.go DoStmt;
+    executor/simple.go)."""
+    exprs: list = field(default_factory=list)
+
+
+@dataclass
+class FlushStmt(StmtNode):
+    """FLUSH PRIVILEGES|STATUS|TABLES (ref: ast/misc.go FlushStmt;
+    executor/simple.go:311 executeFlush)."""
+    tp: str = ""
 
 
 @dataclass

@@ -136,14 +136,25 @@ class Parser:
     def statement(self) -> ast.StmtNode:
         t = self.peek()
         if t.tp == TokenType.IDENT and \
-                t.val.upper() in ("LOAD", "SPLIT", "KILL"):
+                t.val.upper() in ("LOAD", "SPLIT", "KILL", "DO",
+                                  "FLUSH"):
             # non-reserved statement heads (see lexer.NON_RESERVED)
             head = t.val.upper()
             if head == "LOAD":
                 return self.load_data()
             if head == "SPLIT":
                 return self.split_table()
-            return self.kill_stmt()
+            if head == "KILL":
+                return self.kill_stmt()
+            if head == "DO":
+                self.next()
+                exprs = [self.expr()]
+                while self.try_op(","):
+                    exprs.append(self.expr())
+                return ast.DoStmt(exprs=exprs)
+            self.next()                      # FLUSH
+            kind = self.ident().lower()
+            return ast.FlushStmt(tp=kind)
         if t.tp != TokenType.KEYWORD and not (t.tp == TokenType.OP and
                                               t.val == "("):
             raise ParseError("expected statement", t)

@@ -642,6 +642,27 @@ class Session:
             return self._exec_split_table(stmt)
         if isinstance(stmt, ast.KillStmt):
             return self._exec_kill(stmt)
+        if isinstance(stmt, ast.DoStmt):
+            # evaluate for side effects/errors, discard results (ref:
+            # executor/simple.go DoStmt)
+            from tidb_tpu.plan.resolver import PlanSchema, Resolver
+            import numpy as _np
+            r = Resolver(PlanSchema([]))
+            for e in stmt.exprs:
+                try:
+                    expr = r.resolve(e)
+                    expr.eval_xp(_np, [], 1)
+                except (ResolveError, PlanError) as err:
+                    raise SQLError(str(err)) from None
+            return None
+        if isinstance(stmt, ast.FlushStmt):
+            if stmt.tp == "privileges":
+                # re-read the grant tables (ref: executeFlush ->
+                # LoadPrivilegeLoop notify)
+                self.domain.priv_cache().invalidate()
+            elif stmt.tp not in ("status", "tables"):
+                raise SQLError(f"unsupported FLUSH {stmt.tp}")
+            return None
         if isinstance(stmt, (ast.CreateDatabaseStmt, ast.CreateTableStmt,
                              ast.CreateIndexStmt, ast.DropTableStmt,
                              ast.DropDatabaseStmt, ast.DropIndexStmt,
