@@ -775,6 +775,16 @@ class Parser:
                 d.comment = self.next().val
             elif self.try_kw("COLLATE") or self.try_kw("CHARSET"):
                 self.next()
+            elif self.try_kw("REFERENCES"):
+                # inline column REFERENCES: parsed and IGNORED, exactly
+                # as MySQL does (only table-level FOREIGN KEY creates
+                # the constraint)
+                self.table_name()
+                if self.try_op("("):
+                    self.ident()
+                    while self.try_op(","):
+                        self.ident()
+                    self.expect_op(")")
             else:
                 break
         d.ft = ft.with_flags(flags)
