@@ -239,3 +239,36 @@ class TestHostOps:
         # empty-mask path keeps lane shapes merge-compatible
         empty = host_hash_agg(ch, None, groups, aggs)
         assert empty is not None
+
+
+class TestFilterMemo:
+    """Filtered cop results memoize on the cached raw chunk: hot scans
+    return identical chunk objects so device memos keep hitting."""
+
+    def test_hot_filtered_scan_returns_same_objects(self, sess):
+        import numpy as np
+        sess.execute("CREATE TABLE fm (a BIGINT PRIMARY KEY, b BIGINT)")
+        bulkload.bulk_load(
+            sess.storage, _table(sess, "fm"),
+            {"a": np.arange(5000), "b": np.arange(5000) % 9})
+        # plain filter scan (aggregation pushdowns intentionally stay
+        # un-memoized so host/device modes both really compute)
+        q = "SELECT a FROM fm WHERE b < 4 ORDER BY a LIMIT 5"
+        assert sess.query(q).rows == sess.query(q).rows
+        memos = 0
+        for ent in sess.storage.chunk_cache._entries.values():
+            memo = getattr(ent[2], "_cop_filter_memo", None)
+            if memo:
+                memos += len(memo)
+        assert memos >= 1
+
+    def test_correlated_filters_never_memoize(self, sess):
+        sess.execute("CREATE TABLE c1 (a BIGINT PRIMARY KEY)")
+        sess.execute("CREATE TABLE c2 (b BIGINT PRIMARY KEY, "
+                     "name VARCHAR(8))")
+        sess.execute("INSERT INTO c1 VALUES (1), (5), (9)")
+        sess.execute("INSERT INTO c2 VALUES (3,'x'), (7,'y')")
+        q = ("SELECT a FROM c1 WHERE EXISTS (SELECT 1 FROM c2 "
+             "WHERE c2.b > c1.a AND c2.name LIKE '%') ORDER BY a")
+        assert sess.query(q).rows == [(1,), (5,)]
+        assert sess.query(q).rows == [(1,), (5,)]   # hot: not frozen

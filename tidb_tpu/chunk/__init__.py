@@ -112,7 +112,15 @@ class Chunk:
 
     # _dev_cache: memoized device-resident columns (ops/runtime.py
     # device_put_chunk) — chunks are treated as immutable once built
-    __slots__ = ("columns", "_dev_cache")
+    __slots__ = ("columns", "_dev_cache", "_cop_filter_memo")
+
+    def __getstate__(self):
+        # device memos and filter memos are process-local accelerators;
+        # they must never ride a pickle across the storage RPC
+        return {"columns": self.columns}
+
+    def __setstate__(self, state):
+        self.columns = state["columns"]
 
     def __init__(self, columns: Sequence[Column]):
         self.columns = list(columns)

@@ -776,15 +776,36 @@ class Parser:
             elif self.try_kw("COLLATE") or self.try_kw("CHARSET"):
                 self.next()
             elif self.try_kw("REFERENCES"):
-                # inline column REFERENCES: parsed and IGNORED, exactly
-                # as MySQL does (only table-level FOREIGN KEY creates
-                # the constraint)
+                # inline column REFERENCES (incl. MATCH / ON DELETE /
+                # ON UPDATE): parsed and IGNORED, exactly as MySQL does
+                # (only table-level FOREIGN KEY creates the constraint)
                 self.table_name()
-                if self.try_op("("):
-                    self.ident()
-                    while self.try_op(","):
+                if self.peek().tp == TokenType.OP and \
+                        self.peek().val == "(":
+                    self._paren_idents()
+                while True:
+                    if self.peek().tp == TokenType.IDENT and \
+                            self.peek().val.upper() == "MATCH":
+                        self.next()
                         self.ident()
-                    self.expect_op(")")
+                    elif self.try_kw("ON"):
+                        if not (self.try_kw("DELETE") or
+                                self.try_kw("UPDATE")):
+                            raise ParseError("expected DELETE or UPDATE",
+                                             self.peek())
+                        if not (self.try_kw("SET") and
+                                self.try_kw("NULL")):
+                            if self.peek().val.upper() in (
+                                    "CASCADE", "RESTRICT"):
+                                self.next()
+                            elif self.try_kw("NOT"):
+                                self.ident()   # NO ACTION spelled oddly
+                            else:
+                                self.ident()   # NO / ACTION words
+                                if self.peek().val.upper() == "ACTION":
+                                    self.next()
+                    else:
+                        break
             else:
                 break
         d.ft = ft.with_flags(flags)

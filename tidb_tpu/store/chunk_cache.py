@@ -100,6 +100,19 @@ class ChunkCache:
                 _k, (_v, _t, _ch, sz) = self._entries.popitem(last=False)
                 self._bytes -= sz
 
+    def add_cost(self, key, extra: int) -> None:
+        """Charge derived data (e.g. memoized filter results riding the
+        cached chunk) to the entry's budget share."""
+        with self._mu:
+            ent = self._entries.get(key)
+            if ent is None:
+                return
+            self._entries[key] = (ent[0], ent[1], ent[2], ent[3] + extra)
+            self._bytes += extra
+            while self._bytes > self.max_bytes and self._entries:
+                _k, (_v, _t, _ch, sz) = self._entries.popitem(last=False)
+                self._bytes -= sz
+
     def clear(self) -> None:
         with self._mu:
             self._entries.clear()
