@@ -316,3 +316,31 @@ class TestDecimalPrecisionGuards:
         tk.execute("INSERT INTO dg VALUES (1, 123456.78)")
         assert str(tk.query("SELECT amt FROM dg").rows[0][0]) == \
             "123456.78"
+
+
+class TestDMLOrderLimit:
+    """UPDATE/DELETE ... ORDER BY ... LIMIT n restrict the write scope
+    (silently ignoring them deleted every match — the original bug)."""
+
+    def test_delete_order_limit(self, tk):
+        tk.execute("CREATE TABLE dl (id BIGINT PRIMARY KEY, v BIGINT)")
+        tk.execute("INSERT INTO dl VALUES (1,1),(2,2),(3,3),(4,4)")
+        [n] = tk.execute("DELETE FROM dl ORDER BY id DESC LIMIT 1")
+        assert n == 1
+        assert tk.query("SELECT id FROM dl ORDER BY id").rows == \
+            [(1,), (2,), (3,)]
+
+    def test_update_order_limit(self, tk):
+        tk.execute("CREATE TABLE ul (id BIGINT PRIMARY KEY, v BIGINT)")
+        tk.execute("INSERT INTO ul VALUES (1,1),(2,2),(3,3)")
+        [n] = tk.execute("UPDATE ul SET v = 0 ORDER BY id LIMIT 2")
+        assert n == 2
+        assert tk.query("SELECT v FROM ul ORDER BY id").rows == \
+            [(0,), (0,), (3,)]
+
+    def test_plain_limit_without_order(self, tk):
+        tk.execute("CREATE TABLE pl (id BIGINT PRIMARY KEY)")
+        tk.execute("INSERT INTO pl VALUES (1),(2),(3)")
+        [n] = tk.execute("DELETE FROM pl LIMIT 2")
+        assert n == 2
+        assert tk.query("SELECT COUNT(*) FROM pl").rows == [(1,)]

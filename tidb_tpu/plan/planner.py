@@ -1402,10 +1402,28 @@ class Planner:
                 plan = self._assign_cond(plan, r.resolve(c_ast), True)
         return info, plan
 
+    def _order_limit_reader(self, reader, order_by, limit):
+        """UPDATE/DELETE ... [ORDER BY ...] [LIMIT n]: restrict the
+        writable reader to the ordered first-n rows (MySQL semantics —
+        ignoring these silently would write/delete EVERY match)."""
+        if not order_by and limit is None:
+            return reader
+        if order_by:
+            r = Resolver(reader.schema)
+            by = [(r.resolve(item.expr), item.desc) for item in order_by]
+            reader = ph.PhysSort(schema=reader.schema, children=[reader],
+                                 by=by)
+        if limit is not None:
+            reader = ph.PhysLimit(schema=reader.schema, children=[reader],
+                                  count=limit)
+        return reader
+
     def plan_update(self, stmt: ast.UpdateStmt) -> ph.PhysUpdate:
         if not isinstance(stmt.table, ast.TableSource):
             raise PlanError("multi-table UPDATE not supported")
         info, reader = self._plan_writable_reader(stmt.table, stmt.where)
+        reader = self._order_limit_reader(reader, stmt.order_by,
+                                          stmt.limit)
         assigns = []
         r = Resolver(reader.schema)
         for a in stmt.assignments:
@@ -1416,6 +1434,8 @@ class Planner:
 
     def plan_delete(self, stmt: ast.DeleteStmt) -> ph.PhysDelete:
         info, reader = self._plan_writable_reader(stmt.table, stmt.where)
+        reader = self._order_limit_reader(reader, stmt.order_by,
+                                          stmt.limit)
         return ph.PhysDelete(table=info, reader=reader)
 
 
