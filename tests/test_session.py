@@ -344,3 +344,15 @@ class TestDMLOrderLimit:
         [n] = tk.execute("DELETE FROM pl LIMIT 2")
         assert n == 2
         assert tk.query("SELECT COUNT(*) FROM pl").rows == [(1,)]
+
+
+class TestHavingAlias:
+    def test_having_references_select_aliases(self, tk):
+        tk.execute("CREATE TABLE ha (id BIGINT PRIMARY KEY, v BIGINT, "
+                   "g BIGINT)")
+        tk.execute("INSERT INTO ha VALUES (1,10,1),(2,20,1),"
+                   "(3,30,2),(4,40,2)")
+        assert tk.query("SELECT g, SUM(v) s FROM ha GROUP BY g "
+                        "HAVING s > 40 ORDER BY g").rows == [(2, 70)]
+        assert tk.query("SELECT g, SUM(v) s FROM ha GROUP BY g "
+                        "HAVING s > 20 AND g < 2").rows == [(1, 30)]

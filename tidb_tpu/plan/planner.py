@@ -1282,7 +1282,8 @@ class Planner:
         # 3. having
         having_expr = None
         if stmt.having is not None:
-            having_expr = resolver.resolve_over_agg(stmt.having)
+            having_expr = resolver.resolve_over_agg(
+                self._substitute_aliases(stmt.having, stmt))
         # 4. order by may reference aggs too — resolve now, carry through
         order_keys = []
         if stmt.order_by:
@@ -1313,6 +1314,37 @@ class Planner:
         out_schema = PlanSchema([SchemaCol(n, "", e.ft)
                                  for n, e in zip(proj_names, proj_exprs)])
         return out, out_schema, proj_exprs, proj_names, order_keys
+
+    def _substitute_aliases(self, e, stmt: ast.SelectStmt):
+        """Replace select-list aliases ANYWHERE inside an expression
+        (HAVING may combine aliases with other predicates, e.g.
+        HAVING s > 40 AND g < 5 — MySQL resolves those against the
+        select list)."""
+        import dataclasses
+        if isinstance(e, ast.ColName) and not e.table:
+            for f in stmt.fields:
+                if f.alias and f.alias.lower() == e.name.lower():
+                    return f.expr
+            return e
+        if dataclasses.is_dataclass(e) and isinstance(e, ast.ExprNode) \
+                and not isinstance(e, (ast.SubqueryExpr,
+                                       ast.ExistsSubquery)):
+            updates = {}
+            for fld in dataclasses.fields(e):
+                v = getattr(e, fld.name)
+                if isinstance(v, ast.ExprNode):
+                    nv = self._substitute_aliases(v, stmt)
+                    if nv is not v:
+                        updates[fld.name] = nv
+                elif isinstance(v, list):
+                    nl = [self._substitute_aliases(x, stmt)
+                          if isinstance(x, ast.ExprNode) else x
+                          for x in v]
+                    if any(a is not b for a, b in zip(nl, v)):
+                        updates[fld.name] = nl
+            if updates:
+                return dataclasses.replace(e, **updates)
+        return e
 
     def _maybe_alias_target(self, e: ast.ExprNode, stmt: ast.SelectStmt):
         """GROUP BY / ORDER BY may name a select alias or 1-based position."""
