@@ -266,3 +266,84 @@ class TestGroupConcat:
         assert gsess.query("SELECT g, GROUP_CONCAT(name) FROM t "
                            "GROUP BY g ORDER BY g").rows == \
             [(1, "a,b"), (2, "c")]
+
+
+class TestBitOps:
+    """Bitwise operators (ref: expression/builtin_op.go bitAndSig etc.).
+    MySQL's domain is BIGINT UNSIGNED; ours is the same 64 bits viewed
+    signed (docs/DEVIATIONS.md) — identical for &,|,^,<< and for >> of
+    non-negative values."""
+
+    def test_basic(self, sess):
+        assert sess.query(
+            "SELECT 5 & 3, 5 | 3, 5 ^ 3, 1 << 3, 16 >> 2").rows == \
+            [(1, 7, 6, 8, 4)]
+
+    def test_neg_and_precedence(self, sess):
+        assert sess.query("SELECT ~5, ~~7").rows == [(-6, 7)]
+        # ^ binds tighter than *; | tighter than = (MySQL ladder)
+        assert sess.query("SELECT 3 ^ 1 * 2").rows == [(4,)]
+        assert sess.query("SELECT 2 | 1 = 3").rows == [(1,)]
+
+    def test_shift_out_of_range_and_logical_shr(self, sess):
+        assert sess.query(
+            "SELECT 1 << 64, 1 << 100, 8 >> 64, 5 << -1").rows == \
+            [(0, 0, 0, 0)]
+        # >> is a logical shift on the 64-bit word, not arithmetic
+        assert sess.query("SELECT -8 >> 1").rows == \
+            [(9223372036854775804,)]
+
+    def test_rounds_fractional_operands(self, sess):
+        assert sess.query("SELECT 1.6 & 3, 2.4 | 0").rows == [(2, 2)]
+
+    def test_null_propagates(self, sess):
+        assert sess.query("SELECT NULL & 1, 1 << NULL, ~NULL").rows == \
+            [(None, None, None)]
+
+    def test_on_columns_both_paths(self, sess):
+        try:
+            for dev in (1, 0):
+                sess.execute(f"SET @@tidb_tpu_device = {dev}")
+                assert sess.query(
+                    "SELECT x FROM t WHERE CAST(x AS SIGNED) & 2 = 2 "
+                    "ORDER BY id").rows == [(2.0,), (-9.5,)]
+        finally:
+            sess.execute("SET @@tidb_tpu_device = 1")
+
+    def test_huge_string_operand_clamps(self, sess):
+        # float('1e300') overflows int64: clamp, don't crash
+        assert sess.query("SELECT '1e300' & 1").rows == [(1,)]
+        assert sess.query("SELECT CAST('1e300' AS SIGNED)").rows == \
+            [(9223372036854775807,)]
+
+    def test_huge_double_saturates_not_wraps(self, sess):
+        """float(2^63) cast straight to int64 wraps to INT64_MIN; the
+        vectorized path must saturate like the string path does."""
+        try:
+            for dev in (1, 0):
+                sess.execute(f"SET @@tidb_tpu_device = {dev}")
+                assert sess.query(
+                    "SELECT CAST(1e300 AS SIGNED), 1e300 & 1, "
+                    "CAST(-1e300 AS SIGNED), CAST(9.3e18 AS SIGNED)"
+                ).rows == [(9223372036854775807, 1,
+                            -9223372036854775808, 9223372036854775807)]
+        finally:
+            sess.execute("SET @@tidb_tpu_device = 1")
+
+
+class TestCastRounding:
+    def test_cast_int_rounds_half_away(self, sess):
+        assert sess.query(
+            "SELECT CAST(3.7 AS SIGNED), CAST(-3.7 AS SIGNED), "
+            "CAST(2.5 AS SIGNED), CAST(-2.5 AS SIGNED), "
+            "CAST(3.4 AS SIGNED)").rows == [(4, -4, 3, -3, 3)]
+
+    def test_cast_string_rounds(self, sess):
+        assert sess.query("SELECT CAST('3.7' AS SIGNED)").rows == [(4,)]
+
+    def test_no_double_round_at_boundary(self, sess):
+        # 0.49999999999999994 + 0.5 is exactly 1.0 in IEEE double; a
+        # floor(x+0.5) implementation would wrongly yield 1
+        assert sess.query(
+            "SELECT CAST(0.49999999999999994e0 AS SIGNED), "
+            "CAST(-0.49999999999999994e0 AS SIGNED)").rows == [(0, 0)]

@@ -356,3 +356,24 @@ class TestHavingAlias:
                         "HAVING s > 40 ORDER BY g").rows == [(2, 70)]
         assert tk.query("SELECT g, SUM(v) s FROM ha GROUP BY g "
                         "HAVING s > 20 AND g < 2").rows == [(1, 30)]
+
+    def test_real_column_shadows_alias(self, tk):
+        """MySQL resolves HAVING names FROM-clause-first: an alias only
+        fires when no real column of that name exists."""
+        tk.execute("CREATE TABLE hs (a BIGINT PRIMARY KEY, b BIGINT)")
+        tk.execute("INSERT INTO hs VALUES (1,2),(2,3),(5,3)")
+        # 'a' below is the real column (grouped), NOT the alias of b
+        assert tk.query("SELECT b AS a, SUM(b) FROM hs GROUP BY a "
+                        "HAVING a > 1 ORDER BY a").rows == \
+            [(3, 3), (3, 3)]
+
+    def test_alias_inside_aggregate_rejected(self, tk):
+        """HAVING SUM(s) where s aliases an aggregate would nest group
+        functions — MySQL raises ER_INVALID_GROUP_FUNC_USE."""
+        from tidb_tpu.session import SQLError
+        import pytest
+        tk.execute("CREATE TABLE hn (a BIGINT PRIMARY KEY, b BIGINT)")
+        tk.execute("INSERT INTO hn VALUES (1,2),(2,3)")
+        with pytest.raises(SQLError, match="group function"):
+            tk.query("SELECT SUM(b) s FROM hn GROUP BY a "
+                     "HAVING SUM(s) > 0")

@@ -20,6 +20,8 @@ on divide) that the reference's MyDecimal does per value.
 
 from __future__ import annotations
 
+import math
+
 from dataclasses import dataclass
 from enum import Enum
 from typing import Any, Sequence
@@ -44,6 +46,10 @@ class Op(Enum):
     NULLEQ = "<=>"
     # logic
     AND = "and"; OR = "or"; NOT = "not"; XOR = "xor"
+    # bit (on int64 two's complement; MySQL's BIGINT UNSIGNED domain is
+    # shown signed here — same bits, doc'd in DEVIATIONS.md)
+    BIT_AND = "&"; BIT_OR = "|"; BIT_XOR = "^"; SHL = "<<"; SHR = ">>"
+    BIT_NEG = "~"
     # null tests
     IS_NULL = "isnull"; IS_NOT_NULL = "isnotnull"
     # membership / pattern
@@ -261,6 +267,7 @@ def col(idx: int, ft: FieldType, name: str = "") -> ColumnRef:
 # ScalarFunc
 
 _ARITH = {Op.PLUS, Op.MINUS, Op.MUL, Op.DIV, Op.INTDIV, Op.MOD}
+_BIT = {Op.BIT_AND, Op.BIT_OR, Op.BIT_XOR, Op.SHL, Op.SHR, Op.BIT_NEG}
 _CMP = {Op.EQ, Op.NE, Op.LT, Op.LE, Op.GT, Op.GE, Op.NULLEQ}
 _LOGIC = {Op.AND, Op.OR, Op.NOT, Op.XOR}
 _STRING_OPS = {Op.CONCAT, Op.LENGTH, Op.UPPER, Op.LOWER, Op.SUBSTRING,
@@ -328,6 +335,8 @@ class ScalarFunc(Expression):
             return self._merge_types(self.args)
         if op in _ARITH:
             return self._arith_type()
+        if op in _BIT:
+            return new_int_field()
         raise ValueError(f"cannot type op {op}")
 
     def _merge_types(self, exprs) -> FieldType:
@@ -412,6 +421,8 @@ class ScalarFunc(Expression):
             return d, valid
         if op in _ARITH or op == Op.UNARY_MINUS:
             return _eval_arith(xp, op, self, datas, valid)
+        if op in _BIT:
+            return _eval_bit(xp, op, self, datas, valid)
         if op in _MATH:
             return _eval_math(xp, op, self, datas, valid)
         if op in _TIME_OPS:
@@ -833,19 +844,97 @@ def _civil_from_days(xp, z):
     return y, m, d
 
 
+_I64_MAX, _I64_MIN = (1 << 63) - 1, -(1 << 63)
+
+
+def _round_half(x: float) -> int:
+    """MySQL numeric->int conversion: round half away from zero, clamped
+    to the int64 domain. trunc-and-compare, NOT floor(x+0.5): adding 0.5
+    double-rounds at representation boundaries (0.49999999999999994+0.5
+    is exactly 1.0 in IEEE double)."""
+    t = math.trunc(x)
+    if abs(x - t) >= 0.5:
+        t += 1 if x >= 0 else -1
+    return min(max(t, _I64_MIN), _I64_MAX)
+
+
+_I64_MAX_F = 9223372036854774784.0   # largest double strictly below 2^63
+
+
+def _round_half_xp(xp, r):
+    """Vectorized _round_half over a float array, saturating at the
+    int64 bounds. float(2^63) cast to int64 is invalid (wraps to
+    INT64_MIN), so clip to the largest sub-2^63 double first, then
+    restore exact INT64_MAX for the values that were beyond it.
+    float(-2^63) is exactly representable and casts fine."""
+    t = xp.trunc(r)
+    t = t + xp.where(xp.abs(r - t) >= 0.5, xp.sign(r), 0.0)
+    out = xp.asarray(xp.clip(t, float(_I64_MIN), _I64_MAX_F), np.int64)
+    return xp.where(t > _I64_MAX_F, np.int64(_I64_MAX), out)
+
+
+def _obj_to_int(d, n) -> np.ndarray:
+    """Object-array (string) operands to int64 via MySQL float coercion;
+    non-numeric -> 0, out-of-range clamps."""
+    out = np.zeros(n, dtype=np.int64)
+    for i, x in enumerate(d):
+        try:
+            out[i] = _round_half(float(x))
+        except (ValueError, TypeError, OverflowError):
+            out[i] = 0
+    return out
+
+
+def _bit_int(xp, ft, d):
+    """Bit-op operand as plain int64; fractional operands round first
+    (ref: expression/builtin_op.go bitAndSig — MySQL rounds, not
+    truncates, before bit operations)."""
+    if d.dtype == np.dtype(object):
+        return _obj_to_int(d, len(d))
+    if ft.eval_type in (EvalType.REAL, EvalType.DECIMAL) or \
+            d.dtype == np.float64:
+        return _round_half_xp(xp, _to_real(xp, ft, d))
+    return xp.asarray(d, np.int64)
+
+
+def _eval_bit(xp, op, f: ScalarFunc, datas, valid):
+    ints = [_bit_int(xp, e.ft, d) for e, d in zip(f.args, datas)]
+    if op == Op.BIT_NEG:
+        return ~ints[0], valid
+    a, b = ints
+    if op == Op.BIT_AND:
+        return a & b, valid
+    if op == Op.BIT_OR:
+        return a | b, valid
+    if op == Op.BIT_XOR:
+        return a ^ b, valid
+    # shifts act on the 64-bit word: a count outside [0, 64) yields 0
+    in_range = (b >= 0) & (b < 64)
+    sb = xp.where(in_range, b, 0)
+    if op == Op.SHL:
+        r = a << sb
+    else:
+        # logical (not arithmetic) right shift in two's complement:
+        # mask off the sign bits the arithmetic shift smeared in.
+        # 2^(64-s)-1 for s=1 wraps through int64 min to INT64_MAX,
+        # which is exactly the 0x7ff..f mask wanted.
+        sb1 = xp.where(sb == 0, 1, sb)
+        mask = (np.int64(1) << (np.int64(64) - sb1)) - np.int64(1)
+        r = xp.where(sb == 0, a, (a >> sb1) & mask)
+    zero = xp.zeros_like(r)
+    return xp.where(in_range, r, zero), valid
+
+
 def _eval_cast(xp, op, f: ScalarFunc, argv, n):
     (d, v) = argv[0]
     a = f.args[0].ft
     if op == Op.CAST_INT:
         if d.dtype == np.dtype(object):
-            out = np.zeros(n, dtype=np.int64)
-            for i in range(n):
-                try:
-                    out[i] = int(float(d[i]))
-                except (ValueError, TypeError):
-                    out[i] = 0
-            return out, v
-        return xp.asarray(_to_real(xp, a, d), np.int64) if a.eval_type != EvalType.INT else d, v
+            return _obj_to_int(d, n), v
+        if a.eval_type == EvalType.INT:
+            return d, v
+        # CAST rounds half away from zero (int() would truncate)
+        return _round_half_xp(xp, _to_real(xp, a, d)), v
     if op == Op.CAST_REAL:
         if d.dtype == np.dtype(object):
             out = np.zeros(n, dtype=np.float64)

@@ -1315,17 +1315,25 @@ class Parser:
         return left
 
     def mul_expr(self):
-        left = self.unary_expr()
+        left = self.bitxor_expr()
         while True:
             t = self.peek()
             if t.tp == TokenType.OP and t.val in ("*", "/", "%"):
                 self.next()
-                left = ast.BinaryOp(t.val, left, self.unary_expr())
+                left = ast.BinaryOp(t.val, left, self.bitxor_expr())
             elif t.is_kw("DIV") or t.is_kw("MOD"):
                 self.next()
-                left = ast.BinaryOp(t.val, left, self.unary_expr())
+                left = ast.BinaryOp(t.val, left, self.bitxor_expr())
             else:
                 return left
+
+    def bitxor_expr(self):
+        # bitwise ^ binds tighter than * (MySQL precedence), unlike | and &
+        left = self.unary_expr()
+        while self.peek().tp == TokenType.OP and self.peek().val == "^":
+            self.next()
+            left = ast.BinaryOp("^", left, self.unary_expr())
+        return left
 
     def unary_expr(self):
         t = self.peek()

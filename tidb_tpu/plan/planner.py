@@ -23,8 +23,8 @@ from tidb_tpu.expression import (AggDesc, AggFunc, ColumnRef, Constant,
                                  Expression, Op, ScalarFunc, and_all, func)
 from tidb_tpu.parser import ast
 from tidb_tpu.plan import physical as ph
-from tidb_tpu.plan.resolver import (PlanSchema, Resolver, ResolveError,
-                                    SchemaCol)
+from tidb_tpu.plan.resolver import (ColumnAmbiguousError, PlanSchema,
+                                    Resolver, ResolveError, SchemaCol)
 from tidb_tpu.schema.infoschema import InfoSchema, SchemaError
 
 __all__ = ["Planner", "PlanError"]
@@ -1251,12 +1251,10 @@ class Planner:
         # 1. group exprs over input schema
         group_asts = [bi.expr for bi in stmt.group_by]
         group_exprs = []
-        for ga in group_asts:
-            # GROUP BY <alias> / <position>
-            ga2 = self._maybe_alias_target(ga, stmt)
-            group_exprs.append(base_r.resolve(ga2))
-        group_ast_reprs = [repr(self._maybe_alias_target(g, stmt))
-                           for g in group_asts]
+        group_targets = [self._maybe_alias_target(ga, stmt, in_schema)
+                         for ga in group_asts]   # GROUP BY alias/position
+        group_exprs = [base_r.resolve(ga2) for ga2 in group_targets]
+        group_ast_reprs = [repr(ga2) for ga2 in group_targets]
 
         aggs: list[AggDesc] = []
         num_g = len(group_exprs)
@@ -1283,7 +1281,8 @@ class Planner:
         having_expr = None
         if stmt.having is not None:
             having_expr = resolver.resolve_over_agg(
-                self._substitute_aliases(stmt.having, stmt))
+                self._substitute_aliases(stmt.having, stmt,
+                                         resolver.in_schema))
         # 4. order by may reference aggs too — resolve now, carry through
         order_keys = []
         if stmt.order_by:
@@ -1315,29 +1314,42 @@ class Planner:
                                  for n, e in zip(proj_names, proj_exprs)])
         return out, out_schema, proj_exprs, proj_names, order_keys
 
-    def _substitute_aliases(self, e, stmt: ast.SelectStmt):
+    def _substitute_aliases(self, e, stmt: ast.SelectStmt,
+                            schema: PlanSchema | None = None,
+                            in_agg: bool = False):
         """Replace select-list aliases ANYWHERE inside an expression
         (HAVING may combine aliases with other predicates, e.g.
         HAVING s > 40 AND g < 5 — MySQL resolves those against the
-        select list)."""
+        select list). A real FROM-clause column of the same name wins
+        over the alias (MySQL's HAVING resolution order); an alias
+        whose expression holds an aggregate may not land inside
+        another aggregate (ER_INVALID_GROUP_FUNC_USE)."""
         import dataclasses
         if isinstance(e, ast.ColName) and not e.table:
+            if self._column_shadows(schema, e.name):
+                return e
             for f in stmt.fields:
                 if f.alias and f.alias.lower() == e.name.lower():
+                    if in_agg and self._contains_agg(f.expr):
+                        raise ResolveError(
+                            "Invalid use of group function")
                     return f.expr
             return e
         if dataclasses.is_dataclass(e) and isinstance(e, ast.ExprNode) \
                 and not isinstance(e, (ast.SubqueryExpr,
                                        ast.ExistsSubquery)):
+            inner_agg = in_agg or isinstance(e, ast.AggregateCall)
             updates = {}
             for fld in dataclasses.fields(e):
                 v = getattr(e, fld.name)
                 if isinstance(v, ast.ExprNode):
-                    nv = self._substitute_aliases(v, stmt)
+                    nv = self._substitute_aliases(v, stmt, schema,
+                                                  inner_agg)
                     if nv is not v:
                         updates[fld.name] = nv
                 elif isinstance(v, list):
-                    nl = [self._substitute_aliases(x, stmt)
+                    nl = [self._substitute_aliases(x, stmt, schema,
+                                                   inner_agg)
                           if isinstance(x, ast.ExprNode) else x
                           for x in v]
                     if any(a is not b for a, b in zip(nl, v)):
@@ -1346,18 +1358,55 @@ class Planner:
                 return dataclasses.replace(e, **updates)
         return e
 
-    def _maybe_alias_target(self, e: ast.ExprNode, stmt: ast.SelectStmt):
-        """GROUP BY / ORDER BY may name a select alias or 1-based position."""
+    def _contains_agg(self, e) -> bool:
+        import dataclasses
+        if isinstance(e, ast.AggregateCall):
+            return True
+        if dataclasses.is_dataclass(e) and isinstance(e, ast.ExprNode):
+            for fld in dataclasses.fields(e):
+                v = getattr(e, fld.name)
+                if isinstance(v, ast.ExprNode) and self._contains_agg(v):
+                    return True
+                if isinstance(v, list) and any(
+                        isinstance(x, ast.ExprNode) and
+                        self._contains_agg(x) for x in v):
+                    return True
+        return False
+
+    def _maybe_alias_target(self, e: ast.ExprNode, stmt: ast.SelectStmt,
+                            schema: PlanSchema | None = None):
+        """GROUP BY / ORDER BY may name a select alias or 1-based
+        position. Pass `schema` for GROUP BY: MySQL resolves GROUP
+        BY/HAVING names FROM-clause-first (a real column shadows the
+        alias), but ORDER BY select-list-first."""
         if isinstance(e, ast.Literal) and isinstance(e.value, int) and \
                 1 <= e.value <= len(stmt.fields):
             f = stmt.fields[e.value - 1]
             if not isinstance(f.expr, ast.Star):
                 return f.expr
         if isinstance(e, ast.ColName) and not e.table:
+            if self._column_shadows(schema, e.name):
+                return e
             for f in stmt.fields:
                 if f.alias and f.alias.lower() == e.name.lower():
                     return f.expr
         return e
+
+    @staticmethod
+    def _column_shadows(schema: PlanSchema | None, name: str) -> bool:
+        """MySQL GROUP BY/HAVING resolution order: a FROM-clause column
+        of the same name wins over a select-list alias (ORDER BY is the
+        opposite — callers there pass schema=None). Ambiguity among the
+        FROM columns stays a hard error."""
+        if schema is None:
+            return False
+        try:
+            schema.find(name, "")
+            return True
+        except ColumnAmbiguousError:
+            raise
+        except ResolveError:
+            return False
 
     def _resolve_order(self, stmt, in_schema: PlanSchema,
                        out_schema: PlanSchema, proj_exprs, order_keys):

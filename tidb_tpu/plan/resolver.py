@@ -139,7 +139,9 @@ _BIN_OPS = {"+": Op.PLUS, "-": Op.MINUS, "*": Op.MUL, "/": Op.DIV,
             "DIV": Op.INTDIV, "%": Op.MOD, "MOD": Op.MOD,
             "=": Op.EQ, "<": Op.LT, "<=": Op.LE, ">": Op.GT, ">=": Op.GE,
             "<>": Op.NE, "!=": Op.NE, "<=>": Op.NULLEQ,
-            "AND": Op.AND, "OR": Op.OR, "XOR": Op.XOR}
+            "AND": Op.AND, "OR": Op.OR, "XOR": Op.XOR,
+            "&": Op.BIT_AND, "|": Op.BIT_OR, "^": Op.BIT_XOR,
+            "<<": Op.SHL, ">>": Op.SHR}
 
 
 class Resolver:
@@ -262,6 +264,8 @@ class Resolver:
             return func(Op.UNARY_MINUS, a)
         if e.op == "NOT":
             return func(Op.NOT, a)
+        if e.op == "~":
+            return func(Op.BIT_NEG, a)
         raise ResolveError(f"unsupported unary {e.op}")
 
     def _r_IsNullExpr(self, e: ast.IsNullExpr) -> Expression:
