@@ -269,3 +269,147 @@ class TestExternalSort:
         assert len(got) == 5000
         vs = [r[1] for r in got]
         assert vs == sorted(vs, reverse=True)
+
+
+class TestOdkuValuesFn:
+    """VALUES(col) in ON DUPLICATE KEY UPDATE (ref: executor/write.go
+    onDuplicateUpdate; expression/builtin_other.go valuesFunction)."""
+
+    @pytest.fixture
+    def vt(self):
+        s = Session(new_mock_storage())
+        s.execute("CREATE DATABASE d")
+        s.execute("USE d")
+        s.execute("CREATE TABLE vt (id BIGINT PRIMARY KEY, "
+                  "v BIGINT DEFAULT 7, dc DECIMAL(8,2) DEFAULT 1.50)")
+        s.execute("INSERT INTO vt VALUES (1, 10, 2.25), (2, 20, 3.00)")
+        yield s
+        s.close()
+
+    def test_values_takes_candidate(self, vt):
+        vt.execute("INSERT INTO vt VALUES (2, 555, 9.99) "
+                   "ON DUPLICATE KEY UPDATE v = VALUES(v) + 1, "
+                   "dc = VALUES(dc)")
+        from decimal import Decimal
+        assert vt.query("SELECT v, dc FROM vt WHERE id = 2").rows == \
+            [(556, Decimal("9.99"))]
+
+    def test_values_mixes_with_old_row(self, vt):
+        vt.execute("INSERT INTO vt VALUES (1, 100, 5.00) "
+                   "ON DUPLICATE KEY UPDATE v = v + VALUES(v)")
+        assert vt.query("SELECT v FROM vt WHERE id = 1").rows == [(110,)]
+
+    def test_values_of_omitted_column_is_default(self, vt):
+        vt.execute("INSERT INTO vt (id) VALUES (1) "
+                   "ON DUPLICATE KEY UPDATE v = VALUES(v)")
+        assert vt.query("SELECT v FROM vt WHERE id = 1").rows == [(7,)]
+
+    def test_values_non_column_rejected(self, vt):
+        from tidb_tpu.session import SQLError
+        with pytest.raises(SQLError, match="single column"):
+            vt.execute("INSERT INTO vt VALUES (1, 1, 1) "
+                       "ON DUPLICATE KEY UPDATE v = VALUES(v + 1)")
+
+
+class TestDefaultFn:
+    """DEFAULT / DEFAULT(col) beyond the bare INSERT cell."""
+
+    @pytest.fixture
+    def dt(self):
+        s = Session(new_mock_storage())
+        s.execute("CREATE DATABASE d")
+        s.execute("USE d")
+        s.execute("CREATE TABLE dt (id BIGINT PRIMARY KEY, "
+                  "v BIGINT DEFAULT 7, nm VARCHAR(10))")
+        s.execute("INSERT INTO dt VALUES (1, 100, 'a')")
+        yield s
+        s.close()
+
+    def test_default_fn_in_values(self, dt):
+        dt.execute("INSERT INTO dt VALUES (5, DEFAULT(v) * 2, 'x')")
+        assert dt.query("SELECT v FROM dt WHERE id = 5").rows == [(14,)]
+
+    def test_update_set_default(self, dt):
+        dt.execute("UPDATE dt SET v = DEFAULT WHERE id = 1")
+        assert dt.query("SELECT v FROM dt WHERE id = 1").rows == [(7,)]
+        dt.execute("UPDATE dt SET v = DEFAULT(v) + 1 WHERE id = 1")
+        assert dt.query("SELECT v FROM dt WHERE id = 1").rows == [(8,)]
+
+    def test_insert_set_default(self, dt):
+        dt.execute("INSERT INTO dt SET id = 6, v = DEFAULT, nm = 'k'")
+        assert dt.query("SELECT v FROM dt WHERE id = 6").rows == [(7,)]
+
+    def test_odku_bare_default(self, dt):
+        dt.execute("INSERT INTO dt VALUES (1, 1, 'z') "
+                   "ON DUPLICATE KEY UPDATE v = DEFAULT")
+        assert dt.query("SELECT v FROM dt WHERE id = 1").rows == [(7,)]
+
+    def test_default_no_such_column(self, dt):
+        from tidb_tpu.session import SQLError
+        with pytest.raises(SQLError, match="Unknown column"):
+            dt.execute("INSERT INTO dt VALUES (9, DEFAULT(nope), '')")
+
+    def test_default_of_defaultless_column_is_null(self, dt):
+        dt.execute("INSERT INTO dt VALUES (7, 1, DEFAULT(nm))")
+        assert dt.query("SELECT nm IS NULL FROM dt WHERE id = 7"
+                        ).rows == [(1,)]
+
+
+class TestInsertSelectUnion:
+    def test_union_source(self):
+        s = Session(new_mock_storage())
+        s.execute("CREATE DATABASE d")
+        s.execute("USE d")
+        s.execute("CREATE TABLE iu (id BIGINT PRIMARY KEY, "
+                  "v BIGINT DEFAULT 3)")
+        s.execute("INSERT INTO iu (id) SELECT 1 UNION ALL SELECT 2")
+        assert s.query("SELECT id, v FROM iu ORDER BY id").rows == \
+            [(1, 3), (2, 3)]
+        s.execute("INSERT INTO iu (id, v) "
+                  "SELECT 10, 1 UNION SELECT 11, 2")
+        assert s.query("SELECT COUNT(*) FROM iu").rows == [(4,)]
+        s.close()
+
+
+class TestOdkuReviewEdges:
+    @pytest.fixture
+    def rt(self):
+        s = Session(new_mock_storage())
+        s.execute("CREATE DATABASE d")
+        s.execute("USE d")
+        s.execute("CREATE TABLE rt (id BIGINT PRIMARY KEY, "
+                  "v BIGINT DEFAULT 7, w BIGINT NOT NULL)")
+        s.execute("INSERT INTO rt VALUES (1, 10, 5)")
+        yield s
+        s.close()
+
+    def test_values_inside_case(self, rt):
+        """The canonical greatest-of idiom: CASE over VALUES()."""
+        rt.execute("INSERT INTO rt VALUES (1, 100, 1) "
+                   "ON DUPLICATE KEY UPDATE v = CASE "
+                   "WHEN VALUES(v) > v THEN VALUES(v) ELSE v END")
+        assert rt.query("SELECT v FROM rt WHERE id = 1").rows == [(100,)]
+        rt.execute("INSERT INTO rt VALUES (1, 50, 1) "
+                   "ON DUPLICATE KEY UPDATE v = CASE "
+                   "WHEN VALUES(v) > v THEN VALUES(v) ELSE v END")
+        assert rt.query("SELECT v FROM rt WHERE id = 1").rows == [(100,)]
+
+    def test_default_inside_case(self, rt):
+        rt.execute("UPDATE rt SET v = CASE WHEN 1 THEN DEFAULT(v) "
+                   "ELSE 0 END WHERE id = 1")
+        assert rt.query("SELECT v FROM rt WHERE id = 1").rows == [(7,)]
+
+    def test_default_on_not_null_without_default_errors(self, rt):
+        from tidb_tpu.session import SQLError
+        with pytest.raises(SQLError, match="doesn't have a default"):
+            rt.execute("UPDATE rt SET w = DEFAULT WHERE id = 1")
+        assert rt.query("SELECT w FROM rt WHERE id = 1").rows == [(5,)]
+
+    def test_values_unknown_column_clean_error(self, rt):
+        from tidb_tpu.session import SQLError
+        with pytest.raises(SQLError, match="Unknown column 'nope'"):
+            rt.execute("INSERT INTO rt VALUES (1, 1, 1) ON DUPLICATE "
+                       "KEY UPDATE v = VALUES(nope)")
+        with pytest.raises(SQLError, match="Unknown column"):
+            rt.execute("INSERT INTO rt VALUES (1, 1, 1) ON DUPLICATE "
+                       "KEY UPDATE v = VALUES(zzz.v)")

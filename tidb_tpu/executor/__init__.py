@@ -1263,11 +1263,22 @@ class InsertExec(Executor):
             tbl.remove_record(txn, handle, old)
             tbl.add_record(txn, values)
             return 2
-        # ON DUPLICATE KEY UPDATE over the existing row
+        # ON DUPLICATE KEY UPDATE over [old | candidate]: the second
+        # half feeds VALUES(col) refs (planner's __values__ columns)
         cols = info.public_columns()
-        from tidb_tpu.table import rows_to_chunk
-        row_chunk = rows_to_chunk([c.ft for c in cols],
-                                  [[old.get(c.id) for c in cols]])
+        from tidb_tpu.table import encode_datum_for_col, rows_to_chunk
+        cand = []
+        for c in cols:
+            cn = c.name.lower()
+            if cn in values:
+                cand.append(encode_datum_for_col(values[cn], c.ft))
+            elif c.has_default:
+                cand.append(encode_datum_for_col(c.default, c.ft))
+            else:
+                cand.append(None)
+        row_chunk = rows_to_chunk(
+            [c.ft for c in cols] * 2,
+            [[old.get(c.id) for c in cols] + cand])
         new_vals = {}
         for cname, expr in self.plan.on_duplicate:
             d, v = expr.eval(row_chunk)

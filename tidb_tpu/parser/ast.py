@@ -165,7 +165,7 @@ class VariableExpr(ExprNode):
 
 @dataclass
 class DefaultExpr(ExprNode):
-    pass
+    pass              # bare DEFAULT; DEFAULT(col) parses as FuncCall
 
 
 @dataclass

@@ -577,7 +577,7 @@ class Parser:
                 c = self.column_name()
                 self.expect_op("=")
                 stmt.columns.append(c.name)
-                row.append(self.expr())
+                row.append(self.expr_or_default())
                 if not self.try_op(","):
                     break
             stmt.values = [row]
@@ -603,14 +603,17 @@ class Parser:
         return row
 
     def expr_or_default(self):
-        if self.try_kw("DEFAULT"):
+        nt = self.peek(1)
+        if self.peek().is_kw("DEFAULT") and not (
+                nt.tp == TokenType.OP and nt.val == "("):
+            self.next()
             return ast.DefaultExpr()
         return self.expr()
 
     def assignment(self) -> ast.Assignment:
         c = self.column_name()
         self.expect_op("=")
-        return ast.Assignment(col=c, expr=self.expr())
+        return ast.Assignment(col=c, expr=self.expr_or_default())
 
     def update(self) -> ast.UpdateStmt:
         self.expect_kw("UPDATE")

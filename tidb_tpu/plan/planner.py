@@ -131,9 +131,7 @@ class Planner:
             # mesh routing first: the fused mesh operators subsume the
             # algorithm choice below (and handle capacity escalation
             # themselves); the physical pass then optimizes what remains
-            built = self.plan_union(stmt) \
-                if isinstance(stmt, ast.UnionStmt) \
-                else self.plan_select(stmt)
+            built = self._plan_query(stmt)
             # mesh routing first: its fused star-join pipeline matches
             # the ORIGINAL join shapes (and already orders dims itself);
             # greedy reorder then improves whatever stays on the
@@ -305,9 +303,7 @@ class Planner:
         if isinstance(node, ast.TableSource):
             return self.build_reader(node)
         if isinstance(node, ast.SubqueryTable):
-            sub = self.plan_union(node.select) \
-                if isinstance(node.select, ast.UnionStmt) \
-                else self.plan_select(node.select)
+            sub = self._plan_query(node.select)
             alias = node.alias.lower()
             schema = PlanSchema([
                 SchemaCol(c.name, alias, c.ft) for c in sub.schema.cols])
@@ -969,13 +965,17 @@ class Planner:
 
     # -- UNION ---------------------------------------------------------------
 
+    def _plan_query(self, stmt) -> ph.PhysPlan:
+        """SELECT or UNION — every seam that accepts a query body."""
+        return self.plan_union(stmt) if isinstance(stmt, ast.UnionStmt) \
+            else self.plan_select(stmt)
+
     def plan_union(self, stmt: ast.UnionStmt) -> ph.PhysPlan:
         """UNION as a real operator tree (ref: builder.go UnionExec):
         branches stream through PhysUnion; MySQL's mixed ALL/DISTINCT
         rule applies — a DISTINCT union dedups everything to its left —
         via one HashAgg grouped on every output column."""
-        sels = [self.plan_union(s) if isinstance(s, ast.UnionStmt)
-                else self.plan_select(s) for s in stmt.selects]
+        sels = [self._plan_query(s) for s in stmt.selects]
         width = len(sels[0].schema)
         for s in sels[1:]:
             if len(s.schema) != width:
@@ -1392,6 +1392,86 @@ class Planner:
                     return f.expr
         return e
 
+    def _rewrite_ast(self, e, fn):
+        """Bottom-up AST rebuild: children first, then fn(node) may
+        return a replacement. Subquery boundaries are not crossed."""
+        import dataclasses
+        if dataclasses.is_dataclass(e) and isinstance(e, ast.ExprNode) \
+                and not isinstance(e, (ast.SubqueryExpr,
+                                       ast.ExistsSubquery)):
+            updates = {}
+            for fld in dataclasses.fields(e):
+                v = getattr(e, fld.name)
+                if isinstance(v, ast.ExprNode):
+                    nv = self._rewrite_ast(v, fn)
+                    if nv is not v:
+                        updates[fld.name] = nv
+                elif isinstance(v, list):
+                    nl = [self._rewrite_ast_item(x, fn) for x in v]
+                    if any(a is not b for a, b in zip(nl, v)):
+                        updates[fld.name] = nl
+            if updates:
+                e = dataclasses.replace(e, **updates)
+        return fn(e)
+
+    def _rewrite_ast_item(self, x, fn):
+        """List element: an expr, or a tuple holding exprs (CASE's
+        when_clauses are (cond, result) pairs)."""
+        if isinstance(x, ast.ExprNode):
+            return self._rewrite_ast(x, fn)
+        if isinstance(x, tuple) and any(
+                isinstance(y, ast.ExprNode) for y in x):
+            nt = tuple(self._rewrite_ast(y, fn)
+                       if isinstance(y, ast.ExprNode) else y for y in x)
+            return x if all(a is b for a, b in zip(nt, x)) else nt
+        return x
+
+    def _rewrite_values_fn(self, e, info):
+        """ON DUPLICATE KEY UPDATE ... VALUES(col) -> the candidate
+        row's value (ref: executor/write.go onDuplicateUpdate;
+        expression/builtin_other.go valuesFunctionClass)."""
+        tname = info.name.lower()
+        def fn(node):
+            if isinstance(node, ast.FuncCall) and \
+                    node.name.upper() == "VALUES":
+                if len(node.args) != 1 or \
+                        not isinstance(node.args[0], ast.ColName):
+                    raise PlanError("VALUES() takes a single column name")
+                c = node.args[0]
+                if (c.table and c.table.lower() != tname) or \
+                        info.col_by_name(c.name) is None:
+                    raise PlanError(f"Unknown column '{c.name}'")
+                return ast.ColName(name="__values__" + c.name.lower())
+            return node
+        return self._rewrite_ast(e, fn)
+
+    def _fold_default(self, e, info, target: str | None = None):
+        """DEFAULT(col) / bare DEFAULT in a SET assignment -> the
+        column's default value as a literal. A NOT NULL column without
+        a default has no value to give (MySQL error 1364)."""
+        def fn(node):
+            cname = None
+            if isinstance(node, ast.FuncCall) and \
+                    node.name.upper() == "DEFAULT":
+                if len(node.args) != 1 or \
+                        not isinstance(node.args[0], ast.ColName):
+                    raise PlanError("DEFAULT() takes a single column name")
+                cname = node.args[0].name
+            elif isinstance(node, ast.DefaultExpr):
+                if target is None:
+                    raise PlanError("DEFAULT not valid here")
+                cname = target
+            if cname is None:
+                return node
+            ci = info.col_by_name(cname)
+            if ci is None:
+                raise PlanError(f"Unknown column '{cname}'")
+            if not ci.has_default and ci.ft.not_null:
+                raise PlanError(
+                    f"Field '{ci.name}' doesn't have a default value")
+            return ast.Literal(ci.default if ci.has_default else None)
+        return self._rewrite_ast(e, fn)
+
     @staticmethod
     def _column_shadows(schema: PlanSchema | None, name: str) -> bool:
         """MySQL GROUP BY/HAVING resolution order: a FROM-clause column
@@ -1438,7 +1518,7 @@ class Planner:
             if info.col_by_name(c) is None:
                 raise PlanError(f"Unknown column '{c}'")
         if stmt.select is not None:
-            source = self.plan_select(stmt.select)
+            source = self._plan_query(stmt.select)
             if len(source.schema) != len(cols):
                 raise PlanError("Column count doesn't match value count")
         else:
@@ -1448,19 +1528,30 @@ class Planner:
                 if len(vr) != len(cols):
                     raise PlanError("Column count doesn't match value count")
                 rows.append([None if isinstance(v, ast.DefaultExpr)
-                             else r.resolve(v) for v in vr])
+                             else r.resolve(self._fold_default(v, info))
+                             for v in vr])
             source = ph.PhysValues(rows=rows)
         dup = []
         if stmt.on_duplicate:
-            # assignments may reference existing row columns
-            schema = PlanSchema([
-                SchemaCol(c.name.lower(), info.name.lower(), c.ft, c.id)
-                for c in info.public_columns()])
+            # assignments may reference existing row columns; VALUES(c)
+            # refers to the would-be inserted value and resolves against
+            # a second column set appended after the existing row (the
+            # executor evaluates over an [old | candidate] chunk) under
+            # reserved __values__-prefixed names so bare refs stay
+            # unambiguous
+            pub = info.public_columns()
+            schema = PlanSchema(
+                [SchemaCol(c.name.lower(), info.name.lower(), c.ft, c.id)
+                 for c in pub] +
+                [SchemaCol("__values__" + c.name.lower(), "", c.ft, c.id)
+                 for c in pub])
             r2 = Resolver(schema)
             for a in stmt.on_duplicate:
                 if info.col_by_name(a.col.name) is None:
                     raise PlanError(f"Unknown column '{a.col.name}'")
-                dup.append((a.col.name.lower(), r2.resolve(a.expr)))
+                e2 = self._rewrite_values_fn(
+                    self._fold_default(a.expr, info, a.col.name), info)
+                dup.append((a.col.name.lower(), r2.resolve(e2)))
         return ph.PhysInsert(table=info, columns=[c.lower() for c in cols],
                              source=source, on_duplicate=dup,
                              is_replace=stmt.is_replace, ignore=stmt.ignore)
@@ -1510,7 +1601,8 @@ class Planner:
         for a in stmt.assignments:
             if info.col_by_name(a.col.name) is None:
                 raise PlanError(f"Unknown column '{a.col.name}'")
-            assigns.append((a.col.name.lower(), r.resolve(a.expr)))
+            assigns.append((a.col.name.lower(), r.resolve(
+                self._fold_default(a.expr, info, a.col.name))))
         return ph.PhysUpdate(table=info, reader=reader, assignments=assigns)
 
     def plan_delete(self, stmt: ast.DeleteStmt) -> ph.PhysDelete:
