@@ -347,3 +347,88 @@ class TestCastRounding:
         assert sess.query(
             "SELECT CAST(0.49999999999999994e0 AS SIGNED), "
             "CAST(-0.49999999999999994e0 AS SIGNED)").rows == [(0, 0)]
+
+
+class TestTimeUnitsAndPatterns:
+    """EXTRACT, sub-day INTERVAL units, calendar-exact month shifts,
+    TIMESTAMPDIFF/ADD, LIKE ESCAPE, REGEXP/RLIKE, BINARY (ref:
+    expression/builtin_time.go, builtin_like.go)."""
+
+    def test_extract(self, sess):
+        assert sess.query(
+            "SELECT EXTRACT(YEAR FROM d), EXTRACT(MONTH FROM d), "
+            "EXTRACT(MINUTE FROM d), EXTRACT(YEAR_MONTH FROM d) "
+            "FROM t WHERE id = 1").rows == [(2024, 3, 30, 202403)]
+
+    def test_subday_intervals(self, sess):
+        assert sess.query(
+            "SELECT DATE_ADD('2024-01-15 10:00:00', INTERVAL 5 HOUR), "
+            "DATE_SUB('2024-01-15 00:00:00', INTERVAL 90 SECOND), "
+            "DATE_ADD('2024-01-15 00:00:00', INTERVAL 30 MINUTE)"
+        ).rows == [("2024-01-15 15:00:00", "2024-01-14 23:58:30",
+                    "2024-01-15 00:30:00")]
+
+    def test_month_shift_clamps_on_columns(self, sess):
+        # non-constant base goes through the branch-free device op;
+        # Jan 31 + 1 month clamps to Feb 29 (2024 is a leap year)
+        assert sess.query(
+            "SELECT DATE_ADD(d, INTERVAL 1 MONTH) FROM t WHERE id = 2"
+        ).rows == [("2025-01-31 23:59:59",)]
+        sess.execute("INSERT INTO t VALUES (90, 1.0, 'x', "
+                     "'2024-01-31 08:00:00')")
+        try:
+            assert sess.query(
+                "SELECT DATE_ADD(d, INTERVAL 1 MONTH), "
+                "DATE_SUB(d, INTERVAL 11 MONTH) FROM t WHERE id = 90"
+            ).rows == [("2024-02-29 08:00:00", "2023-02-28 08:00:00")]
+        finally:
+            sess.execute("DELETE FROM t WHERE id = 90")
+
+    def test_timestampdiff(self, sess):
+        assert sess.query(
+            "SELECT TIMESTAMPDIFF(MONTH, '2024-01-15', '2024-03-16'), "
+            "TIMESTAMPDIFF(MONTH, '2024-01-15', '2024-03-14'), "
+            "TIMESTAMPDIFF(DAY, '2024-03-16', '2024-03-10'), "
+            "TIMESTAMPDIFF(YEAR, '2022-06-01', '2024-05-31')"
+        ).rows == [(2, 1, -6, 1)]
+
+    def test_timestampadd(self, sess):
+        assert sess.query(
+            "SELECT TIMESTAMPADD(HOUR, 26, '2024-01-15 00:00:00')"
+        ).rows == [("2024-01-16 02:00:00",)]
+
+    def test_like_escape(self, sess):
+        assert sess.query("SELECT 'a_b' LIKE 'a|_b' ESCAPE '|', "
+                          "'axb' LIKE 'a|_b' ESCAPE '|', "
+                          "'a%b' LIKE 'a|%b' ESCAPE '|'").rows == \
+            [(1, 0, 1)]
+
+    def test_regexp(self, sess):
+        assert sess.query(
+            "SELECT 'abc123' REGEXP '^abc[0-9]+$', "
+            "'xyz' RLIKE 'a', 'xyz' NOT REGEXP 'a', "
+            "'xabcx' REGEXP 'abc'").rows == [(1, 0, 1, 1)]
+        assert sess.query(
+            "SELECT s FROM t WHERE s REGEXP '^hello' AND id = 1"
+        ).rows == [("hello world",)]
+
+    def test_binary_operator_noop(self, sess):
+        # collations are code-point everywhere; BINARY is the identity
+        assert sess.query("SELECT BINARY 'A' = 'a', BINARY 'a' = 'a'"
+                          ).rows == [(0, 1)]
+
+
+class TestNegativeIntervalsAndErrors:
+    def test_negative_amounts(self, sess):
+        assert sess.query(
+            "SELECT TIMESTAMPADD(HOUR, -2, '2024-03-31 01:00:00'), "
+            "DATE_ADD('2024-03-31 01:00:00', INTERVAL -1 MONTH)"
+        ).rows == [("2024-03-30 23:00:00", "2024-02-29 01:00:00")]
+
+    def test_bad_regexp_is_sql_error(self, sess):
+        with pytest.raises(SQLError, match="regexp"):
+            sess.query("SELECT 'x' REGEXP '['")
+
+    def test_bad_tsdiff_unit_is_sql_error(self, sess):
+        with pytest.raises(SQLError, match="TIMESTAMPDIFF unit"):
+            sess.query("SELECT TIMESTAMPDIFF(FORTNIGHT, d, d) FROM t")

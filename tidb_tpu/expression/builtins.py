@@ -943,3 +943,76 @@ def _arg_to_json(x, valid, expr):
 
 _reg("JSON_ARRAY", 0, 32, _json_ft, _json_array)
 _reg("JSON_OBJECT", 0, 32, _json_ft, _json_object)
+
+
+# -- pattern matching ---------------------------------------------------------
+
+def _regexp_like(args, argv, n):
+    """a REGEXP p (ref: expression/builtin_like.go regexpSig): partial
+    match, per-row pattern, case-sensitive (utf8_bin semantics)."""
+    import re
+    v = _valid_all(argv, n)
+    out = np.zeros(n, dtype=np.int64)
+    cache = {}
+    for i in range(n):
+        if not v[i]:
+            continue
+        p = _s(argv[1][0][i])
+        rx = cache.get(p)
+        if rx is None:
+            try:
+                rx = cache[p] = re.compile(p)
+            except re.error as ex:
+                from tidb_tpu.executor import ExecError
+                raise ExecError(
+                    f"Got error '{ex}' from regexp") from None
+        out[i] = 1 if rx.search(_s(argv[0][0][i])) else 0
+    return out, v
+
+
+_reg("REGEXP_LIKE", 2, 2, "int", _regexp_like)
+
+
+# -- TIMESTAMPDIFF ------------------------------------------------------------
+
+_TSDIFF_US = {"MICROSECOND": 1, "SECOND": 1_000_000, "MINUTE": 60_000_000,
+              "HOUR": 3_600_000_000, "DAY": _US_PER_DAY,
+              "WEEK": 7 * _US_PER_DAY}
+_TSDIFF_MONTHS = {"MONTH": 1, "QUARTER": 3, "YEAR": 12}
+
+
+def _timestampdiff(args, argv, n):
+    """TIMESTAMPDIFF(unit, a, b): complete units from a to b, truncated
+    toward zero (ref: expression/builtin_time.go timestampDiff)."""
+    v = _valid_all(argv, n)
+    a = _micros(argv[1][0])
+    b = _micros(argv[2][0])
+    units = argv[0][0]
+    out = np.zeros(n, dtype=np.int64)
+    for i in range(n):
+        if not v[i]:
+            continue
+        u = _s(units[i]).upper()
+        diff = int(b[i]) - int(a[i])
+        if u in _TSDIFF_US:
+            per = _TSDIFF_US[u]
+            out[i] = abs(diff) // per * (1 if diff >= 0 else -1)
+        elif u in _TSDIFF_MONTHS:
+            da = micros_to_datetime(int(a[i]))
+            db = micros_to_datetime(int(b[i]))
+            months = (db.year - da.year) * 12 + (db.month - da.month)
+            ta = (da.day, da.hour, da.minute, da.second, da.microsecond)
+            tb = (db.day, db.hour, db.minute, db.second, db.microsecond)
+            if months > 0 and tb < ta:
+                months -= 1      # last month not complete
+            elif months < 0 and tb > ta:
+                months += 1
+            k = _TSDIFF_MONTHS[u]
+            out[i] = abs(months) // k * (1 if months >= 0 else -1)
+        else:
+            from tidb_tpu.executor import ExecError
+            raise ExecError(f"unsupported TIMESTAMPDIFF unit {u}")
+    return out, v
+
+
+_reg("TIMESTAMPDIFF", 3, 3, "int", _timestampdiff)

@@ -69,6 +69,8 @@ class Op(Enum):
     MINUTE = "minute"; SECOND = "second"
     DATE_ADD_DAYS = "date_add_days"; DATE_SUB_DAYS = "date_sub_days"
     DATEDIFF = "datediff"
+    DATE_ADD_US = "date_add_us"     # fixed-width units as one micros delta
+    ADD_MONTHS = "add_months"       # calendar-exact, day-clamping
     # cast
     CAST_INT = "cast_int"; CAST_REAL = "cast_real"; CAST_DECIMAL = "cast_decimal"
     CAST_STRING = "cast_string"
@@ -276,7 +278,10 @@ _STRING_OPS = {Op.CONCAT, Op.LENGTH, Op.UPPER, Op.LOWER, Op.SUBSTRING,
 _MATH = {Op.ABS, Op.CEIL, Op.FLOOR, Op.ROUND, Op.POW, Op.SQRT, Op.EXP,
          Op.LN, Op.LOG2, Op.SIGN}
 _TIME_OPS = {Op.YEAR, Op.MONTH, Op.DAY, Op.HOUR, Op.MINUTE, Op.SECOND,
-             Op.DATE_ADD_DAYS, Op.DATE_SUB_DAYS, Op.DATEDIFF}
+             Op.DATE_ADD_DAYS, Op.DATE_SUB_DAYS, Op.DATEDIFF,
+             Op.DATE_ADD_US, Op.ADD_MONTHS}
+_DATE_SHIFT = {Op.DATE_ADD_DAYS, Op.DATE_SUB_DAYS, Op.DATE_ADD_US,
+               Op.ADD_MONTHS}
 
 _MAX_DEC_FRAC = 9  # cap result frac on multiply to bound int64 range
 
@@ -297,10 +302,10 @@ class ScalarFunc(Expression):
         if op in _CMP or op in _LOGIC or op in (Op.IS_NULL, Op.IS_NOT_NULL,
                                                 Op.IN, Op.LIKE):
             return new_int_field()
-        if op in (Op.LENGTH, Op.INSTR, Op.ASCII) or op in _TIME_OPS and op not in (
-                Op.DATE_ADD_DAYS, Op.DATE_SUB_DAYS):
+        if op in (Op.LENGTH, Op.INSTR, Op.ASCII) or \
+                op in _TIME_OPS and op not in _DATE_SHIFT:
             return new_int_field()
-        if op in (Op.DATE_ADD_DAYS, Op.DATE_SUB_DAYS):
+        if op in _DATE_SHIFT:
             return self.args[0].ft
         if op == Op.CAST_INT:
             return new_int_field()
@@ -806,6 +811,24 @@ def _eval_time(xp, op, f: ScalarFunc, datas, valid):
         days = xp.asarray(datas[1], np.int64)
         delta = days * _US_PER_DAY
         return (d + delta if op == Op.DATE_ADD_DAYS else d - delta), valid
+    if op == Op.DATE_ADD_US:
+        return xp.asarray(d, np.int64) + xp.asarray(datas[1], np.int64), \
+            valid
+    if op == Op.ADD_MONTHS:
+        # calendar-exact month shift, day clamped into the target month
+        # (Jan 31 + 1 month -> Feb 29/28), branch-free for jit
+        months = xp.asarray(datas[1], np.int64)
+        us = xp.asarray(d, np.int64)
+        days = us // _US_PER_DAY
+        rem_us = us - days * _US_PER_DAY
+        y, m, dd = _civil_from_days(xp, days)
+        tm = y * 12 + (m - 1) + months
+        ny, nm = tm // 12, tm % 12 + 1
+        one = xp.ones_like(dd)
+        dim = _days_from_civil(xp, (tm + 1) // 12, (tm + 1) % 12 + 1,
+                               one) - _days_from_civil(xp, ny, nm, one)
+        nd = _days_from_civil(xp, ny, nm, xp.minimum(dd, dim))
+        return nd * _US_PER_DAY + rem_us, valid
     if op == Op.DATEDIFF:
         a = xp.asarray(d, np.int64) // _US_PER_DAY
         b = xp.asarray(datas[1], np.int64) // _US_PER_DAY
@@ -842,6 +865,17 @@ def _civil_from_days(xp, z):
     m = xp.where(mp < 10, mp + 3, mp - 9)
     y = xp.where(m <= 2, y + 1, y)
     return y, m, d
+
+
+def _days_from_civil(xp, y, m, d):
+    """(year, month, day) -> days-since-epoch; inverse of
+    _civil_from_days (days_from_civil, H. Hinnant), same int math."""
+    y = y - (m <= 2)
+    era = xp.where(y >= 0, y, y - 399) // 400
+    yoe = y - era * 400
+    doy = (153 * xp.where(m > 2, m - 3, m + 9) + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
 
 
 _I64_MAX, _I64_MIN = (1 << 63) - 1, -(1 << 63)
@@ -1005,21 +1039,22 @@ def _eval_string(f: ScalarFunc, argv, n):
         return vec(lambda x, sub: s(x).find(s(sub)) + 1, datas[0], datas[1],
                    dtype=np.int64), valid
     if op == Op.LIKE:
-        pat = f.extra
-        rx = re.compile(_like_to_regex(pat), re.S)
+        pat, esc = f.extra if isinstance(f.extra, tuple) \
+            else (f.extra, "\\")
+        rx = re.compile(_like_to_regex(pat, esc), re.S)
         return vec(lambda x: 1 if rx.fullmatch(s(x)) else 0, datas[0],
                    dtype=np.int64), valid
     raise NotImplementedError(op)
 
 
-def _like_to_regex(pat: str) -> str:
-    """MySQL LIKE pattern -> regex (%, _ wildcards, backslash escapes).
-    Ref: expression/builtin_like.go."""
+def _like_to_regex(pat: str, esc: str = "\\") -> str:
+    """MySQL LIKE pattern -> regex (%, _ wildcards; `esc` escapes them,
+    ESCAPE '' disables escaping). Ref: expression/builtin_like.go."""
     out = []
     i = 0
     while i < len(pat):
         c = pat[i]
-        if c == "\\" and i + 1 < len(pat):
+        if esc and c == esc and i + 1 < len(pat):
             out.append(re.escape(pat[i + 1]))
             i += 2
             continue

@@ -126,6 +126,7 @@ class LikeExpr(ExprNode):
     expr: ExprNode
     pattern: ExprNode
     negated: bool = False
+    escape: str = "\\"       # LIKE ... ESCAPE 'c'; "" = no escape char
 
 
 @dataclass

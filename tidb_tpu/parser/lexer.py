@@ -66,7 +66,7 @@ KEYWORDS = {
 NON_RESERVED = {
     "LOAD", "DATA", "INFILE", "TERMINATED", "ENCLOSED", "ESCAPED",
     "LINES", "OPTIONALLY", "STARTING", "SPLIT", "AT", "REGIONS", "LOCAL",
-    "KILL", "TIDB", "CONNECTION", "QUERY", "DO", "FLUSH",
+    "KILL", "TIDB", "CONNECTION", "QUERY", "DO", "FLUSH", "ESCAPE",
 }
 
 

@@ -1275,8 +1275,23 @@ class Parser:
                 continue
             if t.is_kw("LIKE"):
                 self.next()
-                left = ast.LikeExpr(expr=left, pattern=self.bit_or_expr(),
-                                    negated=neg)
+                pat = self.bit_or_expr()
+                esc = "\\"
+                if self.try_word("ESCAPE"):
+                    et = self.next()
+                    if et.tp != TokenType.STRING or len(et.val) > 1:
+                        raise ParseError(
+                            "ESCAPE must be a one-character string", et)
+                    esc = et.val
+                left = ast.LikeExpr(expr=left, pattern=pat, negated=neg,
+                                    escape=esc)
+                continue
+            if t.tp in (TokenType.IDENT, TokenType.KEYWORD) and \
+                    t.val.upper() in ("REGEXP", "RLIKE"):
+                self.next()
+                fc = ast.FuncCall(name="REGEXP_LIKE",
+                                  args=[left, self.bit_or_expr()])
+                left = ast.UnaryOp("NOT", fc) if neg else fc
                 continue
             if neg:
                 self.i = j  # lone NOT belongs to a higher level
@@ -1340,6 +1355,13 @@ class Parser:
 
     def unary_expr(self):
         t = self.peek()
+        if t.is_kw("BINARY") and not (
+                self.peek(1).tp == TokenType.OP and
+                self.peek(1).val in (")", ",")):
+            # BINARY expr: collation cast — a no-op here, comparisons
+            # are utf8_bin everywhere (docs/DEVIATIONS.md)
+            self.next()
+            return self.unary_expr()
         if t.tp == TokenType.OP and t.val in ("-", "+", "~", "!"):
             self.next()
             if t.val == "+":
@@ -1509,6 +1531,26 @@ class Parser:
 
     def func_call(self, name: str) -> ast.ExprNode:
         self.expect_op("(")
+        if name == "EXTRACT":
+            # EXTRACT(unit FROM e) desugars to the field functions
+            return self._extract_expr()
+        if name in ("TIMESTAMPDIFF", "TIMESTAMPADD"):
+            # first argument is a bare unit word, not an expression
+            ut = self.next()
+            if ut.tp not in (TokenType.IDENT, TokenType.KEYWORD):
+                raise ParseError("expected time unit", ut)
+            unit = ut.val.upper()
+            self.expect_op(",")
+            a1 = self.expr()
+            self.expect_op(",")
+            a2 = self.expr()
+            self.expect_op(")")
+            if name == "TIMESTAMPADD":
+                return ast.FuncCall(name="DATE_ADD", args=[
+                    a2, ast.FuncCall(name="INTERVAL",
+                                     args=[a1, ast.Literal(unit)])])
+            return ast.FuncCall(name="TIMESTAMPDIFF",
+                                args=[ast.Literal(unit), a1, a2])
         if name in _AGG_FUNCS:
             distinct = self.try_kw("DISTINCT")
             if self.try_op("*"):
@@ -1539,6 +1581,23 @@ class Parser:
                     break
             self.expect_op(")")
         return ast.FuncCall(name=name, args=args)
+
+    def _extract_expr(self) -> ast.ExprNode:
+        ut = self.next()
+        if ut.tp not in (TokenType.IDENT, TokenType.KEYWORD):
+            raise ParseError("expected time unit", ut)
+        unit = ut.val.upper()
+        self.expect_kw("FROM")
+        e = self.expr()
+        self.expect_op(")")
+        if unit in ("YEAR", "MONTH", "DAY", "HOUR", "MINUTE", "SECOND",
+                    "WEEK", "QUARTER", "MICROSECOND"):
+            return ast.FuncCall(name=unit, args=[e])
+        if unit == "YEAR_MONTH":
+            return ast.BinaryOp("+", ast.BinaryOp(
+                "*", ast.FuncCall(name="YEAR", args=[e]),
+                ast.Literal(100)), ast.FuncCall(name="MONTH", args=[e]))
+        raise ParseError(f"unsupported EXTRACT unit {unit}", ut)
 
     def _interval_expr(self) -> ast.FuncCall:
         """`n UNIT` after a consumed INTERVAL keyword."""

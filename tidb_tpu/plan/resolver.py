@@ -8,6 +8,7 @@ plan/resolver.go name checks.
 from __future__ import annotations
 
 import datetime as _dt
+import decimal as _decimal
 from dataclasses import dataclass, field
 
 from tidb_tpu import sqltypes as st
@@ -261,6 +262,11 @@ class Resolver:
     def _r_UnaryOp(self, e: ast.UnaryOp) -> Expression:
         a = self.resolve(e.operand)
         if e.op == "-":
+            # fold over numeric literals: INTERVAL -1 MONTH and range
+            # pruning both want a plain Constant, not a ScalarFunc
+            if isinstance(a, Constant) and not isinstance(a.value, bool) \
+                    and isinstance(a.value, (int, float, _decimal.Decimal)):
+                return Constant(-a.value, a.ft)
             return func(Op.UNARY_MINUS, a)
         if e.op == "NOT":
             return func(Op.NOT, a)
@@ -309,7 +315,8 @@ class Resolver:
         pat = self.resolve(e.pattern)
         if not isinstance(pat, Constant) or not isinstance(pat.value, str):
             raise ResolveError("LIKE pattern must be a string literal")
-        out = func(Op.LIKE, self.resolve(e.expr), extra=pat.value)
+        out = func(Op.LIKE, self.resolve(e.expr),
+                   extra=(pat.value, e.escape))
         return func(Op.NOT, out) if e.negated else out
 
     def _r_CaseExpr(self, e: ast.CaseExpr) -> Expression:
@@ -399,26 +406,35 @@ class Resolver:
             unit = "DAY"
         if not isinstance(n, Constant):
             raise ResolveError("INTERVAL amount must be constant")
-        amount = int(n.value)
-        days = {"DAY": 1, "WEEK": 7, "MONTH": 30, "YEAR": 365,
-                "QUARTER": 91}.get(unit)
-        if days is None:
+        amount = int(n.value) * (-1 if sub else 1)
+        us_per = {"MICROSECOND": 1, "SECOND": 1_000_000,
+                  "MINUTE": 60_000_000, "HOUR": 3_600_000_000,
+                  "DAY": 86_400_000_000, "WEEK": 7 * 86_400_000_000}
+        months_per = {"MONTH": 1, "QUARTER": 3, "YEAR": 12}
+        if unit in us_per:
+            total = amount * us_per[unit]
+            if isinstance(base, Constant):
+                return Constant(None if base.value is None
+                                else base.value + total, base.ft)
+            return func(Op.DATE_ADD_US, base, const(total))
+        if unit not in months_per:
             raise ResolveError(f"unsupported INTERVAL unit {unit}")
-        if unit in ("MONTH", "YEAR", "QUARTER") and isinstance(base, Constant):
-            # calendar-exact for constants (the common TPC-H case)
+        months = months_per[unit] * amount
+        if isinstance(base, Constant):
+            # fold for constants so index range pruning still sees a
+            # plain comparison constant (the common TPC-H case)
             dt = st.micros_to_datetime(base.value)
-            months = {"MONTH": 1, "YEAR": 12, "QUARTER": 3}[unit] * amount
-            if sub:
-                months = -months
             y = dt.year + (dt.month - 1 + months) // 12
             m = (dt.month - 1 + months) % 12 + 1
             try:
                 nd = dt.replace(year=y, month=m)
-            except ValueError:  # e.g. Jan 31 + 1 month
-                nd = dt.replace(year=y, month=m, day=28)
+            except ValueError:  # day beyond target month: clamp
+                nxt_y, nxt_m = (y, m + 1) if m < 12 else (y + 1, 1)
+                last = (_dt.date(nxt_y, nxt_m, 1) -
+                        _dt.timedelta(days=1)).day
+                nd = dt.replace(year=y, month=m, day=last)
             return Constant(st.datetime_to_micros(nd), base.ft)
-        return func(Op.DATE_SUB_DAYS if sub else Op.DATE_ADD_DAYS, base,
-                    const(amount * days))
+        return func(Op.ADD_MONTHS, base, const(months))
 
     def _r_AggregateCall(self, e: ast.AggregateCall) -> Expression:
         if self.aggs is None:
