@@ -153,3 +153,96 @@ class TestExplain:
         plan2 = "\n".join(r[0] for r in q(
             tk, "EXPLAIN SELECT a FROM t WHERE b IN (SELECT y FROM u)"))
         assert "Apply" in plan2 and "uncorrelated" in plan2
+
+
+class TestQuantified:
+    """expr <cmp> ANY/SOME/ALL (SELECT ...) with three-valued logic
+    (ref: plan/expression_rewriter.go handleCompareSubquery)."""
+
+    def test_ordering_any_all(self, tk):
+        assert q(tk, "SELECT a FROM t WHERE b > ANY (SELECT y FROM u "
+                     "WHERE y IS NOT NULL) ORDER BY a") == [(2,), (3,)]
+        assert q(tk, "SELECT a FROM t WHERE b > ALL (SELECT y FROM u "
+                     "WHERE y IS NOT NULL) ORDER BY a") == [(3,)]
+        assert q(tk, "SELECT a FROM t WHERE b = SOME (SELECT y FROM u) "
+                     "ORDER BY a") == [(1,), (2,)]
+
+    def test_empty_set(self, tk):
+        # ALL over the empty set is TRUE (even for NULL b); ANY FALSE
+        assert q(tk, "SELECT COUNT(*) FROM t WHERE b > ALL "
+                     "(SELECT y FROM u WHERE x > 90)") == [(4,)]
+        assert q(tk, "SELECT COUNT(*) FROM t WHERE b > ANY "
+                     "(SELECT y FROM u WHERE x > 90)") == [(0,)]
+
+    def test_null_in_set_blocks_all(self, tk):
+        # u.y holds a NULL: nothing is definitely > ALL of it
+        assert q(tk, "SELECT COUNT(*) FROM t WHERE b > ALL "
+                     "(SELECT y FROM u)") == [(0,)]
+        # but definite violations (10 > 10, 20 > 20 both false) still
+        # pass the negation; b=30 is NULL-blocked, NULL stays NULL
+        assert q(tk, "SELECT a FROM t WHERE NOT (b > ALL "
+                     "(SELECT y FROM u)) ORDER BY a") == [(1,), (2,)]
+
+    def test_ne_quantifiers(self, tk):
+        assert q(tk, "SELECT a FROM t WHERE b <> ALL (SELECT y FROM u "
+                     "WHERE y IS NOT NULL) ORDER BY a") == [(3,)]
+        assert q(tk, "SELECT a FROM t WHERE b <> ANY (SELECT y FROM u "
+                     "WHERE y IS NOT NULL) ORDER BY a") == \
+            [(1,), (2,), (3,)]
+
+
+class TestScalarSubqueryExpr:
+    """Scalar (SELECT ...) in expression position: select list, HAVING,
+    ORDER BY, and general WHERE arithmetic — lifted to applied columns
+    (ref: plan/expression_rewriter.go handleScalarSubquery)."""
+
+    def test_select_list(self, tk):
+        assert q(tk, "SELECT a, (SELECT MAX(y) FROM u) FROM t "
+                     "WHERE a = 1") == [(1, 20)]
+
+    def test_correlated_select_list(self, tk):
+        assert q(tk, "SELECT a, (SELECT COUNT(*) FROM u WHERE u.x < t.a)"
+                     " FROM t ORDER BY a") == \
+            [(1, 0), (2, 1), (3, 2), (4, 2)]
+
+    def test_where_arithmetic(self, tk):
+        assert q(tk, "SELECT a FROM t WHERE b = "
+                     "(SELECT MIN(y) FROM u) + 10") == [(2,)]
+
+    def test_empty_scalar_is_null(self, tk):
+        assert q(tk, "SELECT (SELECT y FROM u WHERE x > 90) IS NULL "
+                     "FROM t WHERE a = 1") == [(1,)]
+
+    def test_order_by_and_having(self, tk):
+        assert q(tk, "SELECT a FROM t ORDER BY "
+                     "b - (SELECT MIN(y) FROM u) DESC LIMIT 2") == \
+            [(3,), (2,)]
+        # groups: c<=2 sums to 10 (== MIN(y), excluded), c>2 to 50
+        assert q(tk, "SELECT c > 2, SUM(b) FROM t GROUP BY c > 2 "
+                     "HAVING SUM(b) > (SELECT MIN(y) FROM u) "
+                     "ORDER BY 1") == [(1, 50)]
+
+    def test_multirow_scalar_errors(self, tk):
+        with pytest.raises(SQLError, match="more than 1 row"):
+            q(tk, "SELECT (SELECT y FROM u) FROM t")
+
+
+class TestLiftEdges:
+    def test_star_not_polluted_by_lifted_column(self, tk):
+        assert q(tk, "SELECT * FROM t WHERE b = "
+                     "(SELECT MIN(y) FROM u) + 10") == [(2, 20, 2.5)]
+        rows = q(tk, "SELECT * FROM t ORDER BY "
+                     "b - (SELECT MIN(y) FROM u) LIMIT 1")
+        assert rows == [(4, None, 4.5)]   # NULL key sorts first ASC
+
+    def test_in_subquery_in_expression_stays_loud(self, tk):
+        # IN's row-set subquery must not be mistaken for a scalar
+        for sql in ["SELECT a FROM t WHERE (b IN (SELECT y FROM u)) = 1",
+                    "SELECT a, b IN (SELECT y FROM u) FROM t"]:
+            with pytest.raises(SQLError):
+                q(tk, sql)
+
+    def test_nulleq_quantifier_rejected(self, tk):
+        from tidb_tpu.parser import ParseError
+        with pytest.raises(ParseError, match="quantified"):
+            q(tk, "SELECT a FROM t WHERE b <=> ANY (SELECT y FROM u)")

@@ -1403,6 +1403,9 @@ class ApplyExec(Executor):
 
     def chunks(self, ctx: ExecContext):
         plan = self.plan
+        if plan.mode == "scalar":
+            yield from self._scalar_chunks(ctx)
+            return
         cache = None            # uncorrelated: (vals, valid, has_rows)
         for chunk in self.child.chunks(ctx):
             n = chunk.num_rows
@@ -1432,6 +1435,51 @@ class ApplyExec(Executor):
                         row_left, 1, vals, valid, has)[0])
             yield chunk.filter(keep)
 
+    def _scalar_chunks(self, ctx):
+        """mode="scalar": append the inner's single value as a new
+        column (the planner's lifted scalar subquery)."""
+        plan = self.plan
+        ft = plan.schema.cols[-1].ft
+        dtype = np_dtype_for(ft.tp)
+        cache = None
+        for chunk in self.child.chunks(ctx):
+            n = chunk.num_rows
+            if n == 0:
+                continue
+            if not plan.corr:
+                if cache is None:
+                    cache = self._scalar_value(ctx)
+                val, ok = cache
+                data = np.full(n, val if ok else
+                               ("" if dtype == np.dtype(object) else 0),
+                               dtype=dtype)
+                valid = np.full(n, ok, dtype=bool)
+            else:
+                data = np.zeros(n, dtype=dtype) \
+                    if dtype != np.dtype(object) else \
+                    np.full(n, "", dtype=object)
+                valid = np.zeros(n, dtype=bool)
+                for i in range(n):
+                    for oi, cell in plan.corr:
+                        c = chunk.columns[oi]
+                        cell.cell[0] = c.data[i]
+                        cell.cell[1] = bool(c.valid[i])
+                    val, ok = self._scalar_value(ctx)
+                    if ok:
+                        data[i] = val
+                        valid[i] = True
+            yield Chunk(chunk.columns + [Column(ft, data, valid)])
+
+    def _scalar_value(self, ctx):
+        """Run the inner plan expecting at most one row -> (value, ok);
+        an empty result is SQL NULL."""
+        vals, valid, has = self._run_inner(ctx, first_only=False)
+        if not has or len(vals) == 0:
+            return None, False
+        if len(vals) > 1:
+            raise ExecError("Subquery returns more than 1 row")
+        return vals[0], bool(valid[0])
+
     def _run_inner(self, ctx, first_only: bool):
         """-> (first-column values, valid, has_rows)."""
         exe = build_executor(self.plan.inner)
@@ -1457,6 +1505,8 @@ class ApplyExec(Executor):
             r = np.full(n, has, dtype=bool)
             return ~r if plan.negated else r
         if plan.mode == "cmp":
+            if plan.quant:
+                return self._quant_mask(left, n, vals, valid)
             if not has or len(vals) == 0:
                 return np.zeros(n, dtype=bool)   # NULL -> filtered
             if len(vals) > 1:
@@ -1466,13 +1516,7 @@ class ApplyExec(Executor):
         ld, lv = left
         inner = vals[valid] if len(vals) else vals
         has_null = bool((~valid).any()) if len(valid) else False
-        ld, inner = self._norm_in_sides(ld, inner)
-        if len(inner) and inner.dtype != np.dtype(object) and \
-                ld.dtype != np.dtype(object):
-            match = np.isin(ld, inner)
-        else:
-            pool = set(inner.tolist())
-            match = np.array([v in pool for v in ld], dtype=bool)
+        match = self._set_match(ld, inner)
         if plan.negated:
             # NOT IN: TRUE only for valid left, no match, and no NULLs
             # in the subquery result (else NULL) — except the empty set,
@@ -1501,6 +1545,86 @@ class ApplyExec(Executor):
         def to_f(d, frac):
             return np.asarray(d).astype(np.float64) / (10.0 ** frac)
         return to_f(ld, lfrac), to_f(inner, ifrac)
+
+    def _quant_mask(self, left, n: int, vals, valid):
+        """expr <cmp> ANY/ALL (subquery) with SQL three-valued logic
+        (ref: expression/builtin_compare.go + plan rewrite of
+        quantified comparisons): only the set's extrema decide ordering
+        comparisons, so no per-element loop is needed.
+
+        ANY:  TRUE if some valid element satisfies; else NULL if the
+              set has NULLs or the left is NULL; else FALSE (empty ->
+              FALSE).
+        ALL:  FALSE if some valid element violates; else NULL if the
+              set has NULLs or the left is NULL; else TRUE (empty ->
+              TRUE)."""
+        from tidb_tpu.expression.core import Op as _Op
+        plan = self.plan
+        ld, lv = left
+        vv = vals[valid] if len(vals) else vals
+        has_null_inner = bool((~valid).any()) if len(valid) else False
+        is_all = plan.quant == "all"
+        if len(vv) == 0:
+            if has_null_inner:          # all-NULL set: always NULL
+                return np.zeros(n, dtype=bool)
+            base = np.full(n, is_all, dtype=bool)   # truly empty set
+            return ~base if plan.negated else base
+        op = plan.cmp_op
+
+        def cmp_vs(v, o):
+            return self._one_cmp(ld, lv, n, v, o)
+
+        lo, hi = vv.min(), vv.max()
+        if op in (_Op.EQ, _Op.NE):
+            # = ANY is IN; = ALL: every element equal (min==v==max);
+            # <> ALL is NOT IN; <> ANY: some element differs
+            def all_eq():
+                return cmp_vs(lo, _Op.EQ) & cmp_vs(hi, _Op.EQ)
+            def in_set():
+                return lv & self._set_match(ld, vv)
+            if op == _Op.EQ:
+                true_m = all_eq() if is_all else in_set()
+            else:
+                true_m = (lv & ~in_set()) if is_all else (lv & ~all_eq())
+        else:
+            # ordering: ANY against the friendliest element, ALL
+            # against the harshest
+            pick_min = (op in (_Op.GT, _Op.GE)) != is_all
+            true_m = cmp_vs(lo if pick_min else hi, op)
+        if is_all:
+            # violation is definite FALSE even with NULLs around
+            false_m = lv & ~true_m
+            if has_null_inner:
+                true_m = np.zeros(n, dtype=bool)
+            return false_m if plan.negated else true_m
+        if has_null_inner:
+            false_m = np.zeros(n, dtype=bool)
+        else:
+            false_m = lv & ~true_m
+        return false_m if plan.negated else true_m
+
+    def _set_match(self, ld, inner):
+        """Membership of each left value in the inner set, after the
+        shared type normalization. Used by IN and the EQ quantifiers."""
+        ld2, inner2 = self._norm_in_sides(ld, inner)
+        if len(inner2) and inner2.dtype != np.dtype(object) and \
+                ld2.dtype != np.dtype(object):
+            return np.isin(ld2, inner2)
+        pool = set(inner2.tolist())
+        return np.array([v in pool for v in ld2], dtype=bool)
+
+    def _one_cmp(self, ld, lv, n: int, v, op):
+        """Vector compare of the left side against one inner value,
+        through the expression layer for type-correct semantics."""
+        plan = self.plan
+        ift = plan.inner.schema.cols[0].ft
+        dt = np.dtype(object) if isinstance(v, (str, bytes)) else None
+        rhs_d = np.full(n, v, dtype=dt)
+        lexpr = _ArrayExpr(plan.left.ft, ld, lv)
+        rexpr = _ArrayExpr(ift, rhs_d, np.ones(n, dtype=bool))
+        from tidb_tpu.expression.core import func as _f
+        d, vmask = _f(op, lexpr, rexpr).eval_xp(np, [], n)
+        return np.asarray(d).astype(bool) & np.asarray(vmask) & lv
 
     def _cmp_mask(self, left, n: int, vals, valid):
         plan = self.plan

@@ -19,7 +19,8 @@ __all__ = [
     "Node", "ExprNode", "StmtNode",
     "Literal", "ColName", "Star", "BinaryOp", "UnaryOp", "FuncCall",
     "AggregateCall", "CaseExpr", "InExpr", "BetweenExpr", "LikeExpr",
-    "IsNullExpr", "CastExpr", "ExistsSubquery", "SubqueryExpr", "RowExpr",
+    "IsNullExpr", "CastExpr", "ExistsSubquery", "SubqueryExpr",
+    "QuantSubquery", "RowExpr",
     "VariableExpr", "DefaultExpr", "ParamMarker",
     "JoinType", "TableSource", "Join", "SubqueryTable",
     "SelectField", "ByItem", "SelectStmt", "UnionStmt",
@@ -143,6 +144,15 @@ class CastExpr(ExprNode):
 
 @dataclass
 class SubqueryExpr(ExprNode):
+    select: "SelectStmt" = None
+
+
+@dataclass
+class QuantSubquery(ExprNode):
+    """expr <cmp> ANY/SOME/ALL (SELECT ...)."""
+    expr: ExprNode = None
+    op: str = "="            # comparison operator token
+    quant: str = "any"       # "any" (SOME == ANY) | "all"
     select: "SelectStmt" = None
 
 

@@ -1220,6 +1220,23 @@ class Parser:
             t = self.peek()
             if t.tp == TokenType.OP and t.val in _CMP_OPS:
                 self.next()
+                qt = self.peek()
+                if qt.tp in (TokenType.IDENT, TokenType.KEYWORD) and \
+                        qt.val.upper() in ("ANY", "SOME", "ALL") and \
+                        self.peek(1).tp == TokenType.OP and \
+                        self.peek(1).val == "(":
+                    if t.val == "<=>":
+                        raise ParseError(
+                            "<=> cannot be quantified with ANY/ALL", t)
+                    self.next()
+                    self.expect_op("(")
+                    sub = self.select_or_union()
+                    self.expect_op(")")
+                    left = ast.QuantSubquery(
+                        expr=left, op=t.val,
+                        quant="all" if qt.val.upper() == "ALL" else "any",
+                        select=sub)
+                    continue
                 left = ast.BinaryOp(t.val, left, self.bit_or_expr())
                 continue
             if t.is_kw("IS"):

@@ -264,10 +264,11 @@ class PhysApply(PhysPlan):
     (the reference's uncorrelated EvalSubquery rewrite)."""
 
     inner: "PhysPlan" = None
-    mode: str = "exists"           # exists | in | cmp
+    mode: str = "exists"           # exists | in | cmp | scalar
     negated: bool = False
     left: Optional[Expression] = None      # IN target / cmp left side
     cmp_op: Optional[object] = None        # expression Op for cmp mode
+    quant: str = ""                # cmp mode: "" | "any" | "all"
     corr: list = field(default_factory=list)   # [(outer_idx, CorrelatedCol)]
 
     def _explain_info(self):

@@ -392,7 +392,15 @@ class Planner:
             plan.cond = func(Op.AND, plan.cond, cond)
             return plan
         if isinstance(plan, ph.PhysApply):
-            # sink plain predicates below the apply (same outer schema):
+            if plan.mode == "scalar" and any(
+                    i >= len(plan.children[0].schema)
+                    for i in cond.columns_used()):
+                # the predicate reads the appended scalar column: it
+                # cannot sink below the apply that produces it
+                return ph.PhysSelection(schema=plan.schema,
+                                        children=[plan], cond=cond)
+            # sink plain predicates below the apply (same outer schema,
+            # scalar appends at the end so base indices are stable):
             # the correlated inner then runs only for surviving rows
             plan.children[0] = self._assign_cond(plan.children[0], cond,
                                                  where_phase)
@@ -901,9 +909,21 @@ class Planner:
             if applied is not None:
                 plan = applied
                 continue
+            if _contains_scalar_subquery(c_ast):
+                # subquery in a general expression position, e.g.
+                # v > (SELECT ...) + 1: lift it to an applied column
+                plan, c_ast = self._lift_scalars_in_expr(plan, c_ast)
+                plan = ph.PhysSelection(
+                    schema=plan.schema, children=[plan],
+                    cond=Resolver(plan.schema).resolve(c_ast))
+                continue
             plan = self._assign_cond(plan,
                                      Resolver(plan.schema).resolve(c_ast),
                                      where_phase=True)
+
+        # scalar subqueries in select/having/order project as applied
+        # columns before anything reads those expressions
+        plan, stmt = self._lift_scalar_subqueries(plan, stmt)
 
         has_agg = bool(stmt.group_by) or _contains_agg(stmt)
         if has_agg:
@@ -1090,6 +1110,18 @@ class Planner:
                                 negated=neg,
                                 left=left, corr=corr)
 
+        if isinstance(node, ast.QuantSubquery):
+            # expr <cmp> ANY/ALL (SELECT ...): apply with quantifier
+            # (ref: plan/expression_rewriter.go handleCompareSubquery)
+            inner, corr = self._plan_subquery(plan.schema, node.select)
+            if len(inner.schema.cols) != 1:
+                raise PlanError("subquery must return 1 column")
+            left = Resolver(plan.schema).resolve(node.expr)
+            return ph.PhysApply(schema=plan.schema, children=[plan],
+                                inner=inner, mode="cmp", negated=negate,
+                                left=left, cmp_op=self._CMP_OPS[node.op],
+                                quant=node.quant, corr=corr)
+
         if isinstance(node, ast.BinaryOp) and node.op in self._CMP_OPS:
             lhs_sub = isinstance(node.left, ast.SubqueryExpr)
             rhs_sub = isinstance(node.right, ast.SubqueryExpr)
@@ -1111,6 +1143,103 @@ class Planner:
                                 inner=inner, mode="cmp", negated=negate,
                                 left=left, cmp_op=op, corr=corr)
         return None
+
+    def _lift_scalars_in_expr(self, plan: ph.PhysPlan, e):
+        """Replace every scalar (SELECT ...) inside `e` with a reference
+        to a column appended by a PhysApply mode="scalar" wrapped around
+        `plan` (ref: plan/expression_rewriter.go handleScalarSubquery).
+        Returns the (possibly wrapped) plan and the rewritten AST."""
+        import dataclasses
+        holder = [plan]
+
+        def lift(node):
+            outer = holder[0]
+            inner, corr = self._plan_subquery(outer.schema, node.select)
+            if len(inner.schema.cols) != 1:
+                raise PlanError("scalar subquery must return 1 column")
+            name = f"__sq{len(outer.schema.cols)}"
+            sc = SchemaCol(name, "", inner.schema.cols[0].ft)
+            holder[0] = ph.PhysApply(
+                schema=PlanSchema(outer.schema.cols + [sc]),
+                children=[outer], inner=inner, mode="scalar", corr=corr)
+            return ast.ColName(name=name)
+
+        def walk(node):
+            if isinstance(node, ast.SubqueryExpr):
+                return lift(node)
+            if isinstance(node, ast.InExpr) and \
+                    isinstance(node.items, ast.SubqueryExpr):
+                # the IN set is a row set, not a scalar: leave it for
+                # the conjunct/apply machinery (or its loud error)
+                ne = walk(node.expr)
+                return dataclasses.replace(node, expr=ne) \
+                    if ne is not node.expr else node
+            return self._rewrite_ast_shallow(node, walk)
+
+        ne = walk(e)        # mutates holder: must run before the read
+        return holder[0], ne
+
+    def _rewrite_ast_shallow(self, e, walk):
+        """One dataclass-rebuild level: recurse via `walk` (which owns
+        the node-type decisions), no fn applied to `e` itself."""
+        import dataclasses
+        if dataclasses.is_dataclass(e) and isinstance(e, ast.ExprNode) \
+                and not isinstance(e, (ast.SubqueryExpr,
+                                       ast.ExistsSubquery,
+                                       ast.QuantSubquery)):
+            updates = {}
+            for fld in dataclasses.fields(e):
+                v = getattr(e, fld.name)
+                if isinstance(v, ast.ExprNode):
+                    nv = walk(v)
+                    if nv is not v:
+                        updates[fld.name] = nv
+                elif isinstance(v, list):
+                    nl = [self._walk_item(x, walk) for x in v]
+                    if any(a is not b for a, b in zip(nl, v)):
+                        updates[fld.name] = nl
+            if updates:
+                return dataclasses.replace(e, **updates)
+        return e
+
+    @staticmethod
+    def _walk_item(x, walk):
+        if isinstance(x, ast.ExprNode):
+            return walk(x)
+        if isinstance(x, tuple) and any(
+                isinstance(y, ast.ExprNode) for y in x):
+            nt = tuple(walk(y) if isinstance(y, ast.ExprNode) else y
+                       for y in x)
+            return x if all(a is b for a, b in zip(nt, x)) else nt
+        return x
+
+    def _lift_scalar_subqueries(self, plan: ph.PhysPlan,
+                                stmt: ast.SelectStmt):
+        import dataclasses
+        exprs = [f.expr for f in stmt.fields]
+        if stmt.having is not None:
+            exprs.append(stmt.having)
+        exprs.extend(b.expr for b in stmt.order_by or [])
+        if not any(_contains_scalar_subquery(x) for x in exprs):
+            return plan, stmt
+        changed = {}
+        fields = []
+        for f in stmt.fields:
+            plan, ne = self._lift_scalars_in_expr(plan, f.expr)
+            fields.append(dataclasses.replace(f, expr=ne)
+                          if ne is not f.expr else f)
+        changed["fields"] = fields
+        if stmt.having is not None:
+            plan, nh = self._lift_scalars_in_expr(plan, stmt.having)
+            changed["having"] = nh
+        if stmt.order_by:
+            order = []
+            for b in stmt.order_by:
+                plan, ne = self._lift_scalars_in_expr(plan, b.expr)
+                order.append(dataclasses.replace(b, expr=ne)
+                             if ne is not b.expr else b)
+            changed["order_by"] = order
+        return plan, dataclasses.replace(stmt, **changed)
 
     def _try_decorrelate(self, plan: ph.PhysPlan, sub_select,
                          anti: bool, in_expr) -> ph.PhysPlan | None:
@@ -1226,6 +1355,8 @@ class Planner:
             if isinstance(f.expr, ast.Star):
                 tbl = f.expr.table.lower()
                 for i, c in enumerate(schema.cols):
+                    if not c.table and c.name.startswith("__sq"):
+                        continue   # lifted scalar-subquery helper column
                     if not tbl or c.table == tbl:
                         out.append((ast.ColName(name=c.name, table=c.table),
                                     c.name))
@@ -1643,6 +1774,35 @@ def _union_ft(fts):
                    if ft.eval_type == EvalType.DECIMAL)
         return new_decimal_field(30, frac)
     return new_string_field(255)
+
+
+def _contains_scalar_subquery(e) -> bool:
+    """True when a SubqueryExpr appears in expression position inside
+    `e` (not crossing into nested subquery bodies)."""
+    if isinstance(e, ast.SubqueryExpr):
+        return True
+    if not isinstance(e, ast.Node) or \
+            isinstance(e, (ast.ExistsSubquery, ast.QuantSubquery)):
+        return False
+    if isinstance(e, ast.InExpr) and \
+            isinstance(e.items, ast.SubqueryExpr):
+        return _contains_scalar_subquery(e.expr)   # row set, not scalar
+    for f in vars(e).values():
+        if isinstance(f, ast.Node) and not isinstance(
+                f, (ast.SelectStmt, ast.UnionStmt)):
+            if _contains_scalar_subquery(f):
+                return True
+        elif isinstance(f, (list, tuple)):
+            for x in f:
+                if isinstance(x, ast.Node) and not isinstance(
+                        x, (ast.SelectStmt, ast.UnionStmt)):
+                    if _contains_scalar_subquery(x):
+                        return True
+                elif isinstance(x, tuple) and any(
+                        _contains_scalar_subquery(y) for y in x
+                        if isinstance(y, ast.Node)):
+                    return True
+    return False
 
 
 def _contains_agg(stmt: ast.SelectStmt) -> bool:
