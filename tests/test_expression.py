@@ -148,3 +148,75 @@ def test_round_decimal():
                                   (decimal.Decimal("-2.35"),)])
     e = func(Op.ROUND, col(0, DEC2), const(1))
     assert ev(e, ch) == [240, -240]  # 2.4 / -2.4 at frac 2
+
+
+class TestRowExpressions:
+    """(a,b) <cmp> (c,d) and (a,b) IN ((..),(..)) desugar to scalar
+    logic (ref: expression/expression.go row expressions); NULL rows
+    follow Kleene semantics — a decided first component decides."""
+
+    @pytest.fixture(scope="class")
+    def rs(self):
+        from tidb_tpu.session import Session
+        from tidb_tpu.store.storage import new_mock_storage
+        s = Session(new_mock_storage())
+        s.execute("CREATE DATABASE d")
+        s.execute("USE d")
+        s.execute("CREATE TABLE r (a BIGINT PRIMARY KEY, b BIGINT, "
+                  "c VARCHAR(8))")
+        s.execute("INSERT INTO r VALUES (1,10,'x'),(2,20,'y'),"
+                  "(3,30,'z'),(4,NULL,'w')")
+        yield s
+        s.close()
+
+    def test_eq_ne(self, rs):
+        assert rs.query("SELECT a FROM r WHERE (a, b) = (2, 20)"
+                        ).rows == [(2,)]
+        # (4,NULL) <> (2,20): first component decides -> TRUE
+        assert rs.query("SELECT a FROM r WHERE (a, b) <> (2, 20) "
+                        "ORDER BY a").rows == [(1,), (3,), (4,)]
+
+    def test_in_not_in(self, rs):
+        assert rs.query("SELECT a FROM r WHERE (a, b) IN ((1,10),(3,30))"
+                        " ORDER BY a").rows == [(1,), (3,)]
+        assert rs.query("SELECT a FROM r WHERE (a, b) NOT IN "
+                        "((1,10),(3,30)) ORDER BY a").rows == \
+            [(2,), (4,)]
+
+    def test_lexicographic_ordering(self, rs):
+        assert rs.query("SELECT a FROM r WHERE (a, b) < (2, 25) "
+                        "ORDER BY a").rows == [(1,), (2,)]
+        assert rs.query("SELECT a FROM r WHERE (a, b) <= (2, 19)"
+                        ).rows == [(1,)]
+        assert rs.query("SELECT a FROM r WHERE (a, b) >= (2, 20) "
+                        "ORDER BY a").rows == [(2,), (3,), (4,)]
+
+    def test_null_component_undecided(self, rs):
+        assert rs.query("SELECT a FROM r WHERE (a, b) = (4, NULL)"
+                        ).rows == []
+
+    def test_arity_and_position_errors(self, rs):
+        from tidb_tpu.session import SQLError
+        with pytest.raises(SQLError, match="2 column"):
+            rs.query("SELECT a FROM r WHERE (a,b) = (1,2,3)")
+        with pytest.raises(SQLError, match="2 column"):
+            rs.query("SELECT a FROM r WHERE (a,b) IN ((1,2,3))")
+        with pytest.raises(SQLError):
+            rs.query("SELECT (a,b) FROM r")
+
+    def test_interval_amount_folds(self, rs):
+        assert rs.query("SELECT DATE_ADD('2024-01-01', "
+                        "INTERVAL 1+1 DAY)").rows == \
+            [("2024-01-03 00:00:00",)]
+        assert rs.query("SELECT DATE_ADD('2024-01-01', "
+                        "INTERVAL NULL DAY) IS NULL").rows == [(1,)]
+
+    def test_decimal_interval_amount_rounds(self, rs):
+        # folded decimal amounts descale (not the scaled int!) and
+        # fractional amounts round half-up like MySQL
+        assert rs.query(
+            "SELECT DATE_ADD('2024-01-01', INTERVAL 1.5+0 DAY), "
+            "DATE_ADD('2024-01-01', INTERVAL 1.5 DAY), "
+            "DATE_ADD('2024-01-01', INTERVAL 0.4 DAY)").rows == \
+            [("2024-01-03 00:00:00", "2024-01-03 00:00:00",
+              "2024-01-01 00:00:00")]

@@ -1423,10 +1423,7 @@ class ApplyExec(Executor):
             else:
                 keep = np.zeros(n, dtype=bool)
                 for i in range(n):
-                    for oi, cell in plan.corr:
-                        c = chunk.columns[oi]
-                        cell.cell[0] = c.data[i]
-                        cell.cell[1] = bool(c.valid[i])
+                    self._bind_corr(chunk, i)
                     vals, valid, has = self._run_inner(
                         ctx, first_only=plan.mode == "exists")
                     row_left = None if left is None else \
@@ -1460,10 +1457,7 @@ class ApplyExec(Executor):
                     np.full(n, "", dtype=object)
                 valid = np.zeros(n, dtype=bool)
                 for i in range(n):
-                    for oi, cell in plan.corr:
-                        c = chunk.columns[oi]
-                        cell.cell[0] = c.data[i]
-                        cell.cell[1] = bool(c.valid[i])
+                    self._bind_corr(chunk, i)
                     val, ok = self._scalar_value(ctx)
                     if ok:
                         data[i] = val
@@ -1479,6 +1473,13 @@ class ApplyExec(Executor):
         if len(vals) > 1:
             raise ExecError("Subquery returns more than 1 row")
         return vals[0], bool(valid[0])
+
+    def _bind_corr(self, chunk, i: int):
+        """Bind outer row i into the inner plan's correlated cells."""
+        for oi, cell in self.plan.corr:
+            c = chunk.columns[oi]
+            cell.cell[0] = c.data[i]
+            cell.cell[1] = bool(c.valid[i])
 
     def _run_inner(self, ctx, first_only: bool):
         """-> (first-column values, valid, has_rows)."""

@@ -136,6 +136,35 @@ _AGG_MAP = {"COUNT": AggFunc.COUNT, "SUM": AggFunc.SUM, "AVG": AggFunc.AVG,
             "BIT_XOR": AggFunc.BIT_XOR,
             "GROUP_CONCAT": AggFunc.GROUP_CONCAT}
 
+def _row_eq(le: "ast.RowExpr", ri: "ast.RowExpr") -> ast.ExprNode:
+    """(a,b) = (c,d)  ->  a=c AND b=d."""
+    out = None
+    for x, y in zip(le.items, ri.items):
+        c = ast.BinaryOp("=", x, y)
+        out = c if out is None else ast.BinaryOp("AND", out, c)
+    return out
+
+
+def _row_ord(op: str, le, ri, i: int) -> ast.ExprNode:
+    """Lexicographic row ordering: (a1,a2) < (b1,b2) is
+    a1<b1 OR (a1=b1 AND a2<b2); <=/>= stay weak only at the tail."""
+    x, y = le.items[i], ri.items[i]
+    if i == len(le.items) - 1:
+        return ast.BinaryOp(op, x, y)
+    strict = {"<=": "<", ">=": ">"}.get(op, op)
+    return ast.BinaryOp(
+        "OR", ast.BinaryOp(strict, x, y),
+        ast.BinaryOp("AND", ast.BinaryOp("=", x, y),
+                     _row_ord(op, le, ri, i + 1)))
+
+
+def _has_correlated(x) -> bool:
+    from tidb_tpu.expression.core import CorrelatedCol
+    if isinstance(x, CorrelatedCol):
+        return True
+    return any(_has_correlated(a) for a in getattr(x, "args", ()))
+
+
 _BIN_OPS = {"+": Op.PLUS, "-": Op.MINUS, "*": Op.MUL, "/": Op.DIV,
             "DIV": Op.INTDIV, "%": Op.MOD, "MOD": Op.MOD,
             "=": Op.EQ, "<": Op.LT, "<=": Op.LE, ">": Op.GT, ">=": Op.GE,
@@ -219,6 +248,12 @@ class Resolver:
         return a, b
 
     def _r_BinaryOp(self, e: ast.BinaryOp) -> Expression:
+        if isinstance(e.left, ast.RowExpr) or \
+                isinstance(e.right, ast.RowExpr):
+            # (a,b) <cmp> (c,d): desugar to scalar logic (ref:
+            # expression/expression.go row-expression handling); NULLs
+            # propagate correctly through the Kleene AND/OR ops
+            return self.resolve(self._desugar_row_cmp(e))
         op = _BIN_OPS.get(e.op)
         if op is None:
             raise ResolveError(f"unsupported operator {e.op}")
@@ -282,6 +317,21 @@ class Resolver:
     def _r_InExpr(self, e: ast.InExpr) -> Expression:
         if isinstance(e.items, ast.SubqueryExpr):
             raise ResolveError("IN (subquery) not yet supported")
+        if isinstance(e.expr, ast.RowExpr):
+            # (a,b) IN ((1,2),(3,4)): OR over per-row equality chains
+            want = len(e.expr.items)
+            ors = None
+            for item in e.items:
+                if not isinstance(item, ast.RowExpr) or \
+                        len(item.items) != want:
+                    raise ResolveError(
+                        f"Operand should contain {want} column(s)")
+                c = _row_eq(e.expr, item)
+                ors = c if ors is None else ast.BinaryOp("OR", ors, c)
+            if ors is None:
+                raise ResolveError("IN list must not be empty")
+            out = self.resolve(ors)
+            return func(Op.NOT, out) if e.negated else out
         target = self.resolve(e.expr)
         vals = []
         for item in e.items:
@@ -404,9 +454,28 @@ class Resolver:
         else:
             n = self.resolve(iv)
             unit = "DAY"
+        if not isinstance(n, Constant) and not n.columns_used() and \
+                not _has_correlated(n):
+            # fold computed amounts (INTERVAL 1+1 DAY)
+            import numpy as _np
+            d, v = n.eval_xp(_np, [], 1)
+            val = None if not v[0] else (
+                d[0].item() if hasattr(d[0], "item") else d[0])
+            if val is not None and \
+                    n.ft.eval_type == st.EvalType.DECIMAL:
+                # eval_xp yields the scaled int representation
+                val = st.scaled_to_decimal(int(val), max(n.ft.frac, 0))
+            n = Constant(val, n.ft)
         if not isinstance(n, Constant):
             raise ResolveError("INTERVAL amount must be constant")
-        amount = int(n.value) * (-1 if sub else 1)
+        if n.value is None:
+            return Constant(None, base.ft)   # NULL interval -> NULL
+        v = n.value
+        if isinstance(v, (float, _decimal.Decimal)):
+            # MySQL rounds fractional amounts for integer units
+            v = _decimal.Decimal(str(v)).quantize(
+                0, rounding=_decimal.ROUND_HALF_UP)
+        amount = int(v) * (-1 if sub else 1)
         us_per = {"MICROSECOND": 1, "SECOND": 1_000_000,
                   "MINUTE": 60_000_000, "HOUR": 3_600_000_000,
                   "DAY": 86_400_000_000, "WEEK": 7 * 86_400_000_000}
@@ -465,7 +534,25 @@ class Resolver:
         raise ResolveError("EXISTS subqueries not yet supported")
 
     def _r_RowExpr(self, e):
-        raise ResolveError("row expressions not yet supported")
+        raise ResolveError(
+            "row expression only valid in comparisons and IN")
+
+    def _desugar_row_cmp(self, e: ast.BinaryOp) -> ast.ExprNode:
+        le, ri = e.left, e.right
+        if not (isinstance(le, ast.RowExpr) and
+                isinstance(ri, ast.RowExpr)):
+            n = len((le if isinstance(le, ast.RowExpr) else ri).items)
+            raise ResolveError(f"Operand should contain {n} column(s)")
+        if len(le.items) != len(ri.items):
+            raise ResolveError(
+                f"Operand should contain {len(le.items)} column(s)")
+        if e.op == "=":
+            return _row_eq(le, ri)
+        if e.op in ("<>", "!="):
+            return ast.UnaryOp("NOT", _row_eq(le, ri))
+        if e.op in ("<", ">", "<=", ">="):
+            return _row_ord(e.op, le, ri, 0)
+        raise ResolveError(f"unsupported row operator {e.op}")
 
     def _r_DefaultExpr(self, e):
         raise ResolveError("DEFAULT only valid in INSERT values")
