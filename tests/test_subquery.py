@@ -235,14 +235,60 @@ class TestLiftEdges:
                      "b - (SELECT MIN(y) FROM u) LIMIT 1")
         assert rows == [(4, None, 4.5)]   # NULL key sorts first ASC
 
-    def test_in_subquery_in_expression_stays_loud(self, tk):
-        # IN's row-set subquery must not be mistaken for a scalar
-        for sql in ["SELECT a FROM t WHERE (b IN (SELECT y FROM u)) = 1",
-                    "SELECT a, b IN (SELECT y FROM u) FROM t"]:
-            with pytest.raises(SQLError):
-                q(tk, sql)
+    def test_in_subquery_in_expression_position(self, tk):
+        # IN's row set in expression position keeps IN's three-valued
+        # semantics (u.y holds a NULL: non-matches go NULL, not 0)
+        assert q(tk, "SELECT a FROM t WHERE (b IN (SELECT y FROM u)) "
+                     "= 1 ORDER BY a") == [(1,), (2,)]
+        assert q(tk, "SELECT a, b IN (SELECT y FROM u) FROM t "
+                     "ORDER BY a") == \
+            [(1, 1), (2, 1), (3, None), (4, None)]
+        assert q(tk, "SELECT a, b NOT IN (SELECT y FROM u WHERE "
+                     "y IS NOT NULL) FROM t ORDER BY a") == \
+            [(1, 0), (2, 0), (3, 1), (4, None)]
+        # empty set: 0 even for NULL left
+        assert q(tk, "SELECT a, b IN (SELECT y FROM u WHERE x > 90) "
+                     "FROM t ORDER BY a") == \
+            [(1, 0), (2, 0), (3, 0), (4, 0)]
+
+    def test_exists_in_expression_position(self, tk):
+        assert q(tk, "SELECT a, EXISTS (SELECT 1 FROM u WHERE "
+                     "u.x = t.a) FROM t ORDER BY a") == \
+            [(1, 1), (2, 1), (3, 0), (4, 0)]
+        assert q(tk, "SELECT CASE WHEN EXISTS (SELECT 1 FROM u WHERE "
+                     "x = 99) THEN 'y' ELSE 'n' END") == [("n",)]
+        assert q(tk, "SELECT (SELECT MAX(y) FROM u) + 1, "
+                     "10 IN (SELECT y FROM u)") == [(21, 1)]
 
     def test_nulleq_quantifier_rejected(self, tk):
         from tidb_tpu.parser import ParseError
         with pytest.raises(ParseError, match="quantified"):
             q(tk, "SELECT a FROM t WHERE b <=> ANY (SELECT y FROM u)")
+
+
+class TestExprPositionEdges:
+    def test_aggregate_operand_clean_error(self, tk):
+        with pytest.raises(SQLError, match="aggregate"):
+            q(tk, "SELECT SUM(b) IN (SELECT y FROM u) FROM t")
+
+    def test_star_in_subquery_expression_position(self, tk):
+        with pytest.raises(SQLError, match="column named"):
+            q(tk, "SELECT 1 IN (SELECT * FROM u)")
+        # conjunct position keeps working with *
+        assert q(tk, "SELECT a FROM t WHERE a IN (SELECT * FROM "
+                     "(SELECT x FROM u) z) ORDER BY a") == [(1,), (2,)]
+
+    def test_string_fractional_interval(self, tk):
+        assert q(tk, "SELECT DATE_ADD('2024-01-01', "
+                     "INTERVAL '1.5' DAY)") == \
+            [("2024-01-03 00:00:00",)]
+        with pytest.raises(SQLError, match="INTERVAL amount"):
+            q(tk, "SELECT DATE_ADD('2024-01-01', INTERVAL 'abc' DAY)")
+
+    def test_fractional_second_is_microseconds(self, tk):
+        assert q(tk, "SELECT DATE_ADD('2024-01-01 00:00:00', "
+                     "INTERVAL 1.5 SECOND), "
+                     "DATE_SUB('2024-01-01 00:00:00', "
+                     "INTERVAL 0.25 SECOND)") == \
+            [("2024-01-01 00:00:01.500000",
+              "2023-12-31 23:59:59.750000")]

@@ -1048,7 +1048,19 @@ class Planner:
         return plan
 
     def _plan_select_no_from(self, stmt: ast.SelectStmt) -> ph.PhysPlan:
-        r = Resolver(PlanSchema([]))
+        plan = None
+        if any(_contains_scalar_subquery(f.expr) for f in stmt.fields
+               if not isinstance(f.expr, ast.Star)):
+            # subqueries over a one-row dual input: the lift appends
+            # their values as apply columns as usual (a zero-column
+            # chunk would report zero rows)
+            from tidb_tpu.sqltypes import new_int_field
+            ift = new_int_field()
+            plan = ph.PhysValues(
+                schema=PlanSchema([SchemaCol("__dual", "", ift)]),
+                rows=[[Constant(1, ift)]])
+            plan, stmt = self._lift_scalar_subqueries(plan, stmt)
+        r = Resolver(plan.schema if plan is not None else PlanSchema([]))
         exprs, names = [], []
         for f in stmt.fields:
             if isinstance(f.expr, ast.Star):
@@ -1058,8 +1070,10 @@ class Planner:
             names.append(f.alias or _field_name(f.expr))
         schema = PlanSchema([SchemaCol(n, "", e.ft)
                              for n, e in zip(names, exprs)])
-        vals = ph.PhysValues(schema=schema, rows=[exprs])
-        return vals
+        if plan is not None:
+            return ph.PhysProjection(schema=schema, children=[plan],
+                                     exprs=exprs)
+        return ph.PhysValues(schema=schema, rows=[exprs])
 
     # -- subquery conjuncts (ref: plan/expression_rewriter.go subquery
     # handling + decorrelateSolver; here: apply-style, uncorrelated inner
@@ -1169,11 +1183,33 @@ class Planner:
                 return lift(node)
             if isinstance(node, ast.InExpr) and \
                     isinstance(node.items, ast.SubqueryExpr):
-                # the IN set is a row set, not a scalar: leave it for
-                # the conjunct/apply machinery (or its loud error)
-                ne = walk(node.expr)
-                return dataclasses.replace(node, expr=ne) \
-                    if ne is not node.expr else node
+                # IN's row set in expression position: desugar to a
+                # three-valued scalar aggregate over a derived table,
+                # then lift that (ref: expression_rewriter.go
+                # handleInSubquery non-conjunct case)
+                if self._contains_agg(node.expr):
+                    # embedding SUM(b) in the generated subquery would
+                    # read outer agg state that does not exist there
+                    raise PlanError(
+                        "aggregate as IN-subquery operand in expression "
+                        "position is not supported")
+                colref = lift(_in_as_scalar(walk(node.expr),
+                                            node.items.select))
+                return ast.UnaryOp("NOT", colref) if node.negated \
+                    else colref
+            if isinstance(node, ast.ExistsSubquery):
+                # EXISTS in expression position -> COUNT(*) > 0 over a
+                # LIMIT 1 inner: the executor stops at the first row
+                inner_sel = node.select
+                if getattr(inner_sel, "limit", None) is None:
+                    inner_sel = dataclasses.replace(inner_sel, limit=1)
+                cnt = ast.SubqueryExpr(select=ast.SelectStmt(
+                    fields=[ast.SelectField(
+                        expr=ast.AggregateCall(name="COUNT", star=True))],
+                    from_clause=ast.SubqueryTable(
+                        select=inner_sel, alias="__ex")))
+                out = ast.BinaryOp(">", lift(cnt), ast.Literal(0))
+                return ast.UnaryOp("NOT", out) if node.negated else out
             return self._rewrite_ast_shallow(node, walk)
 
         ne = walk(e)        # mutates holder: must run before the read
@@ -1776,17 +1812,51 @@ def _union_ft(fts):
     return new_string_field(255)
 
 
+def _in_as_scalar(left, sel) -> ast.SubqueryExpr:
+    """`left IN (sel)` as a scalar aggregate with IN's three-valued
+    semantics: 0 for the empty set, 1 on a match, NULL when undecided
+    (left NULL or a NULL among the non-matching set), else 0. SUM
+    skips NULL comparisons, which is exactly the counting needed."""
+    import dataclasses
+    first = sel.selects[0] if isinstance(sel, ast.UnionStmt) else sel
+    if len(first.fields) != 1:
+        raise PlanError("subquery must return 1 column for IN")
+    if isinstance(first.fields[0].expr, ast.Star):
+        raise PlanError("IN (SELECT *) in expression position needs "
+                        "the column named explicitly")
+    nf = dataclasses.replace(first.fields[0], alias="__v")
+    nfirst = dataclasses.replace(first, fields=[nf])
+    sel = dataclasses.replace(sel, selects=[nfirst] + sel.selects[1:]) \
+        if isinstance(sel, ast.UnionStmt) else nfirst
+    y = ast.ColName(name="__v", table="__in")
+    lit = ast.Literal
+    eq_sum = ast.AggregateCall(name="SUM",
+                               args=[ast.BinaryOp("=", y, left)])
+    null_sum = ast.AggregateCall(name="SUM",
+                                 args=[ast.IsNullExpr(expr=y)])
+    case = ast.CaseExpr(operand=None, when_clauses=[
+        (ast.BinaryOp("=", ast.AggregateCall(name="COUNT", star=True),
+                      lit(0)), lit(0)),
+        (ast.BinaryOp(">", eq_sum, lit(0)), lit(1)),
+        (ast.BinaryOp("OR", ast.IsNullExpr(expr=left),
+                      ast.BinaryOp(">", null_sum, lit(0))), lit(None)),
+    ], else_clause=lit(0))
+    return ast.SubqueryExpr(select=ast.SelectStmt(
+        fields=[ast.SelectField(expr=case)],
+        from_clause=ast.SubqueryTable(select=sel, alias="__in")))
+
+
 def _contains_scalar_subquery(e) -> bool:
-    """True when a SubqueryExpr appears in expression position inside
-    `e` (not crossing into nested subquery bodies)."""
-    if isinstance(e, ast.SubqueryExpr):
+    """True when a subquery appears in expression position inside `e`
+    and the lift can rewrite it (scalar, IN-subquery, EXISTS); does
+    not cross into nested subquery bodies."""
+    if isinstance(e, (ast.SubqueryExpr, ast.ExistsSubquery)):
         return True
-    if not isinstance(e, ast.Node) or \
-            isinstance(e, (ast.ExistsSubquery, ast.QuantSubquery)):
+    if not isinstance(e, ast.Node) or isinstance(e, ast.QuantSubquery):
         return False
     if isinstance(e, ast.InExpr) and \
             isinstance(e.items, ast.SubqueryExpr):
-        return _contains_scalar_subquery(e.expr)   # row set, not scalar
+        return True
     for f in vars(e).values():
         if isinstance(f, ast.Node) and not isinstance(
                 f, (ast.SelectStmt, ast.UnionStmt)):

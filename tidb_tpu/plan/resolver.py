@@ -471,10 +471,24 @@ class Resolver:
         if n.value is None:
             return Constant(None, base.ft)   # NULL interval -> NULL
         v = n.value
+        if isinstance(v, str):
+            try:
+                v = _decimal.Decimal(v.strip())
+            except _decimal.InvalidOperation:
+                raise ResolveError(f"incorrect INTERVAL amount {v!r}")
         if isinstance(v, (float, _decimal.Decimal)):
-            # MySQL rounds fractional amounts for integer units
-            v = _decimal.Decimal(str(v)).quantize(
-                0, rounding=_decimal.ROUND_HALF_UP)
+            dv = _decimal.Decimal(str(v))
+            if unit == "SECOND" and dv != dv.to_integral_value():
+                # MySQL: a fractional SECOND amount is seconds.micros
+                total = int((dv * 1_000_000).quantize(
+                    0, rounding=_decimal.ROUND_HALF_UP))
+                total *= -1 if sub else 1
+                if isinstance(base, Constant):
+                    return Constant(None if base.value is None
+                                    else base.value + total, base.ft)
+                return func(Op.DATE_ADD_US, base, const(total))
+            # other integer units round half-up
+            v = dv.quantize(0, rounding=_decimal.ROUND_HALF_UP)
         amount = int(v) * (-1 if sub else 1)
         us_per = {"MICROSECOND": 1, "SECOND": 1_000_000,
                   "MINUTE": 60_000_000, "HOUR": 3_600_000_000,

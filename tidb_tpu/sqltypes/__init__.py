@@ -260,4 +260,6 @@ def format_datetime(us: int, tp: TypeCode = TypeCode.DATETIME) -> str:
     dt = micros_to_datetime(us)
     if tp == TypeCode.DATE:
         return dt.strftime("%Y-%m-%d")
+    if dt.microsecond:
+        return dt.strftime("%Y-%m-%d %H:%M:%S.%f")
     return dt.strftime("%Y-%m-%d %H:%M:%S")
