@@ -24,7 +24,9 @@ device path; vs_baseline = geomean of per-query device/host speedups.
 
 Env knobs: BENCH_SF (default 1.0), BENCH_ITERS (5), BENCH_HOST_ITERS (2),
 BENCH_REGIONS (4), BENCH_KERNEL_MICRO (1), BENCH_SKIP_PROBE (0; 1 skips
-the 120s device-liveness probe and trusts the default platform).
+the 120s device-liveness probe and trusts the default platform),
+BENCH_CPU_SF (0.2; scale used when the chip tunnel is down and no
+explicit BENCH_SF was given — CPU XLA is ~20-40x slower than a chip).
 """
 
 from __future__ import annotations
@@ -125,6 +127,13 @@ def main() -> None:
         import jax
         jax.config.update("jax_platforms", "cpu")
         device_fallback = "cpu (chip tunnel unavailable)"
+        if "BENCH_SF" not in os.environ:
+            # CPU XLA runs the warm path ~20-40x slower than a chip;
+            # full sf=1 would blow typical harness timeouts. The metric
+            # is rows/s, so a smaller sf stays comparable.
+            sf = float(os.environ.get("BENCH_CPU_SF", "0.2"))
+            iters = min(iters, 2)
+            host_iters = 1
 
     from tidb_tpu import config
     from tidb_tpu.benchmarks import tpch

@@ -292,3 +292,31 @@ class TestExprPositionEdges:
                      "INTERVAL 0.25 SECOND)") == \
             [("2024-01-01 00:00:01.500000",
               "2023-12-31 23:59:59.750000")]
+
+    def test_nonfinite_interval_amounts_rejected(self, tk):
+        for bad in ("'inf'", "'nan'", "'1e100'", "1e100"):
+            with pytest.raises(SQLError, match="INTERVAL amount"):
+                q(tk, f"SELECT DATE_ADD('2024-01-01', "
+                      f"INTERVAL {bad} DAY)")
+
+    def test_lifted_field_display_names(self, tk):
+        res = tk.query("SELECT (SELECT MAX(y) FROM u), "
+                       "10 IN (SELECT y FROM u), "
+                       "EXISTS (SELECT 1 FROM u)")
+        assert res.columns == ["(subquery)", "10 in (subquery)",
+                               "exists(subquery)"]
+
+
+class TestDatetimeFsp:
+    def test_write_rounds_to_column_precision(self, tk):
+        tk.execute("CREATE TABLE dtt (id BIGINT PRIMARY KEY, "
+                   "dt DATETIME)")
+        tk.execute("INSERT INTO dtt VALUES "
+                   "(1, '2024-01-01 00:00:00.5'), "
+                   "(2, '2024-01-01 00:00:00.4')")
+        assert q(tk, "SELECT dt FROM dtt ORDER BY id") == \
+            [("2024-01-01 00:00:01",), ("2024-01-01 00:00:00",)]
+        # computed values keep their sub-second part in display
+        assert q(tk, "SELECT DATE_ADD(dt, INTERVAL 0.5 SECOND) "
+                     "FROM dtt WHERE id = 2") == \
+            [("2024-01-01 00:00:00.500000",)]

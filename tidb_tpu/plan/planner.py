@@ -1262,8 +1262,12 @@ class Planner:
         fields = []
         for f in stmt.fields:
             plan, ne = self._lift_scalars_in_expr(plan, f.expr)
-            fields.append(dataclasses.replace(f, expr=ne)
-                          if ne is not f.expr else f)
+            if ne is not f.expr:
+                # keep the pre-lift display name: clients must not see
+                # the internal __sqN / desugared-node names
+                f = dataclasses.replace(
+                    f, expr=ne, alias=f.alias or _field_name(f.expr))
+            fields.append(f)
         changed["fields"] = fields
         if stmt.having is not None:
             plan, nh = self._lift_scalars_in_expr(plan, stmt.having)
@@ -1912,6 +1916,13 @@ def _field_name(e: ast.ExprNode) -> str:
         return f"{e.name.lower()}({'*' if e.star else '...'})"
     if isinstance(e, ast.Literal):
         return str(e.value)
+    if isinstance(e, ast.SubqueryExpr):
+        return "(subquery)"
+    if isinstance(e, ast.ExistsSubquery):
+        return "exists(subquery)"
+    if isinstance(e, ast.InExpr) and \
+            isinstance(e.items, ast.SubqueryExpr):
+        return f"{_field_name(e.expr)} in (subquery)"
     return type(e).__name__.lower()
 
 

@@ -478,6 +478,9 @@ class Resolver:
                 raise ResolveError(f"incorrect INTERVAL amount {v!r}")
         if isinstance(v, (float, _decimal.Decimal)):
             dv = _decimal.Decimal(str(v))
+            if not dv.is_finite() or abs(dv) > 10 ** 12:
+                raise ResolveError(
+                    f"incorrect INTERVAL amount {str(n.value)!r}")
             if unit == "SECOND" and dv != dv.to_integral_value():
                 # MySQL: a fractional SECOND amount is seconds.micros
                 total = int((dv * 1_000_000).quantize(

@@ -117,9 +117,17 @@ def encode_datum_for_col(v, ft: FieldType):
         return out if scaled >= 0 else -out
     if ft.eval_type == EvalType.REAL:
         return float(v)
-    if ft.eval_type == EvalType.DATETIME and isinstance(v, str):
-        from tidb_tpu.sqltypes import parse_datetime
-        return parse_datetime(v)
+    if ft.eval_type == EvalType.DATETIME:
+        if isinstance(v, str):
+            from tidb_tpu.sqltypes import parse_datetime
+            v = parse_datetime(v)
+        # round micros to the column's fsp at the write, like MySQL
+        # DATETIME(fsp) (frac 0 stores whole seconds — 00:00:00.5
+        # becomes 00:00:01, never a displayed fraction later)
+        step = 10 ** (6 - min(max(ft.frac, 0), 6))
+        if step > 1:
+            v = ((int(v) + step // 2) // step) * step
+        return int(v)
     if isinstance(v, float):      # MySQL rounds halves away from zero
         import math
         return int(math.floor(v + 0.5)) if v >= 0 else int(math.ceil(v - 0.5))
