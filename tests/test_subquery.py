@@ -320,3 +320,39 @@ class TestDatetimeFsp:
         assert q(tk, "SELECT DATE_ADD(dt, INTERVAL 0.5 SECOND) "
                      "FROM dtt WHERE id = 2") == \
             [("2024-01-01 00:00:00.500000",)]
+
+
+class TestDMLSubqueryWhere:
+    """Subqueries in UPDATE/DELETE WHERE ride the same apply/semi-join
+    machinery as SELECT; reading the write target is refused like
+    MySQL error 1093 (Halloween guard)."""
+
+    def test_update_delete_with_subqueries(self, tk):
+        tk.execute("UPDATE t SET b = 0 WHERE b > (SELECT AVG(y) FROM u)")
+        assert q(tk, "SELECT a FROM t WHERE b = 0 ORDER BY a") == \
+            [(2,), (3,)]
+        tk.execute("UPDATE t SET b = 7 WHERE a IN (SELECT x FROM u)")
+        assert q(tk, "SELECT b FROM t WHERE a = 1") == [(7,)]
+        tk.execute("DELETE FROM t WHERE EXISTS "
+                   "(SELECT 1 FROM u WHERE u.x = t.a)")
+        assert q(tk, "SELECT COUNT(*) FROM t") == [(2,)]
+        tk.execute("DELETE FROM t WHERE b >= ALL "
+                   "(SELECT y FROM u WHERE y IS NOT NULL)")
+        assert q(tk, "SELECT COUNT(*) FROM t") == [(2,)]
+
+    def test_target_table_in_subquery_refused(self, tk):
+        for sql in ["UPDATE t SET b = 1 WHERE a IN (SELECT a FROM t)",
+                    "DELETE FROM t WHERE b > (SELECT AVG(b) FROM t)"]:
+            with pytest.raises(SQLError, match="target table"):
+                tk.execute(sql)
+
+    def test_cross_db_same_name_allowed(self, tk):
+        # the 1093 guard is db-qualified: test.t vs d2.t differ
+        tk.execute("CREATE DATABASE d2")
+        tk.execute("CREATE TABLE d2.t (a BIGINT PRIMARY KEY)")
+        tk.execute("INSERT INTO d2.t VALUES (1)")
+        tk.execute("UPDATE t SET b = -5 WHERE a IN (SELECT a FROM d2.t)")
+        assert q(tk, "SELECT b FROM t WHERE a = 1") == [(-5,)]
+        with pytest.raises(SQLError, match="target table"):
+            tk.execute("UPDATE t SET b = 1 WHERE a IN "
+                       "(SELECT a FROM test.t)")

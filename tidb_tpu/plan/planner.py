@@ -1742,6 +1742,20 @@ class Planner:
         if where is not None:
             r = Resolver(schema)
             for c_ast in split_conjuncts(where):
+                # EXISTS / IN / <cmp> (SELECT) filter applies preserve
+                # the reader schema exactly (cols + _handle), so DML
+                # WHERE supports them like SELECT does; scalar LIFTS
+                # would append columns and stay unsupported here
+                if _reads_table(c_ast, _db, info.name, self.db or ""):
+                    # Halloween guard, like MySQL error 1093: the
+                    # subquery must not read the table being written
+                    raise PlanError(
+                        f"You can't specify target table "
+                        f"'{info.name}' for update in FROM clause")
+                applied = self._try_subquery_conjunct(plan, c_ast)
+                if applied is not None:
+                    plan = applied
+                    continue
                 plan = self._assign_cond(plan, r.resolve(c_ast), True)
         return info, plan
 
@@ -1850,33 +1864,44 @@ def _in_as_scalar(left, sel) -> ast.SubqueryExpr:
         from_clause=ast.SubqueryTable(select=sel, alias="__in")))
 
 
-def _contains_scalar_subquery(e) -> bool:
-    """True when a subquery appears in expression position inside `e`
-    and the lift can rewrite it (scalar, IN-subquery, EXISTS); does
-    not cross into nested subquery bodies."""
-    if isinstance(e, (ast.SubqueryExpr, ast.ExistsSubquery)):
-        return True
-    if not isinstance(e, ast.Node) or isinstance(e, ast.QuantSubquery):
-        return False
-    if isinstance(e, ast.InExpr) and \
-            isinstance(e.items, ast.SubqueryExpr):
-        return True
+def _iter_nodes(e, stop: tuple = ()):
+    """Yield `e` and every ast.Node under it (fields, lists, tuples of
+    nodes). Nodes of a `stop` type are yielded but not descended into."""
+    yield e
+    if isinstance(e, stop):
+        return
     for f in vars(e).values():
-        if isinstance(f, ast.Node) and not isinstance(
-                f, (ast.SelectStmt, ast.UnionStmt)):
-            if _contains_scalar_subquery(f):
-                return True
+        if isinstance(f, ast.Node):
+            yield from _iter_nodes(f, stop)
         elif isinstance(f, (list, tuple)):
             for x in f:
-                if isinstance(x, ast.Node) and not isinstance(
-                        x, (ast.SelectStmt, ast.UnionStmt)):
-                    if _contains_scalar_subquery(x):
-                        return True
-                elif isinstance(x, tuple) and any(
-                        _contains_scalar_subquery(y) for y in x
-                        if isinstance(y, ast.Node)):
-                    return True
-    return False
+                if isinstance(x, ast.Node):
+                    yield from _iter_nodes(x, stop)
+                elif isinstance(x, tuple):
+                    for y in x:
+                        if isinstance(y, ast.Node):
+                            yield from _iter_nodes(y, stop)
+
+
+def _reads_table(e, db: str, name: str, cur_db: str) -> bool:
+    """Does any subquery under `e` scan table `db.name`? (DML WHERE
+    may not read its own target table — MySQL error 1093.) An
+    unqualified TableSource resolves against the session db."""
+    db, name = db.lower(), name.lower()
+    return any(isinstance(n, ast.TableSource) and
+               n.name.lower() == name and
+               (n.db or cur_db).lower() == db
+               for n in _iter_nodes(e))
+
+
+def _contains_scalar_subquery(e) -> bool:
+    """True when a subquery appears in expression position inside `e`
+    and the lift can rewrite it (scalar, IN-subquery via its items
+    node, EXISTS); does not cross into nested subquery bodies."""
+    stop = (ast.SubqueryExpr, ast.ExistsSubquery, ast.QuantSubquery,
+            ast.SelectStmt, ast.UnionStmt)
+    return any(isinstance(n, (ast.SubqueryExpr, ast.ExistsSubquery))
+               for n in _iter_nodes(e, stop))
 
 
 def _contains_agg(stmt: ast.SelectStmt) -> bool:
