@@ -1,0 +1,308 @@
+"""Extension builtin families (expression/builtins_ext.py; ref:
+expression/builtin.go:270 `funcs` table). Expected values follow MySQL
+5.7 semantics, incl. the per-function NULL rules."""
+
+import base64
+import datetime as dt
+from decimal import Decimal
+
+import pytest
+
+from tidb_tpu.session import Session, SQLError
+from tidb_tpu.store.storage import new_mock_storage
+
+
+@pytest.fixture(scope="module")
+def sess():
+    s = Session(new_mock_storage())
+    s.execute("CREATE DATABASE bx")
+    s.execute("USE bx")
+    s.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, x DOUBLE, "
+              "s VARCHAR(60), d DATETIME, j JSON)")
+    s.execute("INSERT INTO t VALUES "
+              "(1, 2.0, 'hello', '2024-03-15 10:30:45', "
+              "'{\"a\": {\"b\": [1, 2]}, \"c\": \"hi\"}'),"
+              "(2, -9.5, 'a,b,c', '2024-12-31 23:59:59', '[5, 6]'),"
+              "(3, 0.25, NULL, NULL, NULL)")
+    yield s
+    s.close()
+
+
+def one(sess, expr, where="id=1"):
+    return sess.query(f"SELECT {expr} FROM t WHERE {where}").rows[0][0]
+
+
+class TestTimeConversions:
+    @pytest.mark.parametrize("expr,want", [
+        ("STR_TO_DATE('15,3,2024','%d,%m,%Y')", "2024-03-15 00:00:00"),
+        ("STR_TO_DATE('2024-03-15 10:30:45','%Y-%m-%d %H:%i:%s')",
+         "2024-03-15 10:30:45"),
+        ("FROM_DAYS(739325)", "2024-03-15"),
+        ("TO_DAYS('2024-03-15')", 739325),
+        ("TO_SECONDS('1970-01-02 00:00:01')",
+         719528 * 86400 + 86401),
+        ("MAKEDATE(2024, 75)", "2024-03-15"),
+        ("MAKEDATE(24, 75)", "2024-03-15"),       # 2-digit year
+        ("PERIOD_ADD(202401, 13)", 202502),
+        ("PERIOD_DIFF(202502, 202401)", 13),
+        ("WEEKOFYEAR('2024-01-04')", 1),
+        ("TIMESTAMP('2024-03-15')", "2024-03-15 00:00:00"),
+        ("TIMESTAMP('2024-03-15', '10:30:45')", "2024-03-15 10:30:45"),
+        ("CONVERT_TZ('2024-03-15 12:00:00','+00:00','+05:30')",
+         "2024-03-15 17:30:00"),
+        ("GET_FORMAT(DATE, 'ISO')", "%Y-%m-%d"),
+        ("GET_FORMAT(DATETIME, 'JIS')", "%Y-%m-%d %H:%i:%s"),
+    ])
+    def test_values(self, sess, expr, want):
+        assert one(sess, expr) == want
+
+    def test_str_to_date_unparseable_is_null(self, sess):
+        assert one(sess, "STR_TO_DATE('bogus','%Y-%m-%d')") is None
+
+    def test_makedate_day_zero_is_null(self, sess):
+        assert one(sess, "MAKEDATE(2024, 0)") is None
+
+    def test_convert_tz_named_zone_is_null(self, sess):
+        # parity: MySQL without tz tables loaded returns NULL
+        assert one(sess, "CONVERT_TZ(d,'US/Pacific','+00:00')") is None
+
+    def test_null_propagation(self, sess):
+        assert one(sess, "STR_TO_DATE(s,'%Y')", "id=3") is None
+        assert one(sess, "PERIOD_ADD(NULL, 1)") is None
+
+
+class TestDurations:
+    @pytest.mark.parametrize("expr,want", [
+        ("SEC_TO_TIME(3661)", "01:01:01"),
+        ("SEC_TO_TIME(-90)", "-00:01:30"),
+        ("TIME_TO_SEC('01:01:01')", 3661),
+        ("MAKETIME(12, 30, 15)", "12:30:15.000000"),
+        ("TIME('2024-03-15 10:30:45')", "10:30:45.000000"),
+        ("TIMEDIFF('2024-03-15 12:00:00','2024-03-15 10:30:00')",
+         "01:30:00.000000"),
+        ("TIMEDIFF('10:00:00','08:15:00')", "01:45:00.000000"),
+        ("ADDTIME('2024-03-15 10:30:45','01:00:15')",
+         "2024-03-15 11:31:00"),
+        ("SUBTIME('2024-03-15 10:30:45','10:30:45')",
+         "2024-03-15 00:00:00"),
+        ("ADDTIME('10:00:00', '02:30:00')", "12:30:00"),
+        ("TIME_FORMAT('25:30:45', '%H|%i|%s')", "25|30|45"),
+    ])
+    def test_values(self, sess, expr, want):
+        assert one(sess, expr) == want
+
+    def test_maketime_bad_minute_is_null(self, sess):
+        assert one(sess, "MAKETIME(1, 61, 0)") is None
+
+    def test_decimal_seconds_unscale(self, sess):
+        # scaled-int DECIMAL lane must be unscaled, not read raw
+        assert one(sess, "MAKETIME(0, 0, 10.5)") == "00:00:10.500000"
+        assert one(sess, "SEC_TO_TIME(90.5)") == "00:01:30.5"
+        assert one(sess, "TIME_TO_SEC('bogus')") is None
+        assert one(sess, "TIMESTAMP('2024-03-15','bogus')") is None
+
+    def test_time_day_prefix_form(self, sess):
+        assert one(sess, "TIME('1 10:00:00')") == "34:00:00.000000"
+
+    def test_get_format_timestamp_synonym(self, sess):
+        assert one(sess, "GET_FORMAT(TIMESTAMP, 'ISO')") == \
+            "%Y-%m-%d %H:%i:%s"
+
+    def test_timediff_mixed_types_is_null(self, sess):
+        # MySQL: datetime vs bare time -> NULL
+        assert one(sess,
+                   "TIMEDIFF('2024-03-15 12:00:00','10:00:00')") is None
+
+    def test_sec_to_time_clamps(self, sess):
+        assert one(sess, "SEC_TO_TIME(4000000)") == "838:59:59"
+
+    def test_current_moment_functions_run(self, sess):
+        year = dt.datetime.now().year
+        assert str(year) in one(sess, "CURDATE()")
+        assert str(year) in one(sess, "SYSDATE()")
+        assert str(year) in one(sess, "LOCALTIME()")
+        assert one(sess, "CURTIME()").count(":") == 2
+        assert one(sess, "UTC_DATE()").count("-") == 2
+
+
+class TestStrings:
+    @pytest.mark.parametrize("expr,want", [
+        ("FORMAT(1234567.8912, 2)", "1,234,567.89"),
+        ("FORMAT(1234.5, 0)", "1,234"),
+        ("TO_BASE64('abc')", base64.b64encode(b"abc").decode()),
+        ("FROM_BASE64(TO_BASE64('hello'))", "hello"),
+        ("INSERT('Quadratic', 3, 4, 'What')", "QuWhattic"),
+        ("INSERT('Quadratic', -1, 4, 'What')", "Quadratic"),
+        ("INSERT('Quadratic', 3, 100, 'What')", "QuWhat"),
+        ("EXPORT_SET(5, 'Y', 'N', ',', 4)", "Y,N,Y,N"),
+        ("EXPORT_SET(6, '1', '0', '', 10)", "0110000000"),
+        ("MAKE_SET(5, 'a', 'b', 'c')", "a,c"),
+        ("ORD('a')", 97),
+        ("ORD('€')", 14844588),       # utf8 bytes E2 82 AC as base-256
+        ("CHAR(77, 121, 83, 81, 76)", "MySQL"),
+        ("CHAR(256)", "\x01\x00"),
+    ])
+    def test_values(self, sess, expr, want):
+        assert one(sess, expr) == want
+
+    def test_from_base64_invalid_is_null(self, sess):
+        assert one(sess, "FROM_BASE64('!not-base64!')") is None
+
+    def test_load_file_is_null(self, sess):
+        assert one(sess, "LOAD_FILE('/etc/passwd')") is None
+
+    def test_make_set_skips_null_strings(self, sess):
+        assert one(sess, "MAKE_SET(3, 'a', NULL, 'c')") == "a"
+
+    def test_char_skips_nulls(self, sess):
+        assert one(sess, "CHAR(77, NULL, 121)") == "My"
+
+
+class TestInfoAndMisc:
+    @pytest.mark.parametrize("expr,want", [
+        ("CHARSET('x')", "utf8mb4"),
+        ("COLLATION('x')", "utf8mb4_bin"),
+        ("COERCIBILITY('x')", 4),
+        ("INET_ATON('10.0.5.9')", 167773449),
+        ("INET_ATON('127.1')", 127 * (1 << 24) + 1),   # short form
+        ("INET_NTOA(167773449)", "10.0.5.9"),
+        ("IS_IPV4('10.0.0.1')", 1),
+        ("IS_IPV4('::1')", 0),
+        ("IS_IPV6('::1')", 1),
+        ("IS_IPV6('10.0.0.1')", 0),
+        ("IS_IPV4_MAPPED(INET6_ATON('::ffff:10.0.0.1'))", 1),
+        ("IS_IPV4_COMPAT(INET6_ATON('::10.0.0.1'))", 1),
+        ("IS_IPV4_COMPAT(INET6_ATON('::ffff:10.0.0.1'))", 0),
+        ("INET6_NTOA(INET6_ATON('fdfe::5a55:caff:fefa:9089'))",
+         "fdfe::5a55:caff:fefa:9089"),
+        ("BIT_COUNT(29)", 4),
+        ("BIT_COUNT(-1)", 64),        # two's complement
+        ("INTERVAL(23, 1, 15, 17, 30, 44, 200)", 3),
+        ("INTERVAL(10, 1, 10, 100)", 2),
+        ("GET_LOCK('l', 10)", 1),
+        ("RELEASE_LOCK('l')", 1),
+        ("IS_FREE_LOCK('l')", 1),
+        ("RELEASE_ALL_LOCKS()", 0),
+        ("SLEEP(0)", 0),
+        ("BENCHMARK(10, 1+1)", 0),
+        ("NAME_CONST('k', 42)", 42),
+        ("ANY_VALUE(5)", 5),
+    ])
+    def test_values(self, sess, expr, want):
+        assert one(sess, expr) == want
+
+    def test_inet_invalid_is_null(self, sess):
+        assert one(sess, "INET_ATON('1.2.3.256')") is None
+        assert one(sess, "INET_NTOA(-1)") is None
+        assert one(sess, "INET6_ATON('bogus')") is None
+
+    def test_interval_null_is_minus_one(self, sess):
+        assert one(sess, "INTERVAL(NULL, 1, 2)") == -1
+
+    def test_interval_decimal_args_unscale(self, sess):
+        assert one(sess, "INTERVAL(1.5, 1, 2)") == 1
+
+    def test_interval_nested_in_call(self, sess):
+        assert one(sess, "IFNULL(INTERVAL(23, 1, 15), -1)") == 2
+
+    def test_is_used_lock_null(self, sess):
+        assert one(sess, "IS_USED_LOCK('l')") is None
+
+    def test_uuid_shape(self, sess):
+        u = one(sess, "UUID()")
+        assert len(u) == 36 and u.count("-") == 4
+
+    def test_uuid_short_monotonic(self, sess):
+        a = one(sess, "UUID_SHORT()")
+        b = one(sess, "UUID_SHORT()")
+        assert b > a
+
+    def test_tidb_version_string(self, sess):
+        assert "tidb_tpu" in one(sess, "TIDB_VERSION()")
+
+
+class TestCompressionCrypto:
+    def test_compress_round_trip(self, sess):
+        assert one(sess, "UNCOMPRESS(COMPRESS('hello world'))") == \
+            "hello world"
+
+    def test_uncompressed_length(self, sess):
+        assert one(sess, "UNCOMPRESSED_LENGTH(COMPRESS(s))") == 5
+
+    def test_uncompress_garbage_is_null(self, sess):
+        assert one(sess, "UNCOMPRESS('garbage-bytes')") is None
+
+    def test_password_hash(self, sess):
+        # PASSWORD('mypass') is the documented double-sha1 format
+        assert one(sess, "PASSWORD('mypass')") == \
+            "*6C8989366EAF75BB670AD8EA7A7FC1176A95CEF4"
+        assert one(sess, "PASSWORD('')") == ""
+
+    def test_random_bytes_length(self, sess):
+        assert len(one(sess, "RANDOM_BYTES(16)")) == 16
+
+    def test_random_bytes_range_error(self, sess):
+        with pytest.raises(SQLError):
+            one(sess, "RANDOM_BYTES(0)")
+
+    def test_aes_round_trip(self, sess):
+        assert one(sess,
+                   "AES_DECRYPT(AES_ENCRYPT('secret','key'),'key')") == \
+            "secret"
+
+    def test_aes_is_one_block_and_deterministic(self, sess):
+        # 'text' pads to one AES block; ECB is deterministic
+        a = one(sess, "HEX(AES_ENCRYPT('text','key'))")
+        b = one(sess, "HEX(AES_ENCRYPT('text','key'))")
+        assert a == b and len(a) == 32
+
+    def test_aes_decrypt_garbage_is_null(self, sess):
+        assert one(sess, "AES_DECRYPT('oddlength','key')") is None
+
+
+class TestJSONModify:
+    @pytest.mark.parametrize("expr,want", [
+        ('JSON_QUOTE(\'a"b\')', '"a\\"b"'),
+        ("JSON_SET('{\"a\":1}', '$.a', 2)", '{"a":2}'),
+        ("JSON_SET('{\"a\":1}', '$.b', 9)", '{"a":1,"b":9}'),
+        ("JSON_INSERT('{\"a\":1}', '$.a', 2)", '{"a":1}'),
+        ("JSON_INSERT('{\"a\":1}', '$.b', 2)", '{"a":1,"b":2}'),
+        ("JSON_REPLACE('{\"a\":1}', '$.a', 2)", '{"a":2}'),
+        ("JSON_REPLACE('{\"a\":1}', '$.b', 2)", '{"a":1}'),
+        ("JSON_REMOVE('{\"a\":1,\"b\":2}', '$.b')", '{"a":1}'),
+        ("JSON_REMOVE('[1,2,3]', '$[0]')", "[2,3]"),
+        ("JSON_MERGE('[1,2]', '[3]')", "[1,2,3]"),
+        ("JSON_MERGE('{\"a\":1}', '{\"b\":2}')", '{"a":1,"b":2}'),
+        ("JSON_MERGE('1', '2')", "[1,2]"),
+        ("JSON_ARRAY_APPEND('[1,2]', '$', 3)", "[1,2,3]"),
+        ("JSON_ARRAY_APPEND('{\"a\":[1]}', '$.a', 2)", '{"a":[1,2]}'),
+        ("JSON_CONTAINS_PATH('{\"a\":{\"b\":1}}', 'one', '$.a.b')", 1),
+        ("JSON_CONTAINS_PATH('{\"a\":1}', 'all', '$.a', '$.b')", 0),
+        ("JSON_CONTAINS_PATH('{\"a\":1}', 'one', '$.a', '$.b')", 1),
+        ("JSON_DEPTH('3')", 1),
+        ("JSON_DEPTH('[1,[2,3]]')", 3),
+        ("JSON_SEARCH('[\"abc\",\"ghi\"]', 'one', 'abc')", '"$[0]"'),
+        ("JSON_SEARCH('{\"a\":\"xx\",\"b\":\"xx\"}', 'all', 'xx')",
+         '["$.a","$.b"]'),
+        ("JSON_SEARCH('[\"ab\"]', 'one', 'a%')", '"$[0]"'),
+    ])
+    def test_values(self, sess, expr, want):
+        assert one(sess, expr) == want
+
+    def test_on_column(self, sess):
+        assert one(sess, "JSON_SET(j, '$.c', 'yo')") == \
+            '{"a":{"b":[1,2]},"c":"yo"}'
+
+    def test_search_no_hit_is_null(self, sess):
+        assert one(sess, "JSON_SEARCH(j, 'one', 'nope')") is None
+
+    def test_bad_one_or_all_errors(self, sess):
+        with pytest.raises(SQLError):
+            one(sess, "JSON_CONTAINS_PATH(j, 'some', '$.a')")
+
+    def test_bad_path_errors(self, sess):
+        with pytest.raises(SQLError):
+            one(sess, "JSON_SET(j, 'nopath', 1)")
+
+    def test_null_doc_propagates(self, sess):
+        assert one(sess, "JSON_SET(j, '$.a', 1)", "id=3") is None

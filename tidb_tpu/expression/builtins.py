@@ -50,9 +50,11 @@ class FnSpec:
     def result_ft(self, args):
         if callable(self.ret):
             return self.ret(args)
+        from tidb_tpu.sqltypes import new_duration_field
         return {"int": new_int_field, "real": new_double_field,
                 "string": lambda: new_string_field(),
                 "datetime": new_datetime_field,
+                "duration": new_duration_field,
                 "first": lambda: args[0].ft}[self.ret]()
 
     def __hash__(self):
@@ -267,7 +269,9 @@ def _hex(args, argv, n):
     from tidb_tpu.sqltypes import EvalType
     d, v = argv[0]
     if args[0].ft.eval_type == EvalType.STRING:
-        return _vec(lambda x: _s(x).encode().hex().upper(), v, n, d), v
+        return _vec(
+            lambda x: (x if isinstance(x, bytes)
+                       else _s(x).encode()).hex().upper(), v, n, d), v
     return _vec(lambda x: format(int(x) & _U64, "X"), v, n, d), v
 
 
@@ -1016,3 +1020,9 @@ def _timestampdiff(args, argv, n):
 
 
 _reg("TIMESTAMPDIFF", 3, 3, "int", _timestampdiff)
+
+
+# The long-tail extension families (time/string/info/misc/crypto/JSON)
+# register themselves on import; kept in a sibling module so each family
+# file stays reviewable (mirrors the reference's builtin_*.go split).
+from tidb_tpu.expression import builtins_ext  # noqa: E402,F401

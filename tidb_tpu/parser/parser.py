@@ -1469,6 +1469,10 @@ class Parser:
             self.expect_op(")")
             return ast.ExistsSubquery(select=sub)
         if kw == "INTERVAL":
+            if self.peek(1).tp == TokenType.OP and self.peek(1).val == "(":
+                # INTERVAL(n, a1, a2, ...) — the compare function
+                self.next()
+                return self.func_call(kw)
             # INTERVAL n DAY — only inside date_add/sub handled there
             raise ParseError("INTERVAL outside date arithmetic", t)
         if kw in ("IF", "IFNULL", "COALESCE", "NULLIF", "REPLACE", "LEFT",
@@ -1551,6 +1555,16 @@ class Parser:
         if name == "EXTRACT":
             # EXTRACT(unit FROM e) desugars to the field functions
             return self._extract_expr()
+        if name == "GET_FORMAT":
+            # first argument is a bare DATE/TIME/DATETIME/TIMESTAMP word
+            ut = self.next()
+            if ut.tp not in (TokenType.IDENT, TokenType.KEYWORD):
+                raise ParseError("expected DATE/TIME/DATETIME", ut)
+            self.expect_op(",")
+            loc = self.expr()
+            self.expect_op(")")
+            return ast.FuncCall(name="GET_FORMAT",
+                                args=[ast.Literal(ut.val.upper()), loc])
         if name in ("TIMESTAMPDIFF", "TIMESTAMPADD"):
             # first argument is a bare unit word, not an expression
             ut = self.next()
@@ -1589,7 +1603,11 @@ class Parser:
         if not self.try_op(")"):
             # DATE_ADD(d, INTERVAL n DAY)
             while True:
-                if self.peek().is_kw("INTERVAL"):
+                if self.peek().is_kw("INTERVAL") and not (
+                        self.peek(1).tp == TokenType.OP and
+                        self.peek(1).val == "("):
+                    # DATE_ADD(d, INTERVAL n DAY); INTERVAL( stays the
+                    # compare function and parses as a normal expr
                     self.next()
                     args.append(self._interval_expr())
                 else:

@@ -1704,6 +1704,9 @@ def _format_chunk(ch) -> list[tuple]:
                 row.append(scaled_to_decimal(int(v), c.ft.frac))
             elif et == EvalType.DATETIME:
                 row.append(format_datetime(int(v), c.ft.tp))
+            elif et == EvalType.DURATION:
+                from tidb_tpu.sqltypes import format_duration
+                row.append(format_duration(int(v), c.ft.frac))
             elif isinstance(v, bytes) and c.ft.tp == TypeCode.JSON:
                 # JSON text reaches clients as str; BLOB bytes stay raw
                 row.append(v.decode("utf8", "replace"))

@@ -29,11 +29,12 @@ __all__ = [
     "TypeCode", "EvalType", "FieldType", "Flag",
     "new_int_field", "new_uint_field", "new_double_field",
     "new_decimal_field", "new_string_field", "new_datetime_field",
-    "new_date_field",
+    "new_date_field", "new_duration_field",
     "np_dtype_for", "eval_type_of",
     "decimal_to_scaled", "scaled_to_decimal",
     "datetime_to_micros", "micros_to_datetime", "date_to_micros",
     "parse_datetime", "format_datetime",
+    "parse_duration", "format_duration",
     "NULL",
 ]
 
@@ -195,6 +196,10 @@ def new_date_field(flags: int = 0) -> FieldType:
     return FieldType(TypeCode.DATE, flags=flags, flen=10)
 
 
+def new_duration_field(flags: int = 0, frac: int = 0) -> FieldType:
+    return FieldType(TypeCode.DURATION, flags=flags, flen=10, frac=frac)
+
+
 # ---------------------------------------------------------------------------
 # Decimal <-> scaled int64
 
@@ -263,3 +268,60 @@ def format_datetime(us: int, tp: TypeCode = TypeCode.DATETIME) -> str:
     if dt.microsecond:
         return dt.strftime("%Y-%m-%d %H:%M:%S.%f")
     return dt.strftime("%Y-%m-%d %H:%M:%S")
+
+
+# MySQL TIME range is [-838:59:59, 838:59:59] (ref: types/time.go MaxTime)
+MAX_DURATION_US = ((838 * 3600 + 59 * 60 + 59) * 1_000_000)
+
+
+def clamp_duration(us: int) -> int:
+    return max(-MAX_DURATION_US, min(MAX_DURATION_US, int(us)))
+
+
+def parse_duration(s: str) -> int:
+    """MySQL TIME literal -> signed microseconds.
+    Accepts '[-][D ]HH:MM:SS[.ffffff]', 'HH:MM', 'SS', and the packed
+    numeric form HHMMSS (ref: types/time.go ParseDuration)."""
+    s = s.strip()
+    neg = s.startswith("-")
+    if neg:
+        s = s[1:].strip()
+    days = 0
+    if " " in s:
+        d, s = s.split(" ", 1)
+        days = int(d)
+    frac_us = 0
+    if "." in s:
+        s, f = s.split(".", 1)
+        frac_us = int((f + "000000")[:6]) if f else 0
+    if ":" in s:
+        parts = [int(p or 0) for p in s.split(":")]
+        if len(parts) == 2:
+            h, m, sec = parts[0], parts[1], 0
+        elif len(parts) == 3:
+            h, m, sec = parts
+        else:
+            raise ValueError(f"invalid time literal: {s!r}")
+    else:
+        packed = int(s or 0)        # HHMMSS
+        h, m, sec = packed // 10000, (packed // 100) % 100, packed % 100
+    if m > 59 or sec > 59:
+        raise ValueError(f"invalid time literal: {s!r}")
+    us = ((days * 24 + h) * 3600 + m * 60 + sec) * 1_000_000 + frac_us
+    return clamp_duration(-us if neg else us)
+
+
+def format_duration(us: int, frac: int = -1) -> str:
+    """Signed microseconds -> 'HH:MM:SS[.ffffff]'."""
+    us = int(us)
+    sign = "-" if us < 0 else ""
+    us = abs(us)
+    micro = us % 1_000_000
+    sec = us // 1_000_000
+    h, m, s = sec // 3600, (sec // 60) % 60, sec % 60
+    out = f"{sign}{h:02d}:{m:02d}:{s:02d}"
+    if frac > 0:
+        out += "." + f"{micro:06d}"[:frac]
+    elif frac < 0 and micro:
+        out += f".{micro:06d}"
+    return out
