@@ -7,12 +7,14 @@ tikvrpc/tikvrpc.go:31-53 (typed command envelope), region_request.go
 the reference's architecture: a STATELESS SQL layer connected by RPC to a
 storage cluster that owns the data, the coprocessor compute, and the TSO.
 
-Wire format: length-prefixed frames, 1-byte status, pickle payload.
-(The reference's envelope is protobuf over gRPC with the pushed subplan
-as an opaque tipb blob inside; here the whole payload is one
-pickle-encoded blob — an explicit simplification of the serialization
-layer, not of the process boundary. The link is trusted, exactly like
-mocktikv's unauthenticated in-process RPC.)
+Wire format: length-prefixed frames, 1-byte status, then a typed
+payload encoded by store/wire.py — a closed tag-length-value contract
+mirroring the reference's protobuf envelope (tikvrpc.CmdType +
+kvproto/tipb messages). Requests carry `u16 Cmd` + an args/kwargs
+tuple; responses carry the result value or a registered typed error.
+No pickle anywhere on the wire path: decoding cannot execute code, and
+malformed frames raise WireError (fuzzed in tests/test_wire.py).
+On-disk snapshots (trusted, local files we wrote) still use pickle.
 
 Failure semantics (region_request.go's network-error split):
   * connection failure BEFORE the request is written -> retry on a fresh
@@ -37,6 +39,7 @@ import time
 
 from tidb_tpu import kv
 from tidb_tpu.mockstore.rpc import TimeoutError_
+from tidb_tpu.store import wire
 
 __all__ = ["StorageServer", "RemoteStorage", "connect", "serve_main"]
 
@@ -122,6 +125,24 @@ class StorageServer:
                 self._threads.add(t)
             t.start()
 
+    @staticmethod
+    def _validate_request(req):
+        """Typed request envelope: (cmd:int, args:tuple, kwargs:dict)."""
+        if not (isinstance(req, tuple) and len(req) == 3):
+            raise wire.WireError("request must be (cmd, args, kwargs)")
+        cmd, args, kwargs = req
+        try:
+            cmd = wire.Cmd(cmd)
+        except ValueError:
+            raise wire.WireError(f"unknown command {cmd!r}") from None
+        if cmd not in wire.METHOD_BY_CMD:
+            raise wire.WireError(f"unroutable command {cmd!r}")
+        if not isinstance(args, tuple) or not isinstance(kwargs, dict):
+            raise wire.WireError("bad args/kwargs")
+        if any(not isinstance(k, str) for k in kwargs):
+            raise wire.WireError("kwargs keys must be strings")
+        return cmd, args, kwargs
+
     def _dispatch(self, method: str, args: tuple, kwargs: dict):
         st = self.storage
         if method == "ping":
@@ -155,12 +176,23 @@ class StorageServer:
                     _status, payload = _recv_frame(sock)
                 except (ConnectionError, OSError):
                     return
-                method, args, kwargs = pickle.loads(payload)
                 try:
+                    req = wire.decode_frame_payload(payload)
+                    cmd, args, kwargs = self._validate_request(req)
+                    method = wire.METHOD_BY_CMD[cmd]
                     result = self._dispatch(method, args, kwargs)
-                    out, status = pickle.dumps(result), _STATUS_OK
+                    out, status = wire.encode(result), _STATUS_OK
+                except wire.WireError as e:
+                    # malformed frame: reject loudly, keep serving
+                    out = wire.encode(kv.KVError(f"bad request: {e}"))
+                    status = _STATUS_ERR
                 except Exception as e:  # noqa: BLE001 - typed errors ride back
-                    out, status = pickle.dumps(e), _STATUS_ERR
+                    try:
+                        out, status = wire.encode(e), _STATUS_ERR
+                    except wire.WireError:
+                        out = wire.encode(
+                            kv.KVError(f"{type(e).__name__}: {e}"))
+                        status = _STATUS_ERR
                 try:
                     _send_frame(sock, status, out)
                 except (ConnectionError, OSError):
@@ -199,12 +231,17 @@ class _Conn:
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 
     def call(self, method: str, args: tuple, kwargs: dict):
-        payload = pickle.dumps((method, args, kwargs))
+        cmd = wire.CMD_BY_METHOD.get(method)
+        if cmd is None:
+            raise kv.KVError(f"method {method!r} has no wire command")
+        payload = wire.encode((int(cmd), tuple(args), dict(kwargs)))
         _send_frame(self.sock, _STATUS_OK, payload)
         status, body = _recv_frame(self.sock)
-        result = pickle.loads(body)
+        result = wire.decode_frame_payload(body)
         if status == _STATUS_ERR:
-            raise result
+            if isinstance(result, BaseException):
+                raise result
+            raise kv.KVError(f"storage error: {result!r}")
         return result
 
     def close(self) -> None:
@@ -261,7 +298,7 @@ class RemoteClient:
                     f"storage unreachable at {self.addr}: {e}") from None
             try:
                 result = conn.call(method, args, kwargs)
-            except (ConnectionError, OSError, pickle.UnpicklingError,
+            except (ConnectionError, OSError, wire.WireError,
                     EOFError) as e:
                 conn.close()
                 sent_once = True
