@@ -108,8 +108,9 @@ class TestRemoteSQL:
         sess.execute("INSERT INTO t VALUES (1), (2)")
         assert sess.query("SELECT COUNT(*) FROM t").rows == [(2,)]
         # sever every pooled connection behind the client's back
-        for c in list(sess.storage.rpc._pool):
-            c.sock.shutdown(socket.SHUT_RDWR)
+        for pool in sess.storage.rpc._pools.values():
+            for c in list(pool):
+                c.sock.shutdown(socket.SHUT_RDWR)
         assert sess.query("SELECT COUNT(*) FROM t").rows == [(2,)]
 
 

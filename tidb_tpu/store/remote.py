@@ -58,6 +58,13 @@ _IDEMPOTENT = {"kv_get", "kv_batch_get", "kv_scan", "kv_scan_lock",
 
 MAX_CONNS = 16   # ref: client.go:37 MaxConnectionCount
 
+# commands that change durable state: replicated to the backup (the
+# "log" of primary/backup log shipping). Everything else is a read.
+_MUTATING = {"kv_prewrite", "kv_commit", "kv_batch_rollback",
+             "kv_resolve_lock", "kv_cleanup", "kv_delete_range", "kv_gc",
+             "raw_put", "raw_batch_put", "raw_delete", "raw_delete_range",
+             "split", "split_table", "split_region", "bulk_import"}
+
 
 def _send_frame(sock: socket.socket, status: int, payload: bytes) -> None:
     sock.sendall(struct.pack("<IB", len(payload) + 1, status) + payload)
@@ -91,7 +98,9 @@ class StorageServer:
     provides consistency exactly as with in-process threads."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 snapshot_path: str | None = None):
+                 snapshot_path: str | None = None,
+                 role: str = "primary", backup_addr=None,
+                 primary_addr=None):
         from tidb_tpu.store.copr import cop_handler
         from tidb_tpu.store.storage import MockStorage, new_mock_storage
         self.snapshot_path = snapshot_path
@@ -102,11 +111,109 @@ class StorageServer:
         else:
             self.storage = new_mock_storage()
         self.storage.shim.install_cop_handler(cop_handler(self.storage))
+        # -- replication (ref: the Raft-replicated TiKV store; here a
+        # synchronous primary/backup log-shipping analogue) ---------------
+        self.role = role
+        self._ship_mu = threading.Lock()   # serializes apply+ship order
+        self._backup: _Conn | None = None
+        self._backup_addr = backup_addr
+        self._backup_dead = False
+        if role == "backup" and primary_addr is not None:
+            self._attach_to_primary(primary_addr)
         self._listener = socket.create_server((host, port))
         self.port = self._listener.getsockname()[1]
         self._closing = threading.Event()
         self._threads: set = set()
         self._mu = threading.Lock()
+
+    # -- replication ---------------------------------------------------------
+
+    def _attach_to_primary(self, primary_addr) -> None:
+        """Pull a full state snapshot so a fresh backup starts in sync
+        (the primary then ships every mutation as it happens)."""
+        conn = _Conn(primary_addr)
+        try:
+            state = conn.call("repl_snapshot", (), {})
+            self._install_state(state)
+        finally:
+            conn.close()
+
+    def _export_state(self):
+        cl, en = self.storage.cluster, self.storage.engine
+        with cl._mu, en._mu:
+            return {
+                "id": cl._id,
+                "stores": list(cl.stores.values()),
+                "regions": list(cl._regions.values()),
+                "tso_physical": cl._tso_physical,
+                "tso_logical": cl._tso_logical,
+                "entries": list(en._entries.items()),
+            }
+
+    def _install_state(self, st: dict) -> None:
+        from sortedcontainers import SortedDict
+        cl, en = self.storage.cluster, self.storage.engine
+        with cl._mu, en._mu:
+            cl._id = st["id"]
+            cl.stores = {s.id: s for s in st["stores"]}
+            cl._regions = SortedDict(
+                {r.start: r for r in st["regions"]})
+            cl._tso_physical = st["tso_physical"]
+            cl._tso_logical = st["tso_logical"]
+            en._entries = SortedDict(
+                {k: e for k, e in st["entries"]})
+            en._locked_keys = {k for k, e in st["entries"]
+                               if e.lock is not None}
+
+    def _ship(self, method: str, args: tuple, kwargs: dict) -> None:
+        """Synchronously replicate one applied mutation. Called under
+        _ship_mu, so the backup applies in exactly primary order. If the
+        backup is unreachable the primary degrades to solo (logged once,
+        surfaced in repl_hello); a re-attached backup re-syncs via
+        repl_snapshot."""
+        if self._backup_dead or self._backup_addr is None:
+            return
+        cl = self.storage.cluster
+        watermark = (cl._tso_physical << 18) | cl._tso_logical
+        try:
+            if self._backup is None:
+                self._backup = _Conn(self._backup_addr)
+            self._backup.call("repl_apply",
+                              (method, args, kwargs, watermark), {})
+        except (ConnectionError, OSError, wire.WireError) as e:
+            if self._backup is not None:
+                self._backup.close()
+                self._backup = None
+            self._backup_dead = True
+            print(f"storage: backup unreachable, degrading to solo: {e}",
+                  flush=True)
+
+    def _repl_apply(self, method: str, args: tuple, kwargs: dict,
+                    watermark: int) -> None:
+        if self.role != "backup":
+            raise kv.KVError("repl_apply on a non-backup node")
+        if method not in _MUTATING:
+            raise kv.KVError(f"refusing to replay {method!r}")
+        cl = self.storage.cluster
+        with cl._mu:
+            # track the primary's TSO so a promotion never goes backward
+            if (watermark >> 18) > cl._tso_physical:
+                cl._tso_physical = watermark >> 18
+                cl._tso_logical = watermark & ((1 << 18) - 1)
+        self._dispatch(method, args, kwargs)
+
+    def _repl_promote(self) -> str:
+        """Backup -> primary (failover). TSO is bumped past everything
+        the dead primary could have issued."""
+        if self.role == "primary":
+            return "already-primary"
+        cl = self.storage.cluster
+        with cl._mu:
+            cl._tso_physical = max(cl._tso_physical,
+                                   int(time.time() * 1000)) + 1
+            cl._tso_logical = 0
+        self.role = "primary"
+        return "promoted"
 
     def start(self) -> None:
         t = threading.Thread(target=self._accept, daemon=True,
@@ -142,6 +249,31 @@ class StorageServer:
         if any(not isinstance(k, str) for k in kwargs):
             raise wire.WireError("kwargs keys must be strings")
         return cmd, args, kwargs
+
+    def _serve_call(self, method: str, args: tuple, kwargs: dict):
+        """Top-level command entry: role gate + replication shipping."""
+        if method == "ping":
+            return "pong"
+        if method == "repl_hello":
+            return {"role": self.role,
+                    "backup_dead": self._backup_dead}
+        if method == "repl_apply":
+            return self._repl_apply(*args)
+        if method == "repl_snapshot":
+            return self._export_state()
+        if method == "repl_promote":
+            return self._repl_promote()
+        if self.role == "backup":
+            # data commands only run on the primary; leader_store=-1 is
+            # the "this is a replication backup" sentinel the client's
+            # failover logic keys on (ref: NotLeader region errors)
+            raise kv.NotLeaderError(0, -1)
+        if method in _MUTATING:
+            with self._ship_mu:
+                result = self._dispatch(method, args, kwargs)
+                self._ship(method, args, kwargs)
+                return result
+        return self._dispatch(method, args, kwargs)
 
     def _dispatch(self, method: str, args: tuple, kwargs: dict):
         st = self.storage
@@ -180,7 +312,7 @@ class StorageServer:
                     req = wire.decode_frame_payload(payload)
                     cmd, args, kwargs = self._validate_request(req)
                     method = wire.METHOD_BY_CMD[cmd]
-                    result = self._dispatch(method, args, kwargs)
+                    result = self._serve_call(method, args, kwargs)
                     out, status = wire.encode(result), _STATUS_OK
                 except wire.WireError as e:
                     # malformed frame: reject loudly, keep serving
@@ -226,8 +358,8 @@ class StorageServer:
 # client side
 
 class _Conn:
-    def __init__(self, addr):
-        self.sock = socket.create_connection(addr, timeout=30)
+    def __init__(self, addr, timeout: float = 30):
+        self.sock = socket.create_connection(addr, timeout=timeout)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 
     def call(self, method: str, args: tuple, kwargs: dict):
@@ -252,29 +384,78 @@ class _Conn:
 
 
 class RemoteClient:
-    """Connection pool + failure translation (ref: client.go connArray +
-    region_request.go onSendFail)."""
+    """Connection pool + failure translation + replica failover (ref:
+    client.go connArray + region_request.go onSendFail; the failover
+    orchestration the reference delegates to PD lives here, client-side
+    — documented single-host substitute).
+
+    `addr` may be one (host, port) or a list of them: a primary plus its
+    backup. On dial failure the client rotates to the next address; when
+    it reaches a node answering NotLeader(leader_store=-1) (the backup
+    sentinel) while the old primary is unreachable, it promotes that
+    backup and retries."""
 
     def __init__(self, addr, max_conns: int = MAX_CONNS,
                  retry_window: float = 10.0):
-        self.addr = addr
+        if isinstance(addr, list):
+            self.addrs = list(addr)
+        else:
+            self.addrs = [addr]
         self.retry_window = retry_window
-        self._pool: list[_Conn] = []
+        self._cur = 0                      # index of believed primary
+        self._pools: dict = {}             # addr -> list[_Conn]
         self._sema = threading.Semaphore(max_conns)
         self._mu = threading.Lock()
 
-    def _checkout(self) -> _Conn:
-        with self._mu:
-            if self._pool:
-                return self._pool.pop()
-        return _Conn(self.addr)
+    @property
+    def addr(self):
+        return self.addrs[self._cur]
 
-    def _checkin(self, conn: _Conn) -> None:
+    def _checkout(self) -> tuple:
         with self._mu:
-            if len(self._pool) < MAX_CONNS:
-                self._pool.append(conn)
-                return
+            addr = self.addrs[self._cur]
+            pool = self._pools.get(addr)
+            if pool:
+                return addr, pool.pop()
+        return addr, _Conn(addr)
+
+    def _checkin(self, addr, conn: _Conn) -> None:
+        with self._mu:
+            if addr == self.addrs[self._cur]:
+                pool = self._pools.setdefault(addr, [])
+                if len(pool) < MAX_CONNS:
+                    pool.append(conn)
+                    return
         conn.close()
+
+    def _rotate(self, from_addr) -> None:
+        with self._mu:
+            if self.addrs[self._cur] == from_addr and len(self.addrs) > 1:
+                self._cur = (self._cur + 1) % len(self.addrs)
+
+    def _old_primary_unreachable(self, backup_addr) -> bool:
+        for a in self.addrs:
+            if a == backup_addr:
+                continue
+            try:
+                c = _Conn(a, timeout=1.0)
+            except OSError:
+                continue
+            try:
+                if c.call("repl_hello", (), {}).get("role") == "primary":
+                    return False
+            except Exception:   # noqa: BLE001 — unhealthy counts as dead
+                pass
+            finally:
+                c.close()
+        return True
+
+    def _promote(self, addr) -> None:
+        c = _Conn(addr)
+        try:
+            c.call("repl_promote", (), {})
+        finally:
+            c.close()
 
     def call(self, method: str, *args, **kwargs):
         self._sema.acquire()
@@ -286,11 +467,11 @@ class RemoteClient:
     def _call_inner(self, method: str, args, kwargs):
         deadline = time.monotonic() + self.retry_window
         idempotent = method in _IDEMPOTENT
-        sent_once = False
         while True:
             try:
-                conn = self._checkout()
+                addr, conn = self._checkout()
             except OSError as e:
+                self._rotate(self.addrs[self._cur])
                 if time.monotonic() < deadline:
                     time.sleep(0.1)
                     continue    # storage may be restarting: keep dialing
@@ -298,10 +479,25 @@ class RemoteClient:
                     f"storage unreachable at {self.addr}: {e}") from None
             try:
                 result = conn.call(method, args, kwargs)
+            except kv.NotLeaderError as e:
+                conn.close()
+                if e.leader_store == -1:
+                    # reached a backup: promote it iff the primary is
+                    # really gone, else go back to the primary
+                    if self._old_primary_unreachable(addr):
+                        try:
+                            self._promote(addr)
+                        except (ConnectionError, OSError) as pe:
+                            raise kv.ServerBusyError(
+                                f"failover promote failed: {pe}") from None
+                        continue
+                    self._rotate(addr)
+                    continue
+                raise
             except (ConnectionError, OSError, wire.WireError,
                     EOFError) as e:
                 conn.close()
-                sent_once = True
+                self._rotate(addr)
                 if idempotent and time.monotonic() < deadline:
                     time.sleep(0.05)
                     continue
@@ -311,14 +507,15 @@ class RemoteClient:
                 # a mutating command may or may not have executed
                 raise TimeoutError_(
                     f"storage i/o failure mid-request: {e}") from None
-            self._checkin(conn)
+            self._checkin(addr, conn)
             return result
 
     def close(self) -> None:
         with self._mu:
-            for c in self._pool:
-                c.close()
-            self._pool.clear()
+            for pool in self._pools.values():
+                for c in pool:
+                    c.close()
+            self._pools.clear()
 
 
 class _RemotePD:
@@ -430,8 +627,10 @@ class RemoteStorage(kv.Storage):
         self.rpc.close()
 
 
-def connect(host: str, port: int) -> RemoteStorage:
-    return RemoteStorage((host, port))
+def connect(host: str, port: int, *backups) -> RemoteStorage:
+    """backups: extra (host, port) pairs forming the replica set."""
+    addrs = [(host, port)] + [tuple(b) for b in backups]
+    return RemoteStorage(addrs if len(addrs) > 1 else addrs[0])
 
 
 # ---------------------------------------------------------------------------
@@ -445,9 +644,23 @@ def serve_main(argv=None) -> int:
     p.add_argument("--snapshot", default=None,
                    help="state snapshot file (loaded at start, saved on "
                         "graceful shutdown)")
+    p.add_argument("--role", choices=["primary", "backup"],
+                   default="primary")
+    p.add_argument("--backup", default=None, metavar="HOST:PORT",
+                   help="(primary) ship every mutation here synchronously")
+    p.add_argument("--primary", default=None, metavar="HOST:PORT",
+                   help="(backup) pull initial state from this primary")
+
+    def _addr(s):
+        h, _, pt = s.rpartition(":")
+        return (h or "127.0.0.1", int(pt))
+
     args = p.parse_args(argv)
-    server = StorageServer(args.host, args.port,
-                           snapshot_path=args.snapshot)
+    server = StorageServer(
+        args.host, args.port, snapshot_path=args.snapshot,
+        role=args.role,
+        backup_addr=_addr(args.backup) if args.backup else None,
+        primary_addr=_addr(args.primary) if args.primary else None)
     server.start()
     print(f"storage listening on {args.host}:{server.port}", flush=True)
     stop = threading.Event()

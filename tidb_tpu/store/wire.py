@@ -84,6 +84,7 @@ class Cmd(IntEnum):
     REPL_HELLO = 70
     REPL_APPLY = 71
     REPL_SNAPSHOT = 72
+    REPL_PROMOTE = 73
 
 
 # method-name <-> Cmd mapping used by the RPC layer (the shim's python
@@ -111,6 +112,7 @@ CMD_BY_METHOD = {
     "bulk_import": Cmd.BULK_IMPORT,
     "repl_hello": Cmd.REPL_HELLO, "repl_apply": Cmd.REPL_APPLY,
     "repl_snapshot": Cmd.REPL_SNAPSHOT,
+    "repl_promote": Cmd.REPL_PROMOTE,
 }
 METHOD_BY_CMD = {v: k for k, v in CMD_BY_METHOD.items()}
 
@@ -223,6 +225,13 @@ def _install_registry():
 
     from tidb_tpu.ops.hashagg import GroupResult
     _reg_struct(22, GroupResult)
+
+    # MVCC engine internals: cross the wire only in REPL_SNAPSHOT state
+    # transfer (primary -> attaching backup)
+    from tidb_tpu.mockstore.mvcc import WriteType, _Entry, _Lock
+    _reg_struct(23, _Lock)
+    _reg_struct(24, _Entry)
+    _reg_enum(9, WriteType)
 
     # enums (ids 1..)
     _reg_enum(1, kv.MutationOp)
