@@ -1,0 +1,89 @@
+"""Versioned bootstrap upgrades (bootstrap.py; ref: bootstrap.go:40-180
+upgradeToVerN chain): a store bootstrapped by round-N code opens under
+round-N+1 code and migrates, idempotently."""
+
+import pytest
+
+from tidb_tpu import bootstrap as bs
+from tidb_tpu.privilege import ALL_PRIVS
+from tidb_tpu.session import Session
+from tidb_tpu.store.storage import new_mock_storage
+
+
+def _downgrade_to_v1(storage):
+    """Rewind a freshly-bootstrapped store to what round-3 code wrote:
+    version row '1', no help_topic, root with a pre-SUPER bitmask."""
+    s = Session(storage, internal=True)
+    s.execute("UPDATE mysql.tidb SET variable_value = '1' "
+              "WHERE variable_name = 'bootstrapped'")
+    s.execute("UPDATE mysql.user SET privs = 1 "
+              "WHERE user = 'root' AND host = '%'")
+    s.execute("DROP TABLE mysql.help_topic")
+    s.close()
+
+
+@pytest.fixture
+def old_store():
+    st = new_mock_storage()
+    bs.bootstrap(st)             # current version
+    _downgrade_to_v1(st)
+    return st
+
+
+def _version(storage) -> int:
+    s = Session(storage, internal=True)
+    try:
+        return int(s.query(
+            "SELECT variable_value FROM mysql.tidb "
+            "WHERE variable_name = 'bootstrapped'").rows[0][0])
+    finally:
+        s.close()
+
+
+class TestUpgradeChain:
+    def test_old_store_migrates_on_open(self, old_store):
+        bs.bootstrap(old_store)
+        assert _version(old_store) == bs.BOOTSTRAP_VERSION
+        s = Session(old_store, internal=True)
+        # ver2: root re-granted the full bitmask
+        assert s.query("SELECT privs FROM mysql.user WHERE user='root'"
+                       ).rows == [(ALL_PRIVS,)]
+        # ver3: help_topic exists and is queryable
+        assert s.query("SELECT COUNT(*) FROM mysql.help_topic"
+                       ).rows == [(0,)]
+        s.close()
+
+    def test_upgrade_is_idempotent(self, old_store):
+        bs.bootstrap(old_store)
+        before = _version(old_store)
+        bs.bootstrap(old_store)      # second open: no-op, no errors
+        bs.bootstrap(old_store)
+        assert _version(old_store) == before
+
+    def test_partial_upgrade_resumes(self, old_store):
+        """Crash between a step and its version write replays the step:
+        simulate by running only ver2 then reopening."""
+        s = Session(old_store, internal=True)
+        bs._upgrade_to_ver2(s)
+        s.execute("UPDATE mysql.tidb SET variable_value = '2' "
+                  "WHERE variable_name = 'bootstrapped'")
+        s.close()
+        bs.bootstrap(old_store)      # resumes at ver3
+        assert _version(old_store) == bs.BOOTSTRAP_VERSION
+        s = Session(old_store, internal=True)
+        assert s.query("SELECT COUNT(*) FROM mysql.help_topic"
+                       ).rows == [(0,)]
+        s.close()
+
+    def test_fresh_store_skips_chain(self):
+        st = new_mock_storage()
+        bs.bootstrap(st)
+        s = Session(st)
+        assert _version(st) == bs.BOOTSTRAP_VERSION
+        assert s.query("SELECT COUNT(*) FROM mysql.help_topic"
+                       ).rows == [(0,)]
+        s.close()
+
+    def test_upgrade_registry_is_contiguous(self):
+        assert set(bs._UPGRADES) == \
+            set(range(2, bs.BOOTSTRAP_VERSION + 1))

@@ -1,10 +1,16 @@
-"""First-run bootstrap: the `mysql` system catalog + root account.
+"""First-run bootstrap + versioned upgrades of the `mysql` catalog.
 
 Reference: /root/reference/bootstrap.go:40-180 — DDL+DML creating
-mysql.user / db / tables_priv / GLOBAL_VARIABLES / tidb, versioned so
-upgrades can run incremental steps, executed once per store under a
-bootstrap guard. Grant rows here use a BIGINT privilege bitmask (see
-tidb_tpu/privilege.py) instead of per-priv enum columns.
+mysql.user / db / tables_priv / GLOBAL_VARIABLES / tidb / help_topic,
+with a persisted bootstrap version and an `upgradeToVerN` chain so a
+store written by version N opens under version N+1 code (bootstrap.go
+upgrade() dispatching upgradeToVer2...). Grant rows here use a BIGINT
+privilege bitmask (see tidb_tpu/privilege.py) instead of per-priv enum
+columns.
+
+Adding a migration: bump BOOTSTRAP_VERSION, append `_upgrade_to_verN`
+to _UPGRADES. Each step must be idempotent — a crash between a step and
+the version-row update replays the step on next open.
 """
 
 from __future__ import annotations
@@ -15,7 +21,7 @@ from tidb_tpu.privilege import ALL_PRIVS
 
 __all__ = ["bootstrap", "load_global_variables", "BOOTSTRAP_VERSION"]
 
-BOOTSTRAP_VERSION = 2   # v2: SUPER added to ALL_PRIVS (root re-granted)
+BOOTSTRAP_VERSION = 3
 
 _DDL = [
     "CREATE DATABASE IF NOT EXISTS mysql",
@@ -73,9 +79,47 @@ def load_global_variables(storage) -> None:
         s.close()
 
 
+_HELP_TOPIC_DDL = """CREATE TABLE IF NOT EXISTS mysql.help_topic (
+    help_topic_id BIGINT PRIMARY KEY, name VARCHAR(64),
+    help_category_id BIGINT, description VARCHAR(1024),
+    example VARCHAR(1024), url VARCHAR(128))"""
+
+
+def _upgrade_to_ver2(session) -> None:
+    """SUPER joined ALL_PRIVS — re-grant root (ref: bootstrap.go's
+    upgradeToVer2 re-granting new privileges)."""
+    session.execute(
+        f"UPDATE mysql.user SET privs = {ALL_PRIVS} "
+        "WHERE user = 'root' AND host = '%'")
+
+
+def _upgrade_to_ver3(session) -> None:
+    """mysql.help_topic, bootstrapped by the reference since its first
+    version (ref: bootstrap.go:100 tableHelpTopic) — created on upgrade
+    for stores bootstrapped before round 5."""
+    session.execute(_HELP_TOPIC_DDL)
+
+
+_UPGRADES = {2: _upgrade_to_ver2, 3: _upgrade_to_ver3}
+assert set(_UPGRADES) == set(range(2, BOOTSTRAP_VERSION + 1))
+
+
+def _write_version(session, ver: int, fresh: bool) -> None:
+    if fresh:
+        session.execute(
+            f"INSERT INTO mysql.tidb VALUES ('bootstrapped', '{ver}', "
+            "'Bootstrap version. Do not delete.')")
+    else:
+        session.execute(
+            f"UPDATE mysql.tidb SET variable_value = '{ver}' "
+            "WHERE variable_name = 'bootstrapped'")
+
+
 def bootstrap(storage) -> None:
-    """Idempotent: creates system tables + root@% superuser on first run
-    (ref: bootstrap.go runInBootstrapSession / doDDLWorks / doDMLWorks)."""
+    """Idempotent: fresh stores get the full current catalog; stores
+    bootstrapped by older code run the upgrade chain one version at a
+    time, persisting the version after each step (ref: bootstrap.go
+    runInBootstrapSession / doDDLWorks / doDMLWorks / upgrade)."""
     from tidb_tpu.session import Session
 
     with _lock:
@@ -84,28 +128,18 @@ def bootstrap(storage) -> None:
             ver = _bootstrapped_version(session)
             if ver >= BOOTSTRAP_VERSION:
                 return
-            for ddl in _DDL:
-                session.execute(ddl)
-            if not session.query(
-                    "SELECT user FROM mysql.user WHERE user = 'root'").rows:
-                session.execute(
-                    "INSERT INTO mysql.user VALUES "
-                    f"('%', 'root', '', {ALL_PRIVS})")
-            elif ver < 2:
-                # upgradeToVer2: SUPER joined ALL_PRIVS — re-grant root
-                # (ref: bootstrap.go's versioned upgradeToVerN steps)
-                session.execute(
-                    f"UPDATE mysql.user SET privs = {ALL_PRIVS} "
-                    "WHERE user = 'root' AND host = '%'")
             if ver == 0:
-                session.execute(
-                    "INSERT INTO mysql.tidb VALUES ('bootstrapped', "
-                    f"'{BOOTSTRAP_VERSION}', 'Bootstrap version. Do not "
-                    "delete.')")
-            else:
-                session.execute(
-                    "UPDATE mysql.tidb SET variable_value = "
-                    f"'{BOOTSTRAP_VERSION}' WHERE variable_name = "
-                    "'bootstrapped'")
+                for ddl in _DDL + [_HELP_TOPIC_DDL]:
+                    session.execute(ddl)
+                if not session.query("SELECT user FROM mysql.user "
+                                     "WHERE user = 'root'").rows:
+                    session.execute(
+                        "INSERT INTO mysql.user VALUES "
+                        f"('%', 'root', '', {ALL_PRIVS})")
+                _write_version(session, BOOTSTRAP_VERSION, fresh=True)
+                return
+            for v in range(ver + 1, BOOTSTRAP_VERSION + 1):
+                _UPGRADES[v](session)
+                _write_version(session, v, fresh=False)
         finally:
             session.close()
