@@ -35,7 +35,9 @@ from tidb_tpu.expression import AggDesc, AggFunc, Expression
 from tidb_tpu.ops import runtime
 from tidb_tpu.ops.hashagg import (CapacityError, CollisionError, GroupResult,
                                   _FILL, _SENTINEL_MASKED, _I64_MAX, _I64_MIN,
-                                  _agg_lanes, _distinct_count, _hash_keys,
+                                  _SegBatch, _agg_requests,
+                                  _direct_group_mode, _direct_group_table,
+                                  _group_table, _hash_keys,
                                   _validate_device_exprs,
                                   finalize_group_result)
 
@@ -50,30 +52,56 @@ _MERGE = {"sum": jax.ops.segment_sum,
 
 
 def group_merge_program(xp, cols, mask, ln, offs, ti, group_exprs, aggs,
-                        C, ndev, tp):
+                        C, ndev, tp, row_ids=None):
     """The shared traced body: local sort-based group tables, all_gather
     merge over every mesh axis, tp-axis slice. `cols` is any virtual
     column list (probe columns, or probe + gathered join payloads —
-    parallel/dist_join.py); expressions index into it."""
-    key_cols = [g.eval_xp(xp, cols, ln) for g in group_exprs]
-    h = _hash_keys(xp, key_cols, ln, seed=0x517CC1B727220A95)
-    h2 = _hash_keys(xp, key_cols, ln, seed=0x2545F4914F6CDD1D)
-    h = xp.where(mask, h, _SENTINEL_MASKED)
+    parallel/dist_join.py); expressions index into it. row_ids (global
+    original probe row index per row) replaces offs+arange for the
+    representative/FIRST_ROW lanes when rows were compacted."""
+    direct = _direct_group_mode(group_exprs)
+    if direct:
+        # dense dict codes index slots directly: no sort, no hash, no
+        # collisions (h2 lanes are zeros so the check trivially passes)
+        axes = ("dp", "tp") if ndev > 1 else None
+        uniq, inv, local_tot = _direct_group_table(
+            xp, group_exprs, cols, ln, mask, C, pmax_axes=axes)
+        h2 = xp.zeros(ln, dtype=jnp.int64)
+    else:
+        key_cols = [g.eval_xp(xp, cols, ln) for g in group_exprs]
+        h = _hash_keys(xp, key_cols, ln, seed=0x517CC1B727220A95)
+        h2 = _hash_keys(xp, key_cols, ln, seed=0x2545F4914F6CDD1D)
+        uniq, inv, local_tot = _group_table(xp, h, ln, C, mask=mask)
 
-    uniq, inv = jnp.unique(h, size=C, fill_value=_FILL, return_inverse=True)
-    local_tot = _distinct_count(xp, h)
+    # one _SegBatch for the header lanes + every aggregate: all lanes
+    # with the same (merge-op, dtype) reduce in one wide scatter pass
+    mask_i = mask.astype(jnp.int64)
+    b = _SegBatch(inv, C)
+    i_cnt = b.add(mask_i, "sum")
+    i_h2min = b.add(xp.where(mask, h2, _I64_MAX), "min")
+    i_h2max = b.add(xp.where(mask, h2, _I64_MIN), "max")
+    if row_ids is not None:
+        i_grep = b.add(xp.where(mask, row_ids, _BIG), "min")
+    else:
+        i_grep = b.add(xp.where(mask, xp.arange(ln), ln), "min")
+    i_ghas = b.add(mask_i, "max")
+    assembles = [_agg_requests(xp, a, cols, ln, mask, b, offs=offs,
+                               row_ids=row_ids)
+                 for a in aggs]
+    b.run()
 
     lanes: list[tuple] = []  # (array[C], merge_op)
-    seg = lambda op, x: _MERGE[op](x, inv, num_segments=C)
-    lanes.append((seg("sum", mask.astype(jnp.int64)), "sum"))      # cnt
-    lanes.append((seg("min", xp.where(mask, h2, _I64_MAX)), "min"))
-    lanes.append((seg("max", xp.where(mask, h2, _I64_MIN)), "max"))
-    grep = seg("min", xp.where(mask, xp.arange(ln), ln))
-    ghas = seg("max", mask.astype(jnp.int64))
-    lanes.append((xp.where(ghas > 0, offs + grep, _BIG), "min"))   # rep
+    lanes.append((b.get(i_cnt), "sum"))                            # cnt
+    lanes.append((b.get(i_h2min), "min"))
+    lanes.append((b.get(i_h2max), "max"))
+    if row_ids is not None:
+        lanes.append((b.get(i_grep), "min"))                       # rep
+    else:
+        lanes.append((xp.where(b.get(i_ghas) > 0,
+                               offs + b.get(i_grep), _BIG), "min"))
     agg_lane_slices = []
-    for a in aggs:
-        ls = _agg_lanes(xp, a, cols, ln, mask, inv, C, offs=offs)
+    for assemble in assembles:
+        ls = assemble(b.get)
         agg_lane_slices.append((len(lanes) - 4, len(ls)))
         lanes.extend(ls)
 
@@ -87,18 +115,54 @@ def group_merge_program(xp, cols, mask, ln, offs, ti, group_exprs, aggs,
                       for s, w in agg_lane_slices),
                 local_tot)
     ax = ("dp", "tp")
+    if direct:
+        # every shard shares one slot space: merge is an elementwise
+        # reduce over the gathered [ndev, C] tables — no re-unique
+        gu = lax.all_gather(uniq, ax)                        # [ndev, C]
+        muniq = xp.min(gu, axis=0)     # FILL > real code > SENTINEL;
+        # a slot live anywhere must not surface as masked-sentinel
+        any_real = xp.max(xp.where(gu == _SENTINEL_MASKED,
+                                   _I64_MIN, gu), axis=0)
+        muniq = xp.where((muniq == _SENTINEL_MASKED) &
+                         (any_real != _I64_MIN) & (any_real != _FILL),
+                         any_real, muniq)
+        gtot = lax.pmax(local_tot, ax)
+        tot = gtot
+        merged = []
+        _RED = {"sum": xp.sum, "min": xp.min, "max": xp.max}
+        for lane, op in lanes:
+            g = lax.all_gather(lane, ax)                     # [ndev, C]
+            merged.append(_RED[op](g, axis=0))
+        blk = C // tp
+        sl = lambda a: lax.dynamic_slice_in_dim(a, ti * blk, blk)
+        cnt, h2min, h2max, rep = merged[:4]
+        agg_out = tuple(
+            tuple(sl(merged[4 + start + i]) for i in range(width))
+            for start, width in agg_lane_slices)
+        return (sl(muniq), sl(cnt), sl(h2min), sl(h2max), sl(rep),
+                agg_out, tot)
     all_uniq = lax.all_gather(uniq, ax, tiled=True)          # [ndev*C]
-    muniq, minv = jnp.unique(all_uniq, size=C, fill_value=_FILL,
-                             return_inverse=True)
-    gtot = _distinct_count(xp, all_uniq)
+    muniq, minv, gtot = _group_table(xp, all_uniq, ndev * C, C)
     # gathered fill/sentinel slots can add up to 2 phantom values to
     # gtot relative to a single table; they are excluded on the host
     # via the live mask, and capacity is checked with slack for them
     tot = xp.maximum(gtot, lax.pmax(local_tot, ax))
-    merged = []
-    for lane, op in lanes:
-        g = lax.all_gather(lane, ax, tiled=True)
-        merged.append(_MERGE[op](g, minv, num_segments=C))
+    # batched re-reduce: stack same-(op,dtype) lanes, one all_gather +
+    # one segment op per kind instead of one per lane
+    groups: dict = {}
+    for i, (lane, op) in enumerate(lanes):
+        groups.setdefault((op, lane.dtype), []).append(i)
+    merged: list = [None] * len(lanes)
+    for (op, _dt), idxs in groups.items():
+        if len(idxs) == 1:
+            g = lax.all_gather(lanes[idxs[0]][0], ax, tiled=True)
+            merged[idxs[0]] = _MERGE[op](g, minv, num_segments=C)
+        else:
+            stk = jnp.stack([lanes[i][0] for i in idxs], axis=1)
+            g = lax.all_gather(stk, ax, tiled=True)
+            r = _MERGE[op](g, minv, num_segments=C)
+            for j, i in enumerate(idxs):
+                merged[i] = r[:, j]
 
     # -- tp-sliced outputs (replicated over dp) ----------------------------
     blk = C // tp
@@ -116,7 +180,7 @@ class MeshKernelBase:
     sharding, and the merged-table postprocess (capacity / collision
     checks + live-group extraction)."""
 
-    def _setup_mesh(self, mesh: Mesh, capacity: int, n_extra_args: int = 0):
+    def _setup_sizes(self, mesh: Mesh, capacity: int):
         self.mesh = mesh
         self.ndev = mesh.devices.size
         self.tp = mesh.shape["tp"]
@@ -128,6 +192,9 @@ class MeshKernelBase:
         self._C = self.capacity + 2
         self._C += (-self._C) % self.tp
         self._row_spec = P(("dp", "tp"))
+
+    def _setup_mesh(self, mesh: Mesh, capacity: int, n_extra_args: int = 0):
+        self._setup_sizes(mesh, capacity)
         in_specs = (self._row_spec, P()) + (P(),) * n_extra_args
         kwargs = dict(mesh=mesh, in_specs=in_specs,
                       out_specs=(P("tp"), P("tp"), P("tp"), P("tp"),

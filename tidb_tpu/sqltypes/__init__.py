@@ -111,10 +111,20 @@ class FieldType:
     frac: int = -1       # decimal digits after the point (NEWDECIMAL, DURATION)
     charset: str = "utf8"
     elems: tuple = ()    # ENUM/SET members
+    # collation drives compare/group/sort/unique for string columns
+    # (ref: util/charset/charset.go; _ci approximated by str.casefold —
+    # unicode simple case folding, docs/DEVIATIONS.md)
+    collation: str = "utf8mb4_bin"
 
     @property
     def is_unsigned(self) -> bool:
         return bool(self.flags & Flag.UNSIGNED)
+
+    @property
+    def is_ci(self) -> bool:
+        """Case-insensitive collation on a string-typed column."""
+        return self.collation.endswith("_ci") and \
+            self.eval_type == EvalType.STRING
 
     @property
     def not_null(self) -> bool:
