@@ -24,9 +24,16 @@ device path; vs_baseline = geomean of per-query device/host speedups.
 
 Env knobs: BENCH_SF (default 1.0), BENCH_ITERS (5), BENCH_HOST_ITERS (2),
 BENCH_REGIONS (4), BENCH_KERNEL_MICRO (1), BENCH_SKIP_PROBE (0; 1 skips
-the 120s device-liveness probe and trusts the default platform),
+the device-liveness probes and trusts the default platform),
+BENCH_PROBE_ATTEMPTS (3) / BENCH_PROBE_TIMEOUT (120s) — the probe
+retries with backoff so one tunnel flap doesn't condemn the run,
 BENCH_CPU_SF (0.2; scale used when the chip tunnel is down and no
 explicit BENCH_SF was given — CPU XLA is ~20-40x slower than a chip).
+
+Reported alongside rows/s: per-query device_scan_gbps (input bytes over
+device wall time) and roofline_fraction against the platform's memory
+peak (chip: HBM datasheet number by device kind; CPU fallback: measured
+memcpy bandwidth), so "fast" is judged against hardware limits.
 """
 
 from __future__ import annotations
@@ -112,6 +119,78 @@ def _probe_devices(timeout_s: int = 120) -> bool:
         return False
 
 
+def _probe_devices_with_retry() -> bool:
+    """The chip tunnel flaps: one failed 120s probe must not condemn the
+    whole run to the CPU fallback. Retries with backoff for ~7 minutes
+    total (BENCH_PROBE_ATTEMPTS / BENCH_PROBE_TIMEOUT override)."""
+    attempts = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "3"))
+    timeout_s = int(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
+    for i in range(attempts):
+        if _probe_devices(timeout_s):
+            return True
+        if i < attempts - 1:
+            wait = 30 * (i + 1)
+            print(f"[bench] device probe {i + 1}/{attempts} failed; "
+                  f"retrying in {wait}s", file=sys.stderr, flush=True)
+            time.sleep(wait)
+    return False
+
+
+# HBM peak per chip family (public figures, GB/s) for the roofline
+# fraction; the CPU fallback measures its own memcpy bandwidth instead.
+_HBM_PEAK_GBPS = {"TPU v2": 700.0, "TPU v3": 900.0, "TPU v4": 1228.0,
+                  "TPU v5 lite": 819.0, "TPU v5e": 819.0,
+                  "TPU v5p": 2765.0, "TPU v6 lite": 1640.0,
+                  "TPU v6e": 1640.0}
+
+
+def _memory_roofline_gbps() -> tuple[float, str]:
+    """-> (peak GB/s, how it was obtained). On a chip: table lookup by
+    device kind. On CPU: measured big-buffer memcpy bandwidth."""
+    import jax
+    kind = jax.devices()[0].device_kind
+    if kind in _HBM_PEAK_GBPS:
+        return _HBM_PEAK_GBPS[kind], f"datasheet({kind})"
+    for k, v in _HBM_PEAK_GBPS.items():
+        if k.lower() in kind.lower():
+            return v, f"datasheet({kind})"
+    import numpy as _np
+    buf = _np.empty(1 << 27, dtype=_np.uint8)   # 128 MB
+    t0 = time.perf_counter()
+    for _ in range(3):
+        buf2 = buf.copy()
+    dt = time.perf_counter() - t0
+    del buf2
+    # copy reads + writes: 2 bytes moved per byte copied
+    return (3 * 2 * buf.nbytes / dt) / 1e9, f"measured-memcpy({kind})"
+
+
+_TABLE_PREFIX = {"region": "r_", "nation": "n_", "customer": "c_",
+                 "supplier": "s_", "orders": "o_", "lineitem": "l_"}
+
+
+def _query_bytes(data, qname: str) -> int:
+    """Bytes the query's input tables occupy in the columnar chunk
+    layout: 8-byte lanes for fixed-width columns, utf8 length for
+    strings — the device path's scan traffic upper bound."""
+    from tidb_tpu.benchmarks import tpch
+    import numpy as _np
+    total = 0
+    for tname in tpch.QUERY_TABLES[qname]:
+        pref = _TABLE_PREFIX[tname]
+        for name in vars(data):
+            if not name.startswith(pref):
+                continue
+            a = _np.asarray(getattr(data, name))
+            if a.ndim != 1:
+                continue
+            if a.dtype == _np.dtype(object):
+                total += int(sum(len(str(x)) for x in a))
+            else:
+                total += int(a.size * 8)
+    return total
+
+
 def main() -> None:
     sf = float(os.environ.get("BENCH_SF", "1.0"))
     iters = int(os.environ.get("BENCH_ITERS", "5"))
@@ -120,9 +199,9 @@ def main() -> None:
 
     device_fallback = None
     if os.environ.get("BENCH_SKIP_PROBE", "0") != "1" and \
-            not _probe_devices():
+            not _probe_devices_with_retry():
         # chip tunnel down: measure CPU-XLA vs numpy rather than hang
-        print("[bench] device probe timed out; falling back to CPU XLA",
+        print("[bench] device probes exhausted; falling back to CPU XLA",
               file=sys.stderr, flush=True)
         import jax
         jax.config.update("jax_platforms", "cpu")
@@ -158,15 +237,29 @@ def main() -> None:
     load_secs = time.perf_counter() - t0
     progress(f"loaded {total_rows} rows in {load_secs:.1f}s")
 
+    roof_gbps, roof_src = _memory_roofline_gbps()
     detail: dict = {"sf": sf, "iters": iters, "rows_loaded": total_rows,
-                    "load_secs": round(load_secs, 1)}
+                    "load_secs": round(load_secs, 1),
+                    # vs_baseline is measured-vs-measured on this
+                    # machine: device XLA path / numpy host path, same
+                    # plans, same store. The Go reference cannot be
+                    # built here (no Go toolchain in the image) — see
+                    # BASELINE.md "Baseline calibration" for why the
+                    # vectorized numpy host is a conservative stand-in
+                    # for the reference's row-at-a-time chunk executor.
+                    "baseline_kind": "measured numpy host executor "
+                                     "(no Go toolchain; BASELINE.md)",
+                    "memory_roofline_gbps": round(roof_gbps, 1),
+                    "memory_roofline_source": roof_src}
     if device_fallback:
         detail["device_platform_fallback"] = device_fallback
     speedups = []
     device_rps = []
+    rooflines = []
 
     for qname, sql in tpch.QUERIES.items():
         in_rows = sum(data.counts[t] for t in tpch.QUERY_TABLES[qname])
+        in_bytes = _query_bytes(data, qname)
 
         # device path: mesh over the visible chip(s) + device kernels
         config.set_var("tidb_tpu_device", 1)
@@ -193,14 +286,19 @@ def main() -> None:
 
         d_rps = in_rows / d_secs
         h_rps = in_rows / h_secs
+        d_gbps = in_bytes / d_secs / 1e9
         speedups.append(d_rps / h_rps)
         device_rps.append(d_rps)
+        rooflines.append(d_gbps / roof_gbps)
         detail[qname] = {
             "input_rows": in_rows,
+            "input_bytes": in_bytes,
             "device_secs": round(d_secs, 4),
             "host_secs": round(h_secs, 4),
             "device_rows_per_sec": round(d_rps, 1),
             "host_rows_per_sec": round(h_rps, 1),
+            "device_scan_gbps": round(d_gbps, 3),
+            "roofline_fraction": round(d_gbps / roof_gbps, 4),
             "speedup": round(d_rps / h_rps, 2),
             "first_run_secs": round(warm_secs, 2),
             "result_rows": len(d_rows),
@@ -218,6 +316,8 @@ def main() -> None:
                        / len(device_rps))
     geo_speedup = math.exp(sum(math.log(x) for x in speedups)
                            / len(speedups))
+    detail["roofline_fraction_geomean"] = round(
+        math.exp(sum(math.log(x) for x in rooflines) / len(rooflines)), 4)
     print(json.dumps({
         "metric": "tpch_q1_q3_q5_e2e_rows_per_sec_per_chip",
         "value": round(geo_rps, 1),
