@@ -217,21 +217,29 @@ def dict_encode(col: Column) -> tuple[np.ndarray, list]:
     """Dictionary-encode a varlen column: returns (int64 codes, dictionary).
 
     NULLs get code -1. The codes array rides the device path for group-by /
-    join keys; the dictionary stays host-side for final decode.
+    join keys; the dictionary stays host-side for final decode. Columns
+    with a _ci collation encode by CASEFOLDED value — case variants share
+    one code, so device group-by/compare over codes follows the collation
+    (the dictionary keeps the first-seen variant for decode, matching the
+    host path's representative-row semantics).
     """
     codes = np.empty(len(col), dtype=np.int64)
     mapping: dict = {}
     values: list = []
     data, valid = col.data, col.valid
+    ci = col.ft.is_ci
+    if ci:
+        from tidb_tpu.sqltypes import collation_key
     for i in range(len(col)):
         if not valid[i]:
             codes[i] = -1
             continue
         v = data[i]
-        c = mapping.get(v)
+        k = collation_key(v) if ci else v
+        c = mapping.get(k)
         if c is None:
             c = len(values)
-            mapping[v] = c
+            mapping[k] = c
             values.append(v)
         codes[i] = c
     return codes, values

@@ -381,14 +381,23 @@ def _check_column_type(cd) -> None:
 def build_table_info(meta: Meta, stmt: ast.CreateTableStmt) -> TableInfo:
     info = TableInfo(id=meta.gen_global_id(), name=stmt.table.name)
     names = set()
+    # table-level default collation applies to string columns without an
+    # explicit COLLATE (ref: util/charset; only _bin and _general_ci are
+    # implemented — docs/DEVIATIONS.md)
+    table_coll = (stmt.options or {}).get("collate", "").lower()
     for i, cd in enumerate(stmt.columns):
         if cd.name.lower() in names:
             raise DDLError(f"duplicate column '{cd.name}'")
         names.add(cd.name.lower())
         _check_column_type(cd)
+        ft = cd.ft
+        if table_coll and ft.eval_type == EvalType.STRING and \
+                ft.collation == "utf8mb4_bin":
+            import dataclasses
+            ft = dataclasses.replace(ft, collation=table_coll)
         default = _const_default(cd) if cd.has_default else None
         info.columns.append(ColumnInfo(
-            id=i + 1, name=cd.name, offset=i, ft=cd.ft, default=default,
+            id=i + 1, name=cd.name, offset=i, ft=ft, default=default,
             has_default=cd.has_default or not cd.ft.not_null,
             auto_increment=cd.auto_increment, comment=cd.comment))
     info.max_column_id = len(stmt.columns)

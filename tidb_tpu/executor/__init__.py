@@ -606,6 +606,9 @@ def _sort_order(by, chunk) -> np.ndarray:
     keys = []
     for e, desc in by:
         d, v = e.eval(chunk)
+        if e.ft.is_ci and np.asarray(d).dtype == np.dtype(object):
+            from tidb_tpu.sqltypes import fold_column
+            d = fold_column(np.asarray(d))   # _ci ordering
         keys.append((d, v, desc))
     return order_from_keys(keys, chunk.num_rows)
 
@@ -697,6 +700,10 @@ class HashJoinExec(Executor):
         for e, oe in zip(exprs, self._other_keys(exprs)):
             d, v = e.eval(chunk)
             d, v = np.asarray(d), np.asarray(v)
+            if d.dtype == np.dtype(object) and \
+                    (e.ft.is_ci or oe.ft.is_ci):
+                from tidb_tpu.sqltypes import fold_column
+                d = fold_column(d)           # _ci join keys
             et, ot = e.ft.eval_type, oe.ft.eval_type
             my = e.ft.frac if et == EvalType.DECIMAL else 0
             their = oe.ft.frac if ot == EvalType.DECIMAL else 0
@@ -1308,8 +1315,11 @@ class InsertExec(Executor):
             vals = []
             for cn in idx.columns:
                 ci = info.col_by_name(cn)
-                vals.append(encode_datum_for_col(values.get(cn.lower()),
-                                                 ci.ft))
+                v = encode_datum_for_col(values.get(cn.lower()), ci.ft)
+                if ci.ft.is_ci and isinstance(v, str):
+                    from tidb_tpu.sqltypes import collation_key
+                    v = collation_key(v)
+                vals.append(v)
             if any(v is None for v in vals):
                 continue
             raw = txn.get(tablecodec.index_key(info.id, idx.id, vals))

@@ -98,7 +98,11 @@ class SpillSorter:
         out = []
         for e, _desc in self.by:
             d, v = e.eval(chunk)
-            out.append((np.asarray(d), np.asarray(v, dtype=bool)))
+            d = np.asarray(d)
+            if e.ft.is_ci and d.dtype == np.dtype(object):
+                from tidb_tpu.sqltypes import fold_column
+                d = fold_column(d)           # _ci ordering
+            out.append((d, np.asarray(v, dtype=bool)))
         return out
 
     def _encode(self, j: int, col: Column) -> np.ndarray:

@@ -482,6 +482,10 @@ class ScalarFunc(Expression):
         if arg_ft.eval_type == EvalType.STRING:
             if xp is not np:
                 raise RuntimeError("string IN is host-only")
+            if arg_ft.is_ci:
+                from tidb_tpu.sqltypes import collation_key, fold_column
+                d = fold_column(d)
+                conv = [collation_key(c) for c in conv]
             out = np.isin(d, np.array(conv, dtype=object))
             return out.astype(np.int64), v
         acc = xp.zeros(n, dtype=bool)
@@ -665,6 +669,14 @@ def _cmp_operands(xp, args, datas):
     a, b = args[0].ft, args[1].ft
     da, db = datas
     if da.dtype == np.dtype(object) or db.dtype == np.dtype(object):
+        if a.is_ci or b.is_ci:
+            # _ci collation: compare casefolded keys (MySQL resolves a
+            # ci column vs a literal to the column's collation)
+            from tidb_tpu.sqltypes import fold_column
+            if da.dtype == np.dtype(object):
+                da = fold_column(da)
+            if db.dtype == np.dtype(object):
+                db = fold_column(db)
         return da, db
     ea, eb = a.eval_type, b.eval_type
     if EvalType.REAL in (ea, eb):
@@ -1041,7 +1053,9 @@ def _eval_string(f: ScalarFunc, argv, n):
     if op == Op.LIKE:
         pat, esc = f.extra if isinstance(f.extra, tuple) \
             else (f.extra, "\\")
-        rx = re.compile(_like_to_regex(pat, esc), re.S)
+        # _ci collation on the matched column: case-insensitive LIKE
+        flags = re.S | (re.I if f.args[0].ft.is_ci else 0)
+        rx = re.compile(_like_to_regex(pat, esc), flags)
         return vec(lambda x: 1 if rx.fullmatch(s(x)) else 0, datas[0],
                    dtype=np.int64), valid
     raise NotImplementedError(op)

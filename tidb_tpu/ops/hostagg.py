@@ -60,9 +60,14 @@ def _host_agg_vectorized(chunk: Chunk, mask, group_exprs, aggs
             _states_to_lanes(a, []) for a in aggs],
             counts=np.zeros(0, dtype=np.int64))
     lanes = []
-    for d, v in gcols:
-        lanes.extend(_lex_key(np.asarray(d)[live],
-                              np.asarray(v)[live]))
+    for (d, v), e in zip(gcols, group_exprs):
+        darr = np.asarray(d)[live]
+        if e.ft.is_ci and darr.dtype == np.dtype(object):
+            # _ci collation groups by the casefolded key; the surfaced
+            # value stays the representative row's original variant
+            from tidb_tpu.sqltypes import fold_column
+            darr = fold_column(darr)
+        lanes.extend(_lex_key(darr, np.asarray(v)[live]))
     if lanes:
         order = np.lexsort(lanes[::-1])   # first col is primary
         sorted_lanes = [l[order] for l in lanes]
@@ -199,6 +204,8 @@ def _host_agg_rowloop(chunk: Chunk, mask, group_exprs,
     states: list[list] = []     # per group: per agg: lanes
     counts: list[int] = []
 
+    from tidb_tpu.sqltypes import collation_key
+    ci = [e.ft.is_ci for e in group_exprs]
     n = chunk.num_rows
     for i in range(n):
         if not mask[i]:
@@ -207,10 +214,13 @@ def _host_agg_rowloop(chunk: Chunk, mask, group_exprs,
             None if not v[i] else (d[i].item() if hasattr(d[i], "item")
                                    else d[i])
             for d, v in gcols)
-        gi = groups.get(key)
+        # group under the collation key; surface the first-seen variant
+        gkey = tuple(collation_key(x) if c and x is not None else x
+                     for x, c in zip(key, ci))
+        gi = groups.get(gkey)
         if gi is None:
             gi = len(keys)
-            groups[key] = gi
+            groups[gkey] = gi
             keys.append(key)
             counts.append(0)
             states.append([_init_state(a) for a in aggs])

@@ -776,7 +776,13 @@ class Parser:
                 pass
             elif self.try_kw("COMMENT"):
                 d.comment = self.next().val
-            elif self.try_kw("COLLATE") or self.try_kw("CHARSET"):
+            elif self.try_kw("COLLATE"):
+                coll = self.next().val.lower()
+                if ft.eval_type == st.EvalType.STRING:
+                    import dataclasses
+                    ft = dataclasses.replace(ft, collation=coll)
+                    d.ft = ft
+            elif self.try_kw("CHARSET"):
                 self.next()
             elif self.try_kw("REFERENCES"):
                 # inline column REFERENCES (incl. MATCH / ON DELETE /

@@ -498,6 +498,12 @@ class Planner:
             if path.useful and path.ranges:
                 indexed = idx_cover_base | {cn.lower() for cn in idx.columns}
                 covering = all(c.name.lower() in indexed for c in cop.cols)
+                # _ci index columns store casefolded keys, not original
+                # values: such indexes can route but never cover
+                if covering and any(
+                        info.col_by_name(cn).ft.is_ci
+                        for cn in idx.columns):
+                    covering = False
                 candidates.append((idx, path, covering))
         if not candidates:
             return reader

@@ -251,6 +251,15 @@ def detach_index_conditions(conjuncts: list, offsets: list[int],
         else:
             r = DatumRange(low=prefix, high=list(prefix))
         ranges.append(r)
+    # _ci index columns store casefolded keys (table/_index_values):
+    # fold the range bounds to match
+    if any(ft.is_ci for ft in fts):
+        from tidb_tpu.sqltypes import collation_key
+        for r in ranges:
+            for vals in (r.low, r.high):
+                for i in range(min(len(vals), len(fts))):
+                    if fts[i].is_ci and isinstance(vals[i], str):
+                        vals[i] = collation_key(vals[i])
     return AccessPath(ranges=ranges, eq_count=eq_count,
                       has_interval=has_interval, consumed=consumed)
 

@@ -47,6 +47,7 @@ class ColumnInfo:
             "tp": int(self.ft.tp), "flags": self.ft.flags,
             "elems": list(self.ft.elems),
             "flen": self.ft.flen, "frac": self.ft.frac,
+            "collation": self.ft.collation,
             "default": _jsonable(self.default),
             "has_default": self.has_default,
             "auto_increment": self.auto_increment,
@@ -58,7 +59,8 @@ class ColumnInfo:
         return ColumnInfo(
             id=d["id"], name=d["name"], offset=d["offset"],
             ft=FieldType(TypeCode(d["tp"]), d["flags"], d["flen"],
-                         d["frac"], elems=tuple(d.get("elems") or ())),
+                         d["frac"], elems=tuple(d.get("elems") or ()),
+                         collation=d.get("collation", "utf8mb4_bin")),
             default=_unjsonable(d.get("default")),
             has_default=d.get("has_default", False),
             auto_increment=d.get("auto_increment", False),

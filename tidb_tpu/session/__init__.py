@@ -1488,8 +1488,14 @@ class Session:
                 [("tidb-tpu", "DEFAULT",
                   "MVCC KV with XLA analytical executors")])
         if stmt.tp == "collation":
-            return ResultSet(["Collation", "Charset", "Default"],
-                             [("utf8_bin", "utf8", "Yes")])
+            # the two implemented collations (sqltypes.FieldType.is_ci;
+            # _general_ci approximated by unicode casefold)
+            return ResultSet(
+                ["Collation", "Charset", "Default"],
+                [("utf8mb4_bin", "utf8mb4", "Yes"),
+                 ("utf8mb4_general_ci", "utf8mb4", ""),
+                 ("utf8_bin", "utf8", ""),
+                 ("utf8_general_ci", "utf8", "")])
         if stmt.tp == "grants":
             target = stmt.pattern or (self.user or "")
             user, _, host = target.partition("@")

@@ -35,6 +35,7 @@ __all__ = [
     "datetime_to_micros", "micros_to_datetime", "date_to_micros",
     "parse_datetime", "format_datetime",
     "parse_duration", "format_duration",
+    "collation_key", "fold_column",
     "NULL",
 ]
 
@@ -145,6 +146,28 @@ class FieldType:
         """True if values are a fixed-width numeric representation
         (device-transferable without dictionary encoding)."""
         return self.eval_type != EvalType.STRING and self.tp != TypeCode.JSON
+
+
+def collation_key(x):
+    """The comparison key of one string value under a _ci collation
+    (approximates utf8mb4_general_ci by unicode simple case folding —
+    docs/DEVIATIONS.md). Non-strings pass through."""
+    if isinstance(x, str):
+        return x.casefold()
+    if isinstance(x, bytes):
+        try:
+            return x.decode("utf8").casefold()
+        except UnicodeDecodeError:
+            return x
+    return x
+
+
+def fold_column(d):
+    """Vectorized collation_key over an object column."""
+    out = np.empty(len(d), dtype=object)
+    for i, x in enumerate(d):
+        out[i] = collation_key(x)
+    return out
 
 
 def eval_type_of(tp: TypeCode) -> EvalType:

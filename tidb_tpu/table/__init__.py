@@ -265,10 +265,19 @@ class Table:
         return handle
 
     def _index_values(self, idx: IndexInfo, row_vals: dict[int, object]):
+        """Index-key datums for one row. _ci string columns contribute
+        their casefolded collation key, so memcomparable byte order IS
+        collation order and unique indexes reject case-duplicates (ref:
+        collation-aware index encoding; the row itself keeps the
+        original value — indexes on _ci columns are never covering)."""
         out = []
         for cname in idx.columns:
             col = self.info.col_by_name(cname)
-            out.append(row_vals.get(col.id))
+            v = row_vals.get(col.id)
+            if col.ft.is_ci and isinstance(v, str):
+                from tidb_tpu.sqltypes import collation_key
+                v = collation_key(v)
+            out.append(v)
         return out
 
     def _add_index_entry(self, txn, idx: IndexInfo,
