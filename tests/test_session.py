@@ -295,15 +295,18 @@ class TestMultiRegion:
 
 
 class TestDecimalPrecisionGuards:
-    """Decimals are scaled int64 (18-digit documented limit): wide
-    declarations fail at DDL and out-of-range values fail at write —
-    never silent truncation or wraparound."""
+    """p<=18 decimals are scaled int64 (device lane); wider columns up
+    to MySQL's 65 use the exact wide lane (tests/test_wide_decimal.py);
+    beyond 65 fails at DDL, out-of-range values fail at write — never
+    silent truncation or wraparound."""
 
-    def test_wide_precision_rejected_at_ddl(self, tk):
+    def test_precision_limits_at_ddl(self, tk):
         from tidb_tpu.session import SQLError
+        tk.execute("CREATE TABLE wd38 (id BIGINT PRIMARY KEY, "
+                   "amt DECIMAL(38, 10))")        # wide lane
         with pytest.raises(SQLError, match="exceeds the supported"):
-            tk.execute("CREATE TABLE wd (id BIGINT PRIMARY KEY, "
-                       "amt DECIMAL(38, 10))")
+            tk.execute("CREATE TABLE wd66 (id BIGINT PRIMARY KEY, "
+                       "amt DECIMAL(66, 10))")
         with pytest.raises(SQLError, match="scale"):
             tk.execute("CREATE TABLE wd (id BIGINT PRIMARY KEY, "
                        "amt DECIMAL(6, 8))")

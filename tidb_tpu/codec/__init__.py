@@ -15,6 +15,13 @@ Flags (1 byte before each datum):
     0x05 FLOAT       IEEE754 with sign-dependent bit flip
     0x06 DECIMAL     frac byte + INT encoding of scaled value (per-column
                      frac is constant, so order holds within a column)
+    0x02 WDEC_NEG    wide decimal, scaled < -2^63: frac byte + inverted
+                     length byte + complemented big-endian magnitude
+    0x07 WDEC_POS    wide decimal, scaled >= 2^63: frac byte + length
+                     byte + big-endian magnitude
+                     (0x02 < 0x06 < 0x07, so a column mixing narrow and
+                     wide scaled values still orders correctly — ref:
+                     types/mydecimal.go's sortable binary form)
     0xFF MAX         sorts after everything (range upper bounds)
 
 Descending order: `encode_desc` inverts every payload byte.
@@ -35,10 +42,12 @@ __all__ = [
 
 NIL_FLAG = 0x00
 BYTES_FLAG = 0x01
+WDEC_NEG_FLAG = 0x02
 INT_FLAG = 0x03
 UINT_FLAG = 0x04
 FLOAT_FLAG = 0x05
 DECIMAL_FLAG = 0x06
+WDEC_POS_FLAG = 0x07
 NIL_DESC_FLAG = 0xFE  # NULL under DESC order: sorts after every value
 MAX_FLAG = 0xFF
 
@@ -157,6 +166,29 @@ def decode_bytes(b: bytes, off: int = 0, desc: bool = False) -> tuple[bytes, int
 
 # -- datums ------------------------------------------------------------------
 
+_I64_LO, _I64_HI = -(1 << 63), (1 << 63) - 1
+
+
+def _encode_decimal(frac: int, scaled: int) -> bytes:
+    """(frac, scaled) -> flagged bytes. Scaled values inside int64 use
+    the fixed 8-byte DECIMAL form; wider ones use the variable-length
+    WDEC forms whose flags straddle DECIMAL so mixed-width columns stay
+    memcomparable (see the module docstring)."""
+    if _I64_LO <= scaled <= _I64_HI:
+        return bytes([DECIMAL_FLAG, frac]) + encode_int(scaled)
+    if scaled > 0:
+        mag = scaled.to_bytes((scaled.bit_length() + 7) // 8, "big")
+        if len(mag) > 255:
+            raise OverflowError("decimal magnitude too large")
+        return bytes([WDEC_POS_FLAG, frac, len(mag)]) + mag
+    m = -scaled
+    mag = m.to_bytes((m.bit_length() + 7) // 8, "big")
+    if len(mag) > 255:
+        raise OverflowError("decimal magnitude too large")
+    return bytes([WDEC_NEG_FLAG, frac, 255 - len(mag)]) + \
+        bytes(0xFF - x for x in mag)
+
+
 def encode_datum(v, desc: bool = False) -> bytes:
     """Encode one python-level value with a type flag.
 
@@ -183,13 +215,14 @@ def encode_datum(v, desc: bool = False) -> bytes:
         raw = bytes([BYTES_FLAG]) + encode_bytes(bytes(v))
     elif isinstance(v, tuple) and len(v) == 2:
         frac, scaled = v
-        raw = bytes([DECIMAL_FLAG, frac]) + encode_int(scaled)
+        raw = _encode_decimal(frac, scaled)
     else:
         import decimal as _d
         if isinstance(v, _d.Decimal):
             from tidb_tpu.sqltypes import decimal_to_scaled
             frac = max(0, -v.as_tuple().exponent)
-            raw = bytes([DECIMAL_FLAG, frac]) + encode_int(decimal_to_scaled(v, frac))
+            raw = _encode_decimal(
+                frac, decimal_to_scaled(v, frac, wide=True))
         else:
             raise TypeError(f"cannot encode datum {v!r} ({type(v)})")
     if desc:
@@ -230,6 +263,22 @@ def decode_one(b: bytes, off: int = 0, desc: bool = False):
             return (frac, decode_int(inv8(), 0)[0]), off + 8
         v, off = decode_int(b, off)
         return (frac, v), off
+    if flag in (WDEC_POS_FLAG, WDEC_NEG_FLAG):
+        def u8(x):
+            return (0xFF - x) if desc else x
+        frac = u8(b[off])
+        ln = u8(b[off + 1])
+        off += 2
+        neg = flag == WDEC_NEG_FLAG
+        if neg:
+            ln = 255 - ln
+        if off + ln > len(b):
+            raise ValueError("truncated wide decimal")
+        mag = bytes(u8(x) for x in b[off:off + ln])
+        if neg:
+            mag = bytes(0xFF - x for x in mag)
+        v = int.from_bytes(mag, "big")
+        return (frac, -v if neg else v), off + ln
     if flag == BYTES_FLAG:
         return decode_bytes(b, off, desc=desc)
     raise ValueError(f"unknown flag {flag:#x}")

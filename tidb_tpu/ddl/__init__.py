@@ -360,8 +360,10 @@ class DDL:
 DDLExecutor = DDL
 
 
-MAX_DECIMAL_DIGITS = 18   # decimals are scaled int64 (documented limit;
-                          # ref MyDecimal goes to 65 via bignum lanes)
+# MySQL's cap (ref: types/mydecimal.go, 65 digits via 9-digit words).
+# p<=18 rides the scaled-int64 device lane; wider columns use exact
+# scaled python ints on the host object lane (FieldType.is_wide_decimal)
+MAX_DECIMAL_DIGITS = 65
 
 
 def _check_column_type(cd) -> None:
@@ -371,7 +373,7 @@ def _check_column_type(cd) -> None:
             raise DDLError(
                 f"column '{cd.name}': DECIMAL({cd.ft.flen},{cd.ft.frac}) "
                 f"exceeds the supported precision "
-                f"({MAX_DECIMAL_DIGITS} digits); values are scaled int64")
+                f"({MAX_DECIMAL_DIGITS} digits)")
         if cd.ft.frac > cd.ft.flen:
             raise DDLError(
                 f"column '{cd.name}': scale {cd.ft.frac} > "

@@ -338,10 +338,12 @@ class ValuesExec(Executor):
             fts = [e.ft for e in self.plan.rows[0]]
         cols = []
         for j, ft in enumerate(fts):
-            dtype = np_dtype_for(ft.tp)
+            dtype = np_dtype_for(ft.tp, ft.flen)
             valid = np.array([r[j] is not None for r in rows], dtype=bool)
             if dtype == np.dtype(object):
-                data = np.array([r[j] if r[j] is not None else ""
+                from tidb_tpu.sqltypes import object_fill
+                _fill = object_fill(ft)
+                data = np.array([r[j] if r[j] is not None else _fill
                                  for r in rows], dtype=object)
             else:
                 data = np.array([r[j] if r[j] is not None else 0
@@ -359,7 +361,7 @@ def _agg_results_to_chunk(schema, num_group: int, aggs: list[AggDesc],
     n = len(results)
     arrays = []
     for j, ft in enumerate(fts):
-        dtype = np_dtype_for(ft.tp)
+        dtype = np_dtype_for(ft.tp, ft.flen)
         valid = np.ones(n, dtype=bool)
         data = np.empty(n, dtype=object) if dtype == np.dtype(object) \
             else np.zeros(n, dtype=dtype)
@@ -567,7 +569,7 @@ class ProjectionExec(Executor):
             for e, ft in zip(self.plan.exprs, fts):
                 d, v = e.eval(chunk)
                 if d.dtype != np.dtype(object):
-                    want = np_dtype_for(ft.tp)
+                    want = np_dtype_for(ft.tp, ft.flen)
                     if d.dtype != want:
                         d = d.astype(want)
                 cols.append(Column(ft, d, v.copy()))
@@ -596,6 +598,11 @@ class LimitExec(Executor):
             yield chunk
             if left <= 0:
                 return
+
+
+def _ofill(ft):
+    from tidb_tpu.sqltypes import object_fill
+    return object_fill(ft)
 
 
 def _sort_order(by, chunk) -> np.ndarray:
@@ -868,10 +875,10 @@ class HashJoinExec(Executor):
             ucols = [Column(c.ft, c.data[ui], c.valid[ui])
                      for c in left_chunk.columns]
             for sc in self.plan.children[1].schema.cols:
-                dtype = np_dtype_for(sc.ft.tp)
+                dtype = np_dtype_for(sc.ft.tp, sc.ft.flen)
                 data = np.zeros(len(ui), dtype=dtype) \
                     if dtype != np.dtype(object) \
-                    else np.full(len(ui), "", dtype=object)
+                    else np.full(len(ui), _ofill(sc.ft), dtype=object)
                 ucols.append(Column(sc.ft, data,
                                     np.zeros(len(ui), dtype=bool)))
             uchunk = Chunk(ucols)
@@ -881,10 +888,10 @@ class HashJoinExec(Executor):
     def _emit_right_unmatched(self, build, un):
         cols = []
         for sc in self.left.schema.cols:
-            dtype = np_dtype_for(sc.ft.tp)
+            dtype = np_dtype_for(sc.ft.tp, sc.ft.flen)
             data = np.zeros(len(un), dtype=dtype) \
                 if dtype != np.dtype(object) \
-                else np.full(len(un), "", dtype=object)
+                else np.full(len(un), _ofill(sc.ft), dtype=object)
             cols.append(Column(sc.ft, data, np.zeros(len(un), dtype=bool)))
         for c in build.columns:
             cols.append(Column(c.ft, c.data[un], c.valid[un]))
@@ -1006,7 +1013,7 @@ class MergeJoinExec(HashJoinExec):
 def _empty_like_schema(schema) -> Chunk:
     cols = []
     for sc in schema.cols:
-        dtype = np_dtype_for(sc.ft.tp)
+        dtype = np_dtype_for(sc.ft.tp, sc.ft.flen)
         data = np.empty(0, dtype=dtype if dtype != np.dtype(object)
                         else object)
         cols.append(Column(sc.ft, data, np.empty(0, dtype=bool)))
@@ -1447,7 +1454,7 @@ class ApplyExec(Executor):
         column (the planner's lifted scalar subquery)."""
         plan = self.plan
         ft = plan.schema.cols[-1].ft
-        dtype = np_dtype_for(ft.tp)
+        dtype = np_dtype_for(ft.tp, ft.flen)
         cache = None
         for chunk in self.child.chunks(ctx):
             n = chunk.num_rows
@@ -1728,7 +1735,7 @@ class UnionExec(Executor):
             elif d.dtype != np.float64 and d.dtype != np.dtype(object):
                 d = d.astype(np.float64)
         else:
-            want = np_dtype_for(ft.tp)
+            want = np_dtype_for(ft.tp, ft.flen)
             if d.dtype != want:
                 d = d.astype(want)
         return Column(ft, d, c.valid.copy())

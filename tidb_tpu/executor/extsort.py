@@ -184,7 +184,7 @@ class SpillSorter:
                     for r in self._runs]
             ncols = len(self._fts)
             from tidb_tpu.sqltypes import np_dtype_for
-            dtypes = [np_dtype_for(ft.tp) for ft in self._fts]
+            dtypes = [np_dtype_for(ft.tp, ft.flen) for ft in self._fts]
             is_obj = [dt == np.dtype(object) for dt in dtypes]
             nruns = len(self._runs)
             for s in range(0, total, self.block_rows):

@@ -64,6 +64,11 @@ class AggDesc:
         aft = self.arg.ft
         if self.fn == AggFunc.AVG:
             if aft.eval_type == EvalType.DECIMAL:
+                if aft.is_wide_decimal:
+                    # wide lane is exact python ints: MySQL's +4 digits
+                    return new_decimal_field(
+                        flen=min(aft.flen + 4, 65),
+                        frac=min(aft.frac + 4, 30))
                 # MySQL: avg adds 4 frac digits; we cap at 8 for int64 headroom
                 return new_decimal_field(frac=min(aft.frac + 4, 8))
             return new_double_field()
@@ -71,7 +76,12 @@ class AggDesc:
             if aft.eval_type == EvalType.INT:
                 return new_int_field()  # departure: MySQL promotes to decimal
             if aft.eval_type == EvalType.DECIMAL:
-                return new_decimal_field(frac=aft.frac)
+                # SUM widens precision (MySQL: DECIMAL(p+22, s)); a wide
+                # arg keeps the exact object lane
+                return new_decimal_field(
+                    flen=min(aft.flen + 22, 65) if aft.is_wide_decimal
+                    else aft.flen,
+                    frac=aft.frac)
             return new_double_field()
         return aft  # MIN/MAX/FIRST keep the arg type
 

@@ -169,7 +169,7 @@ class CorrelatedCol(Expression):
     def eval_xp(self, xp, cols, n):
         import numpy as _np
         v, valid = self.cell
-        dtype = np_dtype_for(self.ft.tp)
+        dtype = np_dtype_for(self.ft.tp, self.ft.flen)
         if not valid:
             data = _np.zeros(n, dtype=dtype) if dtype != _np.dtype(object) \
                 else _np.full(n, "", dtype=object)
@@ -205,8 +205,9 @@ class Constant(Expression):
             return xp.zeros(n, dtype=np.int64), xp.zeros(n, dtype=bool)
         v = self.value
         if self.ft.tp == TypeCode.NEWDECIMAL:
-            v = decimal_to_scaled(v, self.ft.frac)
-        dtype = np_dtype_for(self.ft.tp)
+            v = decimal_to_scaled(v, self.ft.frac,
+                                  wide=self.ft.is_wide_decimal)
+        dtype = np_dtype_for(self.ft.tp, self.ft.flen)
         if dtype == np.dtype(object):
             data = np.full(n, v, dtype=object)  # host-only
             return data, np.ones(n, dtype=bool)
@@ -239,17 +240,21 @@ def const(v, ft: FieldType | None = None) -> Constant:
             v, ft = int(v), new_int_field()
         elif isinstance(v, (int, np.integer)):
             if not (-(1 << 63) <= int(v) < (1 << 63)):
-                # beyond BIGINT: evaluate as real (MySQL promotes to
-                # DECIMAL; comparisons vs int columns fold exactly in
-                # ScalarFunc._fold_huge_int_cmp)
-                v, ft = float(v), new_double_field()
+                # beyond BIGINT: promote to wide DECIMAL like MySQL —
+                # exact against wide-decimal columns; comparisons vs
+                # int columns still fold in _fold_huge_int_cmp
+                import decimal as _d2
+                v = _d2.Decimal(int(v))
+                ft = st.new_decimal_field(
+                    flen=len(v.as_tuple().digits), frac=0)
             else:
                 ft = new_int_field()
         elif isinstance(v, (float, np.floating)):
             ft = new_double_field()
         elif isinstance(v, _d.Decimal):
             frac = max(0, -v.as_tuple().exponent)
-            ft = st.new_decimal_field(frac=frac)
+            digits = len(v.as_tuple().digits)
+            ft = st.new_decimal_field(flen=max(digits, 15), frac=frac)
         elif isinstance(v, str):
             ft = st.new_string_field()
         elif isinstance(v, _dt.datetime):
@@ -669,6 +674,23 @@ def _cmp_operands(xp, args, datas):
     a, b = args[0].ft, args[1].ft
     da, db = datas
     if da.dtype == np.dtype(object) or db.dtype == np.dtype(object):
+        ea, eb = a.eval_type, b.eval_type
+        if EvalType.DECIMAL in (ea, eb) and \
+                EvalType.STRING not in (ea, eb):
+            # wide-decimal lane: python-int math, exact at any precision
+            fa = a.frac if ea == EvalType.DECIMAL else 0
+            fb = b.frac if eb == EvalType.DECIMAL else 0
+            if EvalType.REAL in (ea, eb):
+                ca = da.astype(np.float64) / (10.0 ** fa)
+                cb = db.astype(np.float64) / (10.0 ** fb)
+                return ca, cb
+            f = max(fa, fb)
+
+            def widen(d, fr):
+                if fr == f:
+                    return d.astype(object)
+                return d.astype(object) * (10 ** (f - fr))
+            return widen(da, fa), widen(db, fb)
         if a.is_ci or b.is_ci:
             # _ci collation: compare casefolded keys (MySQL resolves a
             # ci column vs a literal to the column's collation)
@@ -755,12 +777,22 @@ def _eval_arith(xp, op, f: ScalarFunc, datas, valid):
     if ft.eval_type == EvalType.DECIMAL:
         fa = a.frac if a.eval_type == EvalType.DECIMAL else 0
         fb = b.frac if b.eval_type == EvalType.DECIMAL else 0
+
+        def lane(d):
+            # wide-decimal object lanes stay python ints (exact at any
+            # precision); fixed lanes cast to int64 for the device path
+            arr = np.asarray(d) if xp is np else d
+            if xp is np and arr.dtype == np.dtype(object):
+                return arr
+            if ft.is_wide_decimal and xp is np:
+                return arr.astype(object)   # result exceeds int64
+            return xp.asarray(d, np.int64)
         if op == Op.MUL:
-            r = xp.asarray(da, np.int64) * xp.asarray(db, np.int64)
+            r = lane(da) * lane(db)
             return _rescale(xp, r, fa + fb, ft.frac), valid
         tf = ft.frac
-        da = _rescale(xp, xp.asarray(da, np.int64), fa, tf)
-        db = _rescale(xp, xp.asarray(db, np.int64), fb, tf)
+        da = _rescale(xp, lane(da), fa, tf)
+        db = _rescale(xp, lane(db), fb, tf)
         return (da + db if op == Op.PLUS else da - db), valid
     return (da + db if op == Op.PLUS else da - db if op == Op.MINUS else da * db), valid
 

@@ -562,10 +562,16 @@ class HashAggregator:
                     if cur[1] == 0:
                         vals.append(None)
                     elif agg.result_ft.eval_type == EvalType.DECIMAL:
-                        # scaled-int avg: rescale sum by extra frac then div
+                        # scaled-int avg: rescale sum by extra frac then
+                        # divide in EXACT integer arithmetic (half-up;
+                        # float division corrupts wide decimals)
                         extra = agg.result_ft.frac - agg.arg.ft.frac
-                        vals.append(int(round(
-                            int(cur[0]) * (10 ** extra) / int(cur[1]))))
+                        num = int(cur[0]) * (10 ** extra)
+                        den = int(cur[1])
+                        q, r = divmod(abs(num), den)
+                        if 2 * r >= den:
+                            q += 1
+                        vals.append(q if num >= 0 else -q)
                     else:
                         vals.append(float(cur[0]) / float(cur[1]))
                 elif fn in (AggFunc.MIN, AggFunc.MAX, AggFunc.FIRST_ROW,

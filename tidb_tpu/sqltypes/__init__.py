@@ -128,6 +128,14 @@ class FieldType:
             self.eval_type == EvalType.STRING
 
     @property
+    def is_wide_decimal(self) -> bool:
+        """DECIMAL(p>18): scaled PYTHON ints in an object column — the
+        exact host lane (arbitrary precision, like mydecimal.go's
+        9-digit words but with bignum arithmetic); p<=18 stays the
+        int64 device fast path."""
+        return self.tp == TypeCode.NEWDECIMAL and self.flen > 18
+
+    @property
     def not_null(self) -> bool:
         return bool(self.flags & Flag.NOT_NULL)
 
@@ -139,13 +147,20 @@ class FieldType:
         return replace(self, flags=self.flags | extra)
 
     def np_dtype(self):
-        return np_dtype_for(self.tp)
+        return np_dtype_for(self.tp, self.flen)
 
     @property
     def fixed_width(self) -> bool:
         """True if values are a fixed-width numeric representation
         (device-transferable without dictionary encoding)."""
-        return self.eval_type != EvalType.STRING and self.tp != TypeCode.JSON
+        return self.eval_type != EvalType.STRING and \
+            self.tp != TypeCode.JSON and not self.is_wide_decimal
+
+
+def object_fill(ft) -> object:
+    """Dead-slot filler for object-dtype columns: wide decimals hold
+    scaled python ints (0), varlen strings hold ''."""
+    return 0 if ft.tp == TypeCode.NEWDECIMAL else ""
 
 
 def collation_key(x):
@@ -186,10 +201,13 @@ def eval_type_of(tp: TypeCode) -> EvalType:
     return EvalType.STRING
 
 
-def np_dtype_for(tp: TypeCode):
+def np_dtype_for(tp: TypeCode, flen: int = -1):
     """Fixed storage dtype per type (ref: util/chunk/chunk.go:81-97 chooses
     fixed widths per MySQL type; we use 8-byte lanes uniformly so columns map
-    directly onto TPU-friendly int64/float64/float32 arrays)."""
+    directly onto TPU-friendly int64/float64/float32 arrays). DECIMAL with
+    p>18 (pass `flen`) overflows int64: object lane of scaled python ints."""
+    if tp == TypeCode.NEWDECIMAL and flen > 18:
+        return np.dtype(object)
     et = eval_type_of(tp)
     if et in (EvalType.INT, EvalType.DECIMAL, EvalType.DATETIME, EvalType.DURATION):
         return np.dtype(np.int64)
@@ -236,12 +254,15 @@ def new_duration_field(flags: int = 0, frac: int = 0) -> FieldType:
 # ---------------------------------------------------------------------------
 # Decimal <-> scaled int64
 
-def decimal_to_scaled(v, frac: int) -> int:
-    """Encode a decimal value as unscaled int64 with `frac` fractional digits.
+def decimal_to_scaled(v, frac: int, wide: bool = False) -> int:
+    """Encode a decimal value as an unscaled int with `frac` fractional
+    digits.
 
     Replaces the reference's MyDecimal 9-digit-word representation
     (types/mydecimal.go) with a single int64 lane for the device path.
-    Raises OverflowError outside int64 — callers fall back to host decimal.
+    Raises OverflowError outside int64 unless `wide` (DECIMAL(p>18)
+    columns keep exact scaled PYTHON ints on the host object lane) —
+    narrow callers fall back to host decimal on overflow.
     """
     if isinstance(v, float):
         d = _pydec.Decimal(repr(v))
@@ -250,17 +271,23 @@ def decimal_to_scaled(v, frac: int) -> int:
     else:
         d = _pydec.Decimal(str(v))
     try:
-        q = d.scaleb(frac).quantize(_pydec.Decimal(1), rounding=_pydec.ROUND_HALF_UP)
+        with _pydec.localcontext() as ctx:
+            ctx.prec = 70        # MySQL max precision is 65 digits
+            q = d.scaleb(frac).quantize(_pydec.Decimal(1),
+                                        rounding=_pydec.ROUND_HALF_UP)
     except _pydec.InvalidOperation as e:
-        raise OverflowError(f"decimal {v} does not fit scaled int64 frac={frac}") from e
+        raise OverflowError(
+            f"decimal {v} does not fit frac={frac}") from e
     i = int(q)
-    if not (-(1 << 63) <= i < (1 << 63)):
+    if not wide and not (-(1 << 63) <= i < (1 << 63)):
         raise OverflowError(f"decimal {v} does not fit scaled int64 frac={frac}")
     return i
 
 
 def scaled_to_decimal(i: int, frac: int) -> _pydec.Decimal:
-    return _pydec.Decimal(int(i)).scaleb(-frac)
+    with _pydec.localcontext() as ctx:
+        ctx.prec = 70            # wide lane: don't round at 28 digits
+        return _pydec.Decimal(int(i)).scaleb(-frac)
 
 
 # ---------------------------------------------------------------------------

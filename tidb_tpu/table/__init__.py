@@ -78,12 +78,14 @@ def encode_datum_for_col(v, ft: FieldType):
         # normalize to the column's scale: the memcomparable decimal
         # encoding orders by (frac, scaled), so every stored datum of a
         # column MUST share the column frac or index ranges break
+        wide = ft.is_wide_decimal
         if isinstance(v, tuple):
             frac, scaled = v
             out = (ft.frac, _rescale_decimal(scaled, frac, ft.frac))
         else:
-            out = (ft.frac, decimal_to_scaled(v, ft.frac))
-        if ft.flen > 0 and abs(out[1]) >= 10 ** min(ft.flen, 18):
+            out = (ft.frac, decimal_to_scaled(v, ft.frac, wide=wide))
+        if ft.flen > 0 and abs(out[1]) >= 10 ** (
+                ft.flen if wide else min(ft.flen, 18)):
             # MySQL strict mode: out-of-range decimal is an error, never
             # a silently stored wider value
             raise kv.KVError(
@@ -395,12 +397,14 @@ def rows_to_chunk(fts: list[FieldType], rows: list[list]) -> Chunk:
     cols = []
     for j, ft in enumerate(fts):
         vals = [decode_datum_for_col(r[j], ft) for r in rows]
-        dtype = np_dtype_for(ft.tp)
+        dtype = np_dtype_for(ft.tp, ft.flen)
         valid = np.array([v is not None for v in vals], dtype=bool)
         if dtype == np.dtype(object):
+            from tidb_tpu.sqltypes import object_fill
+            fill = object_fill(ft)
             data = np.empty(len(vals), dtype=object)
             for i, v in enumerate(vals):
-                data[i] = v if v is not None else ""
+                data[i] = v if v is not None else fill
         else:
             data = np.zeros(len(vals), dtype=dtype)
             for i, v in enumerate(vals):
@@ -463,7 +467,12 @@ def kvrows_to_chunk(info: TableInfo, col_infos, kvrows,
     Fast path: the C++ batch decoder (ref: util/codec DecodeOneToChunk,
     codec.go:387 — and the Rust TiKV decode the reference leans on)."""
     from tidb_tpu.sqltypes import new_int_field
-    ch = _kvrows_to_chunk_native(col_infos, kvrows, with_handle_col)
+    # wide-decimal datums use variable-length encodings the C++ walker
+    # doesn't know; any such column in the ROW (even unrequested) gates
+    # the whole table to the python decode path
+    ch = None
+    if not any(c.ft.is_wide_decimal for c in info.columns):
+        ch = _kvrows_to_chunk_native(col_infos, kvrows, with_handle_col)
     if ch is not None:
         return ch
     ncols = len(col_infos) + (1 if with_handle_col is not None else 0)
