@@ -132,6 +132,27 @@ class TestJoinsAndMeta:
         assert sess.query("SELECT COUNT(*) FROM d WHERE s = 'q'"
                           ).rows == [(1,)]
 
+    def test_explicit_bin_beats_table_default(self, sess):
+        sess.execute("CREATE TABLE eb (id BIGINT PRIMARY KEY, "
+                     "s VARCHAR(10) COLLATE utf8mb4_bin) "
+                     "COLLATE=utf8mb4_general_ci")
+        sess.execute("INSERT INTO eb VALUES (1, 'Q')")
+        assert sess.query("SELECT COUNT(*) FROM eb WHERE s = 'q'"
+                          ).rows == [(0,)]
+
+    def test_group_by_merges_across_regions(self, sess):
+        """Cross-chunk/region partial merge must fold ci keys too
+        (HashAggregator final merge, not just per-chunk grouping)."""
+        sess.execute("CREATE TABLE mr (id BIGINT PRIMARY KEY, "
+                     "s VARCHAR(20) COLLATE utf8mb4_general_ci)")
+        sess.execute("INSERT INTO mr VALUES (1, 'Alpha'), (15, 'ALPHA'),"
+                     " (2, 'beta'), (16, 'Beta')")
+        sess.execute("SPLIT TABLE mr AT (10)")
+        rows = sess.query("SELECT s, COUNT(*) FROM mr GROUP BY s").rows
+        assert sorted(c for _s, c in rows) == [2, 2]
+        rows = sess.query("SELECT DISTINCT s FROM mr").rows
+        assert len(rows) == 2
+
     def test_show_collation(self, sess):
         rows = sess.query("SHOW COLLATION").rows
         colls = {r[0] for r in rows}

@@ -191,16 +191,18 @@ class TestReplication:
 
             backup = _spawn(b_port, ["--role", "backup",
                                      "--primary", f"127.0.0.1:{p_port}"])
-            # primary degraded to solo when backup was absent; it marks
-            # the backup dead on first failed ship — reconnection needs a
-            # fresh ship target, so write once to trigger, then verify
-            # the snapshot covers everything
+            # primary degraded to solo while the backup was absent; the
+            # next mutations trigger the automatic full-state re-sync
+            # (repl_install), after which shipping resumes — so EVERY
+            # acked row must survive the failover
             s.execute("INSERT INTO t VALUES (3, 30)")
+            time.sleep(1.2)                  # resync retry interval
+            s.execute("INSERT INTO t VALUES (4, 40)")
 
             primary.send_signal(signal.SIGKILL)
             primary.wait(timeout=20)
             r = s.query("SELECT COUNT(*), SUM(v) FROM t")
-            assert r.rows[0][0] >= 2          # snapshot rows survived
+            assert r.rows == [(4, 100)]      # zero lost acked writes
             s.close(); st.close()
         finally:
             for proc in (primary, backup):

@@ -394,7 +394,7 @@ def build_table_info(meta: Meta, stmt: ast.CreateTableStmt) -> TableInfo:
         _check_column_type(cd)
         ft = cd.ft
         if table_coll and ft.eval_type == EvalType.STRING and \
-                ft.collation == "utf8mb4_bin":
+                not getattr(cd, "explicit_collation", False):
             import dataclasses
             ft = dataclasses.replace(ft, collation=table_coll)
         default = _const_default(cd) if cd.has_default else None

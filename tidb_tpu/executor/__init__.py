@@ -397,7 +397,12 @@ class FinalAggExec(Executor):
         self.reader = build_executor(plan.children[0])
 
     def chunks(self, ctx):
-        agg = HashAggregator(self.plan.aggs)
+        # partials arrive pre-grouped: key fts are the schema's leading
+        # num_group_cols columns
+        agg = HashAggregator(
+            self.plan.aggs,
+            [c.ft for c in
+             self.plan.schema.cols[:self.plan.num_group_cols]])
         for gr in self.reader.partials(ctx):
             agg.update(gr)
         results = agg.results()
@@ -424,7 +429,7 @@ class HashAggExec(Executor):
         self._kernel = getattr(plan, "_root_kernel", None)
 
     def chunks(self, ctx):
-        agg = HashAggregator(self.plan.aggs)
+        agg = HashAggregator(self.plan.aggs, self.plan.group_exprs)
         distinct_ok = all(not a.distinct for a in self.plan.aggs)
         seen_any = False
         for chunk in self.child.chunks(ctx):
@@ -485,7 +490,7 @@ class StreamAggExec(Executor):
         self._kernel = getattr(plan, "_root_kernel", None)
 
     def chunks(self, ctx):
-        agg = HashAggregator(self.plan.aggs)
+        agg = HashAggregator(self.plan.aggs, self.plan.group_exprs)
         use_device = (config.device_enabled() and
                       all(not a.distinct for a in self.plan.aggs))
 

@@ -133,7 +133,7 @@ def _emit_agg(plan, agg, executor_mod):
 
 
 def _emit_results(plan, gr_or_none, executor_mod):
-    agg = HashAggregator(plan.aggs)
+    agg = HashAggregator(plan.aggs, plan.group_exprs)
     if gr_or_none is not None:
         agg.update(gr_or_none)
     return _emit_agg(plan, agg, executor_mod)
@@ -287,7 +287,7 @@ class MeshAggExec(_MeshExecBase):
                     _kernel_cache_put(plan, capacity, k)
                 return k
 
-            agg = HashAggregator(plan.aggs)
+            agg = HashAggregator(plan.aggs, plan.group_exprs)
             self._stream_groups(
                 super_batches(parts, it, limit), get_kernel,
                 lambda b: host_hash_agg(b, plan.filter_expr,
@@ -368,7 +368,7 @@ class MeshLookupAggExec(_MeshExecBase):
                     _kernel_cache_put(plan, capacity, k)
                 return refresh(k)
 
-            agg = HashAggregator(plan.aggs)
+            agg = HashAggregator(plan.aggs, plan.group_exprs)
             self._stream_groups(
                 super_batches(parts, it, limit), get_kernel,
                 lambda b: host_lookup_agg(b, plan.filter_expr, specs,

@@ -252,8 +252,10 @@ def const(v, ft: FieldType | None = None) -> Constant:
         elif isinstance(v, (float, np.floating)):
             ft = new_double_field()
         elif isinstance(v, _d.Decimal):
-            frac = max(0, -v.as_tuple().exponent)
-            digits = len(v.as_tuple().digits)
+            t = v.as_tuple()
+            frac = max(0, -t.exponent)
+            # magnitude digits: positive exponents (1E+30) add width
+            digits = len(t.digits) + max(t.exponent, 0)
             ft = st.new_decimal_field(flen=max(digits, 15), frac=frac)
         elif isinstance(v, str):
             ft = st.new_string_field()

@@ -502,17 +502,34 @@ class HashAggregator:
     finalizes per-group values. Mirrors Aggregation.GetPartialResult
     merging (expression/aggregation/aggregation.go:32-47)."""
 
-    def __init__(self, aggs: Sequence[AggDesc]):
+    def __init__(self, aggs: Sequence[AggDesc], group_meta=None):
+        """group_meta: the group-key expressions OR FieldTypes, in key
+        order (anything with an .ft, or an ft itself)."""
         self.aggs = list(aggs)
         self._state: dict[tuple, list] = {}
+        self._orig: dict[tuple, tuple] = {}
+        # _ci group keys must merge across CHUNK partials too (per-chunk
+        # grouping already folds): fold the dict identity, surface the
+        # first-seen variant
+        self._ci = [getattr(g, "ft", g).is_ci for g in group_meta] \
+            if group_meta else None
+
+    def _group_key(self, key: tuple) -> tuple:
+        if not self._ci or not any(self._ci):
+            return key
+        from tidb_tpu.sqltypes import collation_key
+        return tuple(collation_key(x) if c and x is not None else x
+                     for x, c in zip(key, self._ci))
 
     def update(self, res: GroupResult) -> None:
         for gi, key in enumerate(res.keys):
-            st = self._state.get(key)
+            gkey = self._group_key(key)
+            st = self._state.get(gkey)
             if st is None:
-                self._state[key] = [
+                self._state[gkey] = [
                     [lane[gi] for lane in res.partials[ai]]
                     for ai in range(len(self.aggs))]
+                self._orig[gkey] = key
                 continue
             for ai, agg in enumerate(self.aggs):
                 lanes = res.partials[ai]
@@ -551,6 +568,7 @@ class HashAggregator:
         for key, st in sorted(self._state.items(),
                               key=lambda kv: tuple(
                                   (x is None, x) for x in kv[0])):
+            key = self._orig.get(key, key)
             vals = []
             for agg, cur in zip(self.aggs, st):
                 fn = agg.fn

@@ -314,6 +314,9 @@ class ColumnDef(Node):
     is_primary: bool = False          # inline PRIMARY KEY
     is_unique: bool = False           # inline UNIQUE
     auto_increment: bool = False
+    # an explicit column COLLATE wins over the table default, even when
+    # it names the default collation (utf8mb4_bin)
+    explicit_collation: bool = False
 
 
 @dataclass

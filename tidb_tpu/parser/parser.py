@@ -782,6 +782,7 @@ class Parser:
                     import dataclasses
                     ft = dataclasses.replace(ft, collation=coll)
                     d.ft = ft
+                    d.explicit_collation = True
             elif self.try_kw("CHARSET"):
                 self.next()
             elif self.try_kw("REFERENCES"):

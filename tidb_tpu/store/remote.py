@@ -139,6 +139,14 @@ class StorageServer:
             conn.close()
 
     def _export_state(self):
+        # _ship_mu orders the export against the apply+ship critical
+        # section: a mutation is either fully (applied AND shipped)
+        # before the snapshot, or entirely after it — never replayed on
+        # top of a snapshot that already contains it
+        with self._ship_mu:
+            return self._export_state_locked()
+
+    def _export_state_locked(self):
         cl, en = self.storage.cluster, self.storage.engine
         with cl._mu, en._mu:
             return {
@@ -165,12 +173,18 @@ class StorageServer:
             en._locked_keys = {k for k, e in st["entries"]
                                if e.lock is not None}
 
+    _RESYNC_INTERVAL = 1.0   # seconds between re-attach attempts
+
     def _ship(self, method: str, args: tuple, kwargs: dict) -> None:
         """Synchronously replicate one applied mutation. Called under
         _ship_mu, so the backup applies in exactly primary order. If the
-        backup is unreachable the primary degrades to solo (logged once,
-        surfaced in repl_hello); a re-attached backup re-syncs via
-        repl_snapshot."""
+        backup is unreachable (or rejects a replay) the primary degrades
+        to solo and RE-SYNCS it with a full state push as soon as it
+        answers again (_maybe_resync_backup) — the unreplicated window
+        is bounded by the outage plus one resync. Writes acked during
+        that window are lost only if the primary ALSO dies before the
+        resync lands (the inherent 2-node degraded-mode caveat; a quorum
+        design needs 3 nodes)."""
         if self._backup_dead or self._backup_addr is None:
             return
         cl = self.storage.cluster
@@ -180,13 +194,39 @@ class StorageServer:
                 self._backup = _Conn(self._backup_addr)
             self._backup.call("repl_apply",
                               (method, args, kwargs, watermark), {})
-        except (ConnectionError, OSError, wire.WireError) as e:
+        except (ConnectionError, OSError, wire.WireError,
+                kv.KVError) as e:
+            # incl. KVError: a backup that rejects a replay has diverged
+            # and needs the full-state resync, and the client's write —
+            # already applied locally — must NOT fail because of it
             if self._backup is not None:
                 self._backup.close()
                 self._backup = None
             self._backup_dead = True
-            print(f"storage: backup unreachable, degrading to solo: {e}",
+            self._next_resync = time.monotonic() + self._RESYNC_INTERVAL
+            print(f"storage: backup unreachable, degrading to solo "
+                  f"(will re-sync): {e}", flush=True)
+
+    def _maybe_resync_backup(self) -> None:
+        """Called under _ship_mu before a mutation: if the backup is
+        marked dead and the retry timer elapsed, push a full state
+        snapshot (repl_install) and resume shipping."""
+        if not self._backup_dead or self._backup_addr is None:
+            return
+        if time.monotonic() < getattr(self, "_next_resync", 0.0):
+            return
+        try:
+            conn = _Conn(self._backup_addr, timeout=5)
+            try:
+                conn.call("repl_install",
+                          (self._export_state_locked(),), {})
+            finally:
+                conn.close()
+            self._backup_dead = False
+            print("storage: backup re-synced, resuming replication",
                   flush=True)
+        except (ConnectionError, OSError, wire.WireError, kv.KVError):
+            self._next_resync = time.monotonic() + self._RESYNC_INTERVAL
 
     def _repl_apply(self, method: str, args: tuple, kwargs: dict,
                     watermark: int) -> None:
@@ -261,6 +301,11 @@ class StorageServer:
             return self._repl_apply(*args)
         if method == "repl_snapshot":
             return self._export_state()
+        if method == "repl_install":
+            if self.role != "backup":
+                raise kv.KVError("repl_install on a non-backup node")
+            self._install_state(args[0])
+            return "installed"
         if method == "repl_promote":
             return self._repl_promote()
         if self.role == "backup":
@@ -268,8 +313,11 @@ class StorageServer:
             # the "this is a replication backup" sentinel the client's
             # failover logic keys on (ref: NotLeader region errors)
             raise kv.NotLeaderError(0, -1)
-        if method in _MUTATING:
+        if method in _MUTATING and self._backup_addr is not None:
+            # the ship lock serializes apply+ship so the backup applies
+            # in primary order; standalone servers skip it entirely
             with self._ship_mu:
+                self._maybe_resync_backup()
                 result = self._dispatch(method, args, kwargs)
                 self._ship(method, args, kwargs)
                 return result

@@ -85,6 +85,7 @@ class Cmd(IntEnum):
     REPL_APPLY = 71
     REPL_SNAPSHOT = 72
     REPL_PROMOTE = 73
+    REPL_INSTALL = 74
 
 
 # method-name <-> Cmd mapping used by the RPC layer (the shim's python
@@ -113,6 +114,7 @@ CMD_BY_METHOD = {
     "repl_hello": Cmd.REPL_HELLO, "repl_apply": Cmd.REPL_APPLY,
     "repl_snapshot": Cmd.REPL_SNAPSHOT,
     "repl_promote": Cmd.REPL_PROMOTE,
+    "repl_install": Cmd.REPL_INSTALL,
 }
 METHOD_BY_CMD = {v: k for k, v in CMD_BY_METHOD.items()}
 
