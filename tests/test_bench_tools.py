@@ -1,0 +1,32 @@
+"""Bench tool parity (ref: cmd/benchdb, cmd/benchraw, cmd/benchfilesort)
+— smoke runs at tiny sizes proving each harness executes end-to-end."""
+
+from tidb_tpu.benchmarks import benchdb, benchfilesort, benchraw
+from tidb_tpu.session import Session
+from tidb_tpu.store.storage import new_mock_storage
+
+
+def test_benchdb_jobs():
+    s = Session(new_mock_storage())
+    s.execute("CREATE DATABASE bench; USE bench")
+    results = benchdb.run_jobs(
+        s, "create|insert:0_300|update-random:0_300:100|"
+           "select:0_300:3|update-range:50_60:20|gc|truncate",
+        batch=50, blob=32)
+    assert len(results) == 7
+    assert all(dt >= 0 for _j, dt in results)
+    assert s.query("SELECT COUNT(*) FROM benchdb").rows == [(0,)]
+    s.close()
+
+
+def test_benchraw():
+    out = benchraw.run(new_mock_storage(), num=500, batch=64,
+                       value_size=16, workers=2)
+    assert out["num"] == 500
+    assert all(v > 0 for k, v in out.items() if k.endswith("secs"))
+
+
+def test_benchfilesort_spills_and_sorts():
+    out = benchfilesort.run(rows=30_000, run_rows=8_000, chunk_rows=4096)
+    assert out["rows"] == 30_000
+    assert out["rows_per_sec"] > 0
