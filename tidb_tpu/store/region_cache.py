@@ -26,17 +26,58 @@ class KeyLocation:
 
 
 class RegionCache:
-    """Caches Region objects; the Cluster plays PD for cache misses."""
+    """Caches Region objects; the Cluster plays PD for cache misses.
+
+    Insertion evicts STALE OVERLAPS (after a split, the old wide region
+    overlaps both halves; ref: region_cache.go:326 insertRegionToCache
+    dropping intersecting items) and is epoch-aware: an older
+    (version, conf_ver) never replaces a newer cached epoch. An
+    id -> start index keeps invalidation O(log n) under churn with
+    thousands of regions."""
 
     def __init__(self, pd: Cluster):
         self.pd = pd
         self._mu = threading.RLock()
         self._by_start: SortedDict[bytes, Region] = SortedDict()
+        self._start_by_id: dict[int, bytes] = {}
         self._leaders: dict[int, int] = {}  # region_id -> learned leader store
 
     def _ctx(self, r: Region) -> RegionCtx:
         leader = self._leaders.get(r.id, r.leader_store)
         return RegionCtx(r.id, r.version, r.conf_ver, leader)
+
+    def _insert(self, r: Region) -> None:
+        """Called under _mu. Evict every cached region intersecting
+        [r.start, r.end) unless it carries a NEWER epoch (in which case
+        the incoming region is the stale one and is dropped)."""
+        # walk left to the first region that could overlap, then right
+        idx = max(self._by_start.bisect_right(r.start) - 1, 0)
+        keys = self._by_start.keys()
+        stale = []
+        i = idx
+        while i < len(keys):
+            cur = self._by_start[keys[i]]
+            if r.end and cur.start >= r.end:
+                break
+            overlaps = (not cur.end or cur.end > r.start) and \
+                (not r.end or cur.start < r.end)
+            if overlaps:
+                if (cur.version, cur.conf_ver) > (r.version, r.conf_ver):
+                    return          # incoming region is older news
+                if cur.id != r.id or cur.start != r.start:
+                    stale.append(cur)
+            i += 1
+        for cur in stale:
+            del self._by_start[cur.start]
+            self._start_by_id.pop(cur.id, None)
+            self._leaders.pop(cur.id, None)
+        old_start = self._start_by_id.get(r.id)
+        if old_start is not None and old_start != r.start and \
+                old_start in self._by_start and \
+                self._by_start[old_start].id == r.id:
+            del self._by_start[old_start]
+        self._by_start[r.start] = r
+        self._start_by_id[r.id] = r.start
 
     def locate(self, key: bytes) -> KeyLocation:
         with self._mu:
@@ -46,14 +87,15 @@ class RegionCache:
                 if r.contains(key):
                     return KeyLocation(r, self._ctx(r))
             r = self.pd.region_by_key(key)  # "PD RPC"
-            self._by_start[r.start] = r
+            self._insert(r)
             return KeyLocation(r, self._ctx(r))
 
     def invalidate(self, region_id: int) -> None:
         with self._mu:
-            for start, r in list(self._by_start.items()):
-                if r.id == region_id:
-                    del self._by_start[start]
+            start = self._start_by_id.pop(region_id, None)
+            if start is not None and start in self._by_start and \
+                    self._by_start[start].id == region_id:
+                del self._by_start[start]
             self._leaders.pop(region_id, None)
 
     def on_not_leader(self, err: NotLeaderError) -> None:
