@@ -1,0 +1,74 @@
+"""Cross-process trace propagation (trace.py + store/remote.py; ref: the
+reference's OpenTracing spans riding gRPC — session.go:692): storage-side
+span trees come back over the RPC and graft into the statement trace."""
+
+import pytest
+
+from tidb_tpu import trace
+from tidb_tpu.session import Session
+from tidb_tpu.store.remote import StorageServer, connect
+
+
+@pytest.fixture
+def server():
+    srv = StorageServer()
+    srv.start()
+    yield srv
+    srv.close()
+
+
+def _span_names(root):
+    out = []
+
+    def walk(s):
+        out.append(s.name)
+        for c in s.children:
+            walk(c)
+    walk(root)
+    return out
+
+
+def test_remote_spans_graft_into_statement_trace(server):
+    st = connect("127.0.0.1", server.port)
+    s = Session(st)
+    s.execute("CREATE DATABASE tr; USE tr")
+    s.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, v BIGINT)")
+    s.execute("INSERT INTO t VALUES (1, 10), (2, 20)")
+
+    # capture the session's own statement root as it finishes
+    roots = []
+    orig_end = trace.end
+
+    def capture(root):
+        roots.append(root)
+        return orig_end(root)
+
+    trace.end = capture
+    try:
+        assert s.query("SELECT SUM(v) FROM t").rows == [(30,)]
+    finally:
+        trace.end = orig_end
+
+    assert roots
+    names = [n for r in roots for n in _span_names(r)]
+    remote = [n for n in names if n.startswith("storage:")]
+    assert remote, f"no storage-side spans grafted: {names}"
+    # the storage process's own phases ride inside the grafted subtree
+    assert any(n.startswith("storage:") and ("tso" in n or "kv_" in n
+               or "coprocessor" in n or "region" in n)
+               for n in remote), remote
+    s.close()
+    st.close()
+
+
+def test_untraced_calls_skip_propagation(server):
+    st = connect("127.0.0.1", server.port)
+    s = Session(st)
+    s.execute("CREATE DATABASE tr2; USE tr2")
+    s.execute("CREATE TABLE t (id BIGINT PRIMARY KEY)")
+    # no active trace: calls must not error and nothing leaks
+    s.execute("INSERT INTO t VALUES (1)")
+    assert s.query("SELECT COUNT(*) FROM t").rows == [(1,)]
+    assert trace.current_root() is None
+    s.close()
+    st.close()

@@ -45,6 +45,7 @@ __all__ = ["StorageServer", "RemoteStorage", "connect", "serve_main"]
 
 _STATUS_OK = 0
 _STATUS_ERR = 1
+_STATUS_OK_TRACED = 2   # payload = (result, span-tree dict)
 
 # commands safe to re-send after an indeterminate failure
 _IDEMPOTENT = {"kv_get", "kv_batch_get", "kv_scan", "kv_scan_lock",
@@ -274,21 +275,26 @@ class StorageServer:
 
     @staticmethod
     def _validate_request(req):
-        """Typed request envelope: (cmd:int, args:tuple, kwargs:dict)."""
-        if not (isinstance(req, tuple) and len(req) == 3):
-            raise wire.WireError("request must be (cmd, args, kwargs)")
-        cmd, args, kwargs = req
+        """Typed request envelope: (cmd:int, args:tuple, kwargs:dict
+        [, flags:dict]) — flags carry cross-process metadata like the
+        trace-propagation bit."""
+        if not (isinstance(req, tuple) and len(req) in (3, 4)):
+            raise wire.WireError("request must be (cmd, args, kwargs"
+                                 "[, flags])")
+        cmd, args, kwargs = req[:3]
+        flags = req[3] if len(req) == 4 else {}
         try:
             cmd = wire.Cmd(cmd)
         except ValueError:
             raise wire.WireError(f"unknown command {cmd!r}") from None
         if cmd not in wire.METHOD_BY_CMD:
             raise wire.WireError(f"unroutable command {cmd!r}")
-        if not isinstance(args, tuple) or not isinstance(kwargs, dict):
-            raise wire.WireError("bad args/kwargs")
+        if not isinstance(args, tuple) or not isinstance(kwargs, dict) \
+                or not isinstance(flags, dict):
+            raise wire.WireError("bad args/kwargs/flags")
         if any(not isinstance(k, str) for k in kwargs):
             raise wire.WireError("kwargs keys must be strings")
-        return cmd, args, kwargs
+        return cmd, args, kwargs, flags
 
     def _serve_call(self, method: str, args: tuple, kwargs: dict):
         """Top-level command entry: role gate + replication shipping."""
@@ -358,10 +364,24 @@ class StorageServer:
                     return
                 try:
                     req = wire.decode_frame_payload(payload)
-                    cmd, args, kwargs = self._validate_request(req)
+                    cmd, args, kwargs, flags = self._validate_request(req)
                     method = wire.METHOD_BY_CMD[cmd]
-                    result = self._serve_call(method, args, kwargs)
-                    out, status = wire.encode(result), _STATUS_OK
+                    if flags.get("trace"):
+                        # cross-process span propagation: run under a
+                        # local root and ship the finished tree back for
+                        # the client to graft into its statement trace
+                        from tidb_tpu import trace
+                        root = trace.begin(f"storage:{method}")
+                        try:
+                            result = self._serve_call(method, args,
+                                                      kwargs)
+                        finally:
+                            trace.end(root)
+                        out = wire.encode((result, root.to_dict()))
+                        status = _STATUS_OK_TRACED
+                    else:
+                        result = self._serve_call(method, args, kwargs)
+                        out, status = wire.encode(result), _STATUS_OK
                 except wire.WireError as e:
                     # malformed frame: reject loudly, keep serving
                     out = wire.encode(kv.KVError(f"bad request: {e}"))
@@ -411,10 +431,14 @@ class _Conn:
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 
     def call(self, method: str, args: tuple, kwargs: dict):
+        from tidb_tpu import trace
         cmd = wire.CMD_BY_METHOD.get(method)
         if cmd is None:
             raise kv.KVError(f"method {method!r} has no wire command")
-        payload = wire.encode((int(cmd), tuple(args), dict(kwargs)))
+        req = (int(cmd), tuple(args), dict(kwargs))
+        if trace.active():
+            req = req + ({"trace": True},)
+        payload = wire.encode(req)
         _send_frame(self.sock, _STATUS_OK, payload)
         status, body = _recv_frame(self.sock)
         result = wire.decode_frame_payload(body)
@@ -422,6 +446,9 @@ class _Conn:
             if isinstance(result, BaseException):
                 raise result
             raise kv.KVError(f"storage error: {result!r}")
+        if status == _STATUS_OK_TRACED:
+            result, remote_span = result
+            trace.attach_remote(remote_span)
         return result
 
     def close(self) -> None:

@@ -99,6 +99,35 @@ def span(name: str, **tags):
             _tl.cur = parent
 
 
+def active() -> bool:
+    """True when the calling thread is inside a traced statement."""
+    return getattr(_tl, "cur", None) is not None
+
+
+def attach_remote(d: dict) -> None:
+    """Graft a span tree returned by another PROCESS (the storage node's
+    side of an RPC — store/remote.py) under the current span. Remote
+    clocks don't align, so only names/tags/durations carry over; the
+    child is pinned at the current moment with its reported duration.
+    Ref: the reference's cross-process span propagation
+    (session.go:692 opentracing context over gRPC)."""
+    parent = getattr(_tl, "cur", None)
+    if parent is None:
+        return
+
+    def build(node: dict) -> Span:
+        s = Span(node.get("name", "remote"), node.get("tags"))
+        dur = int(node.get("duration_ns", 0))
+        s.end_ns = s.start_ns + dur
+        s.start_ns -= dur          # end at "now", duration preserved
+        s.end_ns = s.start_ns + dur
+        for c in node.get("children", ()):
+            s.children.append(build(c))
+        return s
+
+    parent.children.append(build(d))
+
+
 def phase_ns(root: Span | None, name: str) -> int:
     """Sum of top-level child spans with `name` (a statement's parse /
     plan / execute / commit phase totals)."""
