@@ -1,0 +1,205 @@
+"""Grammar long tail mined from the reference's parser corpus
+(ref: parser/parser_test.go, 2.1k LoC of table cases; VERDICT r4 #6).
+Each case here parses AND the statement classes carry the right data."""
+
+import pytest
+
+from tidb_tpu.parser import ast
+from tidb_tpu.parser.parser import ParseError, parse
+
+
+def one(sql):
+    stmts = parse(sql)
+    assert len(stmts) == 1
+    return stmts[0]
+
+
+PARSES = [
+    # column/type long tail
+    "CREATE TABLE foo (name CHAR(50) BINARY)",
+    "CREATE TABLE foo (name CHAR(50) CHARACTER SET utf8)",
+    "CREATE TABLE foo (name CHAR(50) BINARY CHARACTER SET utf8 "
+    "COLLATE utf8_bin)",
+    "CREATE TABLE t (c TEXT) default CHARACTER SET utf8, "
+    "default COLLATE utf8_general_ci",
+    "CREATE TABLE t (a int1, b int2, c int3, d int4, e int8)",
+    "CREATE TABLE t (c1 national char(2), c2 national varchar(2))",
+    "CREATE TABLE t (ts timestamp NOT NULL DEFAULT CURRENT_TIMESTAMP "
+    "ON UPDATE CURRENT_TIMESTAMP)",
+    "CREATE TABLE c (sd integer CHECK (sd > 0), nm varchar(30))",
+    "CREATE TABLE t (c1 bool, check (c1 in (0, 1)))",
+    "CREATE TABLE t (id int, PRIMARY KEY pk_id (id))",
+    "CREATE TABLE t (v varbinary(16), m mediumtext, l longblob)",
+    # table options / partitioning
+    "CREATE TABLE p (id bigint) ENGINE=InnoDB AUTO_INCREMENT=6 "
+    "DEFAULT CHARSET=utf8 ROW_FORMAT=COMPRESSED KEY_BLOCK_SIZE=8",
+    "CREATE TABLE t (c int) PARTITION BY HASH (c) PARTITIONS 32",
+    # indexes
+    "CREATE INDEX idx ON t (a) USING HASH COMMENT 'foo'",
+    "CREATE INDEX idx USING BTREE ON t (a)",
+    "CREATE TABLE t (a int, INDEX ia (a) COMMENT 'x', "
+    "FULLTEXT KEY ft (a))",
+    # ALTER long tail
+    "ALTER TABLE t ADD COLUMN (a SMALLINT UNSIGNED, b varchar(255))",
+    "ALTER TABLE t DISABLE KEYS",
+    "ALTER TABLE t ENABLE KEYS",
+    "ALTER TABLE t CHANGE COLUMN a b varchar(255) FIRST",
+    "ALTER TABLE t ALTER COLUMN a SET DEFAULT 1",
+    "ALTER TABLE t ALTER a DROP DEFAULT",
+    "ALTER TABLE t ADD COLUMN a SMALLINT UNSIGNED, lock=none",
+    "ALTER TABLE t ADD UNIQUE (a) COMMENT 'a'",
+    "ALTER TABLE t ENGINE = innodb",
+    "ALTER TABLE t ADD FULLTEXT INDEX ft (nm ASC)",
+    # SELECT long tail
+    "SELECT DISTINCTROW * FROM t",
+    "SELECT a.b.* FROM t",
+    "SELECT * from t lock in share mode",
+    "SELECT SUBSTRING('Quadratically' FROM 5)",
+    "SELECT SUBSTRING('Quadratically' FROM 5 FOR 3)",
+    "SELECT CAST(data AS CHAR CHARACTER SET utf8) FROM t",
+    "SELECT CAST(data AS JSON) FROM t",
+    "SELECT CAST(1 AS SIGNED INT)",
+    "SELECT X'0a', 0x0b, b'1010'",
+    "SELECT N'string'",
+    "SELECT 1 AS 'a'",
+    "select * from t1 straight_join t2 on t1.id = t2.id",
+    "(select c1 from t1) union distinctrow select c2 from t2",
+    # SET long tail
+    "SET LOCAL autocommit = 1",
+    "SET @@local.autocommit = 1",
+    "SET PASSWORD FOR 'root'@'localhost' = 'password'",
+    "SET SESSION TRANSACTION ISOLATION LEVEL REPEATABLE READ",
+    "SET GLOBAL TRANSACTION ISOLATION LEVEL READ COMMITTED",
+    "SET SESSION TRANSACTION READ ONLY",
+    # SHOW / FLUSH / DROP / ADMIN / ANALYZE
+    "SHOW CHARACTER SET",
+    "SHOW CHARSET",
+    "SHOW FULL COLUMNS IN t",
+    "SHOW STATS_META",
+    "SHOW STATS_BUCKETS WHERE table_name = 't'",
+    "FLUSH NO_WRITE_TO_BINLOG TABLES tbl1 WITH READ LOCK",
+    "FLUSH TABLES tbl1, tbl2",
+    "DROP TABLES xxx, yyy",
+    "DROP VIEW IF EXISTS xxx",
+    "DROP STATS t",
+    "ADMIN CANCEL DDL JOBS 1, 2",
+    "ANALYZE TABLE t1 INDEX a, b",
+    # misc
+    "INSERT INTO foo () VALUES ()",
+    "CREATE TABLE a LIKE b",
+    "CREATE TABLE IF NOT EXISTS a LIKE b",
+    "ALTER TABLE db.t RENAME db.t1",
+    "GRANT ALL ON db1.* TO 'jeffrey'@'localhost' WITH GRANT OPTION",
+]
+
+
+@pytest.mark.parametrize("sql", PARSES)
+def test_parses(sql):
+    parse(sql)
+
+
+class TestSemantics:
+    def test_hex_literal_value(self):
+        s = one("SELECT X'0a' + 0")
+        assert isinstance(s, ast.SelectStmt)
+
+    def test_create_like_ast(self):
+        s = one("CREATE TABLE a LIKE b")
+        assert s.like_table.name == "b"
+
+    def test_alter_set_default(self):
+        s = one("ALTER TABLE t ALTER COLUMN a SET DEFAULT 1")
+        assert s.specs[0].tp == "set_default"
+        assert s.specs[0].name == "a"
+
+    def test_substring_from_desugars(self):
+        s = one("SELECT SUBSTRING('abcdef' FROM 2 FOR 3)")
+        f = s.fields[0].expr
+        assert isinstance(f, ast.FuncCall) and len(f.args) == 3
+
+    def test_admin_cancel_ids(self):
+        s = one("ADMIN CANCEL DDL JOBS 3, 4")
+        assert s.tp == "cancel_ddl_jobs" and s.job_ids == [3, 4]
+
+    def test_grant_option_adds_grant_priv(self):
+        s = one("GRANT SELECT ON d.* TO 'u'@'%' WITH GRANT OPTION")
+        assert "GRANT" in s.privs
+
+    def test_multi_schema_alter_still_rejected(self):
+        with pytest.raises(ParseError):
+            parse("ALTER TABLE t ADD COLUMN a INT ADD COLUMN b INT")
+
+
+class TestEndToEnd:
+    """The new syntax runs through the session, not just the parser."""
+
+    @pytest.fixture
+    def sess(self):
+        from tidb_tpu.bootstrap import bootstrap
+        from tidb_tpu.session import Session
+        from tidb_tpu.store.storage import new_mock_storage
+        st = new_mock_storage()
+        bootstrap(st)           # SET PASSWORD touches mysql.user
+        s = Session(st)
+        s.execute("CREATE DATABASE lt; USE lt")
+        yield s
+        s.close()
+
+    def test_create_like_clones_schema(self, sess):
+        sess.execute("CREATE TABLE src (id BIGINT PRIMARY KEY, "
+                     "v VARCHAR(10) COLLATE utf8mb4_general_ci)")
+        sess.execute("CREATE INDEX iv ON src (v)")
+        sess.execute("CREATE TABLE dst LIKE src")
+        sess.execute("INSERT INTO dst VALUES (1, 'X')")
+        assert sess.query("SELECT COUNT(*) FROM dst WHERE v = 'x'"
+                          ).rows == [(1,)]
+        # independent tables
+        assert sess.query("SELECT COUNT(*) FROM src").rows == [(0,)]
+
+    def test_set_password_and_transaction(self, sess):
+        sess.execute("CREATE USER 'u1'@'%'")
+        sess.execute("SET PASSWORD FOR 'u1'@'%' = 'secret'")
+        from tidb_tpu.privilege import encode_password
+        assert sess.query(
+            "SELECT authentication_string FROM mysql.user "
+            "WHERE user = 'u1'").rows == [(encode_password("secret"),)]
+        sess.execute("SET SESSION TRANSACTION ISOLATION LEVEL "
+                     "READ COMMITTED")
+
+    def test_alter_set_default_applies(self, sess):
+        sess.execute("CREATE TABLE d (id BIGINT PRIMARY KEY, v BIGINT)")
+        sess.execute("ALTER TABLE d ALTER COLUMN v SET DEFAULT 42")
+        sess.execute("INSERT INTO d (id) VALUES (1)")
+        assert sess.query("SELECT v FROM d").rows == [(42,)]
+        sess.execute("ALTER TABLE d ALTER COLUMN v DROP DEFAULT")
+
+    def test_show_stats_after_analyze(self, sess):
+        sess.execute("CREATE TABLE st (id BIGINT PRIMARY KEY, v BIGINT)")
+        sess.execute("INSERT INTO st VALUES " + ",".join(
+            f"({i},{i % 7})" for i in range(100)))
+        sess.execute("ANALYZE TABLE st")
+        rows = sess.query("SHOW STATS_META WHERE table_name = 'st'").rows
+        assert len(rows) == 1 and rows[0][4] == 100
+        assert sess.query("SHOW STATS_HISTOGRAMS "
+                          "WHERE table_name = 'st'").rows
+        assert sess.query("SHOW STATS_BUCKETS "
+                          "WHERE table_name = 'st'").rows
+
+    def test_drop_stats(self, sess):
+        sess.execute("CREATE TABLE ds (id BIGINT PRIMARY KEY)")
+        sess.execute("INSERT INTO ds VALUES (1)")
+        sess.execute("ANALYZE TABLE ds")
+        sess.execute("DROP STATS ds")
+        assert sess.query("SHOW STATS_META WHERE table_name = 'ds'"
+                          ).rows == []
+
+    def test_admin_cancel_missing_job(self, sess):
+        rows = sess.query("ADMIN CANCEL DDL JOBS 99999").rows
+        assert rows == [(99999, "not found")]
+
+    def test_flush_tables_and_drop_view(self, sess):
+        sess.execute("FLUSH TABLES")
+        sess.execute("DROP VIEW IF EXISTS nothing")
+        from tidb_tpu.session import SQLError
+        with pytest.raises(SQLError):
+            sess.execute("DROP VIEW nothing")

@@ -173,12 +173,17 @@ class TestAccounts:
         assert _host_match("::1", "localhost")
         assert not _host_match("localhost", "10.0.0.1")
 
-    def test_with_grant_option_rejected(self, store):
-        from tidb_tpu.session import SQLError
+    def test_with_grant_option_grants_grant_priv(self, store):
+        """WITH GRANT OPTION grants the GRANT bit: the grantee can then
+        grant onward (previously rejected; now real semantics)."""
         r = root(store)
         r.execute("CREATE USER u")
-        with pytest.raises(Exception, match="GRANT OPTION"):
-            r.execute("GRANT SELECT ON *.* TO u WITH GRANT OPTION")
+        r.execute("CREATE USER v")
+        r.execute("CREATE DATABASE gdb")
+        r.execute("GRANT SELECT ON gdb.* TO u WITH GRANT OPTION")
+        s = Session(store, user="u", host="localhost")
+        s.execute("GRANT SELECT ON gdb.* TO v")   # GRANT bit at work
+        s.close()
 
 
 class TestServerAuth:

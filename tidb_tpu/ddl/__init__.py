@@ -192,7 +192,17 @@ class DDL:
                 if stmt.if_not_exists:
                     return None
                 raise DDLError(f"table '{stmt.table.name}' exists")
-            info = build_table_info(meta, stmt)
+            if stmt.like_table is not None:
+                # CREATE TABLE a LIKE b: clone b's schema with fresh ids
+                # (ref: ddl_api.go CreateTableWithLike)
+                _sdb, src = self._must_resolve(meta, stmt.like_table,
+                                               current_db)
+                info = TableInfo.from_json(src.to_json())   # deep copy
+                info.id = meta.gen_global_id()
+                info.name = stmt.table.name
+                info.auto_inc_id = 0
+            else:
+                info = build_table_info(meta, stmt)
             return Job(tp=JobType.CREATE_TABLE, schema_id=db.id,
                        table_id=info.id, args={"table": info.to_json()})
         return [build]
@@ -274,14 +284,24 @@ class DDL:
     def _build_AlterTableStmt(self, stmt, current_db):
         # one schema change per statement, like the reference
         # (ddl_api.go AlterTable: errRunMultiSchemaChanges) — keeps ALTER
-        # atomic: a failing spec can't leave earlier specs applied
-        if len(stmt.specs) != 1:
+        # atomic: a failing spec can't leave earlier specs applied.
+        # Parse-level no-ops (LOCK=/ALGORITHM=/ENABLE KEYS) don't count.
+        specs = [sp for sp in stmt.specs if sp.tp != "noop"]
+        if not specs:
+            return []
+        if len(specs) != 1:
             raise DDLError("running multiple schema changes in one "
                            "statement is not supported")
+        spec = specs[0]
+        if spec.tp == "add_columns":
+            if len(spec.columns) != 1:
+                raise DDLError("running multiple schema changes in one "
+                               "statement is not supported")
+            spec = ast.AlterSpec(tp="add_column", column=spec.columns[0])
 
         def build(meta: Meta):
             db, t = self._must_resolve(meta, stmt.table, current_db)
-            return self._alter_spec_job(meta, db, t, stmt.specs[0])
+            return self._alter_spec_job(meta, db, t, spec)
         return [build]
 
     def _alter_spec_job(self, meta: Meta, db, t: TableInfo, spec):
@@ -339,13 +359,41 @@ class DDL:
             old = t.col_by_name(old_name)
             if old is None:
                 raise DDLError(f"Unknown column '{old_name}'")
-            new = ColumnInfo(id=old.id, name=spec.column.name,
-                             offset=old.offset, ft=spec.column.ft)
+            # MySQL MODIFY/CHANGE replaces the whole definition: the
+            # default must be restated or it resets
+            cd = spec.column
+            default = _const_default(cd) if cd.has_default else None
+            new = ColumnInfo(id=old.id, name=cd.name,
+                             offset=old.offset, ft=cd.ft,
+                             default=default,
+                             has_default=cd.has_default or
+                             not cd.ft.not_null)
             return Job(tp=JobType.MODIFY_COLUMN, schema_id=db.id,
                        table_id=t.id,
                        args={"old_name": old_name,
                              "column": new.to_json()})
+        if spec.tp in ("set_default", "drop_default"):
+            old = t.col_by_name(spec.name)
+            if old is None:
+                raise DDLError(f"Unknown column '{spec.name}'")
+            # metadata-only change, rides the MODIFY_COLUMN job
+            fake = ast.ColumnDef(name=old.name, ft=old.ft,
+                                 default=spec.default,
+                                 has_default=spec.tp == "set_default")
+            default = _const_default(fake) \
+                if spec.tp == "set_default" else None
+            new = ColumnInfo(id=old.id, name=old.name, offset=old.offset,
+                             ft=old.ft, default=default,
+                             has_default=spec.tp == "set_default" or
+                             not old.ft.not_null,
+                             auto_increment=old.auto_increment)
+            return Job(tp=JobType.MODIFY_COLUMN, schema_id=db.id,
+                       table_id=t.id,
+                       args={"old_name": old.name,
+                             "column": new.to_json()})
         if spec.tp == "rename":
+            if spec.new_db and spec.new_db.lower() != db.name.lower():
+                raise DDLError("cross-database RENAME is not supported")
             existing = self._find_table(meta, db.id, spec.name)
             if existing is not None and existing.id != t.id:
                 raise DDLError(f"table '{spec.name}' exists")

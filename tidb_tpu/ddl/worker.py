@@ -334,6 +334,8 @@ class DDLWorker:
         new = ColumnInfo.from_json(job.args["column"])
         col.name = new.name
         col.ft = new.ft
+        col.default = new.default        # SET/DROP DEFAULT ride this job
+        col.has_default = new.has_default
         m.update_table(job.schema_id, info)
         job.state = JobState.DONE
         return True

@@ -334,6 +334,7 @@ class CreateTableStmt(StmtNode):
     indexes: list = field(default_factory=list)       # [IndexDef]
     if_not_exists: bool = False
     options: dict = field(default_factory=dict)       # engine/charset/comment
+    like_table: Optional[TableSource] = None          # CREATE TABLE a LIKE b
 
 
 @dataclass
@@ -371,13 +372,17 @@ class DropIndexStmt(StmtNode):
 
 @dataclass
 class AlterSpec(Node):
-    tp: str                  # add_column/drop_column/add_index/drop_index/
-    #                          modify_column/rename
+    tp: str                  # add_column(s)/drop_column/add_index/
+    #                          drop_index/modify_column/change_column/
+    #                          rename/set_default/drop_default/noop
     column: Optional[ColumnDef] = None
+    columns: Optional[list] = None     # ADD COLUMN (a ..., b ...)
     index: Optional[IndexDef] = None
     name: str = ""           # drop target / rename target
     position: str = ""       # FIRST / AFTER <col>
     after_col: str = ""
+    default: Optional[ExprNode] = None  # SET DEFAULT value
+    new_db: str = ""         # RENAME to another database
 
 
 @dataclass
@@ -450,6 +455,7 @@ class ExplainStmt(StmtNode):
 @dataclass
 class AnalyzeStmt(StmtNode):
     tables: list = field(default_factory=list)
+    index_names: Optional[list] = None   # ANALYZE ... INDEX [names]
 
 
 @dataclass
@@ -471,8 +477,9 @@ class DeallocateStmt(StmtNode):
 
 @dataclass
 class AdminStmt(StmtNode):
-    tp: str = ""             # show_ddl / check_table
+    tp: str = ""             # show_ddl / check_table / cancel_ddl_jobs
     tables: list = field(default_factory=list)
+    job_ids: list = field(default_factory=list)
 
 
 @dataclass
@@ -537,6 +544,30 @@ class UserSpec:
 class CreateUserStmt(StmtNode):
     users: list = field(default_factory=list)      # [UserSpec]
     if_not_exists: bool = False
+
+
+@dataclass
+class DropViewStmt(StmtNode):
+    """Views are unimplemented; DROP VIEW IF EXISTS no-ops (migration
+    scripts), otherwise errors."""
+
+    tables: list = field(default_factory=list)
+    if_exists: bool = False
+
+
+@dataclass
+class DropStatsStmt(StmtNode):
+    """DROP STATS t (ref: parser.y DropStatsStmt)."""
+
+    table: TableSource = None
+
+
+@dataclass
+class SetPasswordStmt(StmtNode):
+    """SET PASSWORD [FOR user] = 'pw' (ref: parser.y SetPwdStmt)."""
+
+    user: Optional["UserSpec"] = None   # None = the current user
+    password: str = ""
 
 
 @dataclass

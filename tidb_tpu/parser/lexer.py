@@ -67,6 +67,11 @@ NON_RESERVED = {
     "LOAD", "DATA", "INFILE", "TERMINATED", "ENCLOSED", "ESCAPED",
     "LINES", "OPTIONALLY", "STARTING", "SPLIT", "AT", "REGIONS", "LOCAL",
     "KILL", "TIDB", "CONNECTION", "QUERY", "DO", "FLUSH", "ESCAPE",
+    # ALTER/SET/SHOW long tail (keyword meaning only in those clauses)
+    "DISABLE", "ENABLE", "KEYS", "READ", "ONLY", "ISOLATION", "LEVEL",
+    "BINARY", "CHARACTER", "FULLTEXT", "TRANSACTION", "PASSWORD",
+    "TABLES", "STATS", "NO_WRITE_TO_BINLOG", "SHARE", "MODE",
+    "DISTINCTROW", "CHARSET", "LOCK", "VIEW", "JOBS", "CANCEL",
 }
 
 
@@ -112,6 +117,16 @@ class Lexer:
             return Token(TokenType.EOF, "", self.pos)
         c = self.sql[self.pos]
         start = self.pos
+        if c in "xX" and self._peek(1) == "'":
+            return self._hex_literal(start)          # X'0a'
+        if c in "bB" and self._peek(1) == "'":
+            return self._bit_literal(start)          # b'1010'
+        if c in "nN" and self._peek(1) == "'":
+            self.pos += 1                            # N'...' national str
+            return self._string(self.pos, "'")
+        if c == "0" and self._peek(1) in "xX" and \
+                self._is_hex(self._peek(2)):
+            return self._hex0x_literal(start)        # 0x0a
         if c.isdigit() or (c == "." and self._peek(1).isdigit()):
             return self._number(start)
         if c.isalpha() or c == "_":
@@ -141,6 +156,40 @@ class Lexer:
                 self.pos = end + 2
             else:
                 return
+
+    @staticmethod
+    def _is_hex(c: str) -> bool:
+        return bool(c) and c in "0123456789abcdefABCDEF"
+
+    def _hex_literal(self, start: int) -> Token:
+        """X'0a' -> INT token (MySQL hex literals act as numbers in
+        numeric context; string-context binary semantics are out of
+        scope — docs/DEVIATIONS.md)."""
+        end = self.sql.find("'", start + 2)
+        if end < 0:
+            raise LexError(f"unterminated hex literal at {start}")
+        digits = self.sql[start + 2:end]
+        if digits and not all(self._is_hex(c) for c in digits):
+            raise LexError(f"bad hex literal at {start}")
+        self.pos = end + 1
+        return Token(TokenType.INT, str(int(digits or "0", 16)), start)
+
+    def _bit_literal(self, start: int) -> Token:
+        end = self.sql.find("'", start + 2)
+        if end < 0:
+            raise LexError(f"unterminated bit literal at {start}")
+        digits = self.sql[start + 2:end]
+        if digits and not all(c in "01" for c in digits):
+            raise LexError(f"bad bit literal at {start}")
+        self.pos = end + 1
+        return Token(TokenType.INT, str(int(digits or "0", 2)), start)
+
+    def _hex0x_literal(self, start: int) -> Token:
+        self.pos = start + 2
+        while self.pos < self.n and self._is_hex(self.sql[self.pos]):
+            self.pos += 1
+        return Token(TokenType.INT,
+                     str(int(self.sql[start + 2:self.pos], 16)), start)
 
     def _number(self, start: int) -> Token:
         has_dot = has_exp = False

@@ -90,6 +90,13 @@ class Parser:
         if not self.try_kw(kw):
             raise ParseError(f"expected {kw}", self.peek())
 
+    # word helpers: match a KEYWORD *or* IDENT by (upper-cased) value —
+    # for MySQL's many non-reserved words (ISOLATION, LOCAL, DISABLE...)
+    def peek_word(self, k: int = 0) -> str:
+        t = self.peek(k)
+        return t.val.upper() if t.tp in (TokenType.KEYWORD,
+                                         TokenType.IDENT) else ""
+
     # non-reserved words (lexer.NON_RESERVED): keyword meaning only in
     # LOAD DATA / SPLIT TABLE clauses, plain identifiers elsewhere
     def try_word(self, *words: str) -> bool:
@@ -153,7 +160,19 @@ class Parser:
                     exprs.append(self.expr())
                 return ast.DoStmt(exprs=exprs)
             self.next()                      # FLUSH
+            # FLUSH [NO_WRITE_TO_BINLOG|LOCAL] TABLES [t, ...]
+            #       [WITH READ LOCK] / PRIVILEGES / STATUS ...
+            self.try_word("NO_WRITE_TO_BINLOG", "LOCAL")
             kind = self.ident().lower()
+            if kind in ("tables", "table"):
+                kind = "tables"
+                while self.peek().tp == TokenType.IDENT:
+                    self.ident()
+                    if not self.try_op(","):
+                        break
+                if self.try_kw("WITH"):
+                    self.expect_word("READ")
+                    self.expect_word("LOCK")
             return ast.FlushStmt(tp=kind)
         if t.tp != TokenType.KEYWORD and not (t.tp == TokenType.OP and
                                               t.val == "("):
@@ -239,7 +258,15 @@ class Parser:
             tables = [self.table_name()]
             while self.try_op(","):
                 tables.append(self.table_name())
-            return ast.AnalyzeStmt(tables=tables)
+            idx_names = None
+            if self.try_kw("INDEX"):
+                # ANALYZE TABLE t INDEX [a, b]: restrict to index stats
+                idx_names = []
+                while self.peek().tp == TokenType.IDENT:
+                    idx_names.append(self.ident())
+                    if not self.try_op(","):
+                        break
+            return ast.AnalyzeStmt(tables=tables, index_names=idx_names)
         if kw == "GRANT":
             return self.grant_revoke(is_grant=True)
         if kw == "REVOKE":
@@ -255,6 +282,15 @@ class Parser:
                         self.next()
                         return ast.AdminStmt(tp="show_ddl_jobs")
                 return ast.AdminStmt(tp="show_ddl")
+            if self.try_word("CANCEL"):
+                # ADMIN CANCEL DDL JOBS id [, id]
+                if self.peek_word() == "DDL":
+                    self.next()
+                self.expect_word("JOBS")
+                ids = [self._int_lit()]
+                while self.try_op(","):
+                    ids.append(self._int_lit())
+                return ast.AdminStmt(tp="cancel_ddl_jobs", job_ids=ids)
             self.expect_kw("CHECK")
             self.expect_kw("TABLE")
             tables = [self.table_name()]
@@ -375,7 +411,7 @@ class Parser:
         alls = []
         while self.try_kw("UNION"):
             is_all = self.try_kw("ALL")
-            self.try_kw("DISTINCT")
+            self.try_kw("DISTINCT") or self.try_word("DISTINCTROW")
             alls.append(is_all)
             selects.append(self.select_core())
         u = ast.UnionStmt(selects=selects, alls=alls)
@@ -407,7 +443,8 @@ class Parser:
             return s
         self.expect_kw("SELECT")
         s = ast.SelectStmt()
-        s.distinct = self.try_kw("DISTINCT")
+        s.distinct = self.try_kw("DISTINCT") or \
+            self.try_word("DISTINCTROW")
         self.try_kw("ALL")
         s.fields.append(self.select_field())
         while self.try_op(","):
@@ -429,6 +466,12 @@ class Parser:
         if self.try_kw("FOR"):
             self.expect_kw("UPDATE")
             s.for_update = True
+        elif self.try_word("LOCK"):
+            # LOCK IN SHARE MODE: reads are snapshot-consistent already;
+            # accepted as the weaker cousin of FOR UPDATE (no row locks)
+            self.expect_kw("IN")
+            self.expect_word("SHARE")
+            self.expect_word("MODE")
         return s
 
     def select_field(self) -> ast.SelectField:
@@ -436,15 +479,25 @@ class Parser:
         if t.tp == TokenType.OP and t.val == "*":
             self.next()
             return ast.SelectField(expr=ast.Star())
-        # t.* form
+        # t.* / db.t.* forms
         if t.tp == TokenType.IDENT and self.peek(1).val == "." and \
                 self.peek(2).val == "*":
             self.next(); self.next(); self.next()
             return ast.SelectField(expr=ast.Star(table=t.val))
+        if t.tp == TokenType.IDENT and self.peek(1).val == "." and \
+                self.peek(2).tp == TokenType.IDENT and \
+                self.peek(3).val == "." and self.peek(4).val == "*":
+            self.next()
+            tbl = self.peek(1).val
+            self.next(); self.next(); self.next(); self.next()
+            return ast.SelectField(expr=ast.Star(table=tbl))
         e = self.expr()
         alias = ""
         if self.try_kw("AS"):
-            alias = self.ident()
+            if self.peek().tp == TokenType.STRING:
+                alias = self.next().val
+            else:
+                alias = self.ident()
         elif self.peek().tp == TokenType.IDENT:
             alias = self.ident()
         return ast.SelectField(expr=e, alias=alias)
@@ -490,6 +543,15 @@ class Parser:
                     self.peek().is_kw("CROSS") or self.peek().is_kw("LEFT") \
                     or self.peek().is_kw("RIGHT"):
                 left = self._join_rest(left)
+            elif self.peek().tp == TokenType.IDENT and \
+                    self.peek().val.upper() == "STRAIGHT_JOIN":
+                # optimizer-order hint; join order is the planner's call
+                self.next()
+                right = self.table_ref()
+                j = ast.Join(left, right, ast.JoinType.INNER)
+                if self.try_kw("ON"):
+                    j.on = self.expr()
+                left = j
             else:
                 return left
 
@@ -534,7 +596,9 @@ class Parser:
         ts = self.table_name()
         if self.try_kw("AS"):
             ts.alias = self.ident()
-        elif self.peek().tp == TokenType.IDENT:
+        elif self.peek().tp == TokenType.IDENT and \
+                self.peek().val.upper() not in ("LOCK",
+                                                "STRAIGHT_JOIN"):
             ts.alias = self.ident()
         return ts
 
@@ -561,10 +625,11 @@ class Parser:
                 self.expect_op(")")
                 return stmt
             self.expect_op("(")
-            stmt.columns.append(self.ident())
-            while self.try_op(","):
+            if not self.try_op(")"):       # () = explicit empty list
                 stmt.columns.append(self.ident())
-            self.expect_op(")")
+                while self.try_op(","):
+                    stmt.columns.append(self.ident())
+                self.expect_op(")")
         if self.try_kw("VALUES") or self.try_kw("VALUE"):
             stmt.values.append(self.value_row())
             while self.try_op(","):
@@ -662,6 +727,7 @@ class Parser:
         unique = self.try_kw("UNIQUE")
         if self.try_kw("INDEX"):
             name = self.ident()
+            self._index_using()            # CREATE INDEX i USING BTREE ON ...
             self.expect_kw("ON")
             table = self.table_name()
             self.expect_op("(")
@@ -669,6 +735,15 @@ class Parser:
             while self.try_op(","):
                 cols.append(self.ident())
             self.expect_op(")")
+            # trailing index options: USING, COMMENT (accepted, fixed
+            # implementation — there is one index layout)
+            while True:
+                if self._index_using():
+                    continue
+                if self.try_kw("COMMENT"):
+                    self.next()
+                    continue
+                break
             return ast.CreateIndexStmt(index_name=name, table=table,
                                        columns=cols, unique=unique)
         if unique:
@@ -678,10 +753,23 @@ class Parser:
         ine = self._if_not_exists()
         stmt = ast.CreateTableStmt(table=self.table_name(),
                                    if_not_exists=ine)
+        if self.try_kw("LIKE"):
+            stmt.like_table = self.table_name()
+            return stmt
+        if self.peek().tp == TokenType.OP and self.peek().val == "(" \
+                and self.peek(1).tp == TokenType.KEYWORD and \
+                self.peek(1).val == "LIKE":
+            self.next()
+            self.next()
+            stmt.like_table = self.table_name()
+            self.expect_op(")")
+            return stmt
         self.expect_op("(")
         while True:
             if self.try_kw("PRIMARY"):
                 self.expect_kw("KEY")
+                if self.peek().tp == TokenType.IDENT:
+                    self.ident()     # optional constraint name, ignored
                 stmt.indexes.append(ast.IndexDef(
                     name="PRIMARY", columns=self._paren_idents(),
                     unique=True, primary=True))
@@ -690,10 +778,34 @@ class Parser:
                 name = "" if self.peek().val == "(" else self.ident()
                 stmt.indexes.append(ast.IndexDef(
                     name=name, columns=self._paren_idents(), unique=True))
+                self._index_tail_options()
             elif self.try_kw("KEY") or self.try_kw("INDEX"):
                 name = "" if self.peek().val == "(" else self.ident()
                 stmt.indexes.append(ast.IndexDef(
                     name=name, columns=self._paren_idents()))
+                self._index_tail_options()
+            elif self.try_kw("CHECK"):
+                # table-level CHECK constraint: parsed + IGNORED (as
+                # MySQL did before 8.0.16)
+                self.expect_op("(")
+                depth = 1
+                while depth:
+                    tk = self.next()
+                    if tk.tp == TokenType.OP and tk.val == "(":
+                        depth += 1
+                    elif tk.tp == TokenType.OP and tk.val == ")":
+                        depth -= 1
+                    elif tk.tp == TokenType.EOF:
+                        raise ParseError("unterminated CHECK", tk)
+            elif self.peek_word() == "FULLTEXT":
+                # fulltext layout: stored as a plain secondary index
+                # (MATCH() search is unsupported — DEVIATIONS.md)
+                self.next()
+                self.try_kw("KEY") or self.try_kw("INDEX")
+                name = "" if self.peek().val == "(" else self.ident()
+                stmt.indexes.append(ast.IndexDef(
+                    name=name, columns=self._paren_idents()))
+                self._index_tail_options()
             elif self.try_kw("CONSTRAINT"):
                 # CONSTRAINT [name] UNIQUE/PRIMARY/FOREIGN KEY ...
                 if self.peek().tp == TokenType.IDENT:
@@ -711,19 +823,82 @@ class Parser:
             if not self.try_op(","):
                 break
         self.expect_op(")")
-        # table options
-        while self.peek().tp == TokenType.KEYWORD and self.peek().val in (
-                "ENGINE", "CHARSET", "COLLATE", "COMMENT", "AUTO_INCREMENT"):
-            opt = self.next().val
-            self.try_op("=")
-            v = self.next().val
-            stmt.options[opt.lower()] = v
-        if self.try_kw("DEFAULT"):
-            while self.peek().val in ("CHARSET", "COLLATE"):
-                opt = self.next().val
+        # table options (ref: parser.y TableOption — the storage-engine
+        # tuning knobs are accepted and recorded, not acted on)
+        _OPTS = ("ENGINE", "CHARSET", "COLLATE", "COMMENT",
+                 "AUTO_INCREMENT", "ROW_FORMAT", "KEY_BLOCK_SIZE",
+                 "CHECKSUM", "DELAY_KEY_WRITE", "MAX_ROWS", "MIN_ROWS",
+                 "AVG_ROW_LENGTH", "CONNECTION", "PASSWORD",
+                 "STATS_PERSISTENT", "COMPRESSION")
+        while True:
+            self.try_op(",")       # options may be comma-separated
+            t = self.peek()
+            name = t.val.upper() if t.tp in (TokenType.KEYWORD,
+                                             TokenType.IDENT) else ""
+            if name == "DEFAULT":
+                self.next()
+                name = self.peek().val.upper()
+                if name == "CHARACTER":
+                    self.next()
+                    self.expect_kw("SET")
+                    self.try_op("=")
+                    stmt.options["charset"] = self.next().val
+                    continue
+                if name in ("CHARSET", "COLLATE"):
+                    opt = self.next().val
+                    self.try_op("=")
+                    stmt.options[opt.lower()] = self.next().val
+                    continue
+                raise ParseError("expected CHARSET/COLLATE", self.peek())
+            if name == "CHARACTER":
+                self.next()
+                self.expect_kw("SET")
                 self.try_op("=")
-                stmt.options[opt.lower()] = self.next().val
+                stmt.options["charset"] = self.next().val
+                continue
+            if name in _OPTS:
+                self.next()
+                self.try_op("=")
+                stmt.options[name.lower()] = self.next().val
+                continue
+            if name == "PARTITION" and self.peek_word(1) == "BY":
+                # partitioning clause: parsed + IGNORED (regions already
+                # range-partition storage; DEVIATIONS.md)
+                depth = 0
+                while True:
+                    t2 = self.peek()
+                    if t2.tp == TokenType.EOF:
+                        break
+                    if t2.tp == TokenType.OP and t2.val == "(":
+                        depth += 1
+                    elif t2.tp == TokenType.OP and t2.val == ")":
+                        depth -= 1
+                    elif t2.tp == TokenType.OP and t2.val == ";" and \
+                            depth == 0:
+                        break
+                    self.next()
+                continue
+            break
         return stmt
+
+    def _index_tail_options(self) -> None:
+        """Inline index definitions accept [USING ...] [COMMENT '...']."""
+        while True:
+            if self._index_using():
+                continue
+            if self.try_kw("COMMENT"):
+                self.next()
+                continue
+            break
+
+    def _index_using(self) -> bool:
+        """[USING BTREE|HASH] — accepted; one index layout exists."""
+        if self.try_kw("USING"):
+            t = self.next()
+            if t.val.upper() not in ("BTREE", "HASH"):
+                raise ParseError("expected BTREE or HASH", t)
+            return True
+        return False
 
     def _if_not_exists(self) -> bool:
         if self.try_kw("IF"):
@@ -735,15 +910,17 @@ class Parser:
     def _paren_idents(self) -> list[str]:
         self.expect_op("(")
         out = [self.ident()]
-        # ignore optional key length e.g. col(10)
+        # ignore optional key length e.g. col(10) and ASC/DESC order
         if self.try_op("("):
             self._int_lit()
             self.expect_op(")")
+        self.try_kw("ASC") or self.try_kw("DESC")
         while self.try_op(","):
             out.append(self.ident())
             if self.try_op("("):
                 self._int_lit()
                 self.expect_op(")")
+            self.try_kw("ASC") or self.try_kw("DESC")
         self.expect_op(")")
         return out
 
@@ -751,6 +928,8 @@ class Parser:
         name = self.ident()
         ft = self.field_type()
         d = ast.ColumnDef(name=name, ft=ft)
+        if getattr(self, "_last_type_collation", None) is not None:
+            d.explicit_collation = True
         flags = ft.flags
         while True:
             if self.try_kw("NOT"):
@@ -785,6 +964,33 @@ class Parser:
                     d.explicit_collation = True
             elif self.try_kw("CHARSET"):
                 self.next()
+            elif self.peek_word() == "CHARACTER" and \
+                    self.peek_word(1) == "SET":
+                self.next()
+                self.next()
+                self.next()
+            elif self.try_kw("ON"):
+                # ON UPDATE CURRENT_TIMESTAMP[(n)]: parsed + ignored
+                # (auto-update timestamps — DEVIATIONS.md)
+                self.expect_kw("UPDATE")
+                self.next()
+                if self.try_op("("):
+                    if self.peek().tp == TokenType.INT:
+                        self.next()
+                    self.expect_op(")")
+            elif self.try_kw("CHECK"):
+                # inline CHECK constraints: parsed + IGNORED, as MySQL
+                # did before 8.0.16
+                self.expect_op("(")
+                depth = 1
+                while depth:
+                    tk = self.next()
+                    if tk.tp == TokenType.OP and tk.val == "(":
+                        depth += 1
+                    elif tk.tp == TokenType.OP and tk.val == ")":
+                        depth -= 1
+                    elif tk.tp == TokenType.EOF:
+                        raise ParseError("unterminated CHECK", tk)
             elif self.try_kw("REFERENCES"):
                 # inline column REFERENCES (incl. MATCH / ON DELETE /
                 # ON UPDATE): parsed and IGNORED, exactly as MySQL does
@@ -833,6 +1039,16 @@ class Parser:
         if t.tp not in (TokenType.KEYWORD, TokenType.IDENT):
             raise ParseError("expected type", t)
         name = t.val.upper()
+        if name == "NATIONAL":
+            t = self.next()
+            name = t.val.upper()          # national char/varchar
+        _SYNONYMS = {"INT1": "TINYINT", "INT2": "SMALLINT",
+                     "INT3": "MEDIUMINT", "INT4": "INT",
+                     "INT8": "BIGINT", "MIDDLEINT": "MEDIUMINT",
+                     "DEC": "DECIMAL", "FIXED": "DECIMAL",
+                     "NCHAR": "CHAR", "NVARCHAR": "VARCHAR",
+                     "SERIAL": "BIGINT"}
+        name = _SYNONYMS.get(name, name)
         if name in ("ENUM", "SET"):
             # ENUM('a','b',...) / SET('a','b',...)
             self.expect_op("(")
@@ -855,11 +1071,23 @@ class Parser:
                 frac = self._int_lit()
             self.expect_op(")")
         flags = 0
+        collation = None
         while True:
             if self.try_kw("UNSIGNED"):
                 flags |= st.Flag.UNSIGNED
             elif self.try_kw("SIGNED") or self.try_kw("ZEROFILL"):
                 pass
+            elif self.try_word("BINARY"):
+                pass   # binary attribute == the default _bin collation
+            elif self.peek_word() == "CHARACTER" and \
+                    self.peek_word(1) == "SET":
+                self.next()
+                self.next()
+                self.next()               # charset name: accepted, fixed
+            elif self.try_kw("CHARSET"):
+                self.next()
+            elif self.try_kw("COLLATE"):
+                collation = self.next().val.lower()
             else:
                 break
         TC = st.TypeCode
@@ -870,7 +1098,11 @@ class Parser:
             "FLOAT": TC.FLOAT, "DOUBLE": TC.DOUBLE, "REAL": TC.DOUBLE,
             "DECIMAL": TC.NEWDECIMAL, "NUMERIC": TC.NEWDECIMAL,
             "CHAR": TC.STRING, "VARCHAR": TC.VARCHAR, "TEXT": TC.BLOB,
-            "BLOB": TC.BLOB, "BINARY": TC.STRING,
+            "BLOB": TC.BLOB, "BINARY": TC.STRING, "VARBINARY": TC.VARCHAR,
+            "TINYTEXT": TC.BLOB, "MEDIUMTEXT": TC.BLOB,
+            "LONGTEXT": TC.BLOB, "TINYBLOB": TC.BLOB,
+            "MEDIUMBLOB": TC.BLOB, "LONGBLOB": TC.BLOB,
+            "BIT": TC.TINY,
             "DATE": TC.DATE, "DATETIME": TC.DATETIME,
             "TIMESTAMP": TC.TIMESTAMP, "TIME": TC.DURATION,
             "YEAR": TC.YEAR, "JSON": TC.JSON,
@@ -883,7 +1115,14 @@ class Parser:
                 flen = 10
             if frac < 0:
                 frac = 0
-        return st.FieldType(tp, flags=flags, flen=flen, frac=frac)
+        ft = st.FieldType(tp, flags=flags, flen=flen, frac=frac)
+        if collation is not None and \
+                ft.eval_type == st.EvalType.STRING:
+            import dataclasses
+            ft = dataclasses.replace(ft, collation=collation)
+        # column_def checks this to mark an explicit column collation
+        self._last_type_collation = collation
+        return ft
 
     # -- account management (ref: parser.y GrantStmt/CreateUserStmt) --------
 
@@ -926,7 +1165,13 @@ class Parser:
             while True:
                 t = self.next()
                 name = t.val.upper()
-                if name not in self._PRIV_NAMES:
+                if name == "CREATE" and self.peek_word() == "USER":
+                    self.next()
+                    name = "CREATE USER"
+                elif name == "GRANT" and self.peek_word() == "OPTION":
+                    self.next()
+                    name = "GRANT"
+                elif name not in self._PRIV_NAMES:
                     raise ParseError(f"unknown privilege {t.val!r}", t)
                 privs.append(name)
                 if not self.try_op(","):
@@ -954,10 +1199,10 @@ class Parser:
         while self.try_op(","):
             users.append(self._user_spec())
         if is_grant and self.try_kw("WITH"):
-            # reject rather than silently discard: accepting the syntax
-            # while dropping the capability would mislead administrators
-            raise ParseError("WITH GRANT OPTION is not supported",
-                             self.peek())
+            # WITH GRANT OPTION == granting the GRANT privilege bit
+            self.expect_kw("GRANT")
+            self.expect_kw("OPTION")
+            privs.append("GRANT")
         cls = ast.GrantStmt if is_grant else ast.RevokeStmt
         return cls(privs=privs, db=db, table=tbl, users=users)
 
@@ -977,7 +1222,19 @@ class Parser:
             self.expect_kw("ON")
             return ast.DropIndexStmt(index_name=name,
                                      table=self.table_name())
-        self.expect_kw("TABLE")
+        if self.try_word("VIEW"):
+            # views don't exist here: DROP VIEW IF EXISTS is the common
+            # migration-script form — accept it as a no-op; plain DROP
+            # VIEW on a missing view errors like MySQL
+            ie = self._if_exists()
+            tables = [self.table_name()]
+            while self.try_op(","):
+                tables.append(self.table_name())
+            return ast.DropViewStmt(tables=tables, if_exists=ie)
+        if self.try_word("STATS"):
+            return ast.DropStatsStmt(table=self.table_name())
+        if not (self.try_kw("TABLE") or self.try_word("TABLES")):
+            raise ParseError("expected TABLE", self.peek())
         ie = self._if_exists()
         tables = [self.table_name()]
         while self.try_op(","):
@@ -1002,21 +1259,36 @@ class Parser:
 
     def alter_spec(self) -> ast.AlterSpec:
         if self.try_kw("ADD"):
+            self.try_word("FULLTEXT")   # fulltext layout: plain index here
             if self.try_kw("INDEX") or self.try_kw("KEY"):
                 name = "" if self.peek().val == "(" else self.ident()
-                return ast.AlterSpec(tp="add_index", index=ast.IndexDef(
+                spec = ast.AlterSpec(tp="add_index", index=ast.IndexDef(
                     name=name, columns=self._paren_idents()))
+                self._index_tail_options()
+                return spec
             if self.try_kw("UNIQUE"):
                 self.try_kw("INDEX") or self.try_kw("KEY")
                 name = "" if self.peek().val == "(" else self.ident()
-                return ast.AlterSpec(tp="add_index", index=ast.IndexDef(
+                spec = ast.AlterSpec(tp="add_index", index=ast.IndexDef(
                     name=name, columns=self._paren_idents(), unique=True))
+                self._index_tail_options()
+                return spec
             if self.try_kw("PRIMARY"):
                 self.expect_kw("KEY")
-                return ast.AlterSpec(tp="add_index", index=ast.IndexDef(
+                spec = ast.AlterSpec(tp="add_index", index=ast.IndexDef(
                     name="PRIMARY", columns=self._paren_idents(),
                     unique=True, primary=True))
+                self._index_tail_options()
+                return spec
             self.try_kw("COLUMN")
+            if self.peek().tp == TokenType.OP and self.peek().val == "(":
+                # ADD COLUMN (a INT, b VARCHAR(10)): multi-column form
+                self.next()
+                cols = [self.column_def()]
+                while self.try_op(","):
+                    cols.append(self.column_def())
+                self.expect_op(")")
+                return ast.AlterSpec(tp="add_columns", columns=cols)
             spec = ast.AlterSpec(tp="add_column", column=self.column_def())
             if self.try_kw("FIRST"):
                 spec.position = "first"
@@ -1041,10 +1313,60 @@ class Parser:
             spec = ast.AlterSpec(tp="change_column",
                                  column=self.column_def())
             spec.name = old
+            if self.try_kw("FIRST"):
+                spec.position = "first"
+            elif self.try_kw("AFTER"):
+                spec.position = "after"
+                spec.after_col = self.ident()
             return spec
+        if self.try_kw("ALTER"):
+            # ALTER [COLUMN] a SET DEFAULT v | DROP DEFAULT
+            self.try_kw("COLUMN")
+            col = self.ident()
+            if self.try_kw("SET"):
+                self.expect_kw("DEFAULT")
+                return ast.AlterSpec(tp="set_default", name=col,
+                                     default=self.expr())
+            self.expect_kw("DROP")
+            self.expect_kw("DEFAULT")
+            return ast.AlterSpec(tp="drop_default", name=col)
         if self.try_kw("RENAME"):
             self.try_kw("TO") or self.try_kw("AS")
-            return ast.AlterSpec(tp="rename", name=self.ident())
+            tn = self.table_name()
+            return ast.AlterSpec(tp="rename", name=tn.name,
+                                 new_db=tn.db)
+        if self.try_word("DISABLE") or self.try_word("ENABLE"):
+            # DISABLE/ENABLE KEYS: MyISAM bulk-load hint, no-op here
+            self.expect_word("KEYS")
+            return ast.AlterSpec(tp="noop")
+        word = self.peek_word()
+        if word in ("LOCK", "ALGORITHM"):
+            # online-DDL hints: LOCK=NONE|DEFAULT|SHARED|EXCLUSIVE,
+            # ALGORITHM=INPLACE|COPY|DEFAULT — accepted; this DDL is
+            # always online (F1 states), so the hints are no-ops
+            self.next()
+            self.try_op("=")
+            self.next()
+            return ast.AlterSpec(tp="noop")
+        if word == "DEFAULT" and self.peek_word(1) in (
+                "COLLATE", "CHARSET", "CHARACTER"):
+            self.next()
+            word = self.peek_word()
+        if word in ("ENGINE", "COMMENT", "COLLATE", "CHARSET",
+                    "ROW_FORMAT", "KEY_BLOCK_SIZE", "CHECKSUM",
+                    "AUTO_INCREMENT", "DELAY_KEY_WRITE"):
+            # ALTER-time table options: accepted + ignored (no storage
+            # engines / formats to switch)
+            self.next()
+            self.try_op("=")
+            self.next()
+            return ast.AlterSpec(tp="noop")
+        if word == "CHARACTER" and self.peek_word(1) == "SET":
+            self.next()
+            self.next()
+            self.try_op("=")
+            self.next()
+            return ast.AlterSpec(tp="noop")
         raise ParseError("unsupported ALTER spec", self.peek())
 
     def rename(self) -> ast.RenameTableStmt:
@@ -1088,21 +1410,67 @@ class Parser:
                 name="character_set_client", is_system=True,
                 value=ast.Literal(cs)))
             return stmt
+        if self.peek().val.upper() == "PASSWORD" and \
+                self.peek().tp in (TokenType.IDENT, TokenType.KEYWORD):
+            # SET PASSWORD [FOR user] = 'pw'
+            self.next()
+            user = None
+            if self.try_kw("FOR"):
+                user = self._user_spec()
+            self.expect_op("=")
+            t = self.next()
+            if t.tp != TokenType.STRING:
+                raise ParseError("SET PASSWORD takes a string", t)
+            return ast.SetPasswordStmt(user=user, password=t.val)
+        if self.peek().val.upper() == "TRANSACTION" or (
+                self.peek().val.upper() in ("SESSION", "GLOBAL", "LOCAL")
+                and self.peek(1).val.upper() == "TRANSACTION"):
+            # SET [SESSION|GLOBAL] TRANSACTION ISOLATION LEVEL ... /
+            # READ ONLY|WRITE — mapped onto the isolation sysvars
+            is_global = False
+            if self.peek().val.upper() in ("SESSION", "GLOBAL", "LOCAL"):
+                is_global = self.next().val.upper() == "GLOBAL"
+            self.next()                    # TRANSACTION
+            if self.try_word("READ"):
+                t = self.next()            # ONLY | WRITE
+                if t.val.upper() not in ("ONLY", "WRITE"):
+                    raise ParseError("expected ONLY or WRITE", t)
+                stmt.assignments.append(ast.VarAssignment(
+                    name="transaction_read_only", is_system=True,
+                    is_global=is_global,
+                    value=ast.Literal(1 if t.val.upper() == "ONLY"
+                                      else 0)))
+                return stmt
+            self.expect_word("ISOLATION")
+            self.expect_word("LEVEL")
+            words = [self.next().val.upper()]
+            if words[0] in ("READ", "REPEATABLE"):
+                words.append(self.next().val.upper())
+            level = " ".join(words)
+            if level not in ("READ UNCOMMITTED", "READ COMMITTED",
+                             "REPEATABLE READ", "SERIALIZABLE"):
+                raise ParseError(f"bad isolation level {level}",
+                                 self.peek())
+            stmt.assignments.append(ast.VarAssignment(
+                name="tx_isolation", is_system=True, is_global=is_global,
+                value=ast.Literal(level.replace(" ", "-"))))
+            return stmt
         while True:
             va = ast.VarAssignment(name="")
             if self.try_kw("GLOBAL"):
                 va.is_global = True
                 va.is_system = True
                 va.name = self.ident()
-            elif self.try_kw("SESSION"):
+            elif self.try_kw("SESSION") or self.try_word("LOCAL"):
                 va.is_system = True
                 va.name = self.ident()
             elif self.try_op("@"):
                 if self.try_op("@"):
                     va.is_system = True
-                    # @@global.x / @@session.x / @@x
+                    # @@global.x / @@session.x / @@local.x / @@x
                     nm = self.ident()
-                    if nm in ("global", "session") and self.try_op("."):
+                    if nm in ("global", "session", "local") and \
+                            self.try_op("."):
                         va.is_global = nm == "global"
                         nm = self.ident()
                     va.name = nm
@@ -1138,7 +1506,8 @@ class Parser:
             s.table = self.table_name()
         elif self.try_kw("COLUMNS") or self.try_kw("FIELDS"):
             s.tp = "columns"
-            self.expect_kw("FROM")
+            if not (self.try_kw("FROM") or self.try_kw("IN")):
+                raise ParseError("expected FROM", self.peek())
             s.table = self.table_name()
         elif self.try_kw("INDEX", "KEY"):
             s.tp = "index"
@@ -1174,6 +1543,16 @@ class Parser:
             s.tp = "engines"
         elif self.try_kw("COLLATION"):
             s.tp = "collation"
+        elif self.peek_word() == "CHARACTER" and \
+                self.peek_word(1) == "SET":
+            self.next()
+            self.next()
+            s.tp = "charset"
+        elif self.try_kw("CHARSET"):
+            s.tp = "charset"
+        elif self.peek_word() in ("STATS_META", "STATS_HISTOGRAMS",
+                                  "STATS_BUCKETS"):
+            s.tp = self.next().val.lower()
         else:
             raise ParseError("unsupported SHOW", self.peek())
         if self.try_kw("LIKE"):
@@ -1526,15 +1905,20 @@ class Parser:
                 frac = self._int_lit()
             self.expect_op(")")
         if name in ("SIGNED", "INT", "INTEGER"):
-            self.try_kw("INTEGER")
+            self.try_kw("INTEGER") or self.try_kw("INT")
             return st.new_int_field()
         if name == "UNSIGNED":
-            self.try_kw("INTEGER")
+            self.try_kw("INTEGER") or self.try_kw("INT")
             return st.new_uint_field()
         if name in ("DECIMAL", "NUMERIC"):
             return st.new_decimal_field(flen if flen > 0 else 10,
                                         frac if frac >= 0 else 0)
         if name in ("CHAR", "BINARY"):
+            if self.peek_word() == "CHARACTER" and \
+                    self.peek_word(1) == "SET":
+                self.next()
+                self.next()
+                self.next()        # charset name: accepted, fixed utf8
             return st.new_string_field(flen if flen > 0 else 255)
         if name in ("DOUBLE", "REAL", "FLOAT"):
             return st.new_double_field()
@@ -1542,6 +1926,10 @@ class Parser:
             return st.new_date_field()
         if name == "DATETIME":
             return st.new_datetime_field()
+        if name == "TIME":
+            return st.new_duration_field()
+        if name == "JSON":
+            return st.FieldType(TC.JSON)
         raise ParseError(f"unsupported cast type {name}", t)
 
     def _ident_primary(self) -> ast.ExprNode:
@@ -1562,6 +1950,19 @@ class Parser:
         if name == "EXTRACT":
             # EXTRACT(unit FROM e) desugars to the field functions
             return self._extract_expr()
+        if name in ("SUBSTRING", "SUBSTR", "MID"):
+            # SUBSTRING(s FROM pos [FOR len]) == SUBSTRING(s, pos[, len])
+            first = self.expr()
+            args = [first]
+            if self.try_kw("FROM"):
+                args.append(self.expr())
+                if self.try_kw("FOR"):
+                    args.append(self.expr())
+            else:
+                while self.try_op(","):
+                    args.append(self.expr())
+            self.expect_op(")")
+            return ast.FuncCall(name="SUBSTRING", args=args)
         if name == "GET_FORMAT":
             # first argument is a bare DATE/TIME/DATETIME/TIMESTAMP word
             ut = self.next()

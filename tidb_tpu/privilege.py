@@ -44,7 +44,9 @@ PRIV_BY_NAME = {"SELECT": Priv.SELECT, "INSERT": Priv.INSERT,
                 "UPDATE": Priv.UPDATE, "DELETE": Priv.DELETE,
                 "CREATE": Priv.CREATE, "DROP": Priv.DROP,
                 "ALTER": Priv.ALTER, "INDEX": Priv.INDEX,
-                "SUPER": Priv.SUPER, "ALL": ALL_PRIVS}
+                "SUPER": Priv.SUPER, "GRANT": Priv.GRANT,
+                "CREATE USER": Priv.CREATE_USER,
+                "ALL": ALL_PRIVS}
 
 
 def encode_password(password: str) -> str:

@@ -360,9 +360,10 @@ class Session:
         # auth statements never expose credentials in the processlist or
         # the slow log (the reference redacts before logging) — the WHOLE
         # batch text is redacted if any statement in it carries one
-        if any(isinstance(s, ast.CreateUserStmt) for s in stmts):
-            sql = "<redacted: batch containing CREATE USER>" \
-                if len(stmts) > 1 else "<redacted: CREATE USER>"
+        if any(isinstance(s, (ast.CreateUserStmt, ast.SetPasswordStmt))
+               for s in stmts):
+            sql = "<redacted: batch containing credentials>" \
+                if len(stmts) > 1 else "<redacted: credential statement>"
         for stmt in stmts:
             out.append(self._timed_stmt(stmt, sql, sql_text=single))
         return out
@@ -618,7 +619,8 @@ class Session:
         t = type(stmt).__name__
         self._check_privileges(stmt)
         if isinstance(stmt, (ast.CreateUserStmt, ast.DropUserStmt,
-                             ast.GrantStmt, ast.RevokeStmt)):
+                             ast.GrantStmt, ast.RevokeStmt,
+                             ast.SetPasswordStmt)):
             return self._exec_account(stmt)
         if isinstance(stmt, (ast.SelectStmt, ast.UnionStmt)):
             stmt, folded = self._fold_session_exprs(stmt)
@@ -662,6 +664,16 @@ class Session:
                 self.domain.priv_cache().invalidate()
             elif stmt.tp not in ("status", "tables"):
                 raise SQLError(f"unsupported FLUSH {stmt.tp}")
+            return None
+        if isinstance(stmt, ast.DropViewStmt):
+            if not stmt.if_exists:
+                names = ", ".join(t.name for t in stmt.tables)
+                raise SQLError(f"Unknown view '{names}'")
+            return None     # IF EXISTS: nothing to drop, by construction
+        if isinstance(stmt, ast.DropStatsStmt):
+            db = stmt.table.db or self.current_db
+            info = self.domain.info_schema().table(db, stmt.table.name)
+            self.domain.stats_handle().drop(info.id)
             return None
         if isinstance(stmt, (ast.CreateDatabaseStmt, ast.CreateTableStmt,
                              ast.CreateIndexStmt, ast.DropTableStmt,
@@ -748,6 +760,38 @@ class Session:
             return ResultSet(["JOB_ID", "JOB_TYPE", "SCHEMA_ID",
                               "TABLE_ID", "STATE", "SCHEMA_STATE",
                               "SOURCE"], rows)
+        if stmt.tp == "cancel_ddl_jobs":
+            # flip still-QUEUEING jobs to CANCELLED in the meta queue
+            # (ref: admin.CancelJobs — running jobs can't be cancelled
+            # here; the single transition already commits atomically)
+            from tidb_tpu.ddl.job import Job, JobState
+            rows = []
+            txn = self.storage.begin()
+            try:
+                m = Meta(txn)
+                items = list(m.t.litems(Meta.JOB_LIST_KEY))
+                for jid in stmt.job_ids:
+                    found = False
+                    for pos, raw in enumerate(items):
+                        j = Job.loads(raw)
+                        if j.id != jid:
+                            continue
+                        found = True
+                        if j.state == JobState.QUEUEING:
+                            j.state = JobState.CANCELLED
+                            m.t.lset(Meta.JOB_LIST_KEY, pos, j.dumps())
+                            rows.append((jid, "cancelled"))
+                        else:
+                            rows.append((jid, f"cannot cancel: "
+                                              f"{j.state.value}"))
+                        break
+                    if not found:
+                        rows.append((jid, "not found"))
+                txn.commit()
+            except Exception:
+                txn.rollback()
+                raise
+            return ResultSet(["JOB_ID", "RESULT"], rows)
         if stmt.tp != "check_table":
             return ResultSet(columns=["info"], rows=[])
         from tidb_tpu import codec as _codec
@@ -825,8 +869,22 @@ class Session:
         if isinstance(stmt, (ast.CreateUserStmt, ast.DropUserStmt)):
             need("", "", Priv.CREATE_USER, "CREATE USER")
             return
+        if isinstance(stmt, ast.SetPasswordStmt):
+            # changing ANOTHER user's password needs CREATE USER; your
+            # own needs nothing (MySQL semantics)
+            if stmt.user is not None and (
+                    stmt.user.user != (self.user or "") or
+                    stmt.user.host not in ("%", self.host or "%")):
+                need("", "", Priv.CREATE_USER, "SET PASSWORD")
+            return
         if isinstance(stmt, (ast.GrantStmt, ast.RevokeStmt)):
-            need("", "", Priv.GRANT, "GRANT")
+            # GRANT at the statement's own scope suffices (MySQL: you
+            # may grant onward anything you hold WITH GRANT OPTION at
+            # that scope; the hierarchy check handles global > db)
+            gdb = "" if stmt.db == "*" else \
+                (stmt.db or self.current_db or "").lower()
+            gtbl = "" if stmt.table == "*" else (stmt.table or "").lower()
+            need(gdb, gtbl, Priv.GRANT, "GRANT")
             return
         if isinstance(stmt, (ast.SelectStmt, ast.UnionStmt,
                              ast.AnalyzeStmt)):
@@ -913,7 +971,19 @@ class Session:
                                         encode_password)
         s = self._account_session()
         try:
-            if isinstance(stmt, ast.CreateUserStmt):
+            if isinstance(stmt, ast.SetPasswordStmt):
+                user = stmt.user.user if stmt.user else (self.user or "")
+                host = stmt.user.host if stmt.user else "%"
+                if not s.query("SELECT user FROM mysql.user WHERE user ="
+                               f" '{_q(user)}' AND host = '{_q(host)}'"
+                               ).rows:
+                    raise SQLError(
+                        f"user '{user}'@'{host}' does not exist")
+                auth = encode_password(stmt.password)
+                s.execute("UPDATE mysql.user SET authentication_string ="
+                          f" '{auth}' WHERE user = '{_q(user)}' AND "
+                          f"host = '{_q(host)}'")
+            elif isinstance(stmt, ast.CreateUserStmt):
                 for u in stmt.users:
                     exists = s.query(
                         "SELECT user FROM mysql.user WHERE user = "
@@ -1391,6 +1461,92 @@ class Session:
         finally:
             s.close()
 
+    @staticmethod
+    def _filter_show_rows(rs: "ResultSet", where) -> "ResultSet":
+        """Minimal SHOW ... WHERE evaluator: `col = literal` conjuncts
+        over the result columns (the shape the reference's SHOW WHERE
+        sees in practice)."""
+        conds = []
+
+        def walk(e):
+            if isinstance(e, ast.BinaryOp) and e.op.upper() == "AND":
+                walk(e.left)
+                walk(e.right)
+                return
+            if isinstance(e, ast.BinaryOp) and e.op == "=" and \
+                    isinstance(e.left, ast.ColName) and \
+                    isinstance(e.right, ast.Literal):
+                conds.append((e.left.name.lower(), e.right.value))
+                return
+            raise SQLError("unsupported SHOW ... WHERE (use col = "
+                           "literal [AND ...])")
+
+        walk(where)
+        lower = [c.lower() for c in rs.columns]
+        idx = []
+        for name, val in conds:
+            if name not in lower:
+                raise SQLError(f"unknown column '{name}' in SHOW WHERE")
+            idx.append((lower.index(name), val))
+        rows = [r for r in rs.rows
+                if all(str(r[i]) == str(v) for i, v in idx)]
+        return ResultSet(rs.columns, rows)
+
+    def _show_stats(self, stmt: ast.ShowStmt) -> ResultSet:
+        """SHOW STATS_META / STATS_HISTOGRAMS / STATS_BUCKETS (ref: the
+        reference's statistics memtables surfaced through SHOW). WHERE
+        filters on the text columns apply post-projection."""
+        import datetime as _dt2
+        handle = self.domain.stats_handle()
+        is_ = self.domain.info_schema()
+        meta_rows, hist_rows, bucket_rows = [], [], []
+        for dbn in is_.db_names():
+            if dbn.lower() in ("mysql",):
+                continue
+            for tn in is_.table_names(dbn):
+                info = is_.table(dbn, tn)
+                ts = handle.get(info.id)
+                if ts.pseudo:
+                    continue
+                # stats version is a hybrid TSO ts: physical ms << 18
+                upd = _dt2.datetime.fromtimestamp(
+                    (ts.version >> 18) / 1e3).strftime(
+                    "%Y-%m-%d %H:%M:%S") if ts.version else ""
+                meta_rows.append((dbn, tn, upd, ts.modify_count,
+                                  ts.count))
+                for cid, cs in ts.columns.items():
+                    col = next((c for c in info.columns if c.id == cid),
+                               None)
+                    h = getattr(cs, "hist", None) or getattr(
+                        cs, "histogram", None)
+                    if col is None:
+                        continue
+                    ndv = getattr(h, "ndv", 0) if h else 0
+                    nulls = getattr(h, "null_count", 0) if h else 0
+                    hist_rows.append((dbn, tn, col.name, 0, upd, ndv,
+                                      nulls))
+                    if h:
+                        for bi in range(len(h.uppers)):
+                            cnt = h.counts[bi] - (h.counts[bi - 1]
+                                                  if bi else 0)
+                            bucket_rows.append(
+                                (dbn, tn, col.name, 0, bi, cnt,
+                                 str(h.lowers[bi]), str(h.uppers[bi])))
+        if stmt.tp == "stats_meta":
+            rs = ResultSet(["Db_name", "Table_name", "Update_time",
+                            "Modify_count", "Row_count"], meta_rows)
+        elif stmt.tp == "stats_histograms":
+            rs = ResultSet(["Db_name", "Table_name", "Column_name",
+                            "Is_index", "Update_time", "Distinct_count",
+                            "Null_count"], hist_rows)
+        else:
+            rs = ResultSet(["Db_name", "Table_name", "Column_name",
+                            "Is_index", "Bucket_id", "Count",
+                            "Lower_Bound", "Upper_Bound"], bucket_rows)
+        if stmt.where is not None:
+            rs = self._filter_show_rows(rs, stmt.where)
+        return rs
+
     def _exec_show(self, stmt: ast.ShowStmt) -> ResultSet:
         ischema = self.domain.info_schema()
         if stmt.tp == "databases":
@@ -1496,6 +1652,15 @@ class Session:
                  ("utf8mb4_general_ci", "utf8mb4", ""),
                  ("utf8_bin", "utf8", ""),
                  ("utf8_general_ci", "utf8", "")])
+        if stmt.tp == "charset":
+            return ResultSet(
+                ["Charset", "Description", "Default collation",
+                 "Maxlen"],
+                [("utf8mb4", "UTF-8 Unicode", "utf8mb4_bin", 4),
+                 ("utf8", "UTF-8 Unicode", "utf8_bin", 3),
+                 ("binary", "Binary pseudo charset", "binary", 1)])
+        if stmt.tp in ("stats_meta", "stats_histograms", "stats_buckets"):
+            return self._show_stats(stmt)
         if stmt.tp == "grants":
             target = stmt.pattern or (self.user or "")
             user, _, host = target.partition("@")
