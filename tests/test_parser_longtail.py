@@ -203,3 +203,48 @@ class TestEndToEnd:
         from tidb_tpu.session import SQLError
         with pytest.raises(SQLError):
             sess.execute("DROP VIEW nothing")
+
+
+class TestMultiTableDelete:
+    @pytest.fixture
+    def sess(self):
+        from tidb_tpu.session import Session
+        from tidb_tpu.store.storage import new_mock_storage
+        s = Session(new_mock_storage())
+        s.execute("CREATE DATABASE md; USE md")
+        s.execute("CREATE TABLE t1 (id BIGINT PRIMARY KEY, v BIGINT)")
+        s.execute("CREATE TABLE t2 (id BIGINT PRIMARY KEY, v BIGINT)")
+        s.execute("CREATE TABLE t3 (id BIGINT PRIMARY KEY)")
+        s.execute("INSERT INTO t1 VALUES (1, 10), (2, 20), (3, 30)")
+        s.execute("INSERT INTO t2 VALUES (1, 1), (3, 3), (4, 4)")
+        s.execute("INSERT INTO t3 VALUES (1), (3)")
+        yield s
+        s.close()
+
+    def test_delete_from_two_targets(self, sess):
+        sess.execute("DELETE t1, t2 FROM t1 INNER JOIN t2 "
+                     "ON t1.id = t2.id WHERE t1.id > 0")
+        # matched ids 1 and 3 deleted from both; unmatched stay
+        assert sess.query("SELECT id FROM t1 ORDER BY id").rows == [(2,)]
+        assert sess.query("SELECT id FROM t2 ORDER BY id").rows == [(4,)]
+
+    def test_using_form_with_extra_table(self, sess):
+        sess.execute("DELETE FROM t1 USING t1 INNER JOIN t3 "
+                     "ON t1.id = t3.id")
+        assert sess.query("SELECT id FROM t1 ORDER BY id").rows == [(2,)]
+        # t3 was only a filter source, untouched
+        assert sess.query("SELECT COUNT(*) FROM t3").rows == [(2,)]
+
+    def test_indexes_maintained(self, sess):
+        sess.execute("CREATE INDEX iv ON t1 (v)")
+        sess.execute("DELETE t1 FROM t1 INNER JOIN t2 ON t1.id = t2.id")
+        assert sess.query("SELECT id FROM t1 WHERE v = 10").rows == []
+        assert sess.query("SELECT id FROM t1 WHERE v = 20").rows == [(2,)]
+
+    def test_rollback(self, sess):
+        sess.execute("BEGIN")
+        sess.execute("DELETE t1, t2 FROM t1 INNER JOIN t2 "
+                     "ON t1.id = t2.id")
+        sess.execute("ROLLBACK")
+        assert sess.query("SELECT COUNT(*) FROM t1").rows == [(3,)]
+        assert sess.query("SELECT COUNT(*) FROM t2").rows == [(3,)]

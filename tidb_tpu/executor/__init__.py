@@ -1411,6 +1411,41 @@ class DeleteExec(Executor):
         return affected
 
 
+class MultiDeleteExec(Executor):
+    """DELETE t1, t2 FROM <join> (ref: executor/write.go:194
+    deleteMultiTables): one pass over the join result; each target
+    deletes its matched rows, deduped per handle (a handle can match
+    several join rows)."""
+
+    def __init__(self, plan: ph.PhysMultiDelete):
+        self.plan = plan
+        self.reader = build_executor(plan.reader)
+
+    def execute(self, ctx: ExecContext) -> int:
+        per_target = []
+        for info, col_start, handle_idx in self.plan.targets:
+            per_target.append((Table(info, ctx.storage), info,
+                               col_start, handle_idx, set()))
+        affected = 0
+        for chunk in self.reader.chunks(ctx):
+            for tbl, info, col_start, handle_idx, seen in per_target:
+                hcol = chunk.columns[handle_idx]
+                cols = info.public_columns()
+                block = Chunk(chunk.columns[col_start:
+                                            col_start + len(cols)])
+                for i in range(chunk.num_rows):
+                    if not hcol.valid[i]:
+                        continue    # outer-join padding: no row there
+                    handle = int(hcol.data[i])
+                    if handle in seen:
+                        continue
+                    seen.add(handle)
+                    old = _chunk_row_to_kvdatums(block, cols, i)
+                    tbl.remove_record(ctx.txn, handle, old)
+                    affected += 1
+        return affected
+
+
 class ApplyExec(Executor):
     """Correlated-subquery apply (ref: executor/join.go:447
     NestedLoopApplyExec): per outer row, bind the correlated cells, run
@@ -1777,4 +1812,5 @@ _BUILDERS = {
     ph.PhysInsert: InsertExec,
     ph.PhysUpdate: UpdateExec,
     ph.PhysDelete: DeleteExec,
+    ph.PhysMultiDelete: MultiDeleteExec,
 }

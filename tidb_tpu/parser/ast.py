@@ -299,6 +299,10 @@ class DeleteStmt(StmtNode):
     where: Optional[ExprNode] = None
     order_by: list = field(default_factory=list)
     limit: Optional[int] = None
+    # multi-table form (ref: ast/dml.go DeleteStmt.IsMultiTable):
+    # DELETE t1, t2 FROM <refs> / DELETE FROM t1, t2 USING <refs>
+    targets: list = field(default_factory=list)   # [TableSource]
+    refs: Optional[Node] = None                   # join tree
 
 
 # ---------------------------------------------------------------------------
@@ -544,6 +548,17 @@ class UserSpec:
 class CreateUserStmt(StmtNode):
     users: list = field(default_factory=list)      # [UserSpec]
     if_not_exists: bool = False
+
+
+@dataclass
+class CreateViewStmt(StmtNode):
+    """Parsed for parity with ast/ddl.go CreateViewStmt; execution
+    rejects it (the reference's planner does too: no view support)."""
+
+    view: TableSource = None
+    columns: list = field(default_factory=list)
+    select: Optional[SelectStmt] = None
+    or_replace: bool = False
 
 
 @dataclass

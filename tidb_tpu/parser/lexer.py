@@ -72,6 +72,8 @@ NON_RESERVED = {
     "BINARY", "CHARACTER", "FULLTEXT", "TRANSACTION", "PASSWORD",
     "TABLES", "STATS", "NO_WRITE_TO_BINLOG", "SHARE", "MODE",
     "DISTINCTROW", "CHARSET", "LOCK", "VIEW", "JOBS", "CANCEL",
+    "REPLACE", "ALGORITHM", "DEFINER", "SQL", "SECURITY", "CASCADED",
+    "OPTION", "STRAIGHT_JOIN", "USING",
 }
 
 

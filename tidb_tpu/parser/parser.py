@@ -699,8 +699,33 @@ class Parser:
 
     def delete(self) -> ast.DeleteStmt:
         self.expect_kw("DELETE")
+        if not self.peek().is_kw("FROM"):
+            # DELETE t1, t2 FROM <refs> ...
+            targets = [self.table_name()]
+            while self.try_op(","):
+                targets.append(self.table_name())
+            self.expect_kw("FROM")
+            refs = self.table_refs()
+            stmt = ast.DeleteStmt(targets=targets, refs=refs)
+            if self.try_kw("WHERE"):
+                stmt.where = self.expr()
+            return stmt
         self.expect_kw("FROM")
-        stmt = ast.DeleteStmt(table=self.table_name())
+        first = self.table_name()
+        if self.try_op(",") or self.peek_word() == "USING":
+            # DELETE FROM t1[, t2] USING <refs> ...
+            targets = [first]
+            while self.peek().tp == TokenType.IDENT:
+                targets.append(self.table_name())
+                if not self.try_op(","):
+                    break
+            self.expect_word("USING")
+            refs = self.table_refs()
+            stmt = ast.DeleteStmt(targets=targets, refs=refs)
+            if self.try_kw("WHERE"):
+                stmt.where = self.expr()
+            return stmt
+        stmt = ast.DeleteStmt(table=first)
         if self.try_kw("WHERE"):
             stmt.where = self.expr()
         if self.try_kw("ORDER"):
@@ -724,6 +749,41 @@ class Parser:
             ine = self._if_not_exists()
             return ast.CreateDatabaseStmt(name=self.ident(),
                                           if_not_exists=ine)
+        # CREATE [OR REPLACE] [ALGORITHM=...] [DEFINER=...]
+        # [SQL SECURITY ...] VIEW v [(cols)] AS select ... — parsed to
+        # the AST like the reference (ast/ddl.go CreateViewStmt), and
+        # like the reference's planner, EXECUTION rejects it loudly
+        # (views are unimplemented there too)
+        save = self.i
+        or_replace = False
+        if self.try_kw("OR"):
+            if not self.try_word("REPLACE") and not self.try_kw("REPLACE"):
+                self.i = save
+            else:
+                or_replace = True
+        while self.peek_word() in ("ALGORITHM", "DEFINER", "SQL"):
+            w = self.next().val.upper()
+            if w == "SQL":
+                self.expect_word("SECURITY")
+                self.next()                 # DEFINER | INVOKER
+            else:
+                self.try_op("=")
+                self.next()                 # undefined/merge/'root'/...
+        if self.try_word("VIEW"):
+            name = self.table_name()
+            cols = []
+            if self.peek().tp == TokenType.OP and self.peek().val == "(":
+                cols = self._paren_idents()
+            self.expect_kw("AS")
+            sel = self.select_or_union()
+            if self.try_kw("WITH"):
+                self.try_word("LOCAL") or self.try_word("CASCADED")
+                self.expect_kw("CHECK")
+                self.expect_word("OPTION")
+            return ast.CreateViewStmt(view=name, columns=cols,
+                                      select=sel, or_replace=or_replace)
+        if or_replace or self.i != save:
+            raise ParseError("expected VIEW", self.peek())
         unique = self.try_kw("UNIQUE")
         if self.try_kw("INDEX"):
             name = self.ident()

@@ -21,7 +21,7 @@ __all__ = ["CopPlan", "PhysPlan", "PhysTableReader", "PhysIndexReader",
            "PhysProjection", "PhysHashAgg", "PhysFinalAgg", "PhysStreamAgg",
            "PhysHashJoin", "PhysMergeJoin", "PhysIndexJoin",
            "PhysApply", "PhysSort", "PhysLimit", "PhysTopN", "PhysInsert",
-           "PhysUpdate", "PhysDelete", "PhysValues"]
+           "PhysUpdate", "PhysDelete", "PhysMultiDelete", "PhysValues"]
 
 
 @dataclass
@@ -343,4 +343,14 @@ class PhysUpdate(PhysPlan):
 @dataclass
 class PhysDelete(PhysPlan):
     table: TableInfo = None
+    reader: PhysPlan = None
+
+
+@dataclass
+class PhysMultiDelete(PhysPlan):
+    """DELETE t1, t2 FROM <join> (ref: executor/write.go:194
+    deleteMultiTables). Per target: (TableInfo, col_start, handle_idx)
+    locating its column block + handle inside the join output."""
+
+    targets: list = field(default_factory=list)
     reader: PhysPlan = None

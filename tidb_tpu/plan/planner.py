@@ -106,6 +106,7 @@ class Planner:
         self.stats = stats_handle
         self.ischema = infoschema
         self.db = current_db
+        self._handle_refs: set = set()   # multi-table DELETE targets
 
     def _tbl_stats(self, info):
         """TableStats for the table — pseudo when never analyzed."""
@@ -176,11 +177,18 @@ class Planner:
             return self._build_perfschema(ts)
         _db, info = self._table_info(ts)
         cols = info.public_columns()
-        schema = PlanSchema([
+        schema_cols = [
             SchemaCol(c.name.lower(), ts.ref_name.lower(), c.ft, c.id)
-            for c in cols])
-        cop = ph.CopPlan(table=info, cols=list(cols))
-        return ph.PhysTableReader(schema=schema, cop=cop)
+            for c in cols]
+        handle_col = None
+        if ts.ref_name.lower() in getattr(self, "_handle_refs", ()):
+            # multi-table DELETE target: the row handle rides the join
+            schema_cols.append(SchemaCol("_handle", ts.ref_name.lower(),
+                                         st.new_int_field()))
+            handle_col = len(cols)
+        cop = ph.CopPlan(table=info, cols=list(cols),
+                         handle_col=handle_col)
+        return ph.PhysTableReader(schema=PlanSchema(schema_cols), cop=cop)
 
     # -- INFORMATION_SCHEMA virtual tables (ref: infoschema/tables.go) -------
 
@@ -1796,11 +1804,66 @@ class Planner:
                 self._fold_default(a.expr, info, a.col.name))))
         return ph.PhysUpdate(table=info, reader=reader, assignments=assigns)
 
-    def plan_delete(self, stmt: ast.DeleteStmt) -> ph.PhysDelete:
+    def plan_delete(self, stmt: ast.DeleteStmt):
+        if stmt.targets:
+            return self.plan_multi_delete(stmt)
         info, reader = self._plan_writable_reader(stmt.table, stmt.where)
         reader = self._order_limit_reader(reader, stmt.order_by,
                                           stmt.limit)
         return ph.PhysDelete(table=info, reader=reader)
+
+    def plan_multi_delete(self, stmt: ast.DeleteStmt) -> ph.PhysMultiDelete:
+        """DELETE t1, t2 FROM <join> ... (ref: executor/write.go
+        deleteMultiTables + ast/dml.go IsMultiTable): target tables'
+        readers carry their row handle through the join; each matched
+        row deletes from every target (deduped per handle)."""
+        # collect the referenced table sources by ref name
+        sources: dict[str, ast.TableSource] = {}
+
+        def walk(node):
+            if isinstance(node, ast.TableSource):
+                sources[node.ref_name.lower()] = node
+            elif isinstance(node, ast.Join):
+                walk(node.left)
+                walk(node.right)
+            elif node is not None:
+                raise PlanError(
+                    "multi-table DELETE supports plain table joins")
+        walk(stmt.refs)
+
+        want: list[tuple[str, ast.TableSource]] = []
+        for tgt in stmt.targets:
+            key = tgt.ref_name.lower()
+            if key not in sources:
+                raise PlanError(f"Unknown table '{tgt.name}' in "
+                                "MULTI DELETE")
+            want.append((key, sources[key]))
+
+        self._handle_refs = {k for k, _ in want}
+        try:
+            plan = self.build_from(stmt.refs)
+            if stmt.where is not None:
+                r = Resolver(plan.schema)
+                for c_ast in split_conjuncts(stmt.where):
+                    plan = self._assign_cond(plan, r.resolve(c_ast), True)
+        finally:
+            self._handle_refs = set()
+
+        targets = []
+        for key, ts in want:
+            _db, info = self._table_info(ts)
+            handle_idx = col_start = None
+            for i, sc in enumerate(plan.schema.cols):
+                if sc.table != key:
+                    continue
+                if col_start is None:
+                    col_start = i
+                if sc.name == "_handle":
+                    handle_idx = i
+            if handle_idx is None:
+                raise PlanError(f"no handle for target '{ts.name}'")
+            targets.append((info, col_start, handle_idx))
+        return ph.PhysMultiDelete(targets=targets, reader=plan)
 
 
 def _type_word(ft) -> str:

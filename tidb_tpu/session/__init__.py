@@ -665,6 +665,8 @@ class Session:
             elif stmt.tp not in ("status", "tables"):
                 raise SQLError(f"unsupported FLUSH {stmt.tp}")
             return None
+        if isinstance(stmt, ast.CreateViewStmt):
+            raise SQLError("CREATE VIEW is not supported")
         if isinstance(stmt, ast.DropViewStmt):
             if not stmt.if_exists:
                 names = ", ".join(t.name for t in stmt.tables)
@@ -1169,6 +1171,9 @@ class Session:
                              _ph.PhysDelete)):
             # schema validation scope: tables this txn WRITES
             self.txn.related_tables.add(plan.table.id)
+        elif isinstance(plan, _ph.PhysMultiDelete):
+            for info, _cs, _hi in plan.targets:
+                self.txn.related_tables.add(info.id)
         ctx = ExecContext(self.storage, self.txn.start_ts, self.txn,
                           interrupted=lambda: self.killed)
         exe = build_executor(plan)
