@@ -176,3 +176,21 @@ class TestJoinsAndMeta:
         assert s2.query("SELECT COUNT(*) FROM rt WHERE s = 'z'"
                         ).rows == [(1,)]
         s2.close()
+
+
+class TestShowCreateRoundTrip:
+    def test_show_create_table_round_trips(self, sess):
+        sess.execute("CREATE TABLE rt2 (id BIGINT PRIMARY KEY "
+                     "AUTO_INCREMENT, s VARCHAR(20) COLLATE "
+                     "utf8mb4_general_ci, b VARCHAR(8) NOT NULL)")
+        sess.execute("CREATE INDEX isx ON rt2 (s)")
+        ddl = sess.query("SHOW CREATE TABLE rt2").rows[0][1]
+        assert "COLLATE utf8mb4_general_ci" in ddl
+        assert "AUTO_INCREMENT" in ddl and "NOT NULL" in ddl
+        assert "PRIMARY KEY" in ddl and "KEY `isx`" in ddl
+        # the emitted DDL re-executes and preserves ci semantics
+        sess.execute("CREATE DATABASE rt2db; USE rt2db")
+        sess.execute(ddl.replace("`rt2`", "`clone`", 1))
+        sess.execute("INSERT INTO clone (id, s, b) VALUES (1, 'Q', 'x')")
+        assert sess.query("SELECT COUNT(*) FROM clone WHERE s = 'q'"
+                          ).rows == [(1,)]

@@ -248,3 +248,70 @@ class TestMultiTableDelete:
         sess.execute("ROLLBACK")
         assert sess.query("SELECT COUNT(*) FROM t1").rows == [(3,)]
         assert sess.query("SELECT COUNT(*) FROM t2").rows == [(3,)]
+
+
+class TestReviewRegressions:
+    @pytest.fixture
+    def sess(self):
+        from tidb_tpu.session import Session
+        from tidb_tpu.store.storage import new_mock_storage
+        s = Session(new_mock_storage())
+        s.execute("CREATE DATABASE rr; USE rr")
+        yield s
+        s.close()
+
+    def test_change_column_first_reorders(self, sess):
+        sess.execute("CREATE TABLE c (a BIGINT PRIMARY KEY, b BIGINT)")
+        sess.execute("INSERT INTO c VALUES (1, 2)")
+        sess.execute("ALTER TABLE c CHANGE COLUMN b b2 BIGINT FIRST")
+        rows = sess.query("SELECT * FROM c").rows
+        assert rows == [(2, 1)]          # b2 now leads
+        cols = [r[0] for r in sess.query("SHOW COLUMNS FROM c").rows]
+        assert cols[0] == "b2"
+
+    def test_multi_delete_needs_privs(self):
+        from tidb_tpu.bootstrap import bootstrap
+        from tidb_tpu.session import Session, SQLError
+        from tidb_tpu.store.storage import new_mock_storage
+        st = new_mock_storage()
+        bootstrap(st)
+        r = Session(st, user="root", host="%")
+        r.execute("CREATE DATABASE pd2; USE pd2")
+        r.execute("CREATE TABLE t1 (id BIGINT PRIMARY KEY)")
+        r.execute("CREATE TABLE t2 (id BIGINT PRIMARY KEY)")
+        r.execute("INSERT INTO t1 VALUES (1)")
+        r.execute("INSERT INTO t2 VALUES (1)")
+        r.execute("CREATE USER w")
+        r.execute("GRANT DELETE ON pd2.t1 TO w")
+        s = Session(st, user="w", host="localhost")
+        s.execute("USE pd2")
+        # DELETE priv on t1 but no SELECT on t2: the join read is denied
+        with pytest.raises(SQLError, match="SELECT"):
+            s.execute("DELETE t1 FROM t1 INNER JOIN t2 "
+                      "ON t1.id = t2.id")
+        r.execute("GRANT SELECT ON pd2.t1 TO w")
+        r.execute("GRANT SELECT ON pd2.t2 TO w")
+        s.execute("DELETE t1 FROM t1 INNER JOIN t2 ON t1.id = t2.id")
+        s.close()
+        assert r.query("SELECT COUNT(*) FROM t1").rows == [(0,)]
+        r.close()
+
+    def test_set_own_password_matches_host_pattern(self):
+        from tidb_tpu.bootstrap import bootstrap
+        from tidb_tpu.privilege import encode_password
+        from tidb_tpu.session import Session, SQLError
+        from tidb_tpu.store.storage import new_mock_storage
+        st = new_mock_storage()
+        bootstrap(st)
+        r = Session(st, user="root", host="%")
+        r.execute("CREATE USER 'u'@'localhost'")
+        s = Session(st, user="u", host="localhost")
+        s.execute("SET PASSWORD = 'mine'")      # no FOR: own account
+        assert r.query("SELECT authentication_string FROM mysql.user "
+                       "WHERE user = 'u'").rows == \
+            [(encode_password("mine"),)]
+        # FOR any account needs CREATE USER
+        with pytest.raises(SQLError):
+            s.execute("SET PASSWORD FOR 'root'@'%' = 'x'")
+        s.close()
+        r.close()

@@ -109,8 +109,9 @@ def run_jobs(session, jobs: str, table: str = "benchdb",
     db = _BenchDB(session, table, batch, blob)
     out = []
     for work in jobs.split("|"):
-        work = work.strip().lower()
+        work = work.strip()
         name, _, spec = work.partition(":")
+        name = name.lower()      # job names only: query: SQL keeps case
         fn = _JOBS.get(name)
         if fn is None:
             raise ValueError(f"unknown job {name!r}")

@@ -368,10 +368,15 @@ class DDL:
                              default=default,
                              has_default=cd.has_default or
                              not cd.ft.not_null)
+            if spec.position == "after" and \
+                    t.col_by_name(spec.after_col) is None:
+                raise DDLError(f"Unknown column '{spec.after_col}'")
             return Job(tp=JobType.MODIFY_COLUMN, schema_id=db.id,
                        table_id=t.id,
                        args={"old_name": old_name,
-                             "column": new.to_json()})
+                             "column": new.to_json(),
+                             "position": spec.position,
+                             "after_col": spec.after_col})
         if spec.tp in ("set_default", "drop_default"):
             old = t.col_by_name(spec.name)
             if old is None:

@@ -336,6 +336,10 @@ class DDLWorker:
         col.ft = new.ft
         col.default = new.default        # SET/DROP DEFAULT ride this job
         col.has_default = new.has_default
+        # CHANGE ... FIRST/AFTER x: order is metadata only (rows store
+        # col-id -> value pairs)
+        self._position_column(info, col, job.args.get("position"),
+                              job.args.get("after_col"))
         m.update_table(job.schema_id, info)
         job.state = JobState.DONE
         return True

@@ -224,12 +224,19 @@ class _SegBatch:
         return len(self._reqs) - 1
 
     def run(self) -> None:
+        from tidb_tpu.ops import pallas_agg
         groups: dict = {}
         for i, (op, x) in enumerate(self._reqs):
             groups.setdefault((op, x.dtype), []).append(i)
         out: list = [None] * len(self._reqs)
         for (op, _dt), idxs in groups.items():
-            fn = _SEG_FNS[op]
+            if op == "sum":
+                # MXU one-hot matmul on TPU float lanes; XLA scatter
+                # elsewhere (pallas_agg dispatches)
+                def fn(x, ids, num_segments):
+                    return pallas_agg.segment_sum(x, ids, num_segments)
+            else:
+                fn = _SEG_FNS[op]
             if len(idxs) == 1:
                 i = idxs[0]
                 out[i] = fn(self._reqs[i][1], self.inv,

@@ -1,0 +1,121 @@
+"""Pallas TPU kernel: group-by segment-sum as a one-hot MXU matmul.
+
+The group-by aggregation's inner op is segment-reduce: rows scatter
+into C group slots. XLA lowers `jax.ops.segment_sum` to scatter-add,
+which serializes on the TPU's vector unit; the MXU-native formulation
+is a ONE-HOT MATMUL per row tile:
+
+    onehot[T, C] = (ids[:, None] == iota(C)[None, :])
+    out[C, K]   += onehot.T @ values[T, K]
+
+— a [C, T] x [T, K] contraction the 128x128 systolic array eats whole
+(pallas_guide.md "matmuls are where the FLOPs are"). The kernel tiles
+rows over a sequential grid and accumulates into a VMEM-resident [C, K]
+output block (constant index map — the standard revisiting/accumulate
+pattern), so HBM traffic is one pass over the rows plus one [C, K]
+writeback.
+
+Engagement rules (auto, see `available()`):
+  * TPU backend only — on CPU the scatter path is faster (measured);
+  * float32 value lanes (the MXU contraction dtype); int64-exact lanes
+    (decimal sums, counts) stay on the scatter path, exactness first;
+  * C <= 4096 so the accumulator tile stays well inside VMEM.
+
+Correctness is validated in interpret mode on CPU (tests/
+test_pallas_agg.py) — the same kernel runs compiled on a real chip.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PALLAS = True
+except Exception:  # noqa: BLE001 - pallas not in this jax build
+    _HAS_PALLAS = False
+
+__all__ = ["available", "segment_sum_pallas"]
+
+_TILE = 512          # rows per grid step
+_MAX_C = 4096
+
+
+def available(platform: str | None = None) -> bool:
+    """True when the pallas path should engage (TPU + pallas present).
+    TIDB_TPU_PALLAS=0 is the kill switch if a chip runtime ever rejects
+    the kernel (e.g. inside an exotic shard_map nesting)."""
+    import os
+    if not _HAS_PALLAS or os.environ.get("TIDB_TPU_PALLAS", "1") == "0":
+        return False
+    if platform is None:
+        try:
+            platform = jax.devices()[0].platform
+        except Exception:  # noqa: BLE001 - no backend
+            return False
+    return platform == "tpu"
+
+
+def _kernel(ids_ref, vals_ref, out_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    ids = ids_ref[:]                       # [T, 1] int32
+    c = out_ref.shape[0]
+    onehot = (ids == jax.lax.broadcasted_iota(
+        jnp.int32, (ids.shape[0], c), 1)).astype(vals_ref.dtype)
+    # [C, T] x [T, K] on the MXU; accumulate across the sequential grid
+    out_ref[:] += jax.lax.dot_general(
+        onehot, vals_ref[:], (((0,), (0,)), ((), ())),
+        preferred_element_type=out_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_segments", "interpret"))
+def segment_sum_pallas(values, ids, num_segments: int,
+                       interpret: bool = False):
+    """MXU segment-sum: values [n, k] float32, ids [n] int32 in
+    [0, num_segments) -> [num_segments, k]. Rows are padded to the tile
+    size with a dead segment that is sliced off."""
+    if values.ndim == 1:
+        values = values[:, None]
+    n, k = values.shape
+    c_pad = num_segments + 1               # dead slot for padding rows
+    pad = (-n) % _TILE
+    if pad:
+        values = jnp.concatenate(
+            [values, jnp.zeros((pad, k), values.dtype)])
+        ids = jnp.concatenate(
+            [ids, jnp.full((pad,), num_segments, jnp.int32)])
+    ids2 = ids.astype(jnp.int32)[:, None]
+    grid = (values.shape[0] // _TILE,)
+    out = pl.pallas_call(
+        _kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_TILE, 1), lambda i: (i, 0)),
+            pl.BlockSpec((_TILE, k), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((c_pad, k), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((c_pad, k), values.dtype),
+        interpret=interpret,
+    )(ids2, values)
+    return out[:num_segments]
+
+
+def segment_sum(values, ids, num_segments: int):
+    """Dispatcher: pallas on TPU float lanes within capacity, XLA
+    scatter otherwise (exactness for int lanes, speed on CPU)."""
+    dt = jnp.asarray(values).dtype
+    if available() and dt == jnp.float32 and num_segments <= _MAX_C:
+        return segment_sum_pallas(values, ids, num_segments)
+    return jax.ops.segment_sum(values, ids, num_segments=num_segments)

@@ -872,11 +872,12 @@ class Session:
             need("", "", Priv.CREATE_USER, "CREATE USER")
             return
         if isinstance(stmt, ast.SetPasswordStmt):
-            # changing ANOTHER user's password needs CREATE USER; your
-            # own needs nothing (MySQL semantics)
-            if stmt.user is not None and (
-                    stmt.user.user != (self.user or "") or
-                    stmt.user.host not in ("%", self.host or "%")):
+            # SET PASSWORD without FOR changes the session's own matched
+            # account; ANY FOR form needs CREATE USER (stricter than
+            # MySQL's current_user() carve-out, never laxer: a
+            # same-username different-host account is a DIFFERENT
+            # account)
+            if stmt.user is not None:
                 need("", "", Priv.CREATE_USER, "SET PASSWORD")
             return
         if isinstance(stmt, (ast.GrantStmt, ast.RevokeStmt)):
@@ -905,6 +906,23 @@ class Session:
             # server-side file read: gated like MySQL's global FILE priv
             # (SUPER here) so table INSERT alone can't read server files
             need("", "", Priv.SUPER, "LOAD DATA INFILE (FILE)")
+        if isinstance(stmt, ast.DeleteStmt) and stmt.targets:
+            # multi-table DELETE: DELETE on every target, SELECT on
+            # every table read by the join
+            def _tdb(ts):
+                return ((ts.db or self.current_db) or "").lower()
+            for ts in stmt.targets:
+                need(_tdb(ts), ts.name.lower(), Priv.DELETE, "DELETE")
+
+            def walk_refs(node):
+                if isinstance(node, ast.TableSource):
+                    need(_tdb(node), node.name.lower(), Priv.SELECT,
+                         "SELECT")
+                elif isinstance(node, ast.Join):
+                    walk_refs(node.left)
+                    walk_refs(node.right)
+            walk_refs(stmt.refs)
+            return
         if isinstance(stmt, (ast.InsertStmt, ast.UpdateStmt,
                              ast.DeleteStmt, ast.LoadDataStmt)):
             want, what = {
@@ -974,8 +992,24 @@ class Session:
         s = self._account_session()
         try:
             if isinstance(stmt, ast.SetPasswordStmt):
-                user = stmt.user.user if stmt.user else (self.user or "")
-                host = stmt.user.host if stmt.user else "%"
+                if stmt.user is not None:
+                    user, host = stmt.user.user, stmt.user.host
+                else:
+                    # own account: the stored row whose host PATTERN
+                    # matched this session (like CURRENT_USER())
+                    from tidb_tpu.privilege import _host_match
+                    user = self.user or ""
+                    host = None
+                    for (h,) in s.query(
+                            "SELECT host FROM mysql.user WHERE user = "
+                            f"'{_q(user)}'").rows:
+                        if _host_match(h, self.host or ""):
+                            host = h
+                            break
+                    if host is None:
+                        raise SQLError(
+                            f"no account matches '{user}'@"
+                            f"'{self.host}'")
                 if not s.query("SELECT user FROM mysql.user WHERE user ="
                                f" '{_q(user)}' AND host = '{_q(host)}'"
                                ).rows:
@@ -1616,11 +1650,37 @@ class Session:
         if stmt.tp == "create_table":
             db = stmt.table.db or self.current_db
             t = ischema.table(db, stmt.table.name)
-            cols = ",\n  ".join(f"`{c.name}` {_type_name(c)}"
-                                for c in t.public_columns())
+
+            def col_sql(c):
+                out = f"`{c.name}` {_type_name(c)}"
+                if c.ft.is_ci:
+                    # non-default collation must round-trip dump/restore
+                    out += f" COLLATE {c.ft.collation}"
+                if c.ft.not_null:
+                    out += " NOT NULL"
+                if c.auto_increment:
+                    out += " AUTO_INCREMENT"
+                return out
+
+            parts = [col_sql(c) for c in t.public_columns()]
+            if t.pk_is_handle and t.pk_col_name:
+                parts.append(f"PRIMARY KEY (`{t.pk_col_name}`)")
+            from tidb_tpu.schema.model import SchemaState
+            for idx in t.indexes:
+                if idx.state != SchemaState.PUBLIC:
+                    continue
+                cols_s = ",".join(f"`{c}`" for c in idx.columns)
+                if idx.primary:
+                    parts.append(f"PRIMARY KEY ({cols_s})")
+                elif idx.unique:
+                    parts.append(
+                        f"UNIQUE KEY `{idx.name}` ({cols_s})")
+                else:
+                    parts.append(f"KEY `{idx.name}` ({cols_s})")
+            body = ",\n  ".join(parts)
             return ResultSet(["Table", "Create Table"],
                              [(t.name,
-                               f"CREATE TABLE `{t.name}` (\n  {cols}\n)")])
+                               f"CREATE TABLE `{t.name}` (\n  {body}\n)")])
         if stmt.tp == "index":
             from tidb_tpu.schema.model import SchemaState
             t = self._resolve_table_or_err(stmt.table)

@@ -176,13 +176,49 @@ class StorageServer:
 
     _RESYNC_INTERVAL = 1.0   # seconds between re-attach attempts
 
+    def _start_resync_thread(self) -> None:
+        """Degraded mode: a daemon thread dials the backup OFF the write
+        path (a blocking connect under _ship_mu would stall every
+        mutation); once the backup answers, it takes _ship_mu only for
+        the consistent snapshot push."""
+        if getattr(self, "_resync_thread", None) is not None and \
+                self._resync_thread.is_alive():
+            return
+
+        def loop():
+            while self._backup_dead and not self._closing.is_set():
+                time.sleep(self._RESYNC_INTERVAL)
+                try:
+                    conn = _Conn(self._backup_addr, timeout=5)
+                except OSError:
+                    continue
+                try:
+                    with self._ship_mu:
+                        if not self._backup_dead:
+                            return
+                        conn.call("repl_install",
+                                  (self._export_state_locked(),), {})
+                        self._backup_dead = False
+                    print("storage: backup re-synced, resuming "
+                          "replication", flush=True)
+                    return
+                except (ConnectionError, OSError, wire.WireError,
+                        kv.KVError):
+                    continue
+                finally:
+                    conn.close()
+
+        self._resync_thread = threading.Thread(
+            target=loop, daemon=True, name="storage-resync")
+        self._resync_thread.start()
+
     def _ship(self, method: str, args: tuple, kwargs: dict) -> None:
         """Synchronously replicate one applied mutation. Called under
         _ship_mu, so the backup applies in exactly primary order. If the
         backup is unreachable (or rejects a replay) the primary degrades
         to solo and RE-SYNCS it with a full state push as soon as it
-        answers again (_maybe_resync_backup) — the unreplicated window
-        is bounded by the outage plus one resync. Writes acked during
+        answers again (_start_resync_thread, off the write path) — the
+        unreplicated window is bounded by the outage plus one resync. Writes acked during
         that window are lost only if the primary ALSO dies before the
         resync lands (the inherent 2-node degraded-mode caveat; a quorum
         design needs 3 nodes)."""
@@ -204,30 +240,10 @@ class StorageServer:
                 self._backup.close()
                 self._backup = None
             self._backup_dead = True
-            self._next_resync = time.monotonic() + self._RESYNC_INTERVAL
             print(f"storage: backup unreachable, degrading to solo "
-                  f"(will re-sync): {e}", flush=True)
+                  f"(re-sync thread running): {e}", flush=True)
+            self._start_resync_thread()
 
-    def _maybe_resync_backup(self) -> None:
-        """Called under _ship_mu before a mutation: if the backup is
-        marked dead and the retry timer elapsed, push a full state
-        snapshot (repl_install) and resume shipping."""
-        if not self._backup_dead or self._backup_addr is None:
-            return
-        if time.monotonic() < getattr(self, "_next_resync", 0.0):
-            return
-        try:
-            conn = _Conn(self._backup_addr, timeout=5)
-            try:
-                conn.call("repl_install",
-                          (self._export_state_locked(),), {})
-            finally:
-                conn.close()
-            self._backup_dead = False
-            print("storage: backup re-synced, resuming replication",
-                  flush=True)
-        except (ConnectionError, OSError, wire.WireError, kv.KVError):
-            self._next_resync = time.monotonic() + self._RESYNC_INTERVAL
 
     def _repl_apply(self, method: str, args: tuple, kwargs: dict,
                     watermark: int) -> None:
@@ -323,7 +339,6 @@ class StorageServer:
             # the ship lock serializes apply+ship so the backup applies
             # in primary order; standalone servers skip it entirely
             with self._ship_mu:
-                self._maybe_resync_backup()
                 result = self._dispatch(method, args, kwargs)
                 self._ship(method, args, kwargs)
                 return result

@@ -1,29 +1,20 @@
 """Race-detection harness for the threaded store/DDL paths.
 
 The reference leans on Go's -race (Makefile:124). CPython has no
-equivalent sanitizer, so this module provides the two pieces that
-catch the same bug class in practice:
-
-1. `stress()` — a context manager that drops the interpreter's thread
-   switch interval to its floor, multiplying the interleavings a test
-   explores (the standard CPython trick for surfacing races).
-2. `LockDiscipline` — instruments chosen methods of an object so each
-   call asserts a declared lock is HELD by the caller; any path that
-   reaches shared state without its lock fails the test instead of
-   corrupting memory silently.
-
-tests/test_race_harness.py uses both to hammer MVCC commit, TSO,
-region-cache churn, and the replication ship path.
+equivalent sanitizer; what catches the same bug class in practice is
+maximizing thread interleavings while asserting SEMANTIC invariants
+(no lost updates, monotonic TSO, one unique-insert winner...):
+`stress()` drops the interpreter's switch interval to its floor — the
+standard CPython trick for surfacing races — and
+tests/test_race_harness.py runs the store workloads under it.
 """
 
 from __future__ import annotations
 
 import contextlib
-import functools
 import sys
-import threading
 
-__all__ = ["stress", "LockDiscipline"]
+__all__ = ["stress"]
 
 
 @contextlib.contextmanager
@@ -35,58 +26,3 @@ def stress(interval: float = 1e-6):
         yield
     finally:
         sys.setswitchinterval(old)
-
-
-class LockDiscipline:
-    """Asserts a lock-held invariant on instrumented methods.
-
-    discipline = LockDiscipline(engine, engine._mu,
-                                ["prewrite", "commit", "rollback"])
-    ... run workload ...
-    discipline.restore()
-    assert discipline.violations == []
-    """
-
-    def __init__(self, obj, lock, methods: list[str]):
-        self.obj = obj
-        self.lock = lock
-        self.violations: list[str] = []
-        self._orig: dict[str, object] = {}
-        self._concurrent = 0
-        self._mu = threading.Lock()
-        for name in methods:
-            orig = getattr(obj, name)
-            self._orig[name] = orig
-            setattr(obj, name, self._wrap(name, orig))
-
-    def _wrap(self, name, orig):
-        @functools.wraps(orig)
-        def wrapper(*a, **k):
-            # entering the method itself takes the lock internally; what
-            # we check is EXCLUSION: no two instrumented calls may run
-            # their critical section at once if the object's own locking
-            # is correct. We detect overlap of lock-free windows.
-            with self._mu:
-                self._concurrent += 1
-                if self._concurrent > 1 and not self._locked_elsewhere():
-                    self.violations.append(
-                        f"{name}: {self._concurrent} concurrent entries "
-                        "with the object lock free")
-            try:
-                return orig(*a, **k)
-            finally:
-                with self._mu:
-                    self._concurrent -= 1
-        return wrapper
-
-    def _locked_elsewhere(self) -> bool:
-        # a held lock means the overlapping callers are serialized by it
-        acquired = self.lock.acquire(blocking=False)
-        if acquired:
-            self.lock.release()
-            return False
-        return True
-
-    def restore(self) -> None:
-        for name, orig in self._orig.items():
-            setattr(self.obj, name, orig)
