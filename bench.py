@@ -123,7 +123,7 @@ def _probe_devices_with_retry() -> bool:
     """The chip tunnel flaps: one failed 120s probe must not condemn the
     whole run to the CPU fallback. Retries with backoff for ~7 minutes
     total (BENCH_PROBE_ATTEMPTS / BENCH_PROBE_TIMEOUT override)."""
-    attempts = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "3"))
+    attempts = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "2"))
     timeout_s = int(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
     for i in range(attempts):
         if _probe_devices(timeout_s):
@@ -205,6 +205,11 @@ def main() -> None:
               file=sys.stderr, flush=True)
         import jax
         jax.config.update("jax_platforms", "cpu")
+        # the persistent cache is for slow through-the-tunnel TPU
+        # compiles; on CPU it can LOAD AOT results compiled under a
+        # different virtualized feature set (prefer-no-scatter etc.),
+        # which deoptimizes scatter-heavy programs ~5x (measured on Q3)
+        jax.config.update("jax_compilation_cache_dir", None)
         device_fallback = "cpu (chip tunnel unavailable)"
         if "BENCH_SF" not in os.environ:
             # CPU XLA runs the warm path ~20-40x slower than a chip;
