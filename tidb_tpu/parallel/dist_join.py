@@ -325,24 +325,14 @@ class MeshLookupAggKernel(MeshKernelBase):
         self._setup_sizes(mesh, capacity)
         self._stage1_jit = None
         self._stage2_jits: dict = {}
+        self._stage3_jits: dict = {}
 
     # -- traced programs -----------------------------------------------------
 
-    def _stage1(self, cols, nrows, build0):
-        """filter + first lookup + compaction ->
-        (compacted (data, valid) pairs ... incl. live flag + row ids,
-        global max survivor count)."""
-        ln = cols[0][0].shape[0]
-        xp = jnp
-        di = lax.axis_index("dp")
-        ti = lax.axis_index("tp")
-        offs = (di * self.tp + ti).astype(jnp.int64) * ln
-        alive = (offs + xp.arange(ln)) < nrows
-        mask = runtime.filter_mask_xp(xp, self.filter_expr, cols, ln) & alive
-        virt = list(cols)
-        mask = _lookup_step(xp, self.lookups[0], self.builds[0], build0,
-                            virt, mask, ln)
-        row_ids = offs + xp.arange(ln)
+    def _compact(self, xp, virt, mask, row_ids, ln):
+        """Prefix-sum compaction of the surviving rows ->
+        (compacted (data, valid) pairs, live flag, row ids, global max
+        survivor count)."""
         s_local = mask.sum()
         pos = xp.cumsum(mask.astype(jnp.int32)) - 1
         idx = xp.where(mask, pos, ln)      # OOB -> dropped by scatter
@@ -358,10 +348,28 @@ class MeshLookupAggKernel(MeshKernelBase):
             lax.pmax(s_local, ("dp", "tp"))
         return tuple(compacted), live, rid, smax
 
+    def _stage1(self, cols, nrows, build0):
+        """filter + first lookup + compaction."""
+        ln = cols[0][0].shape[0]
+        xp = jnp
+        di = lax.axis_index("dp")
+        ti = lax.axis_index("tp")
+        offs = (di * self.tp + ti).astype(jnp.int64) * ln
+        alive = (offs + xp.arange(ln)) < nrows
+        mask = runtime.filter_mask_xp(xp, self.filter_expr, cols, ln) & alive
+        virt = list(cols)
+        mask = _lookup_step(xp, self.lookups[0], self.builds[0], build0,
+                            virt, mask, ln)
+        row_ids = offs + xp.arange(ln)
+        return self._compact(xp, virt, mask, row_ids, ln)
+
     def _stage2_fn(self, bucket: int):
+        """Remaining lookups, then compact AGAIN: the chain's total
+        selectivity (a 20% dimension filter deep in a star join) shrinks
+        the aggregation's input — the group-table sort is the next cost
+        center after the probes."""
         def stage2(ccols, live, rid, builds_rest):
             xp = jnp
-            ti = lax.axis_index("tp")
             b = bucket
             virt = [(d[:b], v[:b]) for d, v in ccols]
             mask = live[:b]
@@ -369,10 +377,20 @@ class MeshLookupAggKernel(MeshKernelBase):
             for lk, bt, bd in zip(self.lookups[1:], self.builds[1:],
                                   builds_rest):
                 mask = _lookup_step(xp, lk, bt, bd, virt, mask, b)
-            return group_merge_program(
-                xp, virt, mask, b, jnp.int64(0), ti, self.group_exprs,
-                self.aggs, self._C, self.ndev, self.tp, row_ids=rids)
+            return self._compact(xp, virt, mask, rids, b)
         return stage2
+
+    def _stage3_fn(self, bucket: int):
+        def stage3(ccols, live, rid):
+            xp = jnp
+            ti = lax.axis_index("tp")
+            b = bucket
+            virt = [(d[:b], v[:b]) for d, v in ccols]
+            return group_merge_program(
+                xp, virt, live[:b], b, jnp.int64(0), ti,
+                self.group_exprs, self.aggs, self._C, self.ndev,
+                self.tp, row_ids=rid[:b])
+        return stage3
 
     # -- host driver ---------------------------------------------------------
 
@@ -395,8 +413,8 @@ class MeshLookupAggKernel(MeshKernelBase):
             kwargs = dict(mesh=self.mesh,
                           in_specs=(self._row_spec, self._row_spec,
                                     self._row_spec, P()),
-                          out_specs=(P("tp"), P("tp"), P("tp"), P("tp"),
-                                     P("tp"), P("tp"), P()))
+                          out_specs=(self._row_spec, self._row_spec,
+                                     self._row_spec, P()))
             fn = self._stage2_fn(bucket)
             try:
                 sm = shard_map(fn, check_vma=False, **kwargs)
@@ -405,22 +423,47 @@ class MeshLookupAggKernel(MeshKernelBase):
             j = self._stage2_jits[bucket] = jax.jit(sm)
         return j
 
+    def _get_stage3(self, bucket: int):
+        j = self._stage3_jits.get(bucket)
+        if j is None:
+            kwargs = dict(mesh=self.mesh,
+                          in_specs=(self._row_spec, self._row_spec,
+                                    self._row_spec),
+                          out_specs=(P("tp"), P("tp"), P("tp"), P("tp"),
+                                     P("tp"), P("tp"), P()))
+            fn = self._stage3_fn(bucket)
+            try:
+                sm = shard_map(fn, check_vma=False, **kwargs)
+            except TypeError:
+                sm = shard_map(fn, check_rep=False, **kwargs)
+            j = self._stage3_jits[bucket] = jax.jit(sm)
+        return j
+
+    @staticmethod
+    def _bucket(s: int, ln: int) -> int:
+        b = 8
+        while b < s:
+            b <<= 1
+        return min(b, ln)
+
     def launch(self, probe: Chunk, bucket: bool = False):
-        """Dispatches stage 1, reads back ONE scalar (the survivor
-        count), then dispatches stage 2 on the matching bucket. Build
-        tables are device-memoized by _BuildTable.device_arrays, so
-        per-batch launches re-send nothing."""
+        """Dispatches stage 1 (filter + first lookup + compact), reads
+        back one survivor-count scalar, dispatches stage 2 (remaining
+        lookups + compact), reads one more, then stage 3 (aggregation)
+        on the chain-selectivity-sized bucket. Build tables are
+        device-memoized by _BuildTable.device_arrays, so per-batch
+        launches re-send nothing."""
         cols, ln = self._shard_probe(probe, bucket=bucket)
         rep_sh = NamedSharding(self.mesh, P())
         builds = tuple(b.device_arrays(rep_sh) for b in self.builds)
         ccols, live, rid, smax = self._get_stage1()(
             cols, jnp.int64(probe.num_rows), builds[0])
-        s = int(smax)                   # the one mid-pipeline sync
-        bkt = 8
-        while bkt < s:
-            bkt <<= 1
-        bkt = min(bkt, ln)
-        return self._get_stage2(bkt)(ccols, live, rid, builds[1:])
+        bkt = self._bucket(int(smax), ln)
+        if len(self.lookups) > 1:
+            ccols, live, rid, smax2 = self._get_stage2(bkt)(
+                ccols, live, rid, builds[1:])
+            bkt = self._bucket(int(smax2), bkt)
+        return self._get_stage3(bkt)(ccols, live, rid)
 
     def finish(self, outs, probe: Chunk):
         gidx, rep_rows, lanes_at, counts = self._postprocess(outs)
