@@ -25,8 +25,9 @@ device path; vs_baseline = geomean of per-query device/host speedups.
 Env knobs: BENCH_SF (default 1.0), BENCH_ITERS (5), BENCH_HOST_ITERS (2),
 BENCH_REGIONS (4), BENCH_KERNEL_MICRO (1), BENCH_SKIP_PROBE (0; 1 skips
 the device-liveness probes and trusts the default platform),
-BENCH_PROBE_ATTEMPTS (3) / BENCH_PROBE_TIMEOUT (120s) — the probe
-retries with backoff so one tunnel flap doesn't condemn the run,
+BENCH_PROBE_ATTEMPTS (2) / BENCH_PROBE_TIMEOUT (120s) — the probe
+retries with backoff (~4.5 min at the defaults) so one tunnel flap
+doesn't condemn the run,
 BENCH_CPU_SF (0.2; scale used when the chip tunnel is down and no
 explicit BENCH_SF was given — CPU XLA is ~20-40x slower than a chip).
 
@@ -120,9 +121,10 @@ def _probe_devices(timeout_s: int = 120) -> bool:
 
 
 def _probe_devices_with_retry() -> bool:
-    """The chip tunnel flaps: one failed 120s probe must not condemn the
-    whole run to the CPU fallback. Retries with backoff for ~7 minutes
-    total (BENCH_PROBE_ATTEMPTS / BENCH_PROBE_TIMEOUT override)."""
+    """The chip tunnel flaps: one failed 120s probe must not condemn
+    the whole run to the CPU fallback. Retries with backoff, ~4.5
+    minutes at the defaults (BENCH_PROBE_ATTEMPTS / BENCH_PROBE_TIMEOUT
+    override)."""
     attempts = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "2"))
     timeout_s = int(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
     for i in range(attempts):

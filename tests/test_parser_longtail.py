@@ -315,3 +315,72 @@ class TestReviewRegressions:
             s.execute("SET PASSWORD FOR 'root'@'%' = 'x'")
         s.close()
         r.close()
+
+
+class TestThirdReviewRegressions:
+    def test_multi_delete_where_subquery_needs_select(self):
+        from tidb_tpu.bootstrap import bootstrap
+        from tidb_tpu.session import Session, SQLError
+        from tidb_tpu.store.storage import new_mock_storage
+        st = new_mock_storage()
+        bootstrap(st)
+        r = Session(st, user="root", host="%")
+        r.execute("CREATE DATABASE p3; USE p3")
+        r.execute("CREATE TABLE t1 (id BIGINT PRIMARY KEY)")
+        r.execute("CREATE TABLE t2 (id BIGINT PRIMARY KEY)")
+        r.execute("CREATE DATABASE other")
+        r.execute("CREATE TABLE other.secret (id BIGINT PRIMARY KEY)")
+        r.execute("CREATE USER w2")
+        for t in ("t1", "t2"):
+            r.execute(f"GRANT DELETE ON p3.{t} TO w2")
+            r.execute(f"GRANT SELECT ON p3.{t} TO w2")
+        s = Session(st, user="w2", host="localhost")
+        s.execute("USE p3")
+        with pytest.raises(SQLError, match="SELECT"):
+            s.execute("DELETE t1 FROM t1 INNER JOIN t2 ON t1.id=t2.id "
+                      "WHERE t1.id IN (SELECT id FROM other.secret)")
+        s.close(); r.close()
+
+    def test_set_password_prefers_specific_host(self):
+        from tidb_tpu.bootstrap import bootstrap
+        from tidb_tpu.privilege import encode_password
+        from tidb_tpu.session import Session
+        from tidb_tpu.store.storage import new_mock_storage
+        st = new_mock_storage()
+        bootstrap(st)
+        r = Session(st, user="root", host="%")
+        r.execute("CREATE USER 'u'@'%' IDENTIFIED BY 'wild'")
+        r.execute("CREATE USER 'u'@'localhost' IDENTIFIED BY 'loc'")
+        s = Session(st, user="u", host="localhost")
+        s.execute("SET PASSWORD = 'newpw'")
+        rows = dict(r.query(
+            "SELECT host, authentication_string FROM mysql.user "
+            "WHERE user = 'u'").rows)
+        assert rows["localhost"] == encode_password("newpw")
+        assert rows["%"] == encode_password("wild")   # untouched
+        s.close(); r.close()
+
+    def test_change_after_self_rejected_at_submit(self):
+        from tidb_tpu.session import Session, SQLError
+        from tidb_tpu.store.storage import new_mock_storage
+        s = Session(new_mock_storage())
+        s.execute("CREATE DATABASE a3; USE a3")
+        s.execute("CREATE TABLE c (a BIGINT PRIMARY KEY, b BIGINT)")
+        with pytest.raises(SQLError, match="Unknown column"):
+            s.execute("ALTER TABLE c CHANGE COLUMN b b2 BIGINT AFTER b")
+        with pytest.raises(SQLError, match="Unknown column"):
+            s.execute("ALTER TABLE c CHANGE COLUMN b b2 BIGINT "
+                      "AFTER b2")
+        s.close()
+
+    def test_pallas_dispatcher_1d_shape(self):
+        import numpy as np
+        import jax.numpy as jnp
+        from tidb_tpu.ops import pallas_agg as pa
+        v = jnp.asarray(np.ones(10, dtype=np.float32))
+        ids = jnp.asarray(np.zeros(10, dtype=np.int32))
+        out = pa.segment_sum(v, ids, 4)
+        assert out.ndim == 1 and out.shape[0] == 4
+        # the pallas path itself also squeezes via the dispatcher
+        out2 = pa.segment_sum_pallas(v, ids, 4, interpret=True)
+        assert out2.shape == (4, 1)      # raw kernel keeps the lane axis

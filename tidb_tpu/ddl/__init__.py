@@ -368,9 +368,14 @@ class DDL:
                              default=default,
                              has_default=cd.has_default or
                              not cd.ft.not_null)
-            if spec.position == "after" and \
-                    t.col_by_name(spec.after_col) is None:
-                raise DDLError(f"Unknown column '{spec.after_col}'")
+            if spec.position == "after":
+                # AFTER resolves against the post-change schema: the
+                # column being moved (old or new name) can't anchor it
+                if spec.after_col.lower() in (old_name.lower(),
+                                              cd.name.lower()) or \
+                        t.col_by_name(spec.after_col) is None:
+                    raise DDLError(
+                        f"Unknown column '{spec.after_col}'")
             return Job(tp=JobType.MODIFY_COLUMN, schema_id=db.id,
                        table_id=t.id,
                        args={"old_name": old_name,

@@ -114,8 +114,12 @@ def segment_sum_pallas(values, ids, num_segments: int,
 
 def segment_sum(values, ids, num_segments: int):
     """Dispatcher: pallas on TPU float lanes within capacity, XLA
-    scatter otherwise (exactness for int lanes, speed on CPU)."""
-    dt = jnp.asarray(values).dtype
-    if available() and dt == jnp.float32 and num_segments <= _MAX_C:
-        return segment_sum_pallas(values, ids, num_segments)
-    return jax.ops.segment_sum(values, ids, num_segments=num_segments)
+    scatter otherwise (exactness for int lanes, speed on CPU). The
+    output shape mirrors jax.ops.segment_sum exactly: 1-D in -> 1-D
+    out."""
+    v = jnp.asarray(values)
+    if available() and v.dtype == jnp.float32 and \
+            num_segments <= _MAX_C:
+        out = segment_sum_pallas(v, ids, num_segments)
+        return out[:, 0] if v.ndim == 1 else out
+    return jax.ops.segment_sum(v, ids, num_segments=num_segments)

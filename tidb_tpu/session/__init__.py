@@ -922,6 +922,9 @@ class Session:
                     walk_refs(node.left)
                     walk_refs(node.right)
             walk_refs(stmt.refs)
+            # subqueries in the WHERE read too
+            for db, tbl in _referenced_tables([stmt.where]):
+                need(db or self.current_db, tbl, Priv.SELECT, "SELECT")
             return
         if isinstance(stmt, (ast.InsertStmt, ast.UpdateStmt,
                              ast.DeleteStmt, ast.LoadDataStmt)):
@@ -995,21 +998,24 @@ class Session:
                 if stmt.user is not None:
                     user, host = stmt.user.user, stmt.user.host
                 else:
-                    # own account: the stored row whose host PATTERN
-                    # matched this session (like CURRENT_USER())
+                    # own account: the MOST SPECIFIC stored row whose
+                    # host pattern matches this session (CURRENT_USER()
+                    # semantics: exact host beats patterns beats '%')
                     from tidb_tpu.privilege import _host_match
                     user = self.user or ""
-                    host = None
-                    for (h,) in s.query(
+                    my_host = self.host or ""
+                    candidates = [
+                        h for (h,) in s.query(
                             "SELECT host FROM mysql.user WHERE user = "
-                            f"'{_q(user)}'").rows:
-                        if _host_match(h, self.host or ""):
-                            host = h
-                            break
-                    if host is None:
+                            f"'{_q(user)}'").rows
+                        if _host_match(h, my_host)]
+                    if not candidates:
                         raise SQLError(
-                            f"no account matches '{user}'@"
-                            f"'{self.host}'")
+                            f"no account matches '{user}'@'{my_host}'")
+                    candidates.sort(
+                        key=lambda h: (h != my_host, h == "%",
+                                       -len(h)))
+                    host = candidates[0]
                 if not s.query("SELECT user FROM mysql.user WHERE user ="
                                f" '{_q(user)}' AND host = '{_q(host)}'"
                                ).rows:

@@ -177,17 +177,21 @@ class StorageServer:
     _RESYNC_INTERVAL = 1.0   # seconds between re-attach attempts
 
     def _start_resync_thread(self) -> None:
-        """Degraded mode: a daemon thread dials the backup OFF the write
-        path (a blocking connect under _ship_mu would stall every
-        mutation); once the backup answers, it takes _ship_mu only for
-        the consistent snapshot push."""
+        """Degraded mode: a PERMANENT daemon monitor dials the backup
+        OFF the write path (a blocking connect under _ship_mu would
+        stall every mutation); once the backup answers, it takes
+        _ship_mu only for the consistent snapshot push. The monitor
+        never exits while the server lives, so there is no window where
+        a dying thread suppresses the start of its replacement."""
         if getattr(self, "_resync_thread", None) is not None and \
                 self._resync_thread.is_alive():
             return
 
         def loop():
-            while self._backup_dead and not self._closing.is_set():
+            while not self._closing.is_set():
                 time.sleep(self._RESYNC_INTERVAL)
+                if not self._backup_dead:
+                    continue
                 try:
                     conn = _Conn(self._backup_addr, timeout=5)
                 except OSError:
@@ -195,13 +199,12 @@ class StorageServer:
                 try:
                     with self._ship_mu:
                         if not self._backup_dead:
-                            return
+                            continue
                         conn.call("repl_install",
                                   (self._export_state_locked(),), {})
                         self._backup_dead = False
                     print("storage: backup re-synced, resuming "
                           "replication", flush=True)
-                    return
                 except (ConnectionError, OSError, wire.WireError,
                         kv.KVError):
                     continue
