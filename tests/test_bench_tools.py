@@ -30,3 +30,15 @@ def test_benchfilesort_spills_and_sorts():
     out = benchfilesort.run(rows=30_000, run_rows=8_000, chunk_rows=4096)
     assert out["rows"] == 30_000
     assert out["rows_per_sec"] > 0
+
+
+def test_ssb_streaming_wide_scan():
+    """BASELINE config 5 shape: regions stream through the mesh agg in
+    super-batches; device and host agree."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from tidb_tpu.benchmarks import ssb
+    out = ssb.run(sf=0.005, regions=4, stream_rows=8192)
+    assert out["rows"] == 30_000
+    assert out["q11"]["rows_per_sec"] > 0
+    assert out["qgrp"]["speedup"] > 0

@@ -1,0 +1,135 @@
+"""SSB-shaped streaming wide-scan benchmark — BASELINE config 5.
+
+Reference target (BASELINE.json configs[5]): "SSB wide scan: concurrent
+distsql regions streaming into TPU Selection+HashAgg with host->HBM
+overlap" (ref paths: store/tikv/coprocessor.go:342 region worker pool,
+distsql/distsql.go:92 producer/consumer channel). Here: a Star-Schema-
+Benchmark lineorder-shaped wide fact table (13 numeric columns), split
+into N regions, aggregated by an SSB Q1.1-shaped query
+
+    SELECT SUM(lo_extendedprice * lo_discount) FROM lineorder
+    WHERE lo_discount BETWEEN 1 AND 3 AND lo_quantity < 25
+
+plus a grouped variant, with `tidb_tpu_stream_rows` forced BELOW the
+table size so the mesh path streams double-buffered super-batches
+(launch batch k+1 while batch k drains — the host->HBM overlap).
+
+Usage: python -m tidb_tpu.benchmarks.ssb [--sf F] [--regions N]
+       [--stream-rows N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+__all__ = ["run", "main"]
+
+DDL = """CREATE TABLE lineorder (
+    lo_orderkey BIGINT PRIMARY KEY, lo_linenumber BIGINT,
+    lo_custkey BIGINT, lo_partkey BIGINT, lo_suppkey BIGINT,
+    lo_orderdate BIGINT, lo_quantity BIGINT, lo_extendedprice BIGINT,
+    lo_ordtotalprice BIGINT, lo_discount BIGINT, lo_revenue BIGINT,
+    lo_supplycost BIGINT, lo_tax BIGINT)"""
+
+Q11 = ("SELECT SUM(lo_extendedprice * lo_discount) FROM lineorder "
+       "WHERE lo_discount >= 1 AND lo_discount <= 3 "
+       "AND lo_quantity < 25")
+QGRP = ("SELECT lo_discount, COUNT(*), SUM(lo_revenue) FROM lineorder "
+        "WHERE lo_quantity < 30 GROUP BY lo_discount")
+
+
+def run(sf: float = 0.1, regions: int = 16,
+        stream_rows: int | None = None) -> dict:
+    from tidb_tpu import config
+    from tidb_tpu.parallel import config as mesh_config
+    from tidb_tpu.schema.model import TableInfo  # noqa: F401 (import check)
+    from tidb_tpu.session import Session
+    from tidb_tpu.store.storage import new_mock_storage
+    from tidb_tpu.table import Table, bulkload
+
+    n = int(6_000_000 * sf)
+    rng = np.random.default_rng(7)
+    storage = new_mock_storage()
+    s = Session(storage)
+    s.execute("CREATE DATABASE ssb; USE ssb")
+    s.execute(DDL)
+    info = s.domain.info_schema().table("ssb", "lineorder")
+    t0 = time.perf_counter()
+    bulkload.bulk_load(storage, Table(info, storage), {
+        "lo_orderkey": np.arange(n, dtype=np.int64),
+        "lo_linenumber": rng.integers(1, 8, n),
+        "lo_custkey": rng.integers(0, 30_000, n),
+        "lo_partkey": rng.integers(0, 200_000, n),
+        "lo_suppkey": rng.integers(0, 2_000, n),
+        "lo_orderdate": rng.integers(0, 2556, n),
+        "lo_quantity": rng.integers(1, 51, n),
+        "lo_extendedprice": rng.integers(90_000, 10_000_000, n),
+        "lo_ordtotalprice": rng.integers(100_000, 38_000_000, n),
+        "lo_discount": rng.integers(0, 11, n),
+        "lo_revenue": rng.integers(80_000, 9_000_000, n),
+        "lo_supplycost": rng.integers(50_000, 120_000, n),
+        "lo_tax": rng.integers(0, 9, n)})
+    s.execute(f"SPLIT TABLE lineorder REGIONS {regions}")
+    load_secs = time.perf_counter() - t0
+
+    # force the streaming mesh path: batches well below the table size
+    if stream_rows is None:
+        stream_rows = max(1 << 17, n // 8)
+    prev_stream = config.get_var("tidb_tpu_stream_rows")
+    prev_device = config.get_var("tidb_tpu_device")
+    prev_mesh = mesh_config.active_mesh() is not None
+    config.set_var("tidb_tpu_stream_rows", int(stream_rows))
+
+    out = {"rows": n, "regions": regions, "stream_rows": int(stream_rows),
+           "load_secs": round(load_secs, 2)}
+    try:
+        for name, sql in (("q11", Q11), ("qgrp", QGRP)):
+            config.set_var("tidb_tpu_device", 1)
+            mesh_config.enable_mesh()
+            s.query(sql)                  # compile + warm
+            t0 = time.perf_counter()
+            dev_rows = s.query(sql).rows
+            d = time.perf_counter() - t0
+            config.set_var("tidb_tpu_device", 0)
+            mesh_config.disable_mesh()
+            t0 = time.perf_counter()
+            host_rows = s.query(sql).rows
+            h = time.perf_counter() - t0
+            assert sorted(map(str, dev_rows)) == \
+                sorted(map(str, host_rows))
+            out[name] = {"device_secs": round(d, 4),
+                         "host_secs": round(h, 4),
+                         "rows_per_sec": round(n / d, 1),
+                         "speedup": round(h / d, 2)}
+            print(f"{name}: device {d:.3f}s host {h:.3f}s "
+                  f"({n / d:.0f} rows/s, {h / d:.2f}x)", flush=True)
+    finally:
+        # restore process-global knobs: library callers (and the test
+        # suite) must not inherit this harness's device/stream state
+        config.set_var("tidb_tpu_stream_rows", prev_stream)
+        config.set_var("tidb_tpu_device", prev_device)
+        if prev_mesh:
+            mesh_config.enable_mesh()
+        else:
+            mesh_config.disable_mesh()
+        s.close()
+    print(json.dumps(out), flush=True)
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tidb_tpu.benchmarks.ssb")
+    p.add_argument("--sf", type=float, default=0.1)
+    p.add_argument("--regions", type=int, default=16)
+    p.add_argument("--stream-rows", type=int, default=None)
+    args = p.parse_args(argv)
+    run(args.sf, args.regions, args.stream_rows)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

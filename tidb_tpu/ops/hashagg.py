@@ -156,6 +156,78 @@ def _direct_group_table(xp, group_exprs, cols, n, mask, C, pmax_axes=None):
     return uniq, inv, tot
 
 
+def _cond_direct_mode(group_exprs) -> bool:
+    """True when every group key is a bare ColumnRef of INT or
+    dict-string kind — the shape where a RUNTIME range check can pick
+    direct code-indexed slots (no sort, no hash, exact) over the packed
+    sort, via lax.cond. Covers low-cardinality int keys (status codes,
+    dates-as-days, small dimension ids) that the static string-only
+    check misses; wide-range keys take the hash branch at runtime."""
+    from tidb_tpu.expression.core import ColumnRef
+    from tidb_tpu.sqltypes import EvalType, TypeCode
+    if not group_exprs:
+        return False
+    for g in group_exprs:
+        if not isinstance(g, ColumnRef) or g.ft.tp == TypeCode.JSON:
+            return False
+        if g.ft.eval_type not in (EvalType.INT, EvalType.STRING,
+                                  EvalType.DATETIME,
+                                  EvalType.DURATION):
+            return False
+    return True
+
+
+def _cond_group_table(xp, group_exprs, cols, n, mask, h, C,
+                      pmax_axes=None):
+    """Runtime-selected group table: if the keys' (min..max) span
+    product fits the capacity, index slots DIRECTLY by normalized
+    codes; otherwise fall back to the packed-sort table over the
+    precomputed hash `h`. Mins/spans are global over the mesh axes so
+    every shard agrees on the code space (the value-based re-unique
+    merge then stays correct)."""
+    codes = []
+    spans = []
+    for g in group_exprs:
+        d, v = g.eval_xp(xp, cols, n)
+        d = xp.asarray(d, jnp.int64)
+        live = mask & v
+        lo = xp.min(xp.where(live, d, _I64_MAX))
+        if pmax_axes is not None:
+            lo = -lax.pmax(-lo, pmax_axes)
+        # NULL -> 0; live values -> 1.. (saturate when no live rows)
+        code = xp.where(live, xp.maximum(d - lo, 0) + 1, 0)
+        hi = xp.max(code)
+        if pmax_axes is not None:
+            hi = lax.pmax(hi, pmax_axes)
+        codes.append(code)
+        spans.append(hi + 1)
+
+    span_prod = jnp.prod(jnp.stack(
+        [s.astype(jnp.float64) for s in spans]))
+    small = span_prod <= jnp.float64(C - 2)
+
+    def direct(_):
+        combined = codes[0]
+        for c, s in zip(codes[1:], spans[1:]):
+            combined = combined * s + c
+        tot = xp.max(xp.where(mask, combined, -1)) + 2
+        slot = xp.minimum(combined, C - 2).astype(jnp.int32)
+        inv = xp.where(mask, slot, C - 1).astype(jnp.int32)
+        # slot IDENTITY is the key-tuple hash, not the dense code:
+        # the cross-shard re-unique merge quantizes top bits, which
+        # would collapse small codes into one group (hash values keep
+        # the hash mode's merge contract exactly)
+        uniq = xp.full(C, _FILL, dtype=jnp.int64).at[inv].set(
+            xp.where(mask, h, _SENTINEL_MASKED))
+        return uniq, inv, tot.astype(jnp.int64)
+
+    def hashed(_):
+        uniq, inv, tot = _group_table(xp, h, n, C, mask=mask)
+        return uniq, inv, jnp.asarray(tot, jnp.int64)
+
+    return lax.cond(small, direct, hashed, None)
+
+
 def _group_table(xp, x, m, C, mask=None):
     """Dense group-id table from one PACKED sort — the jnp.unique
     replacement. jnp.unique(size=C, return_inverse) costs a sort plus an
@@ -412,6 +484,12 @@ class HashAggKernel:
             uniq, inv, nuniq = _direct_group_table(
                 xp, self.group_exprs, cols, n, mask, self.capacity)
             h2 = xp.zeros(n, dtype=jnp.int64)
+        elif _cond_direct_mode(self.group_exprs):
+            key_cols = [g.eval_xp(xp, cols, n) for g in self.group_exprs]
+            h = _hash_keys(xp, key_cols, n, seed=0x517CC1B727220A95)
+            h2 = _hash_keys(xp, key_cols, n, seed=0x2545F4914F6CDD1D)
+            uniq, inv, nuniq = _cond_group_table(
+                xp, self.group_exprs, cols, n, mask, h, self.capacity)
         else:
             key_cols = [g.eval_xp(xp, cols, n) for g in self.group_exprs]
             h = _hash_keys(xp, key_cols, n, seed=0x517CC1B727220A95)

@@ -36,6 +36,7 @@ from tidb_tpu.ops import runtime
 from tidb_tpu.ops.hashagg import (CapacityError, CollisionError, GroupResult,
                                   _FILL, _SENTINEL_MASKED, _I64_MAX, _I64_MIN,
                                   _SegBatch, _agg_requests,
+                                  _cond_direct_mode, _cond_group_table,
                                   _direct_group_mode, _direct_group_table,
                                   _group_table, _hash_keys,
                                   _validate_device_exprs,
@@ -60,13 +61,21 @@ def group_merge_program(xp, cols, mask, ln, offs, ti, group_exprs, aggs,
     original probe row index per row) replaces offs+arange for the
     representative/FIRST_ROW lanes when rows were compacted."""
     direct = _direct_group_mode(group_exprs)
+    axes = ("dp", "tp") if ndev > 1 else None
     if direct:
         # dense dict codes index slots directly: no sort, no hash, no
         # collisions (h2 lanes are zeros so the check trivially passes)
-        axes = ("dp", "tp") if ndev > 1 else None
         uniq, inv, local_tot = _direct_group_table(
             xp, group_exprs, cols, ln, mask, C, pmax_axes=axes)
         h2 = xp.zeros(ln, dtype=jnp.int64)
+    elif _cond_direct_mode(group_exprs):
+        # bare int/dict keys: RUNTIME range check picks direct slots
+        # when the span fits capacity, packed-sort hash table otherwise
+        key_cols = [g.eval_xp(xp, cols, ln) for g in group_exprs]
+        h = _hash_keys(xp, key_cols, ln, seed=0x517CC1B727220A95)
+        h2 = _hash_keys(xp, key_cols, ln, seed=0x2545F4914F6CDD1D)
+        uniq, inv, local_tot = _cond_group_table(
+            xp, group_exprs, cols, ln, mask, h, C, pmax_axes=axes)
     else:
         key_cols = [g.eval_xp(xp, cols, ln) for g in group_exprs]
         h = _hash_keys(xp, key_cols, ln, seed=0x517CC1B727220A95)
