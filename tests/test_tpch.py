@@ -68,3 +68,11 @@ def test_q6(sess):
     want = tpch.truth_q6(sess._data)
     assert len(rows) == 1
     _approx(rows[0][0], want)
+
+
+def test_q12(sess):
+    """Shipping-mode-style two-table join with date predicates between
+    columns (l_shipdate < l_commitdate < l_receiptdate)."""
+    rows = sess.query(tpch.Q12).rows
+    want = tpch.truth_q12(sess._data)
+    assert [(r[0], r[1]) for r in rows] == want

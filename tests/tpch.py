@@ -304,3 +304,32 @@ def truth_q6(d: TpchData):
             continue
         rev += (d.l_extendedprice[i] / 100) * (d.l_discount[i] / 100)
     return rev
+
+
+Q12 = """
+SELECT l_linestatus, COUNT(*) AS n
+FROM orders, lineitem
+WHERE o_orderkey = l_orderkey
+  AND l_receiptdate >= DATE '1994-01-01'
+  AND l_receiptdate < DATE '1994-01-01' + INTERVAL '1' YEAR
+  AND l_commitdate < l_receiptdate
+  AND l_shipdate < l_commitdate
+GROUP BY l_linestatus
+ORDER BY l_linestatus
+"""
+
+
+def truth_q12(d: TpchData):
+    lo = (datetime.date(1994, 1, 1) - _EPOCH).days
+    hi = (datetime.date(1995, 1, 1) - _EPOCH).days
+    out = {}
+    for i in range(len(d.l_orderkey)):
+        if not (lo <= d.l_receiptdate[i] < hi):
+            continue
+        if not (d.l_commitdate[i] < d.l_receiptdate[i]):
+            continue
+        if not (d.l_shipdate[i] < d.l_commitdate[i]):
+            continue
+        key = STATUSES[d.l_linestatus[i]]
+        out[key] = out.get(key, 0) + 1
+    return sorted((k, v) for k, v in out.items())
