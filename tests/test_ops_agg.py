@@ -228,3 +228,26 @@ def test_hashagg_exec_replans_capacity_overflow():
     assert out.num_rows == ngroups
     # the kernel was re-planned (not abandoned) with a larger capacity
     assert exe._kernel is not None and exe._kernel.capacity >= ngroups
+
+
+def test_cond_direct_wide_span_takes_hash_branch():
+    """BIGINT keys spanning more than 2^63: the int64 code math wraps,
+    so the smallness decision must come from raw min/max in float64 and
+    route to the hash branch (device path preserved, no collisions)."""
+    import numpy as np
+    from tidb_tpu.chunk import Chunk, Column
+    from tidb_tpu.expression import AggDesc, AggFunc
+    from tidb_tpu.expression.core import col
+    from tidb_tpu.ops.hashagg import HashAggKernel
+    from tidb_tpu.sqltypes import new_int_field
+    n = 64
+    keys = np.where(np.arange(n) % 2 == 0, -(2 ** 62), 2 ** 62)
+    ch = Chunk([Column(new_int_field(), keys.astype(np.int64),
+                       np.ones(n, bool)),
+                Column(new_int_field(), np.ones(n, dtype=np.int64),
+                       np.ones(n, bool))])
+    k = HashAggKernel(None, [col(0, new_int_field(), "k")],
+                      [AggDesc(AggFunc.SUM, col(1, new_int_field()))],
+                      capacity=64)
+    gr = k(ch)          # must not raise CollisionError
+    assert sorted(int(c) for c in gr.counts) == [32, 32]

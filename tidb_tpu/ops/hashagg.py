@@ -187,13 +187,16 @@ def _cond_group_table(xp, group_exprs, cols, n, mask, h, C,
     merge then stays correct)."""
     codes = []
     spans = []
+    span_fs = []
     for g in group_exprs:
         d, v = g.eval_xp(xp, cols, n)
         d = xp.asarray(d, jnp.int64)
         live = mask & v
         lo = xp.min(xp.where(live, d, _I64_MAX))
+        hi_raw = xp.max(xp.where(live, d, _I64_MIN))
         if pmax_axes is not None:
             lo = -lax.pmax(-lo, pmax_axes)
+            hi_raw = lax.pmax(hi_raw, pmax_axes)
         # NULL -> 0; live values -> 1.. (saturate when no live rows)
         code = xp.where(live, xp.maximum(d - lo, 0) + 1, 0)
         hi = xp.max(code)
@@ -201,9 +204,15 @@ def _cond_group_table(xp, group_exprs, cols, n, mask, h, C,
             hi = lax.pmax(hi, pmax_axes)
         codes.append(code)
         spans.append(hi + 1)
+        # the SMALLNESS decision uses raw min/max in float64: the int64
+        # code math (d - lo) wraps when the raw span exceeds 2^63 and
+        # would make a huge span look tiny, forcing the direct branch
+        # onto colliding codes
+        span_fs.append(jnp.maximum(
+            hi_raw.astype(jnp.float64) - lo.astype(jnp.float64) + 2.0,
+            1.0))      # no live rows: empty span counts as 1
 
-    span_prod = jnp.prod(jnp.stack(
-        [s.astype(jnp.float64) for s in spans]))
+    span_prod = jnp.prod(jnp.stack(span_fs))
     small = span_prod <= jnp.float64(C - 2)
 
     def direct(_):

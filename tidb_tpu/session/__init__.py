@@ -914,16 +914,9 @@ class Session:
             for ts in stmt.targets:
                 need(_tdb(ts), ts.name.lower(), Priv.DELETE, "DELETE")
 
-            def walk_refs(node):
-                if isinstance(node, ast.TableSource):
-                    need(_tdb(node), node.name.lower(), Priv.SELECT,
-                         "SELECT")
-                elif isinstance(node, ast.Join):
-                    walk_refs(node.left)
-                    walk_refs(node.right)
-            walk_refs(stmt.refs)
-            # subqueries in the WHERE read too
-            for db, tbl in _referenced_tables([stmt.where]):
+            # the generic walker covers the join tree, ON-clause
+            # subqueries, and WHERE subqueries alike
+            for db, tbl in _referenced_tables([stmt.refs, stmt.where]):
                 need(db or self.current_db, tbl, Priv.SELECT, "SELECT")
             return
         if isinstance(stmt, (ast.InsertStmt, ast.UpdateStmt,
