@@ -384,3 +384,105 @@ class TestThirdReviewRegressions:
         # the pallas path itself also squeezes via the dispatcher
         out2 = pa.segment_sum_pallas(v, ids, 4, interpret=True)
         assert out2.shape == (4, 1)      # raw kernel keeps the lane axis
+
+
+class TestMinedExprCases:
+    """Harvested from the reference's executor test corpus (table-free
+    MustQuery cases run against our session)."""
+
+    @pytest.fixture
+    def sess(self):
+        from tidb_tpu.session import Session
+        from tidb_tpu.store.storage import new_mock_storage
+        s = Session(new_mock_storage())
+        s.execute("CREATE DATABASE mx; USE mx")
+        yield s
+        s.close()
+
+    def test_last_insert_id(self, sess):
+        sess.execute("CREATE TABLE a (id BIGINT PRIMARY KEY "
+                     "AUTO_INCREMENT, v BIGINT)")
+        sess.execute("INSERT INTO a (v) VALUES (7), (8)")
+        first = sess.query("SELECT LAST_INSERT_ID()").rows[0][0]
+        assert first >= 1
+        sess.execute("INSERT INTO a (v) VALUES (9)")
+        second = sess.query("SELECT LAST_INSERT_ID()").rows[0][0]
+        assert second > first    # first id of the LATEST insert
+
+    def test_show_warnings_and_empty_catalogs(self, sess):
+        assert sess.query("SHOW WARNINGS").rows == []
+        assert sess.query("SHOW ERRORS").rows == []
+        assert sess.query("SHOW PLUGINS").rows == []
+        assert sess.query("SHOW PROFILES").rows == []
+        assert sess.query("SHOW TRIGGERS").rows == []
+        assert sess.query("SHOW EVENTS WHERE Db = 'x'").rows == []
+        assert sess.query("SHOW PROCEDURE STATUS").rows == []
+        assert sess.query("SHOW MASTER STATUS").rows == []
+
+    def test_unhex_binary_round_trip(self, sess):
+        assert sess.query("SELECT HEX(UNHEX('FF'))").rows == [("FF",)]
+        assert sess.query(
+            "SELECT INET6_NTOA(UNHEX("
+            "'FDFE0000000000005A55CAFFFEFA9089'))").rows == \
+            [("fdfe::5a55:caff:fefa:9089",)]
+
+    def test_sleep_bad_arg_clean_error(self, sess):
+        from tidb_tpu.session import SQLError
+        with pytest.raises(SQLError, match="sleep"):
+            sess.query("SELECT SLEEP('a')")
+
+    def test_wide_literal_multiply_exact(self, sess):
+        from decimal import Decimal, localcontext
+        got = sess.query(
+            "select 123344532434234234267890.0 * "
+            "1234567118923479823749823749.230").rows[0][0]
+        with localcontext() as ctx:
+            ctx.prec = 70
+            want = (Decimal("123344532434234234267890.0") *
+                    Decimal("1234567118923479823749823749.230"))
+            assert Decimal(got) == want
+
+
+class TestFifthReviewRegressions:
+    """Fixes from the fifth review pass."""
+
+    @pytest.fixture
+    def sess(self):
+        from tidb_tpu.session import Session
+        from tidb_tpu.store.storage import new_mock_storage
+        s = Session(new_mock_storage())
+        s.execute("CREATE DATABASE rv5; USE rv5")
+        yield s
+        s.close()
+
+    def test_last_insert_id_ignores_hidden_rowid(self, sess):
+        sess.execute("CREATE TABLE noauto (a INT, b INT)")
+        sess.execute("INSERT INTO noauto VALUES (1, 2)")
+        # hidden _tidb_rowid allocation must NOT leak into
+        # LAST_INSERT_ID (MySQL: 0 when no AUTO_INCREMENT was used)
+        assert sess.query("SELECT LAST_INSERT_ID()").rows == [(0,)]
+
+    def test_unhex_uniform_bytes_sort_and_compare(self, sess):
+        sess.execute("CREATE TABLE hx (h VARCHAR(32))")
+        sess.execute("INSERT INTO hx VALUES ('41'), ('FF'), ('42')")
+        rows = sess.query("SELECT HEX(UNHEX(h)) FROM hx "
+                          "ORDER BY UNHEX(h)").rows
+        assert [r[0] for r in rows] == ["41", "42", "FF"]
+        # bytes vs str literal comparison must not raise
+        rows = sess.query(
+            "SELECT h FROM hx WHERE UNHEX(h) = 'A'").rows
+        assert rows == [("41",)]
+        assert sess.query(
+            "SELECT LENGTH(UNHEX('FF41'))").rows == [(2,)]
+
+    def test_show_warnings_populated_and_cleared(self, sess):
+        sess.execute("DROP TABLE IF EXISTS ghost")
+        rows = sess.query("SHOW WARNINGS").rows
+        assert rows == [("Note", 1051, "Unknown table 'rv5.ghost'")]
+        # SHOW WARNINGS itself does not clear the area
+        assert sess.query("SHOW WARNINGS").rows == rows
+        # errors-only view filters out notes
+        assert sess.query("SHOW ERRORS").rows == []
+        # any other statement resets the diagnostics area
+        sess.query("SELECT 1")
+        assert sess.query("SHOW WARNINGS").rows == []

@@ -1230,6 +1230,9 @@ class InsertExec(Executor):
                     affected += self._handle_dup(ctx, tbl, txn, values)
                     continue
                 raise
+        if tbl.first_alloc_id is not None:
+            # LAST_INSERT_ID(): first auto value of this statement
+            ctx.last_insert_id = tbl.first_alloc_id
         return affected
 
     def _source_rows(self, ctx):

@@ -85,8 +85,16 @@ def lookup(name: str) -> FnSpec | None:
 # -- helpers -----------------------------------------------------------------
 
 def _s(x) -> str:
-    return x if isinstance(x, str) else (
-        x.decode() if isinstance(x, bytes) else str(x))
+    if isinstance(x, str):
+        return x
+    if isinstance(x, (bytes, bytearray)):
+        try:
+            return bytes(x).decode("utf-8")
+        except UnicodeDecodeError:
+            # binary payload (UNHEX etc.): latin-1 is total and 1 byte
+            # per char, so LENGTH() still counts bytes
+            return bytes(x).decode("latin-1")
+    return str(x)
 
 
 def _valid_all(argv, n):
@@ -283,9 +291,11 @@ def _unhex(args, argv, n):
 
     def one(x):
         try:
-            return bytes.fromhex(_s(x)).decode("utf-8", "replace")
+            # VARBINARY result (MySQL): always bytes, never a lossy str
+            # decode — keeps the column type-homogeneous for sort/compare
+            return bytes.fromhex(_s(x))
         except ValueError:
-            return None
+            return None          # odd length / non-hex -> NULL (MySQL)
 
     out = _vec(one, v, n, d)
     v2 = v & np.array([out[i] is not None for i in range(n)], dtype=bool)

@@ -811,7 +811,13 @@ _reg("ANY_VALUE", 1, 1, "first",
 
 def _sleep(args, argv, n):
     d, v = argv[0]
-    total = float(sum(_numf(d[i], args[0]) for i in range(n) if v[i]))
+    try:
+        total = float(sum(_numf(d[i], args[0])
+                          for i in range(n) if v[i]))
+    except (TypeError, ValueError):
+        from tidb_tpu.executor import ExecError
+        raise ExecError(
+            "Incorrect arguments to sleep") from None
     _time.sleep(min(max(total, 0.0), 10.0))   # bounded: KILL still works
     return np.zeros(n, dtype=np.int64), _const_valid(n)
 

@@ -380,8 +380,21 @@ class ScalarFunc(Expression):
         if EvalType.DECIMAL in (ea, eb):
             fa = a.frac if ea == EvalType.DECIMAL else 0
             fb = b.frac if eb == EvalType.DECIMAL else 0
+            # a WIDE argument makes the result wide (exact bignum lane:
+            # 25-digit * 28-digit literals must not squeeze into int64);
+            # all-narrow chains stay on the int64 device lane
+            any_wide = a.is_wide_decimal or b.is_wide_decimal
+            la = a.flen if ea == EvalType.DECIMAL and a.flen > 0 else 19
+            lb = b.flen if eb == EvalType.DECIMAL and b.flen > 0 else 19
             if self.op == Op.MUL:
-                return new_decimal_field(frac=min(fa + fb, _MAX_DEC_FRAC))
+                if any_wide:
+                    return new_decimal_field(flen=min(la + lb, 65),
+                                             frac=min(fa + fb, 30))
+                return new_decimal_field(
+                    frac=min(fa + fb, _MAX_DEC_FRAC))
+            if any_wide:
+                return new_decimal_field(flen=min(max(la, lb) + 1, 65),
+                                         frac=max(fa, fb))
             return new_decimal_field(frac=max(fa, fb))
         if EvalType.DATETIME in (ea, eb):
             return new_int_field()
@@ -671,6 +684,20 @@ def _eval_logic(xp, op, argv, n):
     return d, av & bv
 
 
+def _debinarize(arr):
+    """Replace bytes elements of an object array with latin-1 strings
+    (identity on code points 0-255, so byte ordering is preserved)."""
+    if getattr(arr, "dtype", None) != np.dtype(object):
+        return arr
+    out = None
+    for i, v in enumerate(arr):
+        if isinstance(v, (bytes, bytearray)):
+            if out is None:
+                out = arr.copy()
+            out[i] = bytes(v).decode("latin-1")
+    return out if out is not None else arr
+
+
 def _cmp_operands(xp, args, datas):
     """Bring two compare operands to a common numeric/string representation."""
     a, b = args[0].ft, args[1].ft
@@ -701,7 +728,9 @@ def _cmp_operands(xp, args, datas):
                 da = fold_column(da)
             if db.dtype == np.dtype(object):
                 db = fold_column(db)
-        return da, db
+        # VARBINARY (e.g. UNHEX output) vs str: lift bytes to latin-1
+        # str so python's '<' is total; latin-1 preserves byte order
+        return _debinarize(da), _debinarize(db)
     ea, eb = a.eval_type, b.eval_type
     if EvalType.REAL in (ea, eb):
         return _to_real(xp, a, da), _to_real(xp, b, db)
@@ -1055,7 +1084,14 @@ def _eval_string(f: ScalarFunc, argv, n):
         return out
 
     def s(x):
-        return x if isinstance(x, str) else (x.decode() if isinstance(x, bytes) else str(x))
+        if isinstance(x, str):
+            return x
+        if isinstance(x, (bytes, bytearray)):
+            try:
+                return bytes(x).decode("utf-8")
+            except UnicodeDecodeError:
+                return bytes(x).decode("latin-1")
+        return str(x)
 
     if op == Op.CONCAT:
         return vec(lambda *xs: "".join(s(x) for x in xs), *datas), valid

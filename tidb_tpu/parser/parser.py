@@ -1613,6 +1613,19 @@ class Parser:
         elif self.peek_word() in ("STATS_META", "STATS_HISTOGRAMS",
                                   "STATS_BUCKETS"):
             s.tp = self.next().val.lower()
+        elif self.peek_word() in ("WARNINGS", "ERRORS", "PLUGINS",
+                                  "PROFILES", "TRIGGERS", "EVENTS",
+                                  "MASTER"):
+            word = self.next().val.lower()
+            if word == "master":
+                self.expect_kw("STATUS")
+                word = "master_status"
+            s.tp = word
+        elif self.peek_word() in ("PROCEDURE", "FUNCTION") and \
+                self.peek(1).is_kw("STATUS"):
+            w = self.next().val.lower()
+            self.next()
+            s.tp = f"{w}_status"
         else:
             raise ParseError("unsupported SHOW", self.peek())
         if self.try_kw("LIKE"):

@@ -348,6 +348,14 @@ class Session:
 
     # -- public API ----------------------------------------------------------
 
+    def add_warning(self, level: str, code: int, message: str) -> None:
+        """Append to the statement diagnostics area (read by SHOW
+        WARNINGS/ERRORS, cleared at the start of the next statement).
+        Ref: sessionctx stmtctx AppendWarning, statement.go."""
+        if not hasattr(self, "_warnings"):
+            self._warnings = []
+        self._warnings.append((level, code, message))
+
     def execute(self, sql: str):
         """Execute semicolon-separated statements; returns a list of
         ResultSet (queries) / int (affected rows) / None (commands)."""
@@ -386,6 +394,11 @@ class Session:
         self.current_sql = sql
         self._stmt_start = time.perf_counter()
         self.killed = False   # a kill that landed while idle is a no-op
+        # each statement resets the diagnostics area, except the SHOWs
+        # that read it (MySQL: SHOW WARNINGS does not clear warnings)
+        if not (isinstance(stmt, ast.ShowStmt)
+                and getattr(stmt, "tp", None) in ("warnings", "errors")):
+            self._warnings = []
         kind = type(stmt).__name__.removesuffix("Stmt").lower()
         ev = perfschema.stmt_begin(self.session_id, sql)
         root = trace.begin("statement", type=kind)
@@ -685,6 +698,14 @@ class Session:
             if self.txn is not None:
                 self._commit()  # implicit commit before DDL (MySQL semantics)
             dropped = self._dropped_table_ids(stmt)
+            if isinstance(stmt, ast.DropTableStmt) and stmt.if_exists:
+                ischema = self.domain.info_schema()
+                for t in stmt.tables:
+                    db = t.db or self.current_db
+                    if not ischema.has_table(db, t.name):
+                        # MySQL: Note 1051 per missing IF EXISTS target
+                        self.add_warning(
+                            "Note", 1051, f"Unknown table '{db}.{t.name}'")
             from tidb_tpu.ddl import DDLError
             try:
                 DDLExecutor(self.storage).execute(stmt, self.current_db,
@@ -1212,7 +1233,11 @@ class Session:
         exe = build_executor(plan)
         try:
             with trace.span("execute", executor=type(exe).__name__):
-                return exe.execute(ctx)
+                out = exe.execute(ctx)
+            lid = getattr(ctx, "last_insert_id", None)
+            if lid is not None:
+                self.last_insert_id = lid
+            return out
         except ExecError as e:
             raise SQLError(str(e)) from None
 
@@ -1221,7 +1246,7 @@ class Session:
 
     _SESSION_FUNCS = ("VERSION", "USER", "SESSION_USER", "SYSTEM_USER",
                       "CURRENT_USER", "CONNECTION_ID", "DATABASE",
-                      "SCHEMA")
+                      "SCHEMA", "LAST_INSERT_ID")
     _CLIENT_SYSVAR_DEFAULTS = {
         "version_comment": "tidb-tpu",
         "character_set_client": "utf8mb4",
@@ -1267,6 +1292,8 @@ class Session:
                 return True, f"{self.user}@{self.host}"
             if n == "CONNECTION_ID":
                 return True, self.session_id
+            if n == "LAST_INSERT_ID":
+                return True, getattr(self, "last_insert_id", 0)
             return True, self.current_db or None   # DATABASE/SCHEMA
         return False, None
 
@@ -1716,6 +1743,31 @@ class Session:
                  ("utf8mb4_general_ci", "utf8mb4", ""),
                  ("utf8_bin", "utf8", ""),
                  ("utf8_general_ci", "utf8", "")])
+        if stmt.tp in ("warnings", "errors"):
+            # statement diagnostics area: populated by add_warning();
+            # cleanly-executed statements leave it empty, like MySQL
+            rows = [(lvl, code, msg)
+                    for lvl, code, msg in getattr(self, "_warnings", [])]
+            return ResultSet(["Level", "Code", "Message"],
+                             rows if stmt.tp == "warnings" else
+                             [r for r in rows if r[0] == "Error"])
+        if stmt.tp == "plugins":
+            return ResultSet(["Name", "Status", "Type", "Library",
+                              "License"], [])
+        if stmt.tp == "profiles":
+            return ResultSet(["Query_ID", "Duration", "Query"], [])
+        if stmt.tp == "triggers":
+            return ResultSet(["Trigger", "Event", "Table", "Statement",
+                              "Timing", "Created"], [])
+        if stmt.tp == "events":
+            return ResultSet(["Db", "Name", "Definer", "Time zone",
+                              "Type", "Status"], [])
+        if stmt.tp in ("procedure_status", "function_status"):
+            return ResultSet(["Db", "Name", "Type", "Definer",
+                              "Modified", "Created"], [])
+        if stmt.tp == "master_status":
+            return ResultSet(["File", "Position", "Binlog_Do_DB",
+                              "Binlog_Ignore_DB"], [])
         if stmt.tp == "charset":
             return ResultSet(
                 ["Charset", "Description", "Default collation",

@@ -179,23 +179,35 @@ class Table:
 
     AUTO_ID_STEP = 4000  # ref: meta/autoid allocator batch (autoid.go:36)
 
-    def alloc_auto_id(self) -> int:
+    # first id this Table instance generated: the LAST_INSERT_ID source
+    # (MySQL reports the FIRST value generated by the last INSERT)
+    first_alloc_id: int | None = None
+
+    def alloc_auto_id(self, track: bool = True) -> int:
+        out = None
         if self._auto_cache is not None:
             nxt, last = self._auto_cache
             if nxt <= last:
                 self._auto_cache = (nxt + 1, last)
-                return nxt
-        from tidb_tpu.meta import Meta
-        txn = self.storage.begin()
-        try:
-            first, last = Meta(txn).gen_auto_id(self.info.id,
-                                                self.AUTO_ID_STEP)
-            txn.commit()
-        except Exception:
-            txn.rollback()
-            raise
-        self._auto_cache = (first + 1, last)
-        return first
+                out = nxt
+        if out is None:
+            from tidb_tpu.meta import Meta
+            txn = self.storage.begin()
+            try:
+                first, last = Meta(txn).gen_auto_id(self.info.id,
+                                                    self.AUTO_ID_STEP)
+                txn.commit()
+            except Exception:
+                txn.rollback()
+                raise
+            self._auto_cache = (first + 1, last)
+            out = first
+        # only user-visible AUTO_INCREMENT allocations feed
+        # LAST_INSERT_ID; the hidden _tidb_rowid handle does not (MySQL
+        # returns 0 after inserting into a table with no auto column)
+        if track and self.first_alloc_id is None:
+            self.first_alloc_id = out
+        return out
 
     def rebase_auto_id(self, at_least: int) -> None:
         from tidb_tpu.meta import Meta
@@ -251,7 +263,7 @@ class Table:
                 handle = int(hv)
                 self.rebase_auto_id(handle) if pk.auto_increment else None
             else:
-                handle = self.alloc_auto_id()
+                handle = self.alloc_auto_id(track=False)
 
         rk = tablecodec.record_key(info.id, handle)
         if not skip_dup_check:
