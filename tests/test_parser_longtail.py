@@ -486,3 +486,66 @@ class TestFifthReviewRegressions:
         # any other statement resets the diagnostics area
         sess.query("SELECT 1")
         assert sess.query("SHOW WARNINGS").rows == []
+
+
+class TestSessionLongtail:
+    """SHOW ... WHERE, no-FROM aggregates, user variables, PREPARE FROM."""
+
+    @pytest.fixture
+    def sess(self):
+        from tidb_tpu.session import Session
+        from tidb_tpu.store.storage import new_mock_storage
+        s = Session(new_mock_storage())
+        s.execute("CREATE DATABASE lt; USE lt")
+        yield s
+        s.close()
+
+    def test_show_variables_where(self, sess):
+        rows = sess.query("show global variables where "
+                          "variable_name = 'autocommit'").rows
+        assert rows == [("autocommit", "1")]
+        rows = sess.query("show variables where "
+                          "Variable_name = 'sql_mode'").rows
+        assert rows == [("sql_mode", "STRICT_TRANS_TABLES")]
+
+    def test_no_from_aggregates(self, sess):
+        assert sess.query("select sum(1.2e2) * 0.1").rows == [(12.0,)]
+        assert sess.query("select count(*)").rows == [(1,)]
+        assert sess.query("select max(3) + min(2)").rows == [(5,)]
+
+    def test_user_var_assignment(self, sess):
+        assert sess.query("select @tmp1 := 11, @tmp2").rows == \
+            [(11, None)]
+        assert sess.query("select @tmp1").rows == [(11,)]
+        # left-to-right: later items see earlier assignments
+        assert sess.query(
+            "select @x := 1 + 2, @y := concat('a','b'), @x + 1"
+        ).rows == [(3, "ab", 4)]
+
+    def test_prepare_from_user_variable(self, sess):
+        sess.execute("SET @q = 'select ? + 1'")
+        sess.execute("PREPARE st FROM @q")
+        sess.execute("SET @v = 41")
+        assert sess.query("execute st using @v").rows == [(42,)]
+        sess.execute("DEALLOCATE PREPARE st")
+        from tidb_tpu.session import SQLError
+        with pytest.raises(SQLError):
+            sess.query("execute st using @v")
+
+    def test_sixth_review_regressions(self, sess):
+        from tidb_tpu.session import SQLError
+        # UNHEX IN-list: binary column lifts for the membership test
+        sess.execute("CREATE TABLE hx6 (h VARCHAR(32))")
+        sess.execute("INSERT INTO hx6 VALUES ('41'), ('FF'), ('42')")
+        rows = sess.query("SELECT h FROM hx6 WHERE UNHEX(h) IN "
+                          "('A','B') ORDER BY h").rows
+        assert [r[0] for r in rows] == ["41", "42"]
+        # no-FROM aggregate honors LIMIT/OFFSET
+        assert sess.query("SELECT COUNT(*) LIMIT 0").rows == []
+        assert sess.query("SELECT COUNT(*) LIMIT 1").rows == [(1,)]
+        # SHOW ... WHERE compares case-insensitively
+        assert sess.query("show variables where variable_name = "
+                          "'AUTOCOMMIT'").rows == [("autocommit", "1")]
+        # @v := <bad expr> keeps the SQLError contract
+        with pytest.raises(SQLError):
+            sess.query("select @e := sleep('x')")

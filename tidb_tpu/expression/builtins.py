@@ -53,6 +53,9 @@ class FnSpec:
         from tidb_tpu.sqltypes import new_duration_field
         return {"int": new_int_field, "real": new_double_field,
                 "string": lambda: new_string_field(),
+                # VARBINARY producers (UNHEX): compare layers use the
+                # binary collation marker to lift bytes for ordering
+                "binary": _new_binary_field,
                 "datetime": new_datetime_field,
                 "duration": new_duration_field,
                 "first": lambda: args[0].ft}[self.ret]()
@@ -71,6 +74,11 @@ def _restore_spec(name: str) -> "FnSpec":
     return REGISTRY[name]
 
 
+def _new_binary_field():
+    import dataclasses
+    return dataclasses.replace(new_string_field(), collation="binary")
+
+
 REGISTRY: dict[str, FnSpec] = {}
 
 
@@ -85,16 +93,8 @@ def lookup(name: str) -> FnSpec | None:
 # -- helpers -----------------------------------------------------------------
 
 def _s(x) -> str:
-    if isinstance(x, str):
-        return x
-    if isinstance(x, (bytes, bytearray)):
-        try:
-            return bytes(x).decode("utf-8")
-        except UnicodeDecodeError:
-            # binary payload (UNHEX etc.): latin-1 is total and 1 byte
-            # per char, so LENGTH() still counts bytes
-            return bytes(x).decode("latin-1")
-    return str(x)
+    from tidb_tpu.sqltypes import bytes_to_str
+    return bytes_to_str(x)
 
 
 def _valid_all(argv, n):
@@ -303,7 +303,7 @@ def _unhex(args, argv, n):
     return out, v2
 
 
-_reg("UNHEX", 1, 1, "string", _unhex)
+_reg("UNHEX", 1, 1, "binary", _unhex)
 
 
 # -- strings (builtin_string.go) ---------------------------------------------

@@ -506,6 +506,12 @@ class ScalarFunc(Expression):
                 from tidb_tpu.sqltypes import collation_key, fold_column
                 d = fold_column(d)
                 conv = [collation_key(c) for c in conv]
+            if arg_ft.collation == "binary":
+                # UNHEX(col) IN ('A', ...): lift bytes for np.isin
+                from tidb_tpu.sqltypes import bytes_to_str
+                d = _debinarize(d)
+                conv = [bytes_to_str(c) if isinstance(c, (bytes, bytearray))
+                        else c for c in conv]
             out = np.isin(d, np.array(conv, dtype=object))
             return out.astype(np.int64), v
         acc = xp.zeros(n, dtype=bool)
@@ -729,8 +735,12 @@ def _cmp_operands(xp, args, datas):
             if db.dtype == np.dtype(object):
                 db = fold_column(db)
         # VARBINARY (e.g. UNHEX output) vs str: lift bytes to latin-1
-        # str so python's '<' is total; latin-1 preserves byte order
-        return _debinarize(da), _debinarize(db)
+        # str so python's '<' is total; latin-1 preserves byte order.
+        # Gated on the binary collation marker so plain str columns
+        # skip the per-element scan
+        if a.collation == "binary" or b.collation == "binary":
+            return _debinarize(da), _debinarize(db)
+        return da, db
     ea, eb = a.eval_type, b.eval_type
     if EvalType.REAL in (ea, eb):
         return _to_real(xp, a, da), _to_real(xp, b, db)
@@ -1083,15 +1093,7 @@ def _eval_string(f: ScalarFunc, argv, n):
             out[i] = fn(*(a[i] for a in arrs)) if valid[i] else (0 if dtype != object else "")
         return out
 
-    def s(x):
-        if isinstance(x, str):
-            return x
-        if isinstance(x, (bytes, bytearray)):
-            try:
-                return bytes(x).decode("utf-8")
-            except UnicodeDecodeError:
-                return bytes(x).decode("latin-1")
-        return str(x)
+    from tidb_tpu.sqltypes import bytes_to_str as s
 
     if op == Op.CONCAT:
         return vec(lambda *xs: "".join(s(x) for x in xs), *datas), valid

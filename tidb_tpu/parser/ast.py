@@ -175,6 +175,13 @@ class VariableExpr(ExprNode):
 
 
 @dataclass
+class VarAssignExpr(ExprNode):
+    """@v := expr in expression position (SELECT @a := 1)."""
+    name: str = ""
+    value: ExprNode | None = None
+
+
+@dataclass
 class DefaultExpr(ExprNode):
     pass              # bare DEFAULT; DEFAULT(col) parses as FuncCall
 
@@ -466,6 +473,7 @@ class AnalyzeStmt(StmtNode):
 class PrepareStmt(StmtNode):
     name: str = ""
     sql: str = ""                  # the statement text to prepare
+    from_var: str | None = None    # PREPARE s FROM @v
 
 
 @dataclass

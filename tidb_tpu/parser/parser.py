@@ -232,6 +232,11 @@ class Parser:
             self.next()
             name = self.ident()
             self.expect_kw("FROM")
+            if self.try_op("@"):
+                # PREPARE s FROM @v: text read from the user variable at
+                # execution time (session layer)
+                return ast.PrepareStmt(name=name, sql="",
+                                       from_var="@" + self.ident())
             tok = self.next()
             if tok.tp != TokenType.STRING:
                 raise ParseError("PREPARE requires a string literal")
@@ -1886,7 +1891,12 @@ class Parser:
                     nm = self.ident()
                 return ast.VariableExpr(name=nm, is_global=is_global,
                                         is_system=True)
-            return ast.VariableExpr(name=self.ident())
+            nm = self.ident()
+            if self.try_op(":="):
+                # @v := expr — assignment in expression position; MySQL
+                # gives := the lowest precedence, so take a full expr
+                return ast.VarAssignExpr(name=nm, value=self.expr())
+            return ast.VariableExpr(name=nm)
         if t.tp == TokenType.OP and t.val == "?":
             self.next()
             return ast.ParamMarker()

@@ -1063,6 +1063,27 @@ class Planner:
 
     def _plan_select_no_from(self, stmt: ast.SelectStmt) -> ph.PhysPlan:
         plan = None
+        if _contains_agg(stmt):
+            # SELECT SUM(1.2e2) * 0.1 — aggregate over the one-row dual
+            # (MySQL: no-FROM behaves as a single-row table); reuse the
+            # regular agg path so expressions over aggregates work
+            from tidb_tpu.sqltypes import new_int_field
+            ift = new_int_field()
+            plan = ph.PhysValues(
+                schema=PlanSchema([SchemaCol("__dual", "", ift)]),
+                rows=[[Constant(1, ift)]])
+            plan, stmt = self._lift_scalar_subqueries(plan, stmt)
+            plan, out_schema, proj_exprs, _names, _ok = \
+                self._plan_agg_select(stmt, plan)
+            plan = ph.PhysProjection(schema=out_schema, children=[plan],
+                                     exprs=proj_exprs)
+            # the dual input yields at most one group, so ORDER BY and
+            # DISTINCT are no-ops here — but LIMIT/OFFSET still apply
+            # (SELECT COUNT(*) LIMIT 0 is empty)
+            if stmt.limit is not None:
+                plan = ph.PhysLimit(schema=out_schema, children=[plan],
+                                    count=stmt.limit, offset=stmt.offset)
+            return plan
         if any(_contains_scalar_subquery(f.expr) for f in stmt.fields
                if not isinstance(f.expr, ast.Star)):
             # subqueries over a one-row dual input: the lift appends

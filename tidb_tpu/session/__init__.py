@@ -640,7 +640,14 @@ class Session:
             return self._exec_query(
                 stmt, sql_text=None if folded else sql_text)
         if isinstance(stmt, ast.PrepareStmt):
-            self.prepare(stmt.sql, name=stmt.name)
+            text = stmt.sql
+            if stmt.from_var is not None:
+                text = self.vars.get(stmt.from_var.lower())
+                if not isinstance(text, str):
+                    raise SQLError(
+                        f"variable {stmt.from_var} does not hold a "
+                        "statement text")
+            self.prepare(text, name=stmt.name)
             return None
         if isinstance(stmt, ast.ExecuteStmt):
             # user variable names are case-insensitive in MySQL
@@ -1297,6 +1304,38 @@ class Session:
             return True, self.current_db or None   # DATABASE/SCHEMA
         return False, None
 
+    def _eval_scalar_expr(self, e):
+        """Evaluate a table-free AST expression to a python value (used
+        by @v := assignments)."""
+        import numpy as np
+        from tidb_tpu import sqltypes as st2
+        from tidb_tpu.expression.core import Constant
+        from tidb_tpu.plan.resolver import PlanSchema, Resolver, \
+            ResolveError
+
+        def unwrap(val, ft):
+            if val is None:
+                return None
+            if ft.eval_type == st2.EvalType.DECIMAL and ft.frac > 0:
+                return st2.scaled_to_decimal(int(val), ft.frac)
+            if isinstance(val, (np.integer,)):
+                return int(val)
+            if isinstance(val, np.floating):
+                return float(val)
+            return val
+
+        try:
+            r = Resolver(PlanSchema([])).resolve(e)
+            if isinstance(r, Constant):
+                return unwrap(r.value, r.ft)
+            data, valid = r.eval_xp(np, [], 1)
+        except (ResolveError, ExecError) as ex:
+            # keep the SQLError API contract for @v := <bad expr>
+            raise SQLError(str(ex)) from None
+        if not bool(np.asarray(valid)[0]):
+            return None
+        return unwrap(np.asarray(data)[0], r.ft)
+
     def _fold_session_exprs(self, node):
         """Rebuild the AST with session-context expressions folded to
         literals (persistent: shared prepared-statement trees are never
@@ -1306,6 +1345,14 @@ class Session:
 
         def walk(x):
             nonlocal changed
+            if isinstance(x, ast.VarAssignExpr):
+                # @v := expr: fold inner session refs, evaluate once per
+                # statement (constant contexts — MySQL's per-row variable
+                # reuse inside table scans is out of scope) and store
+                val = self._eval_scalar_expr(walk(x.value))
+                self.vars["@" + x.name.lstrip("@").lower()] = val
+                changed = True
+                return ast.Literal(val)
             if isinstance(x, ast.ExprNode):
                 handled, val = self._session_expr_value(x)
                 if handled:
@@ -1553,8 +1600,11 @@ class Session:
             if name not in lower:
                 raise SQLError(f"unknown column '{name}' in SHOW WHERE")
             idx.append((lower.index(name), val))
+        # SHOW result columns carry utf8 ci collation in MySQL, so the
+        # value comparison is case-insensitive
         rows = [r for r in rs.rows
-                if all(str(r[i]) == str(v) for i, v in idx)]
+                if all(str(r[i]).lower() == str(v).lower()
+                       for i, v in idx)]
         return ResultSet(rs.columns, rows)
 
     def _show_stats(self, stmt: ast.ShowStmt) -> ResultSet:
@@ -1657,7 +1707,9 @@ class Session:
                 from tidb_tpu.expression.core import _like_to_regex
                 rx = re.compile(_like_to_regex(stmt.pattern))
                 rows = [r for r in rows if rx.fullmatch(r[0])]
-            return ResultSet(["Variable_name", "Value"], rows)
+            rs = ResultSet(["Variable_name", "Value"], rows)
+            return self._filter_show_rows(rs, stmt.where) \
+                if getattr(stmt, "where", None) is not None else rs
         if stmt.tp == "processlist":
             rows = []
             now = time.time()

@@ -35,7 +35,7 @@ __all__ = [
     "datetime_to_micros", "micros_to_datetime", "date_to_micros",
     "parse_datetime", "format_datetime",
     "parse_duration", "format_duration",
-    "collation_key", "fold_column",
+    "collation_key", "fold_column", "bytes_to_str",
     "NULL",
 ]
 
@@ -161,6 +161,21 @@ def object_fill(ft) -> object:
     """Dead-slot filler for object-dtype columns: wide decimals hold
     scaled python ints (0), varlen strings hold ''."""
     return 0 if ft.tp == TypeCode.NEWDECIMAL else ""
+
+
+def bytes_to_str(x) -> str:
+    """Total byte/str-to-str conversion: utf-8 when valid, latin-1
+    otherwise (1 byte per char, so LENGTH() still counts bytes and byte
+    ordering is preserved). Single home for the binary-string decode
+    policy used by builtins and string ops."""
+    if isinstance(x, str):
+        return x
+    if isinstance(x, (bytes, bytearray)):
+        try:
+            return bytes(x).decode("utf-8")
+        except UnicodeDecodeError:
+            return bytes(x).decode("latin-1")
+    return str(x)
 
 
 def collation_key(x):
