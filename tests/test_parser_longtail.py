@@ -549,3 +549,107 @@ class TestSessionLongtail:
         # @v := <bad expr> keeps the SQLError contract
         with pytest.raises(SQLError):
             sess.query("select @e := sleep('x')")
+
+
+class TestMinedFlowFixes:
+    """Fixes surfaced by replaying reference executor-test flows."""
+
+    @pytest.fixture
+    def sess(self):
+        from tidb_tpu.session import Session
+        from tidb_tpu.store.storage import new_mock_storage
+        s = Session(new_mock_storage())
+        s.execute("CREATE DATABASE mf; USE mf")
+        yield s
+        s.close()
+
+    def test_having_without_group_by(self, sess):
+        sess.execute("CREATE TABLE t (c1 INT, c3 INT)")
+        sess.execute("INSERT INTO t VALUES (1,3),(2,1),(3,2)")
+        assert sess.query(
+            "select c1 as c2, c3 from t having c2 = 2").rows == [(2, 1)]
+        assert sess.query(
+            "select t.c1 from t having c1 = 1").rows == [(1,)]
+
+    def test_positional_order_by_star(self, sess):
+        sess.execute("CREATE TABLE t (a INT, b INT)")
+        sess.execute("INSERT INTO t VALUES (1,2),(2,1)")
+        assert sess.query("select * from t order by 2").rows == \
+            [(2, 1), (1, 2)]
+
+    def test_insert_empty_values(self, sess):
+        sess.execute("CREATE TABLE t (id BIGINT PRIMARY KEY "
+                     "AUTO_INCREMENT, v INT DEFAULT 7)")
+        sess.execute("INSERT INTO t VALUES ()")
+        sess.execute("INSERT INTO t VALUES (), ()")
+        assert sess.query("select * from t order by id").rows == \
+            [(1, 7), (2, 7), (3, 7)]
+
+    def test_auto_increment_sequential_across_statements(self, sess):
+        sess.execute("CREATE TABLE t (id BIGINT PRIMARY KEY "
+                     "AUTO_INCREMENT, v INT)")
+        for v in (11, 22, 33):
+            sess.execute(f"INSERT INTO t (v) VALUES ({v})")
+        assert sess.query("select id from t order by id").rows == \
+            [(1,), (2,), (3,)]
+        # explicit id inside the cached batch: skip past it, not +4000
+        sess.execute("INSERT INTO t VALUES (100, 44)")
+        sess.execute("INSERT INTO t (v) VALUES (55)")
+        assert sess.query("select max(id) from t").rows == [(101,)]
+
+    def test_index_hints_and_prefix_index(self, sess):
+        sess.execute("CREATE TABLE t (id INT PRIMARY KEY, v INT, "
+                     "KEY idx(v))")
+        sess.execute("INSERT INTO t VALUES (1, 10), (2, 20)")
+        assert sess.query("select * from t ignore index(idx) "
+                          "where v = 10").rows == [(1, 10)]
+        assert sess.query("select * from t force index(idx) "
+                          "where v = 20").rows == [(2, 20)]
+        sess.execute("create index idx_p on t (v(3))")
+
+    def test_set_do_user_vars_and_current_ts(self, sess):
+        sess.execute("SET @tmp = 1; SET @tmp := @tmp + 1")
+        assert sess.query("select @tmp").rows == [(2,)]
+        sess.execute("do 1, @a := 5")
+        assert sess.query("select @a").rows == [(5,)]
+        assert sess.query("select @@tidb_current_ts").rows == [(0,)]
+
+    def test_enum_numeric_context(self, sess):
+        sess.execute("CREATE TABLE t (c ENUM('a','b','c'))")
+        sess.execute("INSERT INTO t VALUES ('b'), ('a')")
+        assert sess.query("select c + 1 from t where c = 2").rows == \
+            [(3,)]
+        assert sess.query("select c from t where c = 'b'").rows == \
+            [("b",)]
+
+    def test_sum_string_prefix_coercion(self, sess):
+        sess.execute("CREATE TABLE t (id INT, b VARCHAR(10))")
+        sess.execute("INSERT INTO t VALUES (1, '1ff'), (1, '2')")
+        assert sess.query("select id, sum(b) from t group by id"
+                          ).rows == [(1, 3.0)]
+
+    def test_information_schema_charsets(self, sess):
+        rows = sess.query(
+            "SELECT CHARACTER_SET_NAME FROM "
+            "INFORMATION_SCHEMA.CHARACTER_SETS WHERE MAXLEN = 4").rows
+        assert rows == [("utf8mb4",)]
+        assert len(sess.query(
+            "SELECT * FROM INFORMATION_SCHEMA.COLLATIONS").rows) >= 4
+
+    def test_seventh_review_regressions(self, sess):
+        from tidb_tpu.session import SQLError
+        # SET applies left-to-right within one statement
+        sess.execute("SET @a7 = 1, @b7 = @a7 + 1")
+        assert sess.query("select @a7, @b7").rows == [(1, 2)]
+        # HAVING: a real column shadows the select alias
+        sess.execute("CREATE TABLE sh (c1 INT, c2 INT)")
+        sess.execute("INSERT INTO sh VALUES (5, 9)")
+        assert sess.query("SELECT c1 AS c2, c2 AS x FROM sh "
+                          "HAVING c2 = 5").rows == []
+        assert sess.query("SELECT c1 AS z FROM sh HAVING z = 5"
+                          ).rows == [(5,)]
+        # () shorthand is illegal with an explicit column list
+        sess.execute("CREATE TABLE a7 (id BIGINT PRIMARY KEY "
+                     "AUTO_INCREMENT, v INT)")
+        with pytest.raises(SQLError, match="Column count"):
+            sess.execute("INSERT INTO a7 (v) VALUES ()")

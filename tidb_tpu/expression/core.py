@@ -435,6 +435,12 @@ class ScalarFunc(Expression):
         # numeric family: unify operand representation first
         datas, valids = zip(*argv) if argv else ((), ())
         valid = _and_valid(xp, valids, n)
+        if op in _ARITH or op in _MATH or op in _BIT or \
+                op == Op.UNARY_MINUS:
+            # ENUM in numeric context evaluates as its 1-based member
+            # index (MySQL: c + 0 -> ordinal)
+            datas = [_enum_ordinals(a.ft, d)
+                     for a, d in zip(self.args, datas)]
 
         if op in _CMP:
             d = _eval_cmp(xp, op, self.args, datas)
@@ -690,6 +696,24 @@ def _eval_logic(xp, op, argv, n):
     return d, av & bv
 
 
+def _enum_ordinals(ft: FieldType, d):
+    """ENUM object column -> int64 1-based member indexes (0 for the
+    empty/invalid member). Identity for everything else."""
+    if ft.tp != TypeCode.ENUM or \
+            getattr(d, "dtype", None) != np.dtype(object):
+        return d
+    elems = [str(e).lower() for e in ft.elems]
+    out = np.zeros(len(d), dtype=np.int64)
+    for i, x in enumerate(d):
+        if x is None or x == "":
+            continue
+        try:
+            out[i] = elems.index(str(x).lower()) + 1
+        except ValueError:
+            pass
+    return out
+
+
 def _debinarize(arr):
     """Replace bytes elements of an object array with latin-1 strings
     (identity on code points 0-255, so byte ordering is preserved)."""
@@ -710,6 +734,18 @@ def _cmp_operands(xp, args, datas):
     da, db = datas
     if da.dtype == np.dtype(object) or db.dtype == np.dtype(object):
         ea, eb = a.eval_type, b.eval_type
+        # ENUM vs number compares by member index (MySQL: c = 2 matches
+        # the second member)
+        def _num_side(ft_n, d_n):
+            if ft_n.eval_type == EvalType.DECIMAL:
+                return d_n.astype(np.float64) / (10.0 ** ft_n.frac)
+            return d_n
+        if a.tp == TypeCode.ENUM and eb != EvalType.STRING and \
+                b.tp != TypeCode.ENUM:
+            return _enum_ordinals(a, da), _num_side(b, db)
+        if b.tp == TypeCode.ENUM and ea != EvalType.STRING and \
+                a.tp != TypeCode.ENUM:
+            return _num_side(a, da), _enum_ordinals(b, db)
         if EvalType.DECIMAL in (ea, eb) and \
                 EvalType.STRING not in (ea, eb):
             # wide-decimal lane: python-int math, exact at any precision

@@ -118,7 +118,7 @@ def _agg_lanes_vectorized(a: AggDesc, chunk, rows, starts, gid, ngroups,
     if fn in (AggFunc.SUM, AggFunc.AVG):
         if d.dtype == np.dtype(object):
             # decimal/object sums fall back per-group (rare path)
-            sums = np.array([sum(int(x) for x, ok in
+            sums = np.array([sum(_sum_num(x) for x, ok in
                                  zip(d[s:e], v[s:e]) if ok)
                              for s, e in _seg_bounds(starts, len(rows))],
                             dtype=object)
@@ -185,6 +185,24 @@ def _display_str(v, ft) -> str:
     if isinstance(v, bytes):
         return v.decode("utf8", "replace")
     return str(v)
+
+
+_NUM_PREFIX = None
+
+
+def _sum_num(x):
+    """SUM coercion for object lanes: exact ints (decimal scaled /
+    bignum) pass through; strings take MySQL's leading-numeric-prefix
+    cast to double ('1ff' -> 1.0, 'x' -> 0)."""
+    if isinstance(x, str):
+        global _NUM_PREFIX
+        if _NUM_PREFIX is None:
+            import re
+            _NUM_PREFIX = re.compile(
+                r"\s*[+-]?(?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?")
+        m = _NUM_PREFIX.match(x)
+        return float(m.group(0)) if m else 0.0
+    return int(x)
 
 
 def _seg_bounds(starts, n):

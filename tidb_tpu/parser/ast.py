@@ -209,6 +209,8 @@ class TableSource(Node):
     name: str
     db: str = ""
     alias: str = ""
+    # (kind, [index names]) with kind USE|IGNORE|FORCE
+    index_hints: list = field(default_factory=list)
 
     @property
     def ref_name(self) -> str:

@@ -603,9 +603,35 @@ class Parser:
             ts.alias = self.ident()
         elif self.peek().tp == TokenType.IDENT and \
                 self.peek().val.upper() not in ("LOCK",
-                                                "STRAIGHT_JOIN"):
+                                                "STRAIGHT_JOIN") and \
+                not self._at_index_hint():
             ts.alias = self.ident()
+        while self._at_index_hint():
+            kind = self.next().val.upper()
+            self.next()                       # INDEX | KEY
+            if self.try_kw("FOR"):            # FOR JOIN|ORDER BY|GROUP BY
+                if not self.try_kw("JOIN"):
+                    self.try_kw("ORDER") or self.try_kw("GROUP")
+                    self.expect_kw("BY")
+            self.expect_op("(")
+            names = []
+            if not (self.peek().tp == TokenType.OP and
+                    self.peek().val == ")"):
+                names.append(self.ident())
+                while self.try_op(","):
+                    names.append(self.ident())
+            self.expect_op(")")
+            ts.index_hints.append((kind, names))
         return ts
+
+    def _at_index_hint(self) -> bool:
+        """USE|IGNORE|FORCE INDEX|KEY ( ... ) after a table factor."""
+        t, t1 = self.peek(), self.peek(1)
+        w = t.val.upper() if t.tp in (TokenType.KEYWORD,
+                                      TokenType.IDENT) else ""
+        w1 = t1.val.upper() if t1.tp in (TokenType.KEYWORD,
+                                         TokenType.IDENT) else ""
+        return w in ("USE", "IGNORE", "FORCE") and w1 in ("INDEX", "KEY")
 
     def table_name(self) -> ast.TableSource:
         a = self.ident()
@@ -795,11 +821,9 @@ class Parser:
             self._index_using()            # CREATE INDEX i USING BTREE ON ...
             self.expect_kw("ON")
             table = self.table_name()
-            self.expect_op("(")
-            cols = [self.ident()]
-            while self.try_op(","):
-                cols.append(self.ident())
-            self.expect_op(")")
+            # _paren_idents accepts prefix lengths col(10) and ASC/DESC
+            # (prefix indexing stores the full value — DEVIATIONS.md)
+            cols = self._paren_idents()
             # trailing index options: USING, COMMENT (accepted, fixed
             # implementation — there is one index layout)
             while True:

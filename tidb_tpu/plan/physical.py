@@ -43,6 +43,8 @@ class CopPlan:
     # (col_id, DatumRanges) of a pure pk-range scan: the reader reports
     # actual row counts back to the stats handle (query feedback)
     feedback: Optional[tuple] = None
+    # USE/IGNORE/FORCE INDEX hints from the table factor
+    index_hints: list = field(default_factory=list)
 
     @property
     def is_agg(self) -> bool:

@@ -187,12 +187,14 @@ class Planner:
                                          st.new_int_field()))
             handle_col = len(cols)
         cop = ph.CopPlan(table=info, cols=list(cols),
-                         handle_col=handle_col)
+                         handle_col=handle_col,
+                         index_hints=list(ts.index_hints))
         return ph.PhysTableReader(schema=PlanSchema(schema_cols), cop=cop)
 
     # -- INFORMATION_SCHEMA virtual tables (ref: infoschema/tables.go) -------
 
-    _MEMTABLES = ("schemata", "tables", "columns", "statistics")
+    _MEMTABLES = ("schemata", "tables", "columns", "statistics",
+                  "character_sets", "collations")
 
     def _build_memtable(self, ts: ast.TableSource) -> ph.PhysValues:
         """Serve catalog metadata as constant rows computed from the
@@ -263,6 +265,26 @@ class Planner:
             return mk([("table_schema", sf), ("table_name", sf),
                        ("non_unique", intf), ("index_name", sf),
                        ("seq_in_index", intf), ("column_name", sf)], rows)
+        if name == "character_sets":
+            # the four charsets the engine actually stores (ref:
+            # infoschema/tables.go charset rows / util/charset)
+            rows = [("utf8mb4", "utf8mb4_bin", "UTF-8 Unicode", 4),
+                    ("utf8", "utf8_bin", "UTF-8 Unicode", 3),
+                    ("latin1", "latin1_bin", "cp1252 West European", 1),
+                    ("binary", "binary", "Binary pseudo charset", 1)]
+            return mk([("character_set_name", sf),
+                       ("default_collate_name", sf),
+                       ("description", sf), ("maxlen", intf)], rows)
+        if name == "collations":
+            rows = [("utf8mb4_bin", "utf8mb4", 46, "", "Yes", 1),
+                    ("utf8mb4_general_ci", "utf8mb4", 45, "Yes", "Yes", 1),
+                    ("utf8_bin", "utf8", 83, "", "Yes", 1),
+                    ("utf8_general_ci", "utf8", 33, "Yes", "Yes", 1),
+                    ("latin1_bin", "latin1", 47, "", "Yes", 1),
+                    ("binary", "binary", 63, "Yes", "Yes", 1)]
+            return mk([("collation_name", sf), ("character_set_name", sf),
+                       ("id", intf), ("is_default", sf),
+                       ("is_compiled", sf), ("sortlen", intf)], rows)
         raise PlanError(
             f"Unknown table 'information_schema.{ts.name}' "
             f"(available: {', '.join(self._MEMTABLES)})")
@@ -486,10 +508,23 @@ class Planner:
         idx_cover_base = set()
         if info.pk_is_handle and info.pk_col_name:
             idx_cover_base.add(info.pk_col_name.lower())
+        # USE/IGNORE/FORCE INDEX hints (ref: planbuilder.go
+        # getPossibleAccessPaths): IGNORE removes candidates, USE/FORCE
+        # restrict to the named set, FORCE additionally disfavors the
+        # full table scan
+        ignored = {n.lower() for k, ns in cop.index_hints
+                   if k == "IGNORE" for n in ns}
+        restrict = {n.lower() for k, ns in cop.index_hints
+                    if k in ("USE", "FORCE") for n in ns}
+        forced = any(k == "FORCE" and ns for k, ns in cop.index_hints)
         candidates = []
         for idx in info.indexes:
             from tidb_tpu.schema.model import SchemaState
             if idx.state != SchemaState.PUBLIC:
+                continue
+            if idx.name.lower() in ignored:
+                continue
+            if restrict and idx.name.lower() not in restrict:
                 continue
             offsets, fts = [], []
             ok = True
@@ -525,7 +560,7 @@ class Planner:
                 cost = rows * factor
                 if best is None or cost < best[3]:
                     best = (idx, path, cov, cost)
-            if best[3] >= scan_cost:
+            if best[3] >= scan_cost and not forced:
                 return reader            # table scan wins
             idx, path, covering, _cost = best
         else:
@@ -949,6 +984,25 @@ class Planner:
                 SchemaCol(n, "", e.ft) for n, e in
                 zip(proj_names, proj_exprs)])
             order_keys = None
+            if stmt.having is not None:
+                # HAVING without aggregates acts as a filter; MySQL
+                # resolves bare names against select aliases first
+                # (ref: executor tests, aggregate HAVING family)
+                def _subst(n):
+                    if isinstance(n, ast.ColName) and not n.table and \
+                            not self._column_shadows(plan.schema, n.name):
+                        # FROM-clause-first: a real column shadows the
+                        # alias (same rule as the agg HAVING path)
+                        for f in stmt.fields:
+                            if not isinstance(f.expr, ast.Star) and \
+                                    f.alias and \
+                                    f.alias.lower() == n.name.lower():
+                                return f.expr
+                    return n
+                h_ast = self._rewrite_ast(stmt.having, _subst)
+                plan = ph.PhysSelection(
+                    schema=plan.schema, children=[plan],
+                    cond=Resolver(plan.schema).resolve(h_ast))
 
         if stmt.distinct:
             # SQL order: projection -> DISTINCT -> ORDER BY -> LIMIT
@@ -1703,6 +1757,13 @@ class Planner:
                 by.append((order_keys[i][0], order_keys[i][1]))
                 continue
             target = self._maybe_alias_target(bi.expr, stmt)
+            if isinstance(target, ast.Literal) and \
+                    isinstance(target.value, int) and \
+                    1 <= target.value <= len(proj_exprs):
+                # ORDER BY <position> over a SELECT * projection (the
+                # alias map can't expand a Star field)
+                by.append((proj_exprs[target.value - 1], bi.desc))
+                continue
             # alias/output name -> reuse the projection expression
             try:
                 oi = out_schema.find(
@@ -1731,6 +1792,11 @@ class Planner:
             r = Resolver(PlanSchema([]))
             rows = []
             for vr in stmt.values:
+                if len(vr) == 0 and not stmt.columns:
+                    # INSERT t VALUES (): every column takes its default.
+                    # Only legal without an explicit column list (MySQL
+                    # 1136 otherwise — the count check below raises)
+                    vr = [ast.DefaultExpr() for _ in cols]
                 if len(vr) != len(cols):
                     raise PlanError("Column count doesn't match value count")
                 rows.append([None if isinstance(v, ast.DefaultExpr)

@@ -669,6 +669,7 @@ class Session:
             # executor/simple.go DoStmt)
             from tidb_tpu.plan.resolver import PlanSchema, Resolver
             import numpy as _np
+            stmt, _ = self._fold_session_exprs(stmt)  # @v / @v := ...
             r = Resolver(PlanSchema([]))
             for e in stmt.exprs:
                 try:
@@ -1285,6 +1286,11 @@ class Session:
             if name == "version":
                 from tidb_tpu.server import SERVER_VERSION
                 return True, SERVER_VERSION
+            if name == "tidb_current_ts":
+                # start ts of the open txn, 0 outside one (ref:
+                # sessionctx/variable TiDBCurrentTS)
+                return True, (self.txn.start_ts
+                              if self.txn is not None else 0)
             if name in self._CLIENT_SYSVAR_DEFAULTS:
                 return True, self._CLIENT_SYSVAR_DEFAULTS[name]
             raise SQLError(f"Unknown system variable '{e.name}'")
@@ -1513,9 +1519,16 @@ class Session:
     # -- SET / SHOW / EXPLAIN ------------------------------------------------
 
     def _exec_set(self, stmt: ast.SetStmt):
+        import dataclasses
         from tidb_tpu.plan.resolver import PlanSchema, Resolver
         r = Resolver(PlanSchema([]))
         for a in stmt.assignments:
+            # fold user-var reads PER assignment, after the previous
+            # ones applied: SET @a = 1, @b = @a + 1 is left-to-right
+            if isinstance(a.value, ast.ExprNode):
+                nv, changed = self._fold_session_exprs(a.value)
+                if changed:
+                    a = dataclasses.replace(a, value=nv)
             if isinstance(a.value, ast.ColName):
                 val = a.value.name  # bare words like STRICT
             else:

@@ -16,6 +16,9 @@ from __future__ import annotations
 
 import numpy as np
 
+import threading
+import weakref
+
 from tidb_tpu import codec, kv, tablecodec
 from tidb_tpu.chunk import Chunk, Column
 from tidb_tpu.schema.model import IndexInfo, SchemaState, TableInfo
@@ -167,13 +170,19 @@ def decode_datum_for_col(v, ft: FieldType):
     return v
 
 
+# auto-increment batch caches shared across per-statement Table objects:
+# storage -> {table_id: [next, last]} (ref: autoid.go:36 Allocator held
+# by the domain, not the statement)
+_AUTO_REGISTRY: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_AUTO_LOCK = threading.Lock()
+
+
 class Table:
     """Operations for one table inside caller-provided transactions."""
 
     def __init__(self, info: TableInfo, storage):
         self.info = info
         self.storage = storage  # for auto-id allocation meta txns
-        self._auto_cache: tuple[int, int] | None = None  # [next, last]
 
     # -- auto increment ------------------------------------------------------
 
@@ -183,25 +192,46 @@ class Table:
     # (MySQL reports the FIRST value generated by the last INSERT)
     first_alloc_id: int | None = None
 
+    def _auto_cache_slot(self) -> list:
+        """Shared [next, last] batch per (storage, table id). Table
+        objects are per-statement, but the allocator must persist across
+        statements like the reference's domain-held autoid.Allocator
+        (autoid.go:36) — else every INSERT burns a fresh 4000-id batch
+        and ids jump 1, 4001, 8001..."""
+        caches = _AUTO_REGISTRY.get(self.storage)
+        if caches is None:
+            caches = _AUTO_REGISTRY.setdefault(self.storage, {})
+        slot = caches.get(self.info.id)
+        if slot is None:
+            slot = caches[self.info.id] = [1, 0]   # empty range
+        return slot
+
     def alloc_auto_id(self, track: bool = True) -> int:
         out = None
-        if self._auto_cache is not None:
-            nxt, last = self._auto_cache
-            if nxt <= last:
-                self._auto_cache = (nxt + 1, last)
-                out = nxt
+        with _AUTO_LOCK:
+            slot = self._auto_cache_slot()
+            if slot[0] <= slot[1]:
+                out = slot[0]
+                slot[0] += 1
         if out is None:
+            # batch refill OUTSIDE the lock: the meta txn must not
+            # serialize inserts on unrelated tables. Two racing refills
+            # allocate distinct ranges (meta inc is transactional); the
+            # loser's leftover range is skipped, ids just gap.
             from tidb_tpu.meta import Meta
             txn = self.storage.begin()
             try:
-                first, last = Meta(txn).gen_auto_id(self.info.id,
-                                                    self.AUTO_ID_STEP)
+                first, last = Meta(txn).gen_auto_id(
+                    self.info.id, self.AUTO_ID_STEP)
                 txn.commit()
             except Exception:
                 txn.rollback()
                 raise
-            self._auto_cache = (first + 1, last)
             out = first
+            with _AUTO_LOCK:
+                slot = self._auto_cache_slot()
+                if last > slot[1]:
+                    slot[0], slot[1] = first + 1, last
         # only user-visible AUTO_INCREMENT allocations feed
         # LAST_INSERT_ID; the hidden _tidb_rowid handle does not (MySQL
         # returns 0 after inserting into a table with no auto column)
@@ -218,8 +248,14 @@ class Table:
         except Exception:
             txn.rollback()
             raise
-        if self._auto_cache is not None and at_least >= self._auto_cache[0]:
-            self._auto_cache = None
+        with _AUTO_LOCK:
+            slot = self._auto_cache_slot()
+            if slot[0] <= at_least <= slot[1]:
+                # explicit id landed inside the cached batch: skip past
+                # it (ref: autoid.go Rebase with newBase <= alloc.end)
+                slot[0] = at_least + 1
+            elif at_least > slot[1]:
+                slot[0], slot[1] = 1, 0   # force a fresh meta batch
 
     # -- write path ----------------------------------------------------------
 
