@@ -512,4 +512,13 @@ def _const_default(cd: ast.ColumnDef):
             from tidb_tpu import sqltypes as st
             return st.parse_datetime(v)
         return v
+    # DEFAULT CURRENT_TIMESTAMP[()] / NOW() on time columns: stored as
+    # a sentinel, evaluated at each insert (ref: ddl_api.go
+    # setDefaultValue + types CurrentTimestamp handling)
+    name = d.name.upper() if isinstance(d, (ast.ColName,
+                                            ast.FuncCall)) else ""
+    if name in ("CURRENT_TIMESTAMP", "NOW", "LOCALTIME",
+                "LOCALTIMESTAMP") and \
+            cd.ft.eval_type == EvalType.DATETIME:
+        return "CURRENT_TIMESTAMP"
     raise DDLError("only literal defaults supported")

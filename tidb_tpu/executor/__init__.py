@@ -1414,6 +1414,72 @@ class DeleteExec(Executor):
         return affected
 
 
+class MultiUpdateExec(Executor):
+    """UPDATE t1, t2 SET ... (ref: executor/write.go:479 multi-table
+    UpdateExec): one pass over the join result; each target updates its
+    matched rows, deduped per handle; assignment expressions evaluate
+    over the full join row, so t1's new value may read t2's columns."""
+
+    def __init__(self, plan: ph.PhysMultiUpdate):
+        self.plan = plan
+        self.reader = build_executor(plan.reader)
+
+    def execute(self, ctx: ExecContext) -> int:
+        per_target = []
+        for info, col_start, handle_idx, assigns in self.plan.targets:
+            per_target.append((Table(info, ctx.storage), info,
+                               col_start, handle_idx, assigns, set()))
+        affected = 0
+        for chunk in self.reader.chunks(ctx):
+            if chunk.num_rows == 0:
+                continue
+            for tbl, info, col_start, handle_idx, assigns, seen \
+                    in per_target:
+                hcol = chunk.columns[handle_idx]
+                cols = info.public_columns()
+                block = Chunk(chunk.columns[col_start:
+                                            col_start + len(cols)])
+                new_cols = {}
+                for cname, expr in assigns:
+                    new_cols[cname] = (expr, *expr.eval(chunk))
+                pk_name = info.pk_col_name.lower() \
+                    if info.pk_is_handle else None
+                for i in range(chunk.num_rows):
+                    if not hcol.valid[i]:
+                        continue    # outer-join padding: no row there
+                    handle = int(hcol.data[i])
+                    if handle in seen:
+                        continue
+                    seen.add(handle)
+                    old = _chunk_row_to_kvdatums(block, cols, i)
+                    new_vals = {}
+                    for cname, (expr, d, v) in new_cols.items():
+                        ci = info.col_by_name(cname)
+                        if not v[i]:
+                            new_vals[cname] = None
+                        elif ci.ft.eval_type == EvalType.DECIMAL:
+                            frac = expr.ft.frac if \
+                                expr.ft.eval_type == EvalType.DECIMAL \
+                                else ci.ft.frac
+                            new_vals[cname] = (frac, int(d[i]))
+                        else:
+                            new_vals[cname] = d[i].item() \
+                                if hasattr(d[i], "item") else d[i]
+                    if pk_name is not None and pk_name in new_vals and \
+                            new_vals[pk_name] is not None and \
+                            int(new_vals[pk_name]) != handle:
+                        merged = {}
+                        for c in cols:
+                            merged[c.name.lower()] = old.get(c.id)
+                        merged.update(new_vals)
+                        tbl.remove_record(ctx.txn, handle, old)
+                        tbl.add_record(ctx.txn, merged)
+                    else:
+                        tbl.update_record(ctx.txn, handle, old, new_vals)
+                    affected += 1
+        return affected
+
+
 class MultiDeleteExec(Executor):
     """DELETE t1, t2 FROM <join> (ref: executor/write.go:194
     deleteMultiTables): one pass over the join result; each target
@@ -1816,4 +1882,5 @@ _BUILDERS = {
     ph.PhysUpdate: UpdateExec,
     ph.PhysDelete: DeleteExec,
     ph.PhysMultiDelete: MultiDeleteExec,
+    ph.PhysMultiUpdate: MultiUpdateExec,
 }

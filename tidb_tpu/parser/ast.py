@@ -230,6 +230,7 @@ class Join(Node):
     tp: JoinType = JoinType.CROSS
     on: Optional[ExprNode] = None
     using: list = field(default_factory=list)
+    natural: bool = False    # NATURAL JOIN: USING(all common names)
 
 
 # ---------------------------------------------------------------------------

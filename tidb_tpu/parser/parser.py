@@ -557,6 +557,11 @@ class Parser:
                 if self.try_kw("ON"):
                     j.on = self.expr()
                 left = j
+            elif self.peek().tp == TokenType.IDENT and \
+                    self.peek().val.upper() == "NATURAL":
+                self.next()
+                left = self._join_rest(left)
+                left.natural = True     # join columns = common names
             else:
                 return left
 
@@ -602,8 +607,8 @@ class Parser:
         if self.try_kw("AS"):
             ts.alias = self.ident()
         elif self.peek().tp == TokenType.IDENT and \
-                self.peek().val.upper() not in ("LOCK",
-                                                "STRAIGHT_JOIN") and \
+                self.peek().val.upper() not in ("LOCK", "STRAIGHT_JOIN",
+                                                "NATURAL") and \
                 not self._at_index_hint():
             ts.alias = self.ident()
         while self._at_index_hint():

@@ -349,6 +349,15 @@ class PhysDelete(PhysPlan):
 
 
 @dataclass
+class PhysMultiUpdate(PhysPlan):
+    """UPDATE t1, t2 SET ... (ref: executor/write.go:479). Per target:
+    (TableInfo, col_start, handle_idx, [(col_name, Expression)])."""
+
+    targets: list = field(default_factory=list)
+    reader: PhysPlan = None
+
+
+@dataclass
 class PhysMultiDelete(PhysPlan):
     """DELETE t1, t2 FROM <join> (ref: executor/write.go:194
     deleteMultiTables). Per target: (TableInfo, col_start, handle_idx)

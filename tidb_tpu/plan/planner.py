@@ -352,7 +352,14 @@ class Planner:
             if node.on is not None:
                 r = Resolver(join.schema)
                 conds = [r.resolve(c) for c in split_conjuncts(node.on)]
-            for u in node.using:
+            using = list(node.using)
+            if node.natural:
+                # NATURAL JOIN: equijoin on every shared column name,
+                # in left-schema order (ref: MySQL natural join rules)
+                rnames = {c.name for c in right.schema.cols}
+                using = [c.name for c in left.schema.cols
+                         if c.name in rnames]
+            for u in using:
                 li = left.schema.find(u)
                 ri = right.schema.find(u)
                 conds.append(func(
@@ -360,6 +367,28 @@ class Planner:
                     ColumnRef(ri + len(left.schema), right.schema.cols[ri].ft)))
             for c in conds:
                 self._assign_cond(join, c, where_phase=False)
+            if using:
+                # USING/NATURAL coalesce the join columns: they appear
+                # ONCE (from the row-preserving side), first, then the
+                # remaining left then right columns — and unqualified
+                # references to them are not ambiguous
+                nl = len(left.schema)
+                u_low = [u.lower() for u in using]
+                take = []
+                for u in u_low:
+                    take.append(right.schema.find(u) + nl
+                                if tp == "right" else left.schema.find(u))
+                for i, c in enumerate(left.schema.cols):
+                    if c.name.lower() not in u_low:
+                        take.append(i)
+                for i, c in enumerate(right.schema.cols):
+                    if c.name.lower() not in u_low:
+                        take.append(nl + i)
+                cols = [join.schema.cols[i] for i in take]
+                return ph.PhysProjection(
+                    schema=PlanSchema(list(cols)), children=[join],
+                    exprs=[ColumnRef(i, join.schema.cols[i].ft)
+                           for i in take])
             return join
         raise PlanError(f"unsupported FROM {type(node).__name__}")
 
@@ -1876,9 +1905,9 @@ class Planner:
                                   count=limit)
         return reader
 
-    def plan_update(self, stmt: ast.UpdateStmt) -> ph.PhysUpdate:
+    def plan_update(self, stmt: ast.UpdateStmt) -> ph.PhysPlan:
         if not isinstance(stmt.table, ast.TableSource):
-            raise PlanError("multi-table UPDATE not supported")
+            return self.plan_multi_update(stmt)
         info, reader = self._plan_writable_reader(stmt.table, stmt.where)
         reader = self._order_limit_reader(reader, stmt.order_by,
                                           stmt.limit)
@@ -1890,6 +1919,85 @@ class Planner:
             assigns.append((a.col.name.lower(), r.resolve(
                 self._fold_default(a.expr, info, a.col.name))))
         return ph.PhysUpdate(table=info, reader=reader, assignments=assigns)
+
+    def plan_multi_update(self, stmt: ast.UpdateStmt) -> ph.PhysPlan:
+        """UPDATE t1, t2 SET ... / UPDATE <join> SET ... (ref:
+        executor/write.go:479 multi-table UpdateExec): targets are the
+        tables whose columns are assigned; their readers carry row
+        handles through the join; assignments may read any table."""
+        if stmt.order_by or stmt.limit is not None:
+            raise PlanError(
+                "multi-table UPDATE does not allow ORDER BY/LIMIT")
+        sources: dict[str, ast.TableSource] = {}
+
+        def walk(node):
+            if isinstance(node, ast.TableSource):
+                sources[node.ref_name.lower()] = node
+            elif isinstance(node, ast.Join):
+                walk(node.left)
+                walk(node.right)
+            elif node is not None:
+                raise PlanError(
+                    "multi-table UPDATE supports plain table joins")
+        walk(stmt.table)
+
+        def target_of(col: ast.ColName) -> str:
+            if col.table:
+                key = col.table.lower()
+                if key in sources and (not col.db or (
+                        sources[key].db or self.db).lower()
+                        == col.db.lower()):
+                    return key
+                for k, ts in sources.items():   # db-qualified, aliased
+                    if ts.name.lower() == col.table.lower() and \
+                            (not col.db or (ts.db or self.db).lower()
+                             == col.db.lower()):
+                        return k
+                raise PlanError(f"Unknown table '{col.table}' in UPDATE")
+            cands = [k for k, ts in sources.items()
+                     if self._table_info(ts)[1].col_by_name(col.name)]
+            if len(cands) > 1:
+                raise PlanError(f"Column '{col.name}' is ambiguous")
+            if not cands:
+                raise PlanError(f"Unknown column '{col.name}'")
+            return cands[0]
+
+        per_ref: dict[str, list] = {}
+        for a in stmt.assignments:
+            per_ref.setdefault(target_of(a.col), []).append(a)
+
+        self._handle_refs = set(per_ref)
+        try:
+            plan = self.build_from(stmt.table)
+            if stmt.where is not None:
+                r = Resolver(plan.schema)
+                for c_ast in split_conjuncts(stmt.where):
+                    plan = self._assign_cond(plan, r.resolve(c_ast), True)
+        finally:
+            self._handle_refs = set()
+
+        r = Resolver(plan.schema)
+        targets = []
+        for key, assigns_ast in per_ref.items():
+            _db, info = self._table_info(sources[key])
+            handle_idx = col_start = None
+            for i, sc in enumerate(plan.schema.cols):
+                if sc.table != key:
+                    continue
+                if col_start is None:
+                    col_start = i
+                if sc.name == "_handle":
+                    handle_idx = i
+            if handle_idx is None:
+                raise PlanError(f"no handle for target '{key}'")
+            assigns = []
+            for a in assigns_ast:
+                if info.col_by_name(a.col.name) is None:
+                    raise PlanError(f"Unknown column '{a.col.name}'")
+                assigns.append((a.col.name.lower(), r.resolve(
+                    self._fold_default(a.expr, info, a.col.name))))
+            targets.append((info, col_start, handle_idx, assigns))
+        return ph.PhysMultiUpdate(targets=targets, reader=plan)
 
     def plan_delete(self, stmt: ast.DeleteStmt):
         if stmt.targets:

@@ -174,6 +174,22 @@ _BIN_OPS = {"+": Op.PLUS, "-": Op.MINUS, "*": Op.MUL, "/": Op.DIV,
             "<<": Op.SHL, ">>": Op.SHR}
 
 
+def _expr_key(e):
+    """Structural identity of a resolved expression: column INDEXES
+    (names are display-only and can collide across tables)."""
+    if e is None:
+        return None
+    if isinstance(e, ColumnRef):
+        return ("col", e.idx)
+    if isinstance(e, Constant):
+        return ("const", repr(e.value))
+    args = getattr(e, "args", None)
+    if args is not None:
+        return (type(e).__name__, getattr(e, "op", None),
+                tuple(_expr_key(a) for a in args))
+    return repr(e)
+
+
 class Resolver:
     """Resolves AST exprs against a PlanSchema. When `agg_collector` is set,
     AggregateCall nodes are collected as AggDescs and replaced by refs into
@@ -537,9 +553,13 @@ class Resolver:
             arg = self.resolve(e.args[0])
         desc = AggDesc(fn, arg, distinct=e.distinct,
                        sep=getattr(e, "sep", ","))
-        # reuse identical agg (same fn/arg repr)
+
+        # reuse identical aggs — compared STRUCTURALLY (column indexes,
+        # not display names: max(a.b) and max(b.b) both repr as max(b))
+        def key(d):
+            return (d.fn, d.distinct, d.sep, _expr_key(d.arg))
         for i, d in enumerate(self.aggs):
-            if repr(d) == repr(desc):
+            if key(d) == key(desc):
                 return ColumnRef(self.agg_base + i, d.result_ft)
         self.aggs.append(desc)
         return ColumnRef(self.agg_base + len(self.aggs) - 1, desc.result_ft)

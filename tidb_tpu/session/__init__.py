@@ -948,6 +948,18 @@ class Session:
             for db, tbl in _referenced_tables([stmt.refs, stmt.where]):
                 need(db or self.current_db, tbl, Priv.SELECT, "SELECT")
             return
+        if isinstance(stmt, ast.UpdateStmt) and \
+                not isinstance(stmt.table, ast.TableSource):
+            # multi-table UPDATE: UPDATE+SELECT on every joined table
+            # (conservative superset of MySQL's assigned-only UPDATE),
+            # SELECT on tables read by WHERE/SET subqueries
+            for db, tbl in _referenced_tables([stmt.table]):
+                need(db or self.current_db, tbl, Priv.UPDATE, "UPDATE")
+                need(db or self.current_db, tbl, Priv.SELECT, "SELECT")
+            for db, tbl in _referenced_tables(
+                    [stmt.where, stmt.assignments]):
+                need(db or self.current_db, tbl, Priv.SELECT, "SELECT")
+            return
         if isinstance(stmt, (ast.InsertStmt, ast.UpdateStmt,
                              ast.DeleteStmt, ast.LoadDataStmt)):
             want, what = {

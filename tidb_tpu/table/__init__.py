@@ -283,6 +283,9 @@ class Table:
                     v = self.alloc_auto_id()
                 elif col.has_default:
                     v = col.default
+                    if v == "CURRENT_TIMESTAMP" and \
+                            col.ft.eval_type == EvalType.DATETIME:
+                        v = _now_micros()   # evaluated per insert
                 elif col.ft.not_null and col.state == SchemaState.PUBLIC:
                     raise kv.KVError(f"column '{col.name}' cannot be null")
                 else:
