@@ -43,6 +43,7 @@ import json
 import math
 import os
 import sys
+import threading
 import time
 
 # Persistent XLA compilation cache: first-compile of the big fused query
@@ -105,37 +106,111 @@ def _kernel_micro() -> float:
     return chunk.num_rows * iters / dt
 
 
-def _probe_devices(timeout_s: int = 120) -> bool:
-    """True if jax.devices() answers within timeout in a THROWAWAY
-    subprocess. A dead chip tunnel makes any jax call in-process hang
-    unrecoverably, so the probe must be expendable."""
+_PROBE_CODE = (
+    "import json, jax\n"
+    "ds = jax.devices()\n"
+    "print('BENCH_PROBE ' + json.dumps({\n"
+    "    'platform': ds[0].platform,\n"
+    "    'device_count': len(ds),\n"
+    "    'device_kinds': sorted({d.device_kind for d in ds}),\n"
+    "}))\n"
+)
+
+
+def _probe_devices(timeout_s: int = 120):
+    """-> device-inventory dict if jax.devices() answers within timeout
+    in a THROWAWAY subprocess, else None. A dead chip tunnel makes any
+    jax call in-process hang unrecoverably, so the probe must be
+    expendable — the bench process itself NEVER touches backend init
+    until a probe has answered (or it has pinned itself to CPU)."""
     import subprocess
     try:
-        r = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; jax.devices(); print('ok')"],
-            timeout=timeout_s, capture_output=True, text=True)
-        return "ok" in r.stdout
+        r = subprocess.run([sys.executable, "-c", _PROBE_CODE],
+                           timeout=timeout_s, capture_output=True,
+                           text=True)
     except (subprocess.TimeoutExpired, OSError):
-        return False
+        return None
+    for line in r.stdout.splitlines():
+        if line.startswith("BENCH_PROBE "):
+            try:
+                return json.loads(line[len("BENCH_PROBE "):])
+            except ValueError:
+                return None
+    return None
 
 
-def _probe_devices_with_retry() -> bool:
-    """The chip tunnel flaps: one failed 120s probe must not condemn
-    the whole run to the CPU fallback. Retries with backoff, ~4.5
-    minutes at the defaults (BENCH_PROBE_ATTEMPTS / BENCH_PROBE_TIMEOUT
-    override)."""
-    attempts = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "2"))
-    timeout_s = int(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
-    for i in range(attempts):
-        if _probe_devices(timeout_s):
-            return True
-        if i < attempts - 1:
-            wait = 30 * (i + 1)
-            print(f"[bench] device probe {i + 1}/{attempts} failed; "
-                  f"retrying in {wait}s", file=sys.stderr, flush=True)
-            time.sleep(wait)
-    return False
+class _DeviceProber:
+    """Background chip acquisition: probes the TPU tunnel in short-lived
+    subprocesses and KEEPS re-probing across the whole run, snapshotting
+    the device inventory the moment the tunnel answers (VERDICT "Next
+    round" #1 — the same expendable-subprocess trick as
+    __graft_entry__.py:72-96). The bench decides device-vs-CPU once at
+    the initial window; a late answer can't switch an initialized jax
+    platform mid-process, but it IS recorded in the report so the driver
+    knows the tunnel recovered and a re-run would land on chip."""
+
+    def __init__(self):
+        self.attempts = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "2"))
+        self.timeout_s = int(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
+        self.reprobe_interval = int(
+            os.environ.get("BENCH_REPROBE_INTERVAL", "60"))
+        self.snapshot = None         # first successful inventory
+        self.snapshot_at = None      # perf_counter of that success
+        self._initial_done = threading.Event()
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="bench-device-prober")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        # initial window: `attempts` probes with backoff (the decision
+        # gate), then periodic re-probes until success or run end
+        for i in range(self.attempts):
+            if self._stop.is_set():
+                self._initial_done.set()
+                return
+            got = _probe_devices(self.timeout_s)
+            if got is not None:
+                self._record(got)
+                self._initial_done.set()
+                return
+            if i < self.attempts - 1:
+                wait = 30 * (i + 1)
+                print(f"[bench] device probe {i + 1}/{self.attempts} "
+                      f"failed; retrying in {wait}s",
+                      file=sys.stderr, flush=True)
+                if self._stop.wait(wait):
+                    self._initial_done.set()
+                    return
+        self._initial_done.set()
+        while not self._stop.wait(self.reprobe_interval):
+            got = _probe_devices(self.timeout_s)
+            if got is not None and got.get("platform") != "cpu":
+                # a REAL chip answered late — the recovery worth
+                # reporting; cpu-only answers say nothing new about the
+                # tunnel, so keep probing
+                self._record(got)
+                return
+
+    def _record(self, got: dict) -> None:
+        # order matters: main() reads `snapshot` unlocked as the
+        # "did it answer" flag, so its timestamp must already be set
+        self.snapshot_at = time.perf_counter()
+        self.snapshot = got
+        print(f"[bench] tunnel answered: {got}", file=sys.stderr,
+              flush=True)
+
+    def wait_initial(self) -> bool:
+        """Block until the initial probe window resolves.
+        -> True when a device answered within it."""
+        self._initial_done.wait()
+        return self.snapshot is not None
+
+    def stop(self) -> None:
+        self._stop.set()
 
 
 # HBM peak per chip family (public figures, GB/s) for the roofline
@@ -200,10 +275,11 @@ def main() -> None:
     regions = int(os.environ.get("BENCH_REGIONS", "4"))
 
     device_fallback = None
-    if os.environ.get("BENCH_SKIP_PROBE", "0") != "1" and \
-            not _probe_devices_with_retry():
-        # chip tunnel down: measure CPU-XLA vs numpy rather than hang
-        print("[bench] device probes exhausted; falling back to CPU XLA",
+    prober = None
+
+    def fallback_to_cpu(reason: str) -> None:
+        nonlocal sf, iters, host_iters, device_fallback
+        print(f"[bench] {reason}: falling back to CPU XLA",
               file=sys.stderr, flush=True)
         import jax
         jax.config.update("jax_platforms", "cpu")
@@ -212,7 +288,7 @@ def main() -> None:
         # different virtualized feature set (prefer-no-scatter etc.),
         # which deoptimizes scatter-heavy programs ~5x (measured on Q3)
         jax.config.update("jax_compilation_cache_dir", None)
-        device_fallback = "cpu (chip tunnel unavailable)"
+        device_fallback = f"cpu ({reason})"
         if "BENCH_SF" not in os.environ:
             # CPU XLA runs the warm path ~20-40x slower than a chip;
             # full sf=1 would blow typical harness timeouts. The metric
@@ -220,6 +296,24 @@ def main() -> None:
             sf = float(os.environ.get("BENCH_CPU_SF", "0.2"))
             iters = min(iters, 2)
             host_iters = 1
+
+    if os.environ.get("BENCH_SKIP_PROBE", "0") != "1":
+        prober = _DeviceProber()
+        prober.start()
+        if not prober.wait_initial():
+            # chip tunnel down: measure CPU-XLA vs numpy rather than
+            # hang. The prober keeps re-probing in the background so the
+            # report still records the moment the tunnel answers.
+            fallback_to_cpu("chip tunnel unavailable")
+        elif prober.snapshot.get("platform") == "cpu":
+            # the probe ANSWERED but with host CPU only — no accelerator
+            # behind the tunnel. Same CPU economics apply, and crucially
+            # the persistent compile cache must not serve entries built
+            # for a different host feature set.
+            prober.stop()
+            fallback_to_cpu("no accelerator visible")
+        else:
+            prober.stop()   # a real chip answered: run on it
 
     from tidb_tpu import config
     from tidb_tpu.benchmarks import tpch
@@ -260,6 +354,8 @@ def main() -> None:
                     "memory_roofline_source": roof_src}
     if device_fallback:
         detail["device_platform_fallback"] = device_fallback
+    if prober is not None and prober.snapshot is not None:
+        detail["device_probe"] = prober.snapshot
     speedups = []
     device_rps = []
     rooflines = []
@@ -318,6 +414,17 @@ def main() -> None:
             detail["kernel_only_q1_rows_per_sec"] = round(_kernel_micro(), 1)
         except Exception as e:  # noqa: BLE001 - micro is informational
             detail["kernel_only_error"] = str(e)
+
+    if prober is not None:
+        prober.stop()
+        if device_fallback and prober.snapshot is not None and \
+                prober.snapshot.get("platform") != "cpu":
+            # a real chip answered AFTER the CPU decision: too late to
+            # switch an initialized platform, but the driver should know
+            # a re-run would land on chip (and which one)
+            detail["device_probe_late"] = prober.snapshot
+            detail["device_probe_late_after_secs"] = round(
+                prober.snapshot_at - t_start, 1)
 
     geo_rps = math.exp(sum(math.log(x) for x in device_rps)
                        / len(device_rps))

@@ -91,6 +91,42 @@ class TestErrcode:
         assert errcode.classify(
             SchemaError("Table 'x' doesn't exist"))[0] == 1146
 
+    def test_classify_storage_retryable_classes(self):
+        """The 9xxx storage range (ref: terror.go): all retryable,
+        including the region-stream-interrupted class raised when a
+        streamed coprocessor reply dies past its resume budget."""
+        from tidb_tpu import kv
+        cases = {
+            kv.StreamInterruptedError("mid"):
+                errcode.ER_REGION_STREAM_INTERRUPTED,
+            kv.EpochNotMatchError(3): errcode.ER_REGION_UNAVAILABLE,
+            kv.NotLeaderError(1, 2): errcode.ER_REGION_UNAVAILABLE,
+            kv.ServerBusyError("busy"): errcode.ER_TIKV_SERVER_BUSY,
+        }
+        for exc, want in cases.items():
+            code, state, _ = errcode.classify(exc)
+            assert code == want and state == "HY000"
+            assert errcode.is_retryable(code)
+        # GC-too-early maps but is NOT retryable: the snapshot aged out
+        # and re-running the same ts can never succeed
+        code, state, _ = errcode.classify(kv.GCTooEarlyError("old"))
+        assert code == errcode.ER_GC_TOO_EARLY
+        assert not errcode.is_retryable(code)
+        # lock waits retry; user mistakes do not
+        assert errcode.is_retryable(errcode.ER_LOCK_DEADLOCK)
+        assert not errcode.is_retryable(errcode.ER_DUP_ENTRY)
+
+    def test_sqlstate_catalog_consistent(self):
+        """Every catalogued code carries a sqlstate; retryables are all
+        in the catalog."""
+        codes = {v for k, v in vars(errcode).items()
+                 if k.startswith("ER_") and isinstance(v, int)}
+        assert len(codes) >= 75
+        for c in errcode.RETRYABLE:
+            assert c in codes
+        for c, state in errcode._SQLSTATE.items():
+            assert c in codes and len(state) == 5
+
     def test_classify_by_message(self):
         from tidb_tpu.session import SQLError
         assert errcode.classify(

@@ -222,6 +222,17 @@ class TestInfoAndMisc:
 
 
 class TestCompressionCrypto:
+    def test_aes128_fallback_matches_fips197(self):
+        """The pure-python AES fallback (util/aes128.py, used when the
+        `cryptography` package is absent) is the FIPS-197 cipher: the
+        appendix C.1 vector must round-trip exactly."""
+        from tidb_tpu.util.aes128 import decrypt_block, encrypt_block
+        key = bytes(range(16))
+        pt = bytes.fromhex("00112233445566778899aabbccddeeff")
+        ct = encrypt_block(key, pt)
+        assert ct.hex() == "69c4e0d86a7b0430d8cdb78070b4c55a"
+        assert decrypt_block(key, ct) == pt
+
     def test_compress_round_trip(self, sess):
         assert one(sess, "UNCOMPRESS(COMPRESS('hello world'))") == \
             "hello world"

@@ -1,0 +1,136 @@
+"""Static wire-path invariants, enforced as a test so they cannot
+silently regress:
+
+  1. No wire-path module imports pickle. The typed codec (store/wire.py)
+     exists so that DECODING NEVER EXECUTES CODE; one convenient
+     `pickle.loads` on a socket path would reopen that hole. Trusted
+     local-disk snapshots live in store/snapshot.py, deliberately OFF
+     this list.
+  2. Every socket `recv` happens inside the one bounded, length-checked
+     helper (`_recv_exact`), which itself must loop on an explicit
+     remaining-byte count. Ad-hoc `sock.recv(65536)`-style loops are how
+     partial reads turn into frame desync.
+
+Checked by AST walk, not regex, so comments/strings can't fool it and
+renamed imports (`import pickle as p`) can't slip through.
+"""
+
+import ast
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the wire path: every module that builds, parses, or routes frames
+WIRE_PATH_FILES = [
+    "tidb_tpu/store/wire.py",
+    "tidb_tpu/store/remote.py",
+    "tidb_tpu/store/stream.py",
+    "tidb_tpu/store/copr.py",
+    "tidb_tpu/store/region_cache.py",
+    "tidb_tpu/mockstore/rpc.py",
+]
+
+# the only functions allowed to call socket .recv(); each must be a
+# bounded loop over an explicit byte count
+RECV_HELPERS = {"_recv_exact"}
+
+
+def _tree(relpath):
+    with open(os.path.join(REPO, relpath)) as f:
+        return ast.parse(f.read(), filename=relpath)
+
+
+@pytest.mark.parametrize("relpath", WIRE_PATH_FILES)
+def test_no_pickle_on_wire_path(relpath):
+    offenders = []
+    for node in ast.walk(_tree(relpath)):
+        if isinstance(node, ast.Import):
+            offenders += [a.name for a in node.names
+                          if a.name.split(".")[0] in ("pickle", "cPickle",
+                                                      "dill", "shelve",
+                                                      "marshal")]
+        elif isinstance(node, ast.ImportFrom):
+            if node.module and node.module.split(".")[0] in (
+                    "pickle", "cPickle", "dill", "shelve", "marshal"):
+                offenders.append(node.module)
+    assert not offenders, (
+        f"{relpath} imports {offenders}: wire-path modules must stay "
+        "pickle-free (trusted on-disk snapshots belong in "
+        "store/snapshot.py)")
+
+
+def _functions_calling_recv(tree):
+    """Function names (qualified by nesting) whose bodies call `.recv`."""
+    out = {}
+
+    class V(ast.NodeVisitor):
+        def __init__(self):
+            self.stack = []
+
+        def _visit_func(self, node):
+            self.stack.append(node.name)
+            self.generic_visit(node)
+            self.stack.pop()
+
+        visit_FunctionDef = _visit_func
+        visit_AsyncFunctionDef = _visit_func
+
+        def visit_Call(self, node):
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and fn.attr == "recv":
+                name = self.stack[-1] if self.stack else "<module>"
+                out.setdefault(name, []).append(node)
+            self.generic_visit(node)
+
+    V().visit(tree)
+    return out
+
+
+@pytest.mark.parametrize("relpath", WIRE_PATH_FILES)
+def test_every_recv_is_length_prefixed_and_bounded(relpath):
+    callers = _functions_calling_recv(_tree(relpath))
+    rogue = set(callers) - RECV_HELPERS
+    assert not rogue, (
+        f"{relpath}: socket recv outside the bounded helper(s) "
+        f"{sorted(RECV_HELPERS)}: {sorted(rogue)} — all frame reads "
+        "must go through the length-prefixed _recv_exact loop")
+    for name, calls in callers.items():
+        for call in calls:
+            # recv(k) must pass a computed remaining-count expression,
+            # never no-arg / constant-buffer style
+            assert call.args and not isinstance(call.args[0],
+                                                ast.Constant), (
+                f"{relpath}:{call.lineno}: recv must take the exact "
+                "remaining byte count")
+
+
+def test_recv_helper_exists_and_loops():
+    """The helper itself: a while-loop accumulating toward an explicit
+    n, raising on EOF (no silent short read)."""
+    tree = _tree("tidb_tpu/store/remote.py")
+    helper = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and \
+                node.name == "_recv_exact":
+            helper = node
+            break
+    assert helper is not None, "store/remote.py lost _recv_exact"
+    has_loop = any(isinstance(n, ast.While) for n in ast.walk(helper))
+    raises = any(isinstance(n, ast.Raise) for n in ast.walk(helper))
+    assert has_loop and raises, (
+        "_recv_exact must loop to the requested count and raise on EOF")
+
+
+def test_wire_registry_is_closed():
+    """decode() only constructs registry types: spot-check that the
+    registry install function exists and no `eval`/`exec`/`__import__`
+    appears anywhere in the codec."""
+    tree = _tree("tidb_tpu/store/wire.py")
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Name) and \
+                node.func.id in ("eval", "exec", "__import__", "compile"):
+            raise AssertionError(
+                f"store/wire.py:{node.lineno} calls {node.func.id}")

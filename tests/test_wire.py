@@ -230,7 +230,149 @@ class TestFuzz:
         with pytest.raises(wire.WireError):
             wire.decode(wire.encode(1) + b"\x00")
 
-    def test_no_pickle_import_on_wire_path(self):
-        import tidb_tpu.store.wire as w
-        src = open(w.__file__).read()
-        assert "import pickle" not in src and "cPickle" not in src
+    # (the no-pickle invariant moved to tests/test_lint_wire.py, which
+    # checks the whole wire path by AST walk instead of substring grep)
+
+
+class TestStreamWire:
+    """Multi-frame streamed replies (Cmd.COP_STREAM): the credit
+    protocol's state machines must reject every malformed sequence
+    LOUDLY — truncated frames, frames after end, credit violations,
+    interleaved non-stream statuses — and can never deadlock (both
+    machines are synchronous; rejection is an exception, not a wait)."""
+
+    def _frame(self, last=False, start=b"a", end=b"b"):
+        from tidb_tpu.store.stream import StreamFrame
+        c1 = Column(new_int_field(), np.arange(4),
+                    np.ones(4, bool))
+        return StreamFrame(Chunk([c1]), kv.KVRange(start, end), last)
+
+    def test_stream_frame_round_trip(self):
+        f = self._frame(last=True)
+        got = wire.decode(wire.encode(f))
+        assert type(got) is type(f)
+        assert got.last is True
+        assert got.range == kv.KVRange(b"a", b"b")
+        assert np.array_equal(got.chunk.columns[0].data,
+                              f.chunk.columns[0].data)
+        empty = wire.decode(wire.encode(
+            self._frame().__class__(None, kv.KVRange(b"x", b"y"), False)))
+        assert empty.chunk is None and not empty.last
+
+    def test_stream_interrupted_error_round_trip(self):
+        got = wire.decode(wire.encode(kv.StreamInterruptedError("mid")))
+        assert type(got) is kv.StreamInterruptedError
+
+    def test_truncated_stream_frames_rejected(self):
+        payload = wire.encode(self._frame())
+        r = wire.StreamReader(4)
+        for cut in range(len(payload)):
+            with pytest.raises(wire.WireError):
+                wire.StreamReader(4).feed(wire.STATUS_STREAM_FRAME,
+                                          payload[:cut])
+        # the intact payload still feeds fine afterwards
+        kind, frame = r.feed(wire.STATUS_STREAM_FRAME, payload)
+        assert kind == "frame" and frame.range.start == b"a"
+
+    def test_frame_after_end_rejected(self):
+        r = wire.StreamReader(4)
+        assert r.feed(wire.STATUS_STREAM_END, wire.encode(None)) == \
+            ("end", None)
+        with pytest.raises(wire.WireError):
+            r.feed(wire.STATUS_STREAM_FRAME, wire.encode(self._frame()))
+
+    def test_credit_violation_fails_loudly(self):
+        r = wire.StreamReader(2)
+        payload = wire.encode(self._frame())
+        r.feed(wire.STATUS_STREAM_FRAME, payload)
+        r.feed(wire.STATUS_STREAM_FRAME, payload)
+        # third frame without a grant: the peer ignored backpressure
+        with pytest.raises(wire.WireError, match="credit violation"):
+            r.feed(wire.STATUS_STREAM_FRAME, payload)
+        # granting reopens the window on a fresh reader
+        r2 = wire.StreamReader(1)
+        r2.feed(wire.STATUS_STREAM_FRAME, payload)
+        r2.grant(1)
+        kind, _ = r2.feed(wire.STATUS_STREAM_FRAME, payload)
+        assert kind == "frame"
+
+    def test_interleaved_plain_reply_rejected(self):
+        """A non-stream status mid-stream = two replies interleaved on
+        one connection: reject, never misparse."""
+        r = wire.StreamReader(4)
+        for status in (wire.STATUS_OK, wire.STATUS_OK_TRACED,
+                       wire.STATUS_CREDIT, 99):
+            with pytest.raises(wire.WireError):
+                wire.StreamReader(4).feed(status, wire.encode(1))
+        assert r.feed(wire.STATUS_STREAM_END, wire.encode(None))[0] == \
+            "end"
+
+    def test_non_streamframe_payload_rejected(self):
+        with pytest.raises(wire.WireError, match="StreamFrame"):
+            wire.StreamReader(4).feed(wire.STATUS_STREAM_FRAME,
+                                      wire.encode({"not": "a frame"}))
+
+    def test_malformed_frame_fields_rejected(self):
+        """The struct codec will happily encode None/str into any
+        field; the reader must reject shapes the consumer would
+        dereference (range=None was an AttributeError in the resume
+        path, not a WireError, before this check)."""
+        from tidb_tpu.store.stream import StreamFrame
+        bad = [
+            StreamFrame(None, None, False),                 # range=None
+            StreamFrame(None, kv.KVRange(b"a", None), True),
+            StreamFrame(None, kv.KVRange(None, b"b"), False),
+            StreamFrame(None, kv.KVRange(b"a", b"b"), None),  # last=None
+        ]
+        for f in bad:
+            with pytest.raises(wire.WireError, match="malformed"):
+                wire.StreamReader(4).feed(wire.STATUS_STREAM_FRAME,
+                                          wire.encode(f))
+
+    def test_typed_error_terminates_stream(self):
+        r = wire.StreamReader(4)
+        with pytest.raises(kv.ServerBusyError):
+            r.feed(wire.STATUS_ERR, wire.encode(kv.ServerBusyError("b")))
+        assert r.done
+
+    def test_credit_gate_validates_grants(self):
+        g = wire.CreditGate(2)
+        g.consume()
+        g.consume()
+        with pytest.raises(wire.WireError):
+            g.consume()                      # window exhausted
+        with pytest.raises(wire.WireError):
+            g.feed_grant(wire.STATUS_OK, wire.encode(1))
+        with pytest.raises(wire.WireError):
+            g.feed_grant(wire.STATUS_CREDIT, wire.encode(0))
+        with pytest.raises(wire.WireError):
+            g.feed_grant(wire.STATUS_CREDIT, wire.encode(-3))
+        with pytest.raises(wire.WireError):
+            g.feed_grant(wire.STATUS_CREDIT, wire.encode("lots"))
+        with pytest.raises(wire.WireError):
+            g.feed_grant(wire.STATUS_CREDIT, b"\xff\xff")   # truncated
+        g.feed_grant(wire.STATUS_CREDIT, wire.encode(1))
+        g.consume()
+        assert g.sent == 3 and g.received == 1 and g.outstanding == 2
+
+    def test_bad_credit_windows_rejected(self):
+        for bad in (0, -1, wire.MAX_STREAM_CREDIT + 1):
+            with pytest.raises(wire.WireError):
+                wire.StreamReader(bad)
+        for bad in (0, -1, True, "4", None, 1 << 40):
+            with pytest.raises(wire.WireError):
+                wire.CreditGate(bad)
+
+    def test_fuzzed_stream_frames_never_crash(self):
+        rnd = random.Random(99)
+        base = wire.encode(self._frame())
+        for _ in range(2000):
+            buf = bytearray(base)
+            for _ in range(rnd.randint(1, 6)):
+                buf[rnd.randrange(len(buf))] = rnd.randrange(256)
+            r = wire.StreamReader(4)
+            try:
+                r.feed(wire.STATUS_STREAM_FRAME, bytes(buf))
+            except wire.WireError:
+                pass    # rejection is the contract
+            # anything else (crash/hang/other exception) fails the test

@@ -23,7 +23,8 @@ import threading
 __all__ = ["get_var", "set_var", "all_vars", "coerce", "session_overlay",
            "current_overlay", "device_enabled", "chunk_cache_enabled",
            "cop_concurrency", "sort_spill_rows", "device_min_rows",
-           "stream_rows", "UnknownVariableError"]
+           "stream_rows", "copr_stream_enabled", "copr_stream_frame_bytes",
+           "copr_stream_credit", "UnknownVariableError"]
 
 
 class UnknownVariableError(Exception):
@@ -58,6 +59,21 @@ _DEFS: dict[str, tuple[str, int]] = {
     # should engage only when tables genuinely outgrow memory. Lower it
     # per deployment (SET tidb_tpu_stream_rows = ...) to cap footprint.
     "tidb_tpu_stream_rows": (_INT, 1 << 23),
+    # streaming coprocessor (store/stream.py; ref: CmdCopStream,
+    # store/tikv/coprocessor.go:547-555): storage yields framed partial
+    # responses per contiguous key range instead of materializing one
+    # response list per region. 0 = materialized path (default: streaming
+    # trades the chunk cache's hot-scan residency for bounded memory, so
+    # it must be an explicit choice per session or deployment).
+    "tidb_tpu_copr_stream": (_BOOL, 0),
+    # response-size cap: a streamed frame never carries more than this
+    # many raw scanned bytes (the bound that makes SF>=1 scans run in
+    # constant client memory)
+    "tidb_tpu_copr_stream_frame_bytes": (_INT, 4 << 20),
+    # credit window: max frames in flight past the consumer (client
+    # grants N outstanding frames; the producer blocks past the window —
+    # a slow consumer backpressures the server instead of buffering)
+    "tidb_tpu_copr_stream_credit": (_INT, 4),
     # statements at/above this wall time land in the slow-query log
     # (ref: config.Log.SlowThreshold, default 300ms)
     "tidb_tpu_slow_query_ms": (_INT, 300),
@@ -206,3 +222,17 @@ def device_min_rows() -> int:
 
 def stream_rows() -> int:
     return _read("tidb_tpu_stream_rows")
+
+
+def copr_stream_enabled() -> bool:
+    return bool(_read("tidb_tpu_copr_stream"))
+
+
+def copr_stream_frame_bytes() -> int:
+    # clamp both ends: the sysvar is unbounded, the wire/shim contract
+    # (mockstore/rpc.py validation) is not
+    return min(max(1, _read("tidb_tpu_copr_stream_frame_bytes")), 1 << 30)
+
+
+def copr_stream_credit() -> int:
+    return max(1, _read("tidb_tpu_copr_stream_credit"))

@@ -11,9 +11,10 @@ from __future__ import annotations
 
 import re
 
-__all__ = ["classify", "ER_UNKNOWN"]
+__all__ = ["classify", "is_retryable", "ER_UNKNOWN"]
 
-# -- the catalog (subset the engine can actually raise) ----------------------
+# -- the catalog (ref: mysql/errcode.go; MySQL range 1xxx/3xxx plus the
+# reference's own 8xxx planner/DDL and 9xxx storage ranges) ------------------
 
 ER_DUP_ENTRY = 1062
 ER_NO_SUCH_TABLE = 1146
@@ -40,6 +41,111 @@ ER_QUERY_INTERRUPTED = 1317
 ER_NO_SUCH_THREAD = 1094
 ER_UNKNOWN = 1105
 
+# server / connection
+ER_CON_COUNT_ERROR = 1040
+ER_OUT_OF_RESOURCES = 1041
+ER_ABORTING_CONNECTION = 1152
+ER_NET_PACKET_TOO_LARGE = 1153
+ER_NEW_ABORTING_CONNECTION = 1184
+ER_TOO_MANY_USER_CONNECTIONS = 1203
+ER_UNKNOWN_COM_ERROR = 1047
+
+# schema / DDL
+ER_BAD_TABLE_ERROR = 1051
+ER_WRONG_DB_NAME = 1102
+ER_WRONG_TABLE_NAME = 1103
+ER_WRONG_COLUMN_NAME = 1166
+ER_TOO_LONG_IDENT = 1059
+ER_TOO_LONG_KEY = 1071
+ER_TOO_MANY_FIELDS = 1117
+ER_TOO_MANY_KEYS = 1069
+ER_KEY_COLUMN_DOES_NOT_EXITS = 1072
+ER_WRONG_AUTO_KEY = 1075
+ER_PRIMARY_CANT_HAVE_NULL = 1171
+ER_CANT_DROP_FIELD_OR_KEY = 1091
+ER_KEY_DOES_NOT_EXIST = 1176
+ER_TABLE_MUST_HAVE_COLUMNS = 1113
+ER_BLOB_USED_AS_KEY = 1073
+ER_TOO_BIG_FIELDLENGTH = 1074
+ER_INVALID_DEFAULT = 1067
+ER_MULTIPLE_PRI_KEY = 1068
+ER_TOO_BIG_PRECISION = 1426
+ER_TOO_BIG_SCALE = 1425
+ER_TOO_BIG_DISPLAYWIDTH = 1439
+ER_UNSUPPORTED_DDL_OPERATION = 8200
+
+# planner / resolver
+ER_EMPTY_QUERY = 1065
+ER_NONUNIQ_TABLE = 1066
+ER_WRONG_FIELD_WITH_GROUP = 1055
+ER_INVALID_GROUP_FUNC_USE = 1111
+ER_MIX_OF_GROUP_FUNC_AND_FIELDS = 1140
+ER_FIELD_SPECIFIED_TWICE = 1110
+ER_OPERAND_COLUMNS = 1241
+ER_SUBQUERY_NO_1_ROW = 1242
+ER_ILLEGAL_REFERENCE = 1247
+ER_DERIVED_MUST_HAVE_ALIAS = 1248
+ER_TABLENAME_NOT_ALLOWED_HERE = 1250
+ER_NOT_SUPPORTED_YET = 1235
+ER_UNKNOWN_PROCEDURE = 1305
+ER_WRONG_PARAMCOUNT_TO_PROCEDURE = 1318
+
+# values / types
+ER_DIVISION_BY_ZERO = 1365
+ER_WARN_DATA_OUT_OF_RANGE = 1264
+ER_DATA_OUT_OF_RANGE = 1690
+ER_TRUNCATED_WRONG_VALUE_FOR_FIELD = 1366
+ER_NO_DEFAULT_FOR_FIELD = 1364
+ER_WARN_NULL_TO_NOTNULL = 1263
+ER_INVALID_USE_OF_NULL = 1138
+ER_UNKNOWN_CHARACTER_SET = 1115
+ER_UNKNOWN_COLLATION = 1273
+ER_WRONG_VALUE_FOR_VAR = 1231
+ER_GLOBAL_VARIABLE = 1229
+ER_LOCAL_VARIABLE = 1228
+ER_INCORRECT_GLOBAL_LOCAL_VAR = 1238
+
+# prepared statements / transactions
+ER_UNKNOWN_STMT_HANDLER = 1243
+ER_NEED_REPREPARE = 1615
+ER_MAX_PREPARED_STMT_COUNT_REACHED = 1461
+ER_READ_ONLY_TRANSACTION = 1207
+ER_CANT_CHANGE_TX_CHARACTERISTICS = 1568
+ER_SPECIFIC_ACCESS_DENIED = 1227
+
+# storage / distributed (the reference's own 9xxx range, terror.go):
+# every one of these is RETRYABLE at the client — the statement may be
+# re-run verbatim once the cluster heals
+ER_PD_SERVER_TIMEOUT = 9001
+ER_TIKV_SERVER_TIMEOUT = 9002
+ER_TIKV_SERVER_BUSY = 9003
+ER_RESOLVE_LOCK_TIMEOUT = 9004
+ER_REGION_UNAVAILABLE = 9005
+ER_GC_TOO_EARLY = 9006
+# region-stream-interrupted: a streamed coprocessor reply died
+# mid-region and exhausted its resume budget (store/stream.py); same
+# retryable class as region unavailability
+ER_REGION_STREAM_INTERRUPTED = 9007
+# commit outcome unknown (network error on the primary commit,
+# 2pc.go:421-431): NOT retryable — the write may have landed, so a
+# verbatim replay risks applying it twice
+ER_RESULT_UNDETERMINED = 8501
+
+# codes a client may retry verbatim after backoff (the reference's
+# terror retryable classes + lock waits/deadlocks)
+RETRYABLE = frozenset({
+    ER_LOCK_WAIT_TIMEOUT, ER_LOCK_DEADLOCK, ER_NEED_REPREPARE,
+    ER_PD_SERVER_TIMEOUT, ER_TIKV_SERVER_TIMEOUT, ER_TIKV_SERVER_BUSY,
+    ER_RESOLVE_LOCK_TIMEOUT, ER_REGION_UNAVAILABLE,
+    ER_REGION_STREAM_INTERRUPTED,
+})
+
+
+def is_retryable(errno: int) -> bool:
+    """True when a MySQL client may safely re-issue the statement."""
+    return errno in RETRYABLE
+
+
 _SQLSTATE = {
     ER_DUP_ENTRY: "23000",
     ER_BAD_NULL_ERROR: "23000",
@@ -65,6 +171,82 @@ _SQLSTATE = {
     ER_TRUNCATED_WRONG_VALUE: "22007",
     ER_DATA_TOO_LONG: "22001",
     ER_UNKNOWN: "HY000",
+    # server / connection
+    ER_CON_COUNT_ERROR: "08004",
+    ER_OUT_OF_RESOURCES: "08004",
+    ER_ABORTING_CONNECTION: "08S01",
+    ER_NET_PACKET_TOO_LARGE: "08S01",
+    ER_NEW_ABORTING_CONNECTION: "08S01",
+    ER_TOO_MANY_USER_CONNECTIONS: "42000",
+    ER_UNKNOWN_COM_ERROR: "08S01",
+    # schema / DDL
+    ER_BAD_TABLE_ERROR: "42S02",
+    ER_WRONG_DB_NAME: "42000",
+    ER_WRONG_TABLE_NAME: "42000",
+    ER_WRONG_COLUMN_NAME: "42000",
+    ER_TOO_LONG_IDENT: "42000",
+    ER_TOO_LONG_KEY: "42000",
+    ER_TOO_MANY_FIELDS: "42000",
+    ER_TOO_MANY_KEYS: "42000",
+    ER_KEY_COLUMN_DOES_NOT_EXITS: "42000",
+    ER_WRONG_AUTO_KEY: "42000",
+    ER_PRIMARY_CANT_HAVE_NULL: "42000",
+    ER_CANT_DROP_FIELD_OR_KEY: "42000",
+    ER_KEY_DOES_NOT_EXIST: "42000",
+    ER_TABLE_MUST_HAVE_COLUMNS: "42000",
+    ER_BLOB_USED_AS_KEY: "42000",
+    ER_TOO_BIG_FIELDLENGTH: "42000",
+    ER_INVALID_DEFAULT: "42000",
+    ER_MULTIPLE_PRI_KEY: "42000",
+    ER_TOO_BIG_PRECISION: "42000",
+    ER_TOO_BIG_SCALE: "42000",
+    ER_TOO_BIG_DISPLAYWIDTH: "42000",
+    ER_UNSUPPORTED_DDL_OPERATION: "HY000",
+    # planner / resolver
+    ER_EMPTY_QUERY: "42000",
+    ER_NONUNIQ_TABLE: "42000",
+    ER_WRONG_FIELD_WITH_GROUP: "42000",
+    ER_INVALID_GROUP_FUNC_USE: "HY000",
+    ER_MIX_OF_GROUP_FUNC_AND_FIELDS: "42000",
+    ER_FIELD_SPECIFIED_TWICE: "42000",
+    ER_OPERAND_COLUMNS: "21000",
+    ER_SUBQUERY_NO_1_ROW: "21000",
+    ER_ILLEGAL_REFERENCE: "42S22",
+    ER_DERIVED_MUST_HAVE_ALIAS: "42000",
+    ER_TABLENAME_NOT_ALLOWED_HERE: "42000",
+    ER_NOT_SUPPORTED_YET: "42000",
+    ER_UNKNOWN_PROCEDURE: "42000",
+    ER_WRONG_PARAMCOUNT_TO_PROCEDURE: "42000",
+    # values / types
+    ER_DIVISION_BY_ZERO: "22012",
+    ER_WARN_DATA_OUT_OF_RANGE: "22003",
+    ER_DATA_OUT_OF_RANGE: "22003",
+    ER_TRUNCATED_WRONG_VALUE_FOR_FIELD: "HY000",
+    ER_NO_DEFAULT_FOR_FIELD: "HY000",
+    ER_WARN_NULL_TO_NOTNULL: "22004",
+    ER_INVALID_USE_OF_NULL: "22004",
+    ER_UNKNOWN_CHARACTER_SET: "42000",
+    ER_UNKNOWN_COLLATION: "HY000",
+    ER_WRONG_VALUE_FOR_VAR: "42000",
+    ER_GLOBAL_VARIABLE: "HY000",
+    ER_LOCAL_VARIABLE: "HY000",
+    ER_INCORRECT_GLOBAL_LOCAL_VAR: "HY000",
+    # prepared statements / transactions
+    ER_UNKNOWN_STMT_HANDLER: "HY000",
+    ER_NEED_REPREPARE: "HY000",
+    ER_MAX_PREPARED_STMT_COUNT_REACHED: "42000",
+    ER_READ_ONLY_TRANSACTION: "25000",
+    ER_CANT_CHANGE_TX_CHARACTERISTICS: "25001",
+    ER_SPECIFIC_ACCESS_DENIED: "42000",
+    # storage / distributed
+    ER_PD_SERVER_TIMEOUT: "HY000",
+    ER_TIKV_SERVER_TIMEOUT: "HY000",
+    ER_TIKV_SERVER_BUSY: "HY000",
+    ER_RESOLVE_LOCK_TIMEOUT: "HY000",
+    ER_REGION_UNAVAILABLE: "HY000",
+    ER_GC_TOO_EARLY: "HY000",
+    ER_REGION_STREAM_INTERRUPTED: "HY000",
+    ER_RESULT_UNDETERMINED: "HY000",
 }
 
 # message-shape fallbacks for SQLError strings raised deep in the stack
@@ -90,6 +272,17 @@ _PATTERNS = [
     (re.compile(r"interrupted", re.I), ER_QUERY_INTERRUPTED),
     (re.compile(r"Unknown thread id", re.I), ER_NO_SUCH_THREAD),
     (re.compile(r"incorrect value", re.I), ER_TRUNCATED_WRONG_VALUE),
+    (re.compile(r"division by zero|divide by zero", re.I),
+     ER_DIVISION_BY_ZERO),
+    (re.compile(r"Unknown collation", re.I), ER_UNKNOWN_COLLATION),
+    (re.compile(r"Unknown character set|unknown charset", re.I),
+     ER_UNKNOWN_CHARACTER_SET),
+    (re.compile(r"returns more than 1 row", re.I), ER_SUBQUERY_NO_1_ROW),
+    (re.compile(r"out of range", re.I), ER_DATA_OUT_OF_RANGE),
+    (re.compile(r"not supported|unsupported", re.I), ER_NOT_SUPPORTED_YET),
+    (re.compile(r"Unknown prepared statement", re.I),
+     ER_UNKNOWN_STMT_HANDLER),
+    (re.compile(r"Region is unavailable", re.I), ER_REGION_UNAVAILABLE),
 ]
 
 
@@ -123,6 +316,21 @@ def classify(exc: BaseException) -> tuple[int, str, str]:
         code = ER_LOCK_WAIT_TIMEOUT
     elif isinstance(exc, kv.WriteConflictError):
         code = ER_LOCK_DEADLOCK
+    elif isinstance(exc, kv.StreamInterruptedError):
+        # streamed coprocessor reply died past its resume budget: the
+        # retryable region-stream class (store/stream.py subsystem)
+        code = ER_REGION_STREAM_INTERRUPTED
+    elif isinstance(exc, kv.RegionError):
+        code = ER_REGION_UNAVAILABLE
+    elif isinstance(exc, kv.ServerBusyError):
+        code = ER_TIKV_SERVER_BUSY
+    elif isinstance(exc, kv.GCTooEarlyError):
+        code = ER_GC_TOO_EARLY
+    elif isinstance(exc, kv.UndeterminedError):
+        # commit may or may not have landed: must NOT look retryable
+        code = ER_RESULT_UNDETERMINED
+    elif isinstance(exc, kv.TxnAbortedError):
+        code = ER_TIKV_SERVER_TIMEOUT
     else:
         try:
             from tidb_tpu.config import UnknownVariableError

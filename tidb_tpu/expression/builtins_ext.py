@@ -979,27 +979,50 @@ def _mysql_aes_key(key: bytes) -> bytes:
     return bytes(out)
 
 
+_AES_HAVE_CRYPTOGRAPHY = None   # backend choice cached after first call
+
+
+def _aes_ecb(k: bytes, data: bytes, encrypt: bool) -> bytes:
+    """AES-128 ECB over full blocks: the `cryptography` package when the
+    image ships it, else the pure-python fallback (util/aes128.py) —
+    identical bytes either way (both FIPS-197). This runs per ROW, so
+    the backend probe must happen once, not as a failed import per
+    call (failed imports are never cached in sys.modules)."""
+    global _AES_HAVE_CRYPTOGRAPHY
+    if _AES_HAVE_CRYPTOGRAPHY is None:
+        try:
+            import cryptography.hazmat.primitives.ciphers  # noqa: F401
+            _AES_HAVE_CRYPTOGRAPHY = True
+        except ImportError:
+            _AES_HAVE_CRYPTOGRAPHY = False
+    if not _AES_HAVE_CRYPTOGRAPHY:
+        from tidb_tpu.util.aes128 import decrypt_block, encrypt_block
+        op = encrypt_block if encrypt else decrypt_block
+        return b"".join(op(k, data[i:i + 16])
+                        for i in range(0, len(data), 16))
+    from cryptography.hazmat.primitives.ciphers import (Cipher,
+                                                        algorithms,
+                                                        modes)
+    cipher = Cipher(algorithms.AES(k), modes.ECB())
+    ctx = cipher.encryptor() if encrypt else cipher.decryptor()
+    return ctx.update(data) + ctx.finalize()
+
+
 def _aes(encrypt: bool):
     def fn(args, argv, n):
-        from cryptography.hazmat.primitives.ciphers import (Cipher,
-                                                            algorithms,
-                                                            modes)
         v = _valid_all(argv, n)
 
         def one(x, key):
             k = _mysql_aes_key(
                 key if isinstance(key, bytes) else _s(key).encode())
             data = x if isinstance(x, bytes) else _s(x).encode()
-            cipher = Cipher(algorithms.AES(k), modes.ECB())
             if encrypt:
                 pad = 16 - len(data) % 16
                 data += bytes([pad]) * pad
-                enc = cipher.encryptor()
-                return enc.update(data) + enc.finalize()
+                return _aes_ecb(k, data, encrypt=True)
             if len(data) % 16 or not data:
                 return None
-            dec = cipher.decryptor()
-            out = dec.update(data) + dec.finalize()
+            out = _aes_ecb(k, data, encrypt=False)
             pad = out[-1]
             if not 1 <= pad <= 16 or out[-pad:] != bytes([pad]) * pad:
                 return None

@@ -15,14 +15,14 @@ from dataclasses import dataclass, field
 from enum import Enum, IntEnum
 from typing import Iterable, Iterator, Optional
 
-from sortedcontainers import SortedDict
+from tidb_tpu.util.sorteddict import SortedDict
 
 __all__ = [
     "IsolationLevel", "Priority", "ReqType",
     "KVError", "KeyLockedError", "WriteConflictError", "TxnAbortedError",
     "RegionError", "NotFoundError", "RetryableError", "ServerBusyError",
     "EpochNotMatchError", "NotLeaderError", "StoreUnavailableError",
-    "UndeterminedError",
+    "UndeterminedError", "StreamInterruptedError",
     "LockInfo", "Mutation", "MutationOp",
     "MemBuffer", "UnionStore", "Snapshot", "Transaction", "Storage",
     "KVRange", "CopRequest", "CopResponse", "Client",
@@ -159,6 +159,14 @@ class StoreUnavailableError(RegionError):
 
 class ServerBusyError(RetryableError):
     pass
+
+
+class StreamInterruptedError(RetryableError):
+    """A streamed coprocessor reply died mid-region (network drop,
+    server restart, failpoint). Retryable: the client re-issues the
+    stream from the last acked range boundary (store/copr.py), so no
+    row is duplicated or lost. Ref: the stream-recreate path of
+    copIteratorWorker.handleCopStreamResult, store/tikv/coprocessor.go."""
 
 
 # ---------------------------------------------------------------------------

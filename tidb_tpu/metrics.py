@@ -13,7 +13,9 @@ import threading
 
 __all__ = ["counter", "histogram", "expose", "snapshot",
            "QUERY_DURATIONS", "QUERIES_TOTAL", "SLOW_QUERIES",
-           "CONNECTIONS", "COP_TASKS", "QUERY_ERRORS"]
+           "CONNECTIONS", "COP_TASKS", "QUERY_ERRORS",
+           "COP_STREAM_FRAMES", "COP_STREAM_BYTES",
+           "COP_STREAM_CREDIT_STALLS", "COP_STREAM_RESUMES"]
 
 _lock = threading.Lock()
 _counters: dict[tuple[str, tuple], float] = {}
@@ -98,3 +100,9 @@ SLOW_QUERIES = "tidb_tpu_slow_queries_total"
 CONNECTIONS = "tidb_tpu_connections_total"
 COP_TASKS = "tidb_tpu_cop_tasks_total"
 QUERY_ERRORS = "tidb_tpu_query_errors_total"
+# streaming coprocessor (store/stream.py): framed partial responses,
+# credit-window backpressure, mid-stream resume counts
+COP_STREAM_FRAMES = "tidb_tpu_cop_stream_frames_total"
+COP_STREAM_BYTES = "tidb_tpu_cop_stream_bytes_total"
+COP_STREAM_CREDIT_STALLS = "tidb_tpu_cop_stream_credit_stalls_total"
+COP_STREAM_RESUMES = "tidb_tpu_cop_stream_resumes_total"

@@ -13,7 +13,7 @@ import threading
 import time
 from dataclasses import dataclass, field, replace
 
-from sortedcontainers import SortedDict
+from tidb_tpu.util.sorteddict import SortedDict
 
 from tidb_tpu import tablecodec
 

@@ -21,7 +21,7 @@ from dataclasses import dataclass, field
 from enum import Enum
 from typing import Optional
 
-from sortedcontainers import SortedDict
+from tidb_tpu.util.sorteddict import SortedDict
 
 from tidb_tpu.kv import (IsolationLevel, KeyLockedError, KVError, LockInfo,
                          Mutation, MutationOp, TxnAbortedError,

@@ -191,7 +191,42 @@ class RPCShim:
             raise KVError("no coprocessor handler installed")
         return self._cop_handler(r, req)
 
+    def coprocessor_stream(self, ctx: RegionCtx, req, credit=None,
+                           frame_bytes=None):
+        """Streaming coprocessor (ref: CmdCopStream): lazy generator of
+        StreamFrames. The region epoch (and the `inject` failpoint, cmd
+        "CopStream") is re-checked before EVERY frame delivery, so a
+        region split/leader change mid-stream surfaces as a mid-stream
+        RegionError — the client resumes from its last acked range
+        boundary (store/copr.py). `credit` is unused in-process: the
+        consumer pulls the generator, which is perfect backpressure.
+        `frame_bytes` is the CLIENT's response-size cap (validated here
+        — it also arrives off the wire)."""
+        r = self._check("CopStream", ctx)
+        if self._cop_stream_handler is None:
+            raise KVError("no streaming coprocessor handler installed")
+        if frame_bytes is not None:
+            if not isinstance(frame_bytes, int) or \
+                    isinstance(frame_bytes, bool) or \
+                    not 1 <= frame_bytes <= (1 << 31):
+                raise KVError(f"bad frame_bytes {frame_bytes!r}")
+        gen = self._cop_stream_handler(r, req, frame_bytes=frame_bytes)
+
+        def checked():
+            for frame in gen:
+                # per-frame failpoint + epoch re-check: an un-delivered
+                # frame is never acked, so dropping it here cannot lose
+                # rows on resume
+                self._check("CopStream", ctx)
+                yield frame
+
+        return checked()
+
     _cop_handler = None
+    _cop_stream_handler = None
 
     def install_cop_handler(self, fn) -> None:
         self._cop_handler = fn
+
+    def install_cop_stream_handler(self, fn) -> None:
+        self._cop_stream_handler = fn

@@ -29,7 +29,7 @@ from tidb_tpu.store.backoff import (BO_REGION_MISS, BO_SERVER_BUSY,
                                     BO_TXN_LOCK, Backoffer, COP_MAX_BACKOFF)
 from tidb_tpu.table import index_kvrows_to_chunk, kvrows_to_chunk
 
-__all__ = ["CopClient", "cop_handler"]
+__all__ = ["CopClient", "cop_handler", "decode_cop_batch"]
 
 # fan-out width lives in the tidb_tpu_cop_concurrency sysvar (config.py;
 # ref: DistSQLScanConcurrency default, sessionctx/variable/tidb_vars.go:115)
@@ -79,6 +79,17 @@ def _agg_kernels(plan: CopPlan):
     return k
 
 
+def decode_cop_batch(plan: CopPlan, batch):
+    """Raw (key, value) rows -> decoded chunk for `plan` (row or index
+    encoding). Shared by the materialized handler below and the framed
+    producer in store/stream.py."""
+    if plan.index is not None:
+        return index_kvrows_to_chunk(plan.table, plan.index, plan.cols,
+                                     batch, handle_col=plan.handle_col)
+    return kvrows_to_chunk(plan.table, plan.cols, batch,
+                           with_handle_col=plan.handle_col)
+
+
 def exec_cop_plan(plan: CopPlan, chunk) -> CopResponse:
     """Run the pushed subplan over one region's decoded chunk."""
     if plan.host_filter is not None:
@@ -113,13 +124,7 @@ def cop_handler(storage):
     repeated analytical reads go straight from decoded columns to the
     device kernel."""
 
-    def _decode(plan: CopPlan, batch):
-        if plan.index is not None:
-            return index_kvrows_to_chunk(plan.table, plan.index,
-                                         plan.cols, batch,
-                                         handle_col=plan.handle_col)
-        return kvrows_to_chunk(plan.table, plan.cols, batch,
-                               with_handle_col=plan.handle_col)
+    _decode = decode_cop_batch
 
     def _cached_range_chunk(region: Region, plan: CopPlan, s: bytes,
                             e: bytes, req: CopRequest):
@@ -238,6 +243,9 @@ class CopClient(kv.Client):
         # have no installable handler surface
         if getattr(self.shim, "_cop_handler", "remote") is None:
             self.shim.install_cop_handler(cop_handler(storage))
+        if getattr(self.shim, "_cop_stream_handler", "remote") is None:
+            from tidb_tpu.store.stream import cop_stream_handler
+            self.shim.install_cop_stream_handler(cop_stream_handler(storage))
 
     def send(self, req: CopRequest):
         """Yields CopResponses; unordered unless req.keep_order."""
@@ -249,6 +257,10 @@ class CopClient(kv.Client):
         metrics.counter(metrics.COP_TASKS, inc=len(tasks))
         concurrency = min(req.concurrency or config.cop_concurrency(),
                           len(tasks))
+        if config.copr_stream_enabled() and \
+                getattr(self.shim, "coprocessor_stream", None) is not None:
+            yield from self._send_streaming(req, tasks, concurrency)
+            return
         # the session's sysvar overlay is thread-local: capture it here
         # and re-install inside every pool worker so per-session knobs
         # (device on/off, cache) apply uniformly across the fan-out
@@ -346,3 +358,150 @@ class CopClient(kv.Client):
             except KeyLockedError as e:
                 if not self.storage.resolver.resolve(bo, [e.lock]):
                     bo.backoff(BO_TXN_LOCK, e)
+
+    # -- streaming path (tidb_tpu_copr_stream=1; ref: CmdCopStream,
+    # coprocessor.go:547-555 + handleCopStreamResult resume) ---------------
+
+    def _send_streaming(self, req: CopRequest, tasks, concurrency: int):
+        """Framed partial responses, never a materialized per-region
+        list. KeepOrder (or concurrency 1) runs tasks sequentially with
+        ONE lazy in-flight stream — range order is frame order and the
+        client buffers nothing. The unordered fan-out runs tasks in a
+        pool draining into a BoundedFrameQueue sized to the credit
+        window, so producers block (credit stall) instead of buffering
+        when the consumer is slow."""
+        from tidb_tpu import trace
+        from tidb_tpu.store.stream import BoundedFrameQueue
+
+        credit = config.copr_stream_credit()
+        # per-QUERY span tags come from client-side counters (one dict
+        # per task, summed here) — the module-level stream stats are
+        # process-cumulative and would cross-pollute concurrent sessions
+        counters: list[dict] = []
+
+        def new_counter() -> dict:
+            c = {"frames": 0, "resumes": 0}
+            counters.append(c)
+            return c
+
+        def annotate_totals() -> None:
+            trace.annotate(
+                cop_stream_frames=sum(c["frames"] for c in counters),
+                cop_stream_resumes=sum(c["resumes"] for c in counters))
+
+        if req.keep_order or concurrency <= 1 or len(tasks) == 1:
+            for _loc, rng in tasks:
+                yield from self._run_task_stream(req, rng, new_counter())
+            annotate_totals()
+            return
+        stop = threading.Event()
+        q = BoundedFrameQueue(credit, stop)
+        overlay = config.current_overlay()
+        buckets = [tasks[i::concurrency] for i in range(concurrency)]
+
+        def worker(task_list):
+            try:
+                with config.session_overlay(overlay):
+                    for _loc, rng in task_list:
+                        for resp in self._run_task_stream(
+                                req, rng, new_counter()):
+                            if not q.put(resp):
+                                return       # consumer gone
+                q.put_done()
+            except Exception as exc:  # noqa: BLE001 — re-raised by consumer
+                q.put(exc)
+                q.put_done()
+
+        pool = ThreadPoolExecutor(max_workers=concurrency,
+                                  thread_name_prefix="cop-stream")
+        for b in buckets:
+            pool.submit(worker, b)
+        try:
+            yield from q.drain(len(buckets))
+            annotate_totals()
+        finally:
+            stop.set()
+            pool.shutdown(wait=False)
+
+    def _run_task_stream(self, req: CopRequest, rng: KVRange,
+                         counter: dict | None = None):
+        """One range, streamed: frames arrive in key order; `cur` tracks
+        the last ACKED range boundary. A region error, failpoint, or
+        dropped connection mid-stream re-locates from `cur` and
+        re-issues — frames cover contiguous, non-overlapping ranges, so
+        the retry can neither duplicate nor skip rows. Crossing a region
+        boundary (final frame's `range.end` before the requested end)
+        continues into the next region under the same cursor.
+        `counter` collects this call's frame/resume counts for per-query
+        span tags."""
+        from tidb_tpu import kv as _kv
+        from tidb_tpu.store.stream import note_resume
+
+        if counter is None:
+            counter = {"frames": 0, "resumes": 0}
+
+        def resumed() -> None:
+            counter["resumes"] += 1
+            note_resume()
+        bo = Backoffer(COP_MAX_BACKOFF)
+        cur = rng.start
+        while True:
+            loc = self.cache.locate(cur)
+            sub = CopRequest(tp=req.tp, ranges=[KVRange(cur, rng.end)],
+                             plan=req.plan, start_ts=req.start_ts,
+                             concurrency=1, isolation=req.isolation)
+            covered_to = None
+            try:
+                it = self.shim.coprocessor_stream(
+                    loc.ctx, sub, credit=config.copr_stream_credit(),
+                    frame_bytes=config.copr_stream_frame_bytes())
+                for frame in it:
+                    counter["frames"] += 1
+                    # chunk is a Chunk (scan/filter), a GroupResult
+                    # (device partial agg — no num_rows), or None
+                    if frame.chunk is not None and \
+                            getattr(frame.chunk, "num_rows", 1):
+                        yield CopResponse(chunk=frame.chunk,
+                                          range=frame.range)
+                    cur = frame.range.end        # acked through here
+                    if frame.last:
+                        covered_to = frame.range.end
+            except (NotLeaderError, RegionError, ServerBusyError,
+                    KeyLockedError, _kv.StreamInterruptedError) as e:
+                if covered_to is not None:
+                    # the final frame was already acked — the stream's
+                    # work is DONE and only protocol closure failed.
+                    # Resuming would re-scan from `cur`, which for an
+                    # open-ended final frame is b"" (= the very start):
+                    # the one way this loop could duplicate rows.
+                    pass
+                elif isinstance(e, NotLeaderError):
+                    self.cache.on_not_leader(e)
+                    bo.backoff(BO_REGION_MISS, e)
+                    resumed()
+                    continue
+                elif isinstance(e, RegionError):
+                    self.cache.invalidate(loc.region.id)
+                    bo.backoff(BO_REGION_MISS, e)
+                    resumed()
+                    continue
+                elif isinstance(e, _kv.StreamInterruptedError):
+                    bo.backoff(BO_REGION_MISS, e)
+                    resumed()
+                    continue
+                elif isinstance(e, ServerBusyError):
+                    bo.backoff(BO_SERVER_BUSY, e)
+                    resumed()
+                    continue
+                else:   # KeyLockedError
+                    if not self.storage.resolver.resolve(bo, [e.lock]):
+                        bo.backoff(BO_TXN_LOCK, e)
+                    resumed()
+                    continue
+            if covered_to is None:
+                covered_to = cur
+            if not covered_to:
+                return          # open-ended coverage: nothing beyond
+            if rng.end and covered_to >= rng.end:
+                return          # requested range fully covered
+            cur = covered_to    # region ended early: continue next region

@@ -10,7 +10,7 @@ from __future__ import annotations
 import threading
 from dataclasses import dataclass
 
-from sortedcontainers import SortedDict
+from tidb_tpu.util.sorteddict import SortedDict
 
 from tidb_tpu.kv import KVRange, NotLeaderError
 from tidb_tpu.mockstore.cluster import Cluster, Region

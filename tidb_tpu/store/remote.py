@@ -13,8 +13,16 @@ mirroring the reference's protobuf envelope (tikvrpc.CmdType +
 kvproto/tipb messages). Requests carry `u16 Cmd` + an args/kwargs
 tuple; responses carry the result value or a registered typed error.
 No pickle anywhere on the wire path: decoding cannot execute code, and
-malformed frames raise WireError (fuzzed in tests/test_wire.py).
-On-disk snapshots (trusted, local files we wrote) still use pickle.
+malformed frames raise WireError (fuzzed in tests/test_wire.py; the
+no-pickle invariant is pinned by tests/test_lint_wire.py). On-disk
+snapshots (trusted, local files we wrote) live in store/snapshot.py.
+
+Streamed coprocessor replies (Cmd.COP_STREAM) are multi-frame: the
+server answers one request with STATUS_STREAM_FRAME frames under the
+credit-based flow control of store/wire.py — the request carries an
+initial window, the server blocks at zero credit until the client
+grants more, so a slow consumer backpressures the storage node instead
+of buffering whole regions on either side.
 
 Failure semantics (region_request.go's network-error split):
   * connection failure BEFORE the request is written -> retry on a fresh
@@ -30,7 +38,6 @@ from __future__ import annotations
 import argparse
 import io
 import os
-import pickle
 import signal
 import socket
 import struct
@@ -43,13 +50,14 @@ from tidb_tpu.store import wire
 
 __all__ = ["StorageServer", "RemoteStorage", "connect", "serve_main"]
 
-_STATUS_OK = 0
-_STATUS_ERR = 1
-_STATUS_OK_TRACED = 2   # payload = (result, span-tree dict)
+_STATUS_OK = wire.STATUS_OK
+_STATUS_ERR = wire.STATUS_ERR
+_STATUS_OK_TRACED = wire.STATUS_OK_TRACED   # payload = (result, spans)
 
 # commands safe to re-send after an indeterminate failure
 _IDEMPOTENT = {"kv_get", "kv_batch_get", "kv_scan", "kv_scan_lock",
-               "coprocessor", "region_by_key", "tso", "kv_cleanup",
+               "coprocessor", "coprocessor_stream",
+               "region_by_key", "tso", "kv_cleanup",
                "snapshot_batch_get", "ping", "regions_snapshot",
                # raw ops are idempotent by definition (no MVCC, repeat
                # puts/deletes converge); mvcc_* are pure reads
@@ -102,16 +110,19 @@ class StorageServer:
                  snapshot_path: str | None = None,
                  role: str = "primary", backup_addr=None,
                  primary_addr=None):
+        from tidb_tpu.store import snapshot as snapshot_io
         from tidb_tpu.store.copr import cop_handler
         from tidb_tpu.store.storage import MockStorage, new_mock_storage
+        from tidb_tpu.store.stream import cop_stream_handler
         self.snapshot_path = snapshot_path
         if snapshot_path and os.path.exists(snapshot_path):
-            with open(snapshot_path, "rb") as f:
-                cluster, engine = pickle.load(f)
+            cluster, engine = snapshot_io.load(snapshot_path)
             self.storage = MockStorage(cluster, engine)
         else:
             self.storage = new_mock_storage()
         self.storage.shim.install_cop_handler(cop_handler(self.storage))
+        self.storage.shim.install_cop_stream_handler(
+            cop_stream_handler(self.storage))
         # -- replication (ref: the Raft-replicated TiKV store; here a
         # synchronous primary/backup log-shipping analogue) ---------------
         self.role = role
@@ -160,7 +171,7 @@ class StorageServer:
             }
 
     def _install_state(self, st: dict) -> None:
-        from sortedcontainers import SortedDict
+        from tidb_tpu.util.sorteddict import SortedDict
         cl, en = self.storage.cluster, self.storage.engine
         with cl._mu, en._mu:
             cl._id = st["id"]
@@ -373,6 +384,102 @@ class StorageServer:
             raise kv.KVError(f"unknown storage method {method!r}")
         return fn(*args, **kwargs)
 
+    def _serve_stream(self, sock: socket.socket, args: tuple,
+                      kwargs: dict, flags: dict | None = None) -> bool:
+        """Serve one COP_STREAM request: StreamFrames under credit flow
+        control (wire.py). Blocks — not buffers — when the client's
+        credit window is exhausted; the blocking recv IS the
+        backpressure. A traced request runs under a local root span
+        whose finished tree rides back ON THE END FRAME (streams bypass
+        the STATUS_OK_TRACED envelope). -> False when the connection
+        died and the serve loop must exit."""
+        kwargs = dict(kwargs)
+        credit = kwargs.pop("credit", None)
+        root = None
+        if flags and flags.get("trace"):
+            from tidb_tpu import trace as _trace
+            root = _trace.begin("storage:coprocessor_stream")
+        gen = None
+        try:
+            gate = wire.CreditGate(credit if credit is not None else 4)
+            gen = self._serve_call("coprocessor_stream", args, kwargs)
+        except Exception as e:  # noqa: BLE001 — typed errors ride back
+            if root is not None:
+                from tidb_tpu import trace as _trace
+                _trace.end(root)    # unpin the thread-local trace root
+            return self._stream_abort(sock, e)
+        try:
+            it = iter(gen)
+            while True:
+                try:
+                    frame = next(it)
+                except StopIteration:
+                    break
+                except Exception as e:  # noqa: BLE001 — typed mid-stream
+                    # mid-stream abort: the client may have grants in
+                    # flight we cannot count, so the connection dies
+                    # with the stream (the client closes its end too)
+                    self._stream_abort(sock, e)
+                    return gate.sent == 0
+                if gate.credit <= 0:
+                    # one stall EPISODE (matching BoundedFrameQueue's
+                    # accounting), however many grant frames it takes
+                    from tidb_tpu.store.stream import note_credit_stall
+                    note_credit_stall()
+                    while gate.credit <= 0:
+                        status, payload = _recv_frame(sock)
+                        gate.feed_grant(status, payload)
+                _send_frame(sock, wire.STATUS_STREAM_FRAME,
+                            wire.encode(frame))
+                gate.consume()
+            if root is not None:
+                from tidb_tpu import trace as _trace
+                _trace.end(root)
+                end_payload = wire.encode(root.to_dict())
+                root = None
+            else:
+                end_payload = wire.encode(None)
+            _send_frame(sock, wire.STATUS_STREAM_END, end_payload)
+            # absorb the trailing grants (one per consumed frame) so the
+            # next request on this connection isn't misread as a grant.
+            # NO deadline: the client sends each grant only after its
+            # consumer finishes that frame, and a consumer stall (first
+            # XLA compile runs minutes) is legitimate — blocking here is
+            # the same idle state this thread would be in awaiting the
+            # next request, and a vanished client surfaces as
+            # ConnectionError either way
+            while gate.outstanding > 0:
+                status, payload = _recv_frame(sock)
+                gate.feed_grant(status, payload)
+            return True
+        except (ConnectionError, OSError):
+            return False        # client went away mid-stream
+        except wire.WireError as e:
+            # peer protocol violation (bogus grant, etc.): abort loudly;
+            # framing sync is unknown, so the connection must die
+            self._stream_abort(sock, kv.KVError(f"stream protocol: {e}"))
+            return False
+        finally:
+            if root is not None:
+                from tidb_tpu import trace as _trace
+                _trace.end(root)    # error/disconnect path: just unpin
+            if gen is not None and hasattr(gen, "close"):
+                gen.close()
+
+    @staticmethod
+    def _stream_abort(sock: socket.socket, e: BaseException) -> bool:
+        """Terminate a stream with a typed error frame; the connection
+        returns to request/response state. -> serve-loop liveness."""
+        try:
+            out = wire.encode(e)
+        except wire.WireError:
+            out = wire.encode(kv.KVError(f"{type(e).__name__}: {e}"))
+        try:
+            _send_frame(sock, wire.STATUS_ERR, out)
+            return True
+        except (ConnectionError, OSError):
+            return False
+
     def _serve(self, sock: socket.socket) -> None:
         try:
             while True:
@@ -384,6 +491,10 @@ class StorageServer:
                     req = wire.decode_frame_payload(payload)
                     cmd, args, kwargs, flags = self._validate_request(req)
                     method = wire.METHOD_BY_CMD[cmd]
+                    if cmd == wire.Cmd.COP_STREAM:
+                        if self._serve_stream(sock, args, kwargs, flags):
+                            continue
+                        return
                     if flags.get("trace"):
                         # cross-process span propagation: run under a
                         # local root and ship the finished tree back for
@@ -426,10 +537,9 @@ class StorageServer:
     def save_snapshot(self) -> None:
         if not self.snapshot_path:
             return
-        tmp = self.snapshot_path + ".tmp"
-        with open(tmp, "wb") as f:
-            pickle.dump((self.storage.cluster, self.storage.engine), f)
-        os.replace(tmp, self.snapshot_path)
+        from tidb_tpu.store import snapshot as snapshot_io
+        snapshot_io.save(self.snapshot_path, self.storage.cluster,
+                         self.storage.engine)
 
     def close(self) -> None:
         self._closing.set()
@@ -468,6 +578,33 @@ class _Conn:
             result, remote_span = result
             trace.attach_remote(remote_span)
         return result
+
+    def call_stream(self, method: str, args: tuple, kwargs: dict,
+                    credit: int):
+        """Generator over a multi-frame streamed reply. Grants one
+        credit back per consumed frame (sliding window): the server
+        never has more than `credit` frames un-consumed in flight."""
+        from tidb_tpu import trace
+        cmd = wire.CMD_BY_METHOD.get(method)
+        if cmd is None:
+            raise kv.KVError(f"method {method!r} has no wire command")
+        req = (int(cmd), tuple(args), dict(kwargs, credit=credit))
+        if trace.active():
+            req = req + ({"trace": True},)
+        _send_frame(self.sock, wire.STATUS_OK, wire.encode(req))
+        reader = wire.StreamReader(credit)
+        while True:
+            status, body = _recv_frame(self.sock)
+            kind, frame = reader.feed(status, body)
+            if kind == "end":
+                if isinstance(frame, dict):
+                    # the server's span tree rode the END frame
+                    trace.attach_remote(frame)
+                return
+            yield frame
+            # consumer is done with that frame: open the window one slot
+            reader.grant(1)
+            _send_frame(self.sock, wire.STATUS_CREDIT, wire.encode(1))
 
     def close(self) -> None:
         try:
@@ -603,6 +740,70 @@ class RemoteClient:
             self._checkin(addr, conn)
             return result
 
+    def call_stream(self, method: str, *args, credit: int = 4, **kwargs):
+        """Streamed call: yields frames as the server ships them. Any
+        network/protocol failure surfaces as kv.StreamInterruptedError —
+        the coprocessor client resumes from its last acked range
+        boundary (store/copr.py), so no transparent re-send happens
+        here (a blind replay could duplicate already-consumed frames).
+        The connection returns to the pool only after a CLEAN end (or a
+        typed error frame, which leaves framing intact); an abandoned or
+        broken stream closes it."""
+        # the sysvar is unbounded; the wire protocol is not — clamp
+        # rather than spin a legal SET value through the retry budget
+        credit = max(1, min(credit, wire.MAX_STREAM_CREDIT))
+        self._sema.acquire()
+        conn = None
+        clean = False
+        try:
+            try:
+                addr, conn = self._checkout()
+            except OSError as e:
+                self._rotate(self.addrs[self._cur])
+                raise kv.StreamInterruptedError(
+                    f"storage unreachable at {self.addr}: {e}") from None
+            consumed = 0
+            try:
+                for frame in conn.call_stream(method, args, kwargs,
+                                              credit):
+                    consumed += 1
+                    yield frame
+                clean = True
+            except kv.NotLeaderError as e:
+                # typed error frame. Framing is intact ONLY if no frame
+                # was consumed yet (no grants in flight the server
+                # cannot account for); else both ends drop the conn.
+                clean = consumed == 0
+                if e.leader_store == -1 and \
+                        self._old_primary_unreachable(addr):
+                    # reached a backup with the primary gone: promote,
+                    # then let the caller's resume loop retry against it
+                    try:
+                        self._promote(addr)
+                    except (ConnectionError, OSError) as pe:
+                        raise kv.ServerBusyError(
+                            f"failover promote failed: {pe}") from None
+                    raise kv.StreamInterruptedError(
+                        "backup promoted; resume stream") from None
+                if e.leader_store == -1:
+                    self._rotate(addr)
+                raise
+            except kv.KVError:
+                clean = consumed == 0   # see NotLeaderError note above
+                raise
+            except (ConnectionError, OSError, wire.WireError,
+                    EOFError) as e:
+                self._rotate(addr)
+                raise kv.StreamInterruptedError(
+                    f"stream i/o failure: {e}") from None
+        finally:
+            if conn is not None:
+                if clean:
+                    self._checkin(addr, conn)
+                else:
+                    conn.close()
+            self._sema.release()
+
     def close(self) -> None:
         with self._mu:
             for pool in self._pools.values():
@@ -650,6 +851,18 @@ class _RemoteShim:
                 return self.client.call(name, *args, **kwargs)
             return call
         raise AttributeError(name)
+
+    def coprocessor_stream(self, ctx, req, credit=None, frame_bytes=None):
+        """Streamed coprocessor over the wire: lazy frame generator
+        under the credit window (see StorageServer._serve_stream). The
+        client's frame cap ships with the request — the storage
+        process's own sysvar must not override this session's memory
+        bound."""
+        kwargs = {}
+        if frame_bytes is not None:
+            kwargs["frame_bytes"] = frame_bytes
+        return self.client.call_stream("coprocessor_stream", ctx, req,
+                                       credit=credit or 4, **kwargs)
 
 
 class _RemoteEngine:

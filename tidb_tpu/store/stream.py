@@ -1,0 +1,251 @@
+"""Streaming coprocessor: bounded-memory framed partial responses.
+
+Reference: the CmdCopStream mode of /root/reference/store/tikv/
+coprocessor.go:547-555 (handleCopStreamResult: incremental per-range
+responses, stream re-created from the last returned range on region
+errors) and mocktikv/cop_handler_dag.go's chunked DAG execution. The
+materialized path (store/copr.py cop_handler) returns one response list
+per region — a large region costs unbounded memory on both sides. This
+module is the storage half of the streaming path:
+
+  * `region_stream` executes the pushed-down scan/selection/partial-agg
+    PER FRAME: raw KV rows accumulate until the response-size cap
+    (tidb_tpu_copr_stream_frame_bytes), then decode + execute + yield one
+    `StreamFrame`. An aggregating subplan yields per-frame PARTIAL
+    aggregates the client merges incrementally (the "partial partial
+    aggregates" shape — see PAPERS.md).
+  * Every frame carries the contiguous key range it covers; frame i+1
+    starts exactly where frame i ended, so a consumer that acked frame i
+    can resume a dead stream at `frame.range.end` with no duplicate or
+    missing row (store/copr.py `_run_task_stream`).
+  * The final frame has `last=True` and `range.end` = the region-clamped
+    scan end, telling the client where this region's coverage stops (the
+    cursor for crossing into the next region).
+
+Flow control lives one layer up: in-process consumption pulls the
+generator lazily (perfect backpressure); the parallel fan-out buffers
+frames in a `BoundedFrameQueue` sized to the credit window; the
+out-of-process wire path uses the credit protocol of store/wire.py
+(client grants N outstanding frames, the server blocks past the window
+— store/remote.py). The chunk cache (store/chunk_cache.py) is bypassed:
+streaming exists precisely for scans too large to sit in a cache entry.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+from tidb_tpu import config, metrics
+from tidb_tpu.kv import CopRequest, KVRange
+
+__all__ = ["StreamFrame", "region_stream", "cop_stream_handler",
+           "BoundedFrameQueue", "stream_stats", "reset_stream_stats"]
+
+# rows per engine-scan call while filling a frame; small enough that a
+# frame overshoots its byte cap by at most one row, large enough to
+# amortize the engine's lock
+SCAN_SUB_BATCH = 1024
+
+
+@dataclass
+class StreamFrame:
+    """One framed partial response (wire struct id 25, store/wire.py).
+
+    `chunk` is the pushed subplan's result over exactly the raw rows in
+    `range` (None when the frame only advances coverage); `range` is the
+    contiguous scanned span — the resume boundary, NOT the result rows'
+    keys (a filter may have dropped every row in it)."""
+
+    chunk: object | None
+    range: KVRange
+    last: bool = False
+
+
+# -- observability -----------------------------------------------------------
+
+_stats_lock = threading.Lock()
+
+
+def _fresh_stats() -> dict:
+    return {"streams": 0, "frames": 0, "bytes": 0, "frame_bytes_max": 0,
+            "credit_stalls": 0, "resumes": 0, "peak_buffered": 0}
+
+
+_STATS = _fresh_stats()
+
+
+def reset_stream_stats() -> None:
+    with _stats_lock:
+        _STATS.clear()
+        _STATS.update(_fresh_stats())
+
+
+def stream_stats() -> dict:
+    with _stats_lock:
+        return dict(_STATS)
+
+
+def _note(key: str, inc: int = 1) -> None:
+    with _stats_lock:
+        _STATS[key] += inc
+
+
+def _note_max(key: str, value: int) -> None:
+    with _stats_lock:
+        if value > _STATS[key]:
+            _STATS[key] = value
+
+
+def note_resume() -> None:
+    """A client re-issued a stream from its last acked boundary."""
+    _note("resumes")
+    metrics.counter(metrics.COP_STREAM_RESUMES)
+
+
+def note_credit_stall() -> None:
+    """A producer blocked on an exhausted credit window (backpressure
+    engaged — the bound worked, this is not an error)."""
+    _note("credit_stalls")
+    metrics.counter(metrics.COP_STREAM_CREDIT_STALLS)
+
+
+# -- storage side ------------------------------------------------------------
+
+def region_stream(storage, region, req: CopRequest, frame_bytes: int):
+    """Yield StreamFrames for one region's share of `req`.
+
+    Raw (key, value) rows accumulate until the next row would push the
+    frame past `frame_bytes`; the pushed subplan then runs over exactly
+    that batch. A single row larger than the cap still ships alone — the
+    cap bounds buffering, it cannot split a row."""
+    from tidb_tpu.store.copr import decode_cop_batch, exec_cop_plan
+
+    plan = req.plan
+    rng: KVRange = req.ranges[0]
+    s = max(rng.start, region.start)
+    if region.end and rng.end:
+        e = min(rng.end, region.end)
+    else:
+        e = region.end or rng.end   # either bound may be open (falsy)
+    _note("streams")
+
+    remaining = plan.limit if not plan.is_agg else None
+    pend: list[tuple[bytes, bytes]] = []
+    pend_bytes = 0
+    frame_start = s
+    cur = s
+    done = False
+
+    def emit(boundary: bytes, last: bool) -> StreamFrame:
+        nonlocal pend, pend_bytes, frame_start, remaining
+        chunk = None
+        if pend:
+            resp = exec_cop_plan(plan, decode_cop_batch(plan, pend))
+            chunk = resp.chunk
+            if remaining is not None:
+                remaining -= chunk.num_rows
+        frame = StreamFrame(chunk, KVRange(frame_start, boundary), last)
+        nbytes = pend_bytes
+        pend, pend_bytes, frame_start = [], 0, boundary
+        _note("frames")
+        _note("bytes", nbytes)
+        _note_max("frame_bytes_max", nbytes)
+        metrics.counter(metrics.COP_STREAM_FRAMES)
+        metrics.counter(metrics.COP_STREAM_BYTES, inc=nbytes)
+        return frame
+
+    while not done:
+        batch = storage.engine.scan(cur, e, SCAN_SUB_BATCH, req.start_ts,
+                                    req.isolation, desc=False)
+        if not batch:
+            break
+        for k, v in batch:
+            row_bytes = len(k) + len(v) + 16   # 16 ~ per-row list overhead
+            if pend and pend_bytes + row_bytes > frame_bytes:
+                yield emit(k, last=False)
+                if remaining is not None and remaining <= 0:
+                    done = True
+                    break
+            pend.append((k, v))
+            pend_bytes += row_bytes
+        cur = batch[-1][0] + b"\x00"
+        if not done and remaining is not None and pend:
+            # a pushed-down LIMIT stops per scan sub-batch, like the
+            # materialized handler — never buffer a whole byte-cap frame
+            # of rows a LIMIT 7 will throw away
+            yield emit(cur, last=False)
+            if remaining <= 0:
+                done = True
+        if len(batch) < SCAN_SUB_BATCH:
+            break
+    yield emit(e, last=True)
+
+
+def cop_stream_handler(storage):
+    """Handler closure installed into the RPC shim (the streaming
+    counterpart of store/copr.cop_handler): (region, req) -> generator
+    of StreamFrames. The frame cap comes FROM THE CLIENT with each
+    request (the session's sysvar — out of process, the server's own
+    config must not override the client's memory bound); the server
+    sysvar is only the fallback for callers that don't send one."""
+
+    def handle(region, req: CopRequest, frame_bytes=None):
+        return region_stream(storage, region, req,
+                             frame_bytes or
+                             config.copr_stream_frame_bytes())
+
+    return handle
+
+
+# -- client-side bounded buffering -------------------------------------------
+
+class BoundedFrameQueue:
+    """Credit-window buffer between producer threads and one consumer:
+    the in-process analogue of the wire protocol's credit flow control.
+    Capacity = credit window; a put past it blocks (counted as a credit
+    stall — the producer is being backpressured, not buffered)."""
+
+    _DONE = object()
+
+    def __init__(self, credit: int, stop: threading.Event):
+        import queue
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, credit))
+        self._stop = stop
+        self._queue_mod = queue
+
+    def put(self, item) -> bool:
+        """-> False when the consumer has gone away (stop producing)."""
+        stalled = False
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.05)
+                _note_max("peak_buffered", self._q.qsize())
+                return True
+            except self._queue_mod.Full:
+                if not stalled:
+                    stalled = True
+                    note_credit_stall()
+        return False
+
+    def put_done(self) -> None:
+        # sentinel bypasses the stall accounting but not the bound
+        while not self._stop.is_set():
+            try:
+                self._q.put(self._DONE, timeout=0.05)
+                return
+            except self._queue_mod.Full:
+                pass
+
+    def drain(self, producers: int):
+        """Yield items until `producers` DONE sentinels arrived.
+        Exceptions put by producers re-raise in the consumer."""
+        finished = 0
+        while finished < producers:
+            item = self._q.get()
+            if item is self._DONE:
+                finished += 1
+            elif isinstance(item, BaseException):
+                raise item
+            else:
+                yield item

@@ -36,7 +36,10 @@ from enum import IntEnum
 import numpy as np
 
 __all__ = ["Cmd", "WireError", "encode", "decode",
-           "encode_frame", "decode_frame_payload"]
+           "encode_frame", "decode_frame_payload",
+           "STATUS_OK", "STATUS_ERR", "STATUS_OK_TRACED",
+           "STATUS_STREAM_FRAME", "STATUS_STREAM_END", "STATUS_CREDIT",
+           "MAX_STREAM_CREDIT", "StreamReader", "CreditGate"]
 
 
 class WireError(Exception):
@@ -69,6 +72,9 @@ class Cmd(IntEnum):
     RAW_SCAN = 26
     # coprocessor
     COP = 40
+    # streaming coprocessor: multi-frame reply with credit flow control
+    # (ref: CmdCopStream, store/tikv/coprocessor.go:547-555)
+    COP_STREAM = 41
     # debug / admin
     MVCC_BY_KEY = 50
     MVCC_BY_START_TS = 51
@@ -104,6 +110,7 @@ CMD_BY_METHOD = {
     "raw_delete": Cmd.RAW_DELETE,
     "raw_delete_range": Cmd.RAW_DELETE_RANGE, "raw_scan": Cmd.RAW_SCAN,
     "coprocessor": Cmd.COP,
+    "coprocessor_stream": Cmd.COP_STREAM,
     "mvcc_by_key": Cmd.MVCC_BY_KEY,
     "mvcc_by_start_ts": Cmd.MVCC_BY_START_TS,
     "split_region": Cmd.SPLIT_REGION,
@@ -228,6 +235,9 @@ def _install_registry():
     from tidb_tpu.ops.hashagg import GroupResult
     _reg_struct(22, GroupResult)
 
+    from tidb_tpu.store.stream import StreamFrame
+    _reg_struct(25, StreamFrame, fields=["chunk", "range", "last"])
+
     # MVCC engine internals: cross the wire only in REPL_SNAPSHOT state
     # transfer (primary -> attaching backup)
     from tidb_tpu.mockstore.mvcc import WriteType, _Entry, _Lock
@@ -259,6 +269,7 @@ def _install_registry():
     _reg_error(11, kv.StoreUnavailableError)
     _reg_error(12, kv.ServerBusyError)
     _reg_error(13, TimeoutError_)
+    _reg_error(14, kv.StreamInterruptedError)
 
 
 _installed = False
@@ -596,3 +607,127 @@ def decode_frame_payload(buf: bytes):
         raise
     except Exception as e:   # noqa: BLE001 — decoder must never crash caller
         raise WireError(f"malformed frame: {e}") from None
+
+
+# -- streamed replies (COP_STREAM) --------------------------------------------
+#
+# A COP_STREAM request opens a stream on the connection: the server
+# answers with zero or more STATUS_STREAM_FRAME frames (each payload a
+# StreamFrame, struct id 25), terminated by STATUS_STREAM_END (normal) or
+# STATUS_ERR (typed error; the stream is over, the connection is back in
+# request/response state). Flow control is credit-based: the request
+# carries an initial window of N frames; the server decrements per frame
+# sent and BLOCKS at zero until the client ships a STATUS_CREDIT frame
+# (payload: int grant) — a slow consumer backpressures the server instead
+# of growing a buffer on either side. Both directions are validated by
+# the state machines below; any protocol violation (frame after END,
+# more frames outstanding than granted, a non-stream status mid-stream,
+# a malformed grant) raises WireError LOUDLY — never deadlocks, never
+# desynchronizes silently. Ref: the grpc server-streaming contract of
+# CmdCopStream (store/tikv/coprocessor.go:547-555) + tikvrpc.go.
+
+STATUS_OK = 0
+STATUS_ERR = 1
+STATUS_OK_TRACED = 2   # payload = (result, span-tree dict)
+STATUS_STREAM_FRAME = 3
+STATUS_STREAM_END = 4
+STATUS_CREDIT = 5
+
+MAX_STREAM_CREDIT = 1024
+
+
+class StreamReader:
+    """Client-side validation of one streamed reply.
+
+    feed(status, payload) -> ("frame", StreamFrame) | ("end", None);
+    typed server errors re-raise in the caller. Tracks the credit ledger:
+    the server exceeding the granted window is a protocol violation
+    (it proves the peer ignores backpressure) and fails loudly."""
+
+    def __init__(self, credit: int):
+        if not (1 <= credit <= MAX_STREAM_CREDIT):
+            raise WireError(f"bad credit window {credit!r}")
+        self.granted = credit
+        self.consumed = 0
+        self.done = False
+
+    def grant(self, n: int = 1) -> None:
+        self.granted += n
+
+    def feed(self, status: int, payload: bytes):
+        if self.done:
+            raise WireError("frame after stream end")
+        if status == STATUS_STREAM_END:
+            self.done = True
+            # END may carry the server's span tree (trace propagation)
+            return ("end", decode_frame_payload(payload)
+                    if payload else None)
+        if status == STATUS_ERR:
+            self.done = True
+            err = decode_frame_payload(payload)
+            if isinstance(err, BaseException):
+                raise err
+            raise WireError(f"stream error: {err!r}")
+        if status != STATUS_STREAM_FRAME:
+            # e.g. a STATUS_OK of an interleaved plain reply: streams own
+            # the connection until END — anything else is corruption
+            raise WireError(f"unexpected status {status} mid-stream")
+        self.consumed += 1
+        if self.consumed > self.granted:
+            raise WireError(
+                f"credit violation: {self.consumed} frames received, "
+                f"{self.granted} granted")
+        frame = decode_frame_payload(payload)
+        from tidb_tpu import kv as _kv
+        from tidb_tpu.store.stream import StreamFrame
+        if not isinstance(frame, StreamFrame):
+            raise WireError(
+                f"stream frame payload is {type(frame).__name__}, "
+                "want StreamFrame")
+        # field-shape validation: consumers dereference range.start/.end
+        # and branch on last — corruption must fail HERE as WireError,
+        # not as an AttributeError deep in the resume logic
+        if not (isinstance(frame.range, _kv.KVRange) and
+                isinstance(frame.range.start, bytes) and
+                isinstance(frame.range.end, bytes) and
+                isinstance(frame.last, bool)):
+            raise WireError("malformed StreamFrame fields")
+        return ("frame", frame)
+
+
+class CreditGate:
+    """Server-side credit ledger: consume() per frame sent; when the
+    window is exhausted the serving loop blocks reading grant frames and
+    feeds them through feed_grant(), which validates them."""
+
+    def __init__(self, credit: int):
+        if not (isinstance(credit, int) and not isinstance(credit, bool)
+                and 1 <= credit <= MAX_STREAM_CREDIT):
+            raise WireError(f"bad credit window {credit!r}")
+        self.credit = credit
+        self.sent = 0        # frames shipped
+        self.received = 0    # grant units absorbed
+
+    def consume(self) -> None:
+        if self.credit <= 0:
+            raise WireError("sent frame without credit")
+        self.credit -= 1
+        self.sent += 1
+
+    def feed_grant(self, status: int, payload: bytes) -> None:
+        if status != STATUS_CREDIT:
+            raise WireError(f"expected credit grant, got status {status}")
+        n = decode_frame_payload(payload)
+        if not (isinstance(n, int) and not isinstance(n, bool)
+                and 1 <= n <= MAX_STREAM_CREDIT):
+            raise WireError(f"bad credit grant {n!r}")
+        self.credit += n
+        self.received += n
+
+    @property
+    def outstanding(self) -> int:
+        """Grant units still in flight from a well-behaved peer that
+        grants one unit per consumed frame: after a clean stream end the
+        server must absorb exactly this many before the connection is
+        back in request/response framing."""
+        return self.sent - self.received

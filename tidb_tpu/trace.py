@@ -20,7 +20,7 @@ import logging
 import threading
 import time
 
-__all__ = ["begin", "end", "span", "current_root", "phase_ns"]
+__all__ = ["begin", "end", "span", "annotate", "current_root", "phase_ns"]
 
 log = logging.getLogger("tidb_tpu.trace")
 
@@ -102,6 +102,17 @@ def span(name: str, **tags):
 def active() -> bool:
     """True when the calling thread is inside a traced statement."""
     return getattr(_tl, "cur", None) is not None
+
+
+def annotate(**tags) -> None:
+    """Merge tags into the thread's CURRENT span without opening a child
+    — safe from inside generators (a `with span(...)` wrapped around a
+    `yield` would interleave restores with the consumer's own spans).
+    Used by the streaming coprocessor to stamp per-stream frame/byte/
+    stall counts onto the dispatching span. No-op untraced."""
+    cur = getattr(_tl, "cur", None)
+    if cur is not None:
+        cur.tags.update(tags)
 
 
 def attach_remote(d: dict) -> None:
