@@ -374,6 +374,42 @@ def main() -> None:
         progress(f"{qname}: device warm took {warm_secs:.1f}s; timing")
         d_secs, d_rows = _time_query(session, sql, iters)
 
+        # per-operator device-time attribution: one extra instrumented
+        # run with tidb_tpu_runtime_stats_device on (block_until_ready
+        # serializes dispatch, so it must never run inside the timed
+        # iterations). Future rounds diff these totals to pin a
+        # regression on the operator that caused it.
+        config.set_var("tidb_tpu_runtime_stats_device", 1)
+        try:
+            session.query(sql)
+            coll = getattr(session, "_last_stats", None)
+            if coll is not None:
+                # sum per operator NAME: Q3/Q5 plans hold several
+                # HashJoin/TableReader nodes and a dict comprehension
+                # would keep only the last one's numbers
+                op_detail = {}
+                for s in coll.ops():
+                    if not s.loops:
+                        continue
+                    a = op_detail.setdefault(
+                        s.name, {"time_ns": 0, "device_time_ns": 0,
+                                 "act_rows": 0})
+                    a["time_ns"] += s.time_ns
+                    a["device_time_ns"] += s.device_time_ns
+                    a["act_rows"] += s.act_rows
+                op_device = {k: v["device_time_ns"]
+                             for k, v in op_detail.items()
+                             if v["device_time_ns"]}
+            else:
+                op_detail, op_device = {}, {}
+        except Exception as e:  # noqa: BLE001 - attribution is advisory
+            # keep op_device_time_ns shape-stable (op -> int ns) so
+            # cross-round diff tooling never chokes on an error string
+            op_detail, op_device = {}, {}
+            detail.setdefault("op_stats_errors", {})[qname] = str(e)
+        finally:
+            config.set_var("tidb_tpu_runtime_stats_device", 0)
+
         # measured host baseline: same SQL, same store, numpy operators
         config.set_var("tidb_tpu_device", 0)
         mesh_config.disable_mesh()
@@ -405,6 +441,8 @@ def main() -> None:
             "speedup": round(d_rps / h_rps, 2),
             "first_run_secs": round(warm_secs, 2),
             "result_rows": len(d_rows),
+            "op_device_time_ns": op_device,
+            "op_stats": op_detail,
         }
 
     config.set_var("tidb_tpu_device", 1)

@@ -1,0 +1,104 @@
+"""Static metric-name invariant, enforced as a test (style of
+test_lint_wire.py): every `metrics.counter(...)` / `metrics.histogram(...)`
+call site inside the package passes a name CONSTANT declared in
+metrics.py — never a string literal. A typo'd stringly family name would
+silently fork a metric family; the registry of names in metrics.py is
+the single place scrape dashboards are built against.
+
+Checked by AST walk over every package module, so renamed imports and
+f-string names can't slip through."""
+
+import ast
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "tidb_tpu")
+
+
+def _package_files():
+    for root, _dirs, files in os.walk(PKG):
+        if "__pycache__" in root:
+            continue
+        for f in files:
+            if f.endswith(".py"):
+                yield os.path.join(root, f)
+
+
+def _tree(path):
+    with open(path) as f:
+        return ast.parse(f.read(), filename=path)
+
+
+def _declared_constants():
+    """UPPERCASE module-level string constants of metrics.py."""
+    out = {}
+    for node in _tree(os.path.join(PKG, "metrics.py")).body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id.isupper() and \
+                isinstance(node.value, ast.Constant) and \
+                isinstance(node.value.value, str):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+def _metric_calls(tree):
+    """Call nodes of the form <anything>.counter(...) / .histogram(...)
+    where the receiver is the metrics module (imported as `metrics`)."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and \
+                fn.attr in ("counter", "histogram") and \
+                isinstance(fn.value, ast.Name) and \
+                fn.value.id == "metrics":
+            yield node
+
+
+def _name_arg(call):
+    if call.args:
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg == "name":
+            return kw.value
+    return None
+
+
+def test_every_metric_call_uses_a_declared_constant():
+    consts = _declared_constants()
+    assert consts, "metrics.py lost its name constants"
+    offenders = []
+    for path in _package_files():
+        rel = os.path.relpath(path, REPO)
+        for call in _metric_calls(_tree(path)):
+            arg = _name_arg(call)
+            if arg is None:
+                offenders.append(f"{rel}:{call.lineno}: no name arg")
+                continue
+            if isinstance(arg, ast.Attribute) and \
+                    isinstance(arg.value, ast.Name) and \
+                    arg.value.id == "metrics" and arg.attr in consts:
+                continue
+            offenders.append(
+                f"{rel}:{call.lineno}: metric name must be a "
+                f"metrics.<CONSTANT> declared in metrics.py, got "
+                f"{ast.dump(arg)[:60]}")
+    assert not offenders, "\n".join(offenders)
+
+
+def test_declared_names_follow_prometheus_conventions():
+    for const, name in _declared_constants().items():
+        assert name.startswith("tidb_tpu_"), (const, name)
+        assert name == name.lower(), (const, name)
+        # counters end _total, timings end _seconds (Prometheus idiom)
+        assert name.endswith(("_total", "_seconds")), (const, name)
+
+
+def test_call_sites_exist():
+    """The lint is vacuous if nothing calls metrics — pin that the
+    session and coprocessor layers really emit."""
+    hits = 0
+    for path in _package_files():
+        hits += sum(1 for _ in _metric_calls(_tree(path)))
+    assert hits >= 10, hits

@@ -1,0 +1,111 @@
+"""/metrics exposition format (metrics.py): a minimal Prometheus
+text-format parser verifies what real scrapers depend on — # HELP/# TYPE
+metadata per family, monotone cumulative buckets, +Inf == _count, and
+labeled series (counters AND histograms) that parse cleanly."""
+
+import re
+
+from tidb_tpu import metrics
+
+_SERIES = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(\S+)$")
+_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"')
+
+
+def parse_exposition(text: str):
+    """-> (series, meta): series maps (name, frozenset(labels)) -> float,
+    meta maps family name -> {"help": str, "type": str}. Raises on any
+    line a Prometheus scraper would reject."""
+    series: dict = {}
+    meta: dict = {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            _h, _k, name, rest = line.split(" ", 3)
+            meta.setdefault(name, {})["help"] = rest
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(" ")
+            assert len(parts) == 4, f"bad TYPE line: {line!r}"
+            assert parts[3] in ("counter", "gauge", "histogram",
+                                "summary", "untyped"), line
+            meta.setdefault(parts[2], {})["type"] = parts[3]
+            continue
+        assert not line.startswith("#"), f"unknown comment: {line!r}"
+        m = _SERIES.match(line)
+        assert m, f"unparseable series line: {line!r}"
+        name, lbl, val = m.groups()
+        labels = frozenset(_LABEL.findall(lbl)) if lbl else frozenset()
+        series[(name, labels)] = float(val)
+    return series, meta
+
+
+def _family(series, name):
+    return {k: v for k, v in series.items() if k[0] == name}
+
+
+def test_counters_have_help_and_type():
+    metrics.counter("tidb_tpu_test_expo_total", {"kind": "a"}, inc=2)
+    series, meta = parse_exposition(metrics.expose())
+    fam = _family(series, "tidb_tpu_test_expo_total")
+    assert ("tidb_tpu_test_expo_total",
+            frozenset({("kind", "a")})) in fam
+    assert meta["tidb_tpu_test_expo_total"]["type"] == "counter"
+    assert meta["tidb_tpu_test_expo_total"]["help"]
+
+
+def test_histogram_buckets_monotone_and_inf_equals_count():
+    name = "tidb_tpu_test_expo_hist_seconds"
+    for v in (0.0001, 0.003, 0.02, 0.2, 2.0, 100.0):
+        metrics.histogram(name, v)
+    series, meta = parse_exposition(metrics.expose())
+    assert meta[name]["type"] == "histogram"
+    buckets = []
+    for (n, labels), v in series.items():
+        if n == name + "_bucket":
+            le = dict(labels)["le"]
+            buckets.append((float("inf") if le == "+Inf" else float(le),
+                            v))
+    buckets.sort()
+    assert buckets, "no bucket series"
+    counts = [c for _le, c in buckets]
+    assert counts == sorted(counts), "buckets must be cumulative"
+    total = series[(name + "_count", frozenset())]
+    assert buckets[-1][0] == float("inf")
+    assert buckets[-1][1] == total == 6
+    assert series[(name + "_sum", frozenset())] > 100.0
+
+
+def test_labeled_histogram_series():
+    name = "tidb_tpu_test_expo_op_seconds"
+    metrics.histogram(name, 0.01, {"op": "HashAgg"})
+    metrics.histogram(name, 0.5, {"op": "HashJoin"})
+    series, meta = parse_exposition(metrics.expose())
+    assert meta[name]["type"] == "histogram"
+    for op in ("HashAgg", "HashJoin"):
+        key = (name + "_count", frozenset({("op", op)}))
+        assert series[key] == 1, sorted(
+            k for k in series if k[0].startswith(name))
+        # every bucket line of a labeled series carries BOTH labels
+        bucket_labels = [dict(labels) for (n, labels) in series
+                         if n == name + "_bucket"
+                         and dict(labels).get("op") == op]
+        assert bucket_labels and all("le" in d for d in bucket_labels)
+
+
+def test_snapshot_keeps_flat_keys_for_unlabeled():
+    metrics.counter("tidb_tpu_test_expo_flat_total")
+    metrics.histogram("tidb_tpu_test_expo_flat_seconds", 0.1)
+    snap = metrics.snapshot()
+    assert snap["tidb_tpu_test_expo_flat_total"] >= 1
+    assert snap["tidb_tpu_test_expo_flat_seconds_count"] >= 1
+    assert "tidb_tpu_test_expo_flat_seconds_sum" in snap
+
+
+def test_meta_emitted_once_per_family():
+    metrics.counter("tidb_tpu_test_expo_once_total", {"a": "1"})
+    metrics.counter("tidb_tpu_test_expo_once_total", {"a": "2"})
+    text = metrics.expose()
+    assert text.count("# TYPE tidb_tpu_test_expo_once_total ") == 1
+    assert text.count("# HELP tidb_tpu_test_expo_once_total ") == 1

@@ -61,6 +61,34 @@ def test_remote_spans_graft_into_statement_trace(server):
     st.close()
 
 
+def test_attach_remote_child_ends_at_now_duration_preserved():
+    """Unit pin for trace.attach_remote: remote clocks don't align, so a
+    grafted child is positioned to END at the moment of grafting ("now")
+    with its reported duration preserved — and the same holds for nested
+    children."""
+    import time
+    root = trace.begin("statement")
+    try:
+        before = time.perf_counter_ns()
+        trace.attach_remote({
+            "name": "storage:coprocessor", "duration_ns": 5_000_000,
+            "children": [{"name": "storage:kv_scan",
+                          "duration_ns": 2_000_000}],
+        })
+        after = time.perf_counter_ns()
+    finally:
+        trace.end(root)
+    child = root.children[-1]
+    assert child.name == "storage:coprocessor"
+    assert child.duration_ns == 5_000_000
+    # ends at "now": between the instants bracketing the graft call
+    assert before <= child.end_ns <= after
+    assert child.start_ns == child.end_ns - 5_000_000
+    sub = child.children[0]
+    assert sub.duration_ns == 2_000_000
+    assert before <= sub.end_ns <= after
+
+
 def test_untraced_calls_skip_propagation(server):
     st = connect("127.0.0.1", server.port)
     s = Session(st)

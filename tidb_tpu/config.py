@@ -24,7 +24,8 @@ __all__ = ["get_var", "set_var", "all_vars", "coerce", "session_overlay",
            "current_overlay", "device_enabled", "chunk_cache_enabled",
            "cop_concurrency", "sort_spill_rows", "device_min_rows",
            "stream_rows", "copr_stream_enabled", "copr_stream_frame_bytes",
-           "copr_stream_credit", "UnknownVariableError"]
+           "copr_stream_credit", "runtime_stats_enabled",
+           "runtime_stats_device", "UnknownVariableError"]
 
 
 class UnknownVariableError(Exception):
@@ -77,6 +78,16 @@ _DEFS: dict[str, tuple[str, int]] = {
     # statements at/above this wall time land in the slow-query log
     # (ref: config.Log.SlowThreshold, default 300ms)
     "tidb_tpu_slow_query_ms": (_INT, 300),
+    # per-operator runtime statistics (runtime_stats.py; ref: the
+    # RuntimeStatsColl threaded through the reference's executors). On by
+    # default: the host-side cost is a clock read per chunk. Feeds
+    # EXPLAIN ANALYZE, the digest summary's hot spots, the slow log and
+    # the tidb_tpu_op_* metric families.
+    "tidb_tpu_runtime_stats": (_BOOL, 1),
+    # device-time attribution: times kernel calls around
+    # block_until_ready, which SERIALIZES dispatch — off by default,
+    # flip per session when profiling (EXPLAIN ANALYZE device_time)
+    "tidb_tpu_runtime_stats_device": (_BOOL, 0),
     # emit every statement's span tree to the tidb_tpu.trace logger
     # (ref: the OpenTracing spans of session.go:692 / compiler.go:34)
     "tidb_tpu_trace_log": (_BOOL, 0),
@@ -236,3 +247,11 @@ def copr_stream_frame_bytes() -> int:
 
 def copr_stream_credit() -> int:
     return max(1, _read("tidb_tpu_copr_stream_credit"))
+
+
+def runtime_stats_enabled() -> bool:
+    return bool(_read("tidb_tpu_runtime_stats"))
+
+
+def runtime_stats_device() -> bool:
+    return bool(_read("tidb_tpu_runtime_stats_device"))

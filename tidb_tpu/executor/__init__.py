@@ -13,7 +13,7 @@ import threading
 
 import numpy as np
 
-from tidb_tpu import config, kv, tablecodec
+from tidb_tpu import config, kv, runtime_stats, tablecodec
 from tidb_tpu.chunk import Chunk, Column
 from tidb_tpu.expression import AggDesc, AggFunc, Expression
 from tidb_tpu.kv import CopRequest, KVRange, ReqType
@@ -99,7 +99,12 @@ def build_executor(plan: ph.PhysPlan) -> Executor:
     b = _BUILDERS.get(t)
     if b is None:
         raise ExecError(f"no executor for {t.__name__}")
-    return b(plan)
+    exe = b(plan)
+    # per-statement runtime stats: children are built (and wrapped)
+    # inside the constructor above, so every node in the tree passes
+    # through here exactly once per execution
+    runtime_stats.instrument(exe, plan)
+    return exe
 
 
 # ---------------------------------------------------------------------------
@@ -444,7 +449,8 @@ class HashAggExec(Executor):
                         self._kernel = HashAggKernel(
                             None, self.plan.group_exprs, self.plan.aggs)
                         self.plan._root_kernel = self._kernel
-                    gr = self._kernel(chunk)
+                    gr = runtime_stats.device_call(
+                        self.plan, self._kernel, chunk)
                 except CapacityError as e:
                     # re-plan once with a larger device table (the re-plan
                     # the kernel docstring promises), then host fallback
@@ -456,7 +462,8 @@ class HashAggExec(Executor):
                                 None, self.plan.group_exprs,
                                 self.plan.aggs, capacity=cap)
                             self.plan._root_kernel = self._kernel
-                            gr = self._kernel(chunk)
+                            gr = runtime_stats.device_call(
+                                self.plan, self._kernel, chunk)
                         except (CapacityError, CollisionError, ValueError):
                             gr = None
                 except (CollisionError, ValueError):
@@ -505,7 +512,8 @@ class StreamAggExec(Executor):
                         self._kernel = SegmentAggKernel(
                             self.plan.group_exprs, self.plan.aggs)
                         self.plan._root_kernel = self._kernel
-                    gr = self._kernel(part)
+                    gr = runtime_stats.device_call(
+                        self.plan, self._kernel, part)
                 except (ValueError, NotImplementedError):
                     use_device = False
             if gr is None:
@@ -818,15 +826,18 @@ class HashJoinExec(Executor):
                 from tidb_tpu.parallel.shuffle_join import \
                     ShuffleOverflowError
                 try:
-                    li, ri = mesh_kernel(pk, bk, nb, n)
+                    li, ri = runtime_stats.device_call(
+                        self.plan, mesh_kernel, pk, bk, nb, n)
                 except ShuffleOverflowError:
                     # designed fallback: extreme hash skew exhausted the
                     # repartition retry budget
-                    li, ri = self._kernel(bk, pk, nb, n)
+                    li, ri = runtime_stats.device_call(
+                        self.plan, self._kernel, bk, pk, nb, n)
             elif config.device_enabled() and \
                     (n >= self._DEVICE_MIN_PROBE or
                      nb >= self._DEVICE_MIN_BUILD):
-                li, ri = self._kernel(bk, pk, nb, n)
+                li, ri = runtime_stats.device_call(
+                    self.plan, self._kernel, bk, pk, nb, n)
             else:
                 # small inputs / device disabled: the same sort-join,
                 # vectorized in numpy (no jit dispatch, dynamic shapes)
@@ -1155,7 +1166,8 @@ class IndexJoinExec(HashJoinExec):
             enc = JoinKeyEncoder(len(plan.right_keys))  # fresh per batch
             bk = enc.fit_build(self._eval_keys(plan.right_keys, build))
             pk = enc.transform_probe(self._eval_keys(plan.left_keys, chunk))
-            li, ri = self._kernel(bk, pk, nb, n)
+            li, ri = runtime_stats.device_call(
+                self.plan, self._kernel, bk, pk, nb, n)
             pair = None
             if plan.other_cond is not None and len(li):
                 pair = self._gather(chunk, build, li, ri)

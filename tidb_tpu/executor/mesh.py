@@ -19,6 +19,7 @@ from collections import OrderedDict
 import numpy as np
 
 from tidb_tpu import config as sysconf
+from tidb_tpu import runtime_stats
 from tidb_tpu.chunk import Chunk, Column
 from tidb_tpu.ops.hashagg import CapacityError, CollisionError, HashAggregator
 from tidb_tpu.ops.hostagg import host_hash_agg
@@ -288,11 +289,14 @@ class MeshAggExec(_MeshExecBase):
                 return k
 
             agg = HashAggregator(plan.aggs, plan.group_exprs)
-            self._stream_groups(
-                super_batches(parts, it, limit), get_kernel,
-                lambda b: host_hash_agg(b, plan.filter_expr,
-                                        plan.group_exprs, plan.aggs),
-                agg)
+            # mesh pipelines overlap async launches, so the device time
+            # is the whole streaming region's wall (ends on readback)
+            with runtime_stats.device_section(plan):
+                self._stream_groups(
+                    super_batches(parts, it, limit), get_kernel,
+                    lambda b: host_hash_agg(b, plan.filter_expr,
+                                            plan.group_exprs, plan.aggs),
+                    agg)
             yield _emit_agg(plan, agg, ex)
             return
 
@@ -301,7 +305,8 @@ class MeshAggExec(_MeshExecBase):
         big = _concat_chunks_cached(plan, "_probe_cache", parts, schema)
         gr = None
         if big.num_rows:
-            gr = self._run_with_escalation(make, lambda k: k(big))
+            with runtime_stats.device_section(plan):
+                gr = self._run_with_escalation(make, lambda k: k(big))
             if gr is None:
                 yield from self._fallback(ctx)
                 return
@@ -369,12 +374,13 @@ class MeshLookupAggExec(_MeshExecBase):
                 return refresh(k)
 
             agg = HashAggregator(plan.aggs, plan.group_exprs)
-            self._stream_groups(
-                super_batches(parts, it, limit), get_kernel,
-                lambda b: host_lookup_agg(b, plan.filter_expr, specs,
-                                          plan.group_exprs, plan.aggs,
-                                          builds=builds),
-                agg)
+            with runtime_stats.device_section(plan):
+                self._stream_groups(
+                    super_batches(parts, it, limit), get_kernel,
+                    lambda b: host_lookup_agg(b, plan.filter_expr, specs,
+                                              plan.group_exprs, plan.aggs,
+                                              builds=builds),
+                    agg)
             yield _emit_agg(plan, agg, ex)
             return
 
@@ -382,8 +388,9 @@ class MeshLookupAggExec(_MeshExecBase):
                                       plan.children[0].schema)
         gr = None
         if probe.num_rows:
-            gr = self._run_with_escalation(
-                make, lambda kernel: refresh(kernel)(probe))
+            with runtime_stats.device_section(plan):
+                gr = self._run_with_escalation(
+                    make, lambda kernel: refresh(kernel)(probe))
             if gr is None:
                 yield from self._fallback(ctx)
                 return

@@ -4,7 +4,10 @@ Reference: the per-package metrics.go files (10 of them — parse/compile/
 execute histograms at session.go:682,739,755, 2PC action durations, cop
 task counts, backoff totals). No client library dependency: counters and
 histograms are plain atomics-under-lock, and /metrics on the status
-server renders the standard text format scrapers consume.
+server renders the standard text format scrapers consume — including
+`# HELP` / `# TYPE` metadata so real Prometheus ingestion works, and
+labeled histogram series (the per-operator tidb_tpu_op_* families need
+an `op` label per series).
 """
 
 from __future__ import annotations
@@ -15,11 +18,12 @@ __all__ = ["counter", "histogram", "expose", "snapshot",
            "QUERY_DURATIONS", "QUERIES_TOTAL", "SLOW_QUERIES",
            "CONNECTIONS", "COP_TASKS", "QUERY_ERRORS",
            "COP_STREAM_FRAMES", "COP_STREAM_BYTES",
-           "COP_STREAM_CREDIT_STALLS", "COP_STREAM_RESUMES"]
+           "COP_STREAM_CREDIT_STALLS", "COP_STREAM_RESUMES",
+           "OP_DURATIONS", "OP_ROWS", "OP_DEVICE_DURATIONS"]
 
 _lock = threading.Lock()
 _counters: dict[tuple[str, tuple], float] = {}
-_histograms: dict[str, "_Hist"] = {}
+_histograms: dict[tuple[str, tuple], "_Hist"] = {}
 
 _BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0)
 
@@ -45,51 +49,77 @@ class _Hist:
         self.sum += v
 
 
+def _label_key(labels: dict | None) -> tuple:
+    return tuple(sorted((labels or {}).items()))
+
+
+def _label_str(labels: tuple, extra: str = "") -> str:
+    parts = [f'{k}="{v}"' for k, v in labels]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
 def counter(name: str, labels: dict | None = None, inc: float = 1) -> None:
-    key = (name, tuple(sorted((labels or {}).items())))
+    key = (name, _label_key(labels))
     with _lock:
         _counters[key] = _counters.get(key, 0) + inc
 
 
-def histogram(name: str, value: float) -> None:
+def histogram(name: str, value: float, labels: dict | None = None) -> None:
+    key = (name, _label_key(labels))
     with _lock:
-        h = _histograms.get(name)
+        h = _histograms.get(key)
         if h is None:
-            h = _histograms[name] = _Hist()
+            h = _histograms[key] = _Hist()
         h.observe(value)
 
 
 def snapshot() -> dict:
-    """Plain dict of counter values (tests / status JSON)."""
+    """Plain dict of counter/histogram values (tests / status JSON).
+    Unlabeled series keep the historical flat keys (name, name_count,
+    name_sum); labeled series append their label set."""
     with _lock:
         out = {}
         for (name, labels), v in _counters.items():
-            key = name if not labels else \
-                name + "{" + ",".join(f'{k}="{val}"'
-                                      for k, val in labels) + "}"
-            out[key] = v
-        for name, h in _histograms.items():
-            out[name + "_count"] = h.total
-            out[name + "_sum"] = round(h.sum, 6)
+            out[name + _label_str(labels)] = v
+        for (name, labels), h in _histograms.items():
+            lbl = _label_str(labels)
+            out[name + "_count" + lbl] = h.total
+            out[name + "_sum" + lbl] = round(h.sum, 6)
         return out
 
 
 def expose() -> str:
-    """Prometheus text exposition format."""
+    """Prometheus text exposition format, with # HELP/# TYPE per family
+    so real scrapers ingest the endpoint cleanly."""
     lines = []
     with _lock:
+        seen_meta: set[str] = set()
+
+        def meta(name: str, tp: str) -> None:
+            if name in seen_meta:
+                return
+            seen_meta.add(name)
+            lines.append(f"# HELP {name} {_HELP.get(name, name)}")
+            lines.append(f"# TYPE {name} {tp}")
+
         for (name, labels), v in sorted(_counters.items()):
-            lbl = "{" + ",".join(f'{k}="{val}"' for k, val in labels) + "}" \
-                if labels else ""
-            lines.append(f"{name}{lbl} {v}")
-        for name, h in sorted(_histograms.items()):
+            meta(name, "counter")
+            lines.append(f"{name}{_label_str(labels)} {v}")
+        for (name, labels), h in sorted(_histograms.items()):
+            meta(name, "histogram")
             acc = 0
             for b, c in zip(h.buckets, h.counts):
                 acc += c
-                lines.append(f'{name}_bucket{{le="{b}"}} {acc}')
-            lines.append(f'{name}_bucket{{le="+Inf"}} {h.total}')
-            lines.append(f"{name}_count {h.total}")
-            lines.append(f"{name}_sum {h.sum}")
+                le = 'le="%s"' % b
+                lines.append(
+                    f"{name}_bucket{_label_str(labels, le)} {acc}")
+            inf = 'le="+Inf"'
+            lines.append(
+                f"{name}_bucket{_label_str(labels, inf)} {h.total}")
+            lines.append(f"{name}_count{_label_str(labels)} {h.total}")
+            lines.append(f"{name}_sum{_label_str(labels)} {h.sum}")
     return "\n".join(lines) + "\n"
 
 
@@ -106,3 +136,25 @@ COP_STREAM_FRAMES = "tidb_tpu_cop_stream_frames_total"
 COP_STREAM_BYTES = "tidb_tpu_cop_stream_bytes_total"
 COP_STREAM_CREDIT_STALLS = "tidb_tpu_cop_stream_credit_stalls_total"
 COP_STREAM_RESUMES = "tidb_tpu_cop_stream_resumes_total"
+# per-operator runtime stats (runtime_stats.py), labeled {op="HashAgg"}
+OP_DURATIONS = "tidb_tpu_op_duration_seconds"
+OP_ROWS = "tidb_tpu_op_act_rows_total"
+OP_DEVICE_DURATIONS = "tidb_tpu_op_device_seconds"
+
+_HELP = {
+    QUERY_DURATIONS: "Statement wall time through Session.execute.",
+    QUERIES_TOTAL: "Statements executed, by statement type.",
+    SLOW_QUERIES: "Statements at/above tidb_tpu_slow_query_ms.",
+    CONNECTIONS: "Client connections accepted.",
+    COP_TASKS: "Coprocessor region tasks dispatched.",
+    QUERY_ERRORS: "Statements that raised an error.",
+    COP_STREAM_FRAMES: "Streamed coprocessor frames produced.",
+    COP_STREAM_BYTES: "Raw bytes carried by streamed frames.",
+    COP_STREAM_CREDIT_STALLS:
+        "Producer stalls waiting for client credit.",
+    COP_STREAM_RESUMES: "Mid-stream resumes after interruption.",
+    OP_DURATIONS: "Per-operator host wall time per statement, by op.",
+    OP_ROWS: "Per-operator actual output rows, by op.",
+    OP_DEVICE_DURATIONS:
+        "Per-operator device time (block_until_ready), by op.",
+}

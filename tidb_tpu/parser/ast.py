@@ -464,6 +464,7 @@ class ShowStmt(StmtNode):
 @dataclass
 class ExplainStmt(StmtNode):
     stmt: StmtNode = None
+    analyze: bool = False    # EXPLAIN ANALYZE: execute + actual stats
 
 
 @dataclass

@@ -223,11 +223,13 @@ class Parser:
             if self.peek().tp in (TokenType.IDENT,) or (
                     self.peek().tp == TokenType.KEYWORD and
                     self.peek().val not in ("SELECT", "INSERT", "UPDATE",
-                                            "DELETE", "EXTENDED")):
+                                            "DELETE", "EXTENDED",
+                                            "ANALYZE")):
                 # DESCRIBE <table>
                 return ast.ShowStmt(tp="columns", table=self.table_name())
+            analyze = bool(self.try_kw("ANALYZE"))
             self.try_kw("EXTENDED")
-            return ast.ExplainStmt(stmt=self.statement())
+            return ast.ExplainStmt(stmt=self.statement(), analyze=analyze)
         if kw == "PREPARE":
             self.next()
             name = self.ident()

@@ -67,6 +67,25 @@ class PhysPlan:
         return "\n".join([line] + [c.explain(depth + 1)
                                    for c in self.children])
 
+    def explain_nodes(self, depth: int = 0):
+        """(depth, node) pairs in tree order — the per-node form of
+        explain(), so EXPLAIN ANALYZE can pair each rendered line with
+        the node's runtime stats. Sub-plans hanging off dedicated
+        attributes (Apply's inner, DML readers/sources) are included."""
+        yield depth, self
+        for c in self.children:
+            yield from c.explain_nodes(depth + 1)
+        for attr in ("inner", "reader", "source"):
+            sub = getattr(self, attr, None)
+            if isinstance(sub, PhysPlan):
+                yield from sub.explain_nodes(depth + 1)
+
+    def explain_line(self) -> str:
+        """One node's operator name + info (no children; PhysApply's
+        _explain_info embeds the inner tree inline — strip it)."""
+        name = type(self).__name__.replace("Phys", "")
+        return name + self._explain_info().split("\n", 1)[0]
+
     def _explain_info(self) -> str:
         return ""
 

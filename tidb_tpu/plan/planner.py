@@ -293,7 +293,8 @@ class Planner:
     # events_statements_current / events_statements_history) -----------------
 
     _PERF_TABLES = ("events_statements_current",
-                    "events_statements_history")
+                    "events_statements_history",
+                    "events_statements_summary_by_digest")
 
     def _build_perfschema(self, ts: ast.TableSource) -> ph.PhysValues:
         from tidb_tpu import perfschema
@@ -304,6 +305,8 @@ class Planner:
             raise PlanError(
                 f"Unknown table 'performance_schema.{ts.name}' "
                 f"(available: {', '.join(self._PERF_TABLES)})")
+        if name == "events_statements_summary_by_digest":
+            return self._build_digest_summary(alias)
         events = perfschema.current_events() \
             if name == "events_statements_current" \
             else perfschema.history_events()
@@ -327,6 +330,38 @@ class Planner:
         # events change per statement with no schema-version bump: a
         # cached plan would serve a frozen snapshot forever
         pv.cacheable = False
+        return pv
+
+    def _build_digest_summary(self, alias: str) -> ph.PhysValues:
+        """events_statements_summary_by_digest: the per-digest aggregate
+        rows (ref: util/stmtsummary/statement_summary.go surfaced as a
+        performance_schema memtable)."""
+        from tidb_tpu import perfschema
+        from tidb_tpu.sqltypes import new_int_field, new_string_field
+        sf, intf = new_string_field(1024), new_int_field()
+        cols_spec = [("digest", sf), ("digest_text", sf),
+                     ("exec_count", intf), ("sum_latency_ns", intf),
+                     ("max_latency_ns", intf), ("min_latency_ns", intf),
+                     ("avg_latency_ns", intf), ("sum_parse_ns", intf),
+                     ("sum_plan_ns", intf), ("sum_exec_ns", intf),
+                     ("sum_commit_ns", intf), ("sum_rows", intf),
+                     ("sum_errors", intf), ("first_seen", intf),
+                     ("last_seen", intf), ("top_operators", sf)]
+        schema = PlanSchema([SchemaCol(n, alias, ft)
+                             for n, ft in cols_spec])
+        rows = []
+        for r in perfschema.digest_summary():
+            vals = (r["digest"], r["digest_text"], r["exec_count"],
+                    r["sum_latency_ns"], r["max_latency_ns"],
+                    r["min_latency_ns"], r["avg_latency_ns"],
+                    r["sum_parse_ns"], r["sum_plan_ns"],
+                    r["sum_exec_ns"], r["sum_commit_ns"], r["sum_rows"],
+                    r["sum_errors"], int(r["first_seen"]),
+                    int(r["last_seen"]), r["top_operators"])
+            rows.append([Constant(v, ft)
+                         for v, (_n, ft) in zip(vals, cols_spec)])
+        pv = ph.PhysValues(schema=schema, rows=rows)
+        pv.cacheable = False     # aggregates move per statement
         return pv
 
     def build_from(self, node) -> ph.PhysPlan:

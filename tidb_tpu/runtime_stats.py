@@ -1,0 +1,304 @@
+"""Per-operator runtime statistics: the RuntimeStatsColl analogue.
+
+Reference: the reference's execdetails.RuntimeStatsColl — every executor
+registers basic stats (actual rows, loop count, wall time) keyed by plan
+node, EXPLAIN ANALYZE renders them next to the plan tree, and the slow
+log / statement summary embed them per statement.
+
+Here a `StatsCollector` lives for one statement execution. The session
+installs it in a thread-local around build_executor + execution;
+`instrument()` (called from build_executor) wraps each executor's
+`chunks`/`partials`/`execute` so every batch yielded records
+rows/loops/host-time into the node's `OpStats`. The coprocessor fan-out
+re-installs the collector inside its pool workers (like the sysvar
+overlay) so storage-side device kernels can attribute device time to the
+reader node that issued them.
+
+Device time is EXPENSIVE to observe — `jax.block_until_ready` serializes
+dispatch — so it is gated behind the `tidb_tpu_runtime_stats_device`
+sysvar and collected only at explicit kernel call sites via
+`device_call()` / `device_section()`. Host-side counts stay on by
+default (`tidb_tpu_runtime_stats`): the per-chunk cost is one
+perf_counter read and three integer adds, amortized over 64k-row chunks.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+__all__ = ["OpStats", "StatsCollector", "collecting", "current",
+           "instrument", "device_call", "device_section", "fmt_ns",
+           "fmt_bytes"]
+
+_tl = threading.local()
+
+
+_mem_stats_available: bool | None = None   # None = not yet probed
+
+
+def _device_peak_bytes() -> int:
+    """Backend peak-memory watermark, 0 when the platform doesn't report
+    one (CPU jax has no allocator stats). The availability probe is
+    cached: device_call runs this per kernel call, and paying a
+    raised-and-swallowed exception each time on CPU backends would make
+    profiling runs slower than they need to be."""
+    global _mem_stats_available
+    if _mem_stats_available is False:
+        return 0
+    try:
+        import jax
+        ms = jax.local_devices()[0].memory_stats()
+        if ms:
+            _mem_stats_available = True
+            return int(ms.get("peak_bytes_in_use", 0) or 0)
+        _mem_stats_available = False
+    except Exception:  # noqa: BLE001 - stats must never break execution
+        _mem_stats_available = False
+    return 0
+
+
+class OpStats:
+    """One physical operator's actuals for one statement execution."""
+
+    __slots__ = ("name", "act_rows", "loops", "time_ns",
+                 "device_time_ns", "device_peak_bytes", "cop_tasks")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.act_rows = 0
+        self.loops = 0
+        self.time_ns = 0           # host wall, inclusive of children
+        self.device_time_ns = 0    # sum around block_until_ready
+        self.device_peak_bytes = 0  # backend watermark high-water mark
+        self.cop_tasks = 0
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "act_rows": self.act_rows,
+                "loops": self.loops, "time_ns": self.time_ns,
+                "device_time_ns": self.device_time_ns,
+                "device_peak_bytes": self.device_peak_bytes,
+                "cop_tasks": self.cop_tasks}
+
+
+class StatsCollector:
+    """Stats for one statement: OpStats keyed by plan-node identity.
+
+    The entry pins the plan node, so ids cannot be recycled while the
+    collector lives. `link()` routes records made against a secondary
+    key (a reader's CopPlan, executed storage-side) onto the owning
+    node's OpStats. Device notes may arrive from cop pool workers, so
+    those go through a lock; the host counters are only touched by the
+    session thread that drives the executor tree."""
+
+    def __init__(self, device: bool = False):
+        self.device = device
+        self._nodes: dict[int, tuple[object, OpStats]] = {}
+        self._lock = threading.Lock()
+
+    def node(self, plan, name: str | None = None) -> OpStats:
+        ent = self._nodes.get(id(plan))
+        if ent is not None:
+            return ent[1]
+        if name is None:
+            name = type(plan).__name__.removeprefix("Phys")
+        st = OpStats(name)
+        with self._lock:
+            self._nodes.setdefault(id(plan), (plan, st))
+        return self._nodes[id(plan)][1]
+
+    def link(self, alias_plan, stats: OpStats) -> None:
+        """Route records against `alias_plan` onto `stats`."""
+        with self._lock:
+            self._nodes[id(alias_plan)] = (alias_plan, stats)
+
+    def get(self, plan) -> OpStats | None:
+        ent = self._nodes.get(id(plan))
+        return ent[1] if ent is not None else None
+
+    def note_device(self, plan, elapsed_ns: int) -> None:
+        st = self.node(plan)
+        peak = _device_peak_bytes()   # backend query stays off the lock
+        with self._lock:
+            st.device_time_ns += elapsed_ns
+            if peak > st.device_peak_bytes:
+                st.device_peak_bytes = peak
+
+    def note_cop_tasks(self, plan, n: int) -> None:
+        st = self.node(plan)
+        with self._lock:
+            st.cop_tasks += n
+
+    def ops(self) -> list[OpStats]:
+        """Distinct OpStats (aliases deduped), insertion order."""
+        sealed = getattr(self, "_sealed_ops", None)
+        if sealed is not None:
+            return list(sealed)
+        seen: list[OpStats] = []
+        for _plan, st in self._nodes.values():
+            if all(st is not s for s in seen):
+                seen.append(st)
+        return seen
+
+    def seal(self) -> None:
+        """Drop the plan-object references once the statement is done:
+        the collector outlives the statement on the session (bench reads
+        it), and it must not pin the executed plan tree. ops() keeps
+        answering from the sealed snapshot."""
+        ops = self.ops()
+        with self._lock:
+            self._sealed_ops = ops
+            self._nodes = {}
+
+
+@contextlib.contextmanager
+def collecting(coll: StatsCollector | None):
+    """Install `coll` as this thread's active collector. Passing the
+    already-active collector (or None) nests transparently."""
+    prev = getattr(_tl, "coll", None)
+    _tl.coll = coll if coll is not None else prev
+    try:
+        yield _tl.coll
+    finally:
+        _tl.coll = prev
+
+
+def current() -> StatsCollector | None:
+    return getattr(_tl, "coll", None)
+
+
+@contextlib.contextmanager
+def suspended():
+    """Hide the active collector (internal bookkeeping sessions run
+    inside a client statement but must not pollute its operator stats —
+    the stats twin of trace.detach())."""
+    prev = getattr(_tl, "coll", None)
+    _tl.coll = None
+    try:
+        yield
+    finally:
+        _tl.coll = prev
+
+
+# -- executor instrumentation (wired from build_executor) -------------------
+
+
+def instrument(exe, plan) -> None:
+    """Wrap the executor's production methods so each yielded batch
+    records rows/loops/time into the active collector's node for `plan`.
+    No-op when no collector is active (internal sessions, stats off)."""
+    coll = current()
+    if coll is None:
+        return
+    st = coll.node(plan)
+    # storage-side execution of a reader's pushed subplan records against
+    # the CopPlan object; route those onto the reader's stats
+    for attr in ("cop", "index_cop", "table_cop"):
+        cop = getattr(plan, attr, None)
+        if cop is not None:
+            coll.link(cop, st)
+
+    if hasattr(exe, "chunks"):
+        exe.chunks = _wrap_iter(exe.chunks, st)
+    if hasattr(exe, "partials"):
+        exe.partials = _wrap_iter(exe.partials, st)
+    if hasattr(exe, "execute"):
+        inner_exec = exe.execute
+
+        def execute(ctx):
+            t0 = time.perf_counter_ns()
+            try:
+                n = inner_exec(ctx)
+            finally:
+                st.time_ns += time.perf_counter_ns() - t0
+            st.loops += 1
+            if isinstance(n, int):
+                st.act_rows += n
+            return n
+
+        exe.execute = execute
+
+
+def _wrap_iter(fn, st: OpStats):
+    def produce(ctx):
+        it = fn(ctx)
+        while True:
+            t0 = time.perf_counter_ns()
+            try:
+                out = next(it)
+            except StopIteration:
+                st.time_ns += time.perf_counter_ns() - t0
+                return
+            st.time_ns += time.perf_counter_ns() - t0
+            st.loops += 1
+            n = getattr(out, "num_rows", None)
+            if n is None:
+                # agg-pushdown readers yield GroupResult partials: count
+                # the groups they carry, not zero
+                n = len(getattr(out, "keys", ()) or ())
+            st.act_rows += n
+            yield out
+
+    return produce
+
+
+# -- device timing (gated: block_until_ready serializes dispatch) -----------
+
+
+def device_call(plan, fn, *args):
+    """Run a device kernel call, attributing its completion time to
+    `plan`'s stats when device timing is on. With the sysvar off (or no
+    collector) this is one attribute read + one call — cheap enough for
+    the hot loop."""
+    coll = getattr(_tl, "coll", None)
+    if coll is None or not coll.device:
+        return fn(*args)
+    t0 = time.perf_counter_ns()
+    out = fn(*args)
+    try:
+        import jax
+        jax.block_until_ready(out)
+    except Exception:  # noqa: BLE001 - host results pass through
+        pass
+    coll.note_device(plan, time.perf_counter_ns() - t0)
+    return out
+
+
+@contextlib.contextmanager
+def device_section(plan):
+    """Time a whole device region (mesh pipelines overlap async launches,
+    so per-launch timing is meaningless — the region's wall time, which
+    ends on the blocking readback, is the honest number)."""
+    coll = getattr(_tl, "coll", None)
+    if coll is None or not coll.device:
+        yield
+        return
+    t0 = time.perf_counter_ns()
+    try:
+        yield
+    finally:
+        coll.note_device(plan, time.perf_counter_ns() - t0)
+
+
+# -- rendering helpers ------------------------------------------------------
+
+
+def fmt_ns(ns: int) -> str:
+    if ns >= 1_000_000_000:
+        return f"{ns / 1e9:.2f}s"
+    if ns >= 1_000_000:
+        return f"{ns / 1e6:.2f}ms"
+    if ns >= 1_000:
+        return f"{ns / 1e3:.1f}us"
+    return f"{ns}ns"
+
+
+def fmt_bytes(n: int) -> str:
+    if n >= 1 << 30:
+        return f"{n / (1 << 30):.2f}GB"
+    if n >= 1 << 20:
+        return f"{n / (1 << 20):.2f}MB"
+    if n >= 1 << 10:
+        return f"{n / (1 << 10):.1f}KB"
+    return f"{n}B" if n else "0B"

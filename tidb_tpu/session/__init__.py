@@ -372,11 +372,14 @@ class Session:
                for s in stmts):
             sql = "<redacted: batch containing credentials>" \
                 if len(stmts) > 1 else "<redacted: credential statement>"
-        for stmt in stmts:
-            out.append(self._timed_stmt(stmt, sql, sql_text=single))
+        for i, stmt in enumerate(stmts):
+            out.append(self._timed_stmt(
+                stmt, sql, sql_text=single,
+                batch_no=i if len(stmts) > 1 else None))
         return out
 
-    def _timed_stmt(self, stmt, sql: str, sql_text: str | None):
+    def _timed_stmt(self, stmt, sql: str, sql_text: str | None,
+                    batch_no: int | None = None):
         """Statement lifecycle wrapper: processlist state, duration
         metrics, slow-query log (ref: ExecStmt adapter, adapter.go:189 +
         slow-log emit at :353). Internal bookkeeping sessions skip the
@@ -385,15 +388,20 @@ class Session:
         from tidb_tpu import config, metrics, perfschema, trace
         if self.internal:
             # internal catalog work must neither appear in perfschema nor
-            # attach spans to the enclosing client statement's trace
+            # attach spans to the enclosing client statement's trace —
+            # nor record its scans into that statement's operator stats
+            from tidb_tpu import runtime_stats as rs
             token = trace.detach()
             try:
-                return self._run_stmt(stmt, sql_text=sql_text)
+                with rs.suspended():
+                    return self._run_stmt(stmt, sql_text=sql_text)
             finally:
                 trace.restore(token)
         self.current_sql = sql
         self._stmt_start = time.perf_counter()
         self.killed = False   # a kill that landed while idle is a no-op
+        self._last_plan = None    # executed physical plan (EXPLAIN
+        self._last_stats = None   # ANALYZE / slow log / bench read these)
         # each statement resets the diagnostics area, except the SHOWs
         # that read it (MySQL: SHOW WARNINGS does not clear warnings)
         if not (isinstance(stmt, ast.ShowStmt)
@@ -434,16 +442,75 @@ class Session:
             nrows = len(res.rows) if isinstance(res, ResultSet) else \
                 (res if isinstance(res, int) else 0)
             perfschema.stmt_end(ev, root=root, rows=nrows, error=err)
+            # digest summary + per-operator metric families
+            coll = getattr(self, "_last_stats", None)
+            ops = coll.ops() if coll is not None else []
+            phases = {"parse": trace.phase_ns(root, "parse"),
+                      "plan": trace.phase_ns(root, "plan"),
+                      "exec": trace.phase_ns(root, "execute"),
+                      "commit": trace.phase_ns(root, "commit")}
+            digest, _norm = perfschema.digest_record(
+                sql, int(dur * 1e9), phases=phases, rows=nrows,
+                error=err, op_stats=[s.to_dict() for s in ops],
+                tag=None if batch_no is None
+                else f"stmt#{batch_no}:{kind}")
+            for s in ops:
+                if not s.loops:
+                    continue   # operator never produced (cached sub-plan)
+                metrics.histogram(metrics.OP_DURATIONS, s.time_ns / 1e9,
+                                  {"op": s.name})
+                metrics.counter(metrics.OP_ROWS, {"op": s.name},
+                                inc=s.act_rows)
+                if s.device_time_ns:
+                    metrics.histogram(metrics.OP_DEVICE_DURATIONS,
+                                      s.device_time_ns / 1e9,
+                                      {"op": s.name})
             if trace_on:
                 trace.log_tree(root, sql)
             self.killed = False
             if dur * 1000 >= slow_ms:
                 metrics.counter(metrics.SLOW_QUERIES)
                 slow_log.warning(
-                    "slow query: %.3fs user=%s db=%s sql=%s",
-                    dur, self.user, self.current_db, sql[:2048])
+                    "%s", self._slow_log_record(sql, dur, digest, ops,
+                                                err))
+            # release the executed plan tree: an idle pooled session
+            # must not pin a multi-MB INSERT's literal plan (the sealed
+            # collector keeps only name+number OpStats for bench)
+            self._last_plan = None
+            if coll is not None:
+                coll.seal()
             self.current_sql = None
         return res
+
+    def _slow_log_record(self, sql: str, dur: float, digest: str,
+                         ops, err: str | None) -> str:
+        """Structured slow-log record: digest, executed plan, and
+        per-operator stats ride with the SQL (ref: the reference's
+        multi-line slow log, executor/adapter.go:353 +
+        infoschema slow_query parsing contract)."""
+        from tidb_tpu import runtime_stats as rs
+        lines = [f"slow query: {dur:.3f}s user={self.user} "
+                 f"db={self.current_db} digest={digest}"
+                 + (" error=1" if err else "")]
+        plan = getattr(self, "_last_plan", None)
+        if plan is not None:
+            try:
+                for ln in plan.explain().split("\n"):
+                    lines.append("# Plan: " + ln)
+            except Exception:  # noqa: BLE001 - logging must not fail stmts
+                pass
+        for s in ops:
+            if not s.loops and not s.time_ns:
+                continue
+            ln = (f"# Op: {s.name} act_rows={s.act_rows} "
+                  f"loops={s.loops} time={rs.fmt_ns(s.time_ns)}")
+            if s.device_time_ns:
+                ln += f" device_time={rs.fmt_ns(s.device_time_ns)}"
+            if s.cop_tasks:
+                ln += f" cop_tasks={s.cop_tasks}"
+            lines.append(ln)
+        lines.append("# SQL: " + sql[:2048])
+        return "\n".join(lines)
 
     # -- prepared statements (ref: session.go:777-855 PrepareStmt /
     # ExecutePreparedStmt; the binary protocol and SQL PREPARE share it) ----
@@ -1149,8 +1216,25 @@ class Session:
         return Planner(self.domain.info_schema(), self.current_db,
                        stats_handle=self.domain.stats_handle())
 
+    def _stats_collector(self):
+        """Active (or fresh) per-statement runtime-stats collector, None
+        for internal sessions or with tidb_tpu_runtime_stats=0. EXPLAIN
+        ANALYZE installs its own collector before dispatching the inner
+        statement; that one wins (rs.current())."""
+        from tidb_tpu import config, runtime_stats as rs
+        if self.internal:
+            # never instrument internal catalog sessions, even when a
+            # client statement's collector is active on this thread
+            return None
+        active = rs.current()
+        if active is not None:
+            return active
+        if not config.runtime_stats_enabled():
+            return None
+        return rs.StatsCollector(device=config.runtime_stats_device())
+
     def _exec_query(self, stmt, sql_text: str | None = None) -> ResultSet:
-        from tidb_tpu import trace
+        from tidb_tpu import runtime_stats as rs, trace
         if getattr(stmt, "for_update", False) and self.txn is None and \
                 not self.autocommit:
             # autocommit=0: the SELECT starts the transaction, so the
@@ -1176,18 +1260,23 @@ class Session:
                 self.domain.plan_cache().put(cache_key, plan)
         ctx = ExecContext(self.storage, self._read_ts(), self.txn,
                           interrupted=lambda: self.killed)
-        exe = build_executor(plan)
+        coll = self._stats_collector()
+        self._last_plan = plan
         try:
-            with trace.span("execute",
-                            executor=type(exe).__name__):
-                chunks = []
-                for ch in exe.chunks(ctx):
-                    if self.killed:   # KILL QUERY: cooperative check
-                        raise SQLError(
-                            "Query execution was interrupted")
-                    chunks.append(ch)
+            with rs.collecting(coll):
+                exe = build_executor(plan)
+                with trace.span("execute",
+                                executor=type(exe).__name__):
+                    chunks = []
+                    for ch in exe.chunks(ctx):
+                        if self.killed:   # KILL QUERY: cooperative check
+                            raise SQLError(
+                                "Query execution was interrupted")
+                        chunks.append(ch)
         except ExecError as e:
             raise SQLError(str(e)) from None
+        finally:
+            self._last_stats = coll
         if getattr(stmt, "for_update", False) and self.txn is not None:
             try:
                 self._lock_rows_for_update(stmt)
@@ -1231,7 +1320,7 @@ class Session:
         return n
 
     def _exec_dml_in_txn(self, stmt) -> int:
-        from tidb_tpu import trace
+        from tidb_tpu import runtime_stats as rs, trace
         if isinstance(stmt, ast.LoadDataStmt):
             with trace.span("execute", executor="LoadData"):
                 return self._load_data_in_txn(stmt)
@@ -1250,16 +1339,21 @@ class Session:
                 self.txn.related_tables.add(info.id)
         ctx = ExecContext(self.storage, self.txn.start_ts, self.txn,
                           interrupted=lambda: self.killed)
-        exe = build_executor(plan)
+        coll = self._stats_collector()
+        self._last_plan = plan
         try:
-            with trace.span("execute", executor=type(exe).__name__):
-                out = exe.execute(ctx)
+            with rs.collecting(coll):
+                exe = build_executor(plan)
+                with trace.span("execute", executor=type(exe).__name__):
+                    out = exe.execute(ctx)
             lid = getattr(ctx, "last_insert_id", None)
             if lid is not None:
                 self.last_insert_id = lid
             return out
         except ExecError as e:
             raise SQLError(str(e)) from None
+        finally:
+            self._last_stats = coll
 
     # session-context expressions (ref: expression/builtin_info.go
     # VERSION/USER/DATABASE/CONNECTION_ID; sessionctx sysvar reads) ----------
@@ -1939,9 +2033,47 @@ class Session:
                 pass
 
     def _exec_explain(self, stmt: ast.ExplainStmt) -> ResultSet:
+        if stmt.analyze:
+            return self._exec_explain_analyze(stmt.stmt)
         plan = self._planner().plan(stmt.stmt)
         lines = plan.explain().split("\n")
         return ResultSet(["plan"], [(l,) for l in lines])
+
+    def _exec_explain_analyze(self, inner: ast.StmtNode) -> ResultSet:
+        """EXPLAIN ANALYZE: execute the statement for real under a
+        runtime-stats collector, then render the executed plan annotated
+        with per-operator actuals (ref: the reference's EXPLAIN ANALYZE
+        over RuntimeStatsColl, executor/explain.go)."""
+        from tidb_tpu import config, runtime_stats as rs
+        if not isinstance(inner, (ast.SelectStmt, ast.UnionStmt,
+                                  ast.InsertStmt, ast.UpdateStmt,
+                                  ast.DeleteStmt)):
+            raise SQLError(
+                "EXPLAIN ANALYZE supports SELECT/UNION and DML statements")
+        device = config.runtime_stats_device()
+        coll = rs.StatsCollector(device=device)
+        self._last_plan = None
+        with rs.collecting(coll):
+            self._run_stmt(inner)
+        plan = self._last_plan
+        if plan is None:
+            raise SQLError("EXPLAIN ANALYZE: no plan was executed")
+        rows = []
+        for depth, node in plan.explain_nodes():
+            st = coll.get(node)
+            est = "" if node.est_rows is None else f"{node.est_rows:.0f}"
+            if st is None:
+                rows.append(("  " * depth + node.explain_line(), est,
+                             0, 0, "-", "-", "-", 0))
+                continue
+            rows.append((
+                "  " * depth + node.explain_line(), est,
+                st.act_rows, st.loops, rs.fmt_ns(st.time_ns),
+                rs.fmt_ns(st.device_time_ns) if device else "-",
+                rs.fmt_bytes(st.device_peak_bytes) if device else "-",
+                st.cop_tasks))
+        return ResultSet(["id", "est_rows", "act_rows", "loops", "time",
+                          "device_time", "mem", "cop_tasks"], rows)
 
 
 @dataclass

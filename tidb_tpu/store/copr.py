@@ -15,7 +15,7 @@ import threading
 from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 
-from tidb_tpu import config, kv, tablecodec
+from tidb_tpu import config, kv, runtime_stats, tablecodec
 from tidb_tpu.kv import (CopRequest, CopResponse, KVRange, NotLeaderError,
                          RegionError, ReqType, ServerBusyError,
                          KeyLockedError)
@@ -100,7 +100,8 @@ def exec_cop_plan(plan: CopPlan, chunk) -> CopResponse:
                       chunk.num_rows >= config.device_min_rows())
         if use_device:
             try:
-                res = _agg_kernels(plan)(chunk)
+                res = runtime_stats.device_call(plan, _agg_kernels(plan),
+                                                chunk)
                 return CopResponse(chunk=res)
             except (CapacityError, CollisionError, ValueError):
                 pass
@@ -255,6 +256,11 @@ class CopClient(kv.Client):
             return
         from tidb_tpu import metrics
         metrics.counter(metrics.COP_TASKS, inc=len(tasks))
+        coll = runtime_stats.current()
+        if coll is not None:
+            # send() is driven on the session thread (first next()):
+            # attribute the fan-out width to the issuing reader node
+            coll.note_cop_tasks(req.plan, len(tasks))
         concurrency = min(req.concurrency or config.cop_concurrency(),
                           len(tasks))
         if config.copr_stream_enabled() and \
@@ -263,11 +269,14 @@ class CopClient(kv.Client):
             return
         # the session's sysvar overlay is thread-local: capture it here
         # and re-install inside every pool worker so per-session knobs
-        # (device on/off, cache) apply uniformly across the fan-out
+        # (device on/off, cache) apply uniformly across the fan-out —
+        # the runtime-stats collector rides along the same way so
+        # storage-side device kernels attribute to the reader node
         overlay = config.current_overlay()
 
         def run_task(rq, rng):
-            with config.session_overlay(overlay):
+            with config.session_overlay(overlay), \
+                    runtime_stats.collecting(coll):
                 return list(self._run_task(rq, rng))
         if concurrency <= 1 or len(tasks) == 1:
             for loc, rng in tasks:
@@ -278,7 +287,8 @@ class CopClient(kv.Client):
 
         def worker(task_list):
             try:
-                with config.session_overlay(overlay):
+                with config.session_overlay(overlay), \
+                        runtime_stats.collecting(coll):
                     for _loc, rng in task_list:
                         for resp in self._run_task(req, rng):
                             results.put(resp)
@@ -397,11 +407,13 @@ class CopClient(kv.Client):
         stop = threading.Event()
         q = BoundedFrameQueue(credit, stop)
         overlay = config.current_overlay()
+        coll = runtime_stats.current()
         buckets = [tasks[i::concurrency] for i in range(concurrency)]
 
         def worker(task_list):
             try:
-                with config.session_overlay(overlay):
+                with config.session_overlay(overlay), \
+                        runtime_stats.collecting(coll):
                     for _loc, rng in task_list:
                         for resp in self._run_task_stream(
                                 req, rng, new_counter()):

@@ -129,9 +129,8 @@ def attach_remote(d: dict) -> None:
     def build(node: dict) -> Span:
         s = Span(node.get("name", "remote"), node.get("tags"))
         dur = int(node.get("duration_ns", 0))
-        s.end_ns = s.start_ns + dur
-        s.start_ns -= dur          # end at "now", duration preserved
-        s.end_ns = s.start_ns + dur
+        # end at "now" (the Span's birth instant), duration preserved
+        s.end_ns, s.start_ns = s.start_ns, s.start_ns - dur
         for c in node.get("children", ()):
             s.children.append(build(c))
         return s
