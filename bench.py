@@ -49,10 +49,15 @@ import time
 # Persistent XLA compilation cache: first-compile of the big fused query
 # programs costs minutes through the chip tunnel; caching them on disk
 # makes every later bench process (including the driver's round-end run)
-# reuse the compiled executables.
+# reuse the compiled executables. TIDB_TPU_COMPILE_CACHE routes the
+# package's own wiring (tidb_tpu.util.compile_cache — which also counts
+# hits/misses for the report) at the same repo-local directory; the
+# JAX_* variables cover subprocess probes that never import the package.
+_CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          ".jax_cache")
+os.environ.setdefault("TIDB_TPU_COMPILE_CACHE", _CACHE_DIR)
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
-                      os.path.join(os.path.dirname(
-                          os.path.abspath(__file__)), ".jax_cache"))
+                      os.environ["TIDB_TPU_COMPILE_CACHE"])
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
 
 
@@ -288,6 +293,11 @@ def main() -> None:
         # different virtualized feature set (prefer-no-scatter etc.),
         # which deoptimizes scatter-heavy programs ~5x (measured on Q3)
         jax.config.update("jax_compilation_cache_dir", None)
+        # the upcoming tidb_tpu import would re-enable it from
+        # TIDB_TPU_COMPILE_CACHE (util/compile_cache.enable at package
+        # import); poison the env so that enable() no-ops and the stale
+        # tunnel-compiled entries stay unloaded
+        os.environ["TIDB_TPU_COMPILE_CACHE"] = "0"
         device_fallback = f"cpu ({reason})"
         if "BENCH_SF" not in os.environ:
             # CPU XLA runs the warm path ~20-40x slower than a chip;
@@ -393,10 +403,19 @@ def main() -> None:
                         continue
                     a = op_detail.setdefault(
                         s.name, {"time_ns": 0, "device_time_ns": 0,
-                                 "act_rows": 0})
+                                 "act_rows": 0, "superchunks": 0,
+                                 "coalesced_chunks": 0,
+                                 "superchunk_fill_rows": 0,
+                                 "superchunk_bucket_rows": 0,
+                                 "pipeline_stall_ns": 0})
                     a["time_ns"] += s.time_ns
                     a["device_time_ns"] += s.device_time_ns
                     a["act_rows"] += s.act_rows
+                    a["superchunks"] += s.superchunks
+                    a["coalesced_chunks"] += s.coalesced_chunks
+                    a["superchunk_fill_rows"] += s.superchunk_fill_rows
+                    a["superchunk_bucket_rows"] += s.superchunk_bucket_rows
+                    a["pipeline_stall_ns"] += s.pipeline_stall_ns
                 op_device = {k: v["device_time_ns"]
                              for k, v in op_detail.items()
                              if v["device_time_ns"]}
@@ -429,6 +448,16 @@ def main() -> None:
         speedups.append(d_rps / h_rps)
         device_rps.append(d_rps)
         rooflines.append(d_gbps / roof_gbps)
+        # superchunk pipeline attribution (from the instrumented run):
+        # how coalesced the device execution was and how long the host
+        # sat stalled on readback — the numbers the next BENCH round
+        # diffs to attribute a roofline move
+        sc_count = sum(v["superchunks"] for v in op_detail.values())
+        sc_src = sum(v["coalesced_chunks"] for v in op_detail.values())
+        sc_fill = sum(v["superchunk_fill_rows"] for v in op_detail.values())
+        sc_bucket = sum(v["superchunk_bucket_rows"]
+                        for v in op_detail.values())
+        sc_stall = sum(v["pipeline_stall_ns"] for v in op_detail.values())
         detail[qname] = {
             "input_rows": in_rows,
             "input_bytes": in_bytes,
@@ -443,6 +472,13 @@ def main() -> None:
             "result_rows": len(d_rows),
             "op_device_time_ns": op_device,
             "op_stats": op_detail,
+            "superchunk": {
+                "count": sc_count,
+                "coalesced_chunks": sc_src,
+                "fill_ratio": round(sc_fill / sc_bucket, 4)
+                if sc_bucket else 0.0,
+                "pipeline_stall_ns": sc_stall,
+            },
         }
 
     config.set_var("tidb_tpu_device", 1)
@@ -463,6 +499,12 @@ def main() -> None:
             detail["device_probe_late"] = prober.snapshot
             detail["device_probe_late_after_secs"] = round(
                 prober.snapshot_at - t_start, 1)
+
+    # persistent compile cache accounting: misses are fresh XLA compiles
+    # this run paid, hits are executables loaded from disk (the 48.8s
+    # first-run stall of BENCH_r05 becomes a hit on every warm run)
+    from tidb_tpu.util import compile_cache
+    detail["compile_cache"] = compile_cache.stats()
 
     geo_rps = math.exp(sum(math.log(x) for x in device_rps)
                        / len(device_rps))

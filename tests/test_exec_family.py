@@ -101,13 +101,15 @@ class TestStreamAgg:
         import tidb_tpu.executor as ex
         calls = []
         from tidb_tpu.ops.streamagg import SegmentAggKernel as K
-        orig = K.__call__
+        orig = K.dispatch
 
-        def spy(self, chunk):
+        def spy(self, chunk, donate=False):
+            # dispatch is shared by the per-batch path (__call__) and
+            # the superchunk pipeline — spy there so both count
             calls.append(chunk.num_rows)
-            return orig(self, chunk)
+            return orig(self, chunk, donate)
 
-        monkeypatch.setattr(K, "__call__", spy)
+        monkeypatch.setattr(K, "dispatch", spy)
         reader = _reader(sess, "SELECT id, g, v, s FROM t")
         vref = ColumnRef(2, reader.schema.cols[2].ft)
         stream, _ = self._plans(sess, [1], [AggDesc(AggFunc.SUM, vref)])

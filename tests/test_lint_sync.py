@@ -1,0 +1,68 @@
+"""Static hot-path invariant, enforced as a test (style of
+test_lint_metrics.py): `block_until_ready` must not appear anywhere in
+the tidb_tpu package except runtime_stats.py (the gated profiling
+path). The dispatch-ahead pipeline's whole win is that superchunk k+1
+transfers while k executes; ONE accidental block_until_ready on the hot
+path serializes every dispatch and silently erases the overlap. Syncs
+at operator output boundaries use jax.device_get, which is visible in
+review precisely because it returns the data. bench.py and tests sit
+outside the package and may sync freely (profiling / assertions).
+
+Checked by AST walk, so any receiver spelling (jax.block_until_ready,
+arr.block_until_ready, aliased imports) is caught."""
+
+import ast
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "tidb_tpu")
+
+# the one sanctioned site: device-time profiling, gated behind the
+# tidb_tpu_runtime_stats_device sysvar
+ALLOWED = {os.path.join("tidb_tpu", "runtime_stats.py")}
+
+
+def _package_files():
+    for root, _dirs, files in os.walk(PKG):
+        if "__pycache__" in root:
+            continue
+        for f in files:
+            if f.endswith(".py"):
+                yield os.path.join(root, f)
+
+
+def _sync_sites(path):
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and \
+                node.attr == "block_until_ready":
+            yield node.lineno
+        elif isinstance(node, ast.Name) and \
+                node.id == "block_until_ready":
+            yield node.lineno
+        elif isinstance(node, ast.Constant) and \
+                node.value == "block_until_ready":
+            # getattr(jax, "block_until_ready") and friends
+            yield node.lineno
+
+
+def test_no_sync_points_outside_runtime_stats():
+    offenders = []
+    for path in _package_files():
+        rel = os.path.relpath(path, REPO)
+        if rel in ALLOWED:
+            continue
+        for lineno in _sync_sites(path):
+            offenders.append(f"{rel}:{lineno}: block_until_ready on the "
+                             f"hot path (use jax.device_get at an output "
+                             f"boundary, or runtime_stats.device_call for "
+                             f"gated profiling)")
+    assert not offenders, "\n".join(offenders)
+
+
+def test_sanctioned_site_still_exists():
+    """The lint is vacuous if the profiling path moved: pin that
+    runtime_stats.py still owns the one block_until_ready."""
+    sites = list(_sync_sites(os.path.join(PKG, "runtime_stats.py")))
+    assert sites, "runtime_stats.py lost its gated block_until_ready"

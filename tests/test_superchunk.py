@@ -1,0 +1,361 @@
+"""Superchunk batching + the async device pipeline (ops/runtime.py):
+assembly edge cases (0-row chunks, exact power-of-two sizes, oversize
+slicing, varlen dict columns spanning a coalesce boundary), masked-tail
+correctness against the host executor, the dispatch-ahead pipeline_map
+contract, the dev-cache true-LRU fix, and end-to-end device-vs-host
+agreement with pipelining on."""
+
+import random
+
+import numpy as np
+import pytest
+
+from tidb_tpu import config, sqltypes as st
+from tidb_tpu.chunk import Chunk, Column
+from tidb_tpu.expression import AggDesc, AggFunc, col
+from tidb_tpu.ops import runtime
+from tidb_tpu.ops.hashagg import HashAggKernel, HashAggregator
+from tidb_tpu.ops.hostagg import host_hash_agg
+
+INT = st.new_int_field()
+DBL = st.new_double_field()
+STR = st.new_string_field()
+
+
+def _int_chunk(values):
+    return Chunk.from_rows([INT], [(v,) for v in values])
+
+
+# ---------------------------------------------------------------------------
+# bucket_size / pad_column edges
+
+
+def test_bucket_size_edges():
+    assert runtime.bucket_size(0) == runtime.MIN_BUCKET
+    assert runtime.bucket_size(1) == runtime.MIN_BUCKET
+    assert runtime.bucket_size(runtime.MIN_BUCKET) == runtime.MIN_BUCKET
+    assert runtime.bucket_size(runtime.MIN_BUCKET + 1) == \
+        2 * runtime.MIN_BUCKET
+    assert runtime.bucket_size(1 << 18) == 1 << 18       # exact pow2
+    assert runtime.bucket_size((1 << 18) + 1) == 1 << 19
+
+
+def test_pad_column_exact_size_is_identity():
+    data = np.arange(16, dtype=np.int64)
+    valid = np.ones(16, dtype=bool)
+    pd, pv = runtime.pad_column(data, valid, 16)
+    assert pd is data and pv is valid
+
+
+def test_pad_column_zero_rows():
+    pd, pv = runtime.pad_column(np.empty(0, dtype=np.int64),
+                                np.empty(0, dtype=bool), 8)
+    assert len(pd) == 8 and not pv.any()
+
+
+def test_pad_column_tail_is_invalid():
+    pd, pv = runtime.pad_column(np.arange(5, dtype=np.int64),
+                                np.array([True] * 5), 8)
+    assert pv[:5].all() and not pv[5:].any()
+    assert (pd[5:] == 0).all()
+
+
+# ---------------------------------------------------------------------------
+# superchunk assembly
+
+
+def test_superchunks_skip_zero_row_chunks():
+    chunks = [_int_chunk([]), _int_chunk([1, 2]), _int_chunk([]),
+              _int_chunk([3])]
+    out = list(runtime.superchunk_batches(iter(chunks), 1024))
+    assert len(out) == 1
+    assert out[0].num_rows == 3 and out[0].sources == 2
+    assert out[0].chunk.columns[0].data.tolist() == [1, 2, 3]
+
+
+def test_superchunks_exact_power_of_two_fill():
+    # 4 chunks of 256 rows coalesce into exactly one 1024-row bucket
+    chunks = [_int_chunk(range(i * 256, (i + 1) * 256)) for i in range(4)]
+    out = list(runtime.superchunk_batches(iter(chunks), 1024))
+    assert [sc.num_rows for sc in out] == [1024]
+    assert out[0].sources == 4
+    assert out[0].bucket == 1024 and out[0].fill == 1.0
+    assert out[0].chunk.columns[0].data.tolist() == list(range(1024))
+
+
+def test_superchunks_slice_oversize_chunk():
+    out = list(runtime.superchunk_batches(iter([_int_chunk(range(2500))]),
+                                          1024))
+    assert [sc.num_rows for sc in out] == [1024, 1024, 452]
+    got = [v for sc in out for v in sc.chunk.columns[0].data.tolist()]
+    assert got == list(range(2500))
+    # the tail superchunk pads to the next power of two with dead rows
+    assert out[2].bucket == 1024 and 0 < out[2].fill < 1
+
+
+def test_superchunks_source_counts_across_boundary():
+    # 600+600: second chunk spans the 1024 boundary, so it contributes
+    # to (and counts in) both superchunks
+    chunks = [_int_chunk(range(600)), _int_chunk(range(600, 1200))]
+    out = list(runtime.superchunk_batches(iter(chunks), 1024))
+    assert [sc.num_rows for sc in out] == [1024, 176]
+    assert out[0].sources == 2 and out[1].sources == 1
+
+
+def test_varlen_dict_column_spans_coalesce_boundary():
+    """String group keys whose values straddle two source chunks must
+    dict-encode consistently after coalescing: group-by over the
+    superchunk equals group-by over the concatenated host rows."""
+    rng = random.Random(7)
+    words = ["ash", "birch", "cedar", "oak"]
+    rows1 = [(words[rng.randrange(4)], rng.randrange(50))
+             for _ in range(700)]
+    rows2 = [(words[rng.randrange(4)], rng.randrange(50))
+             for _ in range(700)]
+    c1 = Chunk.from_rows([STR, INT], rows1)
+    c2 = Chunk.from_rows([STR, INT], rows2)
+    scs = list(runtime.superchunk_batches(iter([c1, c2]), 1024))
+    assert len(scs) == 2 and scs[0].sources == 2
+    aggs = [AggDesc(AggFunc.SUM, col(1, INT)), AggDesc(AggFunc.COUNT, None)]
+    kernel = HashAggKernel(None, [col(0, STR)], aggs)
+    dev = HashAggregator(aggs)
+    for sc in scs:
+        dev.update(kernel(sc.chunk))
+    host = HashAggregator(aggs)
+    for c in (c1, c2):
+        host.update(host_hash_agg(c, None, [col(0, STR)], aggs))
+    got = {k[0]: (int(v[0]), int(v[1])) for k, v in dev.results()}
+    want = {k[0]: (int(v[0]), int(v[1])) for k, v in host.results()}
+    assert got == want
+
+
+def test_masked_tail_matches_host():
+    """A partially-filled bucket's padding rows (valid=False tail) must
+    contribute nothing: kernel over the padded superchunk == host agg
+    over the raw rows."""
+    rows = [(i % 7, float(i % 11)) for i in range(1500)]   # pads to 2048
+    ch = Chunk.from_rows([INT, DBL], rows)
+    sc = next(runtime.superchunk_batches(iter([ch]), 1 << 18))
+    assert sc.bucket == 2048 and sc.num_rows == 1500
+    aggs = [AggDesc(AggFunc.SUM, col(1, DBL)), AggDesc(AggFunc.COUNT, None),
+            AggDesc(AggFunc.MIN, col(1, DBL))]
+    kernel = HashAggKernel(None, [col(0, INT)], aggs)
+    dev = HashAggregator(aggs)
+    dev.update(kernel(sc.chunk))
+    host = HashAggregator(aggs)
+    host.update(host_hash_agg(ch, None, [col(0, INT)], aggs))
+    for (gk, gv), (hk, hv) in zip(dev.results(), host.results()):
+        assert gk == hk
+        assert float(gv[0]) == pytest.approx(float(hv[0]))
+        assert int(gv[1]) == int(hv[1])
+        assert float(gv[2]) == pytest.approx(float(hv[2]))
+
+
+def test_super_batches_wrapper_yields_chunks():
+    chunks = [_int_chunk(range(10)), _int_chunk(range(10, 20))]
+    out = list(runtime.super_batches([chunks[0]], iter(chunks[1:]), 1024))
+    assert len(out) == 1 and out[0].num_rows == 20
+
+
+# ---------------------------------------------------------------------------
+# dispatch-ahead pipeline
+
+
+def test_pipeline_map_order_and_depth():
+    events = []
+    in_flight = [0]
+    peak = [0]
+
+    def dispatch(i):
+        in_flight[0] += 1
+        peak[0] = max(peak[0], in_flight[0])
+        events.append(("d", i))
+        return i * 10
+
+    def finalize(i, tok):
+        in_flight[0] -= 1
+        events.append(("f", i))
+        return tok + 1
+
+    out = list(runtime.pipeline_map(range(5), dispatch, finalize, 2))
+    assert out == [1, 11, 21, 31, 41]          # item order preserved
+    assert peak[0] == 2                        # never more than depth
+    # double buffering: item 1 dispatches BEFORE item 0 finalizes
+    assert events.index(("d", 1)) < events.index(("f", 0))
+
+
+def test_pipeline_map_depth_one_is_serial():
+    events = []
+    out = list(runtime.pipeline_map(
+        range(3), lambda i: events.append(("d", i)) or i,
+        lambda i, t: events.append(("f", i)) or t, 1))
+    assert out == [0, 1, 2]
+    assert events == [("d", 0), ("f", 0), ("d", 1), ("f", 1),
+                      ("d", 2), ("f", 2)]
+
+
+def test_fingerprint_cache_lru_refresh():
+    cache = runtime.FingerprintCache(capacity=2)
+    a = cache.get_or_create("a", lambda: object())
+    cache.get_or_create("b", lambda: object())
+    assert cache.get_or_create("a", lambda: object()) is a  # refresh "a"
+    cache.get_or_create("c", lambda: object())              # evicts "b"
+    assert cache.get_or_create("a", lambda: object()) is a  # still cached
+    made = []
+    cache.get_or_create("b", lambda: made.append(1) or object())
+    assert made == [1]                                      # "b" was evicted
+
+
+# ---------------------------------------------------------------------------
+# dev-cache true LRU
+
+
+def test_dev_cache_hit_refreshes_lru_position():
+    ch = _int_chunk(range(4))
+    runtime.dev_cache_put(ch, "a", 1)
+    runtime.dev_cache_put(ch, "b", 2)
+    assert runtime.dev_cache_get(ch, "a") == 1     # refresh "a"
+    runtime.dev_cache_put(ch, "c", 3)              # evicts LRU == "b"
+    assert runtime.dev_cache_get(ch, "a") == 1
+    assert runtime.dev_cache_get(ch, "b") is None
+    assert runtime.dev_cache_get(ch, "c") == 3
+
+
+# ---------------------------------------------------------------------------
+# fingerprint-keyed kernel cache
+
+
+def test_kernel_cache_shares_across_equal_plans():
+    from tidb_tpu.ops.hashagg import kernel_for
+    aggs1 = [AggDesc(AggFunc.SUM, col(1, INT))]
+    aggs2 = [AggDesc(AggFunc.SUM, col(1, INT))]
+    k1 = kernel_for(None, [col(0, INT)], aggs1)
+    k2 = kernel_for(None, [col(0, INT)], aggs2)
+    assert k1 is k2
+    # different capacity / different column index -> different kernels
+    assert kernel_for(None, [col(0, INT)], aggs1, capacity=8192) is not k1
+    assert kernel_for(None, [col(2, INT)], aggs1) is not k1
+
+
+def test_kernel_cache_distinguishes_scalar_func_extra():
+    """IN value lists ride ScalarFunc.extra — two filters differing only
+    there must NOT share a kernel (same op tree, different semantics)."""
+    from tidb_tpu.expression import Op
+    from tidb_tpu.expression.core import ScalarFunc
+    from tidb_tpu.ops.hashagg import kernel_for
+    f1 = ScalarFunc(Op.IN, [col(1, INT)], extra=[1, 2])
+    f2 = ScalarFunc(Op.IN, [col(1, INT)], extra=[1, 3])
+    aggs = [AggDesc(AggFunc.COUNT, None)]
+    k1 = kernel_for(f1, [col(0, INT)], aggs)
+    k2 = kernel_for(f2, [col(0, INT)], aggs)
+    assert k1 is not k2
+    assert runtime.plan_fingerprint(f1, [col(0, INT)], aggs) != \
+        runtime.plan_fingerprint(f2, [col(0, INT)], aggs)
+
+
+def test_plan_fingerprint_none_for_correlated():
+    from tidb_tpu.expression.core import CorrelatedCol
+    fp = runtime.plan_fingerprint(None, [CorrelatedCol(INT)], [])
+    assert fp is None
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: pipelined device execution must match the host executor
+
+
+@pytest.fixture(scope="module")
+def sess():
+    from tidb_tpu.session import Session
+    from tidb_tpu.store.storage import new_mock_storage
+    s = Session(new_mock_storage())
+    s.execute("CREATE DATABASE sc")
+    s.execute("USE sc")
+    s.execute("CREATE TABLE f (id BIGINT PRIMARY KEY, g BIGINT, "
+              "tag VARCHAR(16), v DOUBLE)")
+    rng = random.Random(3)
+    rows = ",".join(
+        f"({i},{rng.randrange(9)},'t{rng.randrange(5)}',{rng.random() * 100:.3f})"
+        for i in range(6000))
+    s.execute("INSERT INTO f VALUES " + rows)
+    s.execute("CREATE TABLE dim (g BIGINT PRIMARY KEY, name VARCHAR(16))")
+    s.execute("INSERT INTO dim VALUES " +
+              ",".join(f"({i},'n{i}')" for i in range(9)))
+    yield s
+    s.close()
+
+
+def _device_vs_host(sess, sql, sc_rows=4096, depth=2):
+    with config.session_overlay({"tidb_tpu_device": 1,
+                                 "tidb_tpu_superchunk_rows": sc_rows,
+                                 "tidb_tpu_pipeline_depth": depth}):
+        dev = sess.query(sql).rows
+    with config.session_overlay({"tidb_tpu_device": 0}):
+        host = sess.query(sql).rows
+    assert len(dev) == len(host)
+    for a, b in zip(dev, host):
+        assert len(a) == len(b)
+        for x, y in zip(a, b):
+            if isinstance(x, float) or isinstance(y, float):
+                assert float(x) == pytest.approx(float(y), rel=1e-9)
+            else:
+                assert x == y
+
+
+class TestEndToEnd:
+    def test_group_by_agg(self, sess):
+        _device_vs_host(sess,
+                        "SELECT g, COUNT(*), SUM(v), MIN(v), MAX(v) "
+                        "FROM f GROUP BY g ORDER BY g")
+
+    def test_string_group_keys(self, sess):
+        _device_vs_host(sess,
+                        "SELECT tag, COUNT(*), SUM(v) FROM f "
+                        "GROUP BY tag ORDER BY tag")
+
+    def test_join_then_agg(self, sess):
+        _device_vs_host(sess,
+                        "SELECT d.name, COUNT(*), SUM(f.v) FROM f "
+                        "JOIN dim d ON f.g = d.g "
+                        "GROUP BY d.name ORDER BY d.name")
+
+    def test_tiny_superchunks_still_correct(self, sess):
+        # superchunk smaller than a storage chunk: forces slicing +
+        # many small buckets through the pipeline
+        _device_vs_host(sess,
+                        "SELECT g, COUNT(*), SUM(v) FROM f "
+                        "GROUP BY g ORDER BY g", sc_rows=1024, depth=3)
+
+    def test_pipeline_depth_one(self, sess):
+        _device_vs_host(sess,
+                        "SELECT g, COUNT(*), SUM(v) FROM f "
+                        "GROUP BY g ORDER BY g", depth=1)
+
+    def test_superchunk_off_matches_too(self, sess):
+        _device_vs_host(sess,
+                        "SELECT g, COUNT(*), SUM(v) FROM f "
+                        "GROUP BY g ORDER BY g", sc_rows=0)
+
+    def test_explain_analyze_shows_superchunks(self, sess):
+        with config.session_overlay({"tidb_tpu_device": 1}):
+            rs = sess.query("EXPLAIN ANALYZE SELECT g, COUNT(*), SUM(v) "
+                            "FROM f GROUP BY g")
+        assert rs.columns[-1] == "pipeline"
+        cells = [r[-1] for r in rs.rows]
+        coalesced = [c for c in cells if c != "-"]
+        assert coalesced, rs.rows
+        # "<N>sc/<M>ch fill=<r> stall=<t>"
+        assert "sc/" in coalesced[0] and "fill=" in coalesced[0] \
+            and "stall=" in coalesced[0]
+
+    def test_superchunk_metrics_emitted(self, sess):
+        from tidb_tpu import metrics
+        with config.session_overlay({"tidb_tpu_device": 1}):
+            sess.query("SELECT g, COUNT(*) FROM f GROUP BY g")
+        snap = metrics.snapshot()
+        assert any(k.startswith(metrics.SUPERCHUNKS) for k in snap), \
+            sorted(snap)[:20]
+        fill = sum(v for k, v in snap.items()
+                   if k.startswith(metrics.SUPERCHUNK_FILL_ROWS))
+        bucket = sum(v for k, v in snap.items()
+                     if k.startswith(metrics.SUPERCHUNK_BUCKET_ROWS))
+        assert 0 < fill <= bucket

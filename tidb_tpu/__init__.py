@@ -45,14 +45,9 @@ _jax.config.update("jax_enable_x64", True)
 # (program, shape-bucket) and identical HLO must never recompile — not
 # across kernel instances, not across processes. Large-batch programs
 # cost tens of seconds of XLA compile; this turns them into disk hits.
-import os as _os
+# util/compile_cache owns the wiring (directory from TIDB_TPU_COMPILE_CACHE
+# or ~/.cache/tidb_tpu_xla; "0" disables) and counts hits/misses for
+# bench.py / the server log.
+from tidb_tpu.util import compile_cache as _compile_cache
 
-_cache_dir = _os.environ.get(
-    "TIDB_TPU_COMPILE_CACHE",
-    _os.path.join(_os.path.expanduser("~"), ".cache", "tidb_tpu_xla"))
-if _cache_dir and _cache_dir != "0":
-    try:
-        _jax.config.update("jax_compilation_cache_dir", _cache_dir)
-        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:  # older jax without the knobs
-        pass
+_compile_cache.enable()

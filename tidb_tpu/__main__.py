@@ -76,6 +76,13 @@ def main(argv=None) -> int:
         name, _, val = kv.partition("=")
         config.set_var(name, val)
 
+    # the package import already pointed jax at the persistent compile
+    # cache; surface where (first-compile stalls vanish on warm starts)
+    from tidb_tpu.util import compile_cache
+    cc = compile_cache.stats()
+    log.info("XLA compile cache: %s (%s entries)",
+             cc["dir"] or "disabled", cc["entries"])
+
     from tidb_tpu.parallel import config as mesh_config
     if args.no_mesh:
         mesh_config.disable_mesh()

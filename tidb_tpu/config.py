@@ -23,7 +23,8 @@ import threading
 __all__ = ["get_var", "set_var", "all_vars", "coerce", "session_overlay",
            "current_overlay", "device_enabled", "chunk_cache_enabled",
            "cop_concurrency", "sort_spill_rows", "device_min_rows",
-           "stream_rows", "copr_stream_enabled", "copr_stream_frame_bytes",
+           "stream_rows", "superchunk_rows", "pipeline_depth",
+           "copr_stream_enabled", "copr_stream_frame_bytes",
            "copr_stream_credit", "runtime_stats_enabled",
            "runtime_stats_device", "UnknownVariableError"]
 
@@ -75,6 +76,22 @@ _DEFS: dict[str, tuple[str, int]] = {
     # grants N outstanding frames; the producer blocks past the window —
     # a slow consumer backpressures the server instead of buffering)
     "tidb_tpu_copr_stream_credit": (_INT, 4),
+    # superchunk coalescing (ops/runtime.py): chunks arriving from the
+    # coprocessor fan-out are re-batched into ~this-many-row fixed-shape
+    # batches before a device kernel sees them, so each query compiles a
+    # handful of XLA programs over big buckets instead of dispatching per
+    # storage chunk (the per-batch amortization of arxiv 2505.04153 /
+    # 2603.26698). Power of two keeps full superchunks on one bucket
+    # shape; the tail pads to the next power of two with valid=False
+    # rows. 0 disables coalescing (per-chunk dispatch, the pre-superchunk
+    # behavior). Order-sensitive paths (KeepOrder streaming readers,
+    # limit short-circuit scans, merge join) stay chunk-at-a-time.
+    "tidb_tpu_superchunk_rows": (_INT, 1 << 18),
+    # dispatch-ahead window of the device pipeline: up to this many
+    # superchunks in flight, so superchunk k+1 is padded and transferred
+    # while k executes (2 = classic double buffering). 1 serializes
+    # dispatch against readback.
+    "tidb_tpu_pipeline_depth": (_INT, 2),
     # statements at/above this wall time land in the slow-query log
     # (ref: config.Log.SlowThreshold, default 300ms)
     "tidb_tpu_slow_query_ms": (_INT, 300),
@@ -233,6 +250,14 @@ def device_min_rows() -> int:
 
 def stream_rows() -> int:
     return _read("tidb_tpu_stream_rows")
+
+
+def superchunk_rows() -> int:
+    return max(0, _read("tidb_tpu_superchunk_rows"))
+
+
+def pipeline_depth() -> int:
+    return max(1, _read("tidb_tpu_pipeline_depth"))
 
 
 def copr_stream_enabled() -> bool:

@@ -10,6 +10,7 @@ coprocessor fan-out (distsql.go:92).
 from __future__ import annotations
 
 import threading
+import time
 
 import numpy as np
 
@@ -17,12 +18,13 @@ from tidb_tpu import config, kv, runtime_stats, tablecodec
 from tidb_tpu.chunk import Chunk, Column
 from tidb_tpu.expression import AggDesc, AggFunc, Expression
 from tidb_tpu.kv import CopRequest, KVRange, ReqType
+from tidb_tpu.ops import runtime as op_runtime
 from tidb_tpu.ops.hashagg import (CapacityError, CollisionError,
-                                  GroupResult, HashAggKernel, HashAggregator)
+                                  GroupResult, HashAggregator, kernel_for)
 from tidb_tpu.ops.hostagg import host_hash_agg
 from tidb_tpu.ops.join import (JoinKernel, JoinKeyEncoder,
                                host_match_pairs)
-from tidb_tpu.ops.streamagg import SegmentAggKernel
+from tidb_tpu.ops.streamagg import segment_kernel_for
 from tidb_tpu.ops.runtime import eval_filter_host, super_batches
 from tidb_tpu.plan import physical as ph
 from tidb_tpu.sqltypes import EvalType, FieldType, np_dtype_for
@@ -436,48 +438,128 @@ class HashAggExec(Executor):
     def chunks(self, ctx):
         agg = HashAggregator(self.plan.aggs, self.plan.group_exprs)
         distinct_ok = all(not a.distinct for a in self.plan.aggs)
-        seen_any = False
-        for chunk in self.child.chunks(ctx):
-            if chunk.num_rows == 0:
-                continue
-            seen_any = True
-            gr = None
-            if distinct_ok and config.device_enabled() and \
-                    chunk.num_rows >= config.device_min_rows():
-                try:
-                    if self._kernel is None:
-                        self._kernel = HashAggKernel(
-                            None, self.plan.group_exprs, self.plan.aggs)
-                        self.plan._root_kernel = self._kernel
-                    gr = runtime_stats.device_call(
-                        self.plan, self._kernel, chunk)
-                except CapacityError as e:
-                    # re-plan once with a larger device table (the re-plan
-                    # the kernel docstring promises), then host fallback
-                    needed = getattr(e, "needed", 0)
-                    cap = 1 << max(needed * 2 - 1, 1).bit_length()
-                    if needed and cap <= (1 << 20):
-                        try:
-                            self._kernel = HashAggKernel(
-                                None, self.plan.group_exprs,
-                                self.plan.aggs, capacity=cap)
-                            self.plan._root_kernel = self._kernel
-                            gr = runtime_stats.device_call(
-                                self.plan, self._kernel, chunk)
-                        except (CapacityError, CollisionError, ValueError):
-                            gr = None
-                except (CollisionError, ValueError):
-                    gr = None
-            if gr is None:
-                gr = host_hash_agg(chunk, None, self.plan.group_exprs,
-                                   self.plan.aggs)
-            agg.update(gr)
+        sc_rows = config.superchunk_rows()
+        if distinct_ok and config.device_enabled() and sc_rows:
+            # superchunk pipeline: child chunks coalesce into big padded
+            # batches and flow through the dispatch-ahead device queue;
+            # one partial-agg dispatch per superchunk, not per chunk
+            for gr in self._superchunk_partials(self.child.chunks(ctx)):
+                agg.update(gr)
+        else:
+            for chunk in self.child.chunks(ctx):
+                if chunk.num_rows == 0:
+                    continue
+                gr = None
+                if distinct_ok and config.device_enabled() and \
+                        chunk.num_rows >= config.device_min_rows():
+                    gr = self._device_partial(chunk)
+                if gr is None:
+                    gr = host_hash_agg(chunk, None, self.plan.group_exprs,
+                                       self.plan.aggs)
+                agg.update(gr)
         results = agg.results()
         if not self.plan.group_exprs and not results:
             results = [((), [_empty_agg_value(a) for a in self.plan.aggs])]
         num_g = len(self.plan.group_exprs)
         yield _agg_results_to_chunk(self.schema, num_g, self.plan.aggs,
                                     results)
+
+    def _set_kernel(self, kernel) -> None:
+        self._kernel = kernel
+        # kernels live on the plan object: the plan cache shares plans
+        # across executions, so the jit program outlives any one run
+        self.plan._root_kernel = kernel
+
+    def _escalated_kernel(self, e: CapacityError):
+        """Re-plan once with a larger device table (the re-plan the
+        kernel docstring promises); None when the overflow is hopeless."""
+        needed = getattr(e, "needed", 0)
+        cap = 1 << max(needed * 2 - 1, 1).bit_length()
+        if not needed or cap > (1 << 20):
+            return None
+        try:
+            k = kernel_for(None, self.plan.group_exprs, self.plan.aggs,
+                           capacity=cap)
+        except ValueError:
+            return None
+        self._set_kernel(k)
+        return k
+
+    def _device_partial(self, chunk):
+        """Per-chunk device partial agg (superchunk coalescing off)."""
+        try:
+            if self._kernel is None:
+                self._set_kernel(kernel_for(
+                    None, self.plan.group_exprs, self.plan.aggs))
+            return runtime_stats.device_call(
+                self.plan, self._kernel, chunk)
+        except CapacityError as e:
+            k = self._escalated_kernel(e)
+            if k is not None:
+                try:
+                    return runtime_stats.device_call(self.plan, k, chunk)
+                except (CapacityError, CollisionError, ValueError):
+                    return None
+        except (CollisionError, ValueError):
+            pass
+        return None
+
+    def _superchunk_partials(self, chunks):
+        """Coalesced device partial aggregation: superchunk_batches folds
+        the child's chunk stream into ~tidb_tpu_superchunk_rows batches,
+        pipeline_map keeps tidb_tpu_pipeline_depth of them in flight
+        (padding + H2D transfer of batch k+1 overlaps batch k's compute;
+        the only sync is the finalize device_get at the output boundary),
+        and the padded input buffers are donated to the kernel. Capacity
+        overflow re-plans and re-runs the offending superchunk; collision
+        or non-device-safe plans fall back to the host per superchunk."""
+        plan = self.plan
+        min_rows = config.device_min_rows()
+        if self._kernel is None:
+            try:
+                self._set_kernel(kernel_for(None, plan.group_exprs,
+                                            plan.aggs))
+            except ValueError:
+                pass    # not device-safe: every superchunk goes host
+
+        def dispatch(sc):
+            k = self._kernel
+            if k is None or sc.num_rows < min_rows:
+                return None      # host path at finalize
+            try:
+                tok = (k, k.dispatch(sc.chunk, donate=True))
+            except (ValueError, NotImplementedError):
+                # trace-time failure: this plan will never run on device
+                self._kernel = None
+                return None
+            runtime_stats.note_superchunk(plan, sc.num_rows, sc.bucket,
+                                          sc.sources)
+            return tok
+
+        def finalize(sc, tok):
+            if tok is not None:
+                k, fut = tok
+                t0 = time.perf_counter_ns()
+                try:
+                    return k.finalize(sc.chunk, fut)
+                except CapacityError as e:
+                    k2 = self._escalated_kernel(e)
+                    if k2 is not None:
+                        try:
+                            return k2(sc.chunk)
+                        except (CapacityError, CollisionError, ValueError):
+                            pass
+                except (CollisionError, ValueError):
+                    pass
+                finally:
+                    runtime_stats.note_finalize_wait(
+                        plan, time.perf_counter_ns() - t0)
+            return host_hash_agg(sc.chunk, None, plan.group_exprs,
+                                 plan.aggs)
+
+        yield from op_runtime.pipeline_map(
+            op_runtime.superchunk_batches(chunks, config.superchunk_rows()),
+            dispatch, finalize, config.pipeline_depth())
 
 
 class StreamAggExec(Executor):
@@ -500,6 +582,34 @@ class StreamAggExec(Executor):
         agg = HashAggregator(self.plan.aggs, self.plan.group_exprs)
         use_device = (config.device_enabled() and
                       all(not a.distinct for a in self.plan.aggs))
+        slice_rows = config.superchunk_rows() or self._SLICE
+
+        def parts():
+            """Ordered ~slice_rows Superchunks: key-adjacency (all the
+            segment kernel needs) survives coalescing because both
+            sources below yield key-ordered chunks and superchunk
+            assembly preserves order. Oversize blocks are re-sliced so
+            device dispatches stay bounded."""
+            if self.plan.sorted_input:
+                # already key-ordered (pk scan / keep_order index): pure
+                # streaming, the whole input is never materialized
+                yield from op_runtime.superchunk_batches(
+                    self.child.chunks(ctx), slice_rows)
+                return
+            # needs its own ordering pass: the spill sorter keeps row
+            # memory O(run + block) however large the input
+            # (executor/extsort.py), then yields globally ordered blocks
+            from tidb_tpu.executor.extsort import SpillSorter
+            by = [(g, False) for g in self.plan.group_exprs]
+            sorter = SpillSorter(by, run_rows=config.sort_spill_rows(),
+                                 block_rows=slice_rows)
+            try:
+                for chunk in self.child.chunks(ctx):
+                    sorter.add(chunk)
+                yield from op_runtime.superchunk_batches(
+                    sorter.sorted_chunks(), slice_rows)
+            finally:
+                sorter.close()
 
         # batches keep host+device memory bounded; a group spanning two
         # batches merges itself in the HashAggregator
@@ -509,7 +619,7 @@ class StreamAggExec(Executor):
             if use_device and part.num_rows >= config.device_min_rows():
                 try:
                     if self._kernel is None:
-                        self._kernel = SegmentAggKernel(
+                        self._kernel = segment_kernel_for(
                             self.plan.group_exprs, self.plan.aggs)
                         self.plan._root_kernel = self._kernel
                     gr = runtime_stats.device_call(
@@ -521,37 +631,65 @@ class StreamAggExec(Executor):
                                    self.plan.aggs)
             agg.update(gr)
 
-        if self.plan.sorted_input:
-            # already key-ordered (pk scan / keep_order index): pure
-            # streaming, the whole input is never materialized
-            for part in super_batches([], self.child.chunks(ctx),
-                                       self._SLICE):
-                feed(part)
+        if use_device and config.superchunk_rows():
+            for gr in self._pipelined_segments(parts()):
+                agg.update(gr)
         else:
-            # needs its own ordering pass: the spill sorter keeps row
-            # memory O(run + block) however large the input
-            # (executor/extsort.py), then yields globally ordered blocks
-            from tidb_tpu.executor.extsort import SpillSorter
-            by = [(g, False) for g in self.plan.group_exprs]
-            sorter = SpillSorter(by, run_rows=config.sort_spill_rows(),
-                                 block_rows=self._SLICE)
-            try:
-                for chunk in self.child.chunks(ctx):
-                    sorter.add(chunk)
-                for part in sorter.sorted_chunks():
-                    # a non-spilled tail comes back as one chunk: re-slice
-                    # so device dispatches stay bounded
-                    for s in range(0, part.num_rows, self._SLICE):
-                        feed(part.slice(s, min(s + self._SLICE,
-                                               part.num_rows)))
-            finally:
-                sorter.close()
+            for sc in parts():
+                feed(sc.chunk)
         results = agg.results()
         if not self.plan.group_exprs and not results:
             results = [((), [_empty_agg_value(a) for a in self.plan.aggs])]
         yield _agg_results_to_chunk(self.schema,
                                     len(self.plan.group_exprs),
                                     self.plan.aggs, results)
+
+    def _pipelined_segments(self, parts):
+        """Segment-reduce each superchunk through the dispatch-ahead
+        queue (see HashAggExec._superchunk_partials): one whole-
+        superchunk segment op per coalesced batch, inputs donated, the
+        next batch padded/transferred while this one executes. Segment
+        kernels have no capacity protocol; a trace failure permanently
+        reverts to the host path (matching the old per-batch behavior)."""
+        plan = self.plan
+        min_rows = config.device_min_rows()
+        if self._kernel is None:
+            try:
+                self._kernel = segment_kernel_for(plan.group_exprs,
+                                                  plan.aggs)
+                plan._root_kernel = self._kernel
+            except (ValueError, NotImplementedError):
+                self._kernel = None
+
+        def dispatch(sc):
+            k = self._kernel
+            if k is None or sc.num_rows < min_rows:
+                return None
+            try:
+                tok = (k, k.dispatch(sc.chunk, donate=True))
+            except (ValueError, NotImplementedError):
+                self._kernel = None
+                return None
+            runtime_stats.note_superchunk(plan, sc.num_rows, sc.bucket,
+                                          sc.sources)
+            return tok
+
+        def finalize(sc, tok):
+            if tok is not None:
+                k, fut = tok
+                t0 = time.perf_counter_ns()
+                try:
+                    return k.finalize(sc.chunk, fut)
+                except (ValueError, NotImplementedError):
+                    self._kernel = None
+                finally:
+                    runtime_stats.note_finalize_wait(
+                        plan, time.perf_counter_ns() - t0)
+            return host_hash_agg(sc.chunk, None, plan.group_exprs,
+                                 plan.aggs)
+
+        yield from op_runtime.pipeline_map(parts, dispatch, finalize,
+                                           config.pipeline_depth())
 
 
 # ---------------------------------------------------------------------------
@@ -806,72 +944,134 @@ class HashJoinExec(Executor):
             else:
                 mesh_kernel = None
                 probe_iter = iter(buffered)
-        for chunk in probe_iter:
-            n = chunk.num_rows
-            if n == 0:
-                continue
-            if nb == 0:
-                if plan.join_type == "left":
-                    out = self._emit(chunk, build,
-                                     np.empty(0, np.int64),
-                                     np.empty(0, np.int64),
-                                     np.arange(n))
-                    if out is not None:
-                        yield out
-                elif plan.join_type == "anti":
-                    yield chunk            # nothing can match: all survive
-                continue
-            pk = enc.transform_probe(self._eval_keys(plan.left_keys, chunk))
-            if mesh_kernel is not None:
-                from tidb_tpu.parallel.shuffle_join import \
-                    ShuffleOverflowError
-                try:
-                    li, ri = runtime_stats.device_call(
-                        self.plan, mesh_kernel, pk, bk, nb, n)
-                except ShuffleOverflowError:
-                    # designed fallback: extreme hash skew exhausted the
-                    # repartition retry budget
+        if mesh_kernel is None and nb > 0 and self._kernel is not None \
+                and config.device_enabled() and config.superchunk_rows():
+            # single-chip device path: probe chunks coalesce into
+            # superchunks and flow through the dispatch-ahead matcher
+            # queue (build-side lanes transfer once for the whole probe)
+            yield from self._pipelined_probe(probe_iter, build, bk, enc,
+                                             matched_build, nb)
+        else:
+            for chunk in probe_iter:
+                n = chunk.num_rows
+                if n == 0:
+                    continue
+                if nb == 0:
+                    if plan.join_type == "left":
+                        out = self._emit(chunk, build,
+                                         np.empty(0, np.int64),
+                                         np.empty(0, np.int64),
+                                         np.arange(n))
+                        if out is not None:
+                            yield out
+                    elif plan.join_type == "anti":
+                        yield chunk        # nothing can match: all survive
+                    continue
+                pk = enc.transform_probe(
+                    self._eval_keys(plan.left_keys, chunk))
+                if mesh_kernel is not None:
+                    from tidb_tpu.parallel.shuffle_join import \
+                        ShuffleOverflowError
+                    try:
+                        li, ri = runtime_stats.device_call(
+                            self.plan, mesh_kernel, pk, bk, nb, n)
+                    except ShuffleOverflowError:
+                        # designed fallback: extreme hash skew exhausted
+                        # the repartition retry budget
+                        li, ri = runtime_stats.device_call(
+                            self.plan, self._kernel, bk, pk, nb, n)
+                elif config.device_enabled() and \
+                        (n >= self._DEVICE_MIN_PROBE or
+                         nb >= self._DEVICE_MIN_BUILD):
                     li, ri = runtime_stats.device_call(
                         self.plan, self._kernel, bk, pk, nb, n)
-            elif config.device_enabled() and \
-                    (n >= self._DEVICE_MIN_PROBE or
-                     nb >= self._DEVICE_MIN_BUILD):
-                li, ri = runtime_stats.device_call(
-                    self.plan, self._kernel, bk, pk, nb, n)
-            else:
-                # small inputs / device disabled: the same sort-join,
-                # vectorized in numpy (no jit dispatch, dynamic shapes)
-                li, ri = host_match_pairs(bk, pk, nb, n)
-            # other_cond filters pairs BEFORE unmatched detection, so a
-            # probe row whose every match fails the condition re-enters
-            # as unmatched (outer-join ON-clause semantics)
-            pair = None
-            if plan.other_cond is not None and len(li):
-                pair = self._gather(chunk, build, li, ri)
-                keep = eval_filter_host(plan.other_cond, pair)
-                li, ri = li[keep], ri[keep]
-                pair = pair.filter(keep)
-            if plan.join_type in ("semi", "anti"):
-                # (anti-)semi join: emit probe rows by match existence,
-                # never the joined width (ref: the semi-join family of
-                # plan/gen_physical_plans.go; decorrelated EXISTS/IN)
-                m = np.zeros(n, dtype=bool)
-                m[li] = True
-                yield chunk.filter(m if plan.join_type == "semi" else ~m)
-                continue
-            matched_build[ri] = True
-            unmatched = np.empty(0, np.int64)
-            if plan.join_type == "left":
-                m = np.zeros(n, dtype=bool)
-                m[li] = True
-                unmatched = np.flatnonzero(~m)
-            out = self._emit(chunk, build, li, ri, unmatched, pair=pair)
-            if out is not None:
-                yield out
+                else:
+                    # small inputs / device disabled: the same sort-join,
+                    # vectorized in numpy (no jit dispatch, dynamic shapes)
+                    li, ri = host_match_pairs(bk, pk, nb, n)
+                yield from self._post_match(chunk, build, li, ri,
+                                            matched_build)
         if plan.join_type == "right" and build is not None:
             un = np.flatnonzero(~matched_build)
             if len(un):
                 yield self._emit_right_unmatched(build, un)
+
+    def _post_match(self, chunk, build, li, ri, matched_build):
+        """Shared tail after pair matching for one probe batch:
+        other_cond filtering, semi/anti emission, left-unmatched fill;
+        marks matched build rows for the right-join pass."""
+        plan = self.plan
+        n = chunk.num_rows
+        # other_cond filters pairs BEFORE unmatched detection, so a
+        # probe row whose every match fails the condition re-enters
+        # as unmatched (outer-join ON-clause semantics)
+        pair = None
+        if plan.other_cond is not None and len(li):
+            pair = self._gather(chunk, build, li, ri)
+            keep = eval_filter_host(plan.other_cond, pair)
+            li, ri = li[keep], ri[keep]
+            pair = pair.filter(keep)
+        if plan.join_type in ("semi", "anti"):
+            # (anti-)semi join: emit probe rows by match existence,
+            # never the joined width (ref: the semi-join family of
+            # plan/gen_physical_plans.go; decorrelated EXISTS/IN)
+            m = np.zeros(n, dtype=bool)
+            m[li] = True
+            yield chunk.filter(m if plan.join_type == "semi" else ~m)
+            return
+        matched_build[ri] = True
+        unmatched = np.empty(0, np.int64)
+        if plan.join_type == "left":
+            m = np.zeros(n, dtype=bool)
+            m[li] = True
+            unmatched = np.flatnonzero(~m)
+        out = self._emit(chunk, build, li, ri, unmatched, pair=pair)
+        if out is not None:
+            yield out
+
+    def _pipelined_probe(self, probe_iter, build, bk, enc, matched_build,
+                         nb: int):
+        """Coalesced probe matching with dispatch-ahead: while superchunk
+        k's matcher program executes, k+1's keys are encoded, padded and
+        transferred (the host-side emit of k's output overlaps too). A
+        probe too small to pay a dispatch matches on the host inline —
+        same decision the per-chunk loop made, now per superchunk."""
+        plan = self.plan
+        kernel = self._kernel
+        build_dev = None
+
+        def dispatch(sc):
+            nonlocal build_dev
+            n = sc.num_rows
+            pk = enc.transform_probe(
+                self._eval_keys(plan.left_keys, sc.chunk))
+            if n < self._DEVICE_MIN_PROBE and nb < self._DEVICE_MIN_BUILD:
+                return ("host", host_match_pairs(bk, pk, nb, n))
+            if build_dev is None:
+                build_dev = kernel.prepare_build(bk, nb)
+            runtime_stats.note_superchunk(plan, n, sc.bucket, sc.sources)
+            return ("dev", kernel.dispatch(bk, pk, nb, n,
+                                           build_dev=build_dev))
+
+        def finalize(sc, tok):
+            kind, payload = tok
+            if kind == "host":
+                li, ri = payload
+            else:
+                t0 = time.perf_counter_ns()
+                try:
+                    li, ri = kernel.finalize(payload)
+                finally:
+                    runtime_stats.note_finalize_wait(
+                        plan, time.perf_counter_ns() - t0)
+            return sc, li, ri
+
+        sc_iter = op_runtime.superchunk_batches(probe_iter,
+                                                config.superchunk_rows())
+        for sc, li, ri in op_runtime.pipeline_map(
+                sc_iter, dispatch, finalize, config.pipeline_depth()):
+            yield from self._post_match(sc.chunk, build, li, ri,
+                                        matched_build)
 
     def _gather(self, left_chunk, build, li, ri):
         cols = [Column(c.ft, c.data[li], c.valid[li])

@@ -14,7 +14,8 @@ on hash collisions, or on non-unique dimension build keys."""
 from __future__ import annotations
 
 import itertools
-from collections import OrderedDict
+import time
+from collections import OrderedDict, deque
 
 import numpy as np
 
@@ -23,7 +24,7 @@ from tidb_tpu import runtime_stats
 from tidb_tpu.chunk import Chunk, Column
 from tidb_tpu.ops.hashagg import CapacityError, CollisionError, HashAggregator
 from tidb_tpu.ops.hostagg import host_hash_agg
-from tidb_tpu.ops.runtime import super_batches
+from tidb_tpu.ops.runtime import bucket_size, superchunk_batches
 from tidb_tpu.parallel import config
 from tidb_tpu.parallel.dist_agg import MeshAggKernel
 from tidb_tpu.parallel.dist_join import (BuildError, LookupSpec,
@@ -175,17 +176,20 @@ class _MeshExecBase:
                 return None
         return None
 
-    def _stream_groups(self, batches, get_kernel, host_batch,
+    def _stream_groups(self, superchunks, get_kernel, host_batch,
                        agg: HashAggregator) -> None:
-        """Double-buffered streaming aggregation: batch i+1's host→HBM
-        transfer and kernel dispatch are issued (asynchronously) BEFORE
-        batch i's blocking readback, so transfer/compute/readback overlap
-        (BASELINE config 5). Per-batch recovery: capacity overflow
-        re-plans the kernel and re-runs only that batch (group merging is
-        associative — already-merged batches stay valid); collisions or
-        non-device expressions aggregate that batch on the host."""
+        """Streaming aggregation with dispatch-ahead: up to
+        tidb_tpu_pipeline_depth superchunks' host→HBM transfers and
+        kernel dispatches are issued (asynchronously) BEFORE the oldest
+        one's blocking readback, so transfer/compute/readback overlap
+        (BASELINE config 5; depth 2 = the classic double buffer).
+        Per-batch recovery: capacity overflow re-plans the kernel and
+        re-runs only that batch (group merging is associative —
+        already-merged batches stay valid); collisions or non-device
+        expressions aggregate that batch on the host."""
         _STREAM_STATS["streams"] += 1
         capacity = getattr(self.plan, "_mesh_capacity", DEFAULT_CAPACITY)
+        depth = sysconf.pipeline_depth()
         try:
             kernel = get_kernel(capacity)
         except (ValueError, BuildError):
@@ -193,6 +197,7 @@ class _MeshExecBase:
 
         def finish(pkernel, outs, batch):
             nonlocal kernel, capacity
+            t0 = time.perf_counter_ns()
             try:
                 return pkernel.finish(outs, batch)
             except CapacityError as e:
@@ -214,11 +219,17 @@ class _MeshExecBase:
                         break
             except (CollisionError, BuildError, ValueError):
                 pass
+            finally:
+                # stall only (the enclosing device_section owns device
+                # time — adding it here too would double-count)
+                runtime_stats.note_pipeline_stall(
+                    self.plan, time.perf_counter_ns() - t0)
             _STREAM_STATS["host_batches"] += 1
             return host_batch(batch)
 
-        pending = None          # (kernel, in-flight outs, batch)
-        for batch in batches:
+        pending: deque = deque()    # (kernel, in-flight outs, batch)
+        for sc in superchunks:
+            batch = sc.chunk
             _STREAM_STATS["batches"] += 1
             _STREAM_STATS["max_batch_rows"] = max(
                 _STREAM_STATS["max_batch_rows"], batch.num_rows)
@@ -227,20 +238,26 @@ class _MeshExecBase:
             if launch_kernel is not None:   # capacity re-plan; outs must be
                 try:                        # read back by their own kernel
                     outs = launch_kernel.launch(batch, bucket=True)
-                    if pending is not None:
+                    if pending:
                         _STREAM_STATS["overlapped_launches"] += 1
+                    runtime_stats.note_superchunk(
+                        self.plan, batch.num_rows,
+                        bucket_size(max(batch.num_rows, 1)), sc.sources)
                 except (ValueError, CollisionError, BuildError):
                     outs = None
-            if pending is not None:
-                agg.update(finish(*pending))
-                pending = None
             if outs is not None:
-                pending = (launch_kernel, outs, batch)
+                pending.append((launch_kernel, outs, batch))
+                while len(pending) > depth:
+                    agg.update(finish(*pending.popleft()))
             else:
+                # host batches are synchronous: drain in-flight work
+                # first so results keep arriving in input order
+                while pending:
+                    agg.update(finish(*pending.popleft()))
                 _STREAM_STATS["host_batches"] += 1
                 agg.update(host_batch(batch))
-        if pending is not None:
-            agg.update(finish(*pending))
+        while pending:
+            agg.update(finish(*pending.popleft()))
         if kernel is not None:
             self.plan._mesh_capacity = capacity
 
@@ -293,7 +310,8 @@ class MeshAggExec(_MeshExecBase):
             # is the whole streaming region's wall (ends on readback)
             with runtime_stats.device_section(plan):
                 self._stream_groups(
-                    super_batches(parts, it, limit), get_kernel,
+                    superchunk_batches(itertools.chain(parts, it), limit),
+                    get_kernel,
                     lambda b: host_hash_agg(b, plan.filter_expr,
                                             plan.group_exprs, plan.aggs),
                     agg)
@@ -310,6 +328,10 @@ class MeshAggExec(_MeshExecBase):
             if gr is None:
                 yield from self._fallback(ctx)
                 return
+            # the whole table went down as ONE maximally-coalesced batch
+            runtime_stats.note_superchunk(
+                plan, big.num_rows, bucket_size(max(big.num_rows, 1)),
+                max(len(parts), 1))
         yield _emit_results(plan, gr, ex)
 
 
@@ -376,7 +398,8 @@ class MeshLookupAggExec(_MeshExecBase):
             agg = HashAggregator(plan.aggs, plan.group_exprs)
             with runtime_stats.device_section(plan):
                 self._stream_groups(
-                    super_batches(parts, it, limit), get_kernel,
+                    superchunk_batches(itertools.chain(parts, it), limit),
+                    get_kernel,
                     lambda b: host_lookup_agg(b, plan.filter_expr, specs,
                                               plan.group_exprs, plan.aggs,
                                               builds=builds),
@@ -394,6 +417,9 @@ class MeshLookupAggExec(_MeshExecBase):
             if gr is None:
                 yield from self._fallback(ctx)
                 return
+            runtime_stats.note_superchunk(
+                plan, probe.num_rows, bucket_size(max(probe.num_rows, 1)),
+                max(len(parts), 1))
         yield _emit_results(plan, gr, ex)
 
     @staticmethod

@@ -19,7 +19,9 @@ __all__ = ["counter", "histogram", "expose", "snapshot",
            "CONNECTIONS", "COP_TASKS", "QUERY_ERRORS",
            "COP_STREAM_FRAMES", "COP_STREAM_BYTES",
            "COP_STREAM_CREDIT_STALLS", "COP_STREAM_RESUMES",
-           "OP_DURATIONS", "OP_ROWS", "OP_DEVICE_DURATIONS"]
+           "OP_DURATIONS", "OP_ROWS", "OP_DEVICE_DURATIONS",
+           "SUPERCHUNKS", "SUPERCHUNK_SOURCES", "SUPERCHUNK_FILL_ROWS",
+           "SUPERCHUNK_BUCKET_ROWS", "PIPELINE_STALLS"]
 
 _lock = threading.Lock()
 _counters: dict[tuple[str, tuple], float] = {}
@@ -140,6 +142,14 @@ COP_STREAM_RESUMES = "tidb_tpu_cop_stream_resumes_total"
 OP_DURATIONS = "tidb_tpu_op_duration_seconds"
 OP_ROWS = "tidb_tpu_op_act_rows_total"
 OP_DEVICE_DURATIONS = "tidb_tpu_op_device_seconds"
+# superchunk pipeline (ops/runtime.py), labeled {op=...}: fill ratio is
+# derived as fill_rows / bucket_rows; stall is host time blocked on
+# device readback inside the dispatch-ahead pipeline
+SUPERCHUNKS = "tidb_tpu_superchunks_total"
+SUPERCHUNK_SOURCES = "tidb_tpu_superchunk_source_chunks_total"
+SUPERCHUNK_FILL_ROWS = "tidb_tpu_superchunk_fill_rows_total"
+SUPERCHUNK_BUCKET_ROWS = "tidb_tpu_superchunk_bucket_rows_total"
+PIPELINE_STALLS = "tidb_tpu_pipeline_stall_seconds"
 
 _HELP = {
     QUERY_DURATIONS: "Statement wall time through Session.execute.",
@@ -157,4 +167,13 @@ _HELP = {
     OP_ROWS: "Per-operator actual output rows, by op.",
     OP_DEVICE_DURATIONS:
         "Per-operator device time (block_until_ready), by op.",
+    SUPERCHUNKS: "Coalesced superchunk device dispatches, by op.",
+    SUPERCHUNK_SOURCES:
+        "Source chunks folded into superchunks, by op.",
+    SUPERCHUNK_FILL_ROWS:
+        "Live rows carried by superchunks, by op.",
+    SUPERCHUNK_BUCKET_ROWS:
+        "Padded bucket rows dispatched for superchunks, by op.",
+    PIPELINE_STALLS:
+        "Per-operator host time blocked on device readback, by op.",
 }

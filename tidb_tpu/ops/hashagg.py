@@ -38,7 +38,7 @@ from tidb_tpu.sqltypes import EvalType
 
 __all__ = ["AggSpec", "HashAggKernel", "ScalarAggKernel", "HashAggregator",
            "CapacityError", "CollisionError", "GroupResult",
-           "finalize_group_result"]
+           "finalize_group_result", "kernel_for"]
 
 AggSpec = AggDesc  # the planner's descriptor doubles as the kernel spec
 
@@ -483,6 +483,7 @@ class HashAggKernel:
         self.capacity = capacity
         _validate_device_exprs(filter_expr, self.group_exprs, self.aggs)
         self._jit = jax.jit(self._kernel)
+        self._jitd = None   # donating variant, built on first dispatch
 
     def _kernel(self, cols, nrows):
         n = cols[0][0].shape[0]
@@ -528,13 +529,27 @@ class HashAggKernel:
                  for assemble in assembles]
         return uniq, nuniq, collided, counts, rep, lanes
 
-    def __call__(self, chunk: Chunk) -> GroupResult:
-        cols, _dicts = runtime.device_put_chunk(chunk)
-        # ONE batched device->host transfer for the whole result pytree:
-        # per-array reads each pay full round-trip latency (the device may
-        # sit behind a network tunnel), a single device_get amortizes it
-        uniq, nuniq, collided, counts, rep, lanes = jax.device_get(
-            self._jit(cols, chunk.num_rows))
+    def dispatch(self, chunk: Chunk, donate: bool = False):
+        """Pad + transfer + enqueue the program WITHOUT forcing a sync
+        (jax dispatch is async): the pipeline's overlap point. With
+        donate=True (and a backend that honors it) the padded input
+        buffers are donated to the program, so a transient superchunk's
+        HBM is reused for the group tables instead of living alongside
+        them; donated transfers skip the chunk memo (a memoized donated
+        buffer would be read after free). -> opaque pending token."""
+        donate = donate and runtime.donation_supported()
+        cols, _dicts = runtime.device_put_chunk(chunk, memo=not donate)
+        if donate:
+            if self._jitd is None:
+                self._jitd = jax.jit(self._kernel, donate_argnums=(0,))
+            return self._jitd(cols, chunk.num_rows)
+        return self._jit(cols, chunk.num_rows)
+
+    def finalize(self, chunk: Chunk, pending) -> GroupResult:
+        """Blocking half: one batched device->host transfer for the whole
+        result pytree (per-array reads each pay full round-trip latency —
+        the device may sit behind a network tunnel), then the host tail."""
+        uniq, nuniq, collided, counts, rep, lanes = jax.device_get(pending)
         # capacity before collision: overflow groups clamp into the last
         # slot, which then trips the collision check spuriously
         if int(nuniq) > self.capacity:
@@ -550,6 +565,9 @@ class HashAggKernel:
         return finalize_group_result(chunk, self.group_exprs, self.aggs,
                                      gidx, rep[gidx], lanes_at, counts[gidx])
 
+    def __call__(self, chunk: Chunk) -> GroupResult:
+        return self.finalize(chunk, self.dispatch(chunk))
+
 
 class ScalarAggKernel:
     """No-group aggregation: one partial state row per chunk."""
@@ -560,6 +578,7 @@ class ScalarAggKernel:
         self.aggs = list(aggs)
         _validate_device_exprs(filter_expr, [], self.aggs)
         self._jit = jax.jit(self._kernel)
+        self._jitd = None
 
     def _kernel(self, cols, nrows):
         n = cols[0][0].shape[0]
@@ -573,9 +592,18 @@ class ScalarAggKernel:
                  for a in self.aggs]
         return count, lanes
 
-    def __call__(self, chunk: Chunk) -> GroupResult:
-        cols, _ = runtime.device_put_chunk(chunk)
-        count, lanes = jax.device_get(self._jit(cols, chunk.num_rows))
+    def dispatch(self, chunk: Chunk, donate: bool = False):
+        """Async half; see HashAggKernel.dispatch."""
+        donate = donate and runtime.donation_supported()
+        cols, _ = runtime.device_put_chunk(chunk, memo=not donate)
+        if donate:
+            if self._jitd is None:
+                self._jitd = jax.jit(self._kernel, donate_argnums=(0,))
+            return self._jitd(cols, chunk.num_rows)
+        return self._jit(cols, chunk.num_rows)
+
+    def finalize(self, chunk: Chunk, pending) -> GroupResult:
+        count, lanes = jax.device_get(pending)
         partials = []
         for a, ls in zip(self.aggs, lanes):
             if a.fn == AggFunc.FIRST_ROW:
@@ -589,6 +617,39 @@ class ScalarAggKernel:
                 ls = [np.array([val]), hasv.astype(np.int64)]
             partials.append(ls)
         return GroupResult(keys=[()], partials=partials, counts=count)
+
+    def __call__(self, chunk: Chunk) -> GroupResult:
+        return self.finalize(chunk, self.dispatch(chunk))
+
+
+# -- process-wide kernel cache (executable reuse across plan objects) --------
+
+# keyed on (plan fingerprint, capacity): a plan-cache miss, a new session,
+# or a re-parsed statement re-creates plan OBJECTS, but the device program
+# is identical — re-tracing and re-compiling it per plan instance is pure
+# waste (and through a chip tunnel, seconds of it). jit's own executable
+# cache inside each kernel then handles the bucket-shape axis: one traced
+# kernel serves every padded superchunk size.
+_KERNELS = runtime.FingerprintCache(64)
+
+
+def kernel_for(filter_expr, group_exprs, aggs, capacity: int = 4096):
+    """HashAggKernel/ScalarAggKernel with process-wide reuse keyed on the
+    structural plan fingerprint + capacity. Falls back to a fresh
+    (uncached) kernel when the plan cannot be fingerprinted. Raises
+    ValueError exactly like the constructors when the exprs are not
+    device-safe."""
+    def make():
+        if group_exprs:
+            return HashAggKernel(filter_expr, group_exprs, aggs,
+                                 capacity=capacity)
+        return ScalarAggKernel(filter_expr, aggs)
+
+    fp = runtime.plan_fingerprint(filter_expr, group_exprs, aggs)
+    if fp is None:
+        return make()
+    return _KERNELS.get_or_create((fp, capacity if group_exprs else 0),
+                                  make)
 
 
 class HashAggregator:

@@ -207,6 +207,20 @@ def _matcher_program(out_cap: int):
     return prog
 
 
+class _PendingJoin:
+    """In-flight matcher dispatch: the padded device-resident key lanes
+    ride along so an overflow retry re-runs WITHOUT re-padding or
+    re-transferring either side."""
+
+    __slots__ = ("bk", "pk", "nb", "np_", "cap", "res")
+
+    def __init__(self, bk, pk, nb, np_, cap, res):
+        self.bk, self.pk = bk, pk
+        self.nb, self.np_ = nb, np_
+        self.cap = cap
+        self.res = res
+
+
 class JoinKernel:
     """Pair matcher for one key-lane signature; compiled programs are
     shared process-wide (see _matcher_program)."""
@@ -214,29 +228,51 @@ class JoinKernel:
     def __init__(self, num_keys: int):
         self.num_keys = num_keys
 
-    def __call__(self, build_keys, probe_keys, nb: int, np_: int,
-                 out_cap: int | None = None):
-        """build_keys/probe_keys: [(np data, np valid)] aligned fixed-width
-        lanes (see encode_join_keys). Returns (li, ri) numpy index arrays
-        of matching (probe, build) row pairs."""
+    def prepare_build(self, build_keys, nb: int):
+        """Pad + transfer the build-side key lanes once; the returned
+        device lanes feed every probe superchunk's dispatch (per-probe
+        build re-uploads were pure waste)."""
         bb = runtime.bucket_size(max(nb, 1))
+        return [tuple(map(jnp.asarray, runtime.pad_column(d, v, bb)))
+                for d, v in build_keys]
+
+    def dispatch(self, build_keys, probe_keys, nb: int, np_: int,
+                 out_cap: int | None = None, build_dev=None) -> _PendingJoin:
+        """Async half: enqueue the matcher program for one probe batch
+        (no sync — the pipeline's overlap point). build_dev, when given,
+        is the prepare_build() result reused across batches."""
+        bk = build_dev if build_dev is not None \
+            else self.prepare_build(build_keys, nb)
         pb = runtime.bucket_size(max(np_, 1))
         cap = out_cap or runtime.bucket_size(max(np_ * 2, 1024))
+        pk = [tuple(map(jnp.asarray, runtime.pad_column(d, v, pb)))
+              for d, v in probe_keys]
+        prog = _matcher_program(cap)
+        return _PendingJoin(bk, pk, nb, np_, cap,
+                            prog(bk, pk, nb, np_))
+
+    def finalize(self, p: _PendingJoin):
+        """Blocking half: read back the pair list, growing the output
+        capacity (device lanes reused) until it fits."""
         while True:
-            prog = _matcher_program(cap)
-            bk = [tuple(map(jnp.asarray, runtime.pad_column(d, v, bb)))
-                  for d, v in build_keys]
-            pk = [tuple(map(jnp.asarray, runtime.pad_column(d, v, pb)))
-                  for d, v in probe_keys]
-            li, ri, ok, total = prog(bk, pk, nb, np_)
+            li, ri, ok, total = p.res
             # scalar first: an overflow retry then discards the cap-sized
             # index buffers without ever transferring them; the success
             # path batches the three arrays into one device_get (per-array
             # reads each pay full round-trip latency through the tunnel)
             total = int(jax.device_get(total))
-            if total > cap:
-                cap = runtime.bucket_size(total)
-                continue
-            li, ri, ok = jax.device_get((li, ri, ok))
-            sel = np.flatnonzero(ok)
-            return li[sel], ri[sel]
+            if total <= p.cap:
+                break
+            p.cap = runtime.bucket_size(total)
+            p.res = _matcher_program(p.cap)(p.bk, p.pk, p.nb, p.np_)
+        li, ri, ok = jax.device_get((li, ri, ok))
+        sel = np.flatnonzero(ok)
+        return li[sel], ri[sel]
+
+    def __call__(self, build_keys, probe_keys, nb: int, np_: int,
+                 out_cap: int | None = None):
+        """build_keys/probe_keys: [(np data, np valid)] aligned fixed-width
+        lanes (see encode_join_keys). Returns (li, ri) numpy index arrays
+        of matching (probe, build) row pairs."""
+        return self.finalize(self.dispatch(build_keys, probe_keys, nb, np_,
+                                           out_cap=out_cap))

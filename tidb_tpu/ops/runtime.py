@@ -9,6 +9,9 @@ kernel treats them as NULLs that match no filter and join no group.
 
 from __future__ import annotations
 
+import threading
+from collections import OrderedDict, deque
+
 import numpy as np
 
 import jax
@@ -18,21 +21,57 @@ from tidb_tpu.chunk import Chunk, dict_encode
 from tidb_tpu.expression import Expression
 
 __all__ = ["bucket_size", "pad_column", "device_put_chunk",
-           "eval_filter_host", "super_batches", "MIN_BUCKET"]
+           "eval_filter_host", "super_batches", "MIN_BUCKET",
+           "Superchunk", "superchunk_batches", "pipeline_map",
+           "donation_supported", "plan_fingerprint"]
 
 MIN_BUCKET = 1024
 
 
-def super_batches(first_parts, rest, limit: int):
-    """Re-batch a chunk stream into ~limit-row super-batches: device
+class Superchunk:
+    """One coalesced batch: a chunk re-assembled from `sources` storage
+    chunks, destined for a single padded-bucket device dispatch.
+    `sources` counts the chunks that CONTRIBUTED rows to this batch — a
+    chunk spanning a coalesce boundary feeds (and counts in) each
+    superchunk it touches, so per-superchunk attribution stays honest
+    even though the per-query sum can exceed the distinct chunk count.
+    The fill ratio (rows over the padded bucket) is the fraction of
+    device work spent on live rows — the number EXPLAIN ANALYZE
+    surfaces."""
+
+    __slots__ = ("chunk", "sources")
+
+    def __init__(self, chunk: Chunk, sources: int):
+        self.chunk = chunk
+        self.sources = sources
+
+    @property
+    def num_rows(self) -> int:
+        return self.chunk.num_rows
+
+    @property
+    def bucket(self) -> int:
+        return bucket_size(self.chunk.num_rows)
+
+    @property
+    def fill(self) -> float:
+        return self.chunk.num_rows / self.bucket
+
+
+def superchunk_batches(chunks, limit: int):
+    """Coalesce a chunk stream into ~limit-row Superchunks: device
     dispatches stay large while host memory stays O(limit) — the
     TPU-sized form of the reference's bounded chunk channels
     (distsql/distsql.go:92). Oversize chunks are sliced so one storage
-    chunk cannot break the memory bound."""
-    import itertools
+    chunk cannot break the memory bound; 0-row chunks fold away.
+    A `limit` that is a power of two keeps every full superchunk on ONE
+    bucket shape; only the tail pays a smaller power-of-two bucket."""
     limit = max(int(limit), 1)    # a 0/negative sysvar must not hang
-    buf, total = [], 0
-    for c in itertools.chain(first_parts, rest):
+    buf, total, srcs = [], 0, 0
+    for c in chunks:
+        if c.num_rows == 0:
+            continue
+        srcs += 1
         start = 0
         while start < c.num_rows:
             take = min(c.num_rows - start, limit - total)
@@ -44,12 +83,62 @@ def super_batches(first_parts, rest, limit: int):
             if total >= limit:
                 big = Chunk.concat_all(buf)
                 if big is not None:
-                    yield big
-                buf, total = [], 0
+                    yield Superchunk(big, srcs)
+                buf, total, srcs = [], 0, 1 if start < c.num_rows else 0
     if buf:
         big = Chunk.concat_all(buf)
         if big is not None:
-            yield big
+            yield Superchunk(big, srcs)
+
+
+def super_batches(first_parts, rest, limit: int):
+    """Chunk-only view of superchunk_batches (legacy callers)."""
+    import itertools
+    for sc in superchunk_batches(itertools.chain(first_parts, rest),
+                                 limit):
+        yield sc.chunk
+
+
+def pipeline_map(items, dispatch, finalize, depth: int):
+    """Depth-N dispatch-ahead map over an item stream: up to `depth`
+    dispatched items are in flight before the oldest is finalized, so
+    item k+1's host-side prep (padding, dict-encode, device_put) and its
+    async XLA dispatch overlap item k's device execution — the double
+    buffer at depth 2. Results come back in item order.
+
+    dispatch(item) -> token must only ENQUEUE work (jax dispatch is
+    async; nothing here may force a sync). finalize(item, token) is the
+    one blocking point (device_get at the operator output boundary);
+    callers that want stall attribution time their device readback
+    inside finalize (runtime_stats.note_finalize_wait), where they can
+    tell device tokens from host-fallback ones."""
+    depth = max(int(depth), 1)
+    pending: deque = deque()
+
+    for it in items:
+        while len(pending) >= depth:
+            prev, tok = pending.popleft()
+            yield finalize(prev, tok)
+        pending.append((it, dispatch(it)))
+    while pending:
+        prev, tok = pending.popleft()
+        yield finalize(prev, tok)
+
+
+_donation_supported: bool | None = None
+
+
+def donation_supported() -> bool:
+    """True when the active backend honors input-buffer donation (TPU /
+    GPU). XLA:CPU ignores donations with a per-call warning, so the
+    donating jit variants only engage off-CPU."""
+    global _donation_supported
+    if _donation_supported is None:
+        try:
+            _donation_supported = jax.default_backend() not in ("cpu",)
+        except Exception:  # noqa: BLE001 - no backend: treat as host-only
+            _donation_supported = False
+    return _donation_supported
 
 
 def bucket_size(n: int) -> int:
@@ -72,7 +161,7 @@ def pad_column(data: np.ndarray, valid: np.ndarray, size: int):
 
 
 def device_put_chunk(chunk: Chunk, size: int | None = None,
-                     to_device: bool = True):
+                     to_device: bool = True, memo: bool = True):
     """-> (cols, dicts): cols is a list of (data, valid) per column, padded
     to a bucketed static size; varlen columns are dict-encoded and their
     dictionaries returned in `dicts[col_idx]` for host-side decode.
@@ -82,9 +171,13 @@ def device_put_chunk(chunk: Chunk, size: int | None = None,
     Device transfers are memoized on the chunk (keyed by padded size):
     chunks served repeatedly from the storage-side columnar cache keep
     their columns resident in HBM, so a hot analytical query pays zero
-    host->device bytes. Callers must treat chunks as immutable."""
+    host->device bytes. Callers must treat chunks as immutable.
+    memo=False skips the memo entirely — REQUIRED when the caller will
+    donate the transferred buffers to a kernel (a memoized donated
+    buffer would be read after free) or when the chunk is a transient
+    superchunk that no one will ever present again."""
     size = size or bucket_size(chunk.num_rows)
-    if to_device:
+    if to_device and memo:
         hit = dev_cache_get(chunk, size)
         if hit is not None:
             return hit
@@ -101,7 +194,8 @@ def device_put_chunk(chunk: Chunk, size: int | None = None,
         cols.append((data, valid))
     if to_device:
         cols = jax.device_put(cols)   # one batched transfer
-        dev_cache_put(chunk, size, (cols, dicts))
+        if memo:
+            dev_cache_put(chunk, size, (cols, dicts))
     return cols, dicts
 
 
@@ -113,18 +207,24 @@ _DEV_CACHE_SLOTS = 2
 
 def dev_cache_get(chunk, key):
     cache = getattr(chunk, "_dev_cache", None)
-    if isinstance(cache, dict):
-        return cache.get(key)
+    if isinstance(cache, OrderedDict):
+        hit = cache.get(key)
+        if hit is not None:
+            # true LRU: a hit refreshes the entry's position, so the
+            # entry that actually gets evicted is the LEAST recently
+            # used one, not merely the oldest inserted
+            cache.move_to_end(key)
+        return hit
     return None
 
 
 def dev_cache_put(chunk, key, value) -> None:
     cache = getattr(chunk, "_dev_cache", None)
-    if not isinstance(cache, dict):
-        cache = {}
+    if not isinstance(cache, OrderedDict):
+        cache = OrderedDict()
         chunk._dev_cache = cache
     while len(cache) >= _DEV_CACHE_SLOTS:
-        cache.pop(next(iter(cache)))
+        cache.popitem(last=False)
     cache[key] = value
 
 
@@ -143,3 +243,102 @@ def filter_mask_xp(xp, expr: Expression | None, cols, n):
         return xp.ones(n, dtype=bool)
     d, v = expr.eval_xp(xp, cols, n)
     return v & (d != 0)
+
+
+# -- plan fingerprints (executable-cache keys) -------------------------------
+
+
+class FingerprintCache:
+    """Thread-safe LRU keyed by plan fingerprint: ONE implementation for
+    every process-wide kernel cache (hashagg, streamagg), so the true-LRU
+    contract (a hit refreshes the entry) holds everywhere. Initialized
+    at module level by its owners — no lazy check-then-create races."""
+
+    def __init__(self, capacity: int = 64):
+        self._cap = capacity
+        self._d: OrderedDict = OrderedDict()
+        self._mu = threading.Lock()
+
+    def get_or_create(self, key, factory):
+        """Cached value for `key`, else factory() (called OUTSIDE the
+        lock — kernel construction may validate expressions; a racing
+        duplicate is discarded in favor of the first insert). factory
+        exceptions propagate without touching the cache."""
+        with self._mu:
+            hit = self._d.get(key)
+            if hit is not None:
+                self._d.move_to_end(key)
+                return hit
+        obj = factory()
+        with self._mu:
+            cur = self._d.setdefault(key, obj)
+            self._d.move_to_end(key)
+            while len(self._d) > self._cap:
+                old = next(iter(self._d))
+                if old == key:      # never evict the entry just touched
+                    break
+                self._d.pop(old)
+            return cur
+
+
+class _Unfingerprintable(Exception):
+    """Expression tree contains a node whose device behavior cannot be
+    captured structurally (correlated cells, unknown extensions)."""
+
+
+def _ft_fp(ft) -> str:
+    if ft is None:
+        return "?"
+    return (f"{ft.tp}:{getattr(ft, 'flen', 0)}:{getattr(ft, 'frac', 0)}:"
+            f"{int(bool(getattr(ft, 'is_ci', False)))}:"
+            f"{int(bool(getattr(ft, 'is_wide_decimal', False)))}")
+
+
+def _extra_fp(extra) -> str:
+    """ScalarFunc.extra carries eval-relevant payload (IN value lists,
+    LIKE patterns, cast target types) that MUST distinguish kernels."""
+    if extra is None:
+        return ""
+    if hasattr(extra, "tp"):          # a FieldType (cast target)
+        return _ft_fp(extra)
+    if isinstance(extra, (list, tuple)):
+        return repr([repr(x) for x in extra])
+    if isinstance(extra, (str, bytes, int, float, bool)):
+        return repr(extra)
+    # arbitrary payload (GENERIC handlers): no structural identity
+    raise _Unfingerprintable(type(extra).__name__)
+
+
+def _expr_fp(e) -> str:
+    from tidb_tpu.expression.core import ColumnRef, Constant, ScalarFunc
+    if e is None:
+        return "~"
+    ft = _ft_fp(getattr(e, "ft", None))
+    if isinstance(e, ColumnRef):
+        return f"c{e.idx}|{ft}"
+    if isinstance(e, Constant):
+        return f"k{e.value!r}|{ft}"
+    if isinstance(e, ScalarFunc):
+        args = ",".join(_expr_fp(a) for a in e.args)
+        return f"f{e.op.value}({args})|x{_extra_fp(e.extra)}|{ft}"
+    raise _Unfingerprintable(type(e).__name__)
+
+
+def plan_fingerprint(filter_expr, group_exprs, aggs) -> str | None:
+    """Structural identity of a pushed (filter, group-by, agg) subplan —
+    the process-wide executable-cache key. Two plans with the same
+    fingerprint trace to IDENTICAL device programs: the walk encodes
+    everything a kernel's eval_xp depends on (column indices, field
+    types incl. frac/collation, operator tree shape, literal values).
+    Returns None when any node falls outside the structural vocabulary
+    (then the caller builds an uncached kernel — correct, just slower on
+    a plan-cache miss)."""
+    try:
+        parts = [_expr_fp(filter_expr),
+                 ";".join(_expr_fp(g) for g in group_exprs)]
+        for a in aggs:
+            parts.append(f"{a.fn.value}|{int(bool(a.distinct))}|"
+                         f"{_expr_fp(a.arg)}|{a.sep!r}")
+        return "#".join(parts)
+    except _Unfingerprintable:
+        return None

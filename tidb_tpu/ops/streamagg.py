@@ -34,7 +34,7 @@ from tidb_tpu.ops.hashagg import (GroupResult, _agg_lanes, _key_bits,
                                   _validate_device_exprs,
                                   finalize_group_result)
 
-__all__ = ["SegmentAggKernel"]
+__all__ = ["SegmentAggKernel", "segment_kernel_for"]
 
 
 class SegmentAggKernel:
@@ -52,6 +52,7 @@ class SegmentAggKernel:
         self.aggs = list(aggs)
         _validate_device_exprs(None, self.group_exprs, self.aggs)
         self._jit = jax.jit(self._kernel)
+        self._jitd = None   # donating variant, built on first dispatch
 
     def _kernel(self, cols, nrows):
         xp = jnp
@@ -78,15 +79,41 @@ class SegmentAggKernel:
                  for a in self.aggs]
         return nseg, counts, rep, lanes
 
-    def __call__(self, chunk: Chunk) -> GroupResult:
-        cols, _dicts = runtime.device_put_chunk(chunk)
+    def dispatch(self, chunk: Chunk, donate: bool = False):
+        """Async half: pad + transfer + enqueue, no sync (see
+        HashAggKernel.dispatch for the donation contract)."""
+        donate = donate and runtime.donation_supported()
+        cols, _dicts = runtime.device_put_chunk(chunk, memo=not donate)
+        if donate:
+            if self._jitd is None:
+                self._jitd = jax.jit(self._kernel, donate_argnums=(0,))
+            return self._jitd(cols, chunk.num_rows)
+        return self._jit(cols, chunk.num_rows)
+
+    def finalize(self, chunk: Chunk, pending) -> GroupResult:
         # one batched device->host transfer (per-array reads pay full
-        # round-trip latency each; see HashAggKernel.__call__)
-        nseg, counts, rep, lanes = jax.device_get(
-            self._jit(cols, chunk.num_rows))
+        # round-trip latency each; see HashAggKernel.finalize)
+        nseg, counts, rep, lanes = jax.device_get(pending)
         nseg = int(nseg)
         gidx = np.arange(nseg)
         lanes_at = [[l[gidx] for l in ls] for ls in lanes]
         return finalize_group_result(chunk, self.group_exprs, self.aggs,
                                      gidx, rep[gidx], lanes_at,
                                      counts[gidx])
+
+    def __call__(self, chunk: Chunk) -> GroupResult:
+        return self.finalize(chunk, self.dispatch(chunk))
+
+
+# process-wide cache like ops/hashagg.kernel_for, keyed on the group/agg
+# fingerprint (segment kernels have no capacity axis); shares the same
+# thread-safe true-LRU implementation
+_SEG_KERNELS = runtime.FingerprintCache(64)
+
+
+def segment_kernel_for(group_exprs, aggs) -> SegmentAggKernel:
+    fp = runtime.plan_fingerprint(None, group_exprs, aggs)
+    if fp is None:
+        return SegmentAggKernel(group_exprs, aggs)
+    return _SEG_KERNELS.get_or_create(
+        fp, lambda: SegmentAggKernel(group_exprs, aggs))

@@ -30,7 +30,8 @@ import time
 
 __all__ = ["OpStats", "StatsCollector", "collecting", "current",
            "instrument", "device_call", "device_section", "fmt_ns",
-           "fmt_bytes"]
+           "fmt_bytes", "note_superchunk", "note_pipeline_stall",
+           "note_finalize_wait"]
 
 _tl = threading.local()
 
@@ -63,7 +64,9 @@ class OpStats:
     """One physical operator's actuals for one statement execution."""
 
     __slots__ = ("name", "act_rows", "loops", "time_ns",
-                 "device_time_ns", "device_peak_bytes", "cop_tasks")
+                 "device_time_ns", "device_peak_bytes", "cop_tasks",
+                 "superchunks", "coalesced_chunks", "superchunk_fill_rows",
+                 "superchunk_bucket_rows", "pipeline_stall_ns")
 
     def __init__(self, name: str):
         self.name = name
@@ -73,13 +76,31 @@ class OpStats:
         self.device_time_ns = 0    # sum around block_until_ready
         self.device_peak_bytes = 0  # backend watermark high-water mark
         self.cop_tasks = 0
+        # superchunk pipeline (ops/runtime.py): how the operator's device
+        # work was batched and how long the host sat blocked on readback
+        self.superchunks = 0            # coalesced device dispatches
+        self.coalesced_chunks = 0       # source chunks folded into them
+        self.superchunk_fill_rows = 0   # live rows across superchunks
+        self.superchunk_bucket_rows = 0  # padded bucket rows (>= fill)
+        self.pipeline_stall_ns = 0      # host blocked in finalize
+
+    def fill_ratio(self) -> float:
+        """Live rows over padded bucket rows (0.0 when no superchunks)."""
+        if not self.superchunk_bucket_rows:
+            return 0.0
+        return self.superchunk_fill_rows / self.superchunk_bucket_rows
 
     def to_dict(self) -> dict:
         return {"name": self.name, "act_rows": self.act_rows,
                 "loops": self.loops, "time_ns": self.time_ns,
                 "device_time_ns": self.device_time_ns,
                 "device_peak_bytes": self.device_peak_bytes,
-                "cop_tasks": self.cop_tasks}
+                "cop_tasks": self.cop_tasks,
+                "superchunks": self.superchunks,
+                "coalesced_chunks": self.coalesced_chunks,
+                "superchunk_fill_rows": self.superchunk_fill_rows,
+                "superchunk_bucket_rows": self.superchunk_bucket_rows,
+                "pipeline_stall_ns": self.pipeline_stall_ns}
 
 
 class StatsCollector:
@@ -130,6 +151,23 @@ class StatsCollector:
         with self._lock:
             st.cop_tasks += n
 
+    def note_superchunk(self, plan, rows: int, bucket: int,
+                        sources: int) -> None:
+        """One coalesced device dispatch: `sources` chunks folded into
+        `rows` live rows padded to a `bucket`-row shape. May arrive from
+        cop pool workers, hence the lock."""
+        st = self.node(plan)
+        with self._lock:
+            st.superchunks += 1
+            st.coalesced_chunks += sources
+            st.superchunk_fill_rows += rows
+            st.superchunk_bucket_rows += bucket
+
+    def note_pipeline_stall(self, plan, ns: int) -> None:
+        st = self.node(plan)
+        with self._lock:
+            st.pipeline_stall_ns += ns
+
     def ops(self) -> list[OpStats]:
         """Distinct OpStats (aliases deduped), insertion order."""
         sealed = getattr(self, "_sealed_ops", None)
@@ -166,6 +204,34 @@ def collecting(coll: StatsCollector | None):
 
 def current() -> StatsCollector | None:
     return getattr(_tl, "coll", None)
+
+
+def note_superchunk(plan, rows: int, bucket: int, sources: int) -> None:
+    """Record a coalesced dispatch against the active collector (no-op
+    without one) — the call-site form for executors and the cop handler."""
+    coll = getattr(_tl, "coll", None)
+    if coll is not None:
+        coll.note_superchunk(plan, rows, bucket, sources)
+
+
+def note_pipeline_stall(plan, ns: int) -> None:
+    coll = getattr(_tl, "coll", None)
+    if coll is not None:
+        coll.note_pipeline_stall(plan, ns)
+
+
+def note_finalize_wait(plan, ns: int) -> None:
+    """Blocked-readback time at a pipeline's output boundary: always
+    recorded as pipeline stall; with the device-profiling sysvar on it
+    doubles as the operator's device time (under dispatch overlap,
+    per-launch timing is meaningless — the honest number is the wait at
+    the boundary where the host actually needed the result)."""
+    coll = getattr(_tl, "coll", None)
+    if coll is None:
+        return
+    coll.note_pipeline_stall(plan, ns)
+    if coll.device:
+        coll.note_device(plan, ns)
 
 
 @contextlib.contextmanager

@@ -465,6 +465,22 @@ class Session:
                     metrics.histogram(metrics.OP_DEVICE_DURATIONS,
                                       s.device_time_ns / 1e9,
                                       {"op": s.name})
+                if s.superchunks:
+                    metrics.counter(metrics.SUPERCHUNKS, {"op": s.name},
+                                    inc=s.superchunks)
+                    metrics.counter(metrics.SUPERCHUNK_SOURCES,
+                                    {"op": s.name},
+                                    inc=s.coalesced_chunks)
+                    metrics.counter(metrics.SUPERCHUNK_FILL_ROWS,
+                                    {"op": s.name},
+                                    inc=s.superchunk_fill_rows)
+                    metrics.counter(metrics.SUPERCHUNK_BUCKET_ROWS,
+                                    {"op": s.name},
+                                    inc=s.superchunk_bucket_rows)
+                if s.pipeline_stall_ns:
+                    metrics.histogram(metrics.PIPELINE_STALLS,
+                                      s.pipeline_stall_ns / 1e9,
+                                      {"op": s.name})
             if trace_on:
                 trace.log_tree(root, sql)
             self.killed = False
@@ -508,6 +524,10 @@ class Session:
                 ln += f" device_time={rs.fmt_ns(s.device_time_ns)}"
             if s.cop_tasks:
                 ln += f" cop_tasks={s.cop_tasks}"
+            if s.superchunks:
+                ln += (f" superchunks={s.superchunks}"
+                       f" fill={s.fill_ratio():.2f}"
+                       f" stall={rs.fmt_ns(s.pipeline_stall_ns)}")
             lines.append(ln)
         lines.append("# SQL: " + sql[:2048])
         return "\n".join(lines)
@@ -2064,16 +2084,29 @@ class Session:
             est = "" if node.est_rows is None else f"{node.est_rows:.0f}"
             if st is None:
                 rows.append(("  " * depth + node.explain_line(), est,
-                             0, 0, "-", "-", "-", 0))
+                             0, 0, "-", "-", "-", 0, "-"))
                 continue
             rows.append((
                 "  " * depth + node.explain_line(), est,
                 st.act_rows, st.loops, rs.fmt_ns(st.time_ns),
                 rs.fmt_ns(st.device_time_ns) if device else "-",
                 rs.fmt_bytes(st.device_peak_bytes) if device else "-",
-                st.cop_tasks))
+                st.cop_tasks, _fmt_pipeline(st)))
         return ResultSet(["id", "est_rows", "act_rows", "loops", "time",
-                          "device_time", "mem", "cop_tasks"], rows)
+                          "device_time", "mem", "cop_tasks", "pipeline"],
+                         rows)
+
+
+def _fmt_pipeline(st) -> str:
+    """EXPLAIN ANALYZE `pipeline` cell: how the operator's device work
+    was coalesced (superchunks/source chunks), how full the padded
+    buckets were, and how long the host sat blocked on readback."""
+    from tidb_tpu import runtime_stats as rs
+    if not st.superchunks:
+        return "-"
+    return (f"{st.superchunks}sc/{st.coalesced_chunks}ch "
+            f"fill={st.fill_ratio():.2f} "
+            f"stall={rs.fmt_ns(st.pipeline_stall_ns)}")
 
 
 @dataclass

@@ -20,10 +20,9 @@ from tidb_tpu.kv import (CopRequest, CopResponse, KVRange, NotLeaderError,
                          RegionError, ReqType, ServerBusyError,
                          KeyLockedError)
 from tidb_tpu.mockstore.cluster import Region
-from tidb_tpu.ops.hashagg import (CapacityError, CollisionError,
-                                  HashAggKernel, ScalarAggKernel)
+from tidb_tpu.ops.hashagg import CapacityError, CollisionError
 from tidb_tpu.ops.hostagg import host_hash_agg, host_scalar_agg
-from tidb_tpu.ops.runtime import eval_filter_host
+from tidb_tpu.ops.runtime import bucket_size, eval_filter_host
 from tidb_tpu.plan.physical import CopPlan
 from tidb_tpu.store.backoff import (BO_REGION_MISS, BO_SERVER_BUSY,
                                     BO_TXN_LOCK, Backoffer, COP_MAX_BACKOFF)
@@ -67,14 +66,14 @@ def _plan_filter_memoizable(plan: CopPlan) -> bool:
 
 def _agg_kernels(plan: CopPlan):
     """Compiled kernel cached on the plan object (one jit program per
-    pushed subplan, reused across regions and chunks)."""
+    pushed subplan, reused across regions and chunks), resolved through
+    the process-wide fingerprint cache so a re-created plan (plan-cache
+    miss, new session) reuses the traced program instead of re-tracing."""
+    from tidb_tpu.ops.hashagg import kernel_for
     with _kernel_lock:
         k = getattr(plan, "_kernel", None)
         if k is None:
-            if plan.group_exprs:
-                k = HashAggKernel(plan.filter, plan.group_exprs, plan.aggs)
-            else:
-                k = ScalarAggKernel(plan.filter, plan.aggs)
+            k = kernel_for(plan.filter, plan.group_exprs, plan.aggs)
             plan._kernel = k
     return k
 
@@ -90,8 +89,10 @@ def decode_cop_batch(plan: CopPlan, batch):
                            with_handle_col=plan.handle_col)
 
 
-def exec_cop_plan(plan: CopPlan, chunk) -> CopResponse:
-    """Run the pushed subplan over one region's decoded chunk."""
+def exec_cop_plan(plan: CopPlan, chunk, sources: int = 1) -> CopResponse:
+    """Run the pushed subplan over one region's decoded chunk.
+    `sources` is how many storage scan batches were coalesced into
+    `chunk` (superchunk accounting for EXPLAIN ANALYZE / metrics)."""
     if plan.host_filter is not None:
         mask = eval_filter_host(plan.host_filter, chunk)
         chunk = chunk.filter(mask)
@@ -102,6 +103,13 @@ def exec_cop_plan(plan: CopPlan, chunk) -> CopResponse:
             try:
                 res = runtime_stats.device_call(plan, _agg_kernels(plan),
                                                 chunk)
+                if config.superchunk_rows():
+                    # attribution follows the feature switch: with
+                    # coalescing off this is plain per-batch dispatch,
+                    # not superchunk execution
+                    runtime_stats.note_superchunk(
+                        plan, chunk.num_rows,
+                        bucket_size(max(chunk.num_rows, 1)), sources)
                 return CopResponse(chunk=res)
             except (CapacityError, CollisionError, ValueError):
                 pass
@@ -214,20 +222,50 @@ def cop_handler(storage):
         out = []
         cur = s
         remaining = plan.limit
+        # agg subplans coalesce scan batches into ~superchunk_rows
+        # superchunks before the kernel sees them: one partial-agg
+        # dispatch per superchunk instead of per 64k-row scan batch.
+        # Non-agg plans keep the per-batch loop — the limit
+        # short-circuit below must stay chunk-at-a-time.
+        sc_limit = config.superchunk_rows() if plan.is_agg else 0
+        parts: list = []
+        acc = 0
+
+        def flush_parts() -> None:
+            nonlocal acc
+            from tidb_tpu.chunk import Chunk
+            if not parts:
+                return
+            big = Chunk.concat_all(parts)
+            n_src = len(parts)
+            parts.clear()
+            acc = 0
+            if big is not None:
+                out.append(exec_cop_plan(plan, big, sources=n_src))
+
         while True:
             batch = storage.engine.scan(cur, e, COP_SCAN_BATCH, req.start_ts,
                                         req.isolation, desc=False)
             if not batch:
                 break
-            resp = exec_cop_plan(plan, _decode(plan, batch))
-            out.append(resp)
-            if remaining is not None and not plan.is_agg:
-                remaining -= resp.chunk.num_rows
-                if remaining <= 0:
-                    break
+            if sc_limit:
+                dec = _decode(plan, batch)
+                parts.append(dec)
+                acc += dec.num_rows
+                if acc >= sc_limit:
+                    flush_parts()
+            else:
+                resp = exec_cop_plan(plan, _decode(plan, batch))
+                out.append(resp)
+                if remaining is not None and not plan.is_agg:
+                    remaining -= resp.chunk.num_rows
+                    if remaining <= 0:
+                        break
             if len(batch) < COP_SCAN_BATCH:
                 break
             cur = batch[-1][0] + b"\x00"
+        if sc_limit:
+            flush_parts()
         return out
 
     return handle

@@ -1,0 +1,106 @@
+"""Persistent XLA compilation cache: enablement + hit/miss accounting.
+
+First-compile of the big fused query programs costs tens of seconds (and
+through a chip tunnel, minutes — BENCH_r05 measured a 48.8s first-run
+stall on Q1). The persistent cache turns every later process's compiles
+into disk loads. One place owns the wiring so the package import, the
+server entrypoint and bench.py all agree on the directory and so the
+hit/miss counters (via jax.monitoring events) land in BENCH json.
+
+Directory resolution: the TIDB_TPU_COMPILE_CACHE environment variable,
+else ~/.cache/tidb_tpu_xla. "0" or empty disables.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+__all__ = ["enable", "default_dir", "stats", "reset_counters"]
+
+_lock = threading.Lock()
+_counts = {"hits": 0, "misses": 0}
+_listener_installed = False
+_enabled_dir: str | None = None
+
+
+def default_dir() -> str:
+    return os.environ.get(
+        "TIDB_TPU_COMPILE_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "tidb_tpu_xla"))
+
+
+def _install_listener() -> None:
+    """Count persistent-cache hits/misses from jax's monitoring events
+    ('/jax/compilation_cache/cache_hits' / 'cache_misses'). Must run
+    before the first compile; idempotent."""
+    global _listener_installed
+    if _listener_installed:
+        return
+    try:
+        from jax import monitoring
+    except Exception:  # noqa: BLE001 - no monitoring: counters stay 0
+        return
+
+    def _on_event(event: str, **_kw) -> None:
+        if not event.startswith("/jax/compilation_cache/"):
+            return
+        with _lock:
+            if event.endswith("cache_hits"):
+                _counts["hits"] += 1
+            elif event.endswith("cache_misses"):
+                _counts["misses"] += 1
+
+    try:
+        monitoring.register_event_listener(_on_event)
+        _listener_installed = True
+    except Exception:  # noqa: BLE001 - older jax without listeners
+        pass
+
+
+def enable(path: str | None = None,
+           min_compile_secs: float = 1.0) -> str | None:
+    """Point jax at the persistent compile cache and start counting
+    hits/misses. -> the active directory, or None when disabled."""
+    global _enabled_dir
+    path = default_dir() if path is None else path
+    if not path or path == "0":
+        return None
+    import jax
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(min_compile_secs))
+    except Exception:  # older jax without the knobs
+        return None
+    _install_listener()
+    _enabled_dir = path
+    return path
+
+
+def stats() -> dict:
+    """Snapshot for BENCH json / the status API: the configured
+    directory (None once disabled, e.g. the bench CPU fallback), how
+    many compiled executables it currently holds, and this process's
+    hit/miss counts."""
+    try:
+        import jax
+        cur = jax.config.jax_compilation_cache_dir
+    except Exception:  # noqa: BLE001
+        cur = _enabled_dir
+    entries = None
+    if cur:
+        try:
+            entries = sum(1 for f in os.listdir(cur)
+                          if not f.startswith("."))
+        except OSError:
+            entries = None
+    with _lock:
+        return {"dir": cur, "entries": entries,
+                "hits": _counts["hits"], "misses": _counts["misses"]}
+
+
+def reset_counters() -> None:
+    with _lock:
+        _counts["hits"] = 0
+        _counts["misses"] = 0
