@@ -390,9 +390,17 @@ def main() -> None:
         # iterations). Future rounds diff these totals to pin a
         # regression on the operator that caused it.
         config.set_var("tidb_tpu_runtime_stats_device", 1)
+        mem_host_peak = mem_device_peak = 0
         try:
             session.query(sql)
             coll = getattr(session, "_last_stats", None)
+            # per-query tracked memory peaks (memtrack statement root):
+            # future rounds correlate a rows/sec regression with the
+            # footprint move that caused it
+            mem = getattr(session, "_last_mem", None)
+            if mem is not None:
+                mem_host_peak = mem.host_peak
+                mem_device_peak = mem.device_peak
             if coll is not None:
                 # sum per operator NAME: Q3/Q5 plans hold several
                 # HashJoin/TableReader nodes and a dict comprehension
@@ -472,6 +480,8 @@ def main() -> None:
             "result_rows": len(d_rows),
             "op_device_time_ns": op_device,
             "op_stats": op_detail,
+            "peak_mem_host_bytes": mem_host_peak,
+            "peak_mem_device_bytes": mem_device_peak,
             "superchunk": {
                 "count": sc_count,
                 "coalesced_chunks": sc_src,

@@ -1,0 +1,156 @@
+"""Static memory-accounting invariant, enforced as a test (style of
+test_lint_metrics.py): every DATA-SIZED numpy allocation site in
+executor/ and ops/ — `np.empty` / `np.zeros` / `np.concatenate` whose
+size scales with input data — must either live inside a function
+registered in `memtrack.AUDITED_HELPERS` (its bytes are covered by
+tracker accounting, directly or through its caller) or carry an
+explicit `# memtrack: exempt <reason>` tag on its line or the line
+above. A new operator buffering rows without billing a tracker fails
+this lint instead of silently bypassing per-query accounting.
+
+Below-threshold sites are auto-exempt:
+- constant sizes <= 4096 elements (cannot scale with data; anything
+  larger must be audited even if constant),
+- bool masks (`dtype=bool`): 1 byte/row, an order of magnitude below
+  the column payloads the trackers bound.
+"""
+
+import ast
+import os
+
+from tidb_tpu import memtrack
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "tidb_tpu")
+SCAN_DIRS = ("executor", "ops")
+ALLOC_FNS = ("empty", "zeros", "concatenate")
+CONST_MAX = 4096
+EXEMPT_TAG = "# memtrack: exempt"
+
+
+def _files():
+    for d in SCAN_DIRS:
+        for root, _dirs, files in os.walk(os.path.join(PKG, d)):
+            if "__pycache__" in root:
+                continue
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    yield os.path.join(root, f)
+
+
+def _alloc_calls(tree):
+    """-> [(Call, enclosing qualname)] for np.empty/zeros/concatenate."""
+    out = []
+
+    def visit(node, qual):
+        for child in ast.iter_child_nodes(node):
+            q = qual
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                q = f"{qual}.{child.name}" if qual else child.name
+            visit(child, q)
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and fn.attr in ALLOC_FNS \
+                    and isinstance(fn.value, ast.Name) \
+                    and fn.value.id == "np":
+                out.append((node, qual))
+
+    visit(tree, "")
+    return out
+
+
+def _const_size(arg) -> int | None:
+    """Statically-known element count of a size argument, else None."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, int):
+        return arg.value
+    if isinstance(arg, (ast.Tuple, ast.List)):
+        prod = 1
+        for el in arg.elts:
+            if not (isinstance(el, ast.Constant) and
+                    isinstance(el.value, int)):
+                return None
+            prod *= el.value
+        return prod
+    return None
+
+
+def _is_bool_dtype(call) -> bool:
+    cands = [kw.value for kw in call.keywords if kw.arg == "dtype"]
+    if len(call.args) > 1:
+        cands.append(call.args[1])
+    return any(isinstance(c, ast.Name) and c.id == "bool" for c in cands)
+
+
+def _below_threshold(call) -> bool:
+    if not call.args:
+        return True                     # no size: nothing to bound
+    size = _const_size(call.args[0])
+    if size is not None and size <= CONST_MAX:
+        return True
+    return _is_bool_dtype(call)
+
+
+def _tagged(lines, lineno: int) -> bool:
+    for ln in (lineno, lineno - 1):
+        if 1 <= ln <= len(lines) and EXEMPT_TAG in lines[ln - 1]:
+            return True
+    return False
+
+
+def test_data_sized_allocations_are_accounted_or_exempt():
+    offenders = []
+    for path in _files():
+        rel = os.path.relpath(path, PKG)
+        with open(path) as f:
+            src = f.read()
+        lines = src.splitlines()
+        for call, qual in _alloc_calls(ast.parse(src, filename=path)):
+            if _below_threshold(call):
+                continue
+            if f"{rel}::{qual}" in memtrack.AUDITED_HELPERS:
+                continue
+            if _tagged(lines, call.lineno):
+                continue
+            offenders.append(
+                f"{rel}:{call.lineno} (in {qual or '<module>'}): "
+                f"data-sized np.{call.func.attr} outside an audited "
+                f"helper — bill a memtrack node or tag "
+                f"'{EXEMPT_TAG} <reason>'")
+    assert not offenders, "\n".join(offenders)
+
+
+def test_audited_helpers_still_exist():
+    """A stale registry entry would exempt nothing (renamed function
+    keeps allocating unaudited) — every entry must resolve."""
+    quals_by_file = {}
+    for path in _files():
+        rel = os.path.relpath(path, PKG)
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+        quals = set()
+
+        def collect(node, qual):
+            for child in ast.iter_child_nodes(node):
+                q = qual
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.ClassDef)):
+                    q = f"{qual}.{child.name}" if qual else child.name
+                    quals.add(q)
+                collect(child, q)
+
+        collect(tree, "")
+        quals_by_file[rel] = quals
+    for entry in memtrack.AUDITED_HELPERS:
+        rel, qual = entry.split("::")
+        assert rel in quals_by_file, entry
+        assert qual in quals_by_file[rel], entry
+
+
+def test_lint_is_not_vacuous():
+    """The scan must actually see the allocation sites it governs."""
+    hits = 0
+    for path in _files():
+        with open(path) as f:
+            hits += len(_alloc_calls(ast.parse(f.read(), filename=path)))
+    assert hits >= 30, hits

@@ -44,13 +44,14 @@ def _declared_constants():
 
 def _metric_calls(tree):
     """Call nodes of the form <anything>.counter(...) / .histogram(...)
-    where the receiver is the metrics module (imported as `metrics`)."""
+    / .gauge(...) where the receiver is the metrics module (imported as
+    `metrics`)."""
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call):
             continue
         fn = node.func
         if isinstance(fn, ast.Attribute) and \
-                fn.attr in ("counter", "histogram") and \
+                fn.attr in ("counter", "histogram", "gauge") and \
                 isinstance(fn.value, ast.Name) and \
                 fn.value.id == "metrics":
             yield node
@@ -91,8 +92,10 @@ def test_declared_names_follow_prometheus_conventions():
     for const, name in _declared_constants().items():
         assert name.startswith("tidb_tpu_"), (const, name)
         assert name == name.lower(), (const, name)
-        # counters end _total, timings end _seconds (Prometheus idiom)
-        assert name.endswith(("_total", "_seconds")), (const, name)
+        # counters end _total, timings end _seconds, byte gauges end
+        # _bytes (Prometheus idiom)
+        assert name.endswith(("_total", "_seconds", "_bytes")), \
+            (const, name)
 
 
 def test_call_sites_exist():

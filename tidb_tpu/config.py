@@ -26,7 +26,8 @@ __all__ = ["get_var", "set_var", "all_vars", "coerce", "session_overlay",
            "stream_rows", "superchunk_rows", "pipeline_depth",
            "copr_stream_enabled", "copr_stream_frame_bytes",
            "copr_stream_credit", "runtime_stats_enabled",
-           "runtime_stats_device", "UnknownVariableError"]
+           "runtime_stats_device", "mem_quota_query",
+           "UnknownVariableError"]
 
 
 class UnknownVariableError(Exception):
@@ -108,6 +109,11 @@ _DEFS: dict[str, tuple[str, int]] = {
     # emit every statement's span tree to the tidb_tpu.trace logger
     # (ref: the OpenTracing spans of session.go:692 / compiler.go:34)
     "tidb_tpu_trace_log": (_BOOL, 0),
+    # per-statement memory quota in bytes over BOTH tracker ledgers
+    # (host + device, memtrack.py; ref: the reference's mem-quota-query).
+    # 0 = unlimited. Crossing it fires the OOM-action chain: registered
+    # sort/agg spills first, then cancel with ER_MEM_EXCEED_QUOTA.
+    "tidb_tpu_mem_quota_query": (_INT, 0),
 }
 
 _lock = threading.Lock()
@@ -280,3 +286,7 @@ def runtime_stats_enabled() -> bool:
 
 def runtime_stats_device() -> bool:
     return bool(_read("tidb_tpu_runtime_stats_device"))
+
+
+def mem_quota_query() -> int:
+    return max(0, _read("tidb_tpu_mem_quota_query"))

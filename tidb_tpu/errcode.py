@@ -131,6 +131,11 @@ ER_REGION_STREAM_INTERRUPTED = 9007
 # verbatim replay risks applying it twice
 ER_RESULT_UNDETERMINED = 8501
 
+# per-statement memory quota exceeded with no spill action left
+# (memtrack.py; ref: the reference's "Out Of Memory Quota!" cancel in
+# its executor 8xxx range): the query was cancelled, the session lives
+ER_MEM_EXCEED_QUOTA = 8175
+
 # codes a client may retry verbatim after backoff (the reference's
 # terror retryable classes + lock waits/deadlocks)
 RETRYABLE = frozenset({
@@ -247,6 +252,7 @@ _SQLSTATE = {
     ER_GC_TOO_EARLY: "HY000",
     ER_REGION_STREAM_INTERRUPTED: "HY000",
     ER_RESULT_UNDETERMINED: "HY000",
+    ER_MEM_EXCEED_QUOTA: "HY000",
 }
 
 # message-shape fallbacks for SQLError strings raised deep in the stack
@@ -269,6 +275,9 @@ _PATTERNS = [
     (re.compile(r"parameter count|column count", re.I),
      ER_WRONG_VALUE_COUNT),
     (re.compile(r"cannot be null", re.I), ER_BAD_NULL_ERROR),
+    # memory quota before the generic "interrupted" net: the OOM cancel
+    # rides the cooperative-kill path but must keep its own code
+    (re.compile(r"Out Of Memory Quota", re.I), ER_MEM_EXCEED_QUOTA),
     (re.compile(r"interrupted", re.I), ER_QUERY_INTERRUPTED),
     (re.compile(r"Unknown thread id", re.I), ER_NO_SUCH_THREAD),
     (re.compile(r"incorrect value", re.I), ER_TRUNCATED_WRONG_VALUE),

@@ -14,7 +14,7 @@ import time
 
 import numpy as np
 
-from tidb_tpu import config, kv, runtime_stats, tablecodec
+from tidb_tpu import config, kv, memtrack, runtime_stats, tablecodec
 from tidb_tpu.chunk import Chunk, Column
 from tidb_tpu.expression import AggDesc, AggFunc, Expression
 from tidb_tpu.kv import CopRequest, KVRange, ReqType
@@ -410,13 +410,21 @@ class FinalAggExec(Executor):
             self.plan.aggs,
             [c.ft for c in
              self.plan.schema.cols[:self.plan.num_group_cols]])
-        for gr in self.reader.partials(ctx):
-            agg.update(gr)
-        results = agg.results()
-        if not self.plan.num_group_cols and not results:
-            results = [((), [_empty_agg_value(a) for a in self.plan.aggs])]
-        yield _agg_results_to_chunk(self.schema, self.plan.num_group_cols,
-                                    self.plan.aggs, results)
+        tracked = 0
+        try:
+            for gr in self.reader.partials(ctx):
+                agg.update(gr)
+                tracked = memtrack.track_to(self.plan,
+                                            agg.approx_bytes(), tracked)
+            results = agg.results()
+            if not self.plan.num_group_cols and not results:
+                results = [((), [_empty_agg_value(a)
+                                 for a in self.plan.aggs])]
+            yield _agg_results_to_chunk(self.schema,
+                                        self.plan.num_group_cols,
+                                        self.plan.aggs, results)
+        finally:
+            memtrack.release(self.plan, host=tracked)
 
 
 def _empty_agg_value(a: AggDesc):
@@ -439,30 +447,41 @@ class HashAggExec(Executor):
         agg = HashAggregator(self.plan.aggs, self.plan.group_exprs)
         distinct_ok = all(not a.distinct for a in self.plan.aggs)
         sc_rows = config.superchunk_rows()
-        if distinct_ok and config.device_enabled() and sc_rows:
-            # superchunk pipeline: child chunks coalesce into big padded
-            # batches and flow through the dispatch-ahead device queue;
-            # one partial-agg dispatch per superchunk, not per chunk
-            for gr in self._superchunk_partials(self.child.chunks(ctx)):
-                agg.update(gr)
-        else:
-            for chunk in self.child.chunks(ctx):
-                if chunk.num_rows == 0:
-                    continue
-                gr = None
-                if distinct_ok and config.device_enabled() and \
-                        chunk.num_rows >= config.device_min_rows():
-                    gr = self._device_partial(chunk)
-                if gr is None:
-                    gr = host_hash_agg(chunk, None, self.plan.group_exprs,
-                                       self.plan.aggs)
-                agg.update(gr)
-        results = agg.results()
-        if not self.plan.group_exprs and not results:
-            results = [((), [_empty_agg_value(a) for a in self.plan.aggs])]
-        num_g = len(self.plan.group_exprs)
-        yield _agg_results_to_chunk(self.schema, num_g, self.plan.aggs,
-                                    results)
+        tracked = 0
+        try:
+            if distinct_ok and config.device_enabled() and sc_rows:
+                # superchunk pipeline: child chunks coalesce into big
+                # padded batches and flow through the dispatch-ahead
+                # device queue; one partial-agg dispatch per superchunk
+                for gr in self._superchunk_partials(
+                        self.child.chunks(ctx)):
+                    agg.update(gr)
+                    tracked = memtrack.track_to(
+                        self.plan, agg.approx_bytes(), tracked)
+            else:
+                for chunk in self.child.chunks(ctx):
+                    if chunk.num_rows == 0:
+                        continue
+                    gr = None
+                    if distinct_ok and config.device_enabled() and \
+                            chunk.num_rows >= config.device_min_rows():
+                        gr = self._device_partial(chunk)
+                    if gr is None:
+                        gr = host_hash_agg(chunk, None,
+                                           self.plan.group_exprs,
+                                           self.plan.aggs)
+                    agg.update(gr)
+                    tracked = memtrack.track_to(
+                        self.plan, agg.approx_bytes(), tracked)
+            results = agg.results()
+            if not self.plan.group_exprs and not results:
+                results = [((), [_empty_agg_value(a)
+                                 for a in self.plan.aggs])]
+            num_g = len(self.plan.group_exprs)
+            yield _agg_results_to_chunk(self.schema, num_g,
+                                        self.plan.aggs, results)
+        finally:
+            memtrack.release(self.plan, host=tracked)
 
     def _set_kernel(self, kernel) -> None:
         self._kernel = kernel
@@ -491,15 +510,22 @@ class HashAggExec(Executor):
             if self._kernel is None:
                 self._set_kernel(kernel_for(
                     None, self.plan.group_exprs, self.plan.aggs))
-            return runtime_stats.device_call(
-                self.plan, self._kernel, chunk)
+            with memtrack.device_scope(
+                    self.plan, self._kernel.dispatch_nbytes(chunk)):
+                return runtime_stats.device_call(
+                    self.plan, self._kernel, chunk)
         except CapacityError as e:
             k = self._escalated_kernel(e)
             if k is not None:
-                try:
-                    return runtime_stats.device_call(self.plan, k, chunk)
-                except (CapacityError, CollisionError, ValueError):
-                    return None
+                # the retry kernel's (>=2x) scratch is the statement's
+                # LARGEST device allocation — it must not dodge the quota
+                with memtrack.device_scope(self.plan,
+                                           k.dispatch_nbytes(chunk)):
+                    try:
+                        return runtime_stats.device_call(
+                            self.plan, k, chunk)
+                    except (CapacityError, CollisionError, ValueError):
+                        return None
         except (CollisionError, ValueError):
             pass
         return None
@@ -522,15 +548,22 @@ class HashAggExec(Executor):
             except ValueError:
                 pass    # not device-safe: every superchunk goes host
 
+        mt_node = memtrack.op_node(plan)
+
         def dispatch(sc):
             k = self._kernel
             if k is None or sc.num_rows < min_rows:
                 return None      # host path at finalize
+            # device ledger: padded upload + group-table scratch, sized
+            # from shapes at dispatch; credited back at finalize
+            db = k.dispatch_nbytes(sc.chunk)
+            memtrack.consume(plan, device=db)
             try:
-                tok = (k, k.dispatch(sc.chunk, donate=True))
+                tok = (k, k.dispatch(sc.chunk, donate=True), db)
             except (ValueError, NotImplementedError):
                 # trace-time failure: this plan will never run on device
                 self._kernel = None
+                memtrack.release(plan, device=db)
                 return None
             runtime_stats.note_superchunk(plan, sc.num_rows, sc.bucket,
                                           sc.sources)
@@ -538,28 +571,34 @@ class HashAggExec(Executor):
 
         def finalize(sc, tok):
             if tok is not None:
-                k, fut = tok
+                k, fut, db = tok
                 t0 = time.perf_counter_ns()
                 try:
                     return k.finalize(sc.chunk, fut)
                 except CapacityError as e:
                     k2 = self._escalated_kernel(e)
                     if k2 is not None:
-                        try:
-                            return k2(sc.chunk)
-                        except (CapacityError, CollisionError, ValueError):
-                            pass
+                        with memtrack.device_scope(
+                                plan, k2.dispatch_nbytes(sc.chunk)):
+                            try:
+                                return k2(sc.chunk)
+                            except (CapacityError, CollisionError,
+                                    ValueError):
+                                pass
                 except (CollisionError, ValueError):
                     pass
                 finally:
+                    memtrack.release(plan, device=db)
                     runtime_stats.note_finalize_wait(
                         plan, time.perf_counter_ns() - t0)
             return host_hash_agg(sc.chunk, None, plan.group_exprs,
                                  plan.aggs)
 
         yield from op_runtime.pipeline_map(
-            op_runtime.superchunk_batches(chunks, config.superchunk_rows()),
-            dispatch, finalize, config.pipeline_depth())
+            op_runtime.superchunk_batches(chunks, config.superchunk_rows(),
+                                          tracker=mt_node),
+            dispatch, finalize, config.pipeline_depth(),
+            tracker=mt_node, cost=lambda sc: memtrack.chunk_bytes(sc.chunk))
 
 
 class StreamAggExec(Executor):
@@ -583,6 +622,7 @@ class StreamAggExec(Executor):
         use_device = (config.device_enabled() and
                       all(not a.distinct for a in self.plan.aggs))
         slice_rows = config.superchunk_rows() or self._SLICE
+        mt_node = memtrack.op_node(self.plan)
 
         def parts():
             """Ordered ~slice_rows Superchunks: key-adjacency (all the
@@ -594,20 +634,23 @@ class StreamAggExec(Executor):
                 # already key-ordered (pk scan / keep_order index): pure
                 # streaming, the whole input is never materialized
                 yield from op_runtime.superchunk_batches(
-                    self.child.chunks(ctx), slice_rows)
+                    self.child.chunks(ctx), slice_rows, tracker=mt_node)
                 return
             # needs its own ordering pass: the spill sorter keeps row
             # memory O(run + block) however large the input
-            # (executor/extsort.py), then yields globally ordered blocks
+            # (executor/extsort.py), then yields globally ordered blocks.
+            # The sorter bills this node and registers a quota spill
+            # action — over tidb_tpu_mem_quota_query it sheds its buffer
+            # to disk instead of cancelling the statement.
             from tidb_tpu.executor.extsort import SpillSorter
             by = [(g, False) for g in self.plan.group_exprs]
             sorter = SpillSorter(by, run_rows=config.sort_spill_rows(),
-                                 block_rows=slice_rows)
+                                 block_rows=slice_rows, tracker=mt_node)
             try:
                 for chunk in self.child.chunks(ctx):
                     sorter.add(chunk)
                 yield from op_runtime.superchunk_batches(
-                    sorter.sorted_chunks(), slice_rows)
+                    sorter.sorted_chunks(), slice_rows, tracker=mt_node)
             finally:
                 sorter.close()
 
@@ -622,8 +665,11 @@ class StreamAggExec(Executor):
                         self._kernel = segment_kernel_for(
                             self.plan.group_exprs, self.plan.aggs)
                         self.plan._root_kernel = self._kernel
-                    gr = runtime_stats.device_call(
-                        self.plan, self._kernel, part)
+                    with memtrack.device_scope(
+                            self.plan,
+                            self._kernel.dispatch_nbytes(part)):
+                        gr = runtime_stats.device_call(
+                            self.plan, self._kernel, part)
                 except (ValueError, NotImplementedError):
                     use_device = False
             if gr is None:
@@ -631,18 +677,27 @@ class StreamAggExec(Executor):
                                    self.plan.aggs)
             agg.update(gr)
 
-        if use_device and config.superchunk_rows():
-            for gr in self._pipelined_segments(parts()):
-                agg.update(gr)
-        else:
-            for sc in parts():
-                feed(sc.chunk)
-        results = agg.results()
-        if not self.plan.group_exprs and not results:
-            results = [((), [_empty_agg_value(a) for a in self.plan.aggs])]
-        yield _agg_results_to_chunk(self.schema,
-                                    len(self.plan.group_exprs),
-                                    self.plan.aggs, results)
+        tracked = 0
+        try:
+            if use_device and config.superchunk_rows():
+                for gr in self._pipelined_segments(parts()):
+                    agg.update(gr)
+                    tracked = memtrack.track_to(
+                        self.plan, agg.approx_bytes(), tracked)
+            else:
+                for sc in parts():
+                    feed(sc.chunk)
+                    tracked = memtrack.track_to(
+                        self.plan, agg.approx_bytes(), tracked)
+            results = agg.results()
+            if not self.plan.group_exprs and not results:
+                results = [((), [_empty_agg_value(a)
+                                 for a in self.plan.aggs])]
+            yield _agg_results_to_chunk(self.schema,
+                                        len(self.plan.group_exprs),
+                                        self.plan.aggs, results)
+        finally:
+            memtrack.release(self.plan, host=tracked)
 
     def _pipelined_segments(self, parts):
         """Segment-reduce each superchunk through the dispatch-ahead
@@ -661,14 +716,19 @@ class StreamAggExec(Executor):
             except (ValueError, NotImplementedError):
                 self._kernel = None
 
+        mt_node = memtrack.op_node(plan)
+
         def dispatch(sc):
             k = self._kernel
             if k is None or sc.num_rows < min_rows:
                 return None
+            db = k.dispatch_nbytes(sc.chunk)
+            memtrack.consume(plan, device=db)
             try:
-                tok = (k, k.dispatch(sc.chunk, donate=True))
+                tok = (k, k.dispatch(sc.chunk, donate=True), db)
             except (ValueError, NotImplementedError):
                 self._kernel = None
+                memtrack.release(plan, device=db)
                 return None
             runtime_stats.note_superchunk(plan, sc.num_rows, sc.bucket,
                                           sc.sources)
@@ -676,20 +736,22 @@ class StreamAggExec(Executor):
 
         def finalize(sc, tok):
             if tok is not None:
-                k, fut = tok
+                k, fut, db = tok
                 t0 = time.perf_counter_ns()
                 try:
                     return k.finalize(sc.chunk, fut)
                 except (ValueError, NotImplementedError):
                     self._kernel = None
                 finally:
+                    memtrack.release(plan, device=db)
                     runtime_stats.note_finalize_wait(
                         plan, time.perf_counter_ns() - t0)
             return host_hash_agg(sc.chunk, None, plan.group_exprs,
                                  plan.aggs)
 
-        yield from op_runtime.pipeline_map(parts, dispatch, finalize,
-                                           config.pipeline_depth())
+        yield from op_runtime.pipeline_map(
+            parts, dispatch, finalize, config.pipeline_depth(),
+            tracker=mt_node, cost=lambda sc: memtrack.chunk_bytes(sc.chunk))
 
 
 # ---------------------------------------------------------------------------
@@ -785,8 +847,12 @@ class SortExec(Executor):
 
     def chunks(self, ctx):
         from tidb_tpu.executor.extsort import SpillSorter
+        # the sorter bills this plan node and registers a quota spill
+        # action: crossing tidb_tpu_mem_quota_query sheds the buffered
+        # rows to disk (tracker drops) instead of cancelling
         sorter = SpillSorter(self.plan.by,
-                             run_rows=config.sort_spill_rows())
+                             run_rows=config.sort_spill_rows(),
+                             tracker=memtrack.op_node(self.plan))
         try:
             empty = None
             for chunk in self.child.chunks(ctx):
@@ -816,15 +882,22 @@ class TopNExec(Executor):
     def chunks(self, ctx):
         n = self.plan.count + self.plan.offset
         best = None
-        for chunk in self.child.chunks(ctx):
-            cand = chunk if best is None else best.concat(chunk)
-            if cand.num_rows > 0:
-                best = cand.take(_sort_order(self.plan.by, cand)[:n])
-            else:
-                best = cand
-        if best is None:
-            return
-        yield best.slice(min(self.plan.offset, best.num_rows), best.num_rows)
+        tracked = 0
+        try:
+            for chunk in self.child.chunks(ctx):
+                cand = chunk if best is None else best.concat(chunk)
+                if cand.num_rows > 0:
+                    best = cand.take(_sort_order(self.plan.by, cand)[:n])
+                else:
+                    best = cand
+                tracked = memtrack.track_to(
+                    self.plan, memtrack.chunk_bytes(best), tracked)
+            if best is None:
+                return
+            yield best.slice(min(self.plan.offset, best.num_rows),
+                             best.num_rows)
+        finally:
+            memtrack.release(self.plan, host=tracked)
 
 
 class HashJoinExec(Executor):
@@ -916,6 +989,17 @@ class HashJoinExec(Executor):
             return
         build = Chunk.concat_all(list(self.right.chunks(ctx)))
         nb = build.num_rows if build is not None else 0
+        # the materialized build side is the join's dominant host buffer:
+        # hold it on this node's ledger for the whole probe phase
+        tracked = memtrack.track_to(
+            self.plan, memtrack.chunk_bytes(build) if nb else 0)
+        try:
+            yield from self._probe_join(ctx, build, nb)
+        finally:
+            memtrack.release(self.plan, host=tracked)
+
+    def _probe_join(self, ctx, build, nb: int):
+        plan = self.plan
         enc = JoinKeyEncoder(len(plan.right_keys))
         bk = enc.fit_build(self._eval_keys(plan.right_keys, build)) \
             if nb else None
@@ -983,8 +1067,12 @@ class HashJoinExec(Executor):
                 elif config.device_enabled() and \
                         (n >= self._DEVICE_MIN_PROBE or
                          nb >= self._DEVICE_MIN_BUILD):
-                    li, ri = runtime_stats.device_call(
-                        self.plan, self._kernel, bk, pk, nb, n)
+                    with memtrack.device_scope(
+                            self.plan,
+                            self._kernel.build_nbytes(nb) +
+                            self._kernel.dispatch_nbytes(n)):
+                        li, ri = runtime_stats.device_call(
+                            self.plan, self._kernel, bk, pk, nb, n)
                 else:
                     # small inputs / device disabled: the same sort-join,
                     # vectorized in numpy (no jit dispatch, dynamic shapes)
@@ -1039,22 +1127,34 @@ class HashJoinExec(Executor):
         plan = self.plan
         kernel = self._kernel
         build_dev = None
+        build_db = 0
+        mt_node = memtrack.op_node(plan)
 
         def dispatch(sc):
-            nonlocal build_dev
+            nonlocal build_dev, build_db
             n = sc.num_rows
             pk = enc.transform_probe(
                 self._eval_keys(plan.left_keys, sc.chunk))
             if n < self._DEVICE_MIN_PROBE and nb < self._DEVICE_MIN_BUILD:
-                return ("host", host_match_pairs(bk, pk, nb, n))
+                return ("host", host_match_pairs(bk, pk, nb, n), 0)
             if build_dev is None:
+                # build lanes stay device-resident for the whole probe:
+                # held on the device ledger until the generator winds down
+                build_db = kernel.build_nbytes(nb)
+                memtrack.consume(plan, device=build_db)
                 build_dev = kernel.prepare_build(bk, nb)
+            db = kernel.dispatch_nbytes(n)
+            memtrack.consume(plan, device=db)
+            try:
+                tok = kernel.dispatch(bk, pk, nb, n, build_dev=build_dev)
+            except BaseException:
+                memtrack.release(plan, device=db)
+                raise
             runtime_stats.note_superchunk(plan, n, sc.bucket, sc.sources)
-            return ("dev", kernel.dispatch(bk, pk, nb, n,
-                                           build_dev=build_dev))
+            return ("dev", tok, db)
 
         def finalize(sc, tok):
-            kind, payload = tok
+            kind, payload, db = tok
             if kind == "host":
                 li, ri = payload
             else:
@@ -1062,16 +1162,24 @@ class HashJoinExec(Executor):
                 try:
                     li, ri = kernel.finalize(payload)
                 finally:
+                    memtrack.release(plan, device=db)
                     runtime_stats.note_finalize_wait(
                         plan, time.perf_counter_ns() - t0)
             return sc, li, ri
 
         sc_iter = op_runtime.superchunk_batches(probe_iter,
-                                                config.superchunk_rows())
-        for sc, li, ri in op_runtime.pipeline_map(
-                sc_iter, dispatch, finalize, config.pipeline_depth()):
-            yield from self._post_match(sc.chunk, build, li, ri,
-                                        matched_build)
+                                                config.superchunk_rows(),
+                                                tracker=mt_node)
+        try:
+            for sc, li, ri in op_runtime.pipeline_map(
+                    sc_iter, dispatch, finalize, config.pipeline_depth(),
+                    tracker=mt_node,
+                    cost=lambda sc: memtrack.chunk_bytes(sc.chunk)):
+                yield from self._post_match(sc.chunk, build, li, ri,
+                                            matched_build)
+        finally:
+            if build_db:
+                memtrack.release(plan, device=build_db)
 
     def _gather(self, left_chunk, build, li, ri):
         cols = [Column(c.ft, c.data[li], c.valid[li])
@@ -1115,10 +1223,20 @@ class HashJoinExec(Executor):
 
     def _cross_join(self, ctx):
         build = None
+        tracked = 0
         for chunk in self.right.chunks(ctx):
             build = chunk if build is None else build.concat(chunk)
+            tracked = memtrack.track_to(
+                self.plan, memtrack.chunk_bytes(build), tracked)
         if build is None or build.num_rows == 0:
+            memtrack.release(self.plan, host=tracked)
             return
+        try:
+            yield from self._cross_probe(ctx, build)
+        finally:
+            memtrack.release(self.plan, host=tracked)
+
+    def _cross_probe(self, ctx, build):
         nb = build.num_rows
         for chunk in self.left.chunks(ctx):
             nl = chunk.num_rows
@@ -1160,6 +1278,10 @@ class MergeJoinExec(HashJoinExec):
         right_iter = self.right.chunks(ctx)
         window: Chunk | None = None    # right rows that may still match
         right_done = False
+        # the sliding right window is this operator's only buffer; an
+        # abandoned generator's residue is credited back at statement
+        # detach (memtrack release-on-close)
+        tracked_w = 0
 
         def right_key(ch):
             d, v = self._eval_keys(plan.right_keys, ch)[0]
@@ -1183,6 +1305,9 @@ class MergeJoinExec(HashJoinExec):
                     right_done = True
                     break
                 window = nxt if window is None else window.concat(nxt)
+            tracked_w = memtrack.track_to(
+                plan, memtrack.chunk_bytes(window) if window is not None
+                else 0, tracked_w)
             if window is None or window.num_rows == 0:
                 li = ri = np.empty(0, np.int64)
                 unmatched = np.arange(n) if plan.join_type == "left" \
@@ -1224,6 +1349,9 @@ class MergeJoinExec(HashJoinExec):
                 keep = ~wv | (wd >= lmax)
                 if not keep.all():
                     window = window.filter(keep)
+                    tracked_w = memtrack.track_to(
+                        plan, memtrack.chunk_bytes(window), tracked_w)
+        memtrack.release(plan, host=tracked_w)
 
 
 def _empty_like_schema(schema) -> Chunk:
@@ -1346,6 +1474,7 @@ class IndexJoinExec(HashJoinExec):
 
     def chunks(self, ctx):
         plan = self.plan
+        tracked = 0
         for chunk in self.left.chunks(ctx):
             n = chunk.num_rows
             if n == 0:
@@ -1355,6 +1484,9 @@ class IndexJoinExec(HashJoinExec):
             vals = np.unique(kd[kv]) if kv.any() else kd[:0]
             build = self._fetch_inner(ctx, vals) if len(vals) else \
                 _empty_like_schema(plan.children[1].schema)
+            # per-outer-batch inner build: tracked to its replacement
+            tracked = memtrack.track_to(
+                plan, memtrack.chunk_bytes(build), tracked)
             nb = build.num_rows
             if nb == 0:
                 if plan.join_type == "left":
@@ -1366,8 +1498,11 @@ class IndexJoinExec(HashJoinExec):
             enc = JoinKeyEncoder(len(plan.right_keys))  # fresh per batch
             bk = enc.fit_build(self._eval_keys(plan.right_keys, build))
             pk = enc.transform_probe(self._eval_keys(plan.left_keys, chunk))
-            li, ri = runtime_stats.device_call(
-                self.plan, self._kernel, bk, pk, nb, n)
+            with memtrack.device_scope(
+                    self.plan, self._kernel.build_nbytes(nb) +
+                    self._kernel.dispatch_nbytes(n)):
+                li, ri = runtime_stats.device_call(
+                    self.plan, self._kernel, bk, pk, nb, n)
             pair = None
             if plan.other_cond is not None and len(li):
                 pair = self._gather(chunk, build, li, ri)
@@ -1382,6 +1517,7 @@ class IndexJoinExec(HashJoinExec):
             out = self._emit(chunk, build, li, ri, unmatched, pair=pair)
             if out is not None and out.num_rows:
                 yield out
+        memtrack.release(plan, host=tracked)
 
 
 def _index_datum(v, ft):
@@ -1790,6 +1926,7 @@ class ApplyExec(Executor):
                                dtype=dtype)
                 valid = np.full(n, ok, dtype=bool)
             else:
+                # memtrack: exempt - one scalar column per probe chunk
                 data = np.zeros(n, dtype=dtype) \
                     if dtype != np.dtype(object) else \
                     np.full(n, "", dtype=object)
@@ -1836,6 +1973,7 @@ class ApplyExec(Executor):
             valid.append(np.asarray(c.valid))
         if not vals:
             return (np.empty(0), np.empty(0, dtype=bool), has)
+        # memtrack: exempt - subquery first-column buffer, inner-bounded
         return np.concatenate(vals), np.concatenate(valid), has
 
     def _vector_predicate(self, left, n: int, vals, valid, has):

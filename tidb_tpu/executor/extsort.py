@@ -22,9 +22,11 @@ from __future__ import annotations
 
 import os
 import tempfile
+import threading
 
 import numpy as np
 
+from tidb_tpu import memtrack
 from tidb_tpu.chunk import Chunk, Column
 
 __all__ = ["SpillSorter"]
@@ -67,7 +69,8 @@ class SpillSorter:
     Key memory stays O(total keys); row memory stays O(run + block)."""
 
     def __init__(self, by, run_rows: int = 1 << 20,
-                 block_rows: int = 1 << 16, tmpdir: str | None = None):
+                 block_rows: int = 1 << 16, tmpdir: str | None = None,
+                 tracker=None):
         self.by = by                      # [(Expression, desc)]
         self.run_rows = run_rows
         self.block_rows = block_rows
@@ -81,18 +84,44 @@ class SpillSorter:
         # shared dictionaries for object columns (per column offset)
         self._dicts: dict[int, dict] = {}
         self._dict_vals: dict[int, list] = {}
+        # memory accounting: buffered full rows + resident key arrays.
+        # Spilling RELEASES the buffered-row bytes (they moved to disk)
+        # and keeps only the narrow keys — the tracker visibly drops, the
+        # whole point of a spill OOM action. The RLock serializes add()
+        # against a quota-triggered spill arriving from another thread's
+        # consume (a cop worker crossing the statement quota), and is
+        # re-entrant because add()'s own consume may fire the action on
+        # this very thread.
+        self._tracker = tracker
+        self._tracked_buf = 0
+        self._tracked_keys = 0
+        self._mu = threading.RLock()
+        self._unregister = memtrack.register_spill(self._quota_spill) \
+            if tracker is not None else (lambda: None)
 
     # -- build phase --------------------------------------------------------
 
     def add(self, chunk: Chunk) -> None:
         if chunk.num_rows == 0:
             return
-        if self._fts is None:
-            self._fts = [c.ft for c in chunk.columns]
-        self._buf.append(chunk)
-        self._nbuf += chunk.num_rows
-        if self._nbuf >= self.run_rows:
-            self._spill()
+        with self._mu:
+            if self._fts is None:
+                self._fts = [c.ft for c in chunk.columns]
+            self._buf.append(chunk)
+            self._nbuf += chunk.num_rows
+            if self._tracker is not None:
+                b = memtrack.chunk_bytes(chunk)
+                self._tracked_buf += b
+                self._tracker.consume(host=b)
+            if self._nbuf >= self.run_rows:
+                self._spill()
+
+    def _quota_spill(self) -> None:
+        """OOM spill action (memtrack quota chain): shed the buffered
+        rows to disk early. Re-armed — fires again on later episodes."""
+        with self._mu:
+            if self._nbuf:
+                self._spill()
 
     def _eval_keys(self, chunk: Chunk):
         out = []
@@ -125,12 +154,23 @@ class SpillSorter:
     def _spill(self) -> None:
         whole = Chunk.concat_all(self._buf)
         self._buf, self._nbuf = [], 0
+        if self._tracker is not None and self._tracked_buf:
+            # rows move to disk: credit the buffer back so the quota sees
+            # the spill actually freeing memory
+            self._tracker.release(host=self._tracked_buf)
+            self._tracked_buf = 0
         if whole is None or whole.num_rows == 0:
             return
         if self._tmp is None:
             self._tmp = tempfile.TemporaryDirectory(
                 prefix="tidbtpu-sort-", dir=self._tmpdir)
-        self._keys.append(self._eval_keys(whole))
+        keys = self._eval_keys(whole)
+        self._keys.append(keys)
+        if self._tracker is not None:
+            kb = sum((8 * len(d) if d.dtype == object else d.nbytes)
+                     + v.nbytes for d, v in keys)
+            self._tracked_keys += kb
+            self._tracker.consume(host=kb)
         rid = len(self._runs)
         dpaths, vpaths = [], []
         for j, col in enumerate(whole.columns):
@@ -153,8 +193,15 @@ class SpillSorter:
     def sorted_chunks(self):
         """Yield the accumulated rows in global sort order."""
         try:
-            tail = Chunk.concat_all(self._buf)
-            self._buf = []
+            with self._mu:
+                # drain the buffer ATOMICALLY against a quota spill from
+                # another thread's consume: once _nbuf is zero the spill
+                # action no-ops, so the tail can never be both spilled
+                # to a run and kept in memory (double rows), and
+                # _tracked_buf keeps covering the resident tail until
+                # close() releases it
+                tail = Chunk.concat_all(self._buf)
+                self._buf, self._nbuf = [], 0
             if not self._runs:
                 if tail is not None and tail.num_rows:
                     order = order_from_keys(
@@ -231,6 +278,12 @@ class SpillSorter:
             self.close()
 
     def close(self) -> None:
+        self._unregister()
+        if self._tracker is not None and \
+                (self._tracked_buf or self._tracked_keys):
+            self._tracker.release(
+                host=self._tracked_buf + self._tracked_keys)
+            self._tracked_buf = self._tracked_keys = 0
         if self._tmp is not None:
             self._tmp.cleanup()
             self._tmp = None

@@ -20,7 +20,7 @@ from collections import OrderedDict, deque
 import numpy as np
 
 from tidb_tpu import config as sysconf
-from tidb_tpu import runtime_stats
+from tidb_tpu import memtrack, runtime_stats
 from tidb_tpu.chunk import Chunk, Column
 from tidb_tpu.ops.hashagg import CapacityError, CollisionError, HashAggregator
 from tidb_tpu.ops.hostagg import host_hash_agg
@@ -177,7 +177,7 @@ class _MeshExecBase:
         return None
 
     def _stream_groups(self, superchunks, get_kernel, host_batch,
-                       agg: HashAggregator) -> None:
+                       agg: HashAggregator) -> int:
         """Streaming aggregation with dispatch-ahead: up to
         tidb_tpu_pipeline_depth superchunks' host→HBM transfers and
         kernel dispatches are issued (asynchronously) BEFORE the oldest
@@ -186,16 +186,30 @@ class _MeshExecBase:
         Per-batch recovery: capacity overflow re-plans the kernel and
         re-runs only that batch (group merging is associative —
         already-merged batches stay valid); collisions or non-device
-        expressions aggregate that batch on the host."""
+        expressions aggregate that batch on the host.
+
+        Memory: each in-flight launch holds its padded upload on the
+        plan node's DEVICE ledger until its readback, and the merged agg
+        state is tracked to the host ledger as it grows — so the mesh
+        path answers to tidb_tpu_mem_quota_query and EXPLAIN ANALYZE
+        `mem` like the single-chip pipeline. Returns the tracked state
+        bytes for the caller to release once the results are emitted."""
         _STREAM_STATS["streams"] += 1
         capacity = getattr(self.plan, "_mesh_capacity", DEFAULT_CAPACITY)
         depth = sysconf.pipeline_depth()
+        tracked = 0
         try:
             kernel = get_kernel(capacity)
         except (ValueError, BuildError):
             kernel = None
 
-        def finish(pkernel, outs, batch):
+        def merge(gr) -> None:
+            nonlocal tracked
+            agg.update(gr)
+            tracked = memtrack.track_to(self.plan, agg.approx_bytes(),
+                                        tracked)
+
+        def finish(pkernel, outs, batch, db):
             nonlocal kernel, capacity
             t0 = time.perf_counter_ns()
             try:
@@ -220,6 +234,8 @@ class _MeshExecBase:
             except (CollisionError, BuildError, ValueError):
                 pass
             finally:
+                if db:
+                    memtrack.release(self.plan, device=db)
                 # stall only (the enclosing device_section owns device
                 # time — adding it here too would double-count)
                 runtime_stats.note_pipeline_stall(
@@ -227,15 +243,18 @@ class _MeshExecBase:
             _STREAM_STATS["host_batches"] += 1
             return host_batch(batch)
 
-        pending: deque = deque()    # (kernel, in-flight outs, batch)
+        pending: deque = deque()  # (kernel, in-flight outs, batch, bytes)
         for sc in superchunks:
             batch = sc.chunk
             _STREAM_STATS["batches"] += 1
             _STREAM_STATS["max_batch_rows"] = max(
                 _STREAM_STATS["max_batch_rows"], batch.num_rows)
             outs = None
+            db = 0
             launch_kernel = kernel     # finish() may rebind `kernel` on a
             if launch_kernel is not None:   # capacity re-plan; outs must be
+                db = memtrack.device_put_bytes(batch)
+                memtrack.consume(self.plan, device=db)
                 try:                        # read back by their own kernel
                     outs = launch_kernel.launch(batch, bucket=True)
                     if pending:
@@ -245,21 +264,25 @@ class _MeshExecBase:
                         bucket_size(max(batch.num_rows, 1)), sc.sources)
                 except (ValueError, CollisionError, BuildError):
                     outs = None
+                if outs is None:
+                    memtrack.release(self.plan, device=db)
+                    db = 0
             if outs is not None:
-                pending.append((launch_kernel, outs, batch))
+                pending.append((launch_kernel, outs, batch, db))
                 while len(pending) > depth:
-                    agg.update(finish(*pending.popleft()))
+                    merge(finish(*pending.popleft()))
             else:
                 # host batches are synchronous: drain in-flight work
                 # first so results keep arriving in input order
                 while pending:
-                    agg.update(finish(*pending.popleft()))
+                    merge(finish(*pending.popleft()))
                 _STREAM_STATS["host_batches"] += 1
-                agg.update(host_batch(batch))
+                merge(host_batch(batch))
         while pending:
-            agg.update(finish(*pending.popleft()))
+            merge(finish(*pending.popleft()))
         if kernel is not None:
             self.plan._mesh_capacity = capacity
+        return tracked
 
     def _buffer_probe(self, it, limit):
         """Pull chunks until the probe proves larger than `limit`.
@@ -306,24 +329,34 @@ class MeshAggExec(_MeshExecBase):
                 return k
 
             agg = HashAggregator(plan.aggs, plan.group_exprs)
-            # mesh pipelines overlap async launches, so the device time
-            # is the whole streaming region's wall (ends on readback)
-            with runtime_stats.device_section(plan):
-                self._stream_groups(
-                    superchunk_batches(itertools.chain(parts, it), limit),
-                    get_kernel,
-                    lambda b: host_hash_agg(b, plan.filter_expr,
-                                            plan.group_exprs, plan.aggs),
-                    agg)
-            yield _emit_agg(plan, agg, ex)
+            tracked = 0
+            try:
+                # mesh pipelines overlap async launches, so the device
+                # time is the whole streaming region's wall (readback)
+                with runtime_stats.device_section(plan):
+                    tracked = self._stream_groups(
+                        superchunk_batches(itertools.chain(parts, it),
+                                           limit,
+                                           tracker=memtrack.op_node(plan)),
+                        get_kernel,
+                        lambda b: host_hash_agg(b, plan.filter_expr,
+                                                plan.group_exprs,
+                                                plan.aggs),
+                        agg)
+                yield _emit_agg(plan, agg, ex)
+            finally:
+                memtrack.release(plan, host=tracked)
             return
 
         # small probe: whole-table path, memoized so hot re-executions of
-        # a cached plan transfer zero bytes
+        # a cached plan transfer zero bytes (the resident copy belongs to
+        # the memo; the transfer watermark below is this query's charge)
         big = _concat_chunks_cached(plan, "_probe_cache", parts, schema)
         gr = None
         if big.num_rows:
-            with runtime_stats.device_section(plan):
+            with runtime_stats.device_section(plan), \
+                    memtrack.device_scope(plan,
+                                          memtrack.device_put_bytes(big)):
                 gr = self._run_with_escalation(make, lambda k: k(big))
             if gr is None:
                 yield from self._fallback(ctx)
@@ -396,22 +429,31 @@ class MeshLookupAggExec(_MeshExecBase):
                 return refresh(k)
 
             agg = HashAggregator(plan.aggs, plan.group_exprs)
-            with runtime_stats.device_section(plan):
-                self._stream_groups(
-                    superchunk_batches(itertools.chain(parts, it), limit),
-                    get_kernel,
-                    lambda b: host_lookup_agg(b, plan.filter_expr, specs,
-                                              plan.group_exprs, plan.aggs,
-                                              builds=builds),
-                    agg)
-            yield _emit_agg(plan, agg, ex)
+            tracked = 0
+            try:
+                with runtime_stats.device_section(plan):
+                    tracked = self._stream_groups(
+                        superchunk_batches(itertools.chain(parts, it),
+                                           limit,
+                                           tracker=memtrack.op_node(plan)),
+                        get_kernel,
+                        lambda b: host_lookup_agg(b, plan.filter_expr,
+                                                  specs, plan.group_exprs,
+                                                  plan.aggs,
+                                                  builds=builds),
+                        agg)
+                yield _emit_agg(plan, agg, ex)
+            finally:
+                memtrack.release(plan, host=tracked)
             return
 
         probe = _concat_chunks_cached(plan, "_probe_cache", parts,
                                       plan.children[0].schema)
         gr = None
         if probe.num_rows:
-            with runtime_stats.device_section(plan):
+            with runtime_stats.device_section(plan), \
+                    memtrack.device_scope(plan,
+                                          memtrack.device_put_bytes(probe)):
                 gr = self._run_with_escalation(
                     make, lambda kernel: refresh(kernel)(probe))
             if gr is None:

@@ -14,18 +14,20 @@ from __future__ import annotations
 
 import threading
 
-__all__ = ["counter", "histogram", "expose", "snapshot",
+__all__ = ["counter", "histogram", "gauge", "expose", "snapshot",
            "QUERY_DURATIONS", "QUERIES_TOTAL", "SLOW_QUERIES",
            "CONNECTIONS", "COP_TASKS", "QUERY_ERRORS",
            "COP_STREAM_FRAMES", "COP_STREAM_BYTES",
            "COP_STREAM_CREDIT_STALLS", "COP_STREAM_RESUMES",
            "OP_DURATIONS", "OP_ROWS", "OP_DEVICE_DURATIONS",
            "SUPERCHUNKS", "SUPERCHUNK_SOURCES", "SUPERCHUNK_FILL_ROWS",
-           "SUPERCHUNK_BUCKET_ROWS", "PIPELINE_STALLS"]
+           "SUPERCHUNK_BUCKET_ROWS", "PIPELINE_STALLS",
+           "QUERY_MEM", "MEM_QUOTA_EXCEEDED", "DEVICE_PEAK"]
 
 _lock = threading.Lock()
 _counters: dict[tuple[str, tuple], float] = {}
 _histograms: dict[tuple[str, tuple], "_Hist"] = {}
+_gauges: dict[tuple[str, tuple], float] = {}
 
 _BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0)
 
@@ -77,6 +79,13 @@ def histogram(name: str, value: float, labels: dict | None = None) -> None:
         h.observe(value)
 
 
+def gauge(name: str, value: float, labels: dict | None = None) -> None:
+    """Set a gauge series to its current value (last write wins)."""
+    key = (name, _label_key(labels))
+    with _lock:
+        _gauges[key] = float(value)
+
+
 def snapshot() -> dict:
     """Plain dict of counter/histogram values (tests / status JSON).
     Unlabeled series keep the historical flat keys (name, name_count,
@@ -84,6 +93,8 @@ def snapshot() -> dict:
     with _lock:
         out = {}
         for (name, labels), v in _counters.items():
+            out[name + _label_str(labels)] = v
+        for (name, labels), v in _gauges.items():
             out[name + _label_str(labels)] = v
         for (name, labels), h in _histograms.items():
             lbl = _label_str(labels)
@@ -108,6 +119,9 @@ def expose() -> str:
 
         for (name, labels), v in sorted(_counters.items()):
             meta(name, "counter")
+            lines.append(f"{name}{_label_str(labels)} {v}")
+        for (name, labels), v in sorted(_gauges.items()):
+            meta(name, "gauge")
             lines.append(f"{name}{_label_str(labels)} {v}")
         for (name, labels), h in sorted(_histograms.items()):
             meta(name, "histogram")
@@ -150,6 +164,14 @@ SUPERCHUNK_SOURCES = "tidb_tpu_superchunk_source_chunks_total"
 SUPERCHUNK_FILL_ROWS = "tidb_tpu_superchunk_fill_rows_total"
 SUPERCHUNK_BUCKET_ROWS = "tidb_tpu_superchunk_bucket_rows_total"
 PIPELINE_STALLS = "tidb_tpu_pipeline_stall_seconds"
+# hierarchical memory tracking (memtrack.py): per-statement peak bytes
+# (gauge, last statement's peak, labeled kind=host|device), quota
+# OOM-action firings (counter, labeled action=spill|cancel), and the
+# process-wide backend allocator watermark kept ONLY as a server-root
+# gauge — per-op mem comes from the trackers, never the watermark
+QUERY_MEM = "tidb_tpu_query_mem_bytes"
+MEM_QUOTA_EXCEEDED = "tidb_tpu_mem_quota_exceeded_total"
+DEVICE_PEAK = "tidb_tpu_device_peak_bytes"
 
 _HELP = {
     QUERY_DURATIONS: "Statement wall time through Session.execute.",
@@ -176,4 +198,10 @@ _HELP = {
         "Padded bucket rows dispatched for superchunks, by op.",
     PIPELINE_STALLS:
         "Per-operator host time blocked on device readback, by op.",
+    QUERY_MEM:
+        "Last statement's peak tracked bytes, by ledger kind.",
+    MEM_QUOTA_EXCEEDED:
+        "Quota OOM-action firings, by action (spill|cancel).",
+    DEVICE_PEAK:
+        "Backend allocator peak-bytes watermark (process-wide).",
 }

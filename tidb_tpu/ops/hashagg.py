@@ -529,6 +529,18 @@ class HashAggKernel:
                  for assemble in assembles]
         return uniq, nuniq, collided, counts, rep, lanes
 
+    def dispatch_nbytes(self, chunk: Chunk) -> int:
+        """HBM bytes one dispatch stages, sized purely from shapes at
+        dispatch time: the padded input columns (varlen ships as int64
+        dict codes, every lane carries bool validity) plus the
+        group-table and lane scratch at the kernel's static capacity.
+        Executors charge this to the plan node's device ledger before
+        dispatch and credit it back at finalize."""
+        from tidb_tpu import memtrack
+        n = runtime.bucket_size(max(chunk.num_rows, 1))
+        scratch = self.capacity * 8 * (5 + 2 * len(self.aggs))
+        return memtrack.device_put_bytes(chunk, n) + scratch
+
     def dispatch(self, chunk: Chunk, donate: bool = False):
         """Pad + transfer + enqueue the program WITHOUT forcing a sync
         (jax dispatch is async): the pipeline's overlap point. With
@@ -591,6 +603,12 @@ class ScalarAggKernel:
         lanes = [[l for l, _op in _agg_lanes(xp, a, cols, n, mask, inv, 1)]
                  for a in self.aggs]
         return count, lanes
+
+    def dispatch_nbytes(self, chunk: Chunk) -> int:
+        """See HashAggKernel.dispatch_nbytes (one state row, no table)."""
+        from tidb_tpu import memtrack
+        n = runtime.bucket_size(max(chunk.num_rows, 1))
+        return memtrack.device_put_bytes(chunk, n) + 16 * len(self.aggs)
 
     def dispatch(self, chunk: Chunk, donate: bool = False):
         """Async half; see HashAggKernel.dispatch."""
@@ -675,6 +693,20 @@ class HashAggregator:
         from tidb_tpu.sqltypes import collation_key
         return tuple(collation_key(x) if c and x is not None else x
                      for x, c in zip(key, self._ci))
+
+    def approx_bytes(self) -> int:
+        """Rough host footprint of the merged state — dict slots, key
+        tuples and per-agg lane scalars at CPython object costs. This is
+        the number memtrack bounds under tidb_tpu_mem_quota_query: it
+        scales with the live GROUP COUNT (the quantity that actually
+        grows without bound on a runaway aggregation), not the input."""
+        n = len(self._state)
+        if n == 0:
+            return 0
+        st = next(iter(self._state.values()))
+        lanes = sum(len(ls) for ls in st)
+        key = next(iter(self._orig.values()))
+        return n * (96 + 56 * len(key) + 48 * lanes)
 
     def update(self, res: GroupResult) -> None:
         for gi, key in enumerate(res.keys):

@@ -228,6 +228,21 @@ class JoinKernel:
     def __init__(self, num_keys: int):
         self.num_keys = num_keys
 
+    def build_nbytes(self, nb: int) -> int:
+        """HBM bytes prepare_build stages: one padded int64/float64 data
+        lane + bool validity per key — the device-resident build side a
+        pipelined probe keeps for its whole lifetime."""
+        return self.num_keys * 9 * runtime.bucket_size(max(nb, 1))
+
+    def dispatch_nbytes(self, np_: int, out_cap: int | None = None) -> int:
+        """HBM bytes one probe dispatch stages, from shapes alone: the
+        padded probe key lanes plus the static-capacity pair buffers
+        (li/ri int64 + ok bool). Charged to the plan node's device
+        ledger at dispatch, credited back at finalize."""
+        cap = out_cap or runtime.bucket_size(max(np_ * 2, 1024))
+        return self.num_keys * 9 * runtime.bucket_size(max(np_, 1)) \
+            + cap * 17
+
     def prepare_build(self, build_keys, nb: int):
         """Pad + transfer the build-side key lanes once; the returned
         device lanes feed every probe superchunk's dispatch (per-probe
@@ -253,19 +268,36 @@ class JoinKernel:
 
     def finalize(self, p: _PendingJoin):
         """Blocking half: read back the pair list, growing the output
-        capacity (device lanes reused) until it fits."""
-        while True:
-            li, ri, ok, total = p.res
-            # scalar first: an overflow retry then discards the cap-sized
-            # index buffers without ever transferring them; the success
-            # path batches the three arrays into one device_get (per-array
-            # reads each pay full round-trip latency through the tunnel)
-            total = int(jax.device_get(total))
-            if total <= p.cap:
-                break
-            p.cap = runtime.bucket_size(total)
-            p.res = _matcher_program(p.cap)(p.bk, p.pk, p.nb, p.np_)
-        li, ri, ok = jax.device_get((li, ri, ok))
+        capacity (device lanes reused) until it fits. Capacity growth is
+        billed to the ACTIVE statement's memory root (device ledger):
+        the regrown li/ri/ok buffers on a many-to-many join are the
+        join's largest HBM allocation, and the quota must see them even
+        though no plan handle reaches this layer."""
+        from tidb_tpu import memtrack
+        root = memtrack.current()
+        extra = 0
+        try:
+            while True:
+                li, ri, ok, total = p.res
+                # scalar first: an overflow retry then discards the
+                # cap-sized index buffers without ever transferring them;
+                # the success path batches the three arrays into one
+                # device_get (per-array reads each pay full round-trip
+                # latency through the tunnel)
+                total = int(jax.device_get(total))
+                if total <= p.cap:
+                    break
+                new_cap = runtime.bucket_size(total)
+                if root is not None:
+                    grow = (new_cap - p.cap) * 17    # li+ri int64, ok bool
+                    extra += grow    # before consume: it may raise
+                    root.consume(device=grow)
+                p.cap = new_cap
+                p.res = _matcher_program(p.cap)(p.bk, p.pk, p.nb, p.np_)
+            li, ri, ok = jax.device_get((li, ri, ok))
+        finally:
+            if root is not None and extra:
+                root.release(device=extra)
         sel = np.flatnonzero(ok)
         return li[sel], ri[sel]
 

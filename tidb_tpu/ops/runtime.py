@@ -58,16 +58,31 @@ class Superchunk:
         return self.chunk.num_rows / self.bucket
 
 
-def superchunk_batches(chunks, limit: int):
+def superchunk_batches(chunks, limit: int, tracker=None):
     """Coalesce a chunk stream into ~limit-row Superchunks: device
     dispatches stay large while host memory stays O(limit) — the
     TPU-sized form of the reference's bounded chunk channels
     (distsql/distsql.go:92). Oversize chunks are sliced so one storage
     chunk cannot break the memory bound; 0-row chunks fold away.
     A `limit` that is a power of two keeps every full superchunk on ONE
-    bucket shape; only the tail pays a smaller power-of-two bucket."""
+    bucket shape; only the tail pays a smaller power-of-two bucket.
+
+    `tracker` (a memtrack.MemTracker) accounts the staging buffer: bytes
+    are held while chunks sit in the assembly buffer and credited back
+    when the superchunk is yielded — ownership passes to the consumer
+    (pipeline_map's in-flight slots pick it up from there)."""
+    from tidb_tpu import memtrack
     limit = max(int(limit), 1)    # a 0/negative sysvar must not hang
-    buf, total, srcs = [], 0, 0
+    buf, total, srcs, staged = [], 0, 0, 0
+
+    def emit():
+        nonlocal staged
+        big = Chunk.concat_all(buf)
+        if tracker is not None and staged:
+            tracker.release(host=staged)
+            staged = 0
+        return Superchunk(big, srcs) if big is not None else None
+
     for c in chunks:
         if c.num_rows == 0:
             continue
@@ -78,17 +93,21 @@ def superchunk_batches(chunks, limit: int):
             piece = c if (start == 0 and take == c.num_rows) \
                 else c.slice(start, start + take)
             buf.append(piece)
+            if tracker is not None:
+                b = memtrack.chunk_bytes(piece)
+                tracker.consume(host=b)
+                staged += b
             total += take
             start += take
             if total >= limit:
-                big = Chunk.concat_all(buf)
-                if big is not None:
-                    yield Superchunk(big, srcs)
+                sc = emit()
+                if sc is not None:
+                    yield sc
                 buf, total, srcs = [], 0, 1 if start < c.num_rows else 0
     if buf:
-        big = Chunk.concat_all(buf)
-        if big is not None:
-            yield Superchunk(big, srcs)
+        sc = emit()
+        if sc is not None:
+            yield sc
 
 
 def super_batches(first_parts, rest, limit: int):
@@ -99,7 +118,8 @@ def super_batches(first_parts, rest, limit: int):
         yield sc.chunk
 
 
-def pipeline_map(items, dispatch, finalize, depth: int):
+def pipeline_map(items, dispatch, finalize, depth: int,
+                 tracker=None, cost=None):
     """Depth-N dispatch-ahead map over an item stream: up to `depth`
     dispatched items are in flight before the oldest is finalized, so
     item k+1's host-side prep (padding, dict-encode, device_put) and its
@@ -111,18 +131,38 @@ def pipeline_map(items, dispatch, finalize, depth: int):
     one blocking point (device_get at the operator output boundary);
     callers that want stall attribution time their device readback
     inside finalize (runtime_stats.note_finalize_wait), where they can
-    tell device tokens from host-fallback ones."""
+    tell device tokens from host-fallback ones.
+
+    With `tracker`/`cost` set, each in-flight slot holds cost(item) host
+    bytes from dispatch until its finalize returns — the depth-N window
+    is exactly the memory the pipeline pins beyond one batch."""
     depth = max(int(depth), 1)
     pending: deque = deque()
+    track = tracker is not None and cost is not None
+
+    def pop_finalize():
+        prev, tok, held = pending.popleft()
+        try:
+            return finalize(prev, tok)
+        finally:
+            if held:
+                tracker.release(host=held)
 
     for it in items:
         while len(pending) >= depth:
-            prev, tok = pending.popleft()
-            yield finalize(prev, tok)
-        pending.append((it, dispatch(it)))
+            yield pop_finalize()
+        held = cost(it) if track else 0
+        if held:
+            tracker.consume(host=held)
+        try:
+            tok = dispatch(it)
+        except BaseException:
+            if held:
+                tracker.release(host=held)
+            raise
+        pending.append((it, tok, held))
     while pending:
-        prev, tok = pending.popleft()
-        yield finalize(prev, tok)
+        yield pop_finalize()
 
 
 _donation_supported: bool | None = None

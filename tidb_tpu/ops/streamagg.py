@@ -79,6 +79,15 @@ class SegmentAggKernel:
                  for a in self.aggs]
         return nseg, counts, rep, lanes
 
+    def dispatch_nbytes(self, chunk: Chunk) -> int:
+        """HBM bytes one dispatch stages, from shapes at dispatch time:
+        padded input columns plus the segment-id/count/lane scratch
+        (num_segments = padded rows, the no-capacity-limit trade)."""
+        from tidb_tpu import memtrack
+        n = runtime.bucket_size(max(chunk.num_rows, 1))
+        scratch = n * 8 * (3 + 2 * len(self.aggs))
+        return memtrack.device_put_bytes(chunk, n) + scratch
+
     def dispatch(self, chunk: Chunk, donate: bool = False):
         """Async half: pad + transfer + enqueue, no sync (see
         HashAggKernel.dispatch for the donation contract)."""

@@ -146,6 +146,7 @@ def sql_digest(sql: str) -> tuple[str, str]:
 def digest_record(sql: str, dur_ns: int, phases: dict | None = None,
                   rows: int = 0, error: str | None = None,
                   op_stats: list[dict] | None = None,
+                  mem_bytes: int = 0,
                   tag: str | None = None) -> tuple[str, str]:
     """Fold one finished statement into its digest's summary row.
     -> (digest, normalized text) so callers (slow log) can reuse them.
@@ -167,6 +168,7 @@ def digest_record(sql: str, dur_ns: int, phases: dict | None = None,
                 "max_latency_ns": 0, "min_latency_ns": None,
                 "sum_parse_ns": 0, "sum_plan_ns": 0, "sum_exec_ns": 0,
                 "sum_commit_ns": 0, "sum_rows": 0, "sum_errors": 0,
+                "max_mem_bytes": 0,   # peak tracked bytes (memtrack)
                 "first_seen": now, "last_seen": now,
                 "ops": {},      # op name -> {time_ns, act_rows, device}
             }
@@ -182,6 +184,8 @@ def digest_record(sql: str, dur_ns: int, phases: dict | None = None,
         rec["sum_rows"] += rows
         if error:
             rec["sum_errors"] += 1
+        if mem_bytes > rec.get("max_mem_bytes", 0):
+            rec["max_mem_bytes"] = mem_bytes
         rec["last_seen"] = now
         for op in op_stats or ():
             agg = rec["ops"].setdefault(
@@ -234,6 +238,7 @@ def digest_summary() -> list[dict]:
             "sum_exec_ns": r["sum_exec_ns"],
             "sum_commit_ns": r["sum_commit_ns"],
             "sum_rows": r["sum_rows"], "sum_errors": r["sum_errors"],
+            "max_mem_bytes": r.get("max_mem_bytes", 0),
             "first_seen": r["first_seen"], "last_seen": r["last_seen"],
             "top_operators": _hot_ops(r),
         })

@@ -194,7 +194,7 @@ class Planner:
     # -- INFORMATION_SCHEMA virtual tables (ref: infoschema/tables.go) -------
 
     _MEMTABLES = ("schemata", "tables", "columns", "statistics",
-                  "character_sets", "collations")
+                  "character_sets", "collations", "memory_usage")
 
     def _build_memtable(self, ts: ast.TableSource) -> ph.PhysValues:
         """Serve catalog metadata as constant rows computed from the
@@ -275,6 +275,29 @@ class Planner:
             return mk([("character_set_name", sf),
                        ("default_collate_name", sf),
                        ("description", sf), ("maxlen", intf)], rows)
+        if name == "memory_usage":
+            # hierarchical memory trackers (memtrack.py): one row per
+            # live session (current + peak, host/device ledgers) plus
+            # the server-root totals every session rolls up into
+            from tidb_tpu import memtrack
+            srv = memtrack.SERVER.snapshot()
+            rows = [("server", 0, srv["host"], srv["device"],
+                     srv["host_peak"], srv["device_peak"])]
+            for snap in memtrack.sessions_snapshot():
+                sid = snap["label"].rsplit("-", 1)[-1]
+                rows.append(("session",
+                             int(sid) if sid.isdigit() else 0,
+                             snap["host"], snap["device"],
+                             snap["host_peak"], snap["device_peak"]))
+            pv = mk([("scope", sf), ("session_id", intf),
+                     ("current_host_bytes", intf),
+                     ("current_device_bytes", intf),
+                     ("peak_host_bytes", intf),
+                     ("peak_device_bytes", intf)], rows)
+            # tracker state moves per statement with no schema-version
+            # bump: a cached plan would serve a frozen snapshot forever
+            pv.cacheable = False
+            return pv
         if name == "collations":
             rows = [("utf8mb4_bin", "utf8mb4", 46, "", "Yes", 1),
                     ("utf8mb4_general_ci", "utf8mb4", 45, "Yes", "Yes", 1),
@@ -345,7 +368,8 @@ class Planner:
                      ("avg_latency_ns", intf), ("sum_parse_ns", intf),
                      ("sum_plan_ns", intf), ("sum_exec_ns", intf),
                      ("sum_commit_ns", intf), ("sum_rows", intf),
-                     ("sum_errors", intf), ("first_seen", intf),
+                     ("sum_errors", intf), ("max_mem_bytes", intf),
+                     ("first_seen", intf),
                      ("last_seen", intf), ("top_operators", sf)]
         schema = PlanSchema([SchemaCol(n, alias, ft)
                              for n, ft in cols_spec])
@@ -356,7 +380,8 @@ class Planner:
                     r["min_latency_ns"], r["avg_latency_ns"],
                     r["sum_parse_ns"], r["sum_plan_ns"],
                     r["sum_exec_ns"], r["sum_commit_ns"], r["sum_rows"],
-                    r["sum_errors"], int(r["first_seen"]),
+                    r["sum_errors"], r["max_mem_bytes"],
+                    int(r["first_seen"]),
                     int(r["last_seen"]), r["top_operators"])
             rows.append([Constant(v, ft)
                          for v, (_n, ft) in zip(vals, cols_spec)])

@@ -31,7 +31,7 @@ import time
 __all__ = ["OpStats", "StatsCollector", "collecting", "current",
            "instrument", "device_call", "device_section", "fmt_ns",
            "fmt_bytes", "note_superchunk", "note_pipeline_stall",
-           "note_finalize_wait"]
+           "note_finalize_wait", "device_watermark"]
 
 _tl = threading.local()
 
@@ -39,12 +39,14 @@ _tl = threading.local()
 _mem_stats_available: bool | None = None   # None = not yet probed
 
 
-def _device_peak_bytes() -> int:
+def device_watermark() -> int:
     """Backend peak-memory watermark, 0 when the platform doesn't report
-    one (CPU jax has no allocator stats). The availability probe is
-    cached: device_call runs this per kernel call, and paying a
-    raised-and-swallowed exception each time on CPU backends would make
-    profiling runs slower than they need to be."""
+    one (CPU jax has no allocator stats). PROCESS-WIDE: concurrent
+    statements' allocations inflate it for each other, so it feeds only
+    the server-root gauge (tidb_tpu_device_peak_bytes) — per-operator
+    `mem` comes from memtrack's per-statement trackers. The availability
+    probe is cached so CPU backends never pay a raised-and-swallowed
+    exception per call."""
     global _mem_stats_available
     if _mem_stats_available is False:
         return 0
@@ -64,7 +66,7 @@ class OpStats:
     """One physical operator's actuals for one statement execution."""
 
     __slots__ = ("name", "act_rows", "loops", "time_ns",
-                 "device_time_ns", "device_peak_bytes", "cop_tasks",
+                 "device_time_ns", "cop_tasks",
                  "superchunks", "coalesced_chunks", "superchunk_fill_rows",
                  "superchunk_bucket_rows", "pipeline_stall_ns")
 
@@ -74,7 +76,6 @@ class OpStats:
         self.loops = 0
         self.time_ns = 0           # host wall, inclusive of children
         self.device_time_ns = 0    # sum around block_until_ready
-        self.device_peak_bytes = 0  # backend watermark high-water mark
         self.cop_tasks = 0
         # superchunk pipeline (ops/runtime.py): how the operator's device
         # work was batched and how long the host sat blocked on readback
@@ -94,7 +95,6 @@ class OpStats:
         return {"name": self.name, "act_rows": self.act_rows,
                 "loops": self.loops, "time_ns": self.time_ns,
                 "device_time_ns": self.device_time_ns,
-                "device_peak_bytes": self.device_peak_bytes,
                 "cop_tasks": self.cop_tasks,
                 "superchunks": self.superchunks,
                 "coalesced_chunks": self.coalesced_chunks,
@@ -139,12 +139,13 @@ class StatsCollector:
         return ent[1] if ent is not None else None
 
     def note_device(self, plan, elapsed_ns: int) -> None:
+        # NO watermark read here: the backend's peak-bytes gauge is
+        # process-wide, so a concurrent statement's build would bleed
+        # into this operator's mem — tracked bytes (memtrack) carry the
+        # per-op attribution instead
         st = self.node(plan)
-        peak = _device_peak_bytes()   # backend query stays off the lock
         with self._lock:
             st.device_time_ns += elapsed_ns
-            if peak > st.device_peak_bytes:
-                st.device_peak_bytes = peak
 
     def note_cop_tasks(self, plan, n: int) -> None:
         st = self.node(plan)
@@ -253,7 +254,18 @@ def suspended():
 def instrument(exe, plan) -> None:
     """Wrap the executor's production methods so each yielded batch
     records rows/loops/time into the active collector's node for `plan`.
-    No-op when no collector is active (internal sessions, stats off)."""
+    Also pre-registers the plan node (and its pushed CopPlans) with the
+    active memory tracker, so storage-side allocations credit the
+    issuing reader. No-op when neither is active (internal sessions,
+    stats off)."""
+    from tidb_tpu import memtrack
+    mt = memtrack.current()
+    if mt is not None:
+        mnode = mt.node(plan)
+        for attr in ("cop", "index_cop", "table_cop"):
+            cop = getattr(plan, attr, None)
+            if cop is not None:
+                mt.link(cop, mnode)
     coll = current()
     if coll is None:
         return

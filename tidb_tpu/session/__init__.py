@@ -343,8 +343,16 @@ class Session:
             self._stmt_start = 0.0
             self.killed = False           # KILL QUERY flag (cooperative)
             self.kill_hook = None         # server sets: closes the conn
+            self.mem_tracker = None       # session memory root (memtrack)
             if not internal:
                 _SESSIONS.add(self)
+                from tidb_tpu import memtrack
+                self.mem_tracker = memtrack.session_root(self.session_id)
+                # sessions are not reliably close()d (pools, tests): the
+                # finalizer detaches the tracker from the server root so
+                # information_schema.memory_usage never lists the dead
+                self._mem_finalizer = weakref.finalize(
+                    self, self.mem_tracker.detach)
 
     # -- public API ----------------------------------------------------------
 
@@ -385,15 +393,16 @@ class Session:
         slow-log emit at :353). Internal bookkeeping sessions skip the
         instrumentation entirely — their catalog lookups are not client
         queries and would pollute the metrics."""
-        from tidb_tpu import config, metrics, perfschema, trace
+        from tidb_tpu import config, memtrack, metrics, perfschema, trace
+        from tidb_tpu import runtime_stats as rs
         if self.internal:
             # internal catalog work must neither appear in perfschema nor
             # attach spans to the enclosing client statement's trace —
             # nor record its scans into that statement's operator stats
-            from tidb_tpu import runtime_stats as rs
+            # or bill its buffers to that statement's memory quota
             token = trace.detach()
             try:
-                with rs.suspended():
+                with rs.suspended(), memtrack.suspended():
                     return self._run_stmt(stmt, sql_text=sql_text)
             finally:
                 trace.restore(token)
@@ -422,10 +431,46 @@ class Session:
         root.children.append(pspan)
         err: str | None = None
         res = None
+        # per-statement memory root: operators hang their tracker nodes
+        # off it, it rolls up into the session root, and it carries the
+        # mem-quota + OOM-action chain. on_cancel flips the cooperative
+        # kill flag so concurrent fan-out workers stop at their next
+        # interrupt check while the quota error unwinds this thread.
+        quota_cancel: list[str] = []
+
+        def _on_quota_cancel(msg: str) -> None:
+            quota_cancel.append(msg)
+            self.killed = True
+
+        mt = memtrack.statement_root(
+            parent=self.mem_tracker,
+            on_cancel=_on_quota_cancel,
+            label=f"stmt-{self.session_id}")
+        self._last_mem = mt
         try:
             with config.session_overlay(overlay):
+                mt.quota = config.mem_quota_query()   # session-shadowed
                 try:
-                    res = self._run_stmt(stmt, sql_text=sql_text)
+                    with memtrack.tracking(mt):
+                        res = self._run_stmt(stmt, sql_text=sql_text)
+                except memtrack.QuotaExceededError as e:
+                    # OOM cancel: statement dies with ER_MEM_EXCEED_QUOTA,
+                    # the transaction rolls back, the session survives
+                    self._rollback()
+                    raise SQLError(str(e)) from None
+                except Exception as e:
+                    if quota_cancel and "interrupted" in str(e).lower():
+                        # the cancel fired on a fan-out worker: this
+                        # thread's cooperative-kill check raised a
+                        # generic interrupt before the worker's exception
+                        # drained — surface the honest quota error (and
+                        # its rollback) instead of ER_QUERY_INTERRUPTED.
+                        # Only interrupt-shaped errors are rewritten: an
+                        # unrelated concurrent failure must keep its own
+                        # message and code
+                        self._rollback()
+                        raise SQLError(quota_cancel[0]) from None
+                    raise
                 finally:
                     # effective (session-shadowed) slow-log/trace knobs
                     slow_ms = config.get_var("tidb_tpu_slow_query_ms")
@@ -437,6 +482,15 @@ class Session:
         finally:
             trace.end(root)
             dur = time.perf_counter() - self._stmt_start
+            # peaks survive detach; the gauges sample the last statement
+            metrics.gauge(metrics.QUERY_MEM, mt.host_peak,
+                          {"kind": "host"})
+            metrics.gauge(metrics.QUERY_MEM, mt.device_peak,
+                          {"kind": "device"})
+            # the process-global backend watermark stays a SERVER gauge
+            # only — concurrent statements contaminate it, so it must
+            # never feed per-statement columns again
+            metrics.gauge(metrics.DEVICE_PEAK, rs.device_watermark())
             metrics.counter(metrics.QUERIES_TOTAL, {"type": kind})
             metrics.histogram(metrics.QUERY_DURATIONS, dur)
             nrows = len(res.rows) if isinstance(res, ResultSet) else \
@@ -452,6 +506,7 @@ class Session:
             digest, _norm = perfschema.digest_record(
                 sql, int(dur * 1e9), phases=phases, rows=nrows,
                 error=err, op_stats=[s.to_dict() for s in ops],
+                mem_bytes=mt.host_peak + mt.device_peak,
                 tag=None if batch_no is None
                 else f"stmt#{batch_no}:{kind}")
             for s in ops:
@@ -488,18 +543,22 @@ class Session:
                 metrics.counter(metrics.SLOW_QUERIES)
                 slow_log.warning(
                     "%s", self._slow_log_record(sql, dur, digest, ops,
-                                                err))
+                                                err, mem=mt))
             # release the executed plan tree: an idle pooled session
             # must not pin a multi-MB INSERT's literal plan (the sealed
             # collector keeps only name+number OpStats for bench)
             self._last_plan = None
             if coll is not None:
                 coll.seal()
+            # release-on-close: credit everything still held back to the
+            # session root (leaving it at zero between statements) and
+            # drop the plan pins; peaks stay readable on _last_mem
+            mt.detach()
             self.current_sql = None
         return res
 
     def _slow_log_record(self, sql: str, dur: float, digest: str,
-                         ops, err: str | None) -> str:
+                         ops, err: str | None, mem=None) -> str:
         """Structured slow-log record: digest, executed plan, and
         per-operator stats ride with the SQL (ref: the reference's
         multi-line slow log, executor/adapter.go:353 +
@@ -508,6 +567,11 @@ class Session:
         lines = [f"slow query: {dur:.3f}s user={self.user} "
                  f"db={self.current_db} digest={digest}"
                  + (" error=1" if err else "")]
+        if mem is not None:
+            lines.append(
+                f"# Mem: {rs.fmt_bytes(mem.host_peak + mem.device_peak)}"
+                f" host={rs.fmt_bytes(mem.host_peak)}"
+                f" device={rs.fmt_bytes(mem.device_peak)}")
         plan = getattr(self, "_last_plan", None)
         if plan is not None:
             try:
@@ -637,6 +701,8 @@ class Session:
         from tidb_tpu import perfschema
         if not self.internal:
             perfschema.session_closed(self.session_id)
+            if self.mem_tracker is not None:
+                self._mem_finalizer()   # detach from the server root
         if self.txn is not None:
             self.txn.rollback()
             self.txn = None
@@ -1856,14 +1922,17 @@ class Session:
                 live = list(_SESSIONS)
             for s in sorted(live, key=lambda x: x.session_id):
                 sql = s.current_sql
+                tracker = getattr(s, "mem_tracker", None)
                 rows.append((s.session_id, s.user, s.host,
                              s.current_db or None,
                              "Query" if sql else "Sleep",
                              int(now - s.created_at),
                              "" if sql else None,
-                             (sql or "")[:100] or None))
+                             (sql or "")[:100] or None,
+                             tracker.total() if tracker is not None
+                             else 0))
             return ResultSet(["Id", "User", "Host", "db", "Command",
-                              "Time", "State", "Info"], rows)
+                              "Time", "State", "Info", "Mem"], rows)
         if stmt.tp == "create_table":
             db = stmt.table.db or self.current_db
             t = ischema.table(db, stmt.table.name)
@@ -2064,7 +2133,7 @@ class Session:
         runtime-stats collector, then render the executed plan annotated
         with per-operator actuals (ref: the reference's EXPLAIN ANALYZE
         over RuntimeStatsColl, executor/explain.go)."""
-        from tidb_tpu import config, runtime_stats as rs
+        from tidb_tpu import config, memtrack, runtime_stats as rs
         if not isinstance(inner, (ast.SelectStmt, ast.UnionStmt,
                                   ast.InsertStmt, ast.UpdateStmt,
                                   ast.DeleteStmt)):
@@ -2078,20 +2147,27 @@ class Session:
         plan = self._last_plan
         if plan is None:
             raise SQLError("EXPLAIN ANALYZE: no plan was executed")
+        # per-op mem comes from the statement's memory-tracker nodes
+        # (host + device ledgers), collected by default — NOT from the
+        # process-global backend watermark, which a concurrent
+        # statement's allocations would contaminate
+        mt = memtrack.current()
         rows = []
         for depth, node in plan.explain_nodes():
             st = coll.get(node)
+            mnode = mt.get(node) if mt is not None else None
+            mem = rs.fmt_bytes(mnode.peak_total()) \
+                if mnode is not None else "-"
             est = "" if node.est_rows is None else f"{node.est_rows:.0f}"
             if st is None:
                 rows.append(("  " * depth + node.explain_line(), est,
-                             0, 0, "-", "-", "-", 0, "-"))
+                             0, 0, "-", "-", mem, 0, "-"))
                 continue
             rows.append((
                 "  " * depth + node.explain_line(), est,
                 st.act_rows, st.loops, rs.fmt_ns(st.time_ns),
                 rs.fmt_ns(st.device_time_ns) if device else "-",
-                rs.fmt_bytes(st.device_peak_bytes) if device else "-",
-                st.cop_tasks, _fmt_pipeline(st)))
+                mem, st.cop_tasks, _fmt_pipeline(st)))
         return ResultSet(["id", "est_rows", "act_rows", "loops", "time",
                           "device_time", "mem", "cop_tasks", "pipeline"],
                          rows)

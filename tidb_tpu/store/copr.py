@@ -15,7 +15,7 @@ import threading
 from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 
-from tidb_tpu import config, kv, runtime_stats, tablecodec
+from tidb_tpu import config, kv, memtrack, runtime_stats, tablecodec
 from tidb_tpu.kv import (CopRequest, CopResponse, KVRange, NotLeaderError,
                          RegionError, ReqType, ServerBusyError,
                          KeyLockedError)
@@ -101,8 +101,12 @@ def exec_cop_plan(plan: CopPlan, chunk, sources: int = 1) -> CopResponse:
                       chunk.num_rows >= config.device_min_rows())
         if use_device:
             try:
-                res = runtime_stats.device_call(plan, _agg_kernels(plan),
-                                                chunk)
+                k = _agg_kernels(plan)
+                # device ledger: padded upload + scratch, sized from
+                # shapes at dispatch; the pool worker's tracker routes
+                # the charge to the issuing reader's node
+                with memtrack.device_scope(plan, k.dispatch_nbytes(chunk)):
+                    res = runtime_stats.device_call(plan, k, chunk)
                 if config.superchunk_rows():
                     # attribution follows the feature switch: with
                     # coalescing off this is plain per-batch dispatch,
@@ -230,9 +234,10 @@ def cop_handler(storage):
         sc_limit = config.superchunk_rows() if plan.is_agg else 0
         parts: list = []
         acc = 0
+        staged = 0     # host bytes of the superchunk assembly buffer
 
         def flush_parts() -> None:
-            nonlocal acc
+            nonlocal acc, staged
             from tidb_tpu.chunk import Chunk
             if not parts:
                 return
@@ -240,6 +245,9 @@ def cop_handler(storage):
             n_src = len(parts)
             parts.clear()
             acc = 0
+            if staged:
+                memtrack.release(plan, host=staged)
+                staged = 0
             if big is not None:
                 out.append(exec_cop_plan(plan, big, sources=n_src))
 
@@ -251,6 +259,9 @@ def cop_handler(storage):
             if sc_limit:
                 dec = _decode(plan, batch)
                 parts.append(dec)
+                b = memtrack.chunk_bytes(dec)
+                memtrack.consume(plan, host=b)
+                staged += b
                 acc += dec.num_rows
                 if acc >= sc_limit:
                     flush_parts()
@@ -308,13 +319,16 @@ class CopClient(kv.Client):
         # the session's sysvar overlay is thread-local: capture it here
         # and re-install inside every pool worker so per-session knobs
         # (device on/off, cache) apply uniformly across the fan-out —
-        # the runtime-stats collector rides along the same way so
-        # storage-side device kernels attribute to the reader node
+        # the runtime-stats collector AND the memory tracker ride along
+        # the same way, so storage-side device kernels attribute their
+        # time and bytes to the reader node that issued them
         overlay = config.current_overlay()
+        mem_root = memtrack.current()
 
         def run_task(rq, rng):
             with config.session_overlay(overlay), \
-                    runtime_stats.collecting(coll):
+                    runtime_stats.collecting(coll), \
+                    memtrack.tracking(mem_root):
                 return list(self._run_task(rq, rng))
         if concurrency <= 1 or len(tasks) == 1:
             for loc, rng in tasks:
@@ -326,7 +340,8 @@ class CopClient(kv.Client):
         def worker(task_list):
             try:
                 with config.session_overlay(overlay), \
-                        runtime_stats.collecting(coll):
+                        runtime_stats.collecting(coll), \
+                        memtrack.tracking(mem_root):
                     for _loc, rng in task_list:
                         for resp in self._run_task(req, rng):
                             results.put(resp)
@@ -446,12 +461,14 @@ class CopClient(kv.Client):
         q = BoundedFrameQueue(credit, stop)
         overlay = config.current_overlay()
         coll = runtime_stats.current()
+        mem_root = memtrack.current()
         buckets = [tasks[i::concurrency] for i in range(concurrency)]
 
         def worker(task_list):
             try:
                 with config.session_overlay(overlay), \
-                        runtime_stats.collecting(coll):
+                        runtime_stats.collecting(coll), \
+                        memtrack.tracking(mem_root):
                     for _loc, rng in task_list:
                         for resp in self._run_task_stream(
                                 req, rng, new_counter()):
