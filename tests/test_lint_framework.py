@@ -1,0 +1,440 @@
+"""Unit tests for the lint engine itself (tidb_tpu/lint/engine.py):
+suppression parsing and scoping, the unused-suppression and vacuity
+guards, legacy alias tags, and positive/negative fixture snippets for
+each of the six project-specific rules. The repo-level assertions (all
+rules clean on the tree) live in tests/test_lint.py."""
+
+import pytest
+
+from tidb_tpu.lint import REGISTRY, selfcheck
+from tidb_tpu.lint.engine import (BAD_RULE, REPO, UNUSED_RULE, Forest,
+                                  Rule, run)
+
+EXEC_REL = "tidb_tpu/executor/x.py"
+OPS_REL = "tidb_tpu/ops/x.py"
+STORE_REL = "tidb_tpu/store/x.py"
+
+ALLOC = "import numpy as np\n"          # line 1
+
+
+def lint(sources, rules=None, root=None):
+    forest = Forest.from_sources(sources, root=root)
+    return run(rules=rules, forest=forest, with_selfcheck=False,
+               with_vacuity=False)
+
+
+def rules_of(report):
+    return [f.rule for f in report.findings]
+
+
+# -- suppression parsing and scope ------------------------------------------
+
+def test_tag_on_line_above_suppresses():
+    src = (ALLOC +
+           "def f(n):\n"
+           "    # lint: exempt[memtrack-alloc] caller bills these rows\n"
+           "    return np.empty(n)\n")
+    rep = lint({EXEC_REL: src}, rules=["memtrack-alloc"])
+    assert rep.findings == []
+
+
+def test_tag_trailing_same_line_suppresses():
+    src = (ALLOC +
+           "def f(n):\n"
+           "    return np.empty(n)"
+           "  # lint: exempt[memtrack-alloc] caller bills these rows\n")
+    rep = lint({EXEC_REL: src}, rules=["memtrack-alloc"])
+    assert rep.findings == []
+
+
+def test_tag_two_lines_up_does_not_suppress():
+    src = (ALLOC +
+           "def f(n):\n"
+           "    # lint: exempt[memtrack-alloc] too far from the site\n"
+           "    n = n + 1\n"
+           "    return np.empty(n)\n")
+    rep = lint({EXEC_REL: src}, rules=["memtrack-alloc"])
+    assert "memtrack-alloc" in rules_of(rep)        # finding survives
+    assert UNUSED_RULE in rules_of(rep)             # and the tag is stale
+
+
+def test_wrong_rule_name_does_not_suppress():
+    src = (ALLOC +
+           "def f(n):\n"
+           "    # lint: exempt[bare-except] names a different rule\n"
+           "    return np.empty(n)\n")
+    rep = lint({EXEC_REL: src}, rules=["memtrack-alloc"])
+    assert "memtrack-alloc" in rules_of(rep)
+
+
+def test_def_level_tag_covers_whole_function():
+    src = (ALLOC +
+           "# lint: exempt[memtrack-alloc] whole helper is audited\n"
+           "def f(n):\n"
+           "    a = np.empty(n)\n"
+           "    b = np.empty(n)\n"
+           "    return a, b\n")
+    rep = lint({EXEC_REL: src}, rules=["memtrack-alloc"])
+    assert rep.findings == []                       # both sites, no unused
+
+
+def test_trailing_tag_covers_its_own_line_only():
+    """A tag trailing statement A must not also sanction statement B
+    on the next line."""
+    src = (ALLOC +
+           "def f(n):\n"
+           "    a = np.empty(n)"
+           "  # lint: exempt[memtrack-alloc] a is billed by caller\n"
+           "    b = np.empty(n)\n"
+           "    return a, b\n")
+    rep = lint({EXEC_REL: src}, rules=["memtrack-alloc"])
+    assert [f.line for f in rep.findings
+            if f.rule == "memtrack-alloc"] == [4]   # b only
+
+
+def test_tag_inside_string_literal_is_inert():
+    """A string QUOTING the tag syntax is not a suppression: it neither
+    hides an adjacent violation nor trips unused-suppression."""
+    src = (ALLOC +
+           "def f(n):\n"
+           "    m = \"use '# lint: exempt[memtrack-alloc] why' here\"\n"
+           "    return np.empty(n), m\n")
+    rep = lint({EXEC_REL: src}, rules=["memtrack-alloc"])
+    assert rules_of(rep) == ["memtrack-alloc"]      # real finding only
+
+
+def test_trailing_tag_above_def_stays_line_scoped():
+    """A tag trailing a code line that happens to sit above a def must
+    NOT widen into a whole-function exemption."""
+    src = (ALLOC +
+           "B = np.empty(9000)  # lint: exempt[memtrack-alloc] module "
+           "buffer, billed at import\n"
+           "def f(n):\n"
+           "    return np.empty(n)\n")
+    rep = lint({EXEC_REL: src}, rules=["memtrack-alloc"])
+    assert [f.rule for f in rep.findings] == ["memtrack-alloc"]
+    assert rep.findings[0].line == 4                # the one inside f
+
+
+def test_class_level_tag_is_not_a_blanket():
+    src = (ALLOC +
+           "# lint: exempt[memtrack-alloc] one reason for everything\n"
+           "class Big:\n"
+           "    def a(self, n):\n"
+           "        return np.empty(n)\n")
+    rep = lint({EXEC_REL: src}, rules=["memtrack-alloc"])
+    assert "memtrack-alloc" in rules_of(rep)        # method still flagged
+    assert UNUSED_RULE in rules_of(rep)             # tag covered nothing
+
+
+def test_multi_rule_tag():
+    src = ("import numpy as np\nimport jax.numpy as jnp\n"
+           "# lint: exempt[memtrack-alloc,dtype-discipline] staging "
+           "buffer billed at dispatch; exact int64 lanes\n"
+           "def f(n):\n"
+           "    return np.empty(n), jnp.zeros(n, dtype=jnp.int64)\n")
+    rep = lint({OPS_REL: src},
+               rules=["memtrack-alloc", "dtype-discipline"])
+    assert rep.findings == []
+
+
+def test_stacked_tags_above_one_site_all_apply():
+    src = ("import numpy as np\nimport jax.numpy as jnp\n"
+           "def f(n):\n"
+           "    # lint: exempt[memtrack-alloc] staging billed at dispatch\n"
+           "    # lint: exempt[dtype-discipline] exact int64 lanes\n"
+           "    return np.empty(n), jnp.zeros(n, dtype=jnp.int64)\n")
+    rep = lint({OPS_REL: src},
+               rules=["memtrack-alloc", "dtype-discipline"])
+    assert rep.findings == []
+
+
+def test_tag_trailing_decorated_def_gets_function_scope():
+    src = (ALLOC +
+           "class C:\n"
+           "    @staticmethod\n"
+           "    def f(n):"
+           "  # lint: exempt[memtrack-alloc] audited helper\n"
+           "        return np.empty(n)\n")
+    rep = lint({EXEC_REL: src}, rules=["memtrack-alloc"])
+    assert rep.findings == []
+
+
+def test_legacy_memtrack_alias_still_works():
+    src = (ALLOC +
+           "def f(n):\n"
+           "    # memtrack: exempt - caller bills these rows\n"
+           "    return np.empty(n)\n")
+    rep = lint({EXEC_REL: src}, rules=["memtrack-alloc"])
+    assert rep.findings == []
+
+
+def test_unused_suppression_detected():
+    src = (ALLOC +
+           "# lint: exempt[memtrack-alloc] nothing here needs it\n"
+           "X = 1\n")
+    rep = lint({EXEC_REL: src}, rules=["memtrack-alloc"])
+    assert rules_of(rep) == [UNUSED_RULE]
+
+
+def test_reasonless_tag_is_a_finding():
+    src = (ALLOC +
+           "def f(n):\n"
+           "    # lint: exempt[memtrack-alloc]\n"
+           "    return np.empty(n)\n")
+    rep = lint({EXEC_REL: src}, rules=["memtrack-alloc"])
+    assert BAD_RULE in rules_of(rep)
+
+
+def test_unknown_rule_tag_is_a_finding():
+    src = "# lint: exempt[no-such-rule] misspelled\nX = 1\n"
+    rep = lint({EXEC_REL: src})
+    assert BAD_RULE in rules_of(rep)
+
+
+# -- vacuity guards ---------------------------------------------------------
+
+def test_vacuity_guard_fixture_leg():
+    class HollowRule(Rule):
+        """A rule whose fixture no longer triggers it."""
+        fixture = "X = 1\n"
+
+        def check(self, forest):
+            return iter(())
+
+    HollowRule.name = "hollow-rule"
+    problems = selfcheck(HollowRule)
+    assert problems and "fixture produced no finding" in \
+        problems[0].message
+
+
+def test_vacuity_guard_requires_a_fixture():
+    class NoFixtureRule(Rule):
+        """A rule that never declared a positive fixture."""
+
+        def check(self, forest):
+            return iter(())
+
+    NoFixtureRule.name = "no-fixture-rule"
+    problems = selfcheck(NoFixtureRule)
+    assert problems and "no positive fixture" in problems[0].message
+
+
+def test_vacuity_guard_min_sites_leg():
+    """A rule whose scope stops matching real code fails loudly: the
+    memtrack rule demands >= 30 in-tree allocation sites."""
+    forest = Forest.from_sources({EXEC_REL: "X = 1\n"})
+    rep = run(rules=["memtrack-alloc"], forest=forest,
+              with_selfcheck=False, with_vacuity=True)
+    assert any("vacuity guard" in f.message for f in rep.findings)
+
+
+@pytest.mark.parametrize("name", list(REGISTRY))
+def test_every_registered_rule_passes_selfcheck(name):
+    """Positive fixtures: each rule still fires on the pattern it
+    documents (this is the fixture leg the engine runs in CI)."""
+    assert selfcheck(REGISTRY[name]) == []
+
+
+# -- the six new rules: positive/negative snippets --------------------------
+
+def test_lock_discipline_negatives():
+    src = ("import threading\n"
+           "_lock = threading.Lock()\n"
+           "def ok_try(work):\n"
+           "    _lock.acquire()\n"
+           "    try:\n"
+           "        work()\n"
+           "    finally:\n"
+           "        _lock.release()\n"
+           "def ok_with(work):\n"
+           "    with _lock:\n"
+           "        work()\n"
+           "def ok_assigns_between(work):\n"
+           "    _lock.acquire()\n"
+           "    state = 0\n"
+           "    try:\n"
+           "        work(state)\n"
+           "    finally:\n"
+           "        _lock.release()\n"
+           "def ok_inside_try(work):\n"
+           "    try:\n"
+           "        _lock.acquire()\n"
+           "        work()\n"
+           "    finally:\n"
+           "        _lock.release()\n")
+    rep = lint({STORE_REL: src}, rules=["lock-discipline"])
+    assert rep.findings == []
+
+
+def test_lock_discipline_assign_form_with_try_finally_is_clean():
+    src = ("import threading\n"
+           "_lock = threading.Lock()\n"
+           "def f(work):\n"
+           "    got = _lock.acquire(timeout=1)\n"
+           "    try:\n"
+           "        work(got)\n"
+           "    finally:\n"
+           "        _lock.release()\n")
+    rep = lint({STORE_REL: src}, rules=["lock-discipline"])
+    assert rep.findings == []
+
+
+def test_lock_discipline_positives():
+    src = ("import threading\n"
+           "_lock = threading.Lock()\n"
+           "def bad_plain(work):\n"
+           "    _lock.acquire()\n"
+           "    work()\n"
+           "    _lock.release()\n"
+           "def bad_expression(work):\n"
+           "    if _lock.acquire(timeout=1):\n"
+           "        work()\n")
+    rep = lint({STORE_REL: src}, rules=["lock-discipline"])
+    assert len(rep.findings) == 2
+
+
+def test_lock_discipline_ignores_files_outside_scope():
+    src = "def f(lock):\n    lock.acquire()\n"
+    rep = lint({"tidb_tpu/parser/x.py": src}, rules=["lock-discipline"])
+    assert rep.findings == []
+
+
+def test_sysvar_registry_negative_and_positive():
+    config = '_DEFS = {"tidb_tpu_knob": ("int", 1)}\n'
+    ok = 'V = "tidb_tpu_knob"\n'
+    rogue = 'V = "tidb_tpu_knob"\nW = "tidb_tpu_tpyo"\n'
+    assert lint({"tidb_tpu/config.py": config,
+                 STORE_REL: ok}, rules=["sysvar-registry"]).findings == []
+    rep = lint({"tidb_tpu/config.py": config, STORE_REL: rogue},
+               rules=["sysvar-registry"])
+    assert len(rep.findings) == 1 and "tidb_tpu_tpyo" in \
+        rep.findings[0].message
+
+
+def test_sysvar_registry_docs_drift():
+    """With a real repo root, a declared-but-undocumented sysvar is a
+    finding (the docs leg)."""
+    config = '_DEFS = {"tidb_tpu_never_documented_xyz": ("int", 1)}\n'
+    rep = lint({"tidb_tpu/config.py": config}, rules=["sysvar-registry"],
+               root=REPO)
+    assert any("appears nowhere" in f.message for f in rep.findings)
+
+
+def test_errcode_discipline_negative():
+    src = ("from tidb_tpu import errcode\n"
+           "def f(sess, SQLError):\n"
+           "    sess.add_warning('Note', errcode.ER_DUP_ENTRY, 'dup')\n"
+           "    raise SQLError('no code at all')\n")
+    rep = lint({STORE_REL: src}, rules=["errcode-discipline"])
+    assert rep.findings == []
+
+
+def test_errcode_discipline_positive_kwarg_and_warning():
+    src = ("def f(sess, SQLError):\n"
+           "    sess.add_warning('Note', 1051, 'gone')\n"
+           "    raise SQLError('dup', code=1062)\n")
+    rep = lint({STORE_REL: src}, rules=["errcode-discipline"])
+    assert len(rep.findings) == 2
+
+
+def test_device_sync_negative_finalize_is_sanctioned():
+    src = ("import jax\n"
+           "class K:\n"
+           "    def finalize(self, pending):\n"
+           "        return jax.device_get(pending)\n")
+    rep = lint({OPS_REL: src}, rules=["device-sync"])
+    assert rep.findings == []
+
+
+def test_device_sync_positive_item_and_asarray():
+    src = ("import jax\nimport jax.numpy as jnp\nimport numpy as np\n"
+           "def g(x):\n"
+           "    a = jnp.max(x).item()\n"
+           "    b = np.asarray(jnp.sum(x))\n"
+           "    c = jax.device_get(x)\n"
+           "    return a, b, c\n")
+    rep = lint({OPS_REL: src}, rules=["device-sync"])
+    assert len(rep.findings) == 3
+
+
+def test_dtype_discipline_negative():
+    src = ("import jax.numpy as jnp\nimport numpy as np\n"
+           "def f(n):\n"
+           "    a = jnp.zeros(n, dtype=jnp.int32)\n"
+           "    b = np.empty(n, dtype=np.int64)   # host lanes: fine\n"
+           "    return a, b\n")
+    rep = lint({OPS_REL: src}, rules=["dtype-discipline"])
+    assert rep.findings == []
+
+
+def test_dtype_discipline_only_scans_ops():
+    src = ("import jax.numpy as jnp\n"
+           "def f(n):\n"
+           "    return jnp.zeros(n, dtype=jnp.int64)\n")
+    rep = lint({STORE_REL: src}, rules=["dtype-discipline"])
+    assert rep.findings == []
+
+
+def test_bare_except_negative():
+    src = ("def f(work, log):\n"
+           "    try:\n"
+           "        work()\n"
+           "    except ValueError:\n"
+           "        log()\n"
+           "    try:\n"
+           "        work()\n"
+           "    except BaseException:\n"
+           "        log()\n"
+           "        raise\n")
+    rep = lint({STORE_REL: src}, rules=["bare-except"])
+    assert rep.findings == []
+
+
+def test_bare_except_positive_bare_colon():
+    src = ("def f(work):\n"
+           "    try:\n"
+           "        work()\n"
+           "    except:\n"
+           "        return None\n")
+    rep = lint({STORE_REL: src}, rules=["bare-except"])
+    assert len(rep.findings) == 1
+
+
+def test_bare_except_try_finally_reraise_is_sanctioned():
+    """The canonical cleanup shape — re-raise through a try/finally
+    with no except clauses — must pass."""
+    src = ("def f(work, ledger):\n"
+           "    try:\n"
+           "        work()\n"
+           "    except BaseException:\n"
+           "        try:\n"
+           "            raise\n"
+           "        finally:\n"
+           "            ledger.release()\n")
+    rep = lint({STORE_REL: src}, rules=["bare-except"])
+    assert rep.findings == []
+
+
+def test_bare_except_raise_swallowed_by_nested_try_still_flagged():
+    """A raise the handler itself catches cannot sanction the
+    handler."""
+    src = ("def f(work, log):\n"
+           "    try:\n"
+           "        work()\n"
+           "    except BaseException:\n"
+           "        try:\n"
+           "            raise ValueError('x')\n"
+           "        except ValueError:\n"
+           "            log()\n")
+    rep = lint({STORE_REL: src}, rules=["bare-except"])
+    assert len(rep.findings) == 1
+
+
+def test_reasonless_alias_tag_is_a_finding():
+    src = (ALLOC +
+           "def f(n):\n"
+           "    # memtrack: exempt\n"
+           "    return np.empty(n)\n")
+    rep = lint({EXEC_REL: src}, rules=["memtrack-alloc"])
+    assert BAD_RULE in rules_of(rep)

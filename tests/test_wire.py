@@ -230,8 +230,9 @@ class TestFuzz:
         with pytest.raises(wire.WireError):
             wire.decode(wire.encode(1) + b"\x00")
 
-    # (the no-pickle invariant moved to tests/test_lint_wire.py, which
-    # checks the whole wire path by AST walk instead of substring grep)
+    # (the no-pickle invariant lives in the `wire-discipline` lint rule
+    # — tidb_tpu/lint, run by tests/test_lint.py — which checks the
+    # whole wire path by AST walk instead of substring grep)
 
 
 class TestStreamWire:

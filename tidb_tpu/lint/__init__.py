@@ -1,0 +1,19 @@
+"""tidb_tpu.lint — the package's static-analysis subsystem.
+
+One engine, one parse, one suppression syntax (see engine.py). Run it:
+
+    python -m tidb_tpu.lint              # CI front end, exit 1 on findings
+    python -m tidb_tpu.lint --list-rules
+    python -m tidb_tpu.lint --rule lock-discipline
+
+or through the pytest shim tests/test_lint.py (one shared parse for the
+whole rule set). Rules live in tidb_tpu/lint/rules/; docs/LINTS.md has
+the catalog, the suppression syntax and the how-to-add-a-rule recipe.
+"""
+
+from tidb_tpu.lint import rules as _rules  # noqa: F401  (registers rules)
+from tidb_tpu.lint.engine import (Finding, Forest, REGISTRY, Report,
+                                  Rule, register_rule, run, selfcheck)
+
+__all__ = ["Finding", "Forest", "REGISTRY", "Report", "Rule",
+           "register_rule", "run", "selfcheck"]

@@ -1,0 +1,57 @@
+"""CLI front end: ``python -m tidb_tpu.lint``.
+
+Exit-code contract (CI / pre-commit):
+    0  every selected rule ran clean
+    1  findings (printed one per line: file:line: [rule] message)
+    2  usage error (unknown rule, bad flags)
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tidb_tpu.lint import REGISTRY, run
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tidb_tpu.lint",
+        description="Project static analysis: every rule over one "
+                    "shared parse of the tidb_tpu package.")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalog and exit")
+    parser.add_argument("--rule", action="append", metavar="NAME",
+                        help="run only this rule (repeatable)")
+    parser.add_argument("-q", "--quiet", action="store_true",
+                        help="findings only, no timing report")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        width = max(len(n) for n in REGISTRY)
+        for name, cls in REGISTRY.items():
+            print(f"{name:<{width}}  {cls.doc()}")
+        return 0
+
+    try:
+        report = run(rules=args.rule)
+    except KeyError as e:
+        print(e.args[0], file=sys.stderr)
+        return 2
+
+    for finding in report.findings:
+        print(finding)
+    if not args.quiet:
+        slowest = sorted(report.rule_times.items(),
+                         key=lambda kv: -kv[1])[:3]
+        print(f"{len(report.rules_run)} rule(s) over "
+              f"{report.files} files: {len(report.findings)} finding(s) "
+              f"in {report.total_time * 1e3:.0f} ms "
+              f"(parse {report.parse_time * 1e3:.0f} ms; slowest "
+              + ", ".join(f"{n} {t * 1e3:.0f} ms" for n, t in slowest)
+              + ")")
+    return 1 if report.findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,15 @@
+"""Rule catalog: importing this package registers every rule, in the
+order CI reports them. Four ported from the original standalone test
+walkers, six project-specific additions."""
+
+from tidb_tpu.lint.rules import (  # noqa: F401  (import == register)
+    wire,        # wire-discipline   (ported: tests/test_lint_wire.py)
+    sync,        # hot-path-sync     (ported: tests/test_lint_sync.py)
+    metrics,     # metric-names      (ported: tests/test_lint_metrics.py)
+    memtrack,    # memtrack-alloc    (ported: tests/test_lint_memtrack.py)
+    locks,       # lock-discipline
+    sysvars,     # sysvar-registry
+    errcodes,    # errcode-discipline
+    dtypes,      # dtype-discipline
+    excepts,     # bare-except
+)

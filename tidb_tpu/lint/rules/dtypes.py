@@ -1,0 +1,69 @@
+"""Device dtype discipline: no 64-bit device-array construction in ops/
+without an explicit, justified exemption."""
+
+from __future__ import annotations
+
+import ast
+
+from tidb_tpu.lint.engine import Finding, Rule, register_rule
+
+_SCAN_DIR = "tidb_tpu/ops/"
+_CONSTRUCT = ("empty", "zeros", "ones", "full", "full_like",
+              "zeros_like", "ones_like", "arange", "asarray", "array",
+              "astype")
+_HOSTILE = ("int64", "float64")
+
+
+def _hostile_dtype(call: ast.Call) -> str | None:
+    """'jnp.int64'/'jnp.float64' if any argument pins a 64-bit device
+    dtype, else None. Only jnp-rooted dtypes count: host-side numpy
+    int64 lanes are the SQL-exactness representation and never land in
+    HBM unconverted."""
+    for arg in list(call.args) + [kw.value for kw in call.keywords]:
+        for n in ast.walk(arg):
+            if isinstance(n, ast.Attribute) and n.attr in _HOSTILE and \
+                    isinstance(n.value, ast.Name) and n.value.id == "jnp":
+                return f"jnp.{n.attr}"
+    return None
+
+
+@register_rule("dtype-discipline")
+class DtypeDisciplineRule(Rule):
+    """No jnp.int64 / jnp.float64 array construction in ops/ without an
+    exempt tag naming why the 64-bit lanes are required.
+
+    TPUs have no native 64-bit ALU path: int64 lowers to dual-word
+    emulation and float64 is software-emulated — both silently multiply
+    HBM footprint and kill the vector unit. The kernels that genuinely
+    need exactness (scaled-decimal sums, memcomparable key codes,
+    bitcast hashing) declare it with a per-site or per-function
+    `# lint: exempt[dtype-discipline] reason` so every 64-bit device
+    buffer in ops/ is a documented decision, not an accident.
+    """
+
+    fixture_rel = "tidb_tpu/ops/__lint_fixture__.py"
+    fixture = (
+        "import jax.numpy as jnp\n"
+        "def slots(n):\n"
+        "    return jnp.zeros(n, dtype=jnp.int64)\n"
+    )
+
+    def check(self, forest):
+        for pf in forest:
+            if not pf.rel.startswith(_SCAN_DIR):
+                continue
+            for node in pf.nodes:
+                if not (isinstance(node, ast.Call) and
+                        isinstance(node.func, ast.Attribute) and
+                        node.func.attr in _CONSTRUCT):
+                    continue
+                self.sites += 1
+                hostile = _hostile_dtype(node)
+                if hostile is None:
+                    continue
+                yield Finding(
+                    pf.rel, node.lineno, self.name,
+                    f"{node.func.attr} with {hostile}: TPU-hostile "
+                    f"64-bit device dtype — downcast/bitcast at the "
+                    f"device boundary, or justify it with "
+                    f"'# lint: exempt[dtype-discipline] <reason>'")

@@ -1,0 +1,128 @@
+"""Lock acquisition discipline for the concurrency-bearing layers."""
+
+from __future__ import annotations
+
+import ast
+
+from tidb_tpu.lint.engine import Finding, Rule, register_rule
+
+SCAN = ("tidb_tpu/memtrack.py", "tidb_tpu/metrics.py",
+        "tidb_tpu/session/", "tidb_tpu/store/")
+
+_SIMPLE = (ast.Assign, ast.AnnAssign, ast.AugAssign)
+
+
+def _releases(stmts) -> bool:
+    for s in stmts:
+        for n in ast.walk(s):
+            if isinstance(n, ast.Call) and \
+                    isinstance(n.func, ast.Attribute) and \
+                    n.func.attr == "release":
+                return True
+    return False
+
+
+def _acquires(expr):
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Call) and \
+                isinstance(n.func, ast.Attribute) and \
+                n.func.attr == "acquire":
+            yield n
+
+
+@register_rule("lock-discipline")
+class LockDisciplineRule(Rule):
+    """No bare .acquire() outside `with` / try-finally in memtrack.py,
+    metrics.py, session/ and store/.
+
+    A lock or semaphore acquired without an immediately-following
+    try/finally release leaks on the first exception between acquire
+    and release — and in these layers (the memory-tracker tree, the
+    metrics registry, session statement lifecycle, the connection-pool
+    semaphores) a leaked permit deadlocks the process quietly. The
+    sanctioned shape is `with lock:` or `x.acquire()` followed (bar
+    trivial assignments) by `try: ... finally: x.release()`; an acquire
+    already inside a try whose finally releases also passes.
+    """
+
+    fixture_rel = "tidb_tpu/store/__lint_fixture__.py"
+    fixture = (
+        "import threading\n"
+        "_lock = threading.Lock()\n"
+        "def f(work):\n"
+        "    _lock.acquire()\n"
+        "    work()\n"
+        "    _lock.release()\n"
+    )
+
+    def check(self, forest):
+        for pf in forest:
+            if not (pf.rel in SCAN[:2] or pf.rel.startswith(SCAN[2:])):
+                continue
+            yield from self._block(pf, pf.tree.body, False)
+
+    def _finding(self, pf, node):
+        return Finding(
+            pf.rel, node.lineno, self.name,
+            "bare .acquire() outside with/try-finally — a raise before "
+            "the matching release leaks the permit; acquire, then "
+            "`try: ... finally: release()` (or use `with`)")
+
+    def _header(self, pf, exprs, protected):
+        for expr in exprs:
+            if expr is None:
+                continue
+            for call in _acquires(expr):
+                self.sites += 1
+                if not protected:
+                    yield self._finding(pf, call)
+
+    def _block(self, pf, stmts, protected):
+        for i, stmt in enumerate(stmts):
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                yield from self._block(pf, stmt.body, False)
+            elif isinstance(stmt, ast.Try):
+                prot = protected or _releases(stmt.finalbody)
+                yield from self._block(pf, stmt.body, prot)
+                for h in stmt.handlers:
+                    yield from self._block(pf, h.body, prot)
+                yield from self._block(pf, stmt.orelse, prot)
+                yield from self._block(pf, stmt.finalbody, protected)
+            elif isinstance(stmt, (ast.If, ast.While)):
+                yield from self._header(pf, [stmt.test], protected)
+                yield from self._block(pf, stmt.body, protected)
+                yield from self._block(pf, stmt.orelse, protected)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                yield from self._header(pf, [stmt.iter], protected)
+                yield from self._block(pf, stmt.body, protected)
+                yield from self._block(pf, stmt.orelse, protected)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                yield from self._header(
+                    pf, [it.context_expr for it in stmt.items], protected)
+                yield from self._block(pf, stmt.body, protected)
+            elif isinstance(stmt, ast.Match):
+                yield from self._header(pf, [stmt.subject], protected)
+                for case in stmt.cases:
+                    yield from self._block(pf, case.body, protected)
+            elif isinstance(stmt, (ast.Expr, ast.Assign, ast.AnnAssign)) \
+                    and isinstance(getattr(stmt, "value", None),
+                                   ast.Call) and \
+                    isinstance(stmt.value.func, ast.Attribute) and \
+                    stmt.value.func.attr == "acquire":
+                # canonical statement forms: `x.acquire()` and
+                # `got = x.acquire(timeout=...)` ahead of try/finally
+                self.sites += 1
+                if not (protected or
+                        self._release_try_follows(stmts, i + 1)):
+                    yield self._finding(pf, stmt.value)
+            else:
+                yield from self._header(pf, [stmt], protected)
+
+    @staticmethod
+    def _release_try_follows(stmts, j) -> bool:
+        """Skip trivial assignments, then require try/finally-release."""
+        while j < len(stmts) and isinstance(stmts[j], _SIMPLE):
+            j += 1
+        return j < len(stmts) and isinstance(stmts[j], ast.Try) and \
+            _releases(stmts[j].finalbody)

@@ -1,0 +1,106 @@
+"""Metric-name registry discipline (port of tests/test_lint_metrics.py)."""
+
+from __future__ import annotations
+
+import ast
+
+from tidb_tpu.lint.engine import Finding, Rule, register_rule
+
+_METRICS = "tidb_tpu/metrics.py"
+
+
+def declared_constants(pf) -> dict[str, tuple[str, int]]:
+    """UPPERCASE module-level string constants of metrics.py:
+    NAME -> (value, lineno)."""
+    out = {}
+    for node in pf.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id.isupper() and \
+                isinstance(node.value, ast.Constant) and \
+                isinstance(node.value.value, str):
+            out[node.targets[0].id] = (node.value.value, node.lineno)
+    return out
+
+
+def _metric_calls(pf):
+    """<anything>.counter/.histogram/.gauge(...) where the receiver is
+    the metrics module (imported as `metrics`)."""
+    for node in pf.nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and \
+                fn.attr in ("counter", "histogram", "gauge") and \
+                isinstance(fn.value, ast.Name) and \
+                fn.value.id == "metrics":
+            yield node
+
+
+def _name_arg(call):
+    if call.args:
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg == "name":
+            return kw.value
+    return None
+
+
+@register_rule("metric-names")
+class MetricNamesRule(Rule):
+    """Every metrics.counter/histogram/gauge call site passes a name
+    CONSTANT declared in metrics.py — never a string literal.
+
+    A typo'd stringly family name would silently fork a metric family;
+    the registry of names in metrics.py is the single place scrape
+    dashboards are built against. Declared names must also follow the
+    Prometheus conventions (tidb_tpu_ prefix, lowercase, unit suffix
+    _total/_seconds/_bytes).
+    """
+
+    min_sites = 10      # the session + coprocessor layers really emit
+    fixture = (
+        "from tidb_tpu import metrics\n"
+        "def f():\n"
+        "    metrics.counter('tidb_tpu_oops_total')\n"
+    )
+    fixture_support = {
+        _METRICS: 'QUERIES_TOTAL = "tidb_tpu_queries_total"\n',
+    }
+
+    def check(self, forest):
+        decl_pf = forest.get(_METRICS)
+        if decl_pf is None:
+            yield Finding(_METRICS, 1, self.name,
+                          "metrics.py missing from the forest — the "
+                          "metric-name registry is gone")
+            return
+        consts = declared_constants(decl_pf)
+        if not consts:
+            yield Finding(_METRICS, 1, self.name,
+                          "metrics.py lost its name constants")
+        for const, (value, lineno) in consts.items():
+            ok = (value.startswith("tidb_tpu_") and value == value.lower()
+                  and value.endswith(("_total", "_seconds", "_bytes")))
+            if not ok:
+                yield Finding(
+                    decl_pf.rel, lineno, self.name,
+                    f"{const} = {value!r} breaks Prometheus naming: "
+                    f"tidb_tpu_ prefix, lowercase, unit suffix "
+                    f"_total/_seconds/_bytes")
+        for pf in forest:
+            for call in _metric_calls(pf):
+                self.sites += 1
+                arg = _name_arg(call)
+                if arg is None:
+                    yield Finding(pf.rel, call.lineno, self.name,
+                                  "metric call without a name argument")
+                    continue
+                if isinstance(arg, ast.Attribute) and \
+                        isinstance(arg.value, ast.Name) and \
+                        arg.value.id == "metrics" and arg.attr in consts:
+                    continue
+                yield Finding(
+                    pf.rel, call.lineno, self.name,
+                    f"metric name must be a metrics.<CONSTANT> declared "
+                    f"in metrics.py, got {ast.dump(arg)[:60]}")

@@ -1,0 +1,116 @@
+"""Device synchronization discipline: the ported block_until_ready ban
+(tests/test_lint_sync.py) plus its generalization to every other way of
+forcing a device value onto the host."""
+
+from __future__ import annotations
+
+import ast
+
+from tidb_tpu.lint.astutil import enclosing_map, root_name
+from tidb_tpu.lint.engine import Finding, Rule, register_rule
+
+_PROFILER = "tidb_tpu/runtime_stats.py"
+
+
+@register_rule("hot-path-sync")
+class HotPathSyncRule(Rule):
+    """block_until_ready appears nowhere in the package except
+    runtime_stats.py (the gated profiling path).
+
+    The dispatch-ahead pipeline's whole win is that superchunk k+1
+    transfers while k executes; ONE accidental block_until_ready on the
+    hot path serializes every dispatch and silently erases the overlap.
+    Syncs at operator output boundaries use jax.device_get, which is
+    visible in review precisely because it returns the data. Matched as
+    Name, Attribute, or string constant, so aliased imports and
+    getattr(jax, "block_until_ready") are all caught.
+    """
+
+    min_sites = 1       # the sanctioned profiling site must still exist
+    fixture = "def f(arr):\n    return arr.block_until_ready()\n"
+
+    def check(self, forest):
+        for pf in forest:
+            for node in pf.nodes:
+                hit = (isinstance(node, ast.Attribute) and
+                       node.attr == "block_until_ready") or \
+                      (isinstance(node, ast.Name) and
+                       node.id == "block_until_ready") or \
+                      (isinstance(node, ast.Constant) and
+                       node.value == "block_until_ready")
+                if not hit:
+                    continue
+                if pf.rel == _PROFILER:
+                    self.sites += 1     # sanctioned: profiling owns it
+                    continue
+                yield Finding(
+                    pf.rel, node.lineno, self.name,
+                    "block_until_ready on the hot path (use "
+                    "jax.device_get at an output boundary, or "
+                    "runtime_stats.device_call for gated profiling)")
+
+
+@register_rule("device-sync")
+class DeviceSyncRule(Rule):
+    """Device values are materialized on the host only in finalize()
+    helpers (or the gated profiler): no stray jax.device_get / .item()
+    / np.asarray on device arrays mid-pipeline.
+
+    Every kernel is split into async dispatch() and blocking finalize()
+    so transfers overlap execution; a device_get (or an .item() /
+    np.asarray over a jnp value, which device-transfers implicitly)
+    anywhere else reintroduces a serialization point invisible to the
+    pipeline. Matched: any spelling of device_get, plus .item()/
+    np.asarray/np.array whose receiver/argument is syntactically rooted
+    at jnp or jax. Sanctioned: functions named finalize, and
+    runtime_stats.py.
+    """
+
+    min_sites = 1       # the finalize() device_gets must still exist
+    fixture = (
+        "import jax\n"
+        "def step(pending):\n"
+        "    return jax.device_get(pending)\n"
+    )
+
+    def check(self, forest):
+        for pf in forest:
+            if pf.rel == _PROFILER:
+                continue
+            enclosing = None    # built on first hit: most files have none
+            for node in pf.nodes:
+                site = self._sync_kind(node)
+                if site is None:
+                    continue
+                self.sites += 1
+                if enclosing is None:
+                    enclosing = enclosing_map(pf.tree)
+                func = enclosing(node.lineno)
+                if func.split(".")[-1] == "finalize":
+                    continue            # sanctioned output boundary
+                yield Finding(
+                    pf.rel, node.lineno, self.name,
+                    f"{site} outside a finalize() helper forces a "
+                    f"device->host sync mid-pipeline — move it to the "
+                    f"kernel's finalize() output boundary")
+
+    @staticmethod
+    def _sync_kind(node) -> str | None:
+        if isinstance(node, ast.Attribute) and node.attr == "device_get":
+            return "device_get"
+        if isinstance(node, ast.Name) and node.id == "device_get":
+            return "device_get"
+        if isinstance(node, ast.Constant) and node.value == "device_get":
+            return "device_get"
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and fn.attr == "item" and \
+                    root_name(fn.value) in ("jnp", "jax"):
+                return ".item() on a device value"
+            if isinstance(fn, ast.Attribute) and \
+                    fn.attr in ("asarray", "array") and \
+                    isinstance(fn.value, ast.Name) and \
+                    fn.value.id == "np" and node.args and \
+                    root_name(node.args[0]) in ("jnp", "jax"):
+                return "np.asarray on a device value"
+        return None

@@ -44,8 +44,7 @@ __all__ = ["MemTracker", "QuotaExceededError", "SERVER", "tracking",
            "suspended", "current", "session_root", "statement_root",
            "op_node", "consume", "release", "device_scope", "track_to",
            "register_spill",
-           "chunk_bytes", "device_put_bytes", "sessions_snapshot",
-           "AUDITED_HELPERS"]
+           "chunk_bytes", "device_put_bytes", "sessions_snapshot"]
 
 
 class QuotaExceededError(Exception):
@@ -424,25 +423,9 @@ def device_put_bytes(chunk, size: int | None = None) -> int:
     return total
 
 
-# -- allocation-lint registry (tests/test_lint_memtrack.py) -----------------
-
-# Functions in executor/ and ops/ whose data-sized numpy allocations are
-# covered by tracker accounting — either the function's owner consumes
-# the bytes directly (SpillSorter, pad_column at dispatch sites) or the
-# allocation is bounded by an already-tracked quantity (group-count-sized
-# agg outputs, join-emit padding over tracked builds). The AST lint
-# requires every other data-sized np.empty/np.zeros/np.concatenate site
-# to carry an explicit `# memtrack: exempt <reason>` tag, so a new
-# operator cannot silently bypass accounting.
-AUDITED_HELPERS = frozenset({
-    "executor/__init__.py::_agg_results_to_chunk",
-    "executor/__init__.py::HashJoinExec._emit",
-    "executor/__init__.py::HashJoinExec._emit_right_unmatched",
-    "executor/__init__.py::MergeJoinExec.chunks",
-    "executor/extsort.py::SpillSorter._encode",
-    "executor/extsort.py::SpillSorter.sorted_chunks",
-    "ops/runtime.py::pad_column",
-    "ops/join.py::JoinKeyEncoder.fit_build",
-    "ops/join.py::JoinKeyEncoder.transform_probe",
-    "ops/hostagg.py::_agg_lanes_vectorized",
-})
+# The allocation lint that used to consult an AUDITED_HELPERS function
+# registry here now lives in tidb_tpu/lint (rule `memtrack-alloc`):
+# helpers whose data-sized numpy allocations are covered by tracker
+# accounting carry a lint-exempt tag (rule memtrack-alloc, with reason)
+# on their def, and the engine's unused-suppression check reports any
+# tag that stops matching (the old registry-staleness guard).

@@ -87,6 +87,7 @@ def _key_bits(xp, d):
     return d.astype(ut)
 
 
+# lint: exempt[dtype-discipline] row hashes are int64 by contract: splitmix64 bit patterns, sentinel headroom at both int64 extremes
 def _hash_keys(xp, key_cols, n, seed: int):
     """Combine (data, valid) key lanes into one int64 hash per row.
     NULL contributes a distinct tag so NULL groups separately from 0."""
@@ -129,6 +130,7 @@ def _direct_group_mode(group_exprs) -> bool:
                for g in group_exprs)
 
 
+# lint: exempt[dtype-discipline] group codes carry exact int64 key values (scaled decimals / epoch-micros exceed float range)
 def _direct_group_table(xp, group_exprs, cols, n, mask, C, pmax_axes=None):
     """Direct-indexed group table -> (uniq[C], inv[n] i32, tot).
     Strides come from data maxima (pmax over the mesh axes so every
@@ -177,6 +179,7 @@ def _cond_direct_mode(group_exprs) -> bool:
     return True
 
 
+# lint: exempt[dtype-discipline] exact int64 key codes + float64 span product (span overflow check must not round at 2^53)
 def _cond_group_table(xp, group_exprs, cols, n, mask, h, C,
                       pmax_axes=None):
     """Runtime-selected group table: if the keys' (min..max) span
@@ -237,6 +240,7 @@ def _cond_group_table(xp, group_exprs, cols, n, mask, h, C,
     return lax.cond(small, direct, hashed, None)
 
 
+# lint: exempt[dtype-discipline] packed sort rides the int64 hash lanes (row index bit-packed into the low hash bits)
 def _group_table(xp, x, m, C, mask=None):
     """Dense group-id table from one PACKED sort — the jnp.unique
     replacement. jnp.unique(size=C, return_inverse) costs a sort plus an
@@ -333,6 +337,7 @@ class _SegBatch:
         return self._out[i]
 
 
+# lint: exempt[dtype-discipline] int64 sum lanes: decimal sums exceed 2^53, float64 promotion would corrupt them
 def _agg_requests(xp, agg: AggDesc, cols, n, mask, batch: _SegBatch,
                   offs=None, row_ids=None):
     """Phase 1 of an aggregate's partial-state lanes: enqueue the per-row
@@ -485,6 +490,7 @@ class HashAggKernel:
         self._jit = jax.jit(self._kernel)
         self._jitd = None   # donating variant, built on first dispatch
 
+    # lint: exempt[dtype-discipline] int64 slot init: group slots hold exact key codes and decimal sums
     def _kernel(self, cols, nrows):
         n = cols[0][0].shape[0]
         xp = jnp
@@ -592,6 +598,7 @@ class ScalarAggKernel:
         self._jit = jax.jit(self._kernel)
         self._jitd = None
 
+    # lint: exempt[dtype-discipline] int64 COUNT lane: exact even past 2^53 rows, matches the agg-state stacking dtype
     def _kernel(self, cols, nrows):
         n = cols[0][0].shape[0]
         xp = jnp

@@ -189,6 +189,7 @@ def bucket_size(n: int) -> int:
     return b
 
 
+# lint: exempt[memtrack-alloc] callers bill padded superchunk staging at dispatch (superchunk_batches tracker)
 def pad_column(data: np.ndarray, valid: np.ndarray, size: int):
     n = len(data)
     if n == size:

@@ -54,6 +54,7 @@ class SegmentAggKernel:
         self._jit = jax.jit(self._kernel)
         self._jitd = None   # donating variant, built on first dispatch
 
+    # lint: exempt[dtype-discipline] int64 segment counts/ids: exact lane semantics shared with hashagg's agg-state stacking
     def _kernel(self, cols, nrows):
         xp = jnp
         n = cols[0][0].shape[0]

@@ -245,6 +245,7 @@ class MeshKernelBase:
         # ONE batched device->host transfer for the whole output pytree
         # (per-array reads each pay full round-trip latency; see
         # ops/hashagg.py HashAggKernel.__call__)
+        # lint: exempt[device-sync] mesh collectives are synchronous; this IS the kernel's output boundary (no async finalize split on the pmap path)
         uniq, cnt, h2min, h2max, rep, agg_out, tot = jax.device_get(outs)
         # tot counts the masked sentinel / fill phantoms; _C holds >= 2
         # headroom slots for them, so tot > _C means possible truncation

@@ -194,6 +194,7 @@ class MeshShuffleJoinKernel:
             # the cap-sized pair buffers without transferring them; the
             # success path batches gl/gr/ok into one device_get (per-array
             # reads each pay full round-trip latency through the tunnel)
+            # lint: exempt[device-sync] overflow-retry control read: the capacity decision must land on the host before the pair buffers transfer
             totals, fl, fr = jax.device_get((totals, fl, fr))
             need_l = int(np.max(fl))
             need_r = int(np.max(fr))
@@ -207,6 +208,7 @@ class MeshShuffleJoinKernel:
             if max_total > out_cap:
                 out_cap = runtime.bucket_size(max_total)
                 continue
+            # lint: exempt[device-sync] mesh shuffle-join output boundary: one batched transfer on the success path
             gl, gr, ok = jax.device_get((gl, gr, ok))
             sel = np.flatnonzero(ok)
             return (gl[sel].astype(np.int64),

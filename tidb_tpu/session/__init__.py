@@ -15,7 +15,7 @@ import time
 import weakref
 from dataclasses import dataclass, field
 
-from tidb_tpu import kv, tablecodec
+from tidb_tpu import errcode, kv, tablecodec
 from tidb_tpu.executor import (ExecContext, ExecError, build_executor)
 from tidb_tpu.ddl import DDLExecutor
 from tidb_tpu.meta import Meta
@@ -864,9 +864,10 @@ class Session:
                 for t in stmt.tables:
                     db = t.db or self.current_db
                     if not ischema.has_table(db, t.name):
-                        # MySQL: Note 1051 per missing IF EXISTS target
+                        # MySQL: one Note per missing IF EXISTS target
                         self.add_warning(
-                            "Note", 1051, f"Unknown table '{db}.{t.name}'")
+                            "Note", errcode.ER_BAD_TABLE_ERROR,
+                            f"Unknown table '{db}.{t.name}'")
             from tidb_tpu.ddl import DDLError
             try:
                 DDLExecutor(self.storage).execute(stmt, self.current_db,

@@ -14,8 +14,9 @@ kvproto/tipb messages). Requests carry `u16 Cmd` + an args/kwargs
 tuple; responses carry the result value or a registered typed error.
 No pickle anywhere on the wire path: decoding cannot execute code, and
 malformed frames raise WireError (fuzzed in tests/test_wire.py; the
-no-pickle invariant is pinned by tests/test_lint_wire.py). On-disk
-snapshots (trusted, local files we wrote) live in store/snapshot.py.
+no-pickle invariant is pinned by the `wire-discipline` lint rule —
+tidb_tpu/lint, see docs/LINTS.md). On-disk snapshots (trusted, local
+files we wrote) live in store/snapshot.py.
 
 Streamed coprocessor replies (Cmd.COP_STREAM) are multi-frame: the
 server answers one request with STATUS_STREAM_FRAME frames under the

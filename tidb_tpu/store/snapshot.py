@@ -3,10 +3,11 @@
 Pickle lives HERE, off the wire path: snapshots are trusted local files
 this process wrote itself (the same trust domain as the process image),
 while everything crossing a socket rides the closed typed contract of
-store/wire.py. tests/test_lint_wire.py pins that split — wire-path
-modules (wire, remote, stream, copr, mockstore.rpc) must never import
-pickle, so a refactor cannot silently reopen the decode-executes-code
-hole the typed codec closed.
+store/wire.py. The `wire-discipline` lint rule (tidb_tpu/lint, see
+docs/LINTS.md) pins that split — wire-path modules (wire, remote,
+stream, copr, mockstore.rpc) must never import pickle, so a refactor
+cannot silently reopen the decode-executes-code hole the typed codec
+closed.
 """
 
 from __future__ import annotations

@@ -27,6 +27,7 @@ _enabled_dir: str | None = None
 def default_dir() -> str:
     return os.environ.get(
         "TIDB_TPU_COMPILE_CACHE",
+        # lint: exempt[sysvar-registry] cache directory name, not a sysvar
         os.path.join(os.path.expanduser("~"), ".cache", "tidb_tpu_xla"))
 
 
