@@ -247,6 +247,16 @@ def _memory_roofline_gbps() -> tuple[float, str]:
     return (3 * 2 * buf.nbytes / dt) / 1e9, f"measured-memcpy({kind})"
 
 
+def _hbm_counters() -> dict:
+    """HBM region-block cache counters (store/device_cache.py): the
+    warm/cold series' companion — warm runs should be all hits."""
+    from tidb_tpu import metrics
+    snap = metrics.snapshot()
+    return {"hits": int(snap.get(metrics.HBM_CACHE_HITS, 0)),
+            "misses": int(snap.get(metrics.HBM_CACHE_MISSES, 0)),
+            "evictions": int(snap.get(metrics.HBM_CACHE_EVICTIONS, 0))}
+
+
 _TABLE_PREFIX = {"region": "r_", "nation": "n_", "customer": "c_",
                  "supplier": "s_", "orders": "o_", "lineitem": "l_"}
 
@@ -288,16 +298,34 @@ def main() -> None:
               file=sys.stderr, flush=True)
         import jax
         jax.config.update("jax_platforms", "cpu")
-        # the persistent cache is for slow through-the-tunnel TPU
-        # compiles; on CPU it can LOAD AOT results compiled under a
-        # different virtualized feature set (prefer-no-scatter etc.),
-        # which deoptimizes scatter-heavy programs ~5x (measured on Q3)
-        jax.config.update("jax_compilation_cache_dir", None)
-        # the upcoming tidb_tpu import would re-enable it from
-        # TIDB_TPU_COMPILE_CACHE (util/compile_cache.enable at package
-        # import); poison the env so that enable() no-ops and the stale
-        # tunnel-compiled entries stay unloaded
-        os.environ["TIDB_TPU_COMPILE_CACHE"] = "0"
+        # the base cache dir holds through-the-tunnel TPU compiles; CPU
+        # must not load AOT results built for a different virtualized
+        # feature set (prefer-no-scatter etc. deoptimize scatter-heavy
+        # programs ~5x, measured on Q3). BENCH r05 solved that by
+        # DISABLING the cache — which re-paid Q1's ~49s first compile in
+        # every bench process. Instead: scope the cache to a
+        # per-host-feature-set CPU subdirectory (compile_cache.
+        # scoped_cpu_dir), so CPU entries stay warm across runs and
+        # tunnel entries stay unloaded. Importing the package here is
+        # safe — jax_platforms is already pinned to cpu above.
+        from tidb_tpu.util import compile_cache
+        base = os.environ.get("TIDB_TPU_COMPILE_CACHE", _CACHE_DIR)
+        if base and base != "0":
+            scoped = compile_cache.scoped_cpu_dir(base)
+            os.environ["TIDB_TPU_COMPILE_CACHE"] = scoped
+            os.environ["JAX_COMPILATION_CACHE_DIR"] = scoped
+            # persist EVERY program (floor 0): CPU programs often
+            # compile in <1s apiece, and any floor-skipped program is a
+            # guaranteed miss in every later bench process — the
+            # warm-run contract is misses == 0
+            # (tests/test_compile_cache_warm.py)
+            os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0"
+            compile_cache.enable(scoped, min_compile_secs=0.0)
+        else:
+            # explicit operator disable (TIDB_TPU_COMPILE_CACHE=0)
+            # stays disabled — don't resurrect a cache the operator
+            # just killed (e.g. after a poisoning incident)
+            jax.config.update("jax_compilation_cache_dir", None)
         device_fallback = f"cpu ({reason})"
         if "BENCH_SF" not in os.environ:
             # CPU XLA runs the warm path ~20-40x slower than a chip;
@@ -361,7 +389,13 @@ def main() -> None:
                     "baseline_kind": "measured numpy host executor "
                                      "(no Go toolchain; BASELINE.md)",
                     "memory_roofline_gbps": round(roof_gbps, 1),
-                    "memory_roofline_source": roof_src}
+                    "memory_roofline_source": roof_src,
+                    # cross-round comparability: XLA device-path times
+                    # scale with cores (numpy host baseline much less),
+                    # so a rows/s move between rounds is only meaningful
+                    # at equal core counts (r05 vs r06 showed a ~3x
+                    # device-path swing from container size alone)
+                    "host_cpus": os.cpu_count()}
     if device_fallback:
         detail["device_platform_fallback"] = device_fallback
     if prober is not None and prober.snapshot is not None:
@@ -377,12 +411,16 @@ def main() -> None:
         # device path: mesh over the visible chip(s) + device kernels
         config.set_var("tidb_tpu_device", 1)
         mesh_config.enable_mesh()
-        progress(f"{qname}: device warm-up (compile)")
+        progress(f"{qname}: device cold run (compile + cache fill)")
+        hbm0 = _hbm_counters()
         warm0 = time.perf_counter()
-        session.query(sql)   # compile + cache fill
-        warm_secs = time.perf_counter() - warm0
-        progress(f"{qname}: device warm took {warm_secs:.1f}s; timing")
+        session.query(sql)   # compile + chunk/HBM cache fill
+        cold_secs = time.perf_counter() - warm0
+        hbm_cold = _hbm_counters()
+        progress(f"{qname}: device cold took {cold_secs:.1f}s; timing "
+                 f"warm")
         d_secs, d_rows = _time_query(session, sql, iters)
+        hbm_warm = _hbm_counters()
 
         # per-operator device-time attribution: one extra instrumented
         # run with tidb_tpu_runtime_stats_device on (block_until_ready
@@ -476,7 +514,25 @@ def main() -> None:
             "device_scan_gbps": round(d_gbps, 3),
             "roofline_fraction": round(d_gbps / roof_gbps, 4),
             "speedup": round(d_rps / h_rps, 2),
-            "first_run_secs": round(warm_secs, 2),
+            # warm/cold split: cold_* is the first execution (compile
+            # load + scan + decode + cache fill), warm_* the best of the
+            # timed iterations serving from the chunk/HBM caches —
+            # device_secs/roofline_fraction remain the warm numbers for
+            # cross-round diffing, first_run_secs the cold alias
+            "cold_secs": round(cold_secs, 4),
+            "warm_secs": round(d_secs, 4),
+            "cold_rows_per_sec": round(in_rows / cold_secs, 1),
+            "warm_rows_per_sec": round(d_rps, 1),
+            "cold_roofline_fraction": round(
+                in_bytes / cold_secs / 1e9 / roof_gbps, 4),
+            "warm_roofline_fraction": round(d_gbps / roof_gbps, 4),
+            "first_run_secs": round(cold_secs, 2),
+            # HBM region-block cache traffic, split at the cold/warm
+            # boundary: a healthy warm phase is all hits
+            "hbm_cache": {
+                "cold": {k: hbm_cold[k] - hbm0[k] for k in hbm0},
+                "warm": {k: hbm_warm[k] - hbm_cold[k] for k in hbm0},
+            },
             "result_rows": len(d_rows),
             "op_device_time_ns": op_device,
             "op_stats": op_detail,
@@ -515,6 +571,8 @@ def main() -> None:
     # first-run stall of BENCH_r05 becomes a hit on every warm run)
     from tidb_tpu.util import compile_cache
     detail["compile_cache"] = compile_cache.stats()
+    # process-cumulative HBM cache counters (per-query splits above)
+    detail["hbm_cache_totals"] = _hbm_counters()
 
     geo_rps = math.exp(sum(math.log(x) for x in device_rps)
                        / len(device_rps))

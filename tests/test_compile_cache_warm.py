@@ -1,0 +1,85 @@
+"""Warm-run persistent-compile-cache regression (ISSUE 6 satellite).
+
+BENCH r05 showed Q1 `first_run_secs: 48.82` DESPITE the persistent XLA
+cache from PR 3 — the bench's CPU-fallback path disabled the cache
+outright (to avoid loading AOT entries compiled for a different
+virtualized feature set), so every bench process re-paid the first
+compile. The fix scopes the cache to a per-host-feature-set CPU
+subdirectory (`util/compile_cache.scoped_cpu_dir`) instead of
+disabling it. Pinned here:
+
+  * the scoping helper is stable, distinct from the base dir, and
+    distinct per feature set;
+  * the bench-level contract — a SECOND process over the same scoped
+    cache dir reports compile-cache misses == 0 (everything loads from
+    disk) and at least one hit.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+from tidb_tpu.util import compile_cache
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_PROG = r"""
+import json, os
+from tidb_tpu.util import compile_cache
+# the package enables the cache at import with the production 1s
+# min-compile floor; this probe's programs compile in ms, so lower the
+# floor to catch them (bench's real Q1 program is far above the floor)
+compile_cache.enable(min_compile_secs=0.0)
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def f(x):
+    return (jnp.sin(x) @ jnp.cos(x.T)).sum()
+
+f(jnp.arange(2048.0, dtype=jnp.float32).reshape(32, 64))
+print("STATS " + json.dumps(compile_cache.stats()))
+"""
+
+
+def test_scoped_cpu_dir_stable_and_distinct():
+    base = os.path.join("/tmp", "cc-base")
+    d1 = compile_cache.scoped_cpu_dir(base)
+    assert d1 == compile_cache.scoped_cpu_dir(base)     # deterministic
+    assert d1.startswith(os.path.join(base, "cpu-"))
+    assert len(os.path.basename(d1)) == len("cpu-") + 12
+    # the tag really fingerprints the feature set (arch+jax+cpu flags)
+    assert compile_cache.cpu_feature_tag() == \
+        compile_cache.cpu_feature_tag()
+
+
+def _run(cache_dir: str) -> dict:
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               TIDB_TPU_COMPILE_CACHE=cache_dir,
+               JAX_COMPILATION_CACHE_DIR=cache_dir,
+               JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="0")
+    proc = subprocess.run([sys.executable, "-c", _PROG],
+                          capture_output=True, text=True, timeout=240,
+                          env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    for line in proc.stdout.splitlines():
+        if line.startswith("STATS "):
+            return json.loads(line[len("STATS "):])
+    raise AssertionError(f"no STATS line in: {proc.stdout!r}")
+
+
+def test_warm_run_compile_cache_misses_zero(tmp_path):
+    """The bench regression pin: process 1 compiles into the scoped
+    dir; process 2 (the 'warm bench run') must load everything —
+    misses == 0 — exactly what kills the 48.8s Q1 first-run stall."""
+    scoped = compile_cache.scoped_cpu_dir(str(tmp_path))
+    cold = _run(scoped)
+    assert cold["dir"] == scoped          # cache ENABLED, not poisoned
+    assert cold["misses"] >= 1            # really compiled
+    assert cold["entries"] >= 1           # really persisted
+    warm = _run(scoped)
+    assert warm["dir"] == scoped
+    assert warm["misses"] == 0, warm      # the whole point of the fix
+    assert warm["hits"] >= 1, warm

@@ -56,10 +56,17 @@ def sess():
 def streaming():
     old = {k: config.get_var(k) for k in
            ("tidb_tpu_copr_stream", "tidb_tpu_copr_stream_frame_bytes",
-            "tidb_tpu_copr_stream_credit")}
+            "tidb_tpu_copr_stream_credit", "tidb_tpu_chunk_cache")}
     config.set_var("tidb_tpu_copr_stream", 1)
     config.set_var("tidb_tpu_copr_stream_frame_bytes", FRAME_BYTES)
     config.set_var("tidb_tpu_copr_stream_credit", CREDIT)
+    # the frame contracts pinned in this file (byte cap, exact range
+    # tiling, resume boundaries) are the COLD-path guarantees: with the
+    # chunk cache on, a re-read of a resident range legitimately serves
+    # as ONE final frame straight from the cached block instead
+    # (TestStreamCacheIntegration pins that shape) — so these tests run
+    # cache-off to exercise the real framed scan every time
+    config.set_var("tidb_tpu_chunk_cache", 0)
     costream.reset_stream_stats()
     yield
     for k, v in old.items():
@@ -402,3 +409,110 @@ class TestRemoteStream:
             s.close()
             st.close()
             srv.close()
+
+
+class TestStreamCacheIntegration:
+    """COP_STREAM consults and fills the columnar cache hierarchy
+    (store/stream.py module docstring) — the fix that let
+    tidb_tpu_copr_stream default ON. Cold streams keep the bounded
+    framed contract and fill the host chunk cache at stream end; warm
+    streams serve one final frame per region straight from residency,
+    and fused agg plans hit the HBM device cache."""
+
+    @pytest.fixture
+    def cached_streaming(self):
+        old = {k: config.get_var(k) for k in
+               ("tidb_tpu_copr_stream", "tidb_tpu_copr_stream_frame_bytes",
+                "tidb_tpu_copr_stream_credit", "tidb_tpu_chunk_cache",
+                "tidb_tpu_device_min_rows")}
+        config.set_var("tidb_tpu_copr_stream", 1)
+        config.set_var("tidb_tpu_copr_stream_frame_bytes", FRAME_BYTES)
+        config.set_var("tidb_tpu_copr_stream_credit", CREDIT)
+        config.set_var("tidb_tpu_chunk_cache", 1)
+        config.set_var("tidb_tpu_device_min_rows", 1)
+        costream.reset_stream_stats()
+        yield
+        for k, v in old.items():
+            config.set_var(k, v)
+
+    def test_streaming_defaults_on(self):
+        """The documented default (docs/PERF.md): streaming no longer
+        trades away cache residency, so it is on out of the box."""
+        import tidb_tpu.config as cfg
+        assert cfg._DEFS["tidb_tpu_copr_stream"][1] == 1
+
+    def test_cold_fills_then_warm_single_frames(self, sess,
+                                                cached_streaming):
+        sql = "SELECT COUNT(*), SUM(v) FROM t"
+        cold = q(sess, sql)
+        st1 = costream.stream_stats()
+        assert st1["streams"] >= 4
+        assert st1["frames"] > st1["streams"]   # cold: real framed scan
+        costream.reset_stream_stats()
+        warm = q(sess, sql)
+        st2 = costream.stream_stats()
+        assert warm == cold
+        # warm: every region serves as ONE final frame from the cache
+        assert st2["streams"] >= 4
+        assert st2["frames"] == st2["streams"]
+
+    def test_warm_stream_hits_device_cache(self, sess, cached_streaming):
+        sql = "SELECT COUNT(*), SUM(v) FROM t"
+        q(sess, sql)            # cold: host-cache fill
+        q(sess, sql)            # warm: device-cache fill (fused path)
+        before = metrics.snapshot()
+        got = q(sess, sql)      # warm: fused dispatch from HBM
+        snap = metrics.snapshot()
+        assert got == [(N_ROWS, sum(i * 7 % 1000 for i in range(N_ROWS)))]
+        assert snap.get(metrics.HBM_CACHE_HITS, 0) - \
+            before.get(metrics.HBM_CACHE_HITS, 0) >= 4
+        assert snap.get(metrics.HBM_CACHE_MISSES, 0) == \
+            before.get(metrics.HBM_CACHE_MISSES, 0)
+
+    def test_write_between_streams_is_fresh(self, sess,
+                                            cached_streaming):
+        sql = "SELECT COUNT(*) FROM t"
+        assert q(sess, sql) == [(N_ROWS,)]
+        assert q(sess, sql) == [(N_ROWS,)]      # warm, from residency
+        sess.execute(f"INSERT INTO t VALUES ({N_ROWS + 5}, 1, 'zz')")
+        assert q(sess, sql) == [(N_ROWS + 1,)]  # version bump: fresh
+        assert q(sess, sql) == [(N_ROWS + 1,)]  # and warm again
+
+    def test_filter_scan_parity_warm_and_cold(self, sess,
+                                              cached_streaming):
+        sql = "SELECT id, v FROM t WHERE v >= 500 ORDER BY id"
+        cold = q(sess, sql)
+        warm = q(sess, sql)
+        assert cold == warm == _materialized(sess, sql)
+
+    def test_oversized_warm_agg_partial_streams_framed(self, sess,
+                                                       cached_streaming):
+        """A warm high-cardinality GROUP BY partial approaches the raw
+        block size; shipping it as ONE cached frame would bust the
+        streamed constant-client-memory contract. _cached_frame refuses
+        (returns None) and the region streams framed from the raw scan
+        instead — still correct, and the block stays resident for
+        materialized readers."""
+        sql = "SELECT v, COUNT(*) FROM t GROUP BY v ORDER BY v"
+        cold = q(sess, sql)
+        costream.reset_stream_stats()
+        warm = q(sess, sql)
+        st = costream.stream_stats()
+        assert warm == cold == _materialized(sess, sql)
+        # the ~1000-group partial busts the 1KB cap: every region must
+        # fall back to the framed raw scan, never one unbounded frame
+        assert st["streams"] >= 4
+        assert st["frames"] > st["streams"]
+        assert st["frame_bytes_max"] <= FRAME_BYTES
+        # the refusal memoized the over-cap size: the next warm stream
+        # skips the wasted fused dispatch and goes straight to the raw
+        # framed scan — _cached_frame must not run at all
+        calls = []
+        orig = costream._cached_frame
+        costream._cached_frame = lambda *a, **k: calls.append(1) or \
+            orig(*a, **k)
+        try:
+            assert q(sess, sql) == cold
+        finally:
+            costream._cached_frame = orig
+        assert not calls

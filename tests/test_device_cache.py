@@ -1,0 +1,319 @@
+"""HBM-resident region-block cache (store/device_cache.py) and the
+fused scan->filter->partial-agg dispatch it feeds (store/copr.py).
+
+Pins the acceptance contract of the cache: invalidation on write/DDL
+version bumps (no stale reads, ever), LRU eviction under a small
+`tidb_tpu_device_cache_bytes`, memtrack `hbm-cache` ledger exactness
+through fill/evict/shed (no leak), the registered SERVER OOM shed
+action, and bit-identical results between the fused device path, the
+unfused device path, and the host executors across dtypes, varlen dict
+columns and masked (non-power-of-two) tails."""
+
+import numpy as np
+import pytest
+
+from tidb_tpu import config, memtrack, metrics
+from tidb_tpu.session import Session
+from tidb_tpu.store import device_cache as dc
+from tidb_tpu.store.storage import new_mock_storage
+
+N_ROWS = 3000          # deliberately not a power of two: masked tails
+
+
+def q(s, sql):
+    return s.query(sql).rows
+
+
+def hbm():
+    snap = metrics.snapshot()
+    return {"hits": int(snap.get(metrics.HBM_CACHE_HITS, 0)),
+            "misses": int(snap.get(metrics.HBM_CACHE_MISSES, 0)),
+            "evictions": int(snap.get(metrics.HBM_CACHE_EVICTIONS, 0))}
+
+
+_VARS = ("tidb_tpu_device", "tidb_tpu_device_min_rows",
+         "tidb_tpu_device_cache_bytes", "tidb_tpu_fused_scan",
+         "tidb_tpu_copr_stream", "tidb_tpu_chunk_cache")
+
+
+@pytest.fixture
+def sysvars():
+    old = {k: config.get_var(k) for k in _VARS}
+    config.set_var("tidb_tpu_device_min_rows", 1)
+    yield
+    for k, v in old.items():
+        config.set_var(k, v)
+
+
+@pytest.fixture
+def sess(sysvars):
+    st = new_mock_storage()
+    s = Session(st)
+    s.execute("CREATE DATABASE d")
+    s.execute("USE d")
+    s.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, v BIGINT, "
+              "d DOUBLE, m DECIMAL(12,2), s VARCHAR(16))")
+    rows = []
+    for i in range(N_ROWS):
+        # NULL lanes every 11th row; negative values; repeated dict keys
+        v = "NULL" if i % 11 == 7 else str((i * 37) % 500 - 250)
+        d = "NULL" if i % 13 == 5 else repr((i % 97) * 0.25 - 12.0)
+        m = f"{(i % 701) - 350}.{i % 100:02d}"
+        rows.append(f"({i},{v},{d},{m},'k{i % 23}')")
+    s.execute("INSERT INTO t VALUES " + ",".join(rows))
+    info = s.domain.info_schema().table("d", "t")
+    st.cluster.split_table(info.id, 4, max_handle=N_ROWS)
+    yield s, st
+    s.close()
+
+
+AGG_SQLS = (
+    "SELECT COUNT(*), SUM(v), MIN(v), MAX(v) FROM t",
+    "SELECT SUM(d), AVG(d), COUNT(d) FROM t WHERE v > -100",
+    "SELECT s, COUNT(*), SUM(v), AVG(m) FROM t GROUP BY s ORDER BY s",
+    "SELECT s, MIN(d), MAX(m) FROM t WHERE v % 3 != 1 "
+    "GROUP BY s ORDER BY s",
+)
+
+
+def _approx_eq(a, b):
+    assert len(a) == len(b)
+    for ra, rb in zip(a, b):
+        assert len(ra) == len(rb)
+        for x, y in zip(ra, rb):
+            if isinstance(x, float) or isinstance(y, float):
+                assert abs(float(x) - float(y)) <= \
+                    max(1e-6, abs(float(y)) * 1e-9), (ra, rb)
+            else:
+                assert x == y, (ra, rb)
+
+
+class TestFusedParity:
+    def test_fused_unfused_host_agree(self, sess):
+        """The acceptance criterion: fused(scan->filter->partial-agg
+        over the cached device block) == unfused device == host, across
+        int/double/decimal lanes, varlen dict group keys, NULLs and
+        masked non-pow2 tails — cold AND warm."""
+        s, _st = sess
+        for sql in AGG_SQLS:
+            config.set_var("tidb_tpu_device", 0)
+            host = q(s, sql)
+            config.set_var("tidb_tpu_device", 1)
+            config.set_var("tidb_tpu_fused_scan", 0)
+            unfused = [q(s, sql), q(s, sql)]        # cold + warm
+            config.set_var("tidb_tpu_fused_scan", 1)
+            fused = [q(s, sql), q(s, sql)]          # fill + hit
+            for got in unfused + fused:
+                _approx_eq(got, host)
+
+    def test_warm_fused_runs_hit_the_cache(self, sess):
+        s, st = sess
+        config.set_var("tidb_tpu_fused_scan", 1)
+        sql = AGG_SQLS[0]
+        q(s, sql)                       # cold: host-cache fill
+        q(s, sql)                       # device-cache fill
+        before = hbm()
+        q(s, sql)                       # warm: pure hits
+        delta = {k: hbm()[k] - before[k] for k in before}
+        assert delta["hits"] >= 4       # one per region
+        assert delta["misses"] == 0
+        assert st.device_cache.resident_bytes() > 0
+
+    def test_fused_scan_off_never_touches_device_cache(self, sess):
+        s, st = sess
+        config.set_var("tidb_tpu_fused_scan", 0)
+        for _ in range(3):
+            q(s, AGG_SQLS[0])
+        assert len(st.device_cache) == 0
+
+
+class TestInvalidation:
+    def test_write_invalidates(self, sess):
+        """A committed write bumps the engine version: the next fused
+        read must see it (stale entries drop, counted as evictions)."""
+        s, _st = sess
+        sql = "SELECT COUNT(*), SUM(v) FROM t"
+        for _ in range(2):
+            q(s, sql)
+        warm = q(s, sql)
+        s.execute("INSERT INTO t VALUES (900001, 1000000, 1.5, "
+                  "'7.25', 'fresh')")
+        got = q(s, sql)     # version bumped: no stale read
+        assert got[0][0] == warm[0][0] + 1
+        assert got[0][1] == warm[0][1] + 1000000
+        # and the refreshed entries serve the NEW truth warm
+        assert q(s, sql) == got
+
+    def test_delete_invalidates(self, sess):
+        s, _st = sess
+        sql = "SELECT COUNT(*), MAX(v) FROM t"
+        for _ in range(2):
+            q(s, sql)
+        s.execute("DELETE FROM t WHERE v > 200")
+        got = q(s, sql)
+        config.set_var("tidb_tpu_device", 0)
+        _approx_eq(got, q(s, sql))
+
+    def test_ddl_invalidates(self, sess):
+        """DDL changes the schema fingerprint (and bumps the engine
+        version through its meta writes): post-DDL reads are fresh."""
+        s, _st = sess
+        sql = "SELECT s, COUNT(*) FROM t GROUP BY s ORDER BY s"
+        for _ in range(2):
+            q(s, sql)
+        s.execute("ALTER TABLE t ADD COLUMN extra BIGINT")
+        s.execute("UPDATE t SET extra = 5 WHERE id < 10")
+        got = q(s, "SELECT s, COUNT(*), SUM(extra) FROM t "
+                   "GROUP BY s ORDER BY s")
+        config.set_var("tidb_tpu_device", 0)
+        _approx_eq(got, q(s, "SELECT s, COUNT(*), SUM(extra) FROM t "
+                             "GROUP BY s ORDER BY s"))
+
+
+class TestBudgetAndLedger:
+    def test_eviction_under_small_budget(self, sess):
+        """A budget sized below the working set forces LRU evictions;
+        resident bytes stay within it and the ledger stays exact."""
+        s, st = sess
+        q(s, AGG_SQLS[0])
+        q(s, AGG_SQLS[0])           # fill once at the default budget
+        per_block = st.device_cache.resident_bytes() // max(
+            1, len(st.device_cache))
+        st.device_cache.shed()
+        base = dc.tracker().snapshot()["device"]
+        # room for ~2 of the 4 region blocks
+        config.set_var("tidb_tpu_device_cache_bytes", int(per_block * 2.5))
+        before = hbm()
+        q(s, AGG_SQLS[0])           # host-cache hot: straight to fills
+        delta = {k: hbm()[k] - before[k] for k in before}
+        assert delta["evictions"] >= 1
+        assert 0 < st.device_cache.resident_bytes() <= per_block * 2.5
+        assert dc.tracker().snapshot()["device"] - base == \
+            st.device_cache.resident_bytes()
+
+    def test_ledger_exact_through_fill_evict_shed(self, sess):
+        """No leak: the hbm-cache node's device ledger == resident
+        bytes at every stage, and returns to baseline after shed —
+        the device twin of test_mesh_path_is_tracked's exactness
+        contract."""
+        s, st = sess
+        base = dc.tracker().snapshot()["device"]
+        for sql in AGG_SQLS[:2]:
+            q(s, sql)
+            q(s, sql)
+        assert dc.tracker().snapshot()["device"] - base == \
+            st.device_cache.resident_bytes() > 0
+        s.execute("INSERT INTO t VALUES (900002, 1, 1.0, '1.00', 'x')")
+        q(s, AGG_SQLS[0])
+        q(s, AGG_SQLS[0])           # stale evict + refill
+        assert dc.tracker().snapshot()["device"] - base == \
+            st.device_cache.resident_bytes()
+        st.device_cache.shed()
+        assert dc.tracker().snapshot()["device"] == base
+        assert st.device_cache.resident_bytes() == 0
+        assert len(st.device_cache) == 0
+
+    def test_oom_action_registered_on_server_and_sheds(self, sess):
+        """The cache's shed is a memtrack OOM action on the SERVER
+        root: firing the registered action chain empties every live
+        cache and returns the ledger to baseline."""
+        s, st = sess
+        q(s, AGG_SQLS[0])
+        q(s, AGG_SQLS[0])
+        assert st.device_cache.resident_bytes() > 0
+        assert dc._shed_all in memtrack.SERVER._actions
+        assert st.device_cache.resident_bytes() > 0
+        for act in list(memtrack.SERVER._actions):
+            act()
+        # the action empties EVERY live cache (it is a server-wide
+        # pressure valve), so the shared ledger returns to zero exactly
+        assert st.device_cache.resident_bytes() == 0
+        assert dc.tracker().snapshot()["device"] == 0
+
+    def test_budget_shrink_takes_effect_on_lookup(self, sess):
+        """SET tidb_tpu_device_cache_bytes below current residency must
+        shrink the cache on the NEXT lookup, not only at the next fill —
+        warm workloads whose every access is a hit would otherwise pin
+        the old budget forever (found by an end-to-end drive: resident
+        bytes stayed 6x over a shrunken budget across whole queries)."""
+        s, st = sess
+        q(s, AGG_SQLS[0])
+        q(s, AGG_SQLS[0])           # resident at the default budget
+        resident = st.device_cache.resident_bytes()
+        assert resident > 0
+        new_budget = resident // 2
+        config.set_var("tidb_tpu_device_cache_bytes", new_budget)
+        base = dc.tracker().snapshot()["device"] - resident
+        before = hbm()
+        q(s, AGG_SQLS[0])           # hits enforce the shrunken budget
+        assert st.device_cache.resident_bytes() <= new_budget
+        assert hbm()["evictions"] > before["evictions"]
+        # ledger follows the evictions exactly
+        assert dc.tracker().snapshot()["device"] - base == \
+            st.device_cache.resident_bytes()
+
+    def test_budget_zero_sheds_on_next_consult(self, sess):
+        """SET tidb_tpu_device_cache_bytes = 0 must RECLAIM, not just
+        stop lookups: the 0 gate short-circuits before get(), so the
+        shrink-on-lookup path above can never run — enabled() itself
+        sheds instead. Without this, the documented '0 disables' leaves
+        the full residency pinned in HBM until storage close."""
+        s, st = sess
+        q(s, AGG_SQLS[0])
+        q(s, AGG_SQLS[0])
+        resident = st.device_cache.resident_bytes()
+        assert resident > 0
+        base = dc.tracker().snapshot()["device"] - resident
+        config.set_var("tidb_tpu_device_cache_bytes", 0)
+        q(s, AGG_SQLS[0])           # the consult observes budget 0
+        assert st.device_cache.resident_bytes() == 0
+        assert dc.tracker().snapshot()["device"] == base   # ledger settles
+
+    def test_storage_close_sheds(self, sysvars):
+        st = new_mock_storage()
+        s = Session(st)
+        s.execute("CREATE DATABASE d; USE d")
+        s.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, v BIGINT)")
+        s.execute("INSERT INTO t VALUES " + ",".join(
+            f"({i},{i})" for i in range(2500)))
+        q(s, "SELECT SUM(v) FROM t")
+        q(s, "SELECT SUM(v) FROM t")
+        assert st.device_cache.resident_bytes() > 0
+        s.close()
+        st.close()
+        assert st.device_cache.resident_bytes() == 0
+
+
+class TestUnitMVCC:
+    """Entry-level MVCC semantics without a session: version mismatch
+    drops for everyone; an old reader misses without dropping."""
+
+    def _chunk(self):
+        from tidb_tpu.chunk import Chunk, Column
+        from tidb_tpu.sqltypes import new_int_field
+        col = Column.from_values(new_int_field(), list(range(100)))
+        return Chunk([col])
+
+    def test_version_mismatch_drops(self, sysvars):
+        cache = dc.DeviceCache()
+        blk = cache.fill("k", 1, 10, self._chunk())
+        assert blk is not None
+        assert cache.get("k", 1, 10) is blk
+        assert cache.get("k", 2, 10) is None       # stale: dropped
+        assert len(cache) == 0
+        assert cache.resident_bytes() == 0
+
+    def test_old_reader_misses_entry_survives(self, sysvars):
+        cache = dc.DeviceCache()
+        blk = cache.fill("k", 1, 10, self._chunk())
+        assert cache.get("k", 1, 9) is None        # too old for reader
+        assert len(cache) == 1                     # but not dropped
+        assert cache.get("k", 1, 11) is blk        # newer reader serves
+        cache.shed()
+
+    def test_block_over_budget_not_cached(self, sysvars):
+        config.set_var("tidb_tpu_device_cache_bytes", 64)
+        cache = dc.DeviceCache()
+        assert cache.fill("k", 1, 10, self._chunk()) is None
+        assert len(cache) == 0
+        assert cache.resident_bytes() == 0

@@ -35,14 +35,14 @@ def report():
 
 
 def test_catalog_is_complete():
-    """4 ported rules + 6 project-specific rules."""
-    assert len(RULE_NAMES) == 10, RULE_NAMES
+    """4 ported rules + 7 project-specific rules."""
+    assert len(RULE_NAMES) == 11, RULE_NAMES
     for ported in ("wire-discipline", "hot-path-sync", "metric-names",
                    "memtrack-alloc"):
         assert ported in RULE_NAMES
     for new in ("lock-discipline", "sysvar-registry",
                 "errcode-discipline", "device-sync", "dtype-discipline",
-                "bare-except"):
+                "bare-except", "device-cache"):
         assert new in RULE_NAMES
 
 
@@ -86,7 +86,7 @@ def test_single_parse_wall_time(report):
 
 def test_cli_runs_clean_smoke():
     """One real `python -m tidb_tpu.lint` subprocess: exit 0, no
-    findings, all 10 rules, and the CLI's self-reported lint time well
+    findings, all 11 rules, and the CLI's self-reported lint time well
     under the old four-walk cost (~4.8s wall on this container). The
     reported time is the honest comparison basis: it excludes the
     interpreter+jax import, which the old walkers amortized across the
@@ -96,7 +96,7 @@ def test_cli_runs_clean_smoke():
         [sys.executable, "-m", "tidb_tpu.lint"],
         capture_output=True, text=True, timeout=240, env=env, cwd=REPO)
     assert proc.returncode == 0, proc.stdout + proc.stderr
-    assert "10 rule(s)" in proc.stdout
+    assert "11 rule(s)" in proc.stdout
     assert "0 finding(s)" in proc.stdout
     ms = int(re.search(r"finding\(s\) in (\d+) ms", proc.stdout).group(1))
     # measured: 2.3-3.7s standalone vs ~4.8s for the old four walkers;

@@ -198,6 +198,46 @@ class TestTxn:
         assert got == [(b"a", b"ba"), (b"c", b"bc"), (b"d", b"sd")]
 
 
+class TestTwoPCPool:
+    def test_nested_on_batches_runs_inline_no_deadlock(self, storage):
+        """_on_batches invoked ON a 2pc pool worker (async secondaries,
+        RegionError re-splits) must fan out inline: submitting the
+        sub-batches to the same bounded pool and blocking on their
+        results deadlocks once every worker is a blocked parent — the
+        stuck non-daemon workers then hang interpreter shutdown."""
+        from tidb_tpu.kv import Mutation, MutationOp
+        from tidb_tpu.store.txn import TwoPhaseCommitter
+        storage.cluster.split(b"k2")
+        storage.cluster.split(b"k4")
+        muts = {k: Mutation(MutationOp.PUT, k, b"v")
+                for k in (b"k1", b"k3", b"k5")}   # three regions
+        c = TwoPhaseCommitter(
+            storage.shim, storage.region_cache, storage.oracle,
+            storage.resolver, muts, storage.oracle.get_timestamp(),
+            concurrency=1)
+        try:
+            done = threading.Event()
+            ran = []
+
+            def act(bo, batch):
+                ran.extend(batch.keys)
+
+            def on_worker():   # occupies the committer's ONLY worker
+                c._on_batches(fastbo(), list(muts), act,
+                              primary_first=False)
+                done.set()
+
+            f = c._pool.submit(on_worker)
+            assert done.wait(10.0), \
+                "nested _on_batches deadlocked on its own pool"
+            f.result()
+            assert sorted(ran) == sorted(muts)
+        finally:
+            # wait=False so a reintroduced deadlock fails the assert
+            # above instead of hanging the join here
+            c._pool.shutdown(wait=False)
+
+
 # -- distributed behavior: regions, retries, faults --------------------------
 
 class TestDistributed:
@@ -356,11 +396,16 @@ class TestOrderedCopParallel:
         st.cluster.split_table(ta.info.id, 8, max_handle=n)
         st.cluster.split_table(tb.info.id, 8, max_handle=n)
 
-        # count concurrently-running cop handlers during the merge join
-        st.client()   # installs the cop handler
+        # count concurrently-running cop tasks during the merge join —
+        # on BOTH storage surfaces: the materialized handler and the
+        # streaming producer (the default path streams; its KeepOrder
+        # mode runs a sliding window of parallel per-task streams
+        # drained in range order — copr._send_streaming_ordered)
+        st.client()   # installs the cop handlers
         active, seen_parallel = [0], [False]
         mu = threading.Lock()
         orig = st.shim._cop_handler
+        orig_stream = st.shim.coprocessor_stream
 
         def spy(region, req):
             with mu:
@@ -375,7 +420,21 @@ class TestOrderedCopParallel:
                 with mu:
                     active[0] -= 1
 
+        def spy_stream(ctx, req, **kw):
+            with mu:
+                active[0] += 1
+                if active[0] > 1:
+                    seen_parallel[0] = True
+            try:
+                import time as _t
+                _t.sleep(0.01)
+                yield from orig_stream(ctx, req, **kw)
+            finally:
+                with mu:
+                    active[0] -= 1
+
         st.shim.install_cop_handler(spy)
+        st.shim.coprocessor_stream = spy_stream
         # pk-pk join -> MergeJoin over keep_order readers
         q = "SELECT a.id, a.x, b.y FROM a JOIN b ON a.id = b.id"
         plan_txt = s.plan(q).explain()

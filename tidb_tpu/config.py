@@ -27,6 +27,7 @@ __all__ = ["get_var", "set_var", "all_vars", "coerce", "session_overlay",
            "copr_stream_enabled", "copr_stream_frame_bytes",
            "copr_stream_credit", "runtime_stats_enabled",
            "runtime_stats_device", "mem_quota_query",
+           "device_cache_bytes", "fused_scan_enabled",
            "UnknownVariableError"]
 
 
@@ -65,13 +66,18 @@ _DEFS: dict[str, tuple[str, int]] = {
     # streaming coprocessor (store/stream.py; ref: CmdCopStream,
     # store/tikv/coprocessor.go:547-555): storage yields framed partial
     # responses per contiguous key range instead of materializing one
-    # response list per region. 0 = materialized path (default: streaming
-    # trades the chunk cache's hot-scan residency for bounded memory, so
-    # it must be an explicit choice per session or deployment).
-    "tidb_tpu_copr_stream": (_BOOL, 0),
+    # response list per region. On by default since streams consult and
+    # populate the columnar chunk cache (and the HBM device cache when
+    # eligible) exactly like the materialized path — the old
+    # cache-bypass penalty that forced the default off is gone. 0 =
+    # materialized per-region response lists.
+    "tidb_tpu_copr_stream": (_BOOL, 1),
     # response-size cap: a streamed frame never carries more than this
     # many raw scanned bytes (the bound that makes SF>=1 scans run in
-    # constant client memory)
+    # constant client memory). Cache-resident ranges ship as ONE final
+    # frame only when the response respects this cap too: agg partials
+    # (tiny by construction) and raw blocks that fit a single frame —
+    # bigger resident blocks stream framed like a cold scan
     "tidb_tpu_copr_stream_frame_bytes": (_INT, 4 << 20),
     # credit window: max frames in flight past the consumer (client
     # grants N outstanding frames; the producer blocks past the window —
@@ -93,6 +99,20 @@ _DEFS: dict[str, tuple[str, int]] = {
     # while k executes (2 = classic double buffering). 1 serializes
     # dispatch against readback.
     "tidb_tpu_pipeline_depth": (_INT, 2),
+    # HBM-resident columnar region-block cache (store/device_cache.py):
+    # device-side budget in bytes for dict-encoded, padded region
+    # columns kept resident in HBM across queries, accounted on the
+    # memtrack SERVER device ledger and LRU-evicted past the budget.
+    # 0 disables (every dispatch re-uploads, the pre-cache behavior).
+    "tidb_tpu_device_cache_bytes": (_INT, 2 << 30),
+    # fused scan->filter->partial-agg dispatch (store/copr.py): an
+    # HBM-cached region block flows through predicate + partial
+    # aggregation in ONE compiled call — no per-op device_put/device_get
+    # round trips. 0 reverts the scan path to per-dispatch upload AND
+    # stops consulting/filling the device cache entirely: a cached
+    # block is only consumable by a kernel that accepts device-resident
+    # columns, i.e. the fused dispatch.
+    "tidb_tpu_fused_scan": (_BOOL, 1),
     # statements at/above this wall time land in the slow-query log
     # (ref: config.Log.SlowThreshold, default 300ms)
     "tidb_tpu_slow_query_ms": (_INT, 300),
@@ -290,3 +310,11 @@ def runtime_stats_device() -> bool:
 
 def mem_quota_query() -> int:
     return max(0, _read("tidb_tpu_mem_quota_query"))
+
+
+def device_cache_bytes() -> int:
+    return max(0, _read("tidb_tpu_device_cache_bytes"))
+
+
+def fused_scan_enabled() -> bool:
+    return bool(_read("tidb_tpu_fused_scan"))

@@ -1,6 +1,6 @@
 """Rule catalog: importing this package registers every rule, in the
 order CI reports them. Four ported from the original standalone test
-walkers, six project-specific additions."""
+walkers, seven project-specific additions."""
 
 from tidb_tpu.lint.rules import (  # noqa: F401  (import == register)
     wire,        # wire-discipline   (ported: tests/test_lint_wire.py)
@@ -12,4 +12,5 @@ from tidb_tpu.lint.rules import (  # noqa: F401  (import == register)
     errcodes,    # errcode-discipline
     dtypes,      # dtype-discipline
     excepts,     # bare-except
+    devcache,    # device-cache
 )

@@ -42,9 +42,10 @@ from tidb_tpu import metrics
 
 __all__ = ["MemTracker", "QuotaExceededError", "SERVER", "tracking",
            "suspended", "current", "session_root", "statement_root",
-           "op_node", "consume", "release", "device_scope", "track_to",
-           "register_spill",
-           "chunk_bytes", "device_put_bytes", "sessions_snapshot"]
+           "server_node", "op_node", "consume", "release", "device_scope",
+           "track_to", "register_spill",
+           "chunk_bytes", "result_bytes", "device_put_bytes",
+           "sessions_snapshot"]
 
 
 class QuotaExceededError(Exception):
@@ -271,6 +272,19 @@ def session_root(session_id: int) -> MemTracker:
     return t
 
 
+def server_node(label: str) -> MemTracker:
+    """A long-lived server-scope tracker (shared caches, pools): a child
+    of SERVER whose ledgers roll up into the server totals that
+    information_schema.memory_usage reports, without belonging to any
+    session or statement. The HBM region-block cache charges its
+    resident bytes here (store/device_cache.py) — budget enforcement is
+    the cache's LRU, visibility is this ledger."""
+    t = MemTracker(label, parent=SERVER)
+    with SERVER._mu:
+        SERVER.children[id(t)] = t
+    return t
+
+
 def statement_root(parent: MemTracker | None, quota: int = 0,
                    on_cancel=None, label: str = "stmt") -> MemTracker:
     t = MemTracker(label, parent=parent, quota=quota, on_cancel=on_cancel)
@@ -398,6 +412,28 @@ def chunk_bytes(chunk) -> int:
             total += sum(len(x) for x in data
                          if isinstance(x, (str, bytes)))
         total += len(c.valid)          # bool mask
+    return total
+
+
+def result_bytes(res) -> int:
+    """Host footprint of a coprocessor response payload: a decoded
+    Chunk (chunk_bytes), or an agg partial shaped like
+    ops.hashagg.GroupResult (keys / per-agg lane arrays / counts).
+    Anything else — scalar partials are a handful of lanes — rounds to
+    its lane arrays alone."""
+    if getattr(res, "columns", None) is not None:
+        return chunk_bytes(res)
+    total = 0
+    for lanes in getattr(res, "partials", None) or []:
+        for arr in lanes:
+            nb = getattr(arr, "nbytes", None)
+            total += nb if nb is not None else 8 * len(arr)
+    counts = getattr(res, "counts", None)
+    if counts is not None:
+        total += counts.nbytes
+    for key in getattr(res, "keys", None) or []:
+        total += 8 * max(len(key), 1)
+        total += sum(len(x) for x in key if isinstance(x, (str, bytes)))
     return total
 
 

@@ -22,7 +22,8 @@ __all__ = ["counter", "histogram", "gauge", "expose", "snapshot",
            "OP_DURATIONS", "OP_ROWS", "OP_DEVICE_DURATIONS",
            "SUPERCHUNKS", "SUPERCHUNK_SOURCES", "SUPERCHUNK_FILL_ROWS",
            "SUPERCHUNK_BUCKET_ROWS", "PIPELINE_STALLS",
-           "QUERY_MEM", "MEM_QUOTA_EXCEEDED", "DEVICE_PEAK"]
+           "QUERY_MEM", "MEM_QUOTA_EXCEEDED", "DEVICE_PEAK",
+           "HBM_CACHE_HITS", "HBM_CACHE_MISSES", "HBM_CACHE_EVICTIONS"]
 
 _lock = threading.Lock()
 _counters: dict[tuple[str, tuple], float] = {}
@@ -172,6 +173,13 @@ PIPELINE_STALLS = "tidb_tpu_pipeline_stall_seconds"
 QUERY_MEM = "tidb_tpu_query_mem_bytes"
 MEM_QUOTA_EXCEEDED = "tidb_tpu_mem_quota_exceeded_total"
 DEVICE_PEAK = "tidb_tpu_device_peak_bytes"
+# HBM-resident columnar region-block cache (store/device_cache.py): a
+# hit serves a dispatch straight from device-resident columns (zero
+# host->device bytes); evictions count LRU/budget drops AND stale-
+# version invalidation drops
+HBM_CACHE_HITS = "tidb_tpu_hbm_cache_hits_total"
+HBM_CACHE_MISSES = "tidb_tpu_hbm_cache_misses_total"
+HBM_CACHE_EVICTIONS = "tidb_tpu_hbm_cache_evictions_total"
 
 _HELP = {
     QUERY_DURATIONS: "Statement wall time through Session.execute.",
@@ -204,4 +212,10 @@ _HELP = {
         "Quota OOM-action firings, by action (spill|cancel).",
     DEVICE_PEAK:
         "Backend allocator peak-bytes watermark (process-wide).",
+    HBM_CACHE_HITS:
+        "Dispatches served from the HBM region-block cache.",
+    HBM_CACHE_MISSES:
+        "HBM region-block cache misses (upload paid).",
+    HBM_CACHE_EVICTIONS:
+        "HBM region-block cache entries dropped (LRU/stale/shed).",
 }

@@ -296,25 +296,43 @@ class _SegBatch:
     group-by program (CPU XLA scatters are serial; on TPU each scatter
     is a full HBM pass), so Q1's ~16 per-lane scatters collapse to ~4.
     dtype-separated stacking keeps int64 lanes exact (decimal sums can
-    exceed 2^53 — promoting through float64 would corrupt them)."""
+    exceed 2^53 — promoting through float64 would corrupt them).
+
+    Sum lanes may carry a `valid` mask instead of a pre-masked array:
+    on the TPU pallas path the mask fuses INTO the one-hot MXU kernel
+    (ops/pallas_agg._kernel_masked) so the predicate never materializes
+    a masked value copy in HBM; everywhere else run() lowers the mask to
+    the classic `where(valid, x, 0)` pre-pass, preserving the exact
+    pre-fusion program (and its stacking) bit for bit."""
 
     def __init__(self, inv, capacity: int):
         self.inv = inv
         self.capacity = capacity
-        self._reqs: list = []     # (op, array[n])
+        self._reqs: list = []     # (op, array[n], valid[n] | None)
         self._out: list | None = None
 
-    def add(self, x, op: str) -> int:
-        self._reqs.append((op, x))
+    def add(self, x, op: str, valid=None) -> int:
+        self._reqs.append((op, x, valid))
         return len(self._reqs) - 1
 
     def run(self) -> None:
         from tidb_tpu.ops import pallas_agg
-        groups: dict = {}
-        for i, (op, x) in enumerate(self._reqs):
-            groups.setdefault((op, x.dtype), []).append(i)
+        fuse = pallas_agg.available()
+        plain: list = []          # (i, op, x) after mask lowering
+        fused: list = []          # (i, x, valid) f32 sums for the MXU
+        for i, (op, x, valid) in enumerate(self._reqs):
+            if valid is not None and op == "sum" and fuse and \
+                    x.dtype == jnp.float32:
+                fused.append((i, x, valid))
+                continue
+            if valid is not None:
+                x = jnp.where(valid, x, jnp.zeros((), x.dtype))
+            plain.append((i, op, x))
         out: list = [None] * len(self._reqs)
-        for (op, _dt), idxs in groups.items():
+        groups: dict = {}
+        for i, op, x in plain:
+            groups.setdefault((op, x.dtype), []).append((i, x))
+        for (op, _dt), reqs in groups.items():
             if op == "sum":
                 # MXU one-hot matmul on TPU float lanes; XLA scatter
                 # elsewhere (pallas_agg dispatches)
@@ -322,14 +340,25 @@ class _SegBatch:
                     return pallas_agg.segment_sum(x, ids, num_segments)
             else:
                 fn = _SEG_FNS[op]
-            if len(idxs) == 1:
-                i = idxs[0]
-                out[i] = fn(self._reqs[i][1], self.inv,
-                            num_segments=self.capacity)
+            if len(reqs) == 1:
+                i, x = reqs[0]
+                out[i] = fn(x, self.inv, num_segments=self.capacity)
             else:
-                stk = jnp.stack([self._reqs[i][1] for i in idxs], axis=1)
+                stk = jnp.stack([x for _i, x in reqs], axis=1)
                 r = fn(stk, self.inv, num_segments=self.capacity)
-                for j, i in enumerate(idxs):
+                for j, (i, _x) in enumerate(reqs):
+                    out[i] = r[:, j]
+        if fused:
+            if len(fused) == 1:
+                i, x, valid = fused[0]
+                out[i] = pallas_agg.segment_sum(
+                    x, self.inv, num_segments=self.capacity, valid=valid)
+            else:
+                stk = jnp.stack([x for _i, x, _v in fused], axis=1)
+                mstk = jnp.stack([v for _i, _x, v in fused], axis=1)
+                r = pallas_agg.segment_sum(
+                    stk, self.inv, num_segments=self.capacity, valid=mstk)
+                for j, (i, _x, _v) in enumerate(fused):
                     out[i] = r[:, j]
         self._out = out
 
@@ -360,13 +389,13 @@ def _agg_requests(xp, agg: AggDesc, cols, n, mask, batch: _SegBatch,
         i0 = batch.add(live_i, "sum")
         return lambda g: [(g(i0), "sum")]
     if fn == AggFunc.SUM:
-        zero = 0.0 if d.dtype == jnp.float64 else 0
-        i0 = batch.add(xp.where(live, d, zero), "sum")
+        # the mask rides the request: fused into the MXU kernel on the
+        # pallas path, lowered to where(live, d, 0) everywhere else
+        i0 = batch.add(d, "sum", valid=live)
         i1 = batch.add(live_i, "max")
         return lambda g: [(g(i0), "sum"), (g(i1), "max")]
     if fn == AggFunc.AVG:
-        zero = 0.0 if d.dtype == jnp.float64 else 0
-        i0 = batch.add(xp.where(live, d, zero), "sum")
+        i0 = batch.add(d, "sum", valid=live)
         i1 = batch.add(live_i, "sum")
         return lambda g: [(g(i0), "sum"), (g(i1), "sum")]
     if fn == AggFunc.MIN:
@@ -535,6 +564,13 @@ class HashAggKernel:
                  for assemble in assembles]
         return uniq, nuniq, collided, counts, rep, lanes
 
+    def scratch_nbytes(self, chunk: Chunk) -> int:
+        """Device bytes a dispatch stages BEYOND the input columns: the
+        group-table and lane scratch at the kernel's static capacity —
+        the share a fused dispatch over an HBM-cache-resident block
+        still pays (the input bytes stay on the cache's own ledger)."""
+        return self.capacity * 8 * (5 + 2 * len(self.aggs))
+
     def dispatch_nbytes(self, chunk: Chunk) -> int:
         """HBM bytes one dispatch stages, sized purely from shapes at
         dispatch time: the padded input columns (varlen ships as int64
@@ -544,17 +580,23 @@ class HashAggKernel:
         dispatch and credit it back at finalize."""
         from tidb_tpu import memtrack
         n = runtime.bucket_size(max(chunk.num_rows, 1))
-        scratch = self.capacity * 8 * (5 + 2 * len(self.aggs))
-        return memtrack.device_put_bytes(chunk, n) + scratch
+        return memtrack.device_put_bytes(chunk, n) + \
+            self.scratch_nbytes(chunk)
 
-    def dispatch(self, chunk: Chunk, donate: bool = False):
+    def dispatch(self, chunk: Chunk, donate: bool = False, dev_cols=None):
         """Pad + transfer + enqueue the program WITHOUT forcing a sync
         (jax dispatch is async): the pipeline's overlap point. With
         donate=True (and a backend that honors it) the padded input
         buffers are donated to the program, so a transient superchunk's
         HBM is reused for the group tables instead of living alongside
         them; donated transfers skip the chunk memo (a memoized donated
-        buffer would be read after free). -> opaque pending token."""
+        buffer would be read after free). With dev_cols (device-resident
+        padded columns, e.g. an HBM cache block — store/device_cache.py)
+        the upload is skipped entirely and the fused program runs
+        straight from HBM; cached blocks are shared, so donation never
+        applies to them. -> opaque pending token."""
+        if dev_cols is not None:
+            return self._jit(dev_cols, chunk.num_rows)
         donate = donate and runtime.donation_supported()
         cols, _dicts = runtime.device_put_chunk(chunk, memo=not donate)
         if donate:
@@ -583,8 +625,9 @@ class HashAggKernel:
         return finalize_group_result(chunk, self.group_exprs, self.aggs,
                                      gidx, rep[gidx], lanes_at, counts[gidx])
 
-    def __call__(self, chunk: Chunk) -> GroupResult:
-        return self.finalize(chunk, self.dispatch(chunk))
+    def __call__(self, chunk: Chunk, dev_cols=None) -> GroupResult:
+        return self.finalize(chunk, self.dispatch(chunk,
+                                                  dev_cols=dev_cols))
 
 
 class ScalarAggKernel:
@@ -611,14 +654,21 @@ class ScalarAggKernel:
                  for a in self.aggs]
         return count, lanes
 
+    def scratch_nbytes(self, chunk: Chunk) -> int:
+        """See HashAggKernel.scratch_nbytes (one state row, no table)."""
+        return 16 * len(self.aggs)
+
     def dispatch_nbytes(self, chunk: Chunk) -> int:
         """See HashAggKernel.dispatch_nbytes (one state row, no table)."""
         from tidb_tpu import memtrack
         n = runtime.bucket_size(max(chunk.num_rows, 1))
-        return memtrack.device_put_bytes(chunk, n) + 16 * len(self.aggs)
+        return memtrack.device_put_bytes(chunk, n) + \
+            self.scratch_nbytes(chunk)
 
-    def dispatch(self, chunk: Chunk, donate: bool = False):
+    def dispatch(self, chunk: Chunk, donate: bool = False, dev_cols=None):
         """Async half; see HashAggKernel.dispatch."""
+        if dev_cols is not None:
+            return self._jit(dev_cols, chunk.num_rows)
         donate = donate and runtime.donation_supported()
         cols, _ = runtime.device_put_chunk(chunk, memo=not donate)
         if donate:
@@ -643,8 +693,9 @@ class ScalarAggKernel:
             partials.append(ls)
         return GroupResult(keys=[()], partials=partials, counts=count)
 
-    def __call__(self, chunk: Chunk) -> GroupResult:
-        return self.finalize(chunk, self.dispatch(chunk))
+    def __call__(self, chunk: Chunk, dev_cols=None) -> GroupResult:
+        return self.finalize(chunk, self.dispatch(chunk,
+                                                  dev_cols=dev_cols))
 
 
 # -- process-wide kernel cache (executable reuse across plan objects) --------

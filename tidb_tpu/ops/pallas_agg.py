@@ -41,7 +41,7 @@ try:
 except Exception:  # noqa: BLE001 - pallas not in this jax build
     _HAS_PALLAS = False
 
-__all__ = ["available", "segment_sum_pallas"]
+__all__ = ["available", "segment_sum_pallas", "segment_sum"]
 
 _TILE = 512          # rows per grid step
 _MAX_C = 4096
@@ -79,47 +79,96 @@ def _kernel(ids_ref, vals_ref, out_ref):
         preferred_element_type=out_ref.dtype)
 
 
+def _kernel_masked(ids_ref, vals_ref, valid_ref, out_ref):
+    """Fused predicate + segment-sum tile: the per-lane validity mask is
+    applied INSIDE the kernel on the VMEM-resident tile (jnp.where, so a
+    NaN/garbage value under a dead mask can never poison the sum) before
+    the one-hot contraction — the scan->filter->partial-agg fusion that
+    removes the separate HBM-materialized `where(live, d, 0)` pass the
+    unfused path pays per lane."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    ids = ids_ref[:]                       # [T, 1] int32
+    c = out_ref.shape[0]
+    vals = jnp.where(valid_ref[:], vals_ref[:],
+                     jnp.zeros((), vals_ref.dtype))
+    onehot = (ids == jax.lax.broadcasted_iota(
+        jnp.int32, (ids.shape[0], c), 1)).astype(vals_ref.dtype)
+    out_ref[:] += jax.lax.dot_general(
+        onehot, vals, (((0,), (0,)), ((), ())),
+        preferred_element_type=out_ref.dtype)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("num_segments", "interpret"))
 def segment_sum_pallas(values, ids, num_segments: int,
-                       interpret: bool = False):
+                       interpret: bool = False, valid=None):
     """MXU segment-sum: values [n, k] float32, ids [n] int32 in
     [0, num_segments) -> [num_segments, k]. Rows are padded to the tile
-    size with a dead segment that is sliced off."""
+    size with a dead segment that is sliced off. With `valid` ([n] or
+    [n, k] bool) the mask is fused into the kernel: a row/lane
+    contributes only where valid — the predicate never materializes a
+    masked copy of the values in HBM."""
     if values.ndim == 1:
         values = values[:, None]
     n, k = values.shape
     c_pad = num_segments + 1               # dead slot for padding rows
     pad = (-n) % _TILE
+    if valid is not None:
+        valid = valid[:, None] if valid.ndim == 1 else valid
+        vk = valid.shape[1]
     if pad:
         values = jnp.concatenate(
             [values, jnp.zeros((pad, k), values.dtype)])
         ids = jnp.concatenate(
             [ids, jnp.full((pad,), num_segments, jnp.int32)])
+        if valid is not None:
+            valid = jnp.concatenate(
+                [valid, jnp.zeros((pad, vk), valid.dtype)])
     ids2 = ids.astype(jnp.int32)[:, None]
     grid = (values.shape[0] // _TILE,)
-    out = pl.pallas_call(
-        _kernel,
-        grid=grid,
-        in_specs=[
+    if valid is None:
+        kernel, args = _kernel, (ids2, values)
+        in_specs = [
             pl.BlockSpec((_TILE, 1), lambda i: (i, 0)),
             pl.BlockSpec((_TILE, k), lambda i: (i, 0)),
-        ],
+        ]
+    else:
+        kernel, args = _kernel_masked, (ids2, values, valid)
+        in_specs = [
+            pl.BlockSpec((_TILE, 1), lambda i: (i, 0)),
+            pl.BlockSpec((_TILE, k), lambda i: (i, 0)),
+            pl.BlockSpec((_TILE, vk), lambda i: (i, 0)),
+        ]
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((c_pad, k), lambda i: (0, 0)),
         out_shape=jax.ShapeDtypeStruct((c_pad, k), values.dtype),
         interpret=interpret,
-    )(ids2, values)
+    )(*args)
     return out[:num_segments]
 
 
-def segment_sum(values, ids, num_segments: int):
+def segment_sum(values, ids, num_segments: int, valid=None):
     """Dispatcher: pallas on TPU float lanes within capacity, XLA
     scatter otherwise (exactness for int lanes, speed on CPU). The
     output shape mirrors jax.ops.segment_sum exactly: 1-D in -> 1-D
-    out."""
+    out. `valid` is the fused predicate mask: on the pallas path it is
+    applied inside the kernel tile; on the scatter path it lowers to the
+    classic `where(valid, v, 0)` pre-pass (XLA fuses it into the
+    scatter's operand, so both paths sum exactly the masked values)."""
     v = jnp.asarray(values)
     if available() and v.dtype == jnp.float32 and \
             num_segments <= _MAX_C:
-        out = segment_sum_pallas(v, ids, num_segments)
+        out = segment_sum_pallas(v, ids, num_segments, valid=valid)
         return out[:, 0] if v.ndim == 1 else out
+    if valid is not None:
+        mask = valid if valid.ndim == v.ndim else valid[:, None]
+        v = jnp.where(mask, v, jnp.zeros((), v.dtype))
     return jax.ops.segment_sum(v, ids, num_segments=num_segments)

@@ -80,18 +80,28 @@ class SegmentAggKernel:
                  for a in self.aggs]
         return nseg, counts, rep, lanes
 
+    def scratch_nbytes(self, chunk: Chunk) -> int:
+        """Device bytes beyond the input columns: segment-id/count/lane
+        scratch (num_segments = padded rows, the no-capacity-limit
+        trade) — the fused-dispatch share when the input is an
+        HBM-cache-resident block."""
+        n = runtime.bucket_size(max(chunk.num_rows, 1))
+        return n * 8 * (3 + 2 * len(self.aggs))
+
     def dispatch_nbytes(self, chunk: Chunk) -> int:
         """HBM bytes one dispatch stages, from shapes at dispatch time:
-        padded input columns plus the segment-id/count/lane scratch
-        (num_segments = padded rows, the no-capacity-limit trade)."""
+        padded input columns plus the kernel scratch."""
         from tidb_tpu import memtrack
         n = runtime.bucket_size(max(chunk.num_rows, 1))
-        scratch = n * 8 * (3 + 2 * len(self.aggs))
-        return memtrack.device_put_bytes(chunk, n) + scratch
+        return memtrack.device_put_bytes(chunk, n) + \
+            self.scratch_nbytes(chunk)
 
-    def dispatch(self, chunk: Chunk, donate: bool = False):
+    def dispatch(self, chunk: Chunk, donate: bool = False, dev_cols=None):
         """Async half: pad + transfer + enqueue, no sync (see
-        HashAggKernel.dispatch for the donation contract)."""
+        HashAggKernel.dispatch for the donation and dev_cols
+        contracts)."""
+        if dev_cols is not None:
+            return self._jit(dev_cols, chunk.num_rows)
         donate = donate and runtime.donation_supported()
         cols, _dicts = runtime.device_put_chunk(chunk, memo=not donate)
         if donate:
@@ -111,8 +121,9 @@ class SegmentAggKernel:
                                      gidx, rep[gidx], lanes_at,
                                      counts[gidx])
 
-    def __call__(self, chunk: Chunk) -> GroupResult:
-        return self.finalize(chunk, self.dispatch(chunk))
+    def __call__(self, chunk: Chunk, dev_cols=None) -> GroupResult:
+        return self.finalize(chunk, self.dispatch(chunk,
+                                                  dev_cols=dev_cols))
 
 
 # process-wide cache like ops/hashagg.kernel_for, keyed on the group/agg

@@ -73,6 +73,25 @@ class ChunkCache:
                 tuple(c.id for c in plan.cols), plan.handle_col, s, e)
 
     def get(self, key, data_version: int, read_ts: int):
+        hit = self.lookup(key, data_version, read_ts)
+        return None if hit is None else hit[1]
+
+    def peek(self, key, data_version: int, read_ts: int) -> int | None:
+        """Would lookup() hit? -> the entry's budgeted size in bytes, or
+        None on a miss. No stats bump, no LRU reorder, no stale drop —
+        for route decisions (e.g. the streaming producer picking the
+        served-from-residency shape, sized against its frame cap) whose
+        real lookup follows and does the counting."""
+        with self._mu:
+            ent = self._entries.get(key)
+            if ent is None or ent[0] != data_version or read_ts < ent[1]:
+                return None
+            return ent[3]
+
+    def lookup(self, key, data_version: int, read_ts: int):
+        """Like get() but returns (fill_ts, chunk): the entry's fill
+        snapshot rides along so derived caches (the HBM device cache)
+        can record the SAME validity window as the host entry."""
         with self._mu:
             ent = self._entries.get(key)
             if ent is None:
@@ -84,7 +103,7 @@ class ChunkCache:
                 return None
             self._entries.move_to_end(key)
             self.hits += 1
-            return chunk
+            return fill_ts, chunk
 
     def put(self, key, data_version: int, fill_ts: int, chunk) -> None:
         size = _chunk_bytes(chunk)

@@ -28,7 +28,8 @@ from tidb_tpu.store.backoff import (BO_REGION_MISS, BO_SERVER_BUSY,
                                     BO_TXN_LOCK, Backoffer, COP_MAX_BACKOFF)
 from tidb_tpu.table import index_kvrows_to_chunk, kvrows_to_chunk
 
-__all__ = ["CopClient", "cop_handler", "decode_cop_batch"]
+__all__ = ["CopClient", "cop_handler", "decode_cop_batch",
+           "exec_cop_plan", "exec_cached_cop", "use_cached_path"]
 
 # fan-out width lives in the tidb_tpu_cop_concurrency sysvar (config.py;
 # ref: DistSQLScanConcurrency default, sessionctx/variable/tidb_vars.go:115)
@@ -89,11 +90,23 @@ def decode_cop_batch(plan: CopPlan, batch):
                            with_handle_col=plan.handle_col)
 
 
-def exec_cop_plan(plan: CopPlan, chunk, sources: int = 1) -> CopResponse:
+def exec_cop_plan(plan: CopPlan, chunk, sources: int = 1,
+                  dev_ref=None) -> CopResponse:
     """Run the pushed subplan over one region's decoded chunk.
     `sources` is how many storage scan batches were coalesced into
-    `chunk` (superchunk accounting for EXPLAIN ANALYZE / metrics)."""
+    `chunk` (superchunk accounting for EXPLAIN ANALYZE / metrics).
+
+    `dev_ref` — a (device_cache, key, data_version, read_ts, fill_ts)
+    tuple from _cached_range_chunk — marks `chunk` as an HBM-cacheable
+    region block: a device agg dispatch then runs FUSED from the cached
+    device-resident columns (scan->filter->partial-agg in one compiled
+    call, zero host->device bytes on a hit). fill_ts None = consult
+    only, never fill (the MVCC fill conditions did not hold)."""
     if plan.host_filter is not None:
+        # the host filter rewrites the chunk, so the raw cached block no
+        # longer matches it — the fused path only covers device-complete
+        # predicates
+        dev_ref = None
         mask = eval_filter_host(plan.host_filter, chunk)
         chunk = chunk.filter(mask)
     if plan.is_agg:
@@ -102,11 +115,24 @@ def exec_cop_plan(plan: CopPlan, chunk, sources: int = 1) -> CopResponse:
         if use_device:
             try:
                 k = _agg_kernels(plan)
+                dev_cols = None
+                nbytes = k.dispatch_nbytes(chunk)
+                if dev_ref is not None and config.fused_scan_enabled():
+                    dcache, dkey, dv, read_ts, fill_ts = dev_ref
+                    block = dcache.get_or_fill(dkey, dv, read_ts, chunk,
+                                               fill_ts)
+                    if block is not None and \
+                            block.nrows == chunk.num_rows:
+                        # the input columns stay on the cache's own
+                        # ledger; the statement pays only kernel scratch
+                        dev_cols = block.cols
+                        nbytes = k.scratch_nbytes(chunk)
                 # device ledger: padded upload + scratch, sized from
                 # shapes at dispatch; the pool worker's tracker routes
                 # the charge to the issuing reader's node
-                with memtrack.device_scope(plan, k.dispatch_nbytes(chunk)):
-                    res = runtime_stats.device_call(plan, k, chunk)
+                with memtrack.device_scope(plan, nbytes):
+                    res = runtime_stats.device_call(plan, k, chunk,
+                                                    dev_cols)
                 if config.superchunk_rows():
                     # attribution follows the feature switch: with
                     # coalescing off this is plain per-batch dispatch,
@@ -128,33 +154,31 @@ def exec_cop_plan(plan: CopPlan, chunk, sources: int = 1) -> CopResponse:
     return CopResponse(chunk=chunk)
 
 
-def cop_handler(storage):
-    """Builds the storage-side handler closure installed into the RPC shim.
-    Executes scan+filter+partial-agg for one region (cop_handler_dag.go's
-    role). Unlimited scans are served through the storage node's columnar
-    chunk cache (store/chunk_cache.py — the TiFlash-columnar-replica
-    analogue): the KV scan + row decode runs once per engine state, and
-    repeated analytical reads go straight from decoded columns to the
-    device kernel."""
-
-    _decode = decode_cop_batch
-
-    def _cached_range_chunk(region: Region, plan: CopPlan, s: bytes,
-                            e: bytes, req: CopRequest):
-        """Whole-range decoded chunk with cache lookup/fill."""
-        from tidb_tpu.store.chunk_cache import ChunkCache
-        cache = storage.chunk_cache
-        key = ChunkCache.key(region, plan, s, e)
-        # sample the version BEFORE scanning: a write landing mid-scan
-        # bumps past it, so the filled entry can never serve stale data.
-        # A pending lock anywhere also vetoes caching: lock visibility is
-        # per-reader-ts, so a fill that legally skipped a newer txn's lock
-        # would hide the KeyLockedError a newer reader must hit.
-        dv = storage.engine.data_version
-        cacheable = not storage.engine._locked_keys
-        hit = cache.get(key, dv, req.start_ts)
-        if hit is not None:
-            return hit
+def _cached_range_chunk(storage, region: Region, plan: CopPlan, s: bytes,
+                        e: bytes, req: CopRequest):
+    """Whole-range decoded chunk with host-cache lookup/fill.
+    -> (chunk, dev_ref): dev_ref parameterizes the HBM device cache
+    (store/device_cache.py) for a fused dispatch over the same block —
+    (cache, key, data_version, read_ts, fill_ts), with fill_ts None when
+    the MVCC fill conditions did not hold (consult-only)."""
+    from tidb_tpu.store.chunk_cache import ChunkCache
+    cache = storage.chunk_cache
+    key = ChunkCache.key(region, plan, s, e)
+    # sample the version BEFORE scanning: a write landing mid-scan
+    # bumps past it, so the filled entry can never serve stale data.
+    # A pending lock anywhere also vetoes caching: lock visibility is
+    # per-reader-ts, so a fill that legally skipped a newer txn's lock
+    # would hide the KeyLockedError a newer reader must hit.
+    dv = storage.engine.data_version
+    cacheable = not storage.engine._locked_keys
+    fill_ts = None
+    hit = cache.lookup(key, dv, req.start_ts)
+    if hit is not None:
+        # the host entry's OWN fill snapshot bounds the device entry:
+        # dv-equality means no state change since that fill, so both
+        # caches share one validity window
+        fill_ts, chunk = hit
+    else:
         parts = []
         cur = s
         while True:
@@ -163,66 +187,113 @@ def cop_handler(storage):
                                         desc=False)
             if not batch:
                 break
-            parts.append(_decode(plan, batch))
+            parts.append(decode_cop_batch(plan, batch))
             if len(batch) < COP_SCAN_BATCH:
                 break
             cur = batch[-1][0] + b"\x00"
         from tidb_tpu.chunk import Chunk
-        chunk = Chunk.concat_all(parts) if parts else _decode(plan, [])
+        chunk = Chunk.concat_all(parts) if parts else \
+            decode_cop_batch(plan, [])
         # cache only fills whose snapshot covers every commit: an older
         # snapshot's view is valid for ITS ts but must not become the
         # cached truth for newer readers (see MVCCStore.max_commit_ts)
         if cacheable and req.start_ts >= storage.engine.max_commit_ts:
-            cache.put(key, dv, req.start_ts, chunk)
-        return chunk
+            fill_ts = req.start_ts
+            cache.put(key, dv, fill_ts, chunk)
+    dev_ref = None
+    dcache = getattr(storage, "device_cache", None)
+    if dcache is not None and plan.is_agg and plan.host_filter is None \
+            and dcache.enabled():
+        from tidb_tpu.store.device_cache import DeviceCache
+        dev_ref = (dcache, DeviceCache.key(region, plan, s, e), dv,
+                   req.start_ts, fill_ts)
+    return chunk, dev_ref
+
+
+def exec_cached_cop(storage, region: Region, plan: CopPlan, s: bytes,
+                    e: bytes, req: CopRequest) -> list[CopResponse]:
+    """One region task served through the columnar caches: whole-range
+    decoded chunk (host chunk cache), HBM-resident block for fused agg
+    dispatch (device cache), memoized filter results. Shared by the
+    materialized handler and the streaming producer, so COP_STREAM
+    reads hit exactly the same cache hierarchy."""
+    chunk, dev_ref = _cached_range_chunk(storage, region, plan, s, e, req)
+    if chunk.num_rows == 0:
+        return []
+    if not plan.is_agg and (plan.filter is not None or
+                            plan.host_filter is not None) and \
+            _plan_filter_memoizable(plan):
+        # FILTER-only plans memoize their result on the cached
+        # raw chunk: repeated hot scans then return the SAME
+        # filtered chunk object, so every downstream device
+        # memo (shard transfers, build tables) keeps hitting —
+        # re-filtering per execution silently re-uploaded whole
+        # probe tables. Agg plans stay uncached so the host and
+        # device paths both really compute (the bench contract).
+        with _memo_lock:
+            memo = getattr(chunk, "_cop_filter_memo", None)
+            if memo is None:
+                memo = chunk._cop_filter_memo = OrderedDict()
+            hit = memo.get(id(plan))
+            if hit is not None:
+                memo.move_to_end(id(plan))
+                return [hit[1]]
+        resp = exec_cop_plan(plan, chunk)
+        from tidb_tpu.store.chunk_cache import ChunkCache, _chunk_bytes
+        with _memo_lock:
+            if id(plan) not in memo:
+                # entry pins plan, so the id cannot be recycled
+                memo[id(plan)] = (plan, resp)
+                while len(memo) > 8:
+                    memo.popitem(last=False)
+                # memoized results count toward the raw entry's
+                # cache budget (evicting the raw chunk drops
+                # them all)
+                storage.chunk_cache.add_cost(
+                    ChunkCache.key(region, plan, s, e),
+                    _chunk_bytes(resp.chunk))
+        return [resp]
+    return [exec_cop_plan(plan, chunk, dev_ref=dev_ref)]
+
+
+def use_cached_path(storage, plan: CopPlan) -> bool:
+    """True when a region task is served through the columnar caches
+    (whole-range, no LIMIT short-circuit)."""
+    return (plan.limit is None and config.chunk_cache_enabled()
+            and getattr(storage, "chunk_cache", None) is not None)
+
+
+def clamp_range(region: Region, rng: KVRange) -> tuple[bytes, bytes]:
+    """Clamp one request range to a region's bounds. Cache keys embed
+    this (s, e), so the materialized handler and the streaming producer
+    (store/stream.py) MUST share this one clamp — diverging copies
+    would silently stop their cache entries from serving each other."""
+    s = max(rng.start, region.start)
+    if region.end and rng.end:
+        e = min(rng.end, region.end)
+    else:
+        e = region.end or rng.end   # either bound may be open (falsy)
+    return s, e
+
+
+def cop_handler(storage):
+    """Builds the storage-side handler closure installed into the RPC shim.
+    Executes scan+filter+partial-agg for one region (cop_handler_dag.go's
+    role). Unlimited scans are served through the storage node's columnar
+    chunk cache (store/chunk_cache.py — the TiFlash-columnar-replica
+    analogue): the KV scan + row decode runs once per engine state, and
+    repeated analytical reads go straight from decoded columns to the
+    device kernel — or, when the HBM device cache holds the block
+    (store/device_cache.py), straight from device-resident columns."""
+
+    _decode = decode_cop_batch
 
     def handle(region: Region, req: CopRequest) -> list[CopResponse]:
         plan: CopPlan = req.plan
         rng: KVRange = req.ranges[0]   # client sends one range per task
-        s = max(rng.start, region.start)
-        e = rng.end if not region.end else (
-            min(rng.end, region.end) if rng.end else region.end)
-        use_cache = (plan.limit is None and config.chunk_cache_enabled()
-                     and getattr(storage, "chunk_cache", None) is not None)
-        if use_cache:
-            chunk = _cached_range_chunk(region, plan, s, e, req)
-            if chunk.num_rows == 0:
-                return []
-            if not plan.is_agg and (plan.filter is not None or
-                                    plan.host_filter is not None) and \
-                    _plan_filter_memoizable(plan):
-                # FILTER-only plans memoize their result on the cached
-                # raw chunk: repeated hot scans then return the SAME
-                # filtered chunk object, so every downstream device
-                # memo (shard transfers, build tables) keeps hitting —
-                # re-filtering per execution silently re-uploaded whole
-                # probe tables. Agg plans stay uncached so the host and
-                # device paths both really compute (the bench contract).
-                with _memo_lock:
-                    memo = getattr(chunk, "_cop_filter_memo", None)
-                    if memo is None:
-                        memo = chunk._cop_filter_memo = OrderedDict()
-                    hit = memo.get(id(plan))
-                    if hit is not None:
-                        memo.move_to_end(id(plan))
-                        return [hit[1]]
-                resp = exec_cop_plan(plan, chunk)
-                from tidb_tpu.store.chunk_cache import (ChunkCache,
-                                                        _chunk_bytes)
-                with _memo_lock:
-                    if id(plan) not in memo:
-                        # entry pins plan, so the id cannot be recycled
-                        memo[id(plan)] = (plan, resp)
-                        while len(memo) > 8:
-                            memo.popitem(last=False)
-                        # memoized results count toward the raw entry's
-                        # cache budget (evicting the raw chunk drops
-                        # them all)
-                        storage.chunk_cache.add_cost(
-                            ChunkCache.key(region, plan, s, e),
-                            _chunk_bytes(resp.chunk))
-                return [resp]
-            return [exec_cop_plan(plan, chunk)]
+        s, e = clamp_range(region, rng)
+        if use_cached_path(storage, plan):
+            return exec_cached_cop(storage, region, plan, s, e, req)
         out = []
         cur = s
         remaining = plan.limit
@@ -427,12 +498,18 @@ class CopClient(kv.Client):
 
     def _send_streaming(self, req: CopRequest, tasks, concurrency: int):
         """Framed partial responses, never a materialized per-region
-        list. KeepOrder (or concurrency 1) runs tasks sequentially with
+        list. Concurrency 1 (or one task) runs tasks sequentially with
         ONE lazy in-flight stream — range order is frame order and the
-        client buffers nothing. The unordered fan-out runs tasks in a
-        pool draining into a BoundedFrameQueue sized to the credit
-        window, so producers block (credit stall) instead of buffering
-        when the consumer is slow."""
+        client buffers nothing. KeepOrder at full concurrency runs a
+        sliding window of `concurrency` streams whose frames drain
+        strictly in task (range) order from per-task credit-sized
+        queues — the streaming analogue of the materialized path's
+        per-task response channels (coprocessor.go:342-457), bounded by
+        concurrency x credit frames instead of whole response lists.
+        The unordered fan-out runs tasks in a pool draining into ONE
+        BoundedFrameQueue sized to the credit window, so producers
+        block (credit stall) instead of buffering when the consumer is
+        slow."""
         from tidb_tpu import trace
         from tidb_tpu.store.stream import BoundedFrameQueue
 
@@ -452,9 +529,14 @@ class CopClient(kv.Client):
                 cop_stream_frames=sum(c["frames"] for c in counters),
                 cop_stream_resumes=sum(c["resumes"] for c in counters))
 
-        if req.keep_order or concurrency <= 1 or len(tasks) == 1:
+        if concurrency <= 1 or len(tasks) == 1:
             for _loc, rng in tasks:
                 yield from self._run_task_stream(req, rng, new_counter())
+            annotate_totals()
+            return
+        if req.keep_order:
+            yield from self._send_streaming_ordered(
+                req, tasks, concurrency, credit, new_counter)
             annotate_totals()
             return
         stop = threading.Event()
@@ -486,6 +568,66 @@ class CopClient(kv.Client):
         try:
             yield from q.drain(len(buckets))
             annotate_totals()
+        finally:
+            stop.set()
+            pool.shutdown(wait=False)
+
+    def _send_streaming_ordered(self, req: CopRequest, tasks,
+                                concurrency: int, credit: int,
+                                new_counter):
+        """Ordered streaming at full concurrency: up to `concurrency`
+        region streams produce in parallel, each into its OWN
+        credit-sized BoundedFrameQueue; the consumer drains the queues
+        strictly in task order, launching the next task as each window
+        slot frees. Producers past their credit window block (counted
+        as credit stalls), so client buffering is bounded by
+        concurrency x credit frames while storage-side scan/decode/agg
+        for later ranges overlaps the consumer's drain of earlier
+        ones."""
+        from collections import deque
+        from tidb_tpu.store.stream import BoundedFrameQueue
+
+        stop = threading.Event()
+        overlay = config.current_overlay()
+        coll = runtime_stats.current()
+        mem_root = memtrack.current()
+        pool = ThreadPoolExecutor(max_workers=concurrency,
+                                  thread_name_prefix="cop-stream-ord")
+
+        def launch(rng) -> BoundedFrameQueue:
+            q: BoundedFrameQueue = BoundedFrameQueue(credit, stop)
+
+            def produce():
+                try:
+                    with config.session_overlay(overlay), \
+                            runtime_stats.collecting(coll), \
+                            memtrack.tracking(mem_root):
+                        for resp in self._run_task_stream(
+                                req, rng, new_counter()):
+                            if not q.put(resp):
+                                return       # consumer gone
+                    q.put_done()
+                except Exception as exc:  # noqa: BLE001 — re-raised by
+                    q.put(exc)            # the consumer's drain
+                    q.put_done()
+
+            pool.submit(produce)
+            return q
+
+        try:
+            it = iter(tasks)
+            window: deque = deque()
+            for _ in range(concurrency):
+                nxt = next(it, None)
+                if nxt is None:
+                    break
+                window.append(launch(nxt[1]))
+            while window:
+                q0 = window.popleft()
+                nxt = next(it, None)
+                if nxt is not None:
+                    window.append(launch(nxt[1]))
+                yield from q0.drain(1)
         finally:
             stop.set()
             pool.shutdown(wait=False)

@@ -1,0 +1,274 @@
+"""HBM-resident columnar region-block cache: hot columns live where the
+compute is.
+
+The host-side chunk cache (store/chunk_cache.py) kills the KV-scan +
+decode cost of repeated analytical reads, but every execution still
+re-paid the host->device transfer unless the SAME chunk object happened
+to carry a device memo — an invisible, per-object, unbudgeted residency
+that evaporates with the host entry and never helps the streaming path.
+BENCH r05 put the device scan path at ~0.23 of the memory roofline
+largely on that re-upload. This module is the TiFlash-columnar-replica
+analogue one level further down (PAPER.md): the storage node keeps the
+PADDED, DICT-ENCODED device arrays per region block resident in HBM,
+keyed by (region, schema fingerprint, range) and validated by the
+engine's data version, so a repeated TPC-H scan reads straight from HBM
+and the fused scan->filter->partial-agg dispatch (store/copr.py) starts
+from device-resident columns.
+
+MVCC correctness is inherited from the chunk cache's contract: an entry
+records the engine data_version and the fill snapshot ts, and is served
+only when the version is unchanged AND read_ts >= fill_ts. Version
+bumps on every engine state change (writes, DDL-driven meta mutations,
+lock ops), so a stale block can never serve after a write — the
+invalidation tests pin this. Fills are allowed exactly where chunk-cache
+fills are (no pending locks, snapshot covers every commit), and the
+caller passes the HOST entry's fill_ts so both caches agree on validity.
+
+Budget: `tidb_tpu_device_cache_bytes` bounds resident bytes with LRU
+eviction (re-read on every lookup AND fill, so SET takes effect on the
+next access). Residency is charged to a dedicated memtrack node under
+the SERVER root (device ledger), so information_schema.memory_usage and
+the server gauges see the cache like any other consumer, and `shed()`
+is registered on SERVER's spill-action chain so one call reclaims every
+live cache. NOTE: SERVER carries no quota today, so nothing fires that
+chain automatically yet — the LRU budget is the only self-acting bound;
+the registration is the hook the admission controller (ROADMAP item 1)
+and administrative tooling drive directly.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from collections import OrderedDict
+
+from tidb_tpu import config, memtrack, metrics
+
+__all__ = ["DeviceBlock", "DeviceCache", "upload_block", "tracker"]
+
+
+_tracker_lock = threading.Lock()
+_tracker: memtrack.MemTracker | None = None
+
+# every live cache, for the single server-wide OOM shed action; weak so
+# short-lived test storages don't accumulate forever
+_caches: "weakref.WeakSet[DeviceCache]" = weakref.WeakSet()
+_shed_registered = False
+
+
+def tracker() -> memtrack.MemTracker:
+    """The shared server-scope tracker node all device caches charge
+    (label `hbm-cache`, device ledger)."""
+    global _tracker
+    with _tracker_lock:
+        if _tracker is None:
+            _tracker = memtrack.server_node("hbm-cache")
+        return _tracker
+
+
+def _shed_all() -> None:
+    """The registered memtrack OOM action: drop every resident block in
+    every live cache, returning the hbm-cache ledger to zero."""
+    for cache in list(_caches):
+        cache.shed()
+
+
+def _release_resident(resident: list) -> None:
+    """GC finalizer: credit back whatever a dead cache still held."""
+    freed, resident[0] = resident[0], 0
+    if freed:
+        tracker().release(device=freed)
+
+
+def _register(cache: "DeviceCache") -> None:
+    global _shed_registered
+    with _tracker_lock:
+        _caches.add(cache)
+        if not _shed_registered:
+            memtrack.SERVER.add_spill_action(_shed_all)
+            _shed_registered = True
+
+
+def upload_block(chunk, size: int | None = None):
+    """The ONE audited upload site for region columns (lint rule
+    `device-cache`): pad + dict-encode + device_put without the
+    per-chunk memo (the cache owns residency; a second resident copy
+    memoized on the chunk would double HBM). -> (cols, dicts)."""
+    from tidb_tpu.ops import runtime
+    return runtime.device_put_chunk(chunk, size, memo=False)
+
+
+class DeviceBlock:
+    """One resident region block: the padded device columns exactly as a
+    kernel dispatch consumes them, plus the host dictionaries needed to
+    decode varlen lanes."""
+
+    __slots__ = ("cols", "dicts", "nrows", "size", "nbytes")
+
+    def __init__(self, cols, dicts, nrows: int, size: int, nbytes: int):
+        self.cols = cols
+        self.dicts = dicts
+        self.nrows = nrows
+        self.size = size
+        self.nbytes = nbytes
+
+
+class DeviceCache:
+    """LRU over device-resident region blocks, bounded by the
+    `tidb_tpu_device_cache_bytes` budget (read per operation, so SET
+    takes effect immediately), accounted on the shared hbm-cache
+    memtrack node."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._entries: OrderedDict = OrderedDict()
+        # resident bytes live in a one-slot list shared with a GC
+        # finalizer: a cache dropped without close() (test storages,
+        # abandoned servers) still returns its ledger share, so the
+        # hbm-cache node stays exact over the process lifetime
+        self._resident = [0]
+        self._pending = 0   # bytes dropped under the lock, not settled
+        weakref.finalize(self, _release_resident, self._resident)
+        _register(self)
+
+    @staticmethod
+    def key(region, plan, s: bytes, e: bytes):
+        """(region, schema fingerprint, range): region id+version, table/
+        index ids, the column ids AND their field-type codes (a DDL that
+        re-types a column without re-numbering it must not alias), the
+        handle flag, and the clamped scan range."""
+        from tidb_tpu.store.chunk_cache import ChunkCache
+        return (ChunkCache.key(region, plan, s, e),
+                tuple(getattr(c.ft, "tp", None) for c in plan.cols))
+
+    def enabled(self) -> bool:
+        """Consulted on every agg request. A budget of 0 not only stops
+        lookups, it RECLAIMS: resident blocks shed on the next consult,
+        so `SET tidb_tpu_device_cache_bytes = 0` actually frees the HBM
+        it promises to (the shrink-on-lookup path in get() is
+        unreachable once this gate stops all lookups). A transient
+        `tidb_tpu_device = 0` keeps residency — flipping the device off
+        and on must not cold-start the cache."""
+        if config.device_cache_bytes() <= 0:
+            if self._resident[0]:
+                self.shed()
+            return False
+        return config.device_enabled()
+
+    def resident_bytes(self) -> int:
+        with self._mu:
+            return self._resident[0]
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._entries)
+
+    # -- lookup / fill -------------------------------------------------------
+
+    def get(self, key, data_version: int, read_ts: int) -> DeviceBlock | None:
+        """Resident block for `key`, valid for a reader at `read_ts`
+        under the current engine `data_version`; a version/ts mismatch
+        drops the stale entry (counted as an eviction). The budget is
+        re-read here too, so a shrunk `tidb_tpu_device_cache_bytes`
+        takes effect on the next lookup — not only at the next fill —
+        evicting LRU entries (the served block last) until residency
+        fits."""
+        budget = config.device_cache_bytes()
+        with self._mu:
+            ent = self._entries.get(key)
+            if ent is None:
+                metrics.counter(metrics.HBM_CACHE_MISSES)
+                return None
+            fill_version, fill_ts, block = ent
+            if fill_version != data_version:
+                # stale for EVERY reader: drop now, not at LRU pressure
+                self._drop_locked(key)
+                metrics.counter(metrics.HBM_CACHE_MISSES)
+                metrics.counter(metrics.HBM_CACHE_EVICTIONS)
+                stale = True
+            elif read_ts < fill_ts:
+                # too old for THIS reader only — newer snapshots still
+                # serve from it, so the entry stays
+                metrics.counter(metrics.HBM_CACHE_MISSES)
+                return None
+            else:
+                self._entries.move_to_end(key)
+                while self._resident[0] > budget and self._entries:
+                    self._drop_locked(next(iter(self._entries)))
+                    metrics.counter(metrics.HBM_CACHE_EVICTIONS)
+                # the served block stays alive through the returned
+                # reference even if it was the one over budget; it is
+                # simply no longer resident for the next reader
+                metrics.counter(metrics.HBM_CACHE_HITS)
+                stale = False
+        self._settle()
+        return None if stale else block
+
+    def fill(self, key, data_version: int, fill_ts: int,
+             chunk) -> DeviceBlock | None:
+        """Upload `chunk`'s padded columns and insert. Returns None (no
+        upload) when the block alone would exceed the budget. The caller
+        owns the MVCC fill contract (see module docstring)."""
+        from tidb_tpu.ops.runtime import bucket_size
+        budget = config.device_cache_bytes()
+        size = bucket_size(max(chunk.num_rows, 1))
+        nbytes = memtrack.device_put_bytes(chunk, size)
+        if nbytes > budget:
+            return None
+        cols, dicts = upload_block(chunk, size)
+        block = DeviceBlock(cols, dicts, chunk.num_rows, size, nbytes)
+        with self._mu:
+            if key in self._entries:
+                self._drop_locked(key)
+            self._entries[key] = (data_version, fill_ts, block)
+            self._resident[0] += nbytes
+            while self._resident[0] > budget and len(self._entries) > 1:
+                old = next(iter(self._entries))
+                if old == key:      # never evict the entry just filled
+                    break
+                self._drop_locked(old)
+                metrics.counter(metrics.HBM_CACHE_EVICTIONS)
+        tracker().consume(device=nbytes)
+        # evictions released under the lock tally in _pending_release;
+        # settle them against the shared tracker outside the lock
+        self._settle()
+        return block
+
+    def get_or_fill(self, key, data_version: int, read_ts: int, chunk,
+                    fill_ts: int | None = None) -> DeviceBlock | None:
+        """get(); on miss, fill() when `fill_ts` is provided (the
+        caller's signal that the MVCC fill conditions hold)."""
+        hit = self.get(key, data_version, read_ts)
+        if hit is not None:
+            return hit
+        if fill_ts is None:
+            return None
+        return self.fill(key, data_version, fill_ts, chunk)
+
+    # -- eviction ------------------------------------------------------------
+
+    def _drop_locked(self, key) -> None:
+        _v, _t, block = self._entries.pop(key)
+        self._resident[0] -= block.nbytes
+        self._pending += block.nbytes
+
+    def _settle(self) -> None:
+        with self._mu:
+            owed, self._pending = self._pending, 0
+        if owed:
+            tracker().release(device=owed)
+
+    def shed(self) -> int:
+        """Drop every resident block (the OOM action / close path).
+        -> bytes freed."""
+        with self._mu:
+            freed = self._resident[0]
+            n = len(self._entries)
+            self._entries.clear()
+            self._resident[0] = 0
+        if n:
+            metrics.counter(metrics.HBM_CACHE_EVICTIONS, inc=n)
+        if freed:
+            tracker().release(device=freed)
+        self._settle()
+        return freed

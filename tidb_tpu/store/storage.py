@@ -33,6 +33,11 @@ class MockStorage(kv.Storage):
         # storage-node columnar cache for the coprocessor read path
         from tidb_tpu.store.chunk_cache import ChunkCache
         self.chunk_cache = ChunkCache()
+        # HBM-resident region-block cache: the device-side tier of the
+        # same hierarchy (store/device_cache.py) — fused agg dispatches
+        # read cached blocks straight from device memory
+        from tidb_tpu.store.device_cache import DeviceCache
+        self.device_cache = DeviceCache()
 
     def begin(self, start_ts: int | None = None) -> KVTxn:
         return KVTxn(self, start_ts if start_ts is not None
@@ -64,6 +69,8 @@ class MockStorage(kv.Storage):
 
     def close(self) -> None:
         self.oracle.close()
+        # return the HBM cache's ledger share eagerly (GC would, later)
+        self.device_cache.shed()
 
 
 def new_mock_storage(num_stores: int = 1) -> MockStorage:

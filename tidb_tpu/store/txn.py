@@ -227,6 +227,18 @@ class _Batch:
     keys: list
 
 
+# Set by every committer pool worker at thread start. _on_batches keys
+# its no-nested-submit guard on this flag, NOT on the thread's display
+# name: a worker that submits sub-batches to its own bounded pool and
+# blocks on the results deadlocks once every worker is a blocked parent
+# (and the stuck workers then hang interpreter shutdown).
+_2PC_WORKER = threading.local()
+
+
+def _mark_2pc_worker() -> None:
+    _2PC_WORKER.flag = True
+
+
 class TwoPhaseCommitter:
     """Percolator optimistic commit. Ref: 2pc.go twoPhaseCommitter."""
 
@@ -249,7 +261,8 @@ class TwoPhaseCommitter:
         self.async_secondaries = async_secondaries
         self.undetermined = False
         self._pool = ThreadPoolExecutor(max_workers=concurrency,
-                                        thread_name_prefix="2pc")
+                                        thread_name_prefix="2pc",
+                                        initializer=_mark_2pc_worker)
 
     # -- batching ------------------------------------------------------------
 
@@ -281,8 +294,24 @@ class TwoPhaseCommitter:
         if len(batches) == 1:
             action(bo, batches[0])
             return
-        futures = [self._pool.submit(action, bo.fork(), b) for b in batches]
         first_err = None
+        if getattr(_2PC_WORKER, "flag", False):
+            # Already on a pool worker (async secondaries, or a
+            # RegionError re-split inside a batch action): fan out
+            # inline. Submitting to the same bounded pool and blocking
+            # on the results deadlocks once every worker is a blocked
+            # parent — the queued children then never run, and the
+            # stuck workers hang interpreter shutdown.
+            for b in batches:
+                try:
+                    action(bo.fork(), b)
+                except Exception as e:  # noqa: BLE001 - propagate first error
+                    if first_err is None:
+                        first_err = e
+            if first_err is not None:
+                raise first_err
+            return
+        futures = [self._pool.submit(action, bo.fork(), b) for b in batches]
         for f in futures:
             try:
                 f.result()

@@ -16,7 +16,8 @@ from __future__ import annotations
 import os
 import threading
 
-__all__ = ["enable", "default_dir", "stats", "reset_counters"]
+__all__ = ["enable", "default_dir", "stats", "reset_counters",
+           "cpu_feature_tag", "scoped_cpu_dir"]
 
 _lock = threading.Lock()
 _counts = {"hits": 0, "misses": 0}
@@ -29,6 +30,43 @@ def default_dir() -> str:
         "TIDB_TPU_COMPILE_CACHE",
         # lint: exempt[sysvar-registry] cache directory name, not a sysvar
         os.path.join(os.path.expanduser("~"), ".cache", "tidb_tpu_xla"))
+
+
+def cpu_feature_tag() -> str:
+    """Stable fingerprint of the host CPU execution environment: machine
+    arch + jax version + the kernel-reported CPU feature flags. Entries
+    compiled under a DIFFERENT feature set (a chip tunnel's virtualized
+    host, another machine) must not be loaded — jax warns but loads
+    them, and AOT results built with e.g. prefer-no-scatter deoptimize
+    scatter-heavy programs ~5x (measured on Q3, BENCH r03 note)."""
+    import hashlib
+    import platform as _platform
+    bits = [_platform.machine()]
+    try:
+        import jax
+        bits.append(jax.__version__)
+    except Exception:  # noqa: BLE001 - tag still useful without jax
+        pass
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.lower().startswith(("flags", "features")):
+                    bits.append(" ".join(sorted(
+                        line.split(":", 1)[1].split())))
+                    break
+    except OSError:
+        pass
+    return hashlib.sha256("|".join(bits).encode()).hexdigest()[:12]
+
+
+def scoped_cpu_dir(base: str) -> str:
+    """The per-host-feature-set CPU subdirectory of a cache `base`: CPU
+    processes share warm entries with each other but never with entries
+    compiled for a different platform/feature set. This is what lets the
+    bench CPU fallback KEEP a persistent cache (killing the ~49s Q1
+    first-compile stall of BENCH r05) instead of disabling it to avoid
+    cross-feature-set poisoning."""
+    return os.path.join(base, "cpu-" + cpu_feature_tag())
 
 
 def _install_listener() -> None:
