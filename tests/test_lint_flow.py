@@ -1,0 +1,443 @@
+"""Unit tests for the whole-program concurrency analysis
+(tidb_tpu/lint/flow: call graph, lock registry, flow facts) and the
+three flow rules built on it (lock-order, guarded-by,
+paired-resource). Synthetic forests throughout — the repo-level
+assertions (all rules clean on the tree, vacuity floors) live in
+tests/test_lint.py, and the runtime counterpart of lock-order is
+exercised in tests/test_race_harness.py."""
+
+from tidb_tpu.lint.engine import Forest, run
+from tidb_tpu.lint.flow import flow_of
+from tidb_tpu.lint.flow.lockreg import discover
+
+A_REL = "tidb_tpu/store/a.py"
+B_REL = "tidb_tpu/store/b.py"
+
+THREADING = "import threading\n"
+
+
+def lint(sources, rules=None):
+    forest = Forest.from_sources(sources, root=None)
+    return run(rules=rules, forest=forest, with_selfcheck=False,
+               with_vacuity=False)
+
+
+def forest_of(sources):
+    return Forest.from_sources(sources, root=None)
+
+
+def rules_of(report):
+    return [f.rule for f in report.findings]
+
+
+# -- lock registry ----------------------------------------------------------
+
+def test_registry_discovers_and_names_sites():
+    src = (THREADING +
+           "_g = threading.Lock()\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._mu = threading.RLock()\n"
+           "        self._cv = threading.Condition()\n"
+           "def f():\n"
+           "    local = threading.Lock()\n"   # function-local: skipped
+           "    return local\n")
+    reg = discover(forest_of({A_REL: src}))
+    names = {s.name: s.kind for s in reg.sites}
+    assert names == {
+        f"{A_REL}:_g": "Lock",
+        f"{A_REL}:C._mu": "RLock",
+        f"{A_REL}:C._cv": "Condition",
+    }
+
+
+def test_registry_resolution_policy():
+    src = (THREADING +
+           "_g = threading.Lock()\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._mu = threading.Lock()\n")
+    reg = discover(forest_of({A_REL: src}))
+    import ast
+    glob = ast.parse("_g").body[0].value
+    selfmu = ast.parse("self._mu").body[0].value
+    other = ast.parse("node._mu").body[0].value
+    unknown = ast.parse("foo.bar").body[0].value
+    assert reg.resolve(A_REL, None, glob).name == f"{A_REL}:_g"
+    assert reg.resolve(A_REL, "C", selfmu).name == f"{A_REL}:C._mu"
+    # receiver-typeless `node._mu`: unique class-scoped _mu in module
+    assert reg.resolve(A_REL, None, other).name == f"{A_REL}:C._mu"
+    assert reg.resolve(A_REL, None, unknown) is None
+
+
+# -- lock-order -------------------------------------------------------------
+
+def test_lockorder_intramodule_cycle():
+    src = (THREADING +
+           "_a = threading.Lock()\n"
+           "_b = threading.Lock()\n"
+           "def f():\n"
+           "    with _a:\n"
+           "        with _b:\n"
+           "            pass\n"
+           "def g():\n"
+           "    with _b:\n"
+           "        with _a:\n"
+           "            pass\n")
+    rep = lint({A_REL: src}, rules=["lock-order"])
+    assert len(rep.findings) == 1
+    assert "cycle" in rep.findings[0].message
+
+
+def test_lockorder_consistent_nesting_is_clean():
+    src = (THREADING +
+           "_a = threading.Lock()\n"
+           "_b = threading.Lock()\n"
+           "def f():\n"
+           "    with _a:\n"
+           "        with _b:\n"
+           "            pass\n"
+           "def g():\n"
+           "    with _a:\n"
+           "        with _b:\n"
+           "            pass\n")
+    assert lint({A_REL: src}, rules=["lock-order"]).findings == []
+
+
+def test_lockorder_interprocedural_cycle_across_modules():
+    """f holds A and calls b.g, which takes B; h holds B and calls
+    back into a.k, which takes A — no single function nests both
+    orders, only the call graph sees the cycle."""
+    a = (THREADING +
+         "from tidb_tpu.store import b\n"
+         "_a = threading.Lock()\n"
+         "def f():\n"
+         "    with _a:\n"
+         "        b.g()\n"
+         "def k():\n"
+         "    with _a:\n"
+         "        pass\n")
+    b = (THREADING +
+         "from tidb_tpu.store import a\n"
+         "_b = threading.Lock()\n"
+         "def g():\n"
+         "    with _b:\n"
+         "        pass\n"
+         "def h():\n"
+         "    with _b:\n"
+         "        a.k()\n")
+    rep = lint({A_REL: a, B_REL: b}, rules=["lock-order"])
+    assert len(rep.findings) == 1
+    assert "cycle" in rep.findings[0].message
+    assert f"{A_REL}:_a" in rep.findings[0].message
+    assert f"{B_REL}:_b" in rep.findings[0].message
+
+
+def test_lockorder_acquire_release_sequences_count():
+    src = (THREADING +
+           "_a = threading.Lock()\n"
+           "_b = threading.Lock()\n"
+           "def f():\n"
+           "    _a.acquire()\n"
+           "    try:\n"
+           "        _b.acquire()\n"
+           "        try:\n"
+           "            pass\n"
+           "        finally:\n"
+           "            _b.release()\n"
+           "    finally:\n"
+           "        _a.release()\n"
+           "def g():\n"
+           "    with _b:\n"
+           "        with _a:\n"
+           "            pass\n")
+    rep = lint({A_REL: src}, rules=["lock-order"])
+    assert len(rep.findings) == 1
+
+
+def test_lockorder_nonreentrant_self_nesting_is_flagged():
+    src = (THREADING +
+           "_a = threading.Lock()\n"
+           "def f():\n"
+           "    with _a:\n"
+           "        with _a:\n"
+           "            pass\n")
+    rep = lint({A_REL: src}, rules=["lock-order"])
+    assert len(rep.findings) == 1
+    assert "re-acquired" in rep.findings[0].message
+
+
+def test_lockorder_rlock_reentrancy_is_clean():
+    src = (THREADING +
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._mu = threading.RLock()\n"
+           "    def outer(self):\n"
+           "        with self._mu:\n"
+           "            self.inner()\n"
+           "    def inner(self):\n"
+           "        with self._mu:\n"
+           "            pass\n")
+    assert lint({A_REL: src}, rules=["lock-order"]).findings == []
+
+
+def test_lockorder_suppression_applies():
+    src = (THREADING +
+           "_a = threading.Lock()\n"
+           "_b = threading.Lock()\n"
+           "def f():\n"
+           "    with _a:\n"
+           "        # lint: exempt[lock-order] staged rollout, g dies next PR\n"
+           "        with _b:\n"
+           "            pass\n"
+           "def g():\n"
+           "    with _b:\n"
+           "        with _a:\n"
+           "            pass\n")
+    rep = lint({A_REL: src}, rules=["lock-order"])
+    # the cycle is reported at its first proof edge; tagging that edge
+    # suppresses it (and the tag is therefore not unused)
+    assert rep.findings == []
+
+
+# -- guarded-by -------------------------------------------------------------
+
+def test_guardedby_unlocked_write_flagged():
+    src = (THREADING +
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._mu = threading.Lock()\n"
+           "        self.n = 0   # guarded-by: _mu\n"
+           "    def bump(self):\n"
+           "        self.n += 1\n")
+    rep = lint({A_REL: src}, rules=["guarded-by"])
+    assert len(rep.findings) == 1
+    assert "without holding" in rep.findings[0].message
+
+
+def test_guardedby_locked_write_and_init_are_clean():
+    src = (THREADING +
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._mu = threading.Lock()\n"
+           "        self.n = 0   # guarded-by: _mu\n"
+           "    def bump(self):\n"
+           "        with self._mu:\n"
+           "            self.n += 1\n")
+    assert lint({A_REL: src}, rules=["guarded-by"]).findings == []
+
+
+def test_guardedby_module_global_and_mutators():
+    src = (THREADING +
+           "_lock = threading.Lock()\n"
+           "_stats = {}      # guarded-by: _lock\n"
+           "def ok(k):\n"
+           "    with _lock:\n"
+           "        _stats[k] = 1\n"
+           "        _stats.update(a=1)\n"
+           "def bad(k):\n"
+           "    _stats.update(b=2)\n")
+    rep = lint({A_REL: src}, rules=["guarded-by"])
+    assert len(rep.findings) == 1
+    assert rep.findings[0].line == 9
+    assert ".update" in rep.findings[0].message
+
+
+def test_guardedby_tag_on_wrapped_assignment_continuation():
+    """A trailing tag on the continuation line of a backslash-wrapped
+    assignment binds to THAT assignment, not the next one."""
+    src = (THREADING +
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._mu = threading.Lock()\n"
+           "        self._by_start = \\\n"
+           "            dict()           # guarded-by: _mu\n"
+           "        self._leaders = {}\n"     # NOT annotated
+           "    def bad(self):\n"
+           "        self._by_start.clear()\n"
+           "    def fine(self):\n"
+           "        self._leaders.clear()\n")
+    rep = lint({A_REL: src}, rules=["guarded-by"])
+    assert len(rep.findings) == 1
+    assert "_by_start" in rep.findings[0].message
+
+
+def test_guardedby_caller_held_helper_is_clean():
+    """A helper only ever invoked under the owner's lock checks as
+    guarded without a lexical `with` (DeviceCache._drop_locked)."""
+    src = (THREADING +
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._mu = threading.Lock()\n"
+           "        self.n = 0   # guarded-by: _mu\n"
+           "    def bump(self):\n"
+           "        with self._mu:\n"
+           "            self._bump_locked()\n"
+           "    def drain(self):\n"
+           "        with self._mu:\n"
+           "            self._bump_locked()\n"
+           "    def _bump_locked(self):\n"
+           "        self.n += 1\n")
+    assert lint({A_REL: src}, rules=["guarded-by"]).findings == []
+
+
+def test_guardedby_helper_with_one_unlocked_caller_is_flagged():
+    """caller-held is a meet over ALL call sites: one unlocked caller
+    breaks the guarantee."""
+    src = (THREADING +
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._mu = threading.Lock()\n"
+           "        self.n = 0   # guarded-by: _mu\n"
+           "    def bump(self):\n"
+           "        with self._mu:\n"
+           "            self._bump_locked()\n"
+           "    def sneak(self):\n"
+           "        self._bump_locked()\n"
+           "    def _bump_locked(self):\n"
+           "        self.n += 1\n")
+    rep = lint({A_REL: src}, rules=["guarded-by"])
+    assert len(rep.findings) == 1
+
+
+def test_guardedby_unresolvable_lock_is_a_finding():
+    src = (THREADING +
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._mu = threading.Lock()\n"
+           "        self.n = 0   # guarded-by: _typo\n")
+    rep = lint({A_REL: src}, rules=["guarded-by"])
+    assert len(rep.findings) == 1
+    assert "typo'd guard" in rep.findings[0].message
+
+
+# -- paired-resource --------------------------------------------------------
+
+def test_pairres_unprotected_consume_flagged():
+    src = ("from tidb_tpu import memtrack\n"
+           "def f(plan, rows):\n"
+           "    memtrack.consume(plan, host=64)\n"
+           "    return rows\n")
+    rep = lint({A_REL: src}, rules=["paired-resource"])
+    assert len(rep.findings) == 1
+    assert "exception path" in rep.findings[0].message
+
+
+def test_pairres_try_finally_release_is_clean():
+    src = ("from tidb_tpu import memtrack\n"
+           "def f(plan, rows):\n"
+           "    memtrack.consume(plan, host=64)\n"
+           "    try:\n"
+           "        return rows\n"
+           "    finally:\n"
+           "        memtrack.release(plan, host=64)\n")
+    assert lint({A_REL: src}, rules=["paired-resource"]).findings == []
+
+
+def test_pairres_tracker_method_form_and_carveout():
+    """tracker.consume(host=...) followed (bar trivial assignments) by
+    the try whose finally releases — the sanctioned sequence shape."""
+    src = ("def f(tracker, rows):\n"
+           "    tracker.consume(host=64)\n"
+           "    staged = 64\n"
+           "    try:\n"
+           "        return rows\n"
+           "    finally:\n"
+           "        tracker.release(host=staged)\n")
+    assert lint({A_REL: src}, rules=["paired-resource"]).findings == []
+
+
+def test_pairres_closure_charge_with_driver_finally_is_clean():
+    """The pipeline_map shape: the charge sits in a nested closure, the
+    release in the enclosing driver's finally."""
+    src = ("def driver(tracker, items):\n"
+           "    held = [0]\n"
+           "    def stage(it):\n"
+           "        tracker.consume(host=8)\n"
+           "        held[0] += 8\n"
+           "        return it\n"
+           "    try:\n"
+           "        return [stage(i) for i in items]\n"
+           "    finally:\n"
+           "        tracker.release(host=held[0])\n")
+    assert lint({A_REL: src}, rules=["paired-resource"]).findings == []
+
+
+def test_pairres_closure_charge_without_driver_finally_is_flagged():
+    src = ("def driver(tracker, items):\n"
+           "    def stage(it):\n"
+           "        tracker.consume(host=8)\n"
+           "        return it\n"
+           "    return [stage(i) for i in items]\n")
+    rep = lint({A_REL: src}, rules=["paired-resource"])
+    assert len(rep.findings) == 1
+
+
+def test_pairres_dispatch_without_finalize_flagged():
+    src = ("def f(kernel, chunk):\n"
+           "    tok = kernel.dispatch(chunk)\n"
+           "    return tok\n")
+    rep = lint({A_REL: src}, rules=["paired-resource"])
+    assert len(rep.findings) == 1
+    assert "finalize" in rep.findings[0].message
+
+
+def test_pairres_dispatch_with_finalize_is_clean():
+    src = ("def f(kernel, chunks):\n"
+           "    toks = [kernel.dispatch(c) for c in chunks]\n"
+           "    return [kernel.finalize(t) for t in toks]\n")
+    assert lint({A_REL: src}, rules=["paired-resource"]).findings == []
+
+
+def test_pairres_exempt_tag_for_ownership_transfer():
+    src = ("def stash(tracker, cache, chunk):\n"
+           "    # lint: exempt[paired-resource] residency releases on evict\n"
+           "    tracker.consume(host=64)\n"
+           "    cache.keep(chunk)\n")
+    assert lint({A_REL: src}, rules=["paired-resource"]).findings == []
+
+
+def test_pairres_plain_consume_without_ledger_kwargs_ignored():
+    """Queue.consume()/iterator consume() shapes without host=/device=
+    are not memtrack charges."""
+    src = ("def f(q):\n"
+           "    q.consume()\n"
+           "    q.consume(5)\n")
+    assert lint({A_REL: src}, rules=["paired-resource"]).findings == []
+
+
+# -- the shared analysis ----------------------------------------------------
+
+def test_flow_is_memoized_per_forest():
+    forest = forest_of({A_REL: THREADING + "_a = threading.Lock()\n"})
+    assert flow_of(forest) is flow_of(forest)
+
+
+def test_callgraph_resolves_self_method_and_import():
+    a = ("from tidb_tpu.store import b\n"
+         "class C:\n"
+         "    def f(self):\n"
+         "        self.g()\n"
+         "        b.top()\n"
+         "    def g(self):\n"
+         "        pass\n")
+    b = "def top():\n    pass\n"
+    fl = flow_of(forest_of({A_REL: a, B_REL: b}))
+    facts = fl.facts[(A_REL, "C.f")]
+    callees = {cs.callee.key for cs in facts.calls if cs.callee}
+    assert (A_REL, "C.g") in callees
+    assert (B_REL, "top") in callees
+
+
+def test_dag_export_shape():
+    src = (THREADING +
+           "_a = threading.Lock()\n"
+           "_b = threading.Lock()\n"
+           "def f():\n"
+           "    with _a:\n"
+           "        with _b:\n"
+           "            pass\n")
+    dag = flow_of(forest_of({A_REL: src})).dag_export()
+    assert (f"{A_REL}:_a", f"{A_REL}:_b") in dag["edges"]
+    assert dag["kinds"][f"{A_REL}:_a"] == "Lock"
+    assert dag["sites"][(A_REL, 2)] == (f"{A_REL}:_a", "Lock")

@@ -300,6 +300,73 @@ def test_lock_discipline_ignores_files_outside_scope():
     assert rep.findings == []
 
 
+def test_lock_discipline_rlock_same_shape():
+    """Reentrancy forgives double-acquire, not a leak on the exception
+    path: RLocks are held to the same with/try-finally shape."""
+    bad = ("import threading\n"
+           "_mu = threading.RLock()\n"
+           "def f(work):\n"
+           "    _mu.acquire()\n"
+           "    work()\n"
+           "    _mu.release()\n")
+    ok = ("import threading\n"
+          "_mu = threading.RLock()\n"
+          "def f(work):\n"
+          "    with _mu:\n"
+          "        _mu.acquire()\n"
+          "        try:\n"
+          "            work()\n"
+          "        finally:\n"
+          "            _mu.release()\n")
+    assert len(lint({STORE_REL: bad},
+                    rules=["lock-discipline"]).findings) == 1
+    assert lint({STORE_REL: ok},
+                rules=["lock-discipline"]).findings == []
+
+
+def test_lock_discipline_condition_wait_notify_outside_with():
+    src = ("import threading\n"
+           "_cond = threading.Condition()\n"
+           "def bad_wait():\n"
+           "    _cond.wait()\n"
+           "def bad_notify():\n"
+           "    _cond.notify()\n"
+           "def bad_notify_all():\n"
+           "    _cond.notify_all()\n"
+           "def bad_wait_for(p):\n"
+           "    _cond.wait_for(p)\n")
+    rep = lint({STORE_REL: src}, rules=["lock-discipline"])
+    assert len(rep.findings) == 4
+    assert all("RuntimeError" in f.message for f in rep.findings)
+
+
+def test_lock_discipline_condition_inside_with_is_clean():
+    src = ("import threading\n"
+           "class Q:\n"
+           "    def __init__(self):\n"
+           "        self._cond = threading.Condition()\n"
+           "    def get(self):\n"
+           "        with self._cond:\n"
+           "            while True:\n"
+           "                self._cond.wait(0.1)\n"
+           "    def put(self):\n"
+           "        with self._cond:\n"
+           "            self._cond.notify_all()\n")
+    rep = lint({STORE_REL: src}, rules=["lock-discipline"])
+    assert rep.findings == []
+
+
+def test_lock_discipline_event_wait_not_confused_with_condition():
+    """`Event.wait` / `Thread.join`-style receivers are not Conditions
+    constructed in the file — no finding."""
+    src = ("import threading\n"
+           "_done = threading.Event()\n"
+           "def f():\n"
+           "    _done.wait(1.0)\n")
+    rep = lint({STORE_REL: src}, rules=["lock-discipline"])
+    assert rep.findings == []
+
+
 def test_sysvar_registry_negative_and_positive():
     config = '_DEFS = {"tidb_tpu_knob": ("int", 1)}\n'
     ok = 'V = "tidb_tpu_knob"\n'

@@ -1,7 +1,16 @@
 """Race harness over the threaded store paths (util/racecheck.py; the
 reference's `make race` role, SURVEY §5.2). Each test multiplies thread
 interleavings via a floor switch-interval and asserts semantic
-invariants that break under lost updates or torn state."""
+invariants that break under lost updates or torn state.
+
+The whole module runs under the runtime lock-order sanitizer
+(util/lockorder.py): every registered lock constructed while these
+workloads run is order-checked against the statically-derived DAG of
+the `lock-order` lint rule — the dynamic harness validates the static
+model, and the static DAG gives the dynamic run its oracle. A
+violation fails the module at teardown (and TestSanitizer below pins
+the checker itself: inversions caught, hierarchies allowed,
+self-deadlocks raised instead of hung)."""
 
 import threading
 
@@ -9,7 +18,18 @@ import pytest
 
 from tidb_tpu import kv
 from tidb_tpu.store.storage import new_mock_storage
+from tidb_tpu.util import lockorder
 from tidb_tpu.util.racecheck import stress
+
+
+@pytest.fixture(scope="module", autouse=True)
+def lock_sanitizer():
+    """One sanitizer for the whole module (the static DAG costs one
+    forest parse + flow analysis — build it once). Raises
+    LockOrderError at teardown if any workload ordering contradicted
+    the DAG."""
+    with lockorder.sanitize() as san:
+        yield san
 
 
 @pytest.fixture
@@ -181,3 +201,167 @@ class TestInvariants:
         assert rows > 0
         s0.execute("ADMIN CHECK TABLE t")
         s0.close()
+
+    def test_sanitizer_saw_the_workloads(self, lock_sanitizer):
+        """Vacuity guard for the dynamic half: the store workloads
+        above really went through tracked locks (registered sites are
+        wrapped while the sanitizer is enabled), and none of their
+        orderings contradicted the static DAG so far."""
+        assert lock_sanitizer.acquires > 100, \
+            "sanitizer wrapped (almost) nothing — factory patching or " \
+            "the registry site map has regressed"
+        assert lock_sanitizer.violations == []
+
+
+class TestSanitizer:
+    """The checker itself, against a synthetic DAG (no patching —
+    wrap() installs the proxies directly)."""
+
+    DAG = {"edges": {("A", "B")},
+           "kinds": {"A": "Lock", "B": "Lock", "C": "RLock"},
+           "sites": {}}
+
+    def _san(self):
+        return lockorder.LockOrderSanitizer(self.DAG)
+
+    def test_consistent_order_is_clean(self):
+        san = self._san()
+        a = san.wrap(threading.Lock(), "A")
+        b = san.wrap(threading.Lock(), "B")
+        with a:
+            with b:
+                pass
+        assert san.violations == []
+        assert ("A", "B") in san.observed
+
+    def test_inversion_against_static_dag_is_caught(self):
+        san = self._san()
+        a = san.wrap(threading.Lock(), "A")
+        b = san.wrap(threading.Lock(), "B")
+        with b:         # B then A contradicts the static A -> B
+            with a:
+                pass
+        assert [v.kind for v in san.violations] == ["cycle"]
+        assert san.violations[0].edge == ("B", "A")
+
+    def test_dynamic_dynamic_inversion_is_caught(self):
+        """Two orders only ever seen at runtime still conflict: the
+        observed half of the graph participates in the cycle check."""
+        san = self._san()
+        x = san.wrap(threading.Lock(), "X")
+        y = san.wrap(threading.Lock(), "Y")
+        with x:
+            with y:
+                pass
+        with y:
+            with x:
+                pass
+        assert [v.kind for v in san.violations] == ["cycle"]
+
+    def test_same_name_hierarchy_is_allowed(self):
+        """Distinct instances under one static name (the memtracker
+        parent/child walk) are hierarchical locking the static names
+        cannot order — not an inversion."""
+        san = self._san()
+        parent = san.wrap(threading.Lock(), "N")
+        child = san.wrap(threading.Lock(), "N")
+        with parent:
+            with child:
+                pass
+        assert san.violations == []
+
+    def test_rlock_reentry_is_allowed(self):
+        san = self._san()
+        c = san.wrap(threading.RLock(), "C", kind="RLock")
+        with c:
+            with c:
+                pass
+        assert san.violations == []
+
+    def test_self_deadlock_raises_instead_of_hanging(self):
+        san = self._san()
+        a = san.wrap(threading.Lock(), "A")
+        a.acquire()
+        with pytest.raises(lockorder.LockOrderError):
+            a.acquire()
+        a.release()
+        assert [v.kind for v in san.violations] == ["self-deadlock"]
+
+    def test_transitive_cycle_through_static_edges(self):
+        """B -> C observed, then C -> A: with static A -> B the chain
+        closes a three-lock cycle even though no single pair inverts."""
+        san = self._san()
+        a = san.wrap(threading.Lock(), "A")
+        b = san.wrap(threading.Lock(), "B")
+        c = san.wrap(threading.Lock(), "C2")
+        with b:
+            with c:
+                pass
+        with c:
+            with a:
+                pass
+        assert [v.kind for v in san.violations] == ["cycle"]
+        assert san.violations[0].edge == ("C2", "A")
+
+    def test_timed_acquire_miss_records_nothing(self):
+        """Trylock backoff is deadlock AVOIDANCE: a miss must neither
+        count as held nor enter the observed edge set — even when the
+        attempt was made while holding another lock (recording B->A
+        here would fabricate a cycle against everyone's real A-then-B
+        order)."""
+        san = self._san()
+        a = san.wrap(threading.Lock(), "A")
+        b = san.wrap(threading.Lock(), "B")
+        grabbed = threading.Event()
+        done = threading.Event()
+
+        def holder():
+            with a:
+                grabbed.set()
+                done.wait(5)
+
+        t = threading.Thread(target=holder)
+        t.start()
+        grabbed.wait(5)
+        with b:     # holding B while the timed grab of A misses
+            assert a.acquire(timeout=0.01) is False
+        done.set()
+        t.join()
+        assert ("B", "A") not in san.observed   # the miss left no edge
+        with a:     # the avoided ordering's inverse stays legal
+            with b:
+                pass
+        assert san.violations == []
+        assert ("A", "B") in san.observed
+
+    def test_timed_acquire_success_is_tracked(self):
+        san = self._san()
+        a = san.wrap(threading.Lock(), "A")
+        b = san.wrap(threading.Lock(), "B")
+        with a:
+            assert b.acquire(timeout=1) is True
+            b.release()
+        assert ("A", "B") in san.observed
+        assert san.violations == []
+
+    def test_nested_sanitize_joins_active_and_leaves_it_enabled(self):
+        """An inner sanitize() under an active sanitizer (env gate or
+        an outer scope) joins it: same instance back, factories still
+        patched on exit, and only scope-local violations would raise."""
+        outer = lockorder.active()
+        assert outer is not None    # module fixture
+        with lockorder.sanitize() as inner:
+            assert inner is outer
+        assert lockorder.active() is outer
+
+    def test_factory_patching_wraps_registered_sites_only(self):
+        """While enabled, a lock constructed at a registry site comes
+        back wrapped; stdlib/test-local construction passes through."""
+        active = lockorder.active()
+        assert active is not None   # module fixture
+        raw = threading.Lock()      # this line is no registry site
+        assert not isinstance(raw, lockorder._TrackedLock)
+        from tidb_tpu.memtrack import MemTracker
+        t = MemTracker("sanity")
+        assert isinstance(t._mu, lockorder._TrackedLock)
+        assert t._mu._lo_name.endswith("MemTracker._mu")

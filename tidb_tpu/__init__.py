@@ -51,3 +51,12 @@ _jax.config.update("jax_enable_x64", True)
 from tidb_tpu.util import compile_cache as _compile_cache
 
 _compile_cache.enable()
+
+# Debug lock-order sanitizer (default off, zero overhead): with
+# TIDB_TPU_LOCK_SANITIZER=1 the threading lock factories are patched
+# here — before any runtime module constructs its locks — so every
+# registered lock created from now on is order-checked against the
+# statically-derived DAG (docs/CONCURRENCY.md, util/lockorder.py).
+from tidb_tpu.util import lockorder as _lockorder
+
+_lockorder.enable_from_env()

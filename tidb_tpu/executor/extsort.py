@@ -112,6 +112,7 @@ class SpillSorter:
             if self._tracker is not None:
                 b = memtrack.chunk_bytes(chunk)
                 self._tracked_buf += b
+                # lint: exempt[paired-resource] ownership transfer: buffered rows release on spill/drain/close, quota-spill re-arms
                 self._tracker.consume(host=b)
             if self._nbuf >= self.run_rows:
                 self._spill()
@@ -171,6 +172,7 @@ class SpillSorter:
             kb = sum((8 * len(d) if d.dtype == object else d.nbytes)
                      + v.nbytes for d, v in keys)
             self._tracked_keys += kb
+            # lint: exempt[paired-resource] ownership transfer: in-memory run keys release when the merge drains or the sorter closes
             self._tracker.consume(host=kb)
         rid = len(self._runs)
         dpaths, vpaths = [], []

@@ -254,6 +254,7 @@ class _MeshExecBase:
             launch_kernel = kernel     # finish() may rebind `kernel` on a
             if launch_kernel is not None:   # capacity re-plan; outs must be
                 db = memtrack.device_put_bytes(batch)
+                # lint: exempt[paired-resource] split pipeline pair: released in finish()'s finally (or below on a failed launch)
                 memtrack.consume(self.plan, device=db)
                 try:                        # read back by their own kernel
                     outs = launch_kernel.launch(batch, bucket=True)

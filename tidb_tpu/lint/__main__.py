@@ -1,14 +1,25 @@
 """CLI front end: ``python -m tidb_tpu.lint``.
 
-Exit-code contract (CI / pre-commit):
+Exit-code contract (CI / pre-commit, scripts/lint.sh):
     0  every selected rule ran clean
     1  findings (printed one per line: file:line: [rule] message)
     2  usage error (unknown rule, bad flags)
+
+``--json`` swaps the human lines for one machine-readable document
+(stable schema, pinned by tests/test_lint.py::test_cli_json_smoke):
+
+    {"version": 1, "clean": bool, "files": N, "rules": [...],
+     "findings": [{"file", "line", "rule", "message"}, ...],
+     "timing": {"parse_ms", "total_ms", "parse_calls",
+                "rule_ms": {rule: ms}}}
+
+The exit-code contract is identical in both modes.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 
 from tidb_tpu.lint import REGISTRY, run
@@ -25,6 +36,9 @@ def main(argv=None) -> int:
                         help="run only this rule (repeatable)")
     parser.add_argument("-q", "--quiet", action="store_true",
                         help="findings only, no timing report")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable report on stdout "
+                             "(same exit codes)")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -38,6 +52,25 @@ def main(argv=None) -> int:
     except KeyError as e:
         print(e.args[0], file=sys.stderr)
         return 2
+
+    if args.json:
+        print(json.dumps({
+            "version": 1,
+            "clean": report.clean,
+            "files": report.files,
+            "rules": report.rules_run,
+            "findings": [
+                {"file": f.file, "line": f.line, "rule": f.rule,
+                 "message": f.message} for f in report.findings],
+            "timing": {
+                "parse_ms": round(report.parse_time * 1e3, 1),
+                "total_ms": round(report.total_time * 1e3, 1),
+                "parse_calls": report.parse_calls,
+                "rule_ms": {n: round(t * 1e3, 1)
+                            for n, t in report.rule_times.items()},
+            },
+        }, indent=1))
+        return 1 if report.findings else 0
 
     for finding in report.findings:
         print(finding)

@@ -45,7 +45,7 @@ from dataclasses import dataclass, field
 
 __all__ = ["Finding", "Suppression", "ParsedFile", "Forest", "Rule",
            "register_rule", "REGISTRY", "Report", "run", "selfcheck",
-           "REPO", "PKG_REL"]
+           "parse_count", "REPO", "PKG_REL"]
 
 # repo root: tidb_tpu/lint/engine.py -> repo
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(
@@ -55,6 +55,17 @@ PKG_REL = "tidb_tpu"
 # pseudo-rules emitted by the engine itself (suppression hygiene)
 UNUSED_RULE = "unused-suppression"
 BAD_RULE = "bad-suppression"
+
+# every ast.parse the engine ever performs, process-wide: the
+# single-parse guarantee is asserted on THIS counter (tests/test_lint.py
+# pins `run()` to exactly one parse per package module, however many
+# rules run), not on wall time — wall time flakes under concurrent CPU
+# load inside the tier-1 budget, parse counts cannot
+_PARSE_CALLS = 0
+
+
+def parse_count() -> int:
+    return _PARSE_CALLS
 
 _TAG_RE = re.compile(r"#\s*lint:\s*exempt\[([A-Za-z0-9_,-]*)\]\s*(.*)")
 
@@ -87,9 +98,11 @@ class ParsedFile:
 
     def __init__(self, rel: str, source: str,
                  aliases: dict[str, str] | None = None):
+        global _PARSE_CALLS
         self.rel = rel
         self.source = source
         self.lines = source.splitlines()
+        _PARSE_CALLS += 1
         self.tree = ast.parse(source, filename=rel)
         self.bad_tags: list[Finding] = []
         self._def_spans = self._collect_def_spans()
@@ -323,6 +336,8 @@ class Report:
     total_time: float = 0.0
     files: int = 0
     rules_run: list[str] = field(default_factory=list)
+    parse_calls: int = 0     # ast.parse calls Forest.load spent (one
+    #                          per module; rules add ZERO)
 
     @property
     def clean(self) -> bool:
@@ -344,8 +359,10 @@ def run(rules: list[str] | None = None, forest: Forest | None = None,
                        f"(see --list-rules)")
     report = Report()
     if forest is None:
+        p0 = _PARSE_CALLS
         forest = Forest.load(root)
         report.parse_time = time.perf_counter() - t0
+        report.parse_calls = _PARSE_CALLS - p0
     report.files = len(forest.files)
     report.rules_run = names
 

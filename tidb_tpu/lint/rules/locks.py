@@ -5,11 +5,10 @@ from __future__ import annotations
 import ast
 
 from tidb_tpu.lint.engine import Finding, Rule, register_rule
+from tidb_tpu.lint.rules._shape import release_try_follows
 
 SCAN = ("tidb_tpu/memtrack.py", "tidb_tpu/metrics.py",
         "tidb_tpu/session/", "tidb_tpu/store/")
-
-_SIMPLE = (ast.Assign, ast.AnnAssign, ast.AugAssign)
 
 
 def _releases(stmts) -> bool:
@@ -30,9 +29,44 @@ def _acquires(expr):
             yield n
 
 
+_WAITERS = ("wait", "wait_for", "notify", "notify_all")
+
+
+def _condition_names(pf) -> set:
+    """Attributes / globals assigned `threading.Condition(...)` in this
+    file — the receivers whose wait/notify calls the rule checks (an
+    Event.wait or Thread.join must not false-positive)."""
+    out = set()
+    for n in pf.nodes:
+        if isinstance(n, (ast.Assign, ast.AnnAssign)) and \
+                isinstance(getattr(n, "value", None), ast.Call):
+            fn = n.value.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else \
+                fn.id if isinstance(fn, ast.Name) else None
+            if name != "Condition":
+                continue
+            targets = n.targets if isinstance(n, ast.Assign) \
+                else [n.target]
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+                elif isinstance(t, ast.Attribute):
+                    out.add(t.attr)
+    return out
+
+
+def _receiver_name(expr) -> str | None:
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return None
+
+
 @register_rule("lock-discipline")
 class LockDisciplineRule(Rule):
-    """No bare .acquire() outside `with` / try-finally in memtrack.py,
+    """No bare .acquire() outside `with` / try-finally, and no
+    Condition wait/notify outside `with cond:`, in memtrack.py,
     metrics.py, session/ and store/.
 
     A lock or semaphore acquired without an immediately-following
@@ -41,8 +75,17 @@ class LockDisciplineRule(Rule):
     metrics registry, session statement lifecycle, the connection-pool
     semaphores) a leaked permit deadlocks the process quietly. The
     sanctioned shape is `with lock:` or `x.acquire()` followed (bar
-    trivial assignments) by `try: ... finally: x.release()`; an acquire
-    already inside a try whose finally releases also passes.
+    trivial assignments) by `try: ... finally: x.release()` — the
+    assign form `got = x.acquire(timeout=...)` ahead of the try/finally
+    included; an acquire already inside a try whose finally releases
+    also passes. RLocks are held to the same shape: reentrancy forgives
+    double-acquire, not a leak on the exception path.
+
+    The Condition leg: `cond.wait()` / `cond.notify()` /
+    `cond.notify_all()` on a `threading.Condition` constructed in the
+    same file must sit lexically inside `with cond:` — calling either
+    without the underlying lock raises RuntimeError at the worst
+    possible time (under load, on the signaling path).
     """
 
     fixture_rel = "tidb_tpu/store/__lint_fixture__.py"
@@ -59,7 +102,8 @@ class LockDisciplineRule(Rule):
         for pf in forest:
             if not (pf.rel in SCAN[:2] or pf.rel.startswith(SCAN[2:])):
                 continue
-            yield from self._block(pf, pf.tree.body, False)
+            self._conds = _condition_names(pf)
+            yield from self._block(pf, pf.tree.body, False, ())
 
     def _finding(self, pf, node):
         return Finding(
@@ -68,7 +112,14 @@ class LockDisciplineRule(Rule):
             "the matching release leaks the permit; acquire, then "
             "`try: ... finally: release()` (or use `with`)")
 
-    def _header(self, pf, exprs, protected):
+    def _wait_finding(self, pf, call):
+        return Finding(
+            pf.rel, call.lineno, self.name,
+            f"Condition.{call.func.attr}() outside `with` of its "
+            f"condition — raises RuntimeError('cannot ... un-acquired "
+            f"lock') on the signaling path; wrap in `with cond:`")
+
+    def _header(self, pf, exprs, protected, withs):
         for expr in exprs:
             if expr is None:
                 continue
@@ -76,35 +127,49 @@ class LockDisciplineRule(Rule):
                 self.sites += 1
                 if not protected:
                     yield self._finding(pf, call)
+            for n in ast.walk(expr):
+                if isinstance(n, ast.Call) and \
+                        isinstance(n.func, ast.Attribute) and \
+                        n.func.attr in _WAITERS and \
+                        _receiver_name(n.func.value) in self._conds:
+                    self.sites += 1
+                    if ast.dump(n.func.value) not in withs:
+                        yield self._wait_finding(pf, n)
 
-    def _block(self, pf, stmts, protected):
+    def _block(self, pf, stmts, protected, withs):
         for i, stmt in enumerate(stmts):
             if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
                                  ast.ClassDef)):
-                yield from self._block(pf, stmt.body, False)
+                yield from self._block(pf, stmt.body, False, ())
             elif isinstance(stmt, ast.Try):
                 prot = protected or _releases(stmt.finalbody)
-                yield from self._block(pf, stmt.body, prot)
+                yield from self._block(pf, stmt.body, prot, withs)
                 for h in stmt.handlers:
-                    yield from self._block(pf, h.body, prot)
-                yield from self._block(pf, stmt.orelse, prot)
-                yield from self._block(pf, stmt.finalbody, protected)
+                    yield from self._block(pf, h.body, prot, withs)
+                yield from self._block(pf, stmt.orelse, prot, withs)
+                yield from self._block(pf, stmt.finalbody, protected,
+                                       withs)
             elif isinstance(stmt, (ast.If, ast.While)):
-                yield from self._header(pf, [stmt.test], protected)
-                yield from self._block(pf, stmt.body, protected)
-                yield from self._block(pf, stmt.orelse, protected)
+                yield from self._header(pf, [stmt.test], protected, withs)
+                yield from self._block(pf, stmt.body, protected, withs)
+                yield from self._block(pf, stmt.orelse, protected, withs)
             elif isinstance(stmt, (ast.For, ast.AsyncFor)):
-                yield from self._header(pf, [stmt.iter], protected)
-                yield from self._block(pf, stmt.body, protected)
-                yield from self._block(pf, stmt.orelse, protected)
+                yield from self._header(pf, [stmt.iter], protected, withs)
+                yield from self._block(pf, stmt.body, protected, withs)
+                yield from self._block(pf, stmt.orelse, protected, withs)
             elif isinstance(stmt, (ast.With, ast.AsyncWith)):
                 yield from self._header(
-                    pf, [it.context_expr for it in stmt.items], protected)
-                yield from self._block(pf, stmt.body, protected)
+                    pf, [it.context_expr for it in stmt.items],
+                    protected, withs)
+                inner = withs + tuple(
+                    ast.dump(it.context_expr) for it in stmt.items)
+                yield from self._block(pf, stmt.body, protected, inner)
             elif isinstance(stmt, ast.Match):
-                yield from self._header(pf, [stmt.subject], protected)
+                yield from self._header(pf, [stmt.subject], protected,
+                                        withs)
                 for case in stmt.cases:
-                    yield from self._block(pf, case.body, protected)
+                    yield from self._block(pf, case.body, protected,
+                                           withs)
             elif isinstance(stmt, (ast.Expr, ast.Assign, ast.AnnAssign)) \
                     and isinstance(getattr(stmt, "value", None),
                                    ast.Call) and \
@@ -117,12 +182,10 @@ class LockDisciplineRule(Rule):
                         self._release_try_follows(stmts, i + 1)):
                     yield self._finding(pf, stmt.value)
             else:
-                yield from self._header(pf, [stmt], protected)
+                yield from self._header(pf, [stmt], protected, withs)
 
     @staticmethod
     def _release_try_follows(stmts, j) -> bool:
-        """Skip trivial assignments, then require try/finally-release."""
-        while j < len(stmts) and isinstance(stmts[j], _SIMPLE):
-            j += 1
-        return j < len(stmts) and isinstance(stmts[j], ast.Try) and \
-            _releases(stmts[j].finalbody)
+        """Skip trivial assignments, then require try/finally-release
+        (the shared sequence-shape recognizer, rules/_shape.py)."""
+        return release_try_follows(stmts, j, _releases)

@@ -66,19 +66,20 @@ class MemTracker:
     def __init__(self, label: str, parent: "MemTracker | None" = None,
                  quota: int = 0, on_cancel=None):
         self.label = label
-        self.parent = parent
+        self.parent = parent            # guarded-by: _mu
         self.quota = quota
         self.on_cancel = on_cancel
         self._mu = threading.Lock()
-        self.host = 0
-        self.device = 0
-        self.host_peak = 0
-        self.device_peak = 0
-        self._actions: list = []        # ordered OOM spill actions
-        self._firing = False
-        self._cancel_msg: str | None = None   # latched after cancel
-        self._nodes: dict[int, tuple] = {}   # id(plan) -> (plan, tracker)
-        self.children: dict[int, "MemTracker"] = {}
+        self.host = 0                   # guarded-by: _mu
+        self.device = 0                 # guarded-by: _mu
+        self.host_peak = 0              # guarded-by: _mu
+        self.device_peak = 0            # guarded-by: _mu
+        self._actions: list = []        # guarded-by: _mu  (OOM spills)
+        self._firing = False            # guarded-by: _mu
+        self._cancel_msg: str | None = None   # guarded-by: _mu
+        # id(plan) -> (plan, tracker)
+        self._nodes: dict[int, tuple] = {}    # guarded-by: _mu
+        self.children: dict[int, "MemTracker"] = {}   # guarded-by: _mu
 
     # -- the two ledgers -----------------------------------------------------
 

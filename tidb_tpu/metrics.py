@@ -26,9 +26,9 @@ __all__ = ["counter", "histogram", "gauge", "expose", "snapshot",
            "HBM_CACHE_HITS", "HBM_CACHE_MISSES", "HBM_CACHE_EVICTIONS"]
 
 _lock = threading.Lock()
-_counters: dict[tuple[str, tuple], float] = {}
-_histograms: dict[tuple[str, tuple], "_Hist"] = {}
-_gauges: dict[tuple[str, tuple], float] = {}
+_counters: dict[tuple[str, tuple], float] = {}       # guarded-by: _lock
+_histograms: dict[tuple[str, tuple], "_Hist"] = {}   # guarded-by: _lock
+_gauges: dict[tuple[str, tuple], float] = {}         # guarded-by: _lock
 
 _BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0)
 

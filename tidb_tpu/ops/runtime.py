@@ -83,31 +83,40 @@ def superchunk_batches(chunks, limit: int, tracker=None):
             staged = 0
         return Superchunk(big, srcs) if big is not None else None
 
-    for c in chunks:
-        if c.num_rows == 0:
-            continue
-        srcs += 1
-        start = 0
-        while start < c.num_rows:
-            take = min(c.num_rows - start, limit - total)
-            piece = c if (start == 0 and take == c.num_rows) \
-                else c.slice(start, start + take)
-            buf.append(piece)
-            if tracker is not None:
-                b = memtrack.chunk_bytes(piece)
-                tracker.consume(host=b)
-                staged += b
-            total += take
-            start += take
-            if total >= limit:
-                sc = emit()
-                if sc is not None:
-                    yield sc
-                buf, total, srcs = [], 0, 1 if start < c.num_rows else 0
-    if buf:
-        sc = emit()
-        if sc is not None:
-            yield sc
+    try:
+        for c in chunks:
+            if c.num_rows == 0:
+                continue
+            srcs += 1
+            start = 0
+            while start < c.num_rows:
+                take = min(c.num_rows - start, limit - total)
+                piece = c if (start == 0 and take == c.num_rows) \
+                    else c.slice(start, start + take)
+                buf.append(piece)
+                if tracker is not None:
+                    b = memtrack.chunk_bytes(piece)
+                    tracker.consume(host=b)
+                    staged += b
+                total += take
+                start += take
+                if total >= limit:
+                    sc = emit()
+                    if sc is not None:
+                        yield sc
+                    buf, total, srcs = [], 0, \
+                        1 if start < c.num_rows else 0
+        if buf:
+            sc = emit()
+            if sc is not None:
+                yield sc
+    finally:
+        # abandoned/raised mid-assembly: whatever still sits in the
+        # buffer was never handed to a consumer — credit it back now
+        # instead of waiting for the statement root's detach
+        if tracker is not None and staged:
+            tracker.release(host=staged)
+            staged = 0
 
 
 def super_batches(first_parts, rest, limit: int):
@@ -148,21 +157,40 @@ def pipeline_map(items, dispatch, finalize, depth: int,
             if held:
                 tracker.release(host=held)
 
-    for it in items:
-        while len(pending) >= depth:
-            yield pop_finalize()
-        held = cost(it) if track else 0
-        if held:
-            tracker.consume(host=held)
-        try:
-            tok = dispatch(it)
-        except BaseException:
+    try:
+        for it in items:
+            while len(pending) >= depth:
+                yield pop_finalize()
+            held = cost(it) if track else 0
             if held:
-                tracker.release(host=held)
-            raise
-        pending.append((it, tok, held))
-    while pending:
-        yield pop_finalize()
+                tracker.consume(host=held)
+            try:
+                tok = dispatch(it)
+            except BaseException:
+                if held:
+                    tracker.release(host=held)
+                raise
+            pending.append((it, tok, held))
+        while pending:
+            yield pop_finalize()
+    finally:
+        # a consumer that stops early (limit hit, error upstream)
+        # abandons the generator with dispatched slots still in flight:
+        # neither their held host bytes nor the device bytes their
+        # dispatch charged may linger until statement detach. Every
+        # kernel credits dispatch_nbytes back on its finalize path, so
+        # each abandoned token is finalized (result discarded); a slot
+        # whose finalize fails still releases its host bytes
+        while pending:
+            prev, tok, held = pending.popleft()
+            try:
+                finalize(prev, tok)
+            except Exception:
+                pass    # the slot is dead either way; ledger cleanup
+                #         continues with the remaining slots
+            finally:
+                if held:
+                    tracker.release(host=held)
 
 
 _donation_supported: bool | None = None

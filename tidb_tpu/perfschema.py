@@ -31,10 +31,11 @@ HISTORY_CAP = 1024
 SUMMARY_CAP = 512          # distinct digests kept (LRU beyond)
 
 _lock = threading.Lock()
-_history: deque = deque(maxlen=HISTORY_CAP)
-_current: dict[int, dict] = {}       # session_id -> live event
-_event_seq = 0
-_summary: "OrderedDict[str, dict]" = OrderedDict()   # digest -> record
+_history: deque = deque(maxlen=HISTORY_CAP)          # guarded-by: _lock
+_current: dict[int, dict] = {}       # guarded-by: _lock  (sid -> event)
+_event_seq = 0                       # guarded-by: _lock
+# digest -> record
+_summary: "OrderedDict[str, dict]" = OrderedDict()   # guarded-by: _lock
 
 
 def stmt_begin(session_id: int, sql: str) -> dict:
@@ -119,6 +120,7 @@ def normalize_sql(sql: str) -> str:
 # (re-)lex. Only short statements are cached — a multi-MB bulk INSERT
 # would pin its whole text as a cache key.
 _digest_lock = threading.Lock()
+# guarded-by: _digest_lock
 _digest_cache: "OrderedDict[str, tuple[str, str]]" = OrderedDict()
 _DIGEST_CACHE_CAP = 256
 _DIGEST_CACHE_MAX_SQL = 8192

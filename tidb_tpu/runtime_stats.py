@@ -115,6 +115,7 @@ class StatsCollector:
 
     def __init__(self, device: bool = False):
         self.device = device
+        # guarded-by: _lock
         self._nodes: dict[int, tuple[object, OpStats]] = {}
         self._lock = threading.Lock()
 

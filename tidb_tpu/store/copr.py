@@ -322,32 +322,41 @@ def cop_handler(storage):
             if big is not None:
                 out.append(exec_cop_plan(plan, big, sources=n_src))
 
-        while True:
-            batch = storage.engine.scan(cur, e, COP_SCAN_BATCH, req.start_ts,
-                                        req.isolation, desc=False)
-            if not batch:
-                break
+        try:
+            while True:
+                batch = storage.engine.scan(cur, e, COP_SCAN_BATCH,
+                                            req.start_ts,
+                                            req.isolation, desc=False)
+                if not batch:
+                    break
+                if sc_limit:
+                    dec = _decode(plan, batch)
+                    parts.append(dec)
+                    b = memtrack.chunk_bytes(dec)
+                    memtrack.consume(plan, host=b)
+                    staged += b
+                    acc += dec.num_rows
+                    if acc >= sc_limit:
+                        flush_parts()
+                else:
+                    resp = exec_cop_plan(plan, _decode(plan, batch))
+                    out.append(resp)
+                    if remaining is not None and not plan.is_agg:
+                        remaining -= resp.chunk.num_rows
+                        if remaining <= 0:
+                            break
+                if len(batch) < COP_SCAN_BATCH:
+                    break
+                cur = batch[-1][0] + b"\x00"
             if sc_limit:
-                dec = _decode(plan, batch)
-                parts.append(dec)
-                b = memtrack.chunk_bytes(dec)
-                memtrack.consume(plan, host=b)
-                staged += b
-                acc += dec.num_rows
-                if acc >= sc_limit:
-                    flush_parts()
-            else:
-                resp = exec_cop_plan(plan, _decode(plan, batch))
-                out.append(resp)
-                if remaining is not None and not plan.is_agg:
-                    remaining -= resp.chunk.num_rows
-                    if remaining <= 0:
-                        break
-            if len(batch) < COP_SCAN_BATCH:
-                break
-            cur = batch[-1][0] + b"\x00"
-        if sc_limit:
-            flush_parts()
+                flush_parts()
+        finally:
+            # a raise mid-assembly (decode error, quota cancel from a
+            # sibling worker) must not strand the staging bytes on the
+            # reader's ledger until statement detach
+            if staged:
+                memtrack.release(plan, host=staged)
+                staged = 0
         return out
 
     return handle

@@ -48,12 +48,13 @@ __all__ = ["DeviceBlock", "DeviceCache", "upload_block", "tracker"]
 
 
 _tracker_lock = threading.Lock()
-_tracker: memtrack.MemTracker | None = None
+_tracker: memtrack.MemTracker | None = None   # guarded-by: _tracker_lock
 
 # every live cache, for the single server-wide OOM shed action; weak so
 # short-lived test storages don't accumulate forever
-_caches: "weakref.WeakSet[DeviceCache]" = weakref.WeakSet()
-_shed_registered = False
+_caches: "weakref.WeakSet[DeviceCache]" = \
+    weakref.WeakSet()               # guarded-by: _tracker_lock
+_shed_registered = False            # guarded-by: _tracker_lock
 
 
 def tracker() -> memtrack.MemTracker:
@@ -121,13 +122,14 @@ class DeviceCache:
 
     def __init__(self):
         self._mu = threading.Lock()
-        self._entries: OrderedDict = OrderedDict()
+        self._entries: OrderedDict = OrderedDict()   # guarded-by: _mu
         # resident bytes live in a one-slot list shared with a GC
         # finalizer: a cache dropped without close() (test storages,
         # abandoned servers) still returns its ledger share, so the
         # hbm-cache node stays exact over the process lifetime
-        self._resident = [0]
-        self._pending = 0   # bytes dropped under the lock, not settled
+        self._resident = [0]        # guarded-by: _mu
+        # bytes dropped under the lock, not settled
+        self._pending = 0           # guarded-by: _mu
         weakref.finalize(self, _release_resident, self._resident)
         _register(self)
 
@@ -228,6 +230,7 @@ class DeviceCache:
                     break
                 self._drop_locked(old)
                 metrics.counter(metrics.HBM_CACHE_EVICTIONS)
+        # lint: exempt[paired-resource] ownership transfer: residency releases on evict/shed; a GC finalizer backstops dead caches
         tracker().consume(device=nbytes)
         # evictions released under the lock tally in _pending_release;
         # settle them against the shared tracker outside the lock

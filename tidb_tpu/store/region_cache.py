@@ -38,9 +38,11 @@ class RegionCache:
     def __init__(self, pd: Cluster):
         self.pd = pd
         self._mu = threading.RLock()
-        self._by_start: SortedDict[bytes, Region] = SortedDict()
-        self._start_by_id: dict[int, bytes] = {}
-        self._leaders: dict[int, int] = {}  # region_id -> learned leader store
+        self._by_start: SortedDict[bytes, Region] = \
+            SortedDict()                     # guarded-by: _mu
+        self._start_by_id: dict[int, bytes] = {}   # guarded-by: _mu
+        # region_id -> learned leader store
+        self._leaders: dict[int, int] = {}         # guarded-by: _mu
 
     def _ctx(self, r: Region) -> RegionCtx:
         leader = self._leaders.get(r.id, r.leader_store)

@@ -128,15 +128,15 @@ class StorageServer:
         # synchronous primary/backup log-shipping analogue) ---------------
         self.role = role
         self._ship_mu = threading.Lock()   # serializes apply+ship order
-        self._backup: _Conn | None = None
+        self._backup: _Conn | None = None  # guarded-by: _ship_mu
         self._backup_addr = backup_addr
-        self._backup_dead = False
+        self._backup_dead = False          # guarded-by: _ship_mu
         if role == "backup" and primary_addr is not None:
             self._attach_to_primary(primary_addr)
         self._listener = socket.create_server((host, port))
         self.port = self._listener.getsockname()[1]
         self._closing = threading.Event()
-        self._threads: set = set()
+        self._threads: set = set()         # guarded-by: _mu
         self._mu = threading.Lock()
 
     # -- replication ---------------------------------------------------------

@@ -85,7 +85,7 @@ def _fresh_stats() -> dict:
             "credit_stalls": 0, "resumes": 0, "peak_buffered": 0}
 
 
-_STATS = _fresh_stats()
+_STATS = _fresh_stats()         # guarded-by: _stats_lock
 
 
 def reset_stream_stats() -> None:
@@ -133,7 +133,8 @@ def note_credit_stall() -> None:
 # invalidates naturally on write/DDL; stale tuples age out by LRU.
 _OVERCAP_CAP = 256
 _overcap_lock = threading.Lock()
-_overcap: OrderedDict = OrderedDict()   # (cache key, dv) -> result bytes
+# (cache key, dv) -> result bytes
+_overcap: OrderedDict = OrderedDict()   # guarded-by: _overcap_lock
 
 
 def _overcap_get(key, dv) -> int | None:
