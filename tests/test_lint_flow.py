@@ -389,6 +389,54 @@ def test_pairres_dispatch_with_finalize_is_clean():
     assert lint({A_REL: src}, rules=["paired-resource"]).findings == []
 
 
+def test_pairres_partition_loop_dispatch_finalize_is_clean():
+    """The hybrid-join partition staging shape (ops/hybrid.py /
+    HashJoinExec._hybrid_probe): dispatch in a loop over partitions,
+    each charge settled by a per-task finalize whose finally releases —
+    all inside one top-level function."""
+    src = ("from tidb_tpu import memtrack\n"
+           "def probe(kernel, parts, plan):\n"
+           "    def dispatch_one(p):\n"
+           "        db = kernel.dispatch_nbytes(p)\n"
+           "        memtrack.consume(plan, device=db)\n"
+           "        try:\n"
+           "            tok = kernel.dispatch(p)\n"
+           "        except BaseException:\n"
+           "            memtrack.release(plan, device=db)\n"
+           "            raise\n"
+           "        return tok, db\n"
+           "    def finalize_one(tok, db):\n"
+           "        try:\n"
+           "            return kernel.finalize(tok)\n"
+           "        finally:\n"
+           "            memtrack.release(plan, device=db)\n"
+           "    out = []\n"
+           "    for p in parts:\n"
+           "        tok, db = dispatch_one(p)\n"
+           "        out.append(finalize_one(tok, db))\n"
+           "    return out\n")
+    assert lint({A_REL: src}, rules=["paired-resource"]).findings == []
+
+
+def test_pairres_partition_loop_without_finalize_flagged():
+    """Same partition-loop shape but the dispatched tokens are dropped:
+    both the abandoned futures and the closure charge with no driver
+    release must be flagged."""
+    src = ("from tidb_tpu import memtrack\n"
+           "def probe(kernel, parts, plan):\n"
+           "    toks = []\n"
+           "    def dispatch_one(p):\n"
+           "        memtrack.consume(plan, device=8)\n"
+           "        return kernel.dispatch(p)\n"
+           "    for p in parts:\n"
+           "        toks.append(dispatch_one(p))\n"
+           "    return toks\n")
+    rep = lint({A_REL: src}, rules=["paired-resource"])
+    assert len(rep.findings) == 2
+    msgs = " ".join(f.message for f in rep.findings)
+    assert "finalize" in msgs and "exception path" in msgs
+
+
 def test_pairres_exempt_tag_for_ownership_transfer():
     src = ("def stash(tracker, cache, chunk):\n"
            "    # lint: exempt[paired-resource] residency releases on evict\n"

@@ -25,7 +25,8 @@ __all__ = ["get_var", "set_var", "all_vars", "coerce", "session_overlay",
            "cop_concurrency", "sort_spill_rows", "device_min_rows",
            "stream_rows", "superchunk_rows", "pipeline_depth",
            "copr_stream_enabled", "copr_stream_frame_bytes",
-           "copr_stream_credit", "runtime_stats_enabled",
+           "copr_stream_credit", "join_partitions", "skew_threshold",
+           "runtime_stats_enabled",
            "runtime_stats_device", "mem_quota_query",
            "device_cache_bytes", "fused_scan_enabled",
            "UnknownVariableError"]
@@ -113,6 +114,22 @@ _DEFS: dict[str, tuple[str, int]] = {
     # block is only consumable by a kernel that accepts device-resident
     # columns, i.e. the fused dispatch.
     "tidb_tpu_fused_scan": (_BOOL, 1),
+    # radix fan-out of the partitioned hybrid hash join/agg
+    # (ops/hybrid.py; arxiv 2112.02480's dynamic hybrid hash join): build
+    # and probe keys split into this many hash partitions so a capacity
+    # or collision miss retries ONE partition (and a memtrack quota spill
+    # sheds cold build partitions to host staging) instead of dropping
+    # the whole operator to the host. 0/1 disables partitioning (the
+    # pre-hybrid all-or-nothing behavior). The unskewed fast path is
+    # unchanged: partitioning engages only on detected skew, an
+    # over-superchunk build, an active memory quota, or an agg miss.
+    "tidb_tpu_join_partitions": (_INT, 8),
+    # heavy-hitter threshold in rows (ops/hybrid.py; arxiv 2505.04153):
+    # a join key whose build-side duplication or (CMSketch-estimated)
+    # probe-side frequency reaches this many rows routes to the
+    # dedicated broadcast lane, so one hot key cannot overflow its hash
+    # partition. 0 disables skew routing.
+    "tidb_tpu_skew_threshold": (_INT, 1 << 15),
     # statements at/above this wall time land in the slow-query log
     # (ref: config.Log.SlowThreshold, default 300ms)
     "tidb_tpu_slow_query_ms": (_INT, 300),
@@ -298,6 +315,14 @@ def copr_stream_frame_bytes() -> int:
 
 def copr_stream_credit() -> int:
     return max(1, _read("tidb_tpu_copr_stream_credit"))
+
+
+def join_partitions() -> int:
+    return max(0, _read("tidb_tpu_join_partitions"))
+
+
+def skew_threshold() -> int:
+    return max(0, _read("tidb_tpu_skew_threshold"))
 
 
 def runtime_stats_enabled() -> bool:

@@ -18,9 +18,11 @@ from tidb_tpu import config, kv, memtrack, runtime_stats, tablecodec
 from tidb_tpu.chunk import Chunk, Column
 from tidb_tpu.expression import AggDesc, AggFunc, Expression
 from tidb_tpu.kv import CopRequest, KVRange, ReqType
+from tidb_tpu.ops import hybrid as op_hybrid
 from tidb_tpu.ops import runtime as op_runtime
 from tidb_tpu.ops.hashagg import (CapacityError, CollisionError,
-                                  GroupResult, HashAggregator, kernel_for)
+                                  DeviceRejectError, GroupResult,
+                                  HashAggregator, kernel_for)
 from tidb_tpu.ops.hostagg import host_hash_agg
 from tidb_tpu.ops.join import (JoinKernel, JoinKeyEncoder,
                                host_match_pairs)
@@ -492,10 +494,11 @@ class HashAggExec(Executor):
 
     def _escalated_kernel(self, e: CapacityError):
         """Re-plan once with a larger device table (the re-plan the
-        kernel docstring promises); None when the overflow is hopeless."""
-        needed = getattr(e, "needed", 0)
-        cap = 1 << max(needed * 2 - 1, 1).bit_length()
-        if not needed or cap > (1 << 20):
+        kernel docstring promises); None when the overflow is hopeless.
+        The growth rule/ceiling live in hybrid.escalated_capacity so the
+        whole-chunk retry and the per-partition chains cannot drift."""
+        cap = op_hybrid.escalated_capacity(getattr(e, "needed", 0))
+        if cap is None:
             return None
         try:
             k = kernel_for(None, self.plan.group_exprs, self.plan.aggs,
@@ -506,7 +509,12 @@ class HashAggExec(Executor):
         return k
 
     def _device_partial(self, chunk):
-        """Per-chunk device partial agg (superchunk coalescing off)."""
+        """Per-chunk device partial agg (superchunk coalescing off).
+        A capacity miss re-plans once with a bigger table; a miss that
+        survives (or a collision) radix-partitions the chunk and retries
+        per partition (ops/hybrid.py) instead of abandoning the device.
+        Returns None only for designed rejections (not device-safe) —
+        the caller's host path, counted as a fallback."""
         try:
             if self._kernel is None:
                 self._set_kernel(kernel_for(
@@ -516,6 +524,7 @@ class HashAggExec(Executor):
                 return runtime_stats.device_call(
                     self.plan, self._kernel, chunk)
         except CapacityError as e:
+            reason = "capacity"
             k = self._escalated_kernel(e)
             if k is not None:
                 # the retry kernel's (>=2x) scratch is the statement's
@@ -525,10 +534,23 @@ class HashAggExec(Executor):
                     try:
                         return runtime_stats.device_call(
                             self.plan, k, chunk)
-                    except (CapacityError, CollisionError, ValueError):
+                    except CapacityError:
+                        pass
+                    except CollisionError:
+                        reason = "collision"
+                    except (DeviceRejectError, NotImplementedError):
+                        runtime_stats.note_fallback(self.plan,
+                                                    "unsupported")
                         return None
-        except (CollisionError, ValueError):
-            pass
+            return op_hybrid.partitioned_agg(
+                chunk, None, self.plan.group_exprs, self.plan.aggs,
+                self.plan, reason=reason)
+        except CollisionError:
+            return op_hybrid.partitioned_agg(
+                chunk, None, self.plan.group_exprs, self.plan.aggs,
+                self.plan, reason="collision")
+        except (DeviceRejectError, NotImplementedError):
+            runtime_stats.note_fallback(self.plan, "unsupported")
         return None
 
     def _superchunk_partials(self, chunks):
@@ -546,8 +568,9 @@ class HashAggExec(Executor):
             try:
                 self._set_kernel(kernel_for(None, plan.group_exprs,
                                             plan.aggs))
-            except ValueError:
-                pass    # not device-safe: every superchunk goes host
+            except DeviceRejectError:
+                # not device-safe BY DESIGN: every superchunk goes host
+                runtime_stats.note_fallback(plan, "unsupported")
 
         mt_node = memtrack.op_node(plan)
 
@@ -561,11 +584,15 @@ class HashAggExec(Executor):
             memtrack.consume(plan, device=db)
             try:
                 tok = (k, k.dispatch(sc.chunk, donate=True), db)
-            except (ValueError, NotImplementedError):
-                # trace-time failure: this plan will never run on device
+            except (DeviceRejectError, NotImplementedError):
+                # trace-time rejection: this plan will never run on device
                 self._kernel = None
                 memtrack.release(plan, device=db)
+                runtime_stats.note_fallback(plan, "unsupported")
                 return None
+            except BaseException:
+                memtrack.release(plan, device=db)
+                raise
             runtime_stats.note_superchunk(plan, sc.num_rows, sc.bucket,
                                           sc.sources)
             return tok
@@ -577,17 +604,35 @@ class HashAggExec(Executor):
                 try:
                     return k.finalize(sc.chunk, fut)
                 except CapacityError as e:
+                    reason = "capacity"
                     k2 = self._escalated_kernel(e)
                     if k2 is not None:
                         with memtrack.device_scope(
                                 plan, k2.dispatch_nbytes(sc.chunk)):
                             try:
                                 return k2(sc.chunk)
-                            except (CapacityError, CollisionError,
-                                    ValueError):
+                            except CapacityError:
                                 pass
-                except (CollisionError, ValueError):
-                    pass
+                            except CollisionError:
+                                reason = "collision"
+                            except (DeviceRejectError,
+                                    NotImplementedError):
+                                runtime_stats.note_fallback(
+                                    plan, "unsupported")
+                                return host_hash_agg(
+                                    sc.chunk, None, plan.group_exprs,
+                                    plan.aggs)
+                    # a miss that survived escalation retries per
+                    # radix partition instead of abandoning the device
+                    return op_hybrid.partitioned_agg(
+                        sc.chunk, None, plan.group_exprs, plan.aggs,
+                        plan, reason=reason)
+                except CollisionError:
+                    return op_hybrid.partitioned_agg(
+                        sc.chunk, None, plan.group_exprs, plan.aggs,
+                        plan, reason="collision")
+                except (DeviceRejectError, NotImplementedError):
+                    runtime_stats.note_fallback(plan, "unsupported")
                 finally:
                     memtrack.release(plan, device=db)
                     runtime_stats.note_finalize_wait(
@@ -671,7 +716,8 @@ class StreamAggExec(Executor):
                             self._kernel.dispatch_nbytes(part)):
                         gr = runtime_stats.device_call(
                             self.plan, self._kernel, part)
-                except (ValueError, NotImplementedError):
+                except (DeviceRejectError, NotImplementedError):
+                    runtime_stats.note_fallback(self.plan, "unsupported")
                     use_device = False
             if gr is None:
                 gr = host_hash_agg(part, None, self.plan.group_exprs,
@@ -714,7 +760,8 @@ class StreamAggExec(Executor):
                 self._kernel = segment_kernel_for(plan.group_exprs,
                                                   plan.aggs)
                 plan._root_kernel = self._kernel
-            except (ValueError, NotImplementedError):
+            except (DeviceRejectError, NotImplementedError):
+                runtime_stats.note_fallback(plan, "unsupported")
                 self._kernel = None
 
         mt_node = memtrack.op_node(plan)
@@ -727,10 +774,14 @@ class StreamAggExec(Executor):
             memtrack.consume(plan, device=db)
             try:
                 tok = (k, k.dispatch(sc.chunk, donate=True), db)
-            except (ValueError, NotImplementedError):
+            except (DeviceRejectError, NotImplementedError):
                 self._kernel = None
                 memtrack.release(plan, device=db)
+                runtime_stats.note_fallback(plan, "unsupported")
                 return None
+            except BaseException:
+                memtrack.release(plan, device=db)
+                raise
             runtime_stats.note_superchunk(plan, sc.num_rows, sc.bucket,
                                           sc.sources)
             return tok
@@ -741,8 +792,9 @@ class StreamAggExec(Executor):
                 t0 = time.perf_counter_ns()
                 try:
                     return k.finalize(sc.chunk, fut)
-                except (ValueError, NotImplementedError):
+                except (DeviceRejectError, NotImplementedError):
                     self._kernel = None
+                    runtime_stats.note_fallback(plan, "unsupported")
                 finally:
                     memtrack.release(plan, device=db)
                     runtime_stats.note_finalize_wait(
@@ -1002,8 +1054,8 @@ class HashJoinExec(Executor):
     def _probe_join(self, ctx, build, nb: int):
         plan = self.plan
         enc = JoinKeyEncoder(len(plan.right_keys))
-        bk = enc.fit_build(self._eval_keys(plan.right_keys, build)) \
-            if nb else None
+        raw_bk = self._eval_keys(plan.right_keys, build) if nb else None
+        bk = enc.fit_build(raw_bk) if nb else None
         matched_build = np.zeros(nb, dtype=bool)
         probe_iter = self.left.chunks(ctx)
         mesh_kernel = self._mesh_kernel(nb)
@@ -1029,8 +1081,21 @@ class HashJoinExec(Executor):
             else:
                 mesh_kernel = None
                 probe_iter = iter(buffered)
-        if mesh_kernel is None and nb > 0 and self._kernel is not None \
-                and config.device_enabled() and config.superchunk_rows():
+        device_ok = (mesh_kernel is None and nb > 0 and
+                     self._kernel is not None and
+                     config.device_enabled() and
+                     config.superchunk_rows())
+        hyb = self._maybe_hybrid(bk, nb, raw_bk) if device_ok else None
+        if hyb is not None:
+            # partitioned hybrid path (ops/hybrid.py): skew routed
+            # through the heavy-hitter lane, cold build partitions
+            # spillable to host staging under quota pressure
+            try:
+                yield from self._hybrid_probe(probe_iter, build, hyb,
+                                              enc, matched_build)
+            finally:
+                hyb.close()
+        elif device_ok:
             # single-chip device path: probe chunks coalesce into
             # superchunks and flow through the dispatch-ahead matcher
             # queue (build-side lanes transfer once for the whole probe)
@@ -1117,6 +1182,257 @@ class HashJoinExec(Executor):
         out = self._emit(chunk, build, li, ri, unmatched, pair=pair)
         if out is not None:
             yield out
+
+    def _maybe_hybrid(self, bk, nb: int, raw_bk):
+        """A HybridJoinBuild when the partitioned path should carry this
+        probe (ops/hybrid.py). Partitioning is pure win under skew,
+        memory pressure, or an over-superchunk build — and pure overhead
+        otherwise, so the unskewed in-HBM case stays on the classic
+        pipelined probe. Heavy hitters are seeded from exact build-side
+        duplication plus the probe table's ANALYZE-time CMSketch when
+        the planner traced the probe key to a base column."""
+        parts = config.join_partitions()
+        plan = self.plan
+        if parts <= 1 or nb < self._DEVICE_MIN_BUILD:
+            return None
+        h = op_hybrid.build_hashes(bk, nb)
+        raw_key = None
+        if len(plan.right_keys) == 1 and raw_bk:
+            rk, lk = plan.right_keys[0], plan.left_keys[0]
+            ok_types = (EvalType.INT, EvalType.STRING, EvalType.DATETIME,
+                        EvalType.DURATION)
+            # decimal/real keys rescale in _eval_keys, so their raw
+            # values no longer match the ANALYZE-time sketch encoding;
+            # _ci strings fold the same way — skip sketch seeding there
+            if rk.ft.eval_type in ok_types and \
+                    lk.ft.eval_type in ok_types and \
+                    not rk.ft.is_ci and not lk.ft.is_ci:
+                raw_key = raw_bk[0]
+        threshold = config.skew_threshold()
+        cms = getattr(plan, "probe_cms", None)
+        # the per-distinct-key sketch scan is ~1us/key: cache its result
+        # on the (plan-cache-shared) plan object keyed by sketch
+        # identity + threshold, so repeated executions pay it once.
+        # Staleness is bounded by re-ANALYZE (new sketch object -> new
+        # scan); build keys that appeared since simply miss the seed and
+        # are caught by streaming promotion instead
+        cached = getattr(plan, "_hot_seed", None)
+        if cached is not None and cached[0] is cms and \
+                cached[1] == threshold:
+            sketch_hot = cached[2]
+        else:
+            sketch_hot = op_hybrid.sketch_hot_hashes(h, threshold,
+                                                     raw_key, cms)
+            plan._hot_seed = (cms, threshold, sketch_hot)
+        hot = np.union1d(op_hybrid.dup_hot_hashes(h, threshold),
+                         sketch_hot)
+        root = memtrack.current()
+        quota = root is not None and root.quota > 0
+        if not hot.size and not quota and nb <= config.superchunk_rows():
+            return None
+        return op_hybrid.HybridJoinBuild(self._kernel, bk, nb, parts,
+                                         plan, hot_hashes=hot, h=h)
+
+    # lint: exempt[memtrack-alloc] pair-index buffers are billed at dispatch (cap*17 inside dispatch_nbytes); staged sub-chunks consume on mt_node below
+    def _hybrid_probe(self, probe_iter, build, hyb, enc, matched_build):
+        """Partitioned probe over a HybridJoinBuild.
+
+        Phase 1 streams probe superchunks through the dispatch-ahead
+        pipeline: rows route per partition (the heavy-hitter lane at
+        index `parts`), device-resident partitions match immediately,
+        and — once the memtrack quota action has spilled cold build
+        partitions — rows bound for spilled partitions stage to host
+        buffers instead of thrashing re-uploads. Phase 2 drains the
+        staging one partition at a time, re-uploading each spilled
+        build partition once and evicting it when drained.
+
+        Every probe row reaches exactly one _post_match call (its
+        matching, if any, is complete there), so outer-join unmatched
+        detection and semi/anti emission stay exact per subset."""
+        plan = self.plan
+        kernel = self._kernel
+        mt_node = memtrack.op_node(plan)
+        staged: list = []      # (pid, sub_chunk, pk_lanes, host_bytes)
+
+        def dispatch_one(p, pk_sub, hp_sub, n_sub):
+            bdev = hyb.ensure(p)
+            # SNAPSHOT the partition->global row map at dispatch time: a
+            # later heavy-hitter promotion re-layouts the build while
+            # this token is still in flight, and the pair indices must
+            # resolve against the layout the matcher actually saw. The
+            # pin keeps the partition's device bytes on the ledger (and
+            # off the spill action's menu) while the token is pending.
+            rows = hyb.build_rows(p)
+            cap = hyb.hot_out_cap(hp_sub) if p == hyb.parts else None
+            db = kernel.dispatch_nbytes(n_sub, cap)
+            memtrack.consume(plan, device=db)
+            hyb.pin(p)
+            try:
+                tok = kernel.dispatch(None, pk_sub, len(rows),
+                                      n_sub, out_cap=cap, build_dev=bdev)
+            except BaseException:
+                hyb.unpin(p)
+                memtrack.release(plan, device=db)
+                raise
+            return (p, rows, tok, db)
+
+        def finalize_one(t):
+            p, rows, tok, db = t
+            t0 = time.perf_counter_ns()
+            try:
+                li_l, ri_l = kernel.finalize(tok)
+            finally:
+                hyb.unpin(p)
+                memtrack.release(plan, device=db)
+                runtime_stats.note_finalize_wait(
+                    plan, time.perf_counter_ns() - t0)
+            return li_l, rows[ri_l]
+
+        # one superchunk fans out into one task per touched partition;
+        # tasks (not whole superchunks) ride the dispatch-ahead pipeline
+        # so only ~depth partitions are pinned by in-flight tokens at
+        # any moment — everything else stays evictable by the quota
+        # spill action. A superchunk's emission fires when its LAST
+        # task finalizes (tasks of one superchunk are contiguous in the
+        # stream, so that is also emission order).
+        pending_promo: list = [None]
+        open_states: dict = {}      # id -> state; bytes held to emission
+
+        def task_iter(sc_iter):
+            for sc in sc_iter:
+                # apply the promotion observed on the PREVIOUS batch:
+                # all of its tasks have dispatched by the time the
+                # pipeline pulls this batch's first task, so no routed-
+                # but-undispatched task can straddle the re-layout
+                if pending_promo[0] is not None:
+                    hyb.promote(pending_promo[0])
+                    pending_promo[0] = None
+                n = sc.num_rows
+                pk = enc.transform_probe(
+                    self._eval_keys(plan.left_keys, sc.chunk))
+                hp, tasks = hyb.route(pk, n)
+                pending_promo[0] = hyb.observe(hp)
+                staged_mask = np.zeros(n, dtype=bool)
+                imm = []
+                for p, idx in tasks:
+                    if hyb.want_immediate(p):
+                        imm.append((p, idx))
+                    else:
+                        sub = [(d[idx], v[idx]) for d, v in pk]
+                        sub_chunk = sc.chunk.take(idx)
+                        sb = memtrack.chunk_bytes(sub_chunk) + \
+                            sum(d.nbytes + v.nbytes for d, v in sub)
+                        if mt_node is not None:
+                            # ownership transfer: staged probe bytes
+                            # release in the drain loop / outer finally
+                            mt_node.consume(host=sb)
+                        staged.append((p, sub_chunk, sub, sb))
+                        staged_mask[idx] = True
+                sb = memtrack.chunk_bytes(sc.chunk)
+                if mt_node is not None:
+                    # held until the superchunk's emission (outer
+                    # finally sweeps abandoned states)
+                    mt_node.consume(host=sb)
+                state = {"chunk": sc.chunk, "pk": pk, "hp": hp,
+                         "mask": staged_mask, "li": [], "ri": [],
+                         "left": max(len(imm), 1), "bytes": sb}
+                open_states[id(state)] = state
+                runtime_stats.note_superchunk(plan, n, sc.bucket,
+                                              sc.sources)
+                if not imm:
+                    # every row staged or unmatched: one sentinel task
+                    # still flows through so the emission fires
+                    yield (state, None, None)
+                else:
+                    for p, idx in imm:
+                        yield (state, p, idx)
+
+        def dispatch(task):
+            state, p, idx = task
+            if p is None:
+                return None
+            pk = state["pk"]
+            sub = [(d[idx], v[idx]) for d, v in pk]
+            return dispatch_one(p, sub, state["hp"][idx], len(idx))
+
+        def finalize(task, tok):
+            state, _p, idx = task
+            if tok is not None:
+                li_l, ri = finalize_one(tok)
+                state["li"].append(idx[li_l])
+                state["ri"].append(ri)
+            state["left"] -= 1
+            if state["left"] > 0:
+                return None
+            open_states.pop(id(state), None)
+            if mt_node is not None and state["bytes"]:
+                mt_node.release(host=state["bytes"])
+            li = np.concatenate(state["li"]) if state["li"] \
+                else np.empty(0, dtype=np.int64)
+            ri = np.concatenate(state["ri"]) if state["ri"] \
+                else np.empty(0, dtype=np.int64)
+            mask = state["mask"]
+            if mask.any():
+                # staged rows' matching is NOT complete: hand only the
+                # immediately-matched subset to _post_match
+                keep = np.flatnonzero(~mask)
+                li = np.searchsorted(keep, li)
+                return state["chunk"].take(keep), li, ri
+            return state["chunk"], li, ri
+
+        sc_iter = op_runtime.superchunk_batches(probe_iter,
+                                                config.superchunk_rows(),
+                                                tracker=mt_node)
+        try:
+            for out in op_runtime.pipeline_map(
+                    task_iter(sc_iter), dispatch, finalize,
+                    config.pipeline_depth()):
+                if out is None:
+                    continue
+                chunk_out, li, ri = out
+                yield from self._post_match(chunk_out, build, li, ri,
+                                            matched_build)
+            # phase 2: drain staged cold-partition rows, grouped by
+            # partition so each spilled build uploads exactly once.
+            # Promotions only ever MOVE keys to the always-resident hot
+            # lane, so a staged batch re-routes within {its partition,
+            # hot} and the grouping stays partition-local.
+            staged.sort(key=lambda t: t[0])
+            while staged:
+                p_hint, sub_chunk, pk_sub, sb = staged[0]
+                try:
+                    hp, tasks = hyb.route(pk_sub, sub_chunk.num_rows)
+                    li_parts, ri_parts = [], []
+                    for p, idx in tasks:
+                        lanes = [(d[idx], v[idx]) for d, v in pk_sub]
+                        li_l, ri = finalize_one(
+                            dispatch_one(p, lanes, hp[idx], len(idx)))
+                        li_parts.append(idx[li_l])
+                        ri_parts.append(ri)
+                    li = np.concatenate(li_parts) if li_parts \
+                        else np.empty(0, dtype=np.int64)
+                    ri = np.concatenate(ri_parts) if ri_parts \
+                        else np.empty(0, dtype=np.int64)
+                finally:
+                    staged.pop(0)
+                    if mt_node is not None and sb:
+                        mt_node.release(host=sb)
+                yield from self._post_match(sub_chunk, build, li, ri,
+                                            matched_build)
+                if hyb.under_pressure() and \
+                        (not staged or staged[0][0] != p_hint):
+                    hyb.evict(p_hint)
+        finally:
+            if mt_node is not None:
+                for _p, _c, _k, sb in staged:
+                    if sb:
+                        mt_node.release(host=sb)
+                # superchunks abandoned before their last task finalized
+                for state in open_states.values():
+                    if state["bytes"]:
+                        mt_node.release(host=state["bytes"])
+            staged.clear()
+            open_states.clear()
 
     def _pipelined_probe(self, probe_iter, build, bk, enc, matched_build,
                          nb: int):

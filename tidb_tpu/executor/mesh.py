@@ -241,6 +241,7 @@ class _MeshExecBase:
                 runtime_stats.note_pipeline_stall(
                     self.plan, time.perf_counter_ns() - t0)
             _STREAM_STATS["host_batches"] += 1
+            runtime_stats.note_fallback(self.plan, "mesh")
             return host_batch(batch)
 
         pending: deque = deque()  # (kernel, in-flight outs, batch, bytes)
@@ -278,6 +279,7 @@ class _MeshExecBase:
                 while pending:
                     merge(finish(*pending.popleft()))
                 _STREAM_STATS["host_batches"] += 1
+                runtime_stats.note_fallback(self.plan, "mesh")
                 merge(host_batch(batch))
         while pending:
             merge(finish(*pending.popleft()))

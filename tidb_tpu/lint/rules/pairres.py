@@ -90,6 +90,13 @@ class PairedResourceRule(Rule):
         "def drop(kernel, chunk):\n"
         "    tok = kernel.dispatch(chunk)\n"
         "    return tok\n"
+        "def drop_partition_loop(kernel, parts):\n"
+        "    # the hybrid-join partition staging shape, abandoned:\n"
+        "    # per-partition dispatches that never reach a finalize\n"
+        "    toks = []\n"
+        "    for p in parts:\n"
+        "        toks.append(kernel.dispatch(p))\n"
+        "    return toks\n"
     )
 
     def check(self, forest):

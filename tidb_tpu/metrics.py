@@ -23,7 +23,8 @@ __all__ = ["counter", "histogram", "gauge", "expose", "snapshot",
            "SUPERCHUNKS", "SUPERCHUNK_SOURCES", "SUPERCHUNK_FILL_ROWS",
            "SUPERCHUNK_BUCKET_ROWS", "PIPELINE_STALLS",
            "QUERY_MEM", "MEM_QUOTA_EXCEEDED", "DEVICE_PEAK",
-           "HBM_CACHE_HITS", "HBM_CACHE_MISSES", "HBM_CACHE_EVICTIONS"]
+           "HBM_CACHE_HITS", "HBM_CACHE_MISSES", "HBM_CACHE_EVICTIONS",
+           "DEVICE_FALLBACKS", "JOIN_SPILL_PARTITIONS", "JOIN_HOT_ROWS"]
 
 _lock = threading.Lock()
 _counters: dict[tuple[str, tuple], float] = {}       # guarded-by: _lock
@@ -180,6 +181,17 @@ DEVICE_PEAK = "tidb_tpu_device_peak_bytes"
 HBM_CACHE_HITS = "tidb_tpu_hbm_cache_hits_total"
 HBM_CACHE_MISSES = "tidb_tpu_hbm_cache_misses_total"
 HBM_CACHE_EVICTIONS = "tidb_tpu_hbm_cache_evictions_total"
+# device->host execution fallbacks (labeled {op=...,reason=capacity|
+# collision|unsupported|mesh}): every time an operator planned for the
+# device lands on the host numpy path instead. Before the hybrid
+# join/agg this happened invisibly inside broad except nets; now each
+# one is counted and surfaced in EXPLAIN ANALYZE
+DEVICE_FALLBACKS = "tidb_tpu_device_fallback_total"
+# hybrid hash join (ops/hybrid.py): build partitions shed from HBM to
+# host staging by the memtrack quota spill action, and probe rows routed
+# through the heavy-hitter broadcast lane
+JOIN_SPILL_PARTITIONS = "tidb_tpu_join_spill_partitions_total"
+JOIN_HOT_ROWS = "tidb_tpu_join_hot_lane_rows_total"
 
 _HELP = {
     QUERY_DURATIONS: "Statement wall time through Session.execute.",
@@ -218,4 +230,11 @@ _HELP = {
         "HBM region-block cache misses (upload paid).",
     HBM_CACHE_EVICTIONS:
         "HBM region-block cache entries dropped (LRU/stale/shed).",
+    DEVICE_FALLBACKS:
+        "Device operators that fell back to the host path, "
+        "by op and reason.",
+    JOIN_SPILL_PARTITIONS:
+        "Hybrid-join build partitions spilled from HBM under quota.",
+    JOIN_HOT_ROWS:
+        "Probe rows routed through the heavy-hitter join lane.",
 }

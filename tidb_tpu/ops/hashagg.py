@@ -37,8 +37,8 @@ from tidb_tpu.ops import runtime
 from tidb_tpu.sqltypes import EvalType
 
 __all__ = ["AggSpec", "HashAggKernel", "ScalarAggKernel", "HashAggregator",
-           "CapacityError", "CollisionError", "GroupResult",
-           "finalize_group_result", "kernel_for"]
+           "CapacityError", "CollisionError", "DeviceRejectError",
+           "GroupResult", "finalize_group_result", "kernel_for"]
 
 AggSpec = AggDesc  # the planner's descriptor doubles as the kernel spec
 
@@ -61,6 +61,15 @@ class CapacityError(Exception):
 class CollisionError(Exception):
     """Two distinct key tuples collided in 64-bit hash space (detected by
     the check hash); fall back to the host path."""
+
+
+class DeviceRejectError(ValueError):
+    """The plan is not device-safe BY DESIGN (string computation, host-
+    only aggregate): the designed device->host fallback signal. A
+    ValueError subclass so legacy `except ValueError` handlers keep
+    working — but fallback nets should catch THIS, so a genuine kernel
+    bug raising a bare ValueError surfaces instead of masquerading as a
+    capacity miss."""
 
 
 def _splitmix(xp, h):
@@ -442,22 +451,22 @@ def _validate_device_exprs(filter_expr, group_exprs, aggs) -> None:
     on the host by the planner."""
     from tidb_tpu.expression import ColumnRef
     if filter_expr is not None and not filter_expr.is_device_safe():
-        raise ValueError("filter expression is not device-safe; planner "
+        raise DeviceRejectError("filter expression is not device-safe; planner "
                          "must split string predicates to the host path")
     for g in group_exprs:
         if not g.is_device_safe() and not isinstance(g, ColumnRef):
-            raise ValueError(f"group expr {g!r} computes over a varlen "
+            raise DeviceRejectError(f"group expr {g!r} computes over a varlen "
                              "column; pre-project it on the host")
     for a in aggs:
         if a.fn == AggFunc.GROUP_CONCAT:
-            raise ValueError("GROUP_CONCAT aggregates on the host")
+            raise DeviceRejectError("GROUP_CONCAT aggregates on the host")
         if a.arg is not None and not a.arg.is_device_safe():
             # FIRST_ROW only needs a row index on device, so a bare string
             # ColumnRef is fine (value gathered host-side); computed string
             # exprs would still trace eval_xp and explode mid-jit
             if not (a.fn == AggFunc.FIRST_ROW and
                     isinstance(a.arg, ColumnRef)):
-                raise ValueError(f"agg arg {a.arg!r} is not device-safe")
+                raise DeviceRejectError(f"agg arg {a.arg!r} is not device-safe")
 
 
 @dataclass

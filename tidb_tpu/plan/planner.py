@@ -863,6 +863,7 @@ class Planner:
 
         Rows come from the access pass's stats estimates; with pseudo
         stats only the stats-free merge-vs-hash preference applies."""
+        self._attach_probe_cms(join)
         if len(join.left_keys) != 1 or join.join_type not in (
                 "inner", "left"):
             return join
@@ -898,6 +899,20 @@ class Planner:
                 left_keys=join.left_keys, right_keys=join.right_keys,
                 join_type=join.join_type, other_cond=join.other_cond)
         return join
+
+    def _attach_probe_cms(self, join: ph.PhysHashJoin) -> None:
+        """Hand the executor the probe-side key column's ANALYZE-time
+        CMSketch (when the single probe key traces to a base column):
+        the hybrid hash join seeds its heavy-hitter lane from it, so a
+        known-skewed key routes to the broadcast lane from the very
+        first probe batch instead of after streaming detection."""
+        if len(join.left_keys) != 1 or \
+                not isinstance(join.left_keys[0], ColumnRef):
+            return
+        cs = self._trace_col_stats(join.children[0],
+                                   join.left_keys[0].idx)
+        if cs is not None and cs.cms is not None:
+            join.probe_cms = cs.cms
 
     @staticmethod
     def _pk_ordered_reader(plan, key: Expression) -> bool:
@@ -986,23 +1001,25 @@ class Planner:
         return best
 
     def _trace_col_ndv(self, plan: ph.PhysPlan, idx: int):
+        cs = self._trace_col_stats(plan, idx)
+        return cs.hist.ndv if cs is not None else None
+
+    def _trace_col_stats(self, plan: ph.PhysPlan, idx: int):
+        """ColumnStats of a bare column, traced through the child tree
+        to base-table statistics; None when untraceable or pseudo."""
         if isinstance(plan, (ph.PhysSelection, ph.PhysLimit, ph.PhysSort,
                              ph.PhysTopN)):
-            return self._trace_col_ndv(plan.children[0], idx)
-        if isinstance(plan, (ph.PhysHashJoin, ph.PhysMergeJoin)):
+            return self._trace_col_stats(plan.children[0], idx)
+        if isinstance(plan, (ph.PhysHashJoin, ph.PhysMergeJoin,
+                             ph.PhysIndexJoin)):
             nl = len(plan.children[0].schema)
             if idx < nl:
-                return self._trace_col_ndv(plan.children[0], idx)
-            return self._trace_col_ndv(plan.children[1], idx - nl)
-        if isinstance(plan, ph.PhysIndexJoin):
-            nl = len(plan.children[0].schema)
-            if idx < nl:
-                return self._trace_col_ndv(plan.children[0], idx)
-            return self._trace_col_ndv(plan.children[1], idx - nl)
+                return self._trace_col_stats(plan.children[0], idx)
+            return self._trace_col_stats(plan.children[1], idx - nl)
         if isinstance(plan, ph.PhysProjection):
             e = plan.exprs[idx]
             if isinstance(e, ColumnRef):
-                return self._trace_col_ndv(plan.children[0], e.idx)
+                return self._trace_col_stats(plan.children[0], e.idx)
             return None
         if isinstance(plan, (ph.PhysTableReader, ph.PhysIndexReader)):
             sc = plan.schema.cols[idx]
@@ -1011,8 +1028,7 @@ class Planner:
             stats = self._tbl_stats(plan.cop.table)
             if stats.pseudo:
                 return None
-            cs = stats.columns.get(sc.col_id)
-            return cs.hist.ndv if cs is not None else None
+            return stats.columns.get(sc.col_id)
         return None
 
     def _point_get(self, reader: ph.PhysTableReader, handle, idx, values

@@ -31,7 +31,7 @@ import time
 __all__ = ["OpStats", "StatsCollector", "collecting", "current",
            "instrument", "device_call", "device_section", "fmt_ns",
            "fmt_bytes", "note_superchunk", "note_pipeline_stall",
-           "note_finalize_wait", "device_watermark"]
+           "note_finalize_wait", "note_fallback", "device_watermark"]
 
 _tl = threading.local()
 
@@ -68,7 +68,8 @@ class OpStats:
     __slots__ = ("name", "act_rows", "loops", "time_ns",
                  "device_time_ns", "cop_tasks",
                  "superchunks", "coalesced_chunks", "superchunk_fill_rows",
-                 "superchunk_bucket_rows", "pipeline_stall_ns")
+                 "superchunk_bucket_rows", "pipeline_stall_ns",
+                 "fallbacks")
 
     def __init__(self, name: str):
         self.name = name
@@ -84,6 +85,10 @@ class OpStats:
         self.superchunk_fill_rows = 0   # live rows across superchunks
         self.superchunk_bucket_rows = 0  # padded bucket rows (>= fill)
         self.pipeline_stall_ns = 0      # host blocked in finalize
+        # device->host fallbacks: batches this operator planned for the
+        # device but executed on the host (capacity/collision miss that
+        # survived the partition retry, or a non-device-safe plan)
+        self.fallbacks = 0
 
     def fill_ratio(self) -> float:
         """Live rows over padded bucket rows (0.0 when no superchunks)."""
@@ -100,7 +105,8 @@ class OpStats:
                 "coalesced_chunks": self.coalesced_chunks,
                 "superchunk_fill_rows": self.superchunk_fill_rows,
                 "superchunk_bucket_rows": self.superchunk_bucket_rows,
-                "pipeline_stall_ns": self.pipeline_stall_ns}
+                "pipeline_stall_ns": self.pipeline_stall_ns,
+                "fallbacks": self.fallbacks}
 
 
 class StatsCollector:
@@ -170,6 +176,15 @@ class StatsCollector:
         with self._lock:
             st.pipeline_stall_ns += ns
 
+    def note_fallback(self, plan) -> "OpStats":
+        """One device->host fallback on this operator (may arrive from
+        cop pool workers, hence the lock). Returns the OpStats so the
+        caller can label the metric with the operator name."""
+        st = self.node(plan)
+        with self._lock:
+            st.fallbacks += 1
+        return st
+
     def ops(self) -> list[OpStats]:
         """Distinct OpStats (aliases deduped), insertion order."""
         sealed = getattr(self, "_sealed_ops", None)
@@ -220,6 +235,25 @@ def note_pipeline_stall(plan, ns: int) -> None:
     coll = getattr(_tl, "coll", None)
     if coll is not None:
         coll.note_pipeline_stall(plan, ns)
+
+
+def note_fallback(plan, reason: str) -> None:
+    """Record one device->host fallback: counted on the operator's
+    OpStats (EXPLAIN ANALYZE `pipeline` column) and on the
+    tidb_tpu_device_fallback_total{op,reason} metric family. `reason`
+    is one of capacity|collision|unsupported (single-chip) or mesh
+    (a mesh stream batch served by the host) — the designed fallback
+    causes; anything else should RAISE, not fall back."""
+    from tidb_tpu import metrics
+    coll = getattr(_tl, "coll", None)
+    name = None
+    if coll is not None and plan is not None:
+        name = coll.note_fallback(plan).name
+    if name is None:
+        name = type(plan).__name__.removeprefix("Phys") \
+            if plan is not None else "?"
+    metrics.counter(metrics.DEVICE_FALLBACKS,
+                    {"op": name, "reason": reason})
 
 
 def note_finalize_wait(plan, ns: int) -> None:

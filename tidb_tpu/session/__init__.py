@@ -2177,13 +2177,16 @@ class Session:
 def _fmt_pipeline(st) -> str:
     """EXPLAIN ANALYZE `pipeline` cell: how the operator's device work
     was coalesced (superchunks/source chunks), how full the padded
-    buckets were, and how long the host sat blocked on readback."""
+    buckets were, how long the host sat blocked on readback — and how
+    often the operator fell back to the host path (the note that makes
+    an invisible device->host cliff visible in the plan)."""
     from tidb_tpu import runtime_stats as rs
+    fb = f" fallback={st.fallbacks}" if st.fallbacks else ""
     if not st.superchunks:
-        return "-"
+        return f"-{fb}" if fb else "-"
     return (f"{st.superchunks}sc/{st.coalesced_chunks}ch "
             f"fill={st.fill_ratio():.2f} "
-            f"stall={rs.fmt_ns(st.pipeline_stall_ns)}")
+            f"stall={rs.fmt_ns(st.pipeline_stall_ns)}{fb}")
 
 
 @dataclass

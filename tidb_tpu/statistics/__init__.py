@@ -35,7 +35,7 @@ from tidb_tpu.sqltypes import EvalType
 __all__ = ["Histogram", "CMSketch", "ColumnStats", "IndexStats",
            "TableStats", "StatsHandle", "build_histogram",
            "build_column_stats", "analyze_table", "selectivity",
-           "PSEUDO_ROW_COUNT", "SELECTION_FACTOR"]
+           "cm_key", "PSEUDO_ROW_COUNT", "SELECTION_FACTOR"]
 
 # Pseudo-stats rates; ref: statistics/table.go pseudo estimation constants.
 PSEUDO_ROW_COUNT = 10000
@@ -266,6 +266,13 @@ def _cm_key(v) -> bytes:
     if isinstance(v, (int, np.integer)):
         return b"i" + int(v).to_bytes(8, "little", signed=True)
     return b"f" + np.float64(v).tobytes()
+
+
+def cm_key(v) -> bytes:
+    """Public CMSketch key encoding for a column value — external
+    consumers (the hybrid join's heavy-hitter seeding) must query with
+    EXACTLY the encoding ANALYZE inserted with."""
+    return _cm_key(v)
 
 
 @dataclass

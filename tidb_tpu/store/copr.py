@@ -20,7 +20,8 @@ from tidb_tpu.kv import (CopRequest, CopResponse, KVRange, NotLeaderError,
                          RegionError, ReqType, ServerBusyError,
                          KeyLockedError)
 from tidb_tpu.mockstore.cluster import Region
-from tidb_tpu.ops.hashagg import CapacityError, CollisionError
+from tidb_tpu.ops.hashagg import (CapacityError, CollisionError,
+                                  DeviceRejectError)
 from tidb_tpu.ops.hostagg import host_hash_agg, host_scalar_agg
 from tidb_tpu.ops.runtime import bucket_size, eval_filter_host
 from tidb_tpu.plan.physical import CopPlan
@@ -141,8 +142,23 @@ def exec_cop_plan(plan: CopPlan, chunk, sources: int = 1,
                         plan, chunk.num_rows,
                         bucket_size(max(chunk.num_rows, 1)), sources)
                 return CopResponse(chunk=res)
-            except (CapacityError, CollisionError, ValueError):
-                pass
+            except (CapacityError, CollisionError) as e:
+                if plan.group_exprs:
+                    # capacity/collision miss: escalate once, then retry
+                    # per radix partition (ops/hybrid.py) — the device
+                    # is abandoned per PARTITION, never per operator
+                    from tidb_tpu.ops.hybrid import agg_retry
+                    return CopResponse(chunk=agg_retry(
+                        chunk, plan.filter, plan.group_exprs, plan.aggs,
+                        plan, e))
+                runtime_stats.note_fallback(
+                    plan, "collision" if isinstance(e, CollisionError)
+                    else "capacity")
+            except (DeviceRejectError, NotImplementedError):
+                # designed rejection (not device-safe). A bare
+                # ValueError is NOT caught here any more: a real kernel
+                # bug must surface, not masquerade as a capacity miss
+                runtime_stats.note_fallback(plan, "unsupported")
         if plan.group_exprs:
             return CopResponse(chunk=host_hash_agg(
                 chunk, plan.filter, plan.group_exprs, plan.aggs))
