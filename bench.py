@@ -435,6 +435,294 @@ def _skew_join_bench(session, storage, sf: float, iters: int,
     return out
 
 
+def _scope_cpu_compile_cache() -> bool:
+    """Re-point the persistent compile cache at the per-host-feature-set
+    CPU subdirectory (compile_cache.scoped_cpu_dir): CPU runs must not
+    load through-the-tunnel TPU entries (mismatched AOT results
+    deoptimize scatter-heavy programs ~5x), and every CPU program
+    persists (floor 0) so warm runs pay zero compiles. Returns False
+    when the operator explicitly disabled the cache
+    (TIDB_TPU_COMPILE_CACHE=0) — callers then leave it off."""
+    from tidb_tpu.util import compile_cache
+    base = os.environ.get("TIDB_TPU_COMPILE_CACHE", _CACHE_DIR)
+    if not base or base == "0":
+        return False
+    scoped = compile_cache.scoped_cpu_dir(base)
+    os.environ["TIDB_TPU_COMPILE_CACHE"] = scoped
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = scoped
+    os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0"
+    compile_cache.enable(scoped, min_compile_secs=0.0)
+    return True
+
+
+def _percentile(xs: list, p: float) -> float:
+    """Nearest-rank percentile over a non-empty list of seconds:
+    the ceil(p/100 * n)-th smallest value."""
+    ys = sorted(xs)
+    i = min(math.ceil(p / 100.0 * len(ys)) - 1, len(ys) - 1)
+    return ys[max(i, 0)]
+
+
+def _lat_summary(lat: dict) -> dict:
+    return {cls: {"count": len(xs),
+                  "p50_ms": round(_percentile(xs, 50) * 1e3, 2),
+                  "p99_ms": round(_percentile(xs, 99) * 1e3, 2)}
+            for cls, xs in lat.items() if xs}
+
+
+def _serve_bench(progress) -> dict:
+    """Multi-client wire-protocol load harness (ISSUE 10 / ROADMAP item
+    1's second headline series): N real MySQL connections replay a mixed
+    TPC-H Q1/Q3/Q5 + point-lookup workload against one server. Reports
+    aggregate input rows/sec for the CONCURRENT replay vs the serialized
+    one-connection replay of the same op multiset, p50/p99 per query
+    class, admission outcomes and device-scheduler stall time — then a
+    deliberately pinched `tidb_tpu_server_mem_quota` leg that must
+    complete via shed/queue/retry (admission_shed > 0) with ZERO
+    mid-query OOM cancels.
+
+    Env knobs: BENCH_SERVE_CLIENTS (8), BENCH_SERVE_ROUNDS (2: analytic
+    queries per client), BENCH_SERVE_LOOKUPS (8: point lookups per
+    analytic), BENCH_SERVE_SF (0.02)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tests.mysql_client import MiniClient, MySQLError
+    from tidb_tpu import config, errcode, memtrack, metrics, perfschema, \
+        sched
+    from tidb_tpu.benchmarks import tpch
+    from tidb_tpu.server import Server
+    from tidb_tpu.session import Session
+    from tidb_tpu.store.storage import new_mock_storage
+
+    n_clients = int(os.environ.get("BENCH_SERVE_CLIENTS", "8"))
+    rounds = int(os.environ.get("BENCH_SERVE_ROUNDS", "2"))
+    lookups = int(os.environ.get("BENCH_SERVE_LOOKUPS", "8"))
+    sf = float(os.environ.get("BENCH_SERVE_SF", "0.02"))
+
+    data = tpch.ScaledTpch(sf=sf)
+    storage = new_mock_storage()
+    session = Session(storage)
+    session.execute("CREATE DATABASE tpch_serve")
+    session.execute("USE tpch_serve")
+    progress(f"serve: loading sf={sf} for {n_clients} clients")
+    total_loaded = tpch.load(session, storage, data, regions_per_table=2)
+    classes = list(tpch.QUERIES)
+    class_rows = {q: sum(data.counts[t] for t in tpch.QUERY_TABLES[q])
+                  for q in tpch.QUERIES}
+    n_orders = data.counts["orders"]
+
+    # per-client deterministic op lists: each round is one analytic
+    # (rotating per client+round so the classes overlap ACROSS clients)
+    # plus a burst of point lookups — the starvation-prone mix
+    def client_ops(ci: int) -> list:
+        ops = []
+        for r in range(rounds):
+            q = classes[(ci + r) % len(classes)]
+            ops.append((q, tpch.QUERIES[q], class_rows[q]))
+            for j in range(lookups):
+                k = (ci * 7919 + r * 104729 + j * 131) % n_orders
+                ops.append(("point", "SELECT o_custkey, o_orderpriority "
+                            f"FROM orders WHERE o_orderkey = {k}", 1))
+        return ops
+
+    all_ops = [client_ops(ci) for ci in range(n_clients)]
+    workload_rows = sum(rows for ops in all_ops for _c, _s, rows in ops)
+
+    # warm through a direct session so neither leg pays first-compile
+    progress("serve: warmup (compile + cache fill)")
+    for q in classes:
+        session.query(tpch.QUERIES[q])
+
+    server = Server(storage)
+    server.start()
+
+    def new_client() -> MiniClient:
+        c = MiniClient("127.0.0.1", server.port, db="tpch_serve")
+        c.sock.settimeout(600)
+        return c
+
+    def run_ops(cli, ops, lat, errors) -> None:
+        for cls, sql2, _rows in ops:
+            t0 = time.perf_counter()
+            tries = 0
+            while True:
+                try:
+                    cli.query(sql2)
+                    break
+                except MySQLError as e:
+                    # the admission contract: 9xxx server-busy is
+                    # RETRYABLE verbatim after backoff; anything else
+                    # is a workload bug worth surfacing
+                    if e.code == errcode.ER_SERVER_BUSY_ADMISSION \
+                            and tries < 200:
+                        tries += 1
+                        time.sleep(0.05)
+                        continue
+                    errors.append(f"{cls}: ({e.code}) {e}")
+                    break
+            lat.setdefault(cls, []).append(time.perf_counter() - t0)
+
+    out: dict = {"clients": n_clients, "rounds": rounds,
+                 "lookups_per_round": lookups, "sf": sf,
+                 "rows_loaded": total_loaded,
+                 "ops": sum(len(ops) for ops in all_ops),
+                 "workload_rows": workload_rows}
+    try:
+        # serialized baseline: ONE connection replays every client's op
+        # list back to back — the number concurrency must beat
+        progress("serve: serialized replay")
+        lat_ser: dict = {}
+        errs: list = []
+        cli = new_client()
+        t0 = time.perf_counter()
+        for ops in all_ops:
+            run_ops(cli, ops, lat_ser, errs)
+        ser_secs = time.perf_counter() - t0
+        cli.close()
+        if errs:
+            raise RuntimeError(f"serialized replay errors: {errs[:3]}")
+        out["serialized"] = {
+            "secs": round(ser_secs, 3),
+            "rows_per_sec": round(workload_rows / ser_secs, 1),
+            "latency": _lat_summary(lat_ser)}
+
+        # concurrent replay: same multiset, N wire connections
+        progress(f"serve: concurrent replay x{n_clients}")
+        sched0 = sched.stats()
+        lats = [dict() for _ in range(n_clients)]
+        errlists = [list() for _ in range(n_clients)]
+        clients = [new_client() for _ in range(n_clients)]
+        start = threading.Barrier(n_clients + 1)
+
+        def worker(ci: int) -> None:
+            start.wait()
+            run_ops(clients[ci], all_ops[ci], lats[ci], errlists[ci])
+
+        threads = [threading.Thread(target=worker, args=(ci,),
+                                    name=f"serve-client-{ci}")
+                   for ci in range(n_clients)]
+        for t in threads:
+            t.start()
+        start.wait()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join()
+        conc_secs = time.perf_counter() - t0
+        for c in clients:
+            c.close()
+        errs = [e for el in errlists for e in el]
+        if errs:
+            raise RuntimeError(f"concurrent replay errors: {errs[:3]}")
+        sched1 = sched.stats()
+        lat_conc: dict = {}
+        for d in lats:
+            for cls, xs in d.items():
+                lat_conc.setdefault(cls, []).extend(xs)
+        conc_rps = workload_rows / conc_secs
+        out["concurrent"] = {
+            "secs": round(conc_secs, 3),
+            "rows_per_sec": round(conc_rps, 1),
+            "speedup_vs_serialized": round(
+                conc_rps / (workload_rows / ser_secs), 3),
+            "latency": _lat_summary(lat_conc),
+            "sched_stall_seconds": round(
+                sched1["scheduler"]["stall_seconds"] -
+                sched0["scheduler"]["stall_seconds"], 4),
+            "sched_bypasses": sched1["scheduler"]["bypasses"] -
+            sched0["scheduler"]["bypasses"]}
+
+        # pinched leg: a server quota around one analytic's peak forces
+        # admission to shed HBM residency and queue the rest; clients
+        # retry the retryable 9008. The workload must COMPLETE with
+        # shed > 0 and ZERO mid-query OOM cancels.
+        peak = max(perfschema.digest_max_mem(tpch.QUERIES[q])
+                   for q in classes)
+        resident = memtrack.SERVER.host + memtrack.SERVER.device
+        quota = max(peak, resident, 1 << 22)
+        progress(f"serve: pinched leg quota={quota} "
+                 f"(digest peak {peak}, resident {resident})")
+        oom_key = ('tidb_tpu_mem_quota_exceeded_total'
+                   '{action="cancel"}')
+        oom0 = metrics.snapshot().get(oom_key, 0)
+        adm0 = sched.stats()["admission"]
+        quota_prev = config.get_var("tidb_tpu_server_mem_quota")
+        config.set_var("tidb_tpu_server_mem_quota", quota)
+        try:
+            lats = [dict() for _ in range(n_clients)]
+            errlists = [list() for _ in range(n_clients)]
+            clients = [new_client() for _ in range(n_clients)]
+            start = threading.Barrier(n_clients + 1)
+            threads = [threading.Thread(target=worker, args=(ci,),
+                                        name=f"serve-pinch-{ci}")
+                       for ci in range(n_clients)]
+            for t in threads:
+                t.start()
+            start.wait()
+            t0 = time.perf_counter()
+            for t in threads:
+                t.join()
+            pinch_secs = time.perf_counter() - t0
+            for c in clients:
+                c.close()
+        finally:
+            # restore, not zero: an operator-seeded quota
+            # (TIDB_TPU_SERVER_MEM_QUOTA) must survive the leg
+            config.set_var("tidb_tpu_server_mem_quota", quota_prev)
+        errs = [e for el in errlists for e in el]
+        adm1 = sched.stats()["admission"]
+        oom1 = metrics.snapshot().get(oom_key, 0)
+        lat_p: dict = {}
+        for d in lats:
+            for cls, xs in d.items():
+                lat_p.setdefault(cls, []).extend(xs)
+        out["pinched"] = {
+            "quota_bytes": quota,
+            "secs": round(pinch_secs, 3),
+            "rows_per_sec": round(workload_rows / pinch_secs, 1),
+            "latency": _lat_summary(lat_p),
+            "errors": errs[:5],
+            "admission": {k: adm1[k] - adm0[k]
+                          for k in ("admitted", "queued", "shed",
+                                    "rejected")},
+            "admission_shed": adm1["shed"] - adm0["shed"],
+            "shed_bytes": adm1["shed_bytes"] - adm0["shed_bytes"],
+            # the acceptance bar: admission replaces the OOM cancel
+            "oom_cancels": int(oom1 - oom0)}
+        if errs:
+            out["pinched"]["completed"] = False
+        else:
+            out["pinched"]["completed"] = True
+    finally:
+        server.close()
+        session.close()
+        storage.close()
+    return out
+
+
+def serve_main() -> None:
+    """`python bench.py serve`: ONLY the multi-client load harness, on a
+    small fixed workload — the CI entry point (scripts/serve_bench.sh)
+    with its own one-line JSON."""
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # same per-host-feature-set CPU cache scoping as the full
+        # bench's CPU fallback — one policy, one helper
+        _scope_cpu_compile_cache()
+    t_start = time.perf_counter()
+
+    def progress(msg: str) -> None:
+        print(f"[serve +{time.perf_counter() - t_start:7.1f}s] {msg}",
+              file=sys.stderr, flush=True)
+
+    serve = _serve_bench(progress)
+    print(json.dumps({
+        "metric": "serve_concurrent_rows_per_sec",
+        "value": serve.get("concurrent", {}).get("rows_per_sec", 0.0),
+        "unit": "rows/s",
+        "vs_baseline": serve.get("concurrent", {}).get(
+            "speedup_vs_serialized", 0.0),
+        "detail": serve,
+    }))
+
+
 def main() -> None:
     sf = float(os.environ.get("BENCH_SF", "1.0"))
     iters = int(os.environ.get("BENCH_ITERS", "5"))
@@ -452,28 +740,13 @@ def main() -> None:
         jax.config.update("jax_platforms", "cpu")
         # the base cache dir holds through-the-tunnel TPU compiles; CPU
         # must not load AOT results built for a different virtualized
-        # feature set (prefer-no-scatter etc. deoptimize scatter-heavy
-        # programs ~5x, measured on Q3). BENCH r05 solved that by
-        # DISABLING the cache — which re-paid Q1's ~49s first compile in
-        # every bench process. Instead: scope the cache to a
-        # per-host-feature-set CPU subdirectory (compile_cache.
-        # scoped_cpu_dir), so CPU entries stay warm across runs and
-        # tunnel entries stay unloaded. Importing the package here is
-        # safe — jax_platforms is already pinned to cpu above.
-        from tidb_tpu.util import compile_cache
-        base = os.environ.get("TIDB_TPU_COMPILE_CACHE", _CACHE_DIR)
-        if base and base != "0":
-            scoped = compile_cache.scoped_cpu_dir(base)
-            os.environ["TIDB_TPU_COMPILE_CACHE"] = scoped
-            os.environ["JAX_COMPILATION_CACHE_DIR"] = scoped
-            # persist EVERY program (floor 0): CPU programs often
-            # compile in <1s apiece, and any floor-skipped program is a
-            # guaranteed miss in every later bench process — the
-            # warm-run contract is misses == 0
-            # (tests/test_compile_cache_warm.py)
-            os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0"
-            compile_cache.enable(scoped, min_compile_secs=0.0)
-        else:
+        # feature set. BENCH r05 solved that by DISABLING the cache —
+        # which re-paid Q1's ~49s first compile in every bench process.
+        # Instead: scope to the per-host-feature-set CPU subdirectory
+        # (see _scope_cpu_compile_cache; warm-run contract misses == 0,
+        # tests/test_compile_cache_warm.py). Importing the package here
+        # is safe — jax_platforms is already pinned to cpu above.
+        if not _scope_cpu_compile_cache():
             # explicit operator disable (TIDB_TPU_COMPILE_CACHE=0)
             # stays disabled — don't resurrect a cache the operator
             # just killed (e.g. after a poisoning incident)
@@ -710,6 +983,20 @@ def main() -> None:
             # headline TPC-H numbers must survive a skew-bench failure
             detail["skew_join_error"] = str(e)
 
+    if os.environ.get("BENCH_SERVE", "1") != "0":
+        progress("serve: multi-client wire load harness")
+        # the serve harness brings its own storage/server; the mesh
+        # executors stay out of it (concurrent mesh collectives belong
+        # to the MULTICHIP series, not the serving series)
+        mesh_config.disable_mesh()
+        try:
+            detail["serve"] = _serve_bench(progress)
+        except Exception as e:  # noqa: BLE001 - advisory block: the
+            # headline TPC-H numbers must survive a serve-bench failure
+            detail["serve_error"] = str(e)
+        finally:
+            mesh_config.enable_mesh()
+
     if os.environ.get("BENCH_KERNEL_MICRO", "1") != "0":
         try:
             detail["kernel_only_q1_rows_per_sec"] = round(_kernel_micro(), 1)
@@ -751,4 +1038,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "serve":
+        serve_main()
+    else:
+        main()

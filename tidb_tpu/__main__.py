@@ -82,6 +82,12 @@ def main(argv=None) -> int:
     cc = compile_cache.stats()
     log.info("XLA compile cache: %s (%s entries)",
              cc["dir"] or "disabled", cc["entries"])
+    log.info("serving: scheduler inflight=%d (bytes gate %d), "
+             "server mem quota=%d (admission %s, timeout %dms)",
+             config.sched_inflight(), config.sched_inflight_bytes(),
+             config.server_mem_quota(),
+             "on" if config.server_mem_quota() else "off",
+             config.admission_timeout_ms())
 
     from tidb_tpu.parallel import config as mesh_config
     if args.no_mesh:

@@ -29,6 +29,8 @@ __all__ = ["get_var", "set_var", "all_vars", "coerce", "session_overlay",
            "runtime_stats_enabled",
            "runtime_stats_device", "mem_quota_query",
            "device_cache_bytes", "fused_scan_enabled",
+           "server_mem_quota", "admission_timeout_ms",
+           "sched_inflight", "sched_inflight_bytes",
            "UnknownVariableError"]
 
 
@@ -151,6 +153,30 @@ _DEFS: dict[str, tuple[str, int]] = {
     # 0 = unlimited. Crossing it fires the OOM-action chain: registered
     # sort/agg spills first, then cancel with ER_MEM_EXCEED_QUOTA.
     "tidb_tpu_mem_quota_query": (_INT, 0),
+    # SERVER-wide memory budget in bytes over the memtrack root's two
+    # ledgers combined (tidb_tpu/sched.py AdmissionController; ref: the
+    # reference's server-memory-quota). 0 = admission control off. On
+    # projected overflow at statement admission the controller first
+    # drives the registered shed chain (HBM cache blocks, running
+    # statements' spill actions), then queues the statement up to
+    # tidb_tpu_admission_timeout_ms, then rejects with the RETRYABLE
+    # ER_SERVER_BUSY_ADMISSION (9008) — never a mid-query OOM cancel.
+    "tidb_tpu_server_mem_quota": (_INT, 0),
+    # bounded admission-queue wait before a statement is rejected with
+    # the retryable 9008 (milliseconds)
+    "tidb_tpu_admission_timeout_ms": (_INT, 1000),
+    # global device dispatch window (tidb_tpu/sched.py DeviceScheduler):
+    # at most this many kernel dispatches in flight across ALL
+    # concurrent statements, granted round-robin per statement so one
+    # long analytic query cannot monopolize the device while point
+    # lookups starve. 0 = scheduler off (the pre-scheduler free-for-all
+    # where each statement owned a private pipeline-depth window).
+    "tidb_tpu_sched_inflight": (_INT, 4),
+    # in-flight-bytes gate: a dispatch slot is granted only while the
+    # memtrack SERVER root's DEVICE ledger sits below this many bytes
+    # (0 = no bytes gate). Size it to HBM minus the device-cache budget;
+    # one dispatch is always allowed through when none are in flight.
+    "tidb_tpu_sched_inflight_bytes": (_INT, 0),
 }
 
 _lock = threading.Lock()
@@ -339,6 +365,22 @@ def mem_quota_query() -> int:
 
 def device_cache_bytes() -> int:
     return max(0, _read("tidb_tpu_device_cache_bytes"))
+
+
+def server_mem_quota() -> int:
+    return max(0, _read("tidb_tpu_server_mem_quota"))
+
+
+def admission_timeout_ms() -> int:
+    return max(0, _read("tidb_tpu_admission_timeout_ms"))
+
+
+def sched_inflight() -> int:
+    return max(0, _read("tidb_tpu_sched_inflight"))
+
+
+def sched_inflight_bytes() -> int:
+    return max(0, _read("tidb_tpu_sched_inflight_bytes"))
 
 
 def fused_scan_enabled() -> bool:

@@ -126,6 +126,12 @@ ER_GC_TOO_EARLY = 9006
 # mid-region and exhausted its resume budget (store/stream.py); same
 # retryable class as region unavailability
 ER_REGION_STREAM_INTERRUPTED = 9007
+# statement refused at admission (tidb_tpu/sched.py): the server sits
+# over tidb_tpu_server_mem_quota, the shed chain freed too little, and
+# the bounded queue wait expired. RETRYABLE like ER_TIKV_SERVER_BUSY —
+# nothing ran, the session and its transaction are untouched, a
+# verbatim replay after backoff is always safe
+ER_SERVER_BUSY_ADMISSION = 9008
 # commit outcome unknown (network error on the primary commit,
 # 2pc.go:421-431): NOT retryable — the write may have landed, so a
 # verbatim replay risks applying it twice
@@ -142,7 +148,7 @@ RETRYABLE = frozenset({
     ER_LOCK_WAIT_TIMEOUT, ER_LOCK_DEADLOCK, ER_NEED_REPREPARE,
     ER_PD_SERVER_TIMEOUT, ER_TIKV_SERVER_TIMEOUT, ER_TIKV_SERVER_BUSY,
     ER_RESOLVE_LOCK_TIMEOUT, ER_REGION_UNAVAILABLE,
-    ER_REGION_STREAM_INTERRUPTED,
+    ER_REGION_STREAM_INTERRUPTED, ER_SERVER_BUSY_ADMISSION,
 })
 
 
@@ -251,6 +257,7 @@ _SQLSTATE = {
     ER_REGION_UNAVAILABLE: "HY000",
     ER_GC_TOO_EARLY: "HY000",
     ER_REGION_STREAM_INTERRUPTED: "HY000",
+    ER_SERVER_BUSY_ADMISSION: "HY000",
     ER_RESULT_UNDETERMINED: "HY000",
     ER_MEM_EXCEED_QUOTA: "HY000",
 }
@@ -301,6 +308,11 @@ def _is_sql_layer(exc: BaseException) -> bool:
     return isinstance(exc, (SQLError, kv.KVError))
 
 
+def _is_admission_reject(exc: BaseException) -> bool:
+    from tidb_tpu.sched import AdmissionRejectedError
+    return isinstance(exc, AdmissionRejectedError)
+
+
 def classify(exc: BaseException) -> tuple[int, str, str]:
     """exception -> (errno, sqlstate, message) for the wire ERR packet."""
     from tidb_tpu import kv
@@ -325,6 +337,10 @@ def classify(exc: BaseException) -> tuple[int, str, str]:
         code = ER_LOCK_WAIT_TIMEOUT
     elif isinstance(exc, kv.WriteConflictError):
         code = ER_LOCK_DEADLOCK
+    elif _is_admission_reject(exc):
+        # refused BEFORE anything ran (tidb_tpu/sched.py): retryable
+        # server-busy class, same contract as ER_TIKV_SERVER_BUSY
+        code = ER_SERVER_BUSY_ADMISSION
     elif isinstance(exc, kv.StreamInterruptedError):
         # streamed coprocessor reply died past its resume budget: the
         # retryable region-stream class (store/stream.py subsystem)

@@ -14,7 +14,7 @@ import time
 
 import numpy as np
 
-from tidb_tpu import config, kv, memtrack, runtime_stats, tablecodec
+from tidb_tpu import config, kv, memtrack, runtime_stats, sched, tablecodec
 from tidb_tpu.chunk import Chunk, Column
 from tidb_tpu.expression import AggDesc, AggFunc, Expression
 from tidb_tpu.kv import CopRequest, KVRange, ReqType
@@ -519,7 +519,7 @@ class HashAggExec(Executor):
             if self._kernel is None:
                 self._set_kernel(kernel_for(
                     None, self.plan.group_exprs, self.plan.aggs))
-            with memtrack.device_scope(
+            with sched.device_slot(), memtrack.device_scope(
                     self.plan, self._kernel.dispatch_nbytes(chunk)):
                 return runtime_stats.device_call(
                     self.plan, self._kernel, chunk)
@@ -529,8 +529,9 @@ class HashAggExec(Executor):
             if k is not None:
                 # the retry kernel's (>=2x) scratch is the statement's
                 # LARGEST device allocation — it must not dodge the quota
-                with memtrack.device_scope(self.plan,
-                                           k.dispatch_nbytes(chunk)):
+                with sched.device_slot(), \
+                        memtrack.device_scope(self.plan,
+                                              k.dispatch_nbytes(chunk)):
                     try:
                         return runtime_stats.device_call(
                             self.plan, k, chunk)
@@ -607,7 +608,7 @@ class HashAggExec(Executor):
                     reason = "capacity"
                     k2 = self._escalated_kernel(e)
                     if k2 is not None:
-                        with memtrack.device_scope(
+                        with sched.device_slot(), memtrack.device_scope(
                                 plan, k2.dispatch_nbytes(sc.chunk)):
                             try:
                                 return k2(sc.chunk)
@@ -711,7 +712,7 @@ class StreamAggExec(Executor):
                         self._kernel = segment_kernel_for(
                             self.plan.group_exprs, self.plan.aggs)
                         self.plan._root_kernel = self._kernel
-                    with memtrack.device_scope(
+                    with sched.device_slot(), memtrack.device_scope(
                             self.plan,
                             self._kernel.dispatch_nbytes(part)):
                         gr = runtime_stats.device_call(
@@ -1133,7 +1134,7 @@ class HashJoinExec(Executor):
                 elif config.device_enabled() and \
                         (n >= self._DEVICE_MIN_PROBE or
                          nb >= self._DEVICE_MIN_BUILD):
-                    with memtrack.device_scope(
+                    with sched.device_slot(), memtrack.device_scope(
                             self.plan,
                             self._kernel.build_nbytes(nb) +
                             self._kernel.dispatch_nbytes(n)):
@@ -1818,7 +1819,7 @@ class IndexJoinExec(HashJoinExec):
             enc = JoinKeyEncoder(len(plan.right_keys))  # fresh per batch
             bk = enc.fit_build(self._eval_keys(plan.right_keys, build))
             pk = enc.transform_probe(self._eval_keys(plan.left_keys, chunk))
-            with memtrack.device_scope(
+            with sched.device_slot(), memtrack.device_scope(
                     self.plan, self._kernel.build_nbytes(nb) +
                     self._kernel.dispatch_nbytes(n)):
                 li, ri = runtime_stats.device_call(

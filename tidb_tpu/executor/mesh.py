@@ -20,7 +20,7 @@ from collections import OrderedDict, deque
 import numpy as np
 
 from tidb_tpu import config as sysconf
-from tidb_tpu import memtrack, runtime_stats
+from tidb_tpu import memtrack, runtime_stats, sched
 from tidb_tpu.chunk import Chunk, Column
 from tidb_tpu.ops.hashagg import CapacityError, CollisionError, HashAggregator
 from tidb_tpu.ops.hostagg import host_hash_agg
@@ -209,7 +209,7 @@ class _MeshExecBase:
             tracked = memtrack.track_to(self.plan, agg.approx_bytes(),
                                         tracked)
 
-        def finish(pkernel, outs, batch, db):
+        def finish(pkernel, outs, batch, db, slot=None):
             nonlocal kernel, capacity
             t0 = time.perf_counter_ns()
             try:
@@ -234,6 +234,7 @@ class _MeshExecBase:
             except (CollisionError, BuildError, ValueError):
                 pass
             finally:
+                sched.device_scheduler().release(slot)
                 if db:
                     memtrack.release(self.plan, device=db)
                 # stall only (the enclosing device_section owns device
@@ -244,45 +245,67 @@ class _MeshExecBase:
             runtime_stats.note_fallback(self.plan, "mesh")
             return host_batch(batch)
 
-        pending: deque = deque()  # (kernel, in-flight outs, batch, bytes)
-        for sc in superchunks:
-            batch = sc.chunk
-            _STREAM_STATS["batches"] += 1
-            _STREAM_STATS["max_batch_rows"] = max(
-                _STREAM_STATS["max_batch_rows"], batch.num_rows)
-            outs = None
-            db = 0
-            launch_kernel = kernel     # finish() may rebind `kernel` on a
-            if launch_kernel is not None:   # capacity re-plan; outs must be
-                db = memtrack.device_put_bytes(batch)
-                # lint: exempt[paired-resource] split pipeline pair: released in finish()'s finally (or below on a failed launch)
-                memtrack.consume(self.plan, device=db)
-                try:                        # read back by their own kernel
-                    outs = launch_kernel.launch(batch, bucket=True)
-                    if pending:
-                        _STREAM_STATS["overlapped_launches"] += 1
-                    runtime_stats.note_superchunk(
-                        self.plan, batch.num_rows,
-                        bucket_size(max(batch.num_rows, 1)), sc.sources)
-                except (ValueError, CollisionError, BuildError):
-                    outs = None
-                if outs is None:
-                    memtrack.release(self.plan, device=db)
-                    db = 0
-            if outs is not None:
-                pending.append((launch_kernel, outs, batch, db))
-                while len(pending) > depth:
-                    merge(finish(*pending.popleft()))
-            else:
-                # host batches are synchronous: drain in-flight work
-                # first so results keep arriving in input order
-                while pending:
-                    merge(finish(*pending.popleft()))
-                _STREAM_STATS["host_batches"] += 1
-                runtime_stats.note_fallback(self.plan, "mesh")
-                merge(host_batch(batch))
-        while pending:
-            merge(finish(*pending.popleft()))
+        pending: deque = deque()  # (kernel, outs, batch, bytes, slot)
+        try:
+            for sc in superchunks:
+                batch = sc.chunk
+                _STREAM_STATS["batches"] += 1
+                _STREAM_STATS["max_batch_rows"] = max(
+                    _STREAM_STATS["max_batch_rows"], batch.num_rows)
+                outs = None
+                db = 0
+                slot = None
+                launch_kernel = kernel   # finish() may rebind `kernel` on
+                if launch_kernel is not None:   # a capacity re-plan; outs
+                    # each in-flight mesh launch holds a global dispatch
+                    # slot exactly like the single-chip pipeline — the
+                    # mesh must not dodge the round-robin window
+                    slot = sched.device_scheduler().acquire_or_bypass()
+                    db = memtrack.device_put_bytes(batch)
+                    try:
+                        memtrack.consume(self.plan, device=db)
+                    except BaseException:    # quota cancel mid-charge
+                        sched.device_scheduler().release(slot)
+                        raise
+                    try:                 # read back by their own kernel
+                        outs = launch_kernel.launch(batch, bucket=True)
+                        if pending:
+                            _STREAM_STATS["overlapped_launches"] += 1
+                        runtime_stats.note_superchunk(
+                            self.plan, batch.num_rows,
+                            bucket_size(max(batch.num_rows, 1)),
+                            sc.sources)
+                    except (ValueError, CollisionError, BuildError):
+                        outs = None
+                    if outs is None:
+                        memtrack.release(self.plan, device=db)
+                        db = 0
+                        sched.device_scheduler().release(slot)
+                        slot = None
+                if outs is not None:
+                    pending.append((launch_kernel, outs, batch, db, slot))
+                    while len(pending) > depth:
+                        merge(finish(*pending.popleft()))
+                else:
+                    # host batches are synchronous: drain in-flight work
+                    # first so results keep arriving in input order
+                    while pending:
+                        merge(finish(*pending.popleft()))
+                    _STREAM_STATS["host_batches"] += 1
+                    runtime_stats.note_fallback(self.plan, "mesh")
+                    merge(host_batch(batch))
+            while pending:
+                merge(finish(*pending.popleft()))
+        finally:
+            # an exception unwinding past the drains (quota cancel in
+            # merge, KILL interrupt) abandons launched batches: their
+            # dispatch slots and device bytes must not leak for the
+            # life of the process — mirror of pipeline_map's finally
+            while pending:
+                _k, _outs, _b, p_db, p_slot = pending.popleft()
+                sched.device_scheduler().release(p_slot)
+                if p_db:
+                    memtrack.release(self.plan, device=p_db)
         if kernel is not None:
             self.plan._mesh_capacity = capacity
         return tracked
@@ -357,7 +380,7 @@ class MeshAggExec(_MeshExecBase):
         big = _concat_chunks_cached(plan, "_probe_cache", parts, schema)
         gr = None
         if big.num_rows:
-            with runtime_stats.device_section(plan), \
+            with sched.device_slot(), runtime_stats.device_section(plan), \
                     memtrack.device_scope(plan,
                                           memtrack.device_put_bytes(big)):
                 gr = self._run_with_escalation(make, lambda k: k(big))
@@ -454,7 +477,7 @@ class MeshLookupAggExec(_MeshExecBase):
                                       plan.children[0].schema)
         gr = None
         if probe.num_rows:
-            with runtime_stats.device_section(plan), \
+            with sched.device_slot(), runtime_stats.device_section(plan), \
                     memtrack.device_scope(plan,
                                           memtrack.device_put_bytes(probe)):
                 gr = self._run_with_escalation(

@@ -55,7 +55,9 @@ class MetricNamesRule(Rule):
     the registry of names in metrics.py is the single place scrape
     dashboards are built against. Declared names must also follow the
     Prometheus conventions (tidb_tpu_ prefix, lowercase, unit suffix
-    _total/_seconds/_bytes).
+    _total/_seconds/_bytes — or the unitless gauge-level suffixes
+    _current/_depth for instantaneous counts like open connections and
+    queue depths, which carry no unit to name).
     """
 
     min_sites = 10      # the session + coprocessor layers really emit
@@ -81,13 +83,15 @@ class MetricNamesRule(Rule):
                           "metrics.py lost its name constants")
         for const, (value, lineno) in consts.items():
             ok = (value.startswith("tidb_tpu_") and value == value.lower()
-                  and value.endswith(("_total", "_seconds", "_bytes")))
+                  and value.endswith(("_total", "_seconds", "_bytes",
+                                      "_current", "_depth")))
             if not ok:
                 yield Finding(
                     decl_pf.rel, lineno, self.name,
                     f"{const} = {value!r} breaks Prometheus naming: "
                     f"tidb_tpu_ prefix, lowercase, unit suffix "
-                    f"_total/_seconds/_bytes")
+                    f"_total/_seconds/_bytes (or gauge-level "
+                    f"_current/_depth)")
         for pf in forest:
             for call in _metric_calls(pf):
                 self.sites += 1

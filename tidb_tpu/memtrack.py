@@ -203,6 +203,46 @@ class MemTracker:
             with self._mu:
                 self._firing = False
 
+    def run_spill_actions(self, target: int = 0,
+                          recurse: bool = False) -> int:
+        """Administratively drive registered spill actions until this
+        node's total() is at/below `target` bytes; -> bytes freed.
+        Unlike the quota chain (_over_quota) this NEVER cancels and
+        needs no quota armed — it is the door the admission controller
+        and the status port's /shed hook use to fire the shed chain the
+        HBM cache (and, with recurse=True, running statements' spill
+        actions: hybrid-join cold partitions, sort buffers) registered.
+        Actions fire with every tracker lock dropped, exactly like the
+        quota chain, so they may consume/release re-entrantly."""
+        with self._mu:
+            before = self.host + self.device
+        if before <= target:
+            return 0
+        actions: list = []
+        nodes = [self]
+        seen: set[int] = set()
+        while nodes:
+            node = nodes.pop()
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            with node._mu:
+                actions.extend(node._actions)
+                if recurse:
+                    nodes.extend(node.children.values())
+        for act in actions:
+            with self._mu:
+                cur = self.host + self.device
+            if cur <= target:
+                break
+            try:
+                act()
+            except Exception:  # noqa: BLE001 - one broken spiller must
+                pass           # not stop the rest of the chain
+        with self._mu:
+            after = self.host + self.device
+        return max(before - after, 0)
+
     # -- per-plan-node children (statement roots) ----------------------------
 
     def node(self, plan, name: str | None = None) -> "MemTracker":

@@ -24,7 +24,9 @@ __all__ = ["counter", "histogram", "gauge", "expose", "snapshot",
            "SUPERCHUNK_BUCKET_ROWS", "PIPELINE_STALLS",
            "QUERY_MEM", "MEM_QUOTA_EXCEEDED", "DEVICE_PEAK",
            "HBM_CACHE_HITS", "HBM_CACHE_MISSES", "HBM_CACHE_EVICTIONS",
-           "DEVICE_FALLBACKS", "JOIN_SPILL_PARTITIONS", "JOIN_HOT_ROWS"]
+           "DEVICE_FALLBACKS", "JOIN_SPILL_PARTITIONS", "JOIN_HOT_ROWS",
+           "CONNECTIONS_CURRENT", "ADMISSIONS", "ADMISSION_WAITS",
+           "ADMISSION_QUEUE_DEPTH", "SCHED_STALLS", "SCHED_BYPASSES"]
 
 _lock = threading.Lock()
 _counters: dict[tuple[str, tuple], float] = {}       # guarded-by: _lock
@@ -192,6 +194,17 @@ DEVICE_FALLBACKS = "tidb_tpu_device_fallback_total"
 # through the heavy-hitter broadcast lane
 JOIN_SPILL_PARTITIONS = "tidb_tpu_join_spill_partitions_total"
 JOIN_HOT_ROWS = "tidb_tpu_join_hot_lane_rows_total"
+# concurrent serving (tidb_tpu/sched.py + server accept loop): live
+# connection count, statement admission outcomes/wait/queue against
+# tidb_tpu_server_mem_quota, and the device scheduler's dispatch-slot
+# stalls (time statements spent waiting for their round-robin grant)
+# and bypasses (dispatches that proceeded unscheduled past the valve)
+CONNECTIONS_CURRENT = "tidb_tpu_connections_current"
+ADMISSIONS = "tidb_tpu_admission_total"
+ADMISSION_WAITS = "tidb_tpu_admission_wait_seconds"
+ADMISSION_QUEUE_DEPTH = "tidb_tpu_admission_queue_depth"
+SCHED_STALLS = "tidb_tpu_sched_stall_seconds"
+SCHED_BYPASSES = "tidb_tpu_sched_bypass_total"
 
 _HELP = {
     QUERY_DURATIONS: "Statement wall time through Session.execute.",
@@ -237,4 +250,16 @@ _HELP = {
         "Hybrid-join build partitions spilled from HBM under quota.",
     JOIN_HOT_ROWS:
         "Probe rows routed through the heavy-hitter join lane.",
+    CONNECTIONS_CURRENT: "Client connections currently open.",
+    ADMISSIONS:
+        "Statement admission decisions, by outcome "
+        "(admitted|queued|shed|rejected).",
+    ADMISSION_WAITS:
+        "Time statements spent in the admission controller.",
+    ADMISSION_QUEUE_DEPTH:
+        "Statements currently waiting for admission.",
+    SCHED_STALLS:
+        "Time statements spent waiting for a device dispatch slot.",
+    SCHED_BYPASSES:
+        "Dispatches that proceeded unscheduled past the bypass valve.",
 }

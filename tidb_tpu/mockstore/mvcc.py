@@ -27,7 +27,24 @@ from tidb_tpu.kv import (IsolationLevel, KeyLockedError, KVError, LockInfo,
                          Mutation, MutationOp, TxnAbortedError,
                          WriteConflictError)
 
-__all__ = ["MVCCStore", "WriteType", "physical_ms"]
+__all__ = ["MVCCStore", "WriteType", "physical_ms",
+           "EPHEMERAL_PREFIXES"]
+
+# Ephemeral cluster-bookkeeping namespaces: DDL owner leases
+# (owner.py DDL_OWNER_KEY) and schema-sync heartbeats (session Domain
+# SCHEMA_SYNC_PREFIX). A live server's background workers commit these
+# every half-lease (~1/s); they carry no table data and no schema
+# semantics, so they must NOT bump data_version — one heartbeat would
+# otherwise invalidate every columnar chunk-cache and HBM-cache entry,
+# keeping both caches permanently cold exactly when the server is
+# serving (the concurrent-serving workload that motivated them).
+# max_commit_ts and the lock set still advance/track for these keys, so
+# the MVCC fill contract is untouched.
+EPHEMERAL_PREFIXES = (b"m_owner_", b"m_schema_sync_")
+
+
+def _ephemeral_only(keys) -> bool:
+    return all(k.startswith(EPHEMERAL_PREFIXES) for k in keys)
 
 
 class WriteType(Enum):
@@ -213,7 +230,8 @@ class MVCCStore:
                  start_ts: int, ttl_ms: int = 3000) -> None:
         """All-or-nothing lock acquisition. Ref: mvcc_leveldb.go Prewrite."""
         with self._mu:
-            self.data_version += 1
+            if not _ephemeral_only([m.key for m in mutations]):
+                self.data_version += 1
             for m in mutations:
                 e = self._entry(m.key)
                 if e.lock is not None:
@@ -237,7 +255,8 @@ class MVCCStore:
     def commit(self, keys: list[bytes], start_ts: int, commit_ts: int) -> None:
         """Ref: mvcc_leveldb.go Commit — idempotent for already-committed."""
         with self._mu:
-            self.data_version += 1
+            if not _ephemeral_only(keys):
+                self.data_version += 1
             for k in keys:
                 e = self._entries.get(k)
                 if e is None or e.lock is None or e.lock.start_ts != start_ts:
@@ -275,7 +294,8 @@ class MVCCStore:
     def rollback(self, keys: list[bytes], start_ts: int) -> None:
         """Ref: mvcc_leveldb.go Rollback; errors if already committed."""
         with self._mu:
-            self.data_version += 1
+            if not _ephemeral_only(keys):
+                self.data_version += 1
             for k in keys:
                 e = self._entry(k)
                 wt = self._find_txn_write(e, start_ts)
@@ -294,7 +314,8 @@ class MVCCStore:
         rolling back. Raises KeyLockedError if the lock is still alive.
         Ref: mvcc_leveldb.go Cleanup + lock_resolver.go getTxnStatus."""
         with self._mu:
-            self.data_version += 1
+            if not _ephemeral_only([key]):
+                self.data_version += 1
             e = self._entry(key)
             if e.lock is not None and e.lock.start_ts == start_ts:
                 if current_ts and physical_ms(current_ts) < \

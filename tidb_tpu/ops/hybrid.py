@@ -47,7 +47,7 @@ import threading
 
 import numpy as np
 
-from tidb_tpu import config, memtrack, metrics, runtime_stats
+from tidb_tpu import config, memtrack, metrics, runtime_stats, sched
 from tidb_tpu.ops import runtime
 from tidb_tpu.ops.hashagg import (CapacityError, CollisionError,
                                   DeviceRejectError, GroupResult,
@@ -592,7 +592,8 @@ def _one_partition_agg(sub, filter_expr, group_exprs, aggs, plan,
     while True:
         try:
             k = kernel_for(filter_expr, group_exprs, aggs, capacity=cap)
-            with memtrack.device_scope(plan, k.dispatch_nbytes(sub)):
+            with sched.device_slot(), \
+                    memtrack.device_scope(plan, k.dispatch_nbytes(sub)):
                 return runtime_stats.device_call(plan, k, sub)
         except CapacityError as e:
             nxt = escalated_capacity(getattr(e, "needed", 0))
@@ -663,7 +664,8 @@ def agg_retry(chunk, filter_expr, group_exprs, aggs, plan,
             try:
                 k = kernel_for(filter_expr, group_exprs, aggs,
                                capacity=cap)
-                with memtrack.device_scope(plan, k.dispatch_nbytes(chunk)):
+                with sched.device_slot(), memtrack.device_scope(
+                        plan, k.dispatch_nbytes(chunk)):
                     return runtime_stats.device_call(plan, k, chunk)
             except (CapacityError, CollisionError) as e2:
                 reason = "collision" if isinstance(e2, CollisionError) \

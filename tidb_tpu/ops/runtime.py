@@ -144,16 +144,28 @@ def pipeline_map(items, dispatch, finalize, depth: int,
 
     With `tracker`/`cost` set, each in-flight slot holds cost(item) host
     bytes from dispatch until its finalize returns — the depth-N window
-    is exactly the memory the pipeline pins beyond one batch."""
+    is exactly the memory the pipeline pins beyond one batch.
+
+    `depth` is this STATEMENT's window; the server-wide window belongs
+    to the device scheduler (tidb_tpu/sched.py): every dispatch takes a
+    global slot first, granted round-robin across concurrent
+    statements. Under contention the pipeline drains its own oldest
+    in-flight token before asking again — shrinking its local window to
+    its fair share — and past the scheduler's bypass valve the dispatch
+    proceeds unscheduled, so the global window can throttle but never
+    hang a statement."""
+    from tidb_tpu import sched
+    scheduler = sched.device_scheduler()
     depth = max(int(depth), 1)
     pending: deque = deque()
     track = tracker is not None and cost is not None
 
     def pop_finalize():
-        prev, tok, held = pending.popleft()
+        prev, tok, held, slot = pending.popleft()
         try:
             return finalize(prev, tok)
         finally:
+            scheduler.release(slot)
             if held:
                 tracker.release(host=held)
 
@@ -161,16 +173,28 @@ def pipeline_map(items, dispatch, finalize, depth: int,
         for it in items:
             while len(pending) >= depth:
                 yield pop_finalize()
+            slot = scheduler.acquire()
+            while slot is None and pending:
+                yield pop_finalize()
+                slot = scheduler.acquire()
+            if slot is None:
+                slot = scheduler.acquire_or_bypass()
             held = cost(it) if track else 0
             if held:
                 tracker.consume(host=held)
             try:
                 tok = dispatch(it)
             except BaseException:
+                scheduler.release(slot)
                 if held:
                     tracker.release(host=held)
                 raise
-            pending.append((it, tok, held))
+            if tok is None:
+                # host-path item: nothing went to the device — hand the
+                # slot back now instead of across its (host) finalize
+                scheduler.release(slot)
+                slot = None
+            pending.append((it, tok, held, slot))
         while pending:
             yield pop_finalize()
     finally:
@@ -182,13 +206,14 @@ def pipeline_map(items, dispatch, finalize, depth: int,
         # each abandoned token is finalized (result discarded); a slot
         # whose finalize fails still releases its host bytes
         while pending:
-            prev, tok, held = pending.popleft()
+            prev, tok, held, slot = pending.popleft()
             try:
                 finalize(prev, tok)
             except Exception:
                 pass    # the slot is dead either way; ledger cleanup
                 #         continues with the remaining slots
             finally:
+                scheduler.release(slot)
                 if held:
                     tracker.release(host=held)
 

@@ -201,6 +201,16 @@ def digest_record(sql: str, dur_ns: int, phases: dict | None = None,
     return dg, norm
 
 
+def digest_max_mem(sql: str) -> int:
+    """The digest's historical peak tracked bytes (0 when unseen): the
+    admission controller's footprint projection — a statement shaped
+    like one that peaked at N bytes is assumed to need N again."""
+    dg, _norm = sql_digest(sql)
+    with _lock:
+        rec = _summary.get(dg)
+        return rec.get("max_mem_bytes", 0) if rec is not None else 0
+
+
 def _hot_ops(rec: dict, top: int = 3) -> str:
     """Per-digest operator hot spots, worst first."""
     items = sorted(rec["ops"].items(), key=lambda kv: -kv[1]["time_ns"])

@@ -1,0 +1,449 @@
+"""Server-level device scheduler and statement admission control.
+
+ROADMAP item 1: everything below the session layer was built one
+statement at a time — each connection thread drove its own
+`ops/runtime.pipeline_map` and dispatched kernels with zero
+coordination, so concurrent statements interleaved arbitrarily (or
+serialized on implicit XLA locks) and one long analytic scan could
+monopolize the device while point lookups starved. This module owns the
+two server-wide decisions:
+
+**Scheduling** (`DeviceScheduler`): the pipeline-depth in-flight window
+becomes a GLOBAL resource. Every device dispatch — pipelined superchunks
+and one-shot sync kernels alike — takes a slot before it enqueues work,
+and slots are granted round-robin across statements, so the depth-N
+window interleaves tokens from every running statement instead of
+belonging to whichever thread spun first. Two gates bound the grant:
+
+  * `tidb_tpu_sched_inflight` concurrent dispatch slots (0 = scheduler
+    off, the pre-scheduler free-for-all);
+  * `tidb_tpu_sched_inflight_bytes` against the memtrack SERVER root's
+    DEVICE ledger — the ledger every dispatch site already bills its
+    padded upload + scratch to at dispatch and credits back at finalize,
+    so it IS the in-flight HBM figure (plus deliberate residency: HBM
+    cache blocks, pinned join builds). 0 = no bytes gate.
+
+The scheduler is a throttle, not a correctness gate: a waiter that
+times out proceeds WITHOUT a slot (counted in
+`tidb_tpu_sched_bypass_total`) so no lost wakeup, crashed holder, or
+cap misconfiguration can ever hang a statement. `pipeline_map` reacts
+to contention by draining its own oldest in-flight token first —
+shrinking the statement's local window to its fair share of the global
+one. The one blocking resource is the slot itself, released in finally
+blocks and never held across another lock acquisition, so the wait can
+participate in no deadlock cycle.
+
+**Admission** (`AdmissionController`): arms the SERVER memtrack root
+with `tidb_tpu_server_mem_quota` (host+device ledgers combined). At
+statement admission the projected footprint (the statement digest's
+historical peak from perfschema, floor-bounded) is checked against the
+quota; on projected overflow the controller first DRIVES the registered
+shed chain — the hook `store/device_cache.py` registered at import and
+nothing fired until now (HBM cache blocks, hybrid-join cold partitions
+registered on running statements' roots) — then queues the statement
+for a bounded `tidb_tpu_admission_timeout_ms` wait, and only then
+rejects with the retryable `ER_SERVER_BUSY_ADMISSION` (9008) instead of
+letting the statement run into a mid-query OOM cancel. One statement
+always makes progress: when nothing else is admitted, the head of the
+queue is admitted regardless of projection, so a pinched quota degrades
+to serialized execution, never to a stuck server.
+
+Lock discipline: each class owns ONE Condition (`_cv`) guarding its own
+counters. The admission controller fires shed actions and reads the
+SERVER ledgers with `_cv` dropped; the scheduler's bytes gate reads the
+ledger integer lock-free (a stale read is one dispatch of slack, and
+every release re-evaluates). See docs/CONCURRENCY.md.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from tidb_tpu import config, memtrack, metrics
+
+__all__ = ["DeviceScheduler", "AdmissionController",
+           "AdmissionRejectedError", "device_scheduler", "admission",
+           "device_slot", "shed_server", "stats", "reset_for_tests"]
+
+
+class AdmissionRejectedError(Exception):
+    """Statement refused at admission: the server is over
+    `tidb_tpu_server_mem_quota`, shedding freed too little, and the
+    bounded queue wait expired. RETRYABLE — surfaced to clients as
+    ER_SERVER_BUSY_ADMISSION (9008) with a retry-later message; the
+    session and its transaction state are untouched."""
+
+
+# scheduler wait granularity: contended acquires re-check (and
+# pipeline_map gets a chance to drain its own window) on this period
+_SLICE_S = 0.02
+# bypass valve: a dispatch that cannot get a slot for this long stops
+# waiting and proceeds unscheduled (counted, never hung)
+_BYPASS_S = 2.0
+# admission projection floor for digests never seen before: small enough
+# to admit cold workloads, large enough that a flood of unknowns still
+# queues once the ledger fills
+_MIN_PROJECTION = 1 << 20
+
+
+class _Slot:
+    """One granted (or bypassed) dispatch slot."""
+
+    __slots__ = ("stream", "granted", "_event")
+
+    def __init__(self, stream):
+        self.stream = stream
+        self.granted = False          # guarded-by the scheduler's _cv
+        self._event = threading.Event()
+
+
+class DeviceScheduler:
+    """Round-robin dispatch-slot allocator over one device.
+
+    Streams are statements (keyed by their memtrack statement root, so
+    every operator and pool worker of one statement shares one fairness
+    bucket; library use without a tracker falls back to the thread id).
+    Grants hand off: a release picks the next stream in rotation with a
+    waiting head and wakes exactly that waiter, so a statement that
+    just ran yields to every other waiting statement before it runs
+    again."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._granted = 0                  # guarded-by: _cv
+        self._waiters: dict = {}           # guarded-by: _cv  stream -> [slot]
+        self._rr: list = []                # guarded-by: _cv  rotation order
+        self._stall_ns = 0                 # guarded-by: _cv
+        self._bypasses = 0                 # guarded-by: _cv
+        self._grants = 0                   # guarded-by: _cv
+
+    # -- capacity ------------------------------------------------------------
+
+    @staticmethod
+    def enabled() -> bool:
+        return config.sched_inflight() > 0
+
+    def _capacity_free(self) -> bool:
+        """Both gates, called under _cv. The bytes gate reads the SERVER
+        device ledger without its lock (an int load; one dispatch of
+        staleness, re-checked on every release). Min-progress: with
+        nothing granted, one dispatch always fits — resident HBM (cache
+        blocks, pinned builds) above the cap must throttle, not
+        starve."""
+        if self._granted >= config.sched_inflight():
+            return False
+        if self._granted == 0:
+            return True
+        cap = config.sched_inflight_bytes()
+        return cap <= 0 or memtrack.SERVER.device < cap
+
+    # -- acquire / release ---------------------------------------------------
+
+    @staticmethod
+    def _stream_key():
+        root = memtrack.current()
+        return id(root) if root is not None else threading.get_ident()
+
+    def acquire(self, timeout: float | None = None) -> "_Slot | None":
+        """A dispatch slot, or None when `timeout` expires first.
+        timeout=None waits a single grant slice. Returns a no-op slot
+        immediately when the scheduler is off."""
+        if not self.enabled():
+            return _NOOP_SLOT
+        stream = self._stream_key()
+        slot = _Slot(stream)
+        t0 = time.perf_counter_ns()
+        with self._cv:
+            q = self._waiters.get(stream)
+            if q is None:
+                q = self._waiters[stream] = []
+                if stream not in self._rr:   # may linger after a timeout
+                    self._rr.append(stream)
+            q.append(slot)
+            self._grant_locked()
+        wait_s = timeout if timeout is not None else _SLICE_S
+        deadline = time.monotonic() + wait_s
+        stalled = False
+        granted = slot._event.wait(timeout=_SLICE_S)
+        while not granted:
+            stalled = True
+            expired = False
+            with self._cv:
+                if not slot.granted:
+                    self._grant_locked()   # capacity may have freed
+                if not slot.granted and \
+                        time.monotonic() >= deadline:
+                    self._forget_locked(slot)
+                    expired = True
+                granted = slot.granted
+            if expired:
+                self._note_stall(t0, stalled=True)
+                return None
+            if not granted:
+                granted = slot._event.wait(timeout=_SLICE_S)
+        self._note_stall(t0, stalled=stalled)
+        return slot
+
+    def acquire_or_bypass(self) -> "_Slot":
+        """A slot, waiting at most the bypass valve; past it, an
+        ungranted slot is returned so the dispatch proceeds unscheduled
+        rather than hang (`tidb_tpu_sched_bypass_total`)."""
+        slot = self.acquire(timeout=_BYPASS_S)
+        if slot is not None:
+            return slot
+        with self._cv:
+            self._bypasses += 1
+        metrics.counter(metrics.SCHED_BYPASSES)
+        return _Slot(self._stream_key())    # never granted: release no-ops
+
+    def release(self, slot: "_Slot | None") -> None:
+        if slot is None or slot is _NOOP_SLOT:
+            return
+        with self._cv:
+            if not slot.granted:     # bypass slots / double release:
+                return               # checked under _cv, so two racing
+            slot.granted = False     # releasers cannot both decrement
+            self._granted -= 1
+            self._grant_locked()
+
+    # -- grant machinery (all under _cv) -------------------------------------
+
+    def _grant_locked(self) -> None:
+        """Hand free capacity to waiting streams, one slot per stream
+        per rotation pass."""
+        while self._rr and self._capacity_free():
+            progressed = False
+            for _ in range(len(self._rr)):
+                stream = self._rr.pop(0)
+                q = self._waiters.get(stream)
+                if not q:
+                    self._waiters.pop(stream, None)
+                    continue
+                slot = q.pop(0)
+                if not q:
+                    self._waiters.pop(stream, None)
+                else:
+                    self._rr.append(stream)   # stays in rotation, at back
+                slot.granted = True
+                self._granted += 1
+                self._grants += 1
+                slot._event.set()
+                progressed = True
+                break
+            if not progressed:
+                break
+            if not self._capacity_free():
+                break
+
+    def _forget_locked(self, slot: "_Slot") -> None:
+        q = self._waiters.get(slot.stream)
+        if q is not None:
+            try:
+                q.remove(slot)
+            except ValueError:
+                pass
+            if not q:
+                self._waiters.pop(slot.stream, None)
+
+    def _note_stall(self, t0: int, stalled: bool) -> None:
+        waited = time.perf_counter_ns() - t0
+        with self._cv:
+            self._stall_ns += waited
+        if stalled:
+            metrics.histogram(metrics.SCHED_STALLS, waited / 1e9)
+
+    # -- introspection -------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._cv:
+            return {"inflight": self._granted,
+                    "waiting": sum(len(q) for q in self._waiters.values()),
+                    "grants": self._grants,
+                    "bypasses": self._bypasses,
+                    "stall_seconds": round(self._stall_ns / 1e9, 6)}
+
+
+_NOOP_SLOT = _Slot(None)
+
+
+class AdmissionController:
+    """Statement admission against `tidb_tpu_server_mem_quota`.
+
+    admit() outcomes (the `tidb_tpu_admission_total{outcome}` label):
+      * admitted — fit on the first check;
+      * shed     — fit only after driving the SERVER shed chain;
+      * queued   — fit after waiting for running statements to finish;
+      * rejected — still over quota at `tidb_tpu_admission_timeout_ms`:
+        AdmissionRejectedError (retryable 9008).
+
+    Projections reserve their bytes for the statement's lifetime, so N
+    racing admissions cannot all clear one remaining gap. The reserve
+    double-counts once the statement's REAL consumption lands on the
+    SERVER ledgers — deliberately conservative: admission exists to
+    keep mid-query OOM cancels at zero, and the min-progress rule (an
+    empty controller always admits its head) caps the cost at
+    serialized execution, never a stuck server."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._reserved = 0           # guarded-by: _cv  projected bytes
+        self._admitted = 0           # guarded-by: _cv  running statements
+        self._waiting = 0            # guarded-by: _cv  queue depth
+        self._counts = {"admitted": 0, "queued": 0, "shed": 0,
+                        "rejected": 0}   # guarded-by: _cv
+        self._shed_bytes = 0         # guarded-by: _cv
+
+    @staticmethod
+    def enabled() -> bool:
+        return config.server_mem_quota() > 0
+
+    def _fits_locked(self, projected: int, quota: int) -> bool:
+        if self._admitted == 0:
+            # min-progress: with nothing admitted, the next statement
+            # runs whatever the projection says (checks serialize under
+            # _cv, so exactly one waiter takes this door) — the quota
+            # throttles concurrency, it must not brick the server
+            return True
+        return memtrack.SERVER.total() + self._reserved + projected \
+            <= quota
+
+    def admit(self, projected: int = 0, label: str = "stmt"):
+        """-> ticket (pass to finish()), or None when admission is off.
+        Raises AdmissionRejectedError past the bounded queue wait."""
+        quota = config.server_mem_quota()
+        if quota <= 0:
+            return None
+        projected = max(int(projected), _MIN_PROJECTION)
+        t0 = time.perf_counter_ns()
+        deadline = time.monotonic() + \
+            max(config.admission_timeout_ms(), 1) / 1e3
+        outcome = "admitted"
+        shed_tried = False
+        with self._cv:
+            self._waiting += 1
+            # published under _cv so racing enter/leave cannot publish
+            # counts out of order (metrics._lock is a leaf lock)
+            metrics.gauge(metrics.ADMISSION_QUEUE_DEPTH, self._waiting)
+        try:
+            while True:
+                with self._cv:
+                    if self._fits_locked(projected, quota):
+                        self._reserved += projected
+                        self._admitted += 1
+                        self._counts[outcome] += 1
+                        break
+                if not shed_tried:
+                    shed_tried = True
+                    # drive the registered shed chain (HBM cache blocks,
+                    # hybrid-join cold partitions on running statements)
+                    # down to the headroom this statement needs
+                    target = max(quota - projected - self._reserved, 0)
+                    freed = shed_server(target)
+                    if freed > 0:
+                        outcome = "shed"
+                        with self._cv:
+                            self._shed_bytes += freed
+                        continue      # re-check immediately
+                if time.monotonic() >= deadline:
+                    with self._cv:
+                        self._counts["rejected"] += 1
+                    metrics.counter(metrics.ADMISSIONS,
+                                    {"outcome": "rejected"})
+                    raise AdmissionRejectedError(
+                        f"server is busy: admission of {label} would "
+                        f"exceed tidb_tpu_server_mem_quota ({quota} "
+                        f"bytes); retry later")
+                if outcome == "admitted":
+                    outcome = "queued"
+                with self._cv:
+                    # woken by finish() / shed; slices double as the
+                    # re-check for ledger drains that notify nobody
+                    self._cv.wait(timeout=min(
+                        _SLICE_S, max(deadline - time.monotonic(), 0.001)))
+        finally:
+            with self._cv:
+                self._waiting -= 1
+                metrics.gauge(metrics.ADMISSION_QUEUE_DEPTH,
+                              self._waiting)
+            metrics.histogram(metrics.ADMISSION_WAITS,
+                              (time.perf_counter_ns() - t0) / 1e9)
+        metrics.counter(metrics.ADMISSIONS, {"outcome": outcome})
+        return projected
+
+    def finish(self, ticket) -> None:
+        """Release an admit() ticket (None-safe)."""
+        if ticket is None:
+            return
+        with self._cv:
+            self._reserved -= ticket
+            self._admitted -= 1
+            self._cv.notify_all()
+
+    def snapshot(self) -> dict:
+        with self._cv:
+            out = dict(self._counts)
+            out["queue_depth"] = self._waiting
+            out["running"] = self._admitted
+            out["reserved_bytes"] = self._reserved
+            out["shed_bytes"] = self._shed_bytes
+            return out
+
+
+# -- process singletons ------------------------------------------------------
+
+_SCHEDULER = DeviceScheduler()
+_ADMISSION = AdmissionController()
+
+
+def device_scheduler() -> DeviceScheduler:
+    return _SCHEDULER
+
+
+def admission() -> AdmissionController:
+    return _ADMISSION
+
+
+def reset_for_tests() -> None:
+    """Fresh singletons (test isolation: counters and rotation state)."""
+    global _SCHEDULER, _ADMISSION
+    _SCHEDULER = DeviceScheduler()
+    _ADMISSION = AdmissionController()
+
+
+class device_slot:
+    """Hold one scheduler slot for the duration of a synchronous kernel
+    call — the one-shot dispatch sites' (copr scalar aggs, escalated
+    retries, mesh collectives) counterpart of pipeline_map's slot per
+    in-flight token. Uses the bypass valve: a sync dispatch inside
+    another statement's finalize path must throttle, never deadlock."""
+
+    __slots__ = ("_slot",)
+
+    def __init__(self):
+        self._slot = None
+
+    def __enter__(self):
+        self._slot = _SCHEDULER.acquire_or_bypass()
+        return self
+
+    def __exit__(self, *exc):
+        _SCHEDULER.release(self._slot)
+        self._slot = None
+        return False
+
+
+def shed_server(target: int = 0) -> int:
+    """Drive the SERVER root's registered shed chain (recursing into
+    session/statement subtrees, so running statements' spill actions —
+    hybrid-join cold partitions, sort spills — fire too) until the
+    SERVER total is at/below `target` bytes. -> bytes freed. The admin
+    hook behind the status port's /shed endpoint and the admission
+    controller's overflow path."""
+    return memtrack.SERVER.run_spill_actions(target, recurse=True)
+
+
+def stats() -> dict:
+    """Scheduler + admission snapshot (status port, bench serve block)."""
+    return {"scheduler": _SCHEDULER.snapshot(),
+            "admission": _ADMISSION.snapshot()}

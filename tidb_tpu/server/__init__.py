@@ -116,9 +116,15 @@ class Server:
             t.start()
 
     def _serve_conn(self, sock: socket.socket, conn_id: int) -> None:
+        from tidb_tpu import metrics
         conn = ClientConn(self, sock, conn_id)
         with self._mu:
             self._conns.add(conn)
+            # gauge published under _mu: racing connect/disconnect must
+            # not let a stale count overwrite a newer one (metrics._lock
+            # is a leaf — see docs/CONCURRENCY.md)
+            metrics.gauge(metrics.CONNECTIONS_CURRENT, len(self._conns))
+        metrics.counter(metrics.CONNECTIONS)
         try:
             conn.run()
         except (ConnectionError, OSError):
@@ -127,6 +133,8 @@ class Server:
             with self._mu:
                 self._conns.discard(conn)
                 self._conn_threads.discard(threading.current_thread())
+                metrics.gauge(metrics.CONNECTIONS_CURRENT,
+                              len(self._conns))
             conn.close()
             self._tokens.release()
 

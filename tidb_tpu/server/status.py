@@ -101,13 +101,24 @@ class _Handler(BaseHTTPRequestHandler):
                 self.wfile.write(body)
                 return
             if self.path in ("/", "/status"):
+                from tidb_tpu import sched
                 self._json({
                     "version": __version__,
                     "connections": len(getattr(self.server.ctx_server,
                                                "_conns", ())),
                     "regions": len(_all_regions(st)),
+                    "serving": sched.stats(),
                     "metrics": metrics.snapshot(),
                 })
+                return
+            if self.path == "/shed":
+                # administrative shed hook (the KILL-style escape hatch):
+                # drives the SERVER memtrack root's registered shed chain
+                # — HBM cache blocks, running statements' spill actions —
+                # the same chain admission control fires on projected
+                # overflow, here on operator demand
+                from tidb_tpu import sched
+                self._json({"freed_bytes": sched.shed_server(0)})
                 return
             if parts == ["regions"]:
                 self._json([_region_json(r) for r in _all_regions(st)])

@@ -38,6 +38,23 @@ slow_log = logging.getLogger("tidb_tpu.slow_query")
 # live sessions for SHOW PROCESSLIST (ref: util.SessionManager backing
 # SHOW PROCESSLIST in the server package)
 _SESSIONS: "weakref.WeakSet[Session]" = weakref.WeakSet()
+
+# statement kinds subject to server admission control (tidb_tpu/sched.py):
+# the ones that build executors and allocate scan/agg/join memory.
+# Everything else (SET/SHOW/KILL/BEGIN/COMMIT/DDL...) always runs, so an
+# operator can SET quotas, SHED and KILL a busy server out of trouble.
+_ADMISSION_STMTS = (ast.SelectStmt, ast.UnionStmt, ast.InsertStmt,
+                    ast.UpdateStmt, ast.DeleteStmt, ast.LoadDataStmt,
+                    ast.AnalyzeStmt, ast.ExplainStmt, ast.ExecuteStmt,
+                    ast.DoStmt)
+
+
+def _needs_admission(stmt) -> bool:
+    if isinstance(stmt, ast.ExplainStmt):
+        # plain EXPLAIN only plans (the operator's diagnostic tool on a
+        # busy server — must always answer); EXPLAIN ANALYZE executes
+        return bool(getattr(stmt, "analyze", False))
+    return isinstance(stmt, _ADMISSION_STMTS)
 _session_seq = 0
 _session_seq_lock = threading.Lock()
 
@@ -393,7 +410,8 @@ class Session:
         slow-log emit at :353). Internal bookkeeping sessions skip the
         instrumentation entirely — their catalog lookups are not client
         queries and would pollute the metrics."""
-        from tidb_tpu import config, memtrack, metrics, perfschema, trace
+        from tidb_tpu import (config, memtrack, metrics, perfschema, sched,
+                              trace)
         from tidb_tpu import runtime_stats as rs
         if self.internal:
             # internal catalog work must neither appear in perfschema nor
@@ -447,10 +465,23 @@ class Session:
             on_cancel=_on_quota_cancel,
             label=f"stmt-{self.session_id}")
         self._last_mem = mt
+        # server admission (tidb_tpu/sched.py): executable statements
+        # check their projected footprint (this digest's historical
+        # peak) against tidb_tpu_server_mem_quota BEFORE running —
+        # shed / queue / retryable-reject here replaces the mid-query
+        # OOM cancel a full server used to hand an innocent statement.
+        # Control statements (SET/SHOW/KILL/COMMIT...) always run: an
+        # operator must be able to work a busy server out of trouble.
+        adm = sched.admission()
+        admission_ticket = None
         try:
             with config.session_overlay(overlay):
                 mt.quota = config.mem_quota_query()   # session-shadowed
                 try:
+                    if _needs_admission(stmt):
+                        admission_ticket = adm.admit(
+                            projected=perfschema.digest_max_mem(sql),
+                            label=f"session-{self.session_id}")
                     with memtrack.tracking(mt):
                         res = self._run_stmt(stmt, sql_text=sql_text)
                 except memtrack.QuotaExceededError as e:
@@ -554,6 +585,7 @@ class Session:
             # session root (leaving it at zero between statements) and
             # drop the plan pins; peaks stay readable on _last_mem
             mt.detach()
+            adm.finish(admission_ticket)
             self.current_sql = None
         return res
 

@@ -15,7 +15,7 @@ import threading
 from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 
-from tidb_tpu import config, kv, memtrack, runtime_stats, tablecodec
+from tidb_tpu import config, kv, memtrack, runtime_stats, sched, tablecodec
 from tidb_tpu.kv import (CopRequest, CopResponse, KVRange, NotLeaderError,
                          RegionError, ReqType, ServerBusyError,
                          KeyLockedError)
@@ -130,8 +130,11 @@ def exec_cop_plan(plan: CopPlan, chunk, sources: int = 1,
                         nbytes = k.scratch_nbytes(chunk)
                 # device ledger: padded upload + scratch, sized from
                 # shapes at dispatch; the pool worker's tracker routes
-                # the charge to the issuing reader's node
-                with memtrack.device_scope(plan, nbytes):
+                # the charge to the issuing reader's node. The dispatch
+                # slot puts storage-side aggs under the same global
+                # round-robin window as executor-side kernels
+                with sched.device_slot(), \
+                        memtrack.device_scope(plan, nbytes):
                     res = runtime_stats.device_call(plan, k, chunk,
                                                     dev_cols)
                 if config.superchunk_rows():

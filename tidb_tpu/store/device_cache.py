@@ -30,10 +30,12 @@ next access). Residency is charged to a dedicated memtrack node under
 the SERVER root (device ledger), so information_schema.memory_usage and
 the server gauges see the cache like any other consumer, and `shed()`
 is registered on SERVER's spill-action chain so one call reclaims every
-live cache. NOTE: SERVER carries no quota today, so nothing fires that
-chain automatically yet — the LRU budget is the only self-acting bound;
-the registration is the hook the admission controller (ROADMAP item 1)
-and administrative tooling drive directly.
+live cache. That chain is ARMED (ROADMAP item 1 delivered): the
+admission controller (tidb_tpu/sched.py) drives it when a statement's
+projected footprint would push the server past
+`tidb_tpu_server_mem_quota` — resident cache blocks are the first thing
+shed to make room — and the status port's /shed endpoint fires the same
+chain on operator demand.
 """
 
 from __future__ import annotations
