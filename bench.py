@@ -435,6 +435,203 @@ def _skew_join_bench(session, storage, sf: float, iters: int,
     return out
 
 
+def _htap_bench(progress) -> dict:
+    """HTAP under write pressure (ISSUE 11 / ROADMAP item 5): a
+    TPC-C-style new-order/payment write mix runs concurrently with a
+    warm analytic loop over the same table, swept across write rates.
+    Before the MVCC delta store (store/delta.py) ANY committed write
+    re-colded both cache tiers, so analytic throughput fell to
+    cold-scan speed at the first nonzero rate; now cached blocks serve
+    as base ⋈ delta. Reports, per write rate: analytic rows/sec, p99
+    write latency, write-to-visible freshness lag, and the delta/HBM
+    counters — the acceptance bar is warm analytic rows/sec at a
+    nonzero rate within 2x of the rate-0 number.
+
+    Env knobs: BENCH_HTAP_ROWS (60000), BENCH_HTAP_SECS (5: seconds
+    per rate window), BENCH_HTAP_RATES ("0,20,100" writes/sec)."""
+    import numpy as _np
+    from tidb_tpu import metrics
+    from tidb_tpu.session import Session, SQLError
+    from tidb_tpu.store.storage import new_mock_storage
+    from tidb_tpu.table import Table, bulkload
+
+    n_rows = int(os.environ.get("BENCH_HTAP_ROWS", "60000"))
+    window = float(os.environ.get("BENCH_HTAP_SECS", "5"))
+    rates = [int(x) for x in os.environ.get(
+        "BENCH_HTAP_RATES", "0,20,100").split(",")]
+
+    storage = new_mock_storage()
+    session = Session(storage)
+    session.execute("CREATE DATABASE htap")
+    session.execute("USE htap")
+    session.execute("CREATE TABLE stock (s_id BIGINT PRIMARY KEY, "
+                    "s_seg BIGINT, s_qty BIGINT, s_ytd DOUBLE, "
+                    "s_cnt BIGINT)")
+    session.execute("CREATE TABLE orders (o_id BIGINT PRIMARY KEY, "
+                    "o_item BIGINT, o_amt DOUBLE)")
+    rng = _np.random.default_rng(20260804)
+    progress(f"htap: loading {n_rows} stock rows")
+    bulkload.bulk_load(storage, Table(
+        session.domain.info_schema().table("htap", "stock"), storage), {
+        "s_id": _np.arange(n_rows, dtype=_np.int64),
+        "s_seg": _np.arange(n_rows, dtype=_np.int64) % 11,
+        "s_qty": rng.integers(10, 100, n_rows),
+        "s_ytd": rng.uniform(0, 1000, n_rows).round(2),
+        "s_cnt": _np.zeros(n_rows, dtype=_np.int64)})
+    analytic = ("SELECT s_seg, COUNT(*), SUM(s_qty), SUM(s_ytd), "
+                "MAX(s_cnt) FROM stock GROUP BY s_seg ORDER BY s_seg")
+    progress("htap: warming (compile + cache fill)")
+    session.query(analytic)
+    session.query(analytic)
+
+    def counters() -> dict:
+        snap = metrics.snapshot()
+
+        def total(prefix):
+            return int(sum(v for k, v in snap.items()
+                           if k.startswith(prefix)))
+        return {"served_with_delta": total(metrics.CACHE_DELTA_SERVES),
+                "delta_merges": total(metrics.DELTA_MERGES),
+                "hbm_hits": total(metrics.HBM_CACHE_HITS),
+                "hbm_misses": total(metrics.HBM_CACHE_MISSES)}
+
+    out: dict = {"rows": n_rows, "window_secs": window,
+                 "rates": {}}
+    seq_commit: dict = {}            # write seq -> commit wall time
+    baseline_rps = None
+    try:
+        for rate in rates:
+            stop = threading.Event()
+            write_lat: list = []
+            write_errs: list = []
+            written = [0]
+            seq0 = max(seq_commit, default=0)
+
+            def writer(rate=rate, seq0=seq0):
+                ws = Session(storage, db="htap")
+                period = 1.0 / rate
+                nxt = time.perf_counter()
+                seq = seq0
+                while not stop.is_set():
+                    seq += 1
+                    k = int((seq * 7919) % n_rows)
+                    t0 = time.perf_counter()
+                    try:
+                        if seq % 2:     # new-order: touch stock + log
+                            ws.execute(
+                                f"UPDATE stock SET s_qty = s_qty - 1, "
+                                f"s_cnt = {seq} WHERE s_id = {k}")
+                            ws.execute(
+                                f"INSERT INTO orders VALUES "
+                                f"({seq}, {k}, 9.99)")
+                        else:           # payment: money moves
+                            ws.execute(
+                                f"UPDATE stock SET s_ytd = s_ytd + 1.5,"
+                                f" s_cnt = {seq} WHERE s_id = {k}")
+                        seq_commit[seq] = time.perf_counter()
+                        written[0] += 1
+                    except SQLError as exc:
+                        write_errs.append(str(exc))
+                    write_lat.append(time.perf_counter() - t0)
+                    nxt += period
+                    delay = nxt - time.perf_counter()
+                    if delay > 0:
+                        time.sleep(delay)
+                    else:
+                        nxt = time.perf_counter()   # fell behind
+                ws.close()
+
+            c0 = counters()
+            wt = None
+            if rate > 0:
+                wt = threading.Thread(target=writer, name="htap-writer")
+                wt.start()
+            progress(f"htap: rate {rate}/s window {window}s")
+            queries = 0
+            lag_samples: list = []
+            seen = seq0
+            errs: list = []
+            t_start2 = time.perf_counter()
+            while time.perf_counter() - t_start2 < window:
+                rows = session.query(analytic).rows
+                t_read = time.perf_counter()
+                queries += 1
+                if sum(r[1] for r in rows) != n_rows:
+                    errs.append(f"COUNT mismatch: {rows}")
+                    break
+                top = max(r[4] for r in rows)
+                if top > seen:
+                    seen = top
+                    t_commit = seq_commit.get(top)
+                    if t_commit is not None:
+                        lag_samples.append(t_read - t_commit)
+            secs = time.perf_counter() - t_start2
+            stop.set()
+            if wt is not None:
+                wt.join()
+            c1 = counters()
+            rps = queries * n_rows / secs
+            if rate == 0 and baseline_rps is None:
+                baseline_rps = rps
+            out["rates"][str(rate)] = {
+                "target_writes_per_sec": rate,
+                "achieved_writes_per_sec": round(written[0] / secs, 1),
+                "write_p99_ms": round(
+                    _percentile(write_lat, 99) * 1e3, 2)
+                if write_lat else None,
+                "analytic_queries": queries,
+                "analytic_rows_per_sec": round(rps, 1),
+                "vs_read_only": round(rps / baseline_rps, 3)
+                if baseline_rps else None,
+                "freshness_ms_avg": round(
+                    1e3 * sum(lag_samples) / len(lag_samples), 1)
+                if lag_samples else None,
+                "freshness_ms_max": round(1e3 * max(lag_samples), 1)
+                if lag_samples else None,
+                "errors": (errs + write_errs)[:3],
+                "delta": {k: c1[k] - c0[k] for k in c0},
+            }
+            progress(f"htap: rate {rate}: {rps:,.0f} analytic rows/s, "
+                     f"{written[0]} writes, "
+                     f"delta serves {c1['served_with_delta'] - c0['served_with_delta']}")
+        out["read_only_rows_per_sec"] = round(baseline_rps or 0.0, 1)
+        nz = [v for k, v in out["rates"].items() if int(k) > 0]
+        if nz and baseline_rps:
+            out["min_vs_read_only"] = min(
+                v["vs_read_only"] for v in nz)
+        out["delta_rows_staged_end"] = \
+            storage.delta_store.rows_current()
+    finally:
+        session.close()
+        storage.close()
+    return out
+
+
+def htap_main() -> None:
+    """`python bench.py htap`: ONLY the HTAP write-pressure sweep — the
+    CI entry point (scripts/htap_bench.sh) with its own one-line
+    JSON."""
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        _scope_cpu_compile_cache()
+    t_start = time.perf_counter()
+
+    def progress(msg: str) -> None:
+        print(f"[htap +{time.perf_counter() - t_start:7.1f}s] {msg}",
+              file=sys.stderr, flush=True)
+
+    htap = _htap_bench(progress)
+    rates = htap.get("rates", {})
+    top = max((int(k) for k in rates), default=0)
+    print(json.dumps({
+        "metric": "htap_analytic_rows_per_sec_under_writes",
+        "value": rates.get(str(top), {}).get(
+            "analytic_rows_per_sec", 0.0),
+        "unit": "rows/s",
+        "vs_baseline": htap.get("min_vs_read_only", 0.0),
+        "detail": htap,
+    }))
+
+
 def _scope_cpu_compile_cache() -> bool:
     """Re-point the persistent compile cache at the per-host-feature-set
     CPU subdirectory (compile_cache.scoped_cpu_dir): CPU runs must not
@@ -997,6 +1194,17 @@ def main() -> None:
         finally:
             mesh_config.enable_mesh()
 
+    if os.environ.get("BENCH_HTAP", "1") != "0":
+        progress("htap: write-pressure sweep")
+        mesh_config.disable_mesh()
+        try:
+            detail["htap"] = _htap_bench(progress)
+        except Exception as e:  # noqa: BLE001 - advisory block: the
+            # headline TPC-H numbers must survive an htap-bench failure
+            detail["htap_error"] = str(e)
+        finally:
+            mesh_config.enable_mesh()
+
     if os.environ.get("BENCH_KERNEL_MICRO", "1") != "0":
         try:
             detail["kernel_only_q1_rows_per_sec"] = round(_kernel_micro(), 1)
@@ -1040,5 +1248,7 @@ def main() -> None:
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "serve":
         serve_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "htap":
+        htap_main()
     else:
         main()

@@ -202,6 +202,68 @@ class TestInvariants:
         s0.execute("ADMIN CHECK TABLE t")
         s0.close()
 
+    def test_delta_store_writes_vs_analytic_scans(self, storage):
+        """The delta store under contention: writers mutate rows
+        through sessions while readers run cached analytic scans, all
+        under the lock sanitizer (the MVCCStore._mu -> DeltaStore._mu
+        capture edge and the in-place HBM patch run here). Every read
+        must be a consistent snapshot: COUNT(*) is always the loaded
+        row count (updates never add or lose rows) and SUM(v) only
+        grows (writers only increment)."""
+        import numpy as np
+        from tidb_tpu.session import Session
+        from tidb_tpu.table import Table, bulkload
+        s0 = Session(storage)
+        s0.execute("CREATE DATABASE dr; USE dr")
+        s0.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, v BIGINT)")
+        n = 3000
+        bulkload.bulk_load(storage, Table(
+            s0.domain.info_schema().table("dr", "t"), storage), {
+            "id": np.arange(n), "v": np.zeros(n, dtype=np.int64)})
+        s0.query("SELECT SUM(v) FROM t")     # warm the caches
+        bad: list = []
+
+        def writer(i):
+            s = Session(storage)
+            s.execute("USE dr")
+            from tidb_tpu.session import SQLError
+            for k in range(25):
+                try:
+                    s.execute(f"UPDATE t SET v = v + 1 WHERE id = "
+                              f"{(i * 97 + k * 13) % n}")
+                except SQLError:
+                    pass            # write-write conflict: retried IRL
+            s.close()
+
+        def reader(i):
+            s = Session(storage)
+            s.execute("USE dr")
+            prev = -1
+            for _ in range(12):
+                cnt, sv = s.query(
+                    "SELECT COUNT(*), SUM(v) FROM t").rows[0]
+                if cnt != n or sv < prev:
+                    bad.append((cnt, sv, prev))
+                prev = sv
+            s.close()
+
+        with stress():
+            stop = threading.Event()
+            rts = [threading.Thread(target=reader, args=(i,))
+                   for i in range(3)]
+            for t in rts:
+                t.start()
+            assert _run_threads(2, writer) == []
+            stop.set()
+            for t in rts:
+                t.join()
+        assert bad == [], f"inconsistent snapshots: {bad[:3]}"
+        # final state visible through the delta-served cache
+        final = s0.query("SELECT SUM(v) FROM t").rows[0][0]
+        storage.delta_store.merge(trigger="rows")
+        assert s0.query("SELECT SUM(v) FROM t").rows[0][0] == final
+        s0.close()
+
     def test_sanitizer_saw_the_workloads(self, lock_sanitizer):
         """Vacuity guard for the dynamic half: the store workloads
         above really went through tracked locks (registered sites are

@@ -111,8 +111,12 @@ class Chunk:
     """A batch of rows in columnar layout. Ref: util/chunk/chunk.go NewChunk."""
 
     # _dev_cache: memoized device-resident columns (ops/runtime.py
-    # device_put_chunk) — chunks are treated as immutable once built
-    __slots__ = ("columns", "_dev_cache", "_cop_filter_memo")
+    # device_put_chunk) — chunks are treated as immutable once built.
+    # _scan_handles/_delta_memo ride cached base chunks only
+    # (store/delta.py): the row handles of a cached record scan, and
+    # the memoized base-plus-delta merges computed from them.
+    __slots__ = ("columns", "_dev_cache", "_cop_filter_memo",
+                 "_scan_handles", "_delta_memo")
 
     def __getstate__(self):
         # device memos and filter memos are process-local accelerators;

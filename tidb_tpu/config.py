@@ -31,6 +31,8 @@ __all__ = ["get_var", "set_var", "all_vars", "coerce", "session_overlay",
            "device_cache_bytes", "fused_scan_enabled",
            "server_mem_quota", "admission_timeout_ms",
            "sched_inflight", "sched_inflight_bytes",
+           "delta_store_enabled", "delta_merge_rows",
+           "delta_merge_ratio_pct",
            "UnknownVariableError"]
 
 
@@ -177,6 +179,18 @@ _DEFS: dict[str, tuple[str, int]] = {
     # (0 = no bytes gate). Size it to HBM minus the device-cache budget;
     # one dispatch is always allowed through when none are in flight.
     "tidb_tpu_sched_inflight_bytes": (_INT, 0),
+    # MVCC delta store (store/delta.py): committed row mutations are
+    # journaled per table and cached columnar blocks serve as
+    # base + delta instead of being wholesale-invalidated — the HTAP
+    # write path. 0 = legacy behavior: every committed write bumps
+    # data_version and re-colds both the chunk cache and the HBM cache.
+    "tidb_tpu_delta_store": (_BOOL, 1),
+    # staged delta rows per table that trigger a background merge
+    # (fold deltas into new base blocks + truncate the journal)
+    "tidb_tpu_delta_merge_rows": (_INT, 8192),
+    # merge when staged delta rows exceed this percent of the table's
+    # observed cached base rows (0 = ratio trigger off)
+    "tidb_tpu_delta_merge_ratio_pct": (_INT, 25),
 }
 
 _lock = threading.Lock()
@@ -385,3 +399,15 @@ def sched_inflight_bytes() -> int:
 
 def fused_scan_enabled() -> bool:
     return bool(_read("tidb_tpu_fused_scan"))
+
+
+def delta_store_enabled() -> bool:
+    return bool(_read("tidb_tpu_delta_store"))
+
+
+def delta_merge_rows() -> int:
+    return max(1, _read("tidb_tpu_delta_merge_rows"))
+
+
+def delta_merge_ratio_pct() -> int:
+    return max(0, _read("tidb_tpu_delta_merge_ratio_pct"))

@@ -97,6 +97,17 @@ class PairedResourceRule(Rule):
         "    for p in parts:\n"
         "        toks.append(kernel.dispatch(p))\n"
         "    return toks\n"
+        "class StagedStore:\n"
+        "    # the delta store's stage->merge->release shape, UNtagged:\n"
+        "    # bytes staged in one method and released in another are a\n"
+        "    # cross-function ownership transfer the rule must flag\n"
+        "    # unless the consume site carries the exempt tag\n"
+        "    def stage(self, plan, part):\n"
+        "        memtrack.consume(plan, host=32)\n"
+        "        self.parts.append(part)\n"
+        "    def merge(self, plan):\n"
+        "        self.parts.clear()\n"
+        "        memtrack.release(plan, host=32)\n"
     )
 
     def check(self, forest):

@@ -319,7 +319,11 @@ def server_node(label: str) -> MemTracker:
     information_schema.memory_usage reports, without belonging to any
     session or statement. The HBM region-block cache charges its
     resident bytes here (store/device_cache.py) — budget enforcement is
-    the cache's LRU, visibility is this ledger."""
+    the cache's LRU, visibility is this ledger. The MVCC delta store
+    bills its staged commit journal to a sibling `delta-store` node
+    (store/delta.py), with a registered spill action that forces an
+    early merge — so /shed and admission-driven shedding reclaim
+    staged delta bytes like any other server-scope residency."""
     t = MemTracker(label, parent=SERVER)
     with SERVER._mu:
         SERVER.children[id(t)] = t

@@ -26,7 +26,8 @@ __all__ = ["counter", "histogram", "gauge", "expose", "snapshot",
            "HBM_CACHE_HITS", "HBM_CACHE_MISSES", "HBM_CACHE_EVICTIONS",
            "DEVICE_FALLBACKS", "JOIN_SPILL_PARTITIONS", "JOIN_HOT_ROWS",
            "CONNECTIONS_CURRENT", "ADMISSIONS", "ADMISSION_WAITS",
-           "ADMISSION_QUEUE_DEPTH", "SCHED_STALLS", "SCHED_BYPASSES"]
+           "ADMISSION_QUEUE_DEPTH", "SCHED_STALLS", "SCHED_BYPASSES",
+           "DELTA_ROWS", "DELTA_MERGES", "CACHE_DELTA_SERVES"]
 
 _lock = threading.Lock()
 _counters: dict[tuple[str, tuple], float] = {}       # guarded-by: _lock
@@ -205,6 +206,13 @@ ADMISSION_WAITS = "tidb_tpu_admission_wait_seconds"
 ADMISSION_QUEUE_DEPTH = "tidb_tpu_admission_queue_depth"
 SCHED_STALLS = "tidb_tpu_sched_stall_seconds"
 SCHED_BYPASSES = "tidb_tpu_sched_bypass_total"
+# MVCC delta store (store/delta.py): staged committed-row deltas kept
+# per table so cached columnar blocks serve base + delta under OLTP
+# writes instead of re-colding; merges fold deltas back into base
+# blocks (labeled by what triggered them)
+DELTA_ROWS = "tidb_tpu_delta_rows_current"
+DELTA_MERGES = "tidb_tpu_delta_merge_total"
+CACHE_DELTA_SERVES = "tidb_tpu_cache_served_with_delta_total"
 
 _HELP = {
     QUERY_DURATIONS: "Statement wall time through Session.execute.",
@@ -262,4 +270,11 @@ _HELP = {
         "Time statements spent waiting for a device dispatch slot.",
     SCHED_BYPASSES:
         "Dispatches that proceeded unscheduled past the bypass valve.",
+    DELTA_ROWS:
+        "Committed row deltas currently staged in the delta store.",
+    DELTA_MERGES:
+        "Delta-store merges into new base blocks, by trigger "
+        "(rows|ratio|shed|close).",
+    CACHE_DELTA_SERVES:
+        "Cache reads served as base + delta instead of re-scanning.",
 }

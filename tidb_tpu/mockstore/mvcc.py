@@ -31,20 +31,45 @@ __all__ = ["MVCCStore", "WriteType", "physical_ms",
            "EPHEMERAL_PREFIXES"]
 
 # Ephemeral cluster-bookkeeping namespaces: DDL owner leases
-# (owner.py DDL_OWNER_KEY) and schema-sync heartbeats (session Domain
-# SCHEMA_SYNC_PREFIX). A live server's background workers commit these
-# every half-lease (~1/s); they carry no table data and no schema
-# semantics, so they must NOT bump data_version — one heartbeat would
-# otherwise invalidate every columnar chunk-cache and HBM-cache entry,
-# keeping both caches permanently cold exactly when the server is
-# serving (the concurrent-serving workload that motivated them).
-# max_commit_ts and the lock set still advance/track for these keys, so
-# the MVCC fill contract is untouched.
-EPHEMERAL_PREFIXES = (b"m_owner_", b"m_schema_sync_")
+# (owner.py DDL_OWNER_KEY), schema-sync heartbeats (session Domain
+# SCHEMA_SYNC_PREFIX), and auto-increment batch allocations (meta
+# AutoID counters — id handout changes no committed row and no schema,
+# but every 4000th INSERT refills a batch through a meta txn). A live
+# server's background workers commit the leases every half-lease
+# (~1/s); none of these carry table data or schema semantics, so they
+# must NOT bump data_version — one heartbeat (or id-batch refill)
+# would otherwise invalidate every columnar chunk-cache and HBM-cache
+# entry, keeping both caches permanently cold exactly when the server
+# is serving. max_commit_ts and the lock set still advance/track for
+# these keys, so the MVCC fill contract is untouched.
+EPHEMERAL_PREFIXES = (b"m_owner_", b"m_schema_sync_", b"msAutoID:")
 
 
-def _ephemeral_only(keys) -> bool:
-    return all(k.startswith(EPHEMERAL_PREFIXES) for k in keys)
+# key classes for the delta-capture path (store/delta.py): committed
+# table RECORD mutations are journaled per table instead of bumping
+# data_version; index-key commits advance a per-table index watermark
+# (cached index scans re-validate against it); anything else — meta /
+# DDL / structure keys — keeps the wholesale version bump, because a
+# schema change really does invalidate every decoded chunk.
+_KIND_RECORD, _KIND_INDEX, _KIND_EPHEMERAL, _KIND_OTHER = range(4)
+
+
+def _classify_key(key: bytes) -> tuple[int, int, int]:
+    """-> (kind, table_id, handle). table_id/handle are 0 unless
+    meaningful for the kind."""
+    if key.startswith(EPHEMERAL_PREFIXES):
+        return _KIND_EPHEMERAL, 0, 0
+    from tidb_tpu import tablecodec
+    try:
+        tid, handle = tablecodec.decode_record_key(key)
+        return _KIND_RECORD, tid, handle
+    except ValueError:
+        pass
+    try:
+        tid, _iid, _suffix = tablecodec.decode_index_key(key)
+        return _KIND_INDEX, tid, 0
+    except ValueError:
+        return _KIND_OTHER, 0, 0
 
 
 class WriteType(Enum):
@@ -99,17 +124,51 @@ class MVCCStore:
         # be served to a reader that must instead see KeyLockedError —
         # the chunk-cache filler refuses to cache while this is nonempty
         self._locked_keys: set = set()
+        # delta capture (store/delta.py DeltaStore.ingest): installed by
+        # the storage facade. While active, committed RECORD mutations
+        # are journaled (under _mu, atomically with the commit becoming
+        # readable) instead of bumping data_version — the caches then
+        # serve base + delta instead of re-colding on every write.
+        self._delta_sink = None
 
     # engines snapshot to disk for the out-of-process storage node's
     # restart path (store/remote.py); locks are recreated on load
     def __getstate__(self):
         d = self.__dict__.copy()
         d.pop("_mu", None)
+        d.pop("_delta_sink", None)   # process-local, re-wired on load
         return d
 
     def __setstate__(self, d):
         self.__dict__.update(d)
         self._mu = threading.RLock()
+        self._delta_sink = None
+
+    def set_delta_sink(self, sink) -> None:
+        """Install the commit-journal sink (DeltaStore). `sink.ingest`
+        is invoked under the engine lock so a commit and its journal
+        entry become visible atomically; `sink.enabled()` is consulted
+        per operation, so flipping tidb_tpu_delta_store reverts to the
+        legacy whole-version invalidation instantly."""
+        with self._mu:
+            self._delta_sink = sink
+
+    def _capture_active(self) -> bool:
+        sink = self._delta_sink
+        return sink is not None and sink.enabled()
+
+    def _needs_bump(self, keys, capture: bool) -> bool:
+        """Would a state change over `keys` invalidate cached chunks?
+        Without delta capture: any non-ephemeral key (legacy). With it:
+        only keys outside the record/index namespaces."""
+        for k in keys:
+            kind = _classify_key(k)[0]
+            if kind == _KIND_EPHEMERAL:
+                continue
+            if capture and kind in (_KIND_RECORD, _KIND_INDEX):
+                continue
+            return True
+        return False
 
     # -- internal ------------------------------------------------------------
 
@@ -163,6 +222,36 @@ class MVCCStore:
                 if v is not None:
                     out[k] = v
         return out
+
+    def locked_in_range(self, start: bytes, end: bytes, ts: int) -> bool:
+        """Is any pending Percolator lock on a key in [start, end) one a
+        reader at `ts` must observe (SI: lock.start_ts <= ts; LOCK-op
+        locks never block reads)? The cached read path consults this
+        instead of relying on prewrite bumping data_version: while such
+        a lock is pending, the range falls to the real scan path (which
+        raises KeyLockedError for resolution exactly as an uncached
+        read would) and the cached entries SURVIVE the write instead of
+        being wholesale-invalidated.
+
+        Lock-free fast path: with no pending locks at all (the common
+        serving state) this is one attribute read — no engine-lock
+        serialization on the hot analytic path. A lock being ADDED
+        concurrently is safe to miss: its prewrite has not returned, so
+        its txn's eventual commit_ts is strictly newer than any read_ts
+        issued before this check — invisible to this reader either
+        way."""
+        if not self._locked_keys:
+            return False
+        with self._mu:
+            for k in self._locked_keys:
+                if k < start or (end and k >= end):
+                    continue
+                e = self._entries.get(k)
+                if e is not None and e.lock is not None and \
+                        e.lock.start_ts <= ts and \
+                        e.lock.op != MutationOp.LOCK:
+                    return True
+        return False
 
     def scan(self, start: bytes, end: bytes, limit: int, ts: int,
              isolation: IsolationLevel = IsolationLevel.SI,
@@ -230,7 +319,12 @@ class MVCCStore:
                  start_ts: int, ttl_ms: int = 3000) -> None:
         """All-or-nothing lock acquisition. Ref: mvcc_leveldb.go Prewrite."""
         with self._mu:
-            if not _ephemeral_only([m.key for m in mutations]):
+            # with delta capture, record/index prewrites leave
+            # data_version alone: pending-lock correctness moves to the
+            # serve-time locked_in_range veto, so a write in flight no
+            # longer re-colds every cache
+            if self._needs_bump([m.key for m in mutations],
+                                self._capture_active()):
                 self.data_version += 1
             for m in mutations:
                 e = self._entry(m.key)
@@ -253,20 +347,59 @@ class MVCCStore:
                 self._locked_keys.add(m.key)
 
     def commit(self, keys: list[bytes], start_ts: int, commit_ts: int) -> None:
-        """Ref: mvcc_leveldb.go Commit — idempotent for already-committed."""
+        """Ref: mvcc_leveldb.go Commit — idempotent for already-committed.
+
+        With delta capture active, committed RECORD mutations are
+        journaled to the sink (under the engine lock, so the journal
+        entry and the readable commit appear atomically — a reader can
+        never observe the commit but miss its delta) and index-key
+        commits advance the per-table index watermark; data_version
+        bumps only for keys outside both namespaces."""
         with self._mu:
-            if not _ephemeral_only(keys):
+            capture = self._capture_active()
+            if self._needs_bump(keys, capture):
                 self.data_version += 1
-            for k in keys:
-                e = self._entries.get(k)
-                if e is None or e.lock is None or e.lock.start_ts != start_ts:
-                    # lock gone: committed already, or rolled back?
-                    st = self._find_txn_write(e, start_ts) if e else None
-                    if st == WriteType.ROLLBACK or st is None:
-                        raise TxnAbortedError(
-                            f"commit of {start_ts} on {k!r}: lock missing")
-                    continue  # already committed: idempotent
-                self._commit_locked(k, e, start_ts, commit_ts)
+            records: list = []
+            idx_notes: list = []
+            try:
+                for k in keys:
+                    e = self._entries.get(k)
+                    if e is None or e.lock is None or \
+                            e.lock.start_ts != start_ts:
+                        # lock gone: committed already, or rolled back?
+                        st = self._find_txn_write(e, start_ts) if e else None
+                        if st == WriteType.ROLLBACK or st is None:
+                            raise TxnAbortedError(
+                                f"commit of {start_ts} on {k!r}: lock missing")
+                        continue  # already committed: idempotent
+                    if capture:
+                        self._journal(k, e.lock, commit_ts, records,
+                                      idx_notes)
+                    self._commit_locked(k, e, start_ts, commit_ts)
+            finally:
+                # even a TxnAbortedError mid-loop leaves the earlier
+                # keys COMMITTED — their deltas must land regardless
+                if (records or idx_notes) and \
+                        not self._delta_sink.ingest(records, idx_notes):
+                    # sink refused (disabled mid-flight): fall back to
+                    # the legacy wholesale invalidation
+                    self.data_version += 1
+
+    @staticmethod
+    def _journal(key: bytes, lock: _Lock, commit_ts: int,
+                 records: list, idx_notes: list) -> None:
+        """Classify one about-to-commit key into the delta journal:
+        record PUT/DELETE -> (table, handle, key, value|None, ts);
+        index PUT/DELETE -> per-table index watermark note."""
+        if lock.op == MutationOp.LOCK:
+            return
+        kind, tid, handle = _classify_key(key)
+        if kind == _KIND_RECORD:
+            records.append((tid, handle, key,
+                            lock.value if lock.op == MutationOp.PUT
+                            else None, commit_ts))
+        elif kind == _KIND_INDEX:
+            idx_notes.append((tid, commit_ts))
 
     def _commit_locked(self, key: bytes, e: _Entry, start_ts: int,
                        commit_ts: int) -> None:
@@ -294,7 +427,10 @@ class MVCCStore:
     def rollback(self, keys: list[bytes], start_ts: int) -> None:
         """Ref: mvcc_leveldb.go Rollback; errors if already committed."""
         with self._mu:
-            if not _ephemeral_only(keys):
+            # a rollback changes no committed-visible data: with delta
+            # capture, record/index rollbacks leave data_version alone
+            # (the lock-set veto already lifted when the lock clears)
+            if self._needs_bump(keys, self._capture_active()):
                 self.data_version += 1
             for k in keys:
                 e = self._entry(k)
@@ -314,7 +450,7 @@ class MVCCStore:
         rolling back. Raises KeyLockedError if the lock is still alive.
         Ref: mvcc_leveldb.go Cleanup + lock_resolver.go getTxnStatus."""
         with self._mu:
-            if not _ephemeral_only([key]):
+            if self._needs_bump([key], self._capture_active()):
                 self.data_version += 1
             e = self._entry(key)
             if e.lock is not None and e.lock.start_ts == start_ts:
@@ -350,17 +486,30 @@ class MVCCStore:
         """Commit (commit_ts > 0) or roll back every lock of txn start_ts in
         range. Ref: mvcc_leveldb.go ResolveLock."""
         with self._mu:
-            self.data_version += 1
+            capture = self._capture_active()
+            hit = []
             for k in list(self._entries.irange(start, end or None,
                                                inclusive=(True, False))):
                 e = self._entries[k]
                 if e.lock is not None and e.lock.start_ts == start_ts:
-                    if commit_ts > 0:
-                        self._commit_locked(k, e, start_ts, commit_ts)
-                    else:
-                        e.lock = None
-                        self._locked_keys.discard(k)
-                        e.writes.insert(0, (start_ts, start_ts, WriteType.ROLLBACK))
+                    hit.append((k, e))
+            if self._needs_bump([k for k, _e in hit], capture):
+                self.data_version += 1
+            records: list = []
+            idx_notes: list = []
+            for k, e in hit:
+                if commit_ts > 0:
+                    if capture:
+                        self._journal(k, e.lock, commit_ts, records,
+                                      idx_notes)
+                    self._commit_locked(k, e, start_ts, commit_ts)
+                else:
+                    e.lock = None
+                    self._locked_keys.discard(k)
+                    e.writes.insert(0, (start_ts, start_ts, WriteType.ROLLBACK))
+            if (records or idx_notes) and \
+                    not self._delta_sink.ingest(records, idx_notes):
+                self.data_version += 1
 
     # -- maintenance ---------------------------------------------------------
 

@@ -439,7 +439,11 @@ def shed_server(target: int = 0) -> int:
     hybrid-join cold partitions, sort spills — fire too) until the
     SERVER total is at/below `target` bytes. -> bytes freed. The admin
     hook behind the status port's /shed endpoint and the admission
-    controller's overflow path."""
+    controller's overflow path. Registered server-scope actions today:
+    the HBM region-block caches (store/device_cache.py shed) and the
+    MVCC delta stores (store/delta.py — a forced early merge folds and
+    truncates the staged journal, whose re-fills of lagging HBM blocks
+    take device_slot like any other dispatch)."""
     return memtrack.SERVER.run_spill_actions(target, recurse=True)
 
 

@@ -97,12 +97,14 @@ def exec_cop_plan(plan: CopPlan, chunk, sources: int = 1,
     `sources` is how many storage scan batches were coalesced into
     `chunk` (superchunk accounting for EXPLAIN ANALYZE / metrics).
 
-    `dev_ref` — a (device_cache, key, data_version, read_ts, fill_ts)
-    tuple from _cached_range_chunk — marks `chunk` as an HBM-cacheable
-    region block: a device agg dispatch then runs FUSED from the cached
-    device-resident columns (scan->filter->partial-agg in one compiled
-    call, zero host->device bytes on a hit). fill_ts None = consult
-    only, never fill (the MVCC fill conditions did not hold)."""
+    `dev_ref` — a (device_cache, key, data_version, read_ts, fill_ts,
+    pend_fn) tuple from _cached_range_chunk — marks `chunk` as an
+    HBM-cacheable region block: a device agg dispatch then runs FUSED
+    from the cached device-resident columns (scan->filter->partial-agg
+    in one compiled call, zero host->device bytes on a hit). fill_ts
+    None = consult only, never fill (the MVCC fill conditions did not
+    hold); pend_fn lets the HBM cache fold staged row deltas into the
+    resident block in place (store/delta.py)."""
     if plan.host_filter is not None:
         # the host filter rewrites the chunk, so the raw cached block no
         # longer matches it — the fused path only covers device-complete
@@ -119,9 +121,10 @@ def exec_cop_plan(plan: CopPlan, chunk, sources: int = 1,
                 dev_cols = None
                 nbytes = k.dispatch_nbytes(chunk)
                 if dev_ref is not None and config.fused_scan_enabled():
-                    dcache, dkey, dv, read_ts, fill_ts = dev_ref
+                    dcache, dkey, dv, read_ts, fill_ts, pend_fn = \
+                        dev_ref
                     block = dcache.get_or_fill(dkey, dv, read_ts, chunk,
-                                               fill_ts)
+                                               fill_ts, pend_fn=pend_fn)
                     if block is not None and \
                             block.nrows == chunk.num_rows:
                         # the input columns stay on the cache's own
@@ -173,32 +176,108 @@ def exec_cop_plan(plan: CopPlan, chunk, sources: int = 1,
     return CopResponse(chunk=chunk)
 
 
+def _delta_store_of(storage):
+    """The storage's delta store when capture is active, else None."""
+    dstore = getattr(storage, "delta_store", None)
+    if dstore is None or not dstore.enabled():
+        return None
+    return dstore
+
+
+def _dev_pending_fn(dstore, plan: CopPlan, s: bytes, e: bytes):
+    """Closure the HBM cache calls to fetch (and plan-layout decode)
+    the staged delta window for ITS entry's fill_ts — the device block
+    may lag or lead the host entry, so the window is per-consumer."""
+    from tidb_tpu.store import delta as deltamod
+
+    def pend_fn(lo_ts: int, hi_ts: int):
+        pend = dstore.pending(plan.table.id, s, e, lo_ts, hi_ts)
+        if pend is None or pend is deltamod.STALE:
+            return pend
+        if pend.decoded is None:
+            pend.decoded = decode_cop_batch(plan, pend.upsert_rows)
+        return pend
+
+    return pend_fn
+
+
 def _cached_range_chunk(storage, region: Region, plan: CopPlan, s: bytes,
                         e: bytes, req: CopRequest):
-    """Whole-range decoded chunk with host-cache lookup/fill.
+    """Whole-range decoded chunk with host-cache lookup/fill, served as
+    base ⋈ delta under OLTP writes (store/delta.py).
     -> (chunk, dev_ref): dev_ref parameterizes the HBM device cache
     (store/device_cache.py) for a fused dispatch over the same block —
-    (cache, key, data_version, read_ts, fill_ts), with fill_ts None when
-    the MVCC fill conditions did not hold (consult-only)."""
+    (cache, key, data_version, read_ts, fill_ts, pend_fn), with fill_ts
+    None when the MVCC fill conditions did not hold (consult-only) and
+    fill_ts the DELTA WATERMARK when the served chunk is a base⋈delta
+    merge."""
+    from tidb_tpu.store import delta as deltamod
     from tidb_tpu.store.chunk_cache import ChunkCache
     cache = storage.chunk_cache
     key = ChunkCache.key(region, plan, s, e)
-    # sample the version BEFORE scanning: a write landing mid-scan
-    # bumps past it, so the filled entry can never serve stale data.
-    # A pending lock anywhere also vetoes caching: lock visibility is
-    # per-reader-ts, so a fill that legally skipped a newer txn's lock
-    # would hide the KeyLockedError a newer reader must hit.
+    # resolve the delta store BEFORE sampling the version: the consult
+    # has a side effect — flipping tidb_tpu_delta_store off flushes the
+    # staged journal and bumps data_version once (DeltaStore.enabled),
+    # and sampling first would serve the pre-flush base at the old
+    # version
+    dstore = _delta_store_of(storage)
+    # sample the version BEFORE scanning: a structural write landing
+    # mid-scan bumps past it, so the filled entry can never serve stale
+    # data (row commits landing mid-scan get commit_ts > start_ts and
+    # ride the delta journal instead). A pending lock anywhere also
+    # vetoes caching: lock visibility is per-reader-ts, so a fill that
+    # legally skipped a newer txn's lock would hide the KeyLockedError
+    # a newer reader must hit.
     dv = storage.engine.data_version
+    # serve-time lock veto — the delta path's replacement for the
+    # prewrite version bump: a pending lock this reader must observe
+    # forces the real scan below (which raises KeyLockedError for
+    # resolution exactly as an uncached read would) while every cache
+    # entry SURVIVES the write
+    locked = dstore is not None and \
+        storage.engine.locked_in_range(s, e, req.start_ts)
     cacheable = not storage.engine._locked_keys
     fill_ts = None
-    hit = cache.lookup(key, dv, req.start_ts)
+    hit = None if locked else cache.lookup(key, dv, req.start_ts)
+    if hit is not None and dstore is not None:
+        if plan.index is not None:
+            # index layouts can't be patched from row deltas: an
+            # index-key commit since the fill drops the entry (both
+            # tiers) so it re-fills at a newer snapshot — other tables
+            # and record scans stay untouched
+            if dstore.index_stale(plan.table.id, hit[0], req.start_ts):
+                cache.drop(key, if_chunk=hit[1])
+                dc0 = getattr(storage, "device_cache", None)
+                if dc0 is not None:
+                    from tidb_tpu.store.device_cache import DeviceCache
+                    dc0.drop(DeviceCache.key(region, plan, s, e))
+                hit = None
+        else:
+            pend = dstore.pending(plan.table.id, s, e, hit[0],
+                                  req.start_ts)
+            if pend is deltamod.STALE:
+                # journal truncated under the entry: re-scan
+                cache.drop(key, if_chunk=hit[1])
+                hit = None
+            elif pend is not None:
+                merged = dstore.patch_chunk(cache, key, plan, hit[1],
+                                            pend)
+                if merged is None:
+                    cache.drop(key, if_chunk=hit[1])
+                    hit = None
+                else:
+                    from tidb_tpu import metrics
+                    metrics.counter(metrics.CACHE_DELTA_SERVES)
+                    hit = (pend.watermark, merged)
     if hit is not None:
-        # the host entry's OWN fill snapshot bounds the device entry:
-        # dv-equality means no state change since that fill, so both
-        # caches share one validity window
+        # the host entry's OWN fill snapshot (or delta watermark)
+        # bounds the device entry: both caches share one validity
+        # window per the freshness contract
         fill_ts, chunk = hit
     else:
         parts = []
+        hparts = []
+        want_handles = dstore is not None and plan.index is None
         cur = s
         while True:
             batch = storage.engine.scan(cur, e, COP_SCAN_BATCH,
@@ -207,12 +286,20 @@ def _cached_range_chunk(storage, region: Region, plan: CopPlan, s: bytes,
             if not batch:
                 break
             parts.append(decode_cop_batch(plan, batch))
+            if want_handles:
+                hparts.append(deltamod.record_handles(
+                    [k for k, _v in batch]))
             if len(batch) < COP_SCAN_BATCH:
                 break
             cur = batch[-1][0] + b"\x00"
         from tidb_tpu.chunk import Chunk
         chunk = Chunk.concat_all(parts) if parts else \
             decode_cop_batch(plan, [])
+        if want_handles:
+            import numpy as _np
+            chunk._scan_handles = _np.concatenate(hparts) if hparts \
+                else _np.zeros(0, dtype=_np.int64)
+            dstore.note_base_rows(plan.table.id, chunk.num_rows)
         # cache only fills whose snapshot covers every commit: an older
         # snapshot's view is valid for ITS ts but must not become the
         # cached truth for newer readers (see MVCCStore.max_commit_ts)
@@ -222,10 +309,13 @@ def _cached_range_chunk(storage, region: Region, plan: CopPlan, s: bytes,
     dev_ref = None
     dcache = getattr(storage, "device_cache", None)
     if dcache is not None and plan.is_agg and plan.host_filter is None \
-            and dcache.enabled():
+            and not locked and dcache.enabled():
         from tidb_tpu.store.device_cache import DeviceCache
+        pend_fn = None
+        if dstore is not None and plan.index is None:
+            pend_fn = _dev_pending_fn(dstore, plan, s, e)
         dev_ref = (dcache, DeviceCache.key(region, plan, s, e), dv,
-                   req.start_ts, fill_ts)
+                   req.start_ts, fill_ts, pend_fn)
     return chunk, dev_ref
 
 

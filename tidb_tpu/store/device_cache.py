@@ -15,14 +15,24 @@ engine's data version, so a repeated TPC-H scan reads straight from HBM
 and the fused scan->filter->partial-agg dispatch (store/copr.py) starts
 from device-resident columns.
 
-MVCC correctness is inherited from the chunk cache's contract: an entry
-records the engine data_version and the fill snapshot ts, and is served
-only when the version is unchanged AND read_ts >= fill_ts. Version
-bumps on every engine state change (writes, DDL-driven meta mutations,
-lock ops), so a stale block can never serve after a write — the
-invalidation tests pin this. Fills are allowed exactly where chunk-cache
-fills are (no pending locks, snapshot covers every commit), and the
-caller passes the HOST entry's fill_ts so both caches agree on validity.
+MVCC correctness is inherited from the chunk cache's contract — the
+(fill_version, fill_ts, delta_watermark) freshness triple
+(store/chunk_cache.py module docstring): an entry records the engine's
+STRUCTURAL data_version and the fill snapshot ts, and is served only
+when the version is unchanged AND read_ts >= fill_ts. Structural
+changes (DDL/meta mutations, GC, delete-range, bulk import) still bump
+the version and invalidate on the next lookup; committed ROW mutations
+are journaled by the delta store instead (store/delta.py) and FOLDED
+INTO the resident block in place — get() applies the journal window
+(fill_ts, read_ts] as device-side scatters (updates overwrite,
+deletes swap-remove, inserts fill the padding tail, dict columns
+extend incrementally) and advances fill_ts to the delta watermark, so
+an OLTP write stream no longer re-colds the HBM plane. Pending locks
+are handled by the engine's serve-time locked_in_range veto before the
+cache is consulted. Fills are allowed exactly where chunk-cache fills
+are (no pending locks, snapshot covers every commit), and the caller
+passes the HOST entry's effective fill_ts (the delta watermark when
+serving base⋈delta) so both caches agree on validity.
 
 Budget: `tidb_tpu_device_cache_bytes` bounds resident bytes with LRU
 eviction (re-read on every lookup AND fill, so SET takes effect on the
@@ -43,6 +53,8 @@ from __future__ import annotations
 import threading
 import weakref
 from collections import OrderedDict
+
+import numpy as np
 
 from tidb_tpu import config, memtrack, metrics
 
@@ -71,8 +83,13 @@ def tracker() -> memtrack.MemTracker:
 
 def _shed_all() -> None:
     """The registered memtrack OOM action: drop every resident block in
-    every live cache, returning the hbm-cache ledger to zero."""
-    for cache in list(_caches):
+    every live cache, returning the hbm-cache ledger to zero. The
+    WeakSet is snapshotted under its lock — iterating it bare races a
+    concurrent cache construction's add() and raises RuntimeError,
+    which the spill chain would silently swallow."""
+    with _tracker_lock:
+        caches = list(_caches)
+    for cache in caches:
         cache.shed()
 
 
@@ -104,16 +121,31 @@ def upload_block(chunk, size: int | None = None):
 class DeviceBlock:
     """One resident region block: the padded device columns exactly as a
     kernel dispatch consumes them, plus the host dictionaries needed to
-    decode varlen lanes."""
+    decode varlen lanes.
 
-    __slots__ = ("cols", "dicts", "nrows", "size", "nbytes")
+    Blocks are IMMUTABLE once handed out: the delta patch path
+    (apply-pending, store/delta.py) builds a NEW block from scatter
+    updates over this one's device arrays and swaps the cache entry, so
+    a reader that captured this block mid-dispatch keeps a consistent
+    (cols, nrows) pair. `handles`/`pos_handles`/`hmap` are the
+    host-side row-position index that makes the device patch possible;
+    they hand off to the successor block (only the entry's current
+    block is ever patched)."""
 
-    def __init__(self, cols, dicts, nrows: int, size: int, nbytes: int):
+    __slots__ = ("cols", "dicts", "nrows", "size", "nbytes",
+                 "handles", "pos_handles", "hmap", "dictmaps")
+
+    def __init__(self, cols, dicts, nrows: int, size: int, nbytes: int,
+                 handles=None):
         self.cols = cols
         self.dicts = dicts
         self.nrows = nrows
         self.size = size
         self.nbytes = nbytes
+        self.handles = handles      # np int64 [nrows] or None
+        self.pos_handles = None     # np int64 [size], built lazily
+        self.hmap = None            # handle -> row position
+        self.dictmaps = None        # col idx -> value -> code
 
 
 class DeviceCache:
@@ -169,44 +201,98 @@ class DeviceCache:
 
     # -- lookup / fill -------------------------------------------------------
 
-    def get(self, key, data_version: int, read_ts: int) -> DeviceBlock | None:
+    def get(self, key, data_version: int, read_ts: int,
+            pend_fn=None) -> DeviceBlock | None:
         """Resident block for `key`, valid for a reader at `read_ts`
         under the current engine `data_version`; a version/ts mismatch
         drops the stale entry (counted as an eviction). The budget is
         re-read here too, so a shrunk `tidb_tpu_device_cache_bytes`
         takes effect on the next lookup — not only at the next fill —
         evicting LRU entries (the served block last) until residency
-        fits."""
+        fits.
+
+        `pend_fn(lo_ts, hi_ts)` — supplied by the coprocessor serve
+        path (store/copr.py) — returns the table's staged delta for
+        this block's range in (lo_ts, hi_ts] (store/delta.py): a
+        PendingDelta with its plan-layout decode, delta.STALE when the
+        journal was truncated under the entry, or None. A pending delta
+        is folded INTO the resident block in place — value/validity
+        scatters plus tail appends into the padding, dict columns
+        extended incrementally — and the entry's fill_ts advances to
+        the watermark, so the HBM plane stays hot across OLTP writes
+        instead of re-uploading the whole block."""
         budget = config.device_cache_bytes()
-        with self._mu:
-            ent = self._entries.get(key)
-            if ent is None:
-                metrics.counter(metrics.HBM_CACHE_MISSES)
-                return None
-            fill_version, fill_ts, block = ent
-            if fill_version != data_version:
-                # stale for EVERY reader: drop now, not at LRU pressure
-                self._drop_locked(key)
-                metrics.counter(metrics.HBM_CACHE_MISSES)
-                metrics.counter(metrics.HBM_CACHE_EVICTIONS)
-                stale = True
-            elif read_ts < fill_ts:
-                # too old for THIS reader only — newer snapshots still
-                # serve from it, so the entry stays
-                metrics.counter(metrics.HBM_CACHE_MISSES)
-                return None
-            else:
-                self._entries.move_to_end(key)
-                while self._resident[0] > budget and self._entries:
-                    self._drop_locked(next(iter(self._entries)))
+        for _ in range(4):      # bounded retry under patch races
+            with self._mu:
+                ent = self._entries.get(key)
+                if ent is None:
+                    metrics.counter(metrics.HBM_CACHE_MISSES)
+                    return None
+                fill_version, fill_ts, block = ent
+                if fill_version != data_version:
+                    # stale for EVERY reader: drop now, not at LRU
+                    # pressure
+                    self._drop_locked(key)
+                    metrics.counter(metrics.HBM_CACHE_MISSES)
                     metrics.counter(metrics.HBM_CACHE_EVICTIONS)
-                # the served block stays alive through the returned
-                # reference even if it was the one over budget; it is
-                # simply no longer resident for the next reader
+                    stale = True
+                elif read_ts < fill_ts:
+                    # too old for THIS reader only — newer snapshots
+                    # still serve from it, so the entry stays
+                    metrics.counter(metrics.HBM_CACHE_MISSES)
+                    return None
+                else:
+                    stale = False
+            if stale:
+                self._settle()
+                return None
+            # the delta query + plan-layout decode run with _mu
+            # dropped; the patch below re-validates the entry under it
+            pend = pend_fn(fill_ts, read_ts) if pend_fn is not None \
+                else None
+            if pend is None:
+                with self._mu:
+                    if self._entries.get(key) is not None:
+                        self._entries.move_to_end(key)
+                    while self._resident[0] > budget and self._entries:
+                        self._drop_locked(next(iter(self._entries)))
+                        metrics.counter(metrics.HBM_CACHE_EVICTIONS)
+                    # the served block stays alive through the returned
+                    # reference even if it was the one over budget; it
+                    # is simply no longer resident for the next reader
+                    metrics.counter(metrics.HBM_CACHE_HITS)
+                self._settle()
+                return block
+            if getattr(pend, "watermark", None) is None:
+                # delta.STALE sentinel: journal truncated under the
+                # entry — it cannot be patched forward any more
+                self.drop(key, if_block=block)
+                metrics.counter(metrics.HBM_CACHE_MISSES)
+                self._settle()
+                return None
+            with self._mu:
+                ent2 = self._entries.get(key)
+                if ent2 is None or ent2[2] is not block or \
+                        ent2[1] != fill_ts:
+                    continue    # raced with another patch: re-evaluate
+                patched = self._patch_locked(key, ent2, pend)
+            if patched is not None:
                 metrics.counter(metrics.HBM_CACHE_HITS)
-                stale = False
-        self._settle()
-        return None if stale else block
+                self._settle()
+                # THIS thread's patched block — at exactly pend's
+                # watermark — never the entry's current one: a newer
+                # reader may already have patched past this reader's
+                # read_ts, and handing that block back here would leak
+                # later commits into an older snapshot
+                return patched
+            # unpatchable (no handles, dtype drift, tail overflow):
+            # drop; the caller re-fills from the merged host chunk
+            self.drop(key, if_block=block)
+            metrics.counter(metrics.HBM_CACHE_MISSES)
+            self._settle()
+            return None
+        metrics.counter(metrics.HBM_CACHE_MISSES)
+        return None
 
     def fill(self, key, data_version: int, fill_ts: int,
              chunk) -> DeviceBlock | None:
@@ -220,7 +306,9 @@ class DeviceCache:
         if nbytes > budget:
             return None
         cols, dicts = upload_block(chunk, size)
-        block = DeviceBlock(cols, dicts, chunk.num_rows, size, nbytes)
+        block = DeviceBlock(cols, dicts, chunk.num_rows, size, nbytes,
+                            handles=getattr(chunk, "_scan_handles",
+                                            None))
         with self._mu:
             if key in self._entries:
                 self._drop_locked(key)
@@ -240,15 +328,183 @@ class DeviceCache:
         return block
 
     def get_or_fill(self, key, data_version: int, read_ts: int, chunk,
-                    fill_ts: int | None = None) -> DeviceBlock | None:
+                    fill_ts: int | None = None,
+                    pend_fn=None) -> DeviceBlock | None:
         """get(); on miss, fill() when `fill_ts` is provided (the
-        caller's signal that the MVCC fill conditions hold)."""
-        hit = self.get(key, data_version, read_ts)
+        caller's signal that the MVCC fill conditions hold). `chunk` is
+        the HOST-side truth for this reader — on the delta path the
+        base⋈delta merge — so an unpatchable block re-fills from
+        exactly the state the entry's new fill_ts describes."""
+        hit = self.get(key, data_version, read_ts, pend_fn=pend_fn)
         if hit is not None:
             return hit
         if fill_ts is None:
             return None
         return self.fill(key, data_version, fill_ts, chunk)
+
+    # -- the in-place delta patch (store/delta.py) ---------------------------
+
+    def _patch_locked(self, key, ent, pend) -> "DeviceBlock | None":
+        """Fold one PendingDelta into the entry's resident block:
+        updates overwrite rows in place, deletes swap-remove (order is
+        free — only agg plans consume resident blocks), inserts land in
+        the padding tail (or freed holes), dict columns extend
+        incrementally. Builds a NEW DeviceBlock over the scattered
+        device arrays and swaps the entry, so concurrent readers keep a
+        consistent (cols, nrows) snapshot. -> False when the block
+        cannot be patched (no handles, layout drift, tail overflow);
+        the caller then drops it and re-fills from the merged host
+        chunk. Called under _mu; the scatters are async device
+        dispatches, not syncs."""
+        fill_version, _fill_ts, block = ent
+        dchunk = pend.decoded
+        if block.handles is None or dchunk is None or \
+                dchunk.num_cols != len(block.cols):
+            return None
+        nrows, size = block.nrows, block.size
+        if block.hmap is None:
+            ph = np.full(size, -1, dtype=np.int64)
+            ph[:nrows] = block.handles[:nrows]
+            block.pos_handles = ph
+            block.hmap = {int(h): i
+                          for i, h in enumerate(block.handles[:nrows])}
+        hmap, pos_handles = block.hmap, block.pos_handles
+        upd_idx: list = []
+        upd_src: list = []
+        app_src: list = []
+        dead: list = []
+        for i, h in enumerate(pend.upsert_handles.tolist()):
+            p = hmap.get(h)
+            if p is not None:
+                upd_idx.append(p)
+                upd_src.append(i)
+            else:
+                app_src.append(i)
+        for h in pend.delete_handles.tolist():
+            p = hmap.get(h)
+            if p is not None:
+                dead.append(p)
+        new_nrows = nrows - len(dead) + len(app_src)
+        if new_nrows > size:
+            return None             # padding exhausted: re-fill
+        dead_set = set(dead)
+        free = sorted(p for p in dead if p < new_nrows)
+        if new_nrows > nrows:
+            free.extend(range(nrows, new_nrows))
+        # live rows stranded past the new row count move into leftover
+        # holes (values gathered on device, no host round trip)
+        movers = [p for p in range(new_nrows, nrows)
+                  if p not in dead_set]
+        app_dst = free[:len(app_src)]
+        holes = free[len(app_src):]
+        if len(holes) != len(movers):
+            return None             # accounting drift: bail safely
+        move_map = dict(zip(movers, holes))
+        # pad index vectors to powers of two, repeating the last entry
+        # (scatter-idempotent): the eager XLA scatters then compile for
+        # log2 shapes instead of one program per delta batch size
+        write_idx, write_rows = self._pad_pow2(
+            np.asarray([move_map.get(p, p) for p in upd_idx] + app_dst,
+                       dtype=np.int64),
+            np.asarray(upd_src + app_src, dtype=np.int64))
+        move_src, move_dst = self._pad_pow2(
+            np.asarray(movers, dtype=np.int64),
+            np.asarray(holes, dtype=np.int64))
+        new_cols = []
+        from tidb_tpu.chunk import dict_encode
+        for j, (data, valid) in enumerate(block.cols):
+            col = dchunk.columns[j]
+            if j in block.dicts:
+                codes, cvalid = self._encode_against(block, j, col)
+            else:
+                if col.data.dtype != np.dtype(data.dtype):
+                    return None     # layout drift since the fill
+                codes, cvalid = col.data, col.valid
+            wvals = codes[write_rows] if len(write_rows) else \
+                np.zeros(0, dtype=codes.dtype)
+            wvalid = cvalid[write_rows] if len(write_rows) else \
+                np.zeros(0, dtype=bool)
+            if len(move_src):
+                data = data.at[move_dst].set(data[move_src])
+                valid = valid.at[move_dst].set(valid[move_src])
+            if len(write_idx):
+                data = data.at[write_idx].set(wvals)
+                valid = valid.at[write_idx].set(wvalid)
+            new_cols.append((data, valid))
+        # host-side position index follows the same moves/writes
+        for src, dst in move_map.items():
+            h = int(pos_handles[src])
+            pos_handles[dst] = h
+            hmap[h] = dst
+        for p, i in zip(write_idx.tolist(), write_rows.tolist()):
+            h = int(pend.upsert_handles[i])
+            pos_handles[p] = h
+            hmap[h] = p
+        for h in pend.delete_handles.tolist():
+            hmap.pop(int(h), None)
+        pos_handles[new_nrows:nrows] = -1
+        nb = DeviceBlock(new_cols, block.dicts, new_nrows, size,
+                         block.nbytes, handles=None)
+        # the position index hands off: only the entry's CURRENT block
+        # is ever patched, the predecessor keeps serving readers that
+        # already hold it
+        nb.pos_handles, nb.hmap = pos_handles, hmap
+        nb.dictmaps = block.dictmaps
+        nb.handles = nb.pos_handles[:new_nrows]
+        block.hmap = block.pos_handles = None
+        self._entries[key] = (fill_version, pend.watermark, nb)
+        return nb
+
+    @staticmethod
+    def _pad_pow2(*arrs):
+        """Pad parallel index vectors to the next power of two by
+        repeating their last element — scatter-idempotent padding."""
+        n = len(arrs[0])
+        if n == 0:
+            return arrs
+        b = 1
+        while b < n:
+            b <<= 1
+        if b == n:
+            return arrs
+        return tuple(np.concatenate([a, np.repeat(a[-1:], b - n)])
+                     for a in arrs)
+
+    @staticmethod
+    def _encode_against(block: DeviceBlock, j: int, col):
+        """Dict-encode a delta column against the block's existing
+        dictionary, EXTENDING it for unseen values (new codes append;
+        old codes — and every reader holding them — stay valid).
+        Mirrors chunk.dict_encode's collation keying."""
+        values = block.dicts[j]
+        if block.dictmaps is None:
+            block.dictmaps = {}
+        dmap = block.dictmaps.get(j)
+        ci = col.ft.is_ci
+        if ci:
+            from tidb_tpu.sqltypes import collation_key
+        if dmap is None:
+            if ci:
+                dmap = {collation_key(v): c
+                        for c, v in enumerate(values)}
+            else:
+                dmap = {v: c for c, v in enumerate(values)}
+            block.dictmaps[j] = dmap
+        codes = np.empty(len(col), dtype=np.int64)
+        data, valid = col.data, col.valid
+        for i in range(len(col)):
+            if not valid[i]:
+                codes[i] = -1
+                continue
+            v = data[i]
+            k = collation_key(v) if ci else v
+            c = dmap.get(k)
+            if c is None:
+                c = len(values)
+                dmap[k] = c
+                values.append(v)
+            codes[i] = c
+        return codes, valid & (codes >= 0)
 
     # -- eviction ------------------------------------------------------------
 
@@ -262,6 +518,33 @@ class DeviceCache:
             owed, self._pending = self._pending, 0
         if owed:
             tracker().release(device=owed)
+
+    def drop(self, key, if_block: DeviceBlock | None = None) -> int:
+        """Remove one entry (delta staleness, merge refresh). With
+        `if_block`, drop only while the entry still holds that exact
+        block — a reader invalidating a lagging block must not discard
+        a successor another thread just patched/refilled in. -> bytes
+        freed."""
+        with self._mu:
+            ent = self._entries.get(key)
+            if ent is None or (if_block is not None and
+                               ent[2] is not if_block):
+                return 0
+            freed = ent[2].nbytes
+            self._drop_locked(key)
+        metrics.counter(metrics.HBM_CACHE_EVICTIONS)
+        self._settle()
+        return freed
+
+    def snapshot_table(self, table_id: int) -> list:
+        """[(key, fill_version, fill_ts)] for every resident block of
+        one table — the delta merge walks this to refresh lagging
+        blocks. Device keys are (chunk-cache key, ft codes); the chunk
+        key embeds the table id at position 2."""
+        with self._mu:
+            return [(k, ent[0], ent[1])
+                    for k, ent in self._entries.items()
+                    if k[0][2] == table_id]
 
     def shed(self) -> int:
         """Drop every resident block (the OOM action / close path).

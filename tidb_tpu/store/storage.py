@@ -38,6 +38,13 @@ class MockStorage(kv.Storage):
         # read cached blocks straight from device memory
         from tidb_tpu.store.device_cache import DeviceCache
         self.device_cache = DeviceCache()
+        # MVCC delta store (store/delta.py): committed row mutations
+        # journal here (the engine calls ingest under its lock) and
+        # both cache tiers serve base ⋈ delta instead of re-colding on
+        # every OLTP write
+        from tidb_tpu.store.delta import DeltaStore
+        self.delta_store = DeltaStore(self)
+        engine.set_delta_sink(self.delta_store)
 
     def begin(self, start_ts: int | None = None) -> KVTxn:
         return KVTxn(self, start_ts if start_ts is not None
@@ -69,8 +76,10 @@ class MockStorage(kv.Storage):
 
     def close(self) -> None:
         self.oracle.close()
-        # return the HBM cache's ledger share eagerly (GC would, later)
+        # return the HBM cache's and delta journal's ledger shares
+        # eagerly (GC would, later)
         self.device_cache.shed()
+        self.delta_store.close()
 
 
 def new_mock_storage(num_stores: int = 1) -> MockStorage:

@@ -205,6 +205,7 @@ def region_stream(storage, region, req: CopRequest, frame_bytes: int):
 
     fill_key = fill_dv = None
     fill_parts: list | None = None
+    fill_handles: list | None = None
     fill_bytes = fill_billed = 0
     resident = None
     if use_cached_path(storage, plan):
@@ -241,6 +242,13 @@ def region_stream(storage, region, req: CopRequest, frame_bytes: int):
         if resident is None and not storage.engine._locked_keys and \
                 req.start_ts >= storage.engine.max_commit_ts:
             fill_key, fill_dv, fill_parts = key, dv, []
+            from tidb_tpu.store.copr import _delta_store_of
+            if _delta_store_of(storage) is not None and \
+                    plan.index is None:
+                # capture row handles alongside: stream-filled entries
+                # then patch forward as base⋈delta (store/delta.py)
+                # exactly like materialized fills
+                fill_handles = []
 
     remaining = plan.limit if not plan.is_agg else None
     pend: list[tuple[bytes, bytes]] = []
@@ -251,10 +259,14 @@ def region_stream(storage, region, req: CopRequest, frame_bytes: int):
 
     def emit(boundary: bytes, last: bool) -> StreamFrame:
         nonlocal pend, pend_bytes, frame_start, remaining, \
-            fill_parts, fill_bytes, fill_billed
+            fill_parts, fill_handles, fill_bytes, fill_billed
         chunk = None
         if pend:
             dec = decode_cop_batch(plan, pend)
+            if fill_handles is not None and fill_parts is not None:
+                from tidb_tpu.store.delta import record_handles
+                fill_handles.append(record_handles(
+                    [k for k, _v in pend]))
             if fill_parts is not None:
                 from tidb_tpu import memtrack
                 part = memtrack.chunk_bytes(dec)
@@ -326,9 +338,14 @@ def region_stream(storage, region, req: CopRequest, frame_bytes: int):
             from tidb_tpu.chunk import Chunk
             from tidb_tpu.store.copr import decode_cop_batch as _dec
             whole = Chunk.concat_all(fill_parts) if fill_parts else None
-            storage.chunk_cache.put(
-                fill_key, fill_dv, req.start_ts,
-                whole if whole is not None else _dec(plan, []))
+            if whole is None:
+                whole = _dec(plan, [])
+            if fill_handles is not None:
+                import numpy as _np
+                whole._scan_handles = _np.concatenate(fill_handles) \
+                    if fill_handles else _np.zeros(0, dtype=_np.int64)
+            storage.chunk_cache.put(fill_key, fill_dv, req.start_ts,
+                                    whole)
     finally:
         # capture handed to the cache (or dropped, or the generator
         # abandoned/cancelled mid-stream): it is no longer statement
