@@ -283,6 +283,26 @@ def _query_bytes(data, qname: str) -> int:
     return total
 
 
+def _bytes_counters() -> dict:
+    """Encoded-execution bytes-touched counters (ops/encoded.py):
+    encoded bytes device agg/fragment dispatches actually staged or
+    read vs the decoded-equivalent footprint of the same inputs — the
+    per-query `bytes_touched` column diffs these around the warm
+    iterations so the compression win is auditable."""
+    from tidb_tpu import metrics
+    snap = metrics.snapshot()
+    return {"encoded": int(snap.get(metrics.BYTES_ENCODED, 0)),
+            "decoded_equivalent": int(
+                snap.get(metrics.BYTES_DECODED_EQUIV, 0))}
+
+
+def _bytes_touched(b0: dict, b1: dict) -> dict:
+    enc = b1["encoded"] - b0["encoded"]
+    dec = b1["decoded_equivalent"] - b0["decoded_equivalent"]
+    return {"decoded_equivalent_bytes": dec, "encoded_bytes": enc,
+            "ratio": round(enc / dec, 4) if dec else None}
+
+
 def _fallback_counters() -> dict:
     """Hybrid join/agg counters (ops/hybrid.py): device->host fallbacks
     (must stay 0 on the skewed workload), partitions spilled under
@@ -629,6 +649,114 @@ def htap_main() -> None:
         "unit": "rows/s",
         "vs_baseline": htap.get("min_vs_read_only", 0.0),
         "detail": htap,
+    }))
+
+
+def _encoded_bench(progress) -> dict:
+    """Encoded-vs-decoded warm comparison (ISSUE 12 / ROADMAP item 4):
+    Q1 (dict group keys + direct-indexed agg) and Q3 (string-filtered
+    join chain: encoded join-key lanes + fragment fusion) run warm with
+    the encoded feature pair (`tidb_tpu_encoded_exec` AND
+    `tidb_tpu_fuse_fragments`) on vs BOTH off — the baseline leg must
+    not keep fusing, or the comparison misattributes the win. The CI
+    contract (scripts/encoded_bench.sh): identical results, ZERO
+    fallbacks with reason="encoding" on the stock TPC-H schema, and a
+    populated bytes_touched block.
+
+    Env knobs: BENCH_ENCODED_SF (0.05), BENCH_ENCODED_ITERS (3)."""
+    from tidb_tpu import config, metrics
+    from tidb_tpu.benchmarks import tpch
+    from tidb_tpu.session import Session
+    from tidb_tpu.store.storage import new_mock_storage
+
+    sf = float(os.environ.get("BENCH_ENCODED_SF", "0.05"))
+    iters = int(os.environ.get("BENCH_ENCODED_ITERS", "3"))
+    data = tpch.ScaledTpch(sf=sf)
+    storage = new_mock_storage()
+    session = Session(storage)
+    session.execute("CREATE DATABASE tpch_enc")
+    session.execute("USE tpch_enc")
+    progress(f"encoded: loading sf={sf}")
+    total = tpch.load(session, storage, data, regions_per_table=2)
+
+    def enc_fallbacks() -> int:
+        snap = metrics.snapshot()
+        return int(sum(v for k, v in snap.items()
+                       if k.startswith(metrics.DEVICE_FALLBACKS) and
+                       'reason="encoding"' in k))
+
+    out: dict = {"sf": sf, "iters": iters, "rows_loaded": total,
+                 "queries": {}}
+    try:
+        for qname in ("q1", "q3"):
+            sql = tpch.QUERIES[qname]
+            in_rows = sum(data.counts[t]
+                          for t in tpch.QUERY_TABLES[qname])
+            config.set_var("tidb_tpu_encoded_exec", 1)
+            config.set_var("tidb_tpu_fuse_fragments", 1)
+            progress(f"encoded: {qname} warm (encoded)")
+            session.query(sql)          # compile + chunk-cache fill
+            session.query(sql)          # HBM tier fills on the 2nd serve
+            f0 = enc_fallbacks()
+            b0 = _bytes_counters()
+            e_secs, e_rows = _time_query(session, sql, iters)
+            b1 = _bytes_counters()
+            f1 = enc_fallbacks()
+            try:
+                config.set_var("tidb_tpu_encoded_exec", 0)
+                config.set_var("tidb_tpu_fuse_fragments", 0)
+                progress(f"encoded: {qname} warm (decoded)")
+                session.query(sql)
+                session.query(sql)
+                d_secs, d_rows = _time_query(session, sql, iters)
+            finally:
+                config.set_var("tidb_tpu_encoded_exec", 1)
+                config.set_var("tidb_tpu_fuse_fragments", 1)
+            if not _approx_rows_equal(e_rows, d_rows):
+                raise RuntimeError(
+                    f"{qname}: encoded and decoded disagree")
+            out["queries"][qname] = {
+                "input_rows": in_rows,
+                "encoded_secs": round(e_secs, 4),
+                "decoded_secs": round(d_secs, 4),
+                "encoded_rows_per_sec": round(in_rows / e_secs, 1),
+                "decoded_rows_per_sec": round(in_rows / d_secs, 1),
+                "speedup": round(d_secs / e_secs, 3),
+                "bytes_touched": _bytes_touched(b0, b1),
+                # the CI contract: stock TPC-H never falls back
+                "encoding_fallbacks": f1 - f0,
+            }
+            progress(f"encoded: {qname} encoded {e_secs:.3f}s decoded "
+                     f"{d_secs:.3f}s fallbacks {f1 - f0}")
+    finally:
+        session.close()
+        storage.close()
+    return out
+
+
+def encoded_main() -> None:
+    """`python bench.py encoded`: ONLY the encoded-vs-decoded warm
+    comparison — the CI entry point (scripts/encoded_bench.sh) with its
+    own one-line JSON."""
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        _scope_cpu_compile_cache()
+    t_start = time.perf_counter()
+
+    def progress(msg: str) -> None:
+        print(f"[encoded +{time.perf_counter() - t_start:7.1f}s] {msg}",
+              file=sys.stderr, flush=True)
+
+    enc = _encoded_bench(progress)
+    qs = enc.get("queries", {})
+    speedups = [q["speedup"] for q in qs.values() if q.get("speedup")]
+    geo = math.exp(sum(math.log(x) for x in speedups) /
+                   len(speedups)) if speedups else 0.0
+    print(json.dumps({
+        "metric": "encoded_vs_decoded_warm_speedup",
+        "value": round(geo, 3),
+        "unit": "x",
+        "vs_baseline": round(geo, 3),
+        "detail": enc,
     }))
 
 
@@ -1041,8 +1169,10 @@ def main() -> None:
         hbm_cold = _hbm_counters()
         progress(f"{qname}: device cold took {cold_secs:.1f}s; timing "
                  f"warm")
+        bytes0 = _bytes_counters()
         d_secs, d_rows = _time_query(session, sql, iters)
         hbm_warm = _hbm_counters()
+        bytes1 = _bytes_counters()
 
         # per-operator device-time attribution: one extra instrumented
         # run with tidb_tpu_runtime_stats_device on (block_until_ready
@@ -1156,6 +1286,10 @@ def main() -> None:
                 "warm": {k: hbm_warm[k] - hbm_cold[k] for k in hbm0},
             },
             "result_rows": len(d_rows),
+            # encoded vs decoded-equivalent input bytes the warm
+            # iterations' device dispatches touched (all iters summed):
+            # the auditable compression win of encoded execution
+            "bytes_touched": _bytes_touched(bytes0, bytes1),
             "op_device_time_ns": op_device,
             "op_stats": op_detail,
             "peak_mem_host_bytes": mem_host_peak,
@@ -1250,5 +1384,7 @@ if __name__ == "__main__":
         serve_main()
     elif len(sys.argv) > 1 and sys.argv[1] == "htap":
         htap_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "encoded":
+        encoded_main()
     else:
         main()

@@ -1,0 +1,166 @@
+"""Fragment fusion (ops/fragment.py, ISSUE 12): one XLA program per
+probe superchunk executes match -> gather -> group -> partial agg under
+an agg-over-inner-join. Fused == unfused byte-for-byte, pair-capacity
+overflow self-heals, group-capacity misses escalate then degrade per
+batch, ineligible shapes (outer joins, other_cond, skewed/hybrid
+builds) keep the per-operator path, and EXPLAIN ANALYZE shows
+`enc=fused:probe-agg`."""
+
+import numpy as np
+import pytest
+
+from tidb_tpu import metrics
+from tidb_tpu.expression.core import ColumnRef
+from tidb_tpu.ops import fragment as op_fragment
+from tidb_tpu.ops.hashagg import DeviceRejectError
+from tidb_tpu.session import Session
+from tidb_tpu.sqltypes import FieldType, TypeCode, new_string_field
+from tidb_tpu.store.storage import new_mock_storage
+
+FT_I = FieldType(tp=TypeCode.LONGLONG)
+FT_S = new_string_field()
+
+
+def _metric(prefix: str) -> float:
+    return sum(v for k, v in metrics.snapshot().items()
+               if k.startswith(prefix))
+
+
+@pytest.fixture(scope="module")
+def frag_sess():
+    s = Session(new_mock_storage())
+    s.execute("CREATE DATABASE frag")
+    s.execute("USE frag")
+    s.execute("CREATE TABLE fact (id BIGINT PRIMARY KEY, k BIGINT, "
+              "amt DECIMAL(12,2), q BIGINT)")
+    s.execute("CREATE TABLE dim (id BIGINT PRIMARY KEY, grp VARCHAR(8), "
+              "w BIGINT)")
+    rng = np.random.default_rng(12)
+    n, nd = 8000, 300
+    rows = []
+    for i in range(n):
+        # dangling keys past the dim table + a few NULL keys
+        k = "NULL" if i % 97 == 0 else str(int(rng.integers(0, nd + 40)))
+        rows.append(f"({i}, {k}, {rng.integers(0, 99999) / 100}, "
+                    f"{i % 19})")
+    for i in range(0, n, 500):
+        s.execute("INSERT INTO fact VALUES " + ",".join(rows[i:i + 500]))
+    s.execute("INSERT INTO dim VALUES " + ",".join(
+        f"({i}, 'g{i % 7}', {i % 13})" for i in range(nd)))
+    s.execute("SET tidb_tpu_device_min_rows = 1")
+    yield s
+    s.close()
+
+
+def _fused_vs_not(s, q):
+    s.execute("SET tidb_tpu_fuse_fragments = 1")
+    fused = s.query(q).rows
+    s.execute("SET tidb_tpu_fuse_fragments = 0")
+    try:
+        plain = s.query(q).rows
+    finally:
+        s.execute("SET tidb_tpu_fuse_fragments = 1")
+    return fused, plain
+
+
+class TestFusedEqualsUnfused:
+    def test_group_by_build_string(self, frag_sess):
+        q = ("SELECT dim.grp, COUNT(*), SUM(fact.amt), MIN(fact.q), "
+             "MAX(dim.w) FROM fact JOIN dim ON fact.k = dim.id "
+             "GROUP BY dim.grp ORDER BY dim.grp")
+        fused, plain = _fused_vs_not(frag_sess, q)
+        assert fused == plain
+
+    def test_group_by_probe_key_highcard(self, frag_sess):
+        """> capacity distinct groups: the fragment kernel escalates
+        once and stays fused (or falls back per batch) — results must
+        not change either way."""
+        q = ("SELECT fact.id, SUM(fact.amt) FROM fact "
+             "JOIN dim ON fact.k = dim.id "
+             "GROUP BY fact.id ORDER BY fact.id LIMIT 17")
+        fused, plain = _fused_vs_not(frag_sess, q)
+        assert fused == plain
+
+    def test_avg_and_mixed_side_columns(self, frag_sess):
+        q = ("SELECT dim.grp, AVG(fact.amt), SUM(dim.w), COUNT(*) "
+             "FROM fact JOIN dim ON fact.k = dim.id "
+             "GROUP BY dim.grp ORDER BY dim.grp")
+        fused, plain = _fused_vs_not(frag_sess, q)
+        assert fused == plain
+
+    def test_scalar_agg_over_join(self, frag_sess):
+        q = ("SELECT COUNT(*), SUM(fact.amt) FROM fact "
+             "JOIN dim ON fact.k = dim.id")
+        fused, plain = _fused_vs_not(frag_sess, q)
+        assert fused == plain
+
+    def test_explain_shows_fused_mode(self, frag_sess):
+        r = frag_sess.query(
+            "EXPLAIN ANALYZE SELECT dim.grp, COUNT(*) FROM fact "
+            "JOIN dim ON fact.k = dim.id GROUP BY dim.grp")
+        cell = next(row[-1] for row in r.rows if "HashAgg" in row[0])
+        assert "enc=fused:probe-agg" in cell
+
+
+class TestPairOverflow:
+    def test_many_to_many_regrow(self):
+        """All-one-key many-to-many: total pairs far exceed the initial
+        pair capacity — finalize must regrow and stay exact."""
+        s = Session(new_mock_storage())
+        s.execute("CREATE DATABASE ovf")
+        s.execute("USE ovf")
+        s.execute("CREATE TABLE p (id BIGINT PRIMARY KEY, k BIGINT, "
+                  "v BIGINT)")
+        s.execute("CREATE TABLE b (id BIGINT PRIMARY KEY, k BIGINT)")
+        rows = ",".join(f"({i}, 1, {i % 7})" for i in range(5000))
+        s.execute("INSERT INTO p VALUES " + rows)
+        s.execute("INSERT INTO b VALUES " + ",".join(
+            f"({i}, 1)" for i in range(100)))
+        s.execute("SET tidb_tpu_device_min_rows = 1")
+        try:
+            q = ("SELECT COUNT(*), SUM(p.v) FROM p JOIN b "
+                 "ON p.k = b.k")
+            fused, plain = _fused_vs_not(s, q)
+            assert fused == plain == [(500000, 1499500)]
+        finally:
+            s.close()
+
+
+class TestIneligibleShapes:
+    def test_outer_join_not_fused_still_correct(self, frag_sess):
+        q = ("SELECT dim.grp, COUNT(*) FROM fact LEFT JOIN dim "
+             "ON fact.k = dim.id GROUP BY dim.grp ORDER BY dim.grp")
+        fused, plain = _fused_vs_not(frag_sess, q)
+        assert fused == plain
+
+    def test_other_cond_not_fused_still_correct(self, frag_sess):
+        q = ("SELECT dim.grp, COUNT(*) FROM fact JOIN dim "
+             "ON fact.k = dim.id AND fact.q < dim.w "
+             "GROUP BY dim.grp ORDER BY dim.grp")
+        fused, plain = _fused_vs_not(frag_sess, q)
+        assert fused == plain
+
+    def test_first_row_agg_rejects(self):
+        from tidb_tpu.expression import AggDesc, AggFunc
+        with pytest.raises(DeviceRejectError):
+            op_fragment.ProbeAggKernel(
+                1, 2, 4, [ColumnRef(0, FT_I, "k")],
+                [AggDesc(fn=AggFunc.FIRST_ROW,
+                         arg=ColumnRef(3, FT_S, "s"))])
+
+    def test_hybrid_build_stands_aside(self, frag_sess):
+        """An over-superchunk build (> _DEVICE_MIN_BUILD rows, bigger
+        than tidb_tpu_superchunk_rows) hands the probe to the hybrid
+        join's machinery; results match the per-operator path."""
+        s = frag_sess
+        s.execute("SET tidb_tpu_superchunk_rows = 128")
+        try:
+            # self-join: BOTH sides exceed the hybrid's build floor, so
+            # whichever side the planner builds engages partitioning
+            q = ("SELECT f2.q, COUNT(*), SUM(f1.amt) FROM fact f1 "
+                 "JOIN fact f2 ON f1.k = f2.id GROUP BY f2.q "
+                 "ORDER BY f2.q")
+            fused, plain = _fused_vs_not(s, q)
+            assert fused == plain
+        finally:
+            s.execute("SET tidb_tpu_superchunk_rows = 262144")

@@ -48,14 +48,14 @@ def report():
 
 
 def test_catalog_is_complete():
-    """4 ported + 7 project-specific + 3 whole-program flow rules."""
-    assert len(RULE_NAMES) == 14, RULE_NAMES
+    """4 ported + 8 project-specific + 3 whole-program flow rules."""
+    assert len(RULE_NAMES) == 15, RULE_NAMES
     for ported in ("wire-discipline", "hot-path-sync", "metric-names",
                    "memtrack-alloc"):
         assert ported in RULE_NAMES
     for new in ("lock-discipline", "sysvar-registry",
                 "errcode-discipline", "device-sync", "dtype-discipline",
-                "bare-except", "device-cache"):
+                "bare-except", "device-cache", "decode-discipline"):
         assert new in RULE_NAMES
     for flow in ("lock-order", "guarded-by", "paired-resource"):
         assert flow in RULE_NAMES
@@ -91,7 +91,7 @@ def test_single_parse_instrumentation(report):
 
     * Forest.load parsed exactly one AST per package module;
     * the only parses beyond the load are the vacuity guard's fixture
-      forests (a known, enumerable set) — the 14 rule walks themselves
+      forests (a known, enumerable set) — the rule walks themselves
       added ZERO.
     """
     assert report.files >= 90          # it really saw the package

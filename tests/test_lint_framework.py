@@ -505,3 +505,43 @@ def test_reasonless_alias_tag_is_a_finding():
            "    return np.empty(n)\n")
     rep = lint({EXEC_REL: src}, rules=["memtrack-alloc"])
     assert BAD_RULE in rules_of(rep)
+
+
+# -- decode-discipline -------------------------------------------------------
+
+def test_decode_call_outside_registered_helper_flagged():
+    src = ("from tidb_tpu.ops.encoded import decode_codes\n"
+           "def serve(values, codes):\n"
+           "    return decode_codes(values, codes)\n")
+    rep = lint({OPS_REL: src}, rules=["decode-discipline"])
+    assert rules_of(rep) == ["decode-discipline"]
+
+
+def test_decode_gather_comprehension_flagged():
+    src = ("def serve(dict_values, codes):\n"
+           "    return [dict_values[c] for c in codes]\n")
+    rep = lint({OPS_REL: src}, rules=["decode-discipline"])
+    assert rules_of(rep) == ["decode-discipline"]
+
+
+def test_decode_out_of_scope_file_clean():
+    src = ("def serve(dict_values, codes):\n"
+           "    return [dict_values[c] for c in codes]\n")
+    rep = lint({"tidb_tpu/session/x.py": src},
+               rules=["decode-discipline"])
+    assert rep.findings == []
+
+
+def test_decode_plain_comprehension_not_decode_shaped_clean():
+    src = ("def f(rows):\n"
+           "    return [r[0] for r in rows]\n")
+    rep = lint({OPS_REL: src}, rules=["decode-discipline"])
+    assert rep.findings == []
+
+
+def test_decode_tagged_site_suppressed():
+    src = ("def serve(dict_values, codes):\n"
+           "    # lint: exempt[decode-discipline] result formatting at the wire boundary\n"
+           "    return [dict_values[c] for c in codes]\n")
+    rep = lint({OPS_REL: src}, rules=["decode-discipline"])
+    assert rep.findings == []

@@ -34,7 +34,13 @@ MAX_CHUNK_SIZE = 32768
 class Column:
     """One column: numpy data + validity mask."""
 
-    __slots__ = ("ft", "data", "valid")
+    # _enc memoizes dict_encode's (codes, values) — columns are
+    # immutable once built, so the dictionary pass runs once per column
+    # no matter how many consumers (device transfer, join key encoding,
+    # encoded filters) ask for codes. The values list may be EXTENDED in
+    # place by the HBM cache's incremental dict growth (store/
+    # device_cache.py): appends only, existing codes stay stable.
+    __slots__ = ("ft", "data", "valid", "_enc")
 
     def __init__(self, ft: FieldType, data: np.ndarray, valid: np.ndarray | None = None):
         self.ft = ft
@@ -115,8 +121,10 @@ class Chunk:
     # _scan_handles/_delta_memo ride cached base chunks only
     # (store/delta.py): the row handles of a cached record scan, and
     # the memoized base-plus-delta merges computed from them.
+    # _bytes_memo caches memtrack's O(columns-payload) byte sizing —
+    # hot cached chunks are re-sized on every dispatch otherwise
     __slots__ = ("columns", "_dev_cache", "_cop_filter_memo",
-                 "_scan_handles", "_delta_memo")
+                 "_scan_handles", "_delta_memo", "_bytes_memo")
 
     def __getstate__(self):
         # device memos and filter memos are process-local accelerators;
@@ -226,7 +234,16 @@ def dict_encode(col: Column) -> tuple[np.ndarray, list]:
     one code, so device group-by/compare over codes follows the collation
     (the dictionary keeps the first-seen variant for decode, matching the
     host path's representative-row semantics).
+
+    The result is memoized on the column (columns are immutable): hot
+    cached chunks pay the Python encode pass once, and every consumer
+    (device transfer, join key encoder, encoded filter translation)
+    shares ONE (codes, values) pair — the identity that makes
+    shared-dictionary detection possible (ops/encoded.py).
     """
+    hit = getattr(col, "_enc", None)
+    if hit is not None:
+        return hit
     codes = np.empty(len(col), dtype=np.int64)
     mapping: dict = {}
     values: list = []
@@ -246,4 +263,5 @@ def dict_encode(col: Column) -> tuple[np.ndarray, list]:
             mapping[k] = c
             values.append(v)
         codes[i] = c
+    col._enc = (codes, values)
     return codes, values

@@ -29,6 +29,8 @@ __all__ = ["get_var", "set_var", "all_vars", "coerce", "session_overlay",
            "runtime_stats_enabled",
            "runtime_stats_device", "mem_quota_query",
            "device_cache_bytes", "fused_scan_enabled",
+           "encoded_exec_enabled", "fuse_fragments_enabled",
+           "direct_agg_slots",
            "server_mem_quota", "admission_timeout_ms",
            "sched_inflight", "sched_inflight_bytes",
            "delta_store_enabled", "delta_merge_rows",
@@ -118,6 +120,30 @@ _DEFS: dict[str, tuple[str, int]] = {
     # block is only consumable by a kernel that accepts device-resident
     # columns, i.e. the fused dispatch.
     "tidb_tpu_fused_scan": (_BOOL, 1),
+    # encoded execution (ops/encoded.py): operate on dictionary codes
+    # end-to-end instead of decoding varlen columns at the device-cache
+    # boundary — string filters compare against pre-encoded constant
+    # codes on device, join build/probe sides hash codes directly
+    # (re-keyed through a code-translation array when the dictionaries
+    # differ), and only result columns late-materialize at the
+    # operator-output finalize boundary. Any unsupported expression
+    # falls back to the decoded path, counted in
+    # tidb_tpu_device_fallback_total{reason="encoding"}. 0 = always
+    # decode (the pre-encoded behavior).
+    "tidb_tpu_encoded_exec": (_BOOL, 1),
+    # fragment fusion (ops/fragment.py): one XLA program executes a
+    # whole pipeline fragment (scan->filter->probe->partial-agg) per
+    # probe superchunk instead of one program per operator, eliminating
+    # the inter-operator HBM round trips (the joined intermediate never
+    # materializes). 0 = per-operator programs.
+    "tidb_tpu_fuse_fragments": (_BOOL, 1),
+    # cardinality bound of the direct-indexed (code-indexed) partial-agg
+    # table: group domains whose code-span product fits this many slots
+    # aggregate through a fixed-size direct-indexed array (no sort, no
+    # hash, no collision possibility); past it the group-by degrades to
+    # the packed-sort hash table instead of ballooning the direct table
+    # (arxiv 2603.26698 "Partial Partial Aggregates").
+    "tidb_tpu_direct_agg_slots": (_INT, 4096),
     # radix fan-out of the partitioned hybrid hash join/agg
     # (ops/hybrid.py; arxiv 2112.02480's dynamic hybrid hash join): build
     # and probe keys split into this many hash partitions so a capacity
@@ -399,6 +425,18 @@ def sched_inflight_bytes() -> int:
 
 def fused_scan_enabled() -> bool:
     return bool(_read("tidb_tpu_fused_scan"))
+
+
+def encoded_exec_enabled() -> bool:
+    return bool(_read("tidb_tpu_encoded_exec"))
+
+
+def fuse_fragments_enabled() -> bool:
+    return bool(_read("tidb_tpu_fuse_fragments"))
+
+
+def direct_agg_slots() -> int:
+    return max(16, _read("tidb_tpu_direct_agg_slots"))
 
 
 def delta_store_enabled() -> bool:

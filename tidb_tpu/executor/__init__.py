@@ -453,11 +453,19 @@ class HashAggExec(Executor):
         tracked = 0
         try:
             if distinct_ok and config.device_enabled() and sc_rows:
+                # fused pipeline fragment (ops/fragment.py): when the
+                # child is a plain inner hash join, ONE XLA program per
+                # probe superchunk executes match + gather + group +
+                # partial agg — the joined intermediate never
+                # materializes in HBM or on the host
+                frag = self._fragment_kernel()
+                source = self._fused_partials(ctx, frag) \
+                    if frag is not None else \
+                    self._superchunk_partials(self.child.chunks(ctx))
                 # superchunk pipeline: child chunks coalesce into big
                 # padded batches and flow through the dispatch-ahead
                 # device queue; one partial-agg dispatch per superchunk
-                for gr in self._superchunk_partials(
-                        self.child.chunks(ctx)):
+                for gr in source:
                     agg.update(gr)
                     tracked = memtrack.track_to(
                         self.plan, agg.approx_bytes(), tracked)
@@ -491,6 +499,175 @@ class HashAggExec(Executor):
         # kernels live on the plan object: the plan cache shares plans
         # across executions, so the jit program outlives any one run
         self.plan._root_kernel = kernel
+
+    def _fragment_kernel(self):
+        """A ProbeAggKernel when this agg can fuse with its child join
+        into one program per probe superchunk (ops/fragment.py), else
+        None. Fusion requires a plain single-chip inner hash join (no
+        other_cond — pair filtering would need the joined width) and a
+        device-safe group/agg set over the joined schema; everything
+        else keeps the per-operator path."""
+        if not config.fuse_fragments_enabled():
+            return None
+        join = self.child
+        if type(join) is not HashJoinExec:      # not Merge/Index subclasses
+            return None
+        jplan = join.plan
+        if jplan.join_type != "inner" or jplan.other_cond is not None \
+                or not jplan.left_keys:
+            return None
+        from tidb_tpu.parallel import config as mesh_config
+        mesh = mesh_config.active_mesh()
+        if mesh is not None and mesh.devices.size > 1:
+            return None     # the mesh shuffle plane owns multi-chip joins
+        from tidb_tpu.ops import fragment as op_fragment
+        nl = len(jplan.children[0].schema)
+        width = nl + len(jplan.children[1].schema)
+        try:
+            return op_fragment.fragment_kernel_for(
+                len(jplan.left_keys), nl, width, self.plan.group_exprs,
+                self.plan.aggs)
+        except (DeviceRejectError, NotImplementedError, ValueError):
+            return None
+
+    def _fused_partials(self, ctx, fk):
+        """Partial GroupResults from the fused probe->agg fragment: the
+        build side uploads once (used columns + key lanes), probe
+        superchunks stream through the dispatch-ahead pipeline, and
+        each in-flight token is one whole-fragment program. A capacity
+        miss escalates the fragment kernel once (later batches inherit
+        it); a miss that survives — or a collision — falls back to the
+        decoded per-batch path (host pair match + gather + host agg),
+        counted on tidb_tpu_device_fallback_total."""
+        plan = self.plan
+        join = self.child
+        jplan = join.plan
+        nl = len(jplan.children[0].schema)
+        width = nl + len(jplan.children[1].schema)
+        build = Chunk.concat_all(list(join.right.chunks(ctx)))
+        nb = build.num_rows if build is not None else 0
+        if nb == 0:
+            return      # inner join over an empty build: no input rows
+        tracked = memtrack.track_to(plan, memtrack.chunk_bytes(build))
+        enc = JoinKeyEncoder(len(jplan.right_keys))
+        raw_bk = join._eval_keys(jplan.right_keys, build)
+        bk = enc.fit_build(
+            raw_bk, encoded=join._encoded_keys(jplan.right_keys, build),
+            ci=[e.ft.is_ci for e in jplan.right_keys])
+        engage, hot, h = join._hybrid_engage(bk, nb, raw_bk)
+        if engage:
+            # skew / quota pressure / over-superchunk build: the hybrid
+            # join's heavy-hitter lanes and partition-spill machinery
+            # own this probe — run the per-operator path (the fragment
+            # would funnel a 30%-hot key through ONE ballooning pair
+            # buffer with nothing sheddable under quota). The encoded
+            # keys, hashes and hot set just computed ride along.
+            try:
+                yield from self._superchunk_partials(join._probe_join(
+                    ctx, build, nb, prepared=(enc, bk, raw_bk, hot, h)))
+            finally:
+                memtrack.release(plan, host=tracked)
+            return
+        state = {"fk": fk, "build_dev": None, "build_db": 0}
+        min_rows = config.device_min_rows()
+        mt_node = memtrack.op_node(plan)
+
+        def decoded_batch(pk, chunk):
+            li, ri = host_match_pairs(bk, pk, nb, chunk.num_rows)
+            pair = join._gather(chunk, build, li, ri)
+            return host_hash_agg(pair, None, plan.group_exprs,
+                                 plan.aggs)
+
+        def dispatch(sc):
+            n = sc.num_rows
+            pk = join._probe_keys(enc, sc.chunk)
+            if n < min_rows and nb < join._DEVICE_MIN_BUILD:
+                return ("host", pk, 0)
+            k = state["fk"]
+            if state["build_dev"] is None:
+                # build lanes stay device-resident for the whole probe
+                state["build_db"] = k.build_nbytes(build, nb)
+                memtrack.consume(plan, device=state["build_db"])
+                state["build_dev"] = k.prepare_build(build, bk, nb)
+            cap = op_runtime.bucket_size(max(n * 2, 1024))
+            db = k.dispatch_nbytes(sc.chunk, cap)
+            memtrack.consume(plan, device=db)
+            try:
+                tok = k.dispatch(state["build_dev"], nb, pk, sc.chunk, n)
+            except BaseException:
+                memtrack.release(plan, device=db)
+                raise
+            runtime_stats.note_superchunk(plan, n, sc.bucket, sc.sources)
+            runtime_stats.note_bytes_touched(
+                memtrack.chunk_bytes(sc.chunk), k.input_nbytes(sc.chunk))
+            return ("dev", (k, tok, pk), db)
+
+        def finalize(sc, tok):
+            kind, payload, db = tok
+            if kind == "host":
+                return decoded_batch(payload, sc.chunk)
+            k, pend, pk = payload
+            t0 = time.perf_counter_ns()
+            try:
+                gr = k.finalize(sc.chunk, build, nb, pend)
+                runtime_stats.note_encoding(plan, "fused:probe-agg")
+                return gr
+            except CapacityError as e:
+                k2 = self._escalated_fragment(e, nl, width)
+                if k2 is not None:
+                    state["fk"] = k2    # later batches dispatch with it
+                    n = sc.num_rows
+                    cap = op_runtime.bucket_size(max(n * 2, 1024))
+                    with sched.device_slot(), memtrack.device_scope(
+                            plan, k2.dispatch_nbytes(sc.chunk, cap)):
+                        try:
+                            gr = k2.finalize(
+                                sc.chunk, build, nb,
+                                k2.dispatch(state["build_dev"], nb, pk,
+                                            sc.chunk, n))
+                            runtime_stats.note_encoding(
+                                plan, "fused:probe-agg")
+                            return gr
+                        except (CapacityError, CollisionError):
+                            pass
+                runtime_stats.note_fallback(plan, "capacity")
+                return decoded_batch(pk, sc.chunk)
+            except CollisionError:
+                runtime_stats.note_fallback(plan, "collision")
+                return decoded_batch(pk, sc.chunk)
+            finally:
+                memtrack.release(plan, device=db)
+                runtime_stats.note_finalize_wait(
+                    plan, time.perf_counter_ns() - t0)
+
+        sc_iter = op_runtime.superchunk_batches(
+            join.left.chunks(ctx), config.superchunk_rows(),
+            tracker=mt_node)
+        try:
+            yield from op_runtime.pipeline_map(
+                sc_iter, dispatch, finalize, config.pipeline_depth(),
+                tracker=mt_node,
+                cost=lambda sc: memtrack.chunk_bytes(sc.chunk))
+        finally:
+            if state["build_db"]:
+                memtrack.release(plan, device=state["build_db"])
+            memtrack.release(plan, host=tracked)
+
+    def _escalated_fragment(self, e: CapacityError, nl: int, width: int):
+        """Fragment-kernel re-plan after a group-capacity miss; None
+        when the overflow is hopeless (the per-batch decoded fallback
+        then owns the batch)."""
+        from tidb_tpu.ops import fragment as op_fragment
+        cap = op_hybrid.escalated_capacity(getattr(e, "needed", 0))
+        if cap is None:
+            return None
+        jplan = self.child.plan
+        try:
+            return op_fragment.fragment_kernel_for(
+                len(jplan.left_keys), nl, width, self.plan.group_exprs,
+                self.plan.aggs, capacity=cap)
+        except (DeviceRejectError, NotImplementedError, ValueError):
+            return None
 
     def _escalated_kernel(self, e: CapacityError):
         """Re-plan once with a larger device table (the re-plan the
@@ -596,6 +773,9 @@ class HashAggExec(Executor):
                 raise
             runtime_stats.note_superchunk(plan, sc.num_rows, sc.bucket,
                                           sc.sources)
+            runtime_stats.note_bytes_touched(
+                memtrack.chunk_bytes(sc.chunk),
+                memtrack.device_put_bytes(sc.chunk))
             return tok
 
         def finalize(sc, tok):
@@ -785,6 +965,9 @@ class StreamAggExec(Executor):
                 raise
             runtime_stats.note_superchunk(plan, sc.num_rows, sc.bucket,
                                           sc.sources)
+            runtime_stats.note_bytes_touched(
+                memtrack.chunk_bytes(sc.chunk),
+                memtrack.device_put_bytes(sc.chunk))
             return tok
 
         def finalize(sc, tok):
@@ -1014,6 +1197,38 @@ class HashJoinExec(Executor):
         return self.plan.right_keys if exprs is self.plan.left_keys \
             else self.plan.left_keys
 
+    def _encoded_keys(self, exprs, chunk):
+        """Pre-encoded (codes, values) key lanes for bare varlen
+        ColumnRefs (ops/encoded.py, `tidb_tpu_encoded_exec`): the join
+        then hashes dictionary codes directly — a probe side sharing
+        the build's dictionary passes through, a mismatched one re-keys
+        through a code-translation array — instead of re-building a
+        per-join Python dict over every value. Engages per key only
+        when BOTH sides are plain string columns with matching
+        collation (mixed-type and mixed-collation keys keep the raw
+        path, whose rescale/fold rules own those semantics)."""
+        if not config.encoded_exec_enabled():
+            return None
+        from tidb_tpu.ops import encoded as op_encoded
+        out = []
+        any_lane = False
+        for e, oe in zip(exprs, self._other_keys(exprs)):
+            lane = None
+            if (e.ft.eval_type == EvalType.STRING and
+                    oe.ft.eval_type == EvalType.STRING and
+                    bool(e.ft.is_ci) == bool(oe.ft.is_ci)):
+                lane = op_encoded.encoded_lane(e, chunk)
+            out.append(lane)
+            any_lane = any_lane or lane is not None
+        return out if any_lane else None
+
+    def _probe_keys(self, enc, chunk):
+        """One probe batch's aligned key lanes, through the encoded
+        fast path when the lanes are pre-encodable."""
+        return enc.transform_probe(
+            self._eval_keys(self.plan.left_keys, chunk),
+            encoded=self._encoded_keys(self.plan.left_keys, chunk))
+
     def _mesh_kernel(self, nb: int):
         """A shuffle-join kernel when a multi-chip mesh is active and the
         build side is big enough to be worth a repartition (ref: the
@@ -1052,11 +1267,24 @@ class HashJoinExec(Executor):
         finally:
             memtrack.release(self.plan, host=tracked)
 
-    def _probe_join(self, ctx, build, nb: int):
+    def _probe_join(self, ctx, build, nb: int, prepared=None):
+        """`prepared` = (enc, bk, raw_bk, hot, h) from a caller that
+        already encoded the build keys and ran the hybrid-engage scan
+        (the fused fragment's stand-aside path) — O(nb) key evaluation
+        and heavy-hitter hashing must not run twice on exactly the
+        large-build cases."""
         plan = self.plan
-        enc = JoinKeyEncoder(len(plan.right_keys))
-        raw_bk = self._eval_keys(plan.right_keys, build) if nb else None
-        bk = enc.fit_build(raw_bk) if nb else None
+        if prepared is not None and nb:
+            enc, bk, raw_bk, pre_hot, pre_h = prepared
+        else:
+            enc = JoinKeyEncoder(len(plan.right_keys))
+            raw_bk = self._eval_keys(plan.right_keys, build) if nb \
+                else None
+            bk = enc.fit_build(
+                raw_bk,
+                encoded=self._encoded_keys(plan.right_keys, build),
+                ci=[e.ft.is_ci for e in plan.right_keys]) if nb else None
+            pre_hot = pre_h = None
         matched_build = np.zeros(nb, dtype=bool)
         probe_iter = self.left.chunks(ctx)
         mesh_kernel = self._mesh_kernel(nb)
@@ -1086,7 +1314,16 @@ class HashJoinExec(Executor):
                      self._kernel is not None and
                      config.device_enabled() and
                      config.superchunk_rows())
-        hyb = self._maybe_hybrid(bk, nb, raw_bk) if device_ok else None
+        if not device_ok:
+            hyb = None
+        elif pre_h is not None:
+            # the caller's engage scan already said yes: construct
+            # directly over its hashes/hot set
+            hyb = op_hybrid.HybridJoinBuild(
+                self._kernel, bk, nb, config.join_partitions(), plan,
+                hot_hashes=pre_hot, h=pre_h)
+        else:
+            hyb = self._maybe_hybrid(bk, nb, raw_bk)
         if hyb is not None:
             # partitioned hybrid path (ops/hybrid.py): skew routed
             # through the heavy-hitter lane, cold build partitions
@@ -1118,8 +1355,7 @@ class HashJoinExec(Executor):
                     elif plan.join_type == "anti":
                         yield chunk        # nothing can match: all survive
                     continue
-                pk = enc.transform_probe(
-                    self._eval_keys(plan.left_keys, chunk))
+                pk = self._probe_keys(enc, chunk)
                 if mesh_kernel is not None:
                     from tidb_tpu.parallel.shuffle_join import \
                         ShuffleOverflowError
@@ -1184,18 +1420,16 @@ class HashJoinExec(Executor):
         if out is not None:
             yield out
 
-    def _maybe_hybrid(self, bk, nb: int, raw_bk):
-        """A HybridJoinBuild when the partitioned path should carry this
-        probe (ops/hybrid.py). Partitioning is pure win under skew,
-        memory pressure, or an over-superchunk build — and pure overhead
-        otherwise, so the unskewed in-HBM case stays on the classic
-        pipelined probe. Heavy hitters are seeded from exact build-side
-        duplication plus the probe table's ANALYZE-time CMSketch when
-        the planner traced the probe key to a base column."""
+    def _hybrid_engage(self, bk, nb: int, raw_bk):
+        """(engage, hot, h): should the partitioned hybrid path carry
+        this build? Decision only — no HybridJoinBuild is constructed,
+        so the fused-fragment eligibility check (HashAggExec) can
+        consult it cheaply and stand aside when the skew/quota/spill
+        machinery owns the probe."""
         parts = config.join_partitions()
         plan = self.plan
         if parts <= 1 or nb < self._DEVICE_MIN_BUILD:
-            return None
+            return False, None, None
         h = op_hybrid.build_hashes(bk, nb)
         raw_key = None
         if len(plan.right_keys) == 1 and raw_bk:
@@ -1230,9 +1464,23 @@ class HashJoinExec(Executor):
         root = memtrack.current()
         quota = root is not None and root.quota > 0
         if not hot.size and not quota and nb <= config.superchunk_rows():
+            return False, hot, h
+        return True, hot, h
+
+    def _maybe_hybrid(self, bk, nb: int, raw_bk):
+        """A HybridJoinBuild when the partitioned path should carry this
+        probe (ops/hybrid.py). Partitioning is pure win under skew,
+        memory pressure, or an over-superchunk build — and pure overhead
+        otherwise, so the unskewed in-HBM case stays on the classic
+        pipelined probe. Heavy hitters are seeded from exact build-side
+        duplication plus the probe table's ANALYZE-time CMSketch when
+        the planner traced the probe key to a base column."""
+        engage, hot, h = self._hybrid_engage(bk, nb, raw_bk)
+        if not engage:
             return None
-        return op_hybrid.HybridJoinBuild(self._kernel, bk, nb, parts,
-                                         plan, hot_hashes=hot, h=h)
+        return op_hybrid.HybridJoinBuild(self._kernel, bk, nb,
+                                         config.join_partitions(),
+                                         self.plan, hot_hashes=hot, h=h)
 
     # lint: exempt[memtrack-alloc] pair-index buffers are billed at dispatch (cap*17 inside dispatch_nbytes); staged sub-chunks consume on mt_node below
     def _hybrid_probe(self, probe_iter, build, hyb, enc, matched_build):
@@ -1309,8 +1557,7 @@ class HashJoinExec(Executor):
                     hyb.promote(pending_promo[0])
                     pending_promo[0] = None
                 n = sc.num_rows
-                pk = enc.transform_probe(
-                    self._eval_keys(plan.left_keys, sc.chunk))
+                pk = self._probe_keys(enc, sc.chunk)
                 hp, tasks = hyb.route(pk, n)
                 pending_promo[0] = hyb.observe(hp)
                 staged_mask = np.zeros(n, dtype=bool)
@@ -1451,8 +1698,7 @@ class HashJoinExec(Executor):
         def dispatch(sc):
             nonlocal build_dev, build_db
             n = sc.num_rows
-            pk = enc.transform_probe(
-                self._eval_keys(plan.left_keys, sc.chunk))
+            pk = self._probe_keys(enc, sc.chunk)
             if n < self._DEVICE_MIN_PROBE and nb < self._DEVICE_MIN_BUILD:
                 return ("host", host_match_pairs(bk, pk, nb, n), 0)
             if build_dev is None:

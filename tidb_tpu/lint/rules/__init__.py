@@ -1,6 +1,6 @@
 """Rule catalog: importing this package registers every rule, in the
 order CI reports them. Four ported from the original standalone test
-walkers, seven project-specific additions, and three whole-program
+walkers, eight project-specific additions, and three whole-program
 flow rules built on tidb_tpu/lint/flow (call graph + lock registry
 over the same shared parse)."""
 
@@ -15,6 +15,7 @@ from tidb_tpu.lint.rules import (  # noqa: F401  (import == register)
     dtypes,      # dtype-discipline
     excepts,     # bare-except
     devcache,    # device-cache
+    decode,      # decode-discipline (encoded execution stays encoded)
     lockorder,   # lock-order        (flow: acquisition-order cycles)
     guardedby,   # guarded-by        (flow: annotated shared state)
     pairres,     # paired-resource   (flow: consume/release, dispatch/

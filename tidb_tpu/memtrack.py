@@ -445,7 +445,12 @@ def register_spill(fn):
 
 def chunk_bytes(chunk) -> int:
     """Host footprint of a chunk: numpy buffers at their real size,
-    object (string) columns at pointer + payload length."""
+    object (string) columns at pointer + payload length. Memoized on
+    the (immutable) chunk — string columns make this an O(rows) scan,
+    and hot cached chunks are re-sized on every dispatch."""
+    hit = getattr(chunk, "_bytes_memo", None)
+    if hit is not None:
+        return hit
     total = 0
     for c in chunk.columns:
         data = c.data
@@ -457,6 +462,10 @@ def chunk_bytes(chunk) -> int:
             total += sum(len(x) for x in data
                          if isinstance(x, (str, bytes)))
         total += len(c.valid)          # bool mask
+    try:
+        chunk._bytes_memo = total
+    except AttributeError:
+        pass        # duck-typed chunk without the memo slot
     return total
 
 

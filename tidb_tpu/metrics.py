@@ -27,7 +27,8 @@ __all__ = ["counter", "histogram", "gauge", "expose", "snapshot",
            "DEVICE_FALLBACKS", "JOIN_SPILL_PARTITIONS", "JOIN_HOT_ROWS",
            "CONNECTIONS_CURRENT", "ADMISSIONS", "ADMISSION_WAITS",
            "ADMISSION_QUEUE_DEPTH", "SCHED_STALLS", "SCHED_BYPASSES",
-           "DELTA_ROWS", "DELTA_MERGES", "CACHE_DELTA_SERVES"]
+           "DELTA_ROWS", "DELTA_MERGES", "CACHE_DELTA_SERVES",
+           "BYTES_ENCODED", "BYTES_DECODED_EQUIV"]
 
 _lock = threading.Lock()
 _counters: dict[tuple[str, tuple], float] = {}       # guarded-by: _lock
@@ -213,6 +214,13 @@ SCHED_BYPASSES = "tidb_tpu_sched_bypass_total"
 DELTA_ROWS = "tidb_tpu_delta_rows_current"
 DELTA_MERGES = "tidb_tpu_delta_merge_total"
 CACHE_DELTA_SERVES = "tidb_tpu_cache_served_with_delta_total"
+# encoded execution (ops/encoded.py): input bytes device dispatches
+# actually staged/read (dict codes + validity at the padded bucket) vs
+# the decoded-equivalent footprint of the same inputs — BENCH's
+# per-query bytes_touched column diffs these to audit the compression
+# win (ROADMAP item 4)
+BYTES_ENCODED = "tidb_tpu_device_bytes_encoded_total"
+BYTES_DECODED_EQUIV = "tidb_tpu_device_bytes_decoded_equiv_total"
 
 _HELP = {
     QUERY_DURATIONS: "Statement wall time through Session.execute.",
@@ -277,4 +285,9 @@ _HELP = {
         "(rows|ratio|shed|close).",
     CACHE_DELTA_SERVES:
         "Cache reads served as base + delta instead of re-scanning.",
+    BYTES_ENCODED:
+        "Input bytes device dispatches actually staged or read "
+        "(dictionary codes + validity at the padded bucket).",
+    BYTES_DECODED_EQUIV:
+        "Decoded-equivalent footprint of the same dispatch inputs.",
 }

@@ -38,7 +38,8 @@ from tidb_tpu.sqltypes import EvalType
 
 __all__ = ["AggSpec", "HashAggKernel", "ScalarAggKernel", "HashAggregator",
            "CapacityError", "CollisionError", "DeviceRejectError",
-           "GroupResult", "finalize_group_result", "kernel_for"]
+           "GroupResult", "finalize_group_result", "kernel_for",
+           "group_partial"]
 
 AggSpec = AggDesc  # the planner's descriptor doubles as the kernel spec
 
@@ -190,13 +191,17 @@ def _cond_direct_mode(group_exprs) -> bool:
 
 # lint: exempt[dtype-discipline] exact int64 key codes + float64 span product (span overflow check must not round at 2^53)
 def _cond_group_table(xp, group_exprs, cols, n, mask, h, C,
-                      pmax_axes=None):
+                      pmax_axes=None, direct_limit=None):
     """Runtime-selected group table: if the keys' (min..max) span
     product fits the capacity, index slots DIRECTLY by normalized
     codes; otherwise fall back to the packed-sort table over the
     precomputed hash `h`. Mins/spans are global over the mesh axes so
     every shard agrees on the code space (the value-based re-unique
-    merge then stays correct)."""
+    merge then stays correct). `direct_limit` caps the direct branch
+    below the table capacity (tidb_tpu_direct_agg_slots): a
+    capacity-escalated retry keeps a bounded direct domain and degrades
+    wide spans to the hash branch instead of ballooning the
+    direct-indexed table."""
     codes = []
     spans = []
     span_fs = []
@@ -225,7 +230,8 @@ def _cond_group_table(xp, group_exprs, cols, n, mask, h, C,
             1.0))      # no live rows: empty span counts as 1
 
     span_prod = jnp.prod(jnp.stack(span_fs))
-    small = span_prod <= jnp.float64(C - 2)
+    bound = C - 2 if direct_limit is None else min(C - 2, direct_limit)
+    small = span_prod <= jnp.float64(bound)
 
     def direct(_):
         combined = codes[0]
@@ -510,6 +516,54 @@ def finalize_group_result(chunk: Chunk, group_exprs, aggs, gidx: np.ndarray,
     return GroupResult(keys=keys, partials=partials, counts=counts)
 
 
+# lint: exempt[dtype-discipline] int64 slot init: group slots hold exact key codes and decimal sums
+def group_partial(xp, group_exprs, aggs, cols, n, mask, capacity,
+                  force_hash: bool = False, direct_limit=None):
+    """The traced group+partial-agg phase shared by HashAggKernel and
+    the fused pipeline-fragment kernel (ops/fragment.py): group table
+    (direct-indexed / runtime-selected / packed-sort per the group-key
+    shape), one batched scatter pass per (merge-op, dtype), dual-hash
+    collision check. `cols` entries may be None for columns no
+    group/agg expression reads (the fragment kernel gathers only used
+    lanes). -> (uniq, nuniq, collided, counts, rep, lanes)."""
+    if not force_hash and _direct_group_mode(group_exprs):
+        uniq, inv, nuniq = _direct_group_table(
+            xp, group_exprs, cols, n, mask, capacity)
+        h2 = xp.zeros(n, dtype=jnp.int64)
+    elif not force_hash and _cond_direct_mode(group_exprs):
+        key_cols = [g.eval_xp(xp, cols, n) for g in group_exprs]
+        h = _hash_keys(xp, key_cols, n, seed=0x517CC1B727220A95)
+        h2 = _hash_keys(xp, key_cols, n, seed=0x2545F4914F6CDD1D)
+        uniq, inv, nuniq = _cond_group_table(
+            xp, group_exprs, cols, n, mask, h, capacity,
+            direct_limit=direct_limit)
+    else:
+        key_cols = [g.eval_xp(xp, cols, n) for g in group_exprs]
+        h = _hash_keys(xp, key_cols, n, seed=0x517CC1B727220A95)
+        h2 = _hash_keys(xp, key_cols, n, seed=0x2545F4914F6CDD1D)
+        # one packed sort -> group table + inverse + true distinct
+        # count (incl. masked sentinel) for overflow detection
+        uniq, inv, nuniq = _group_table(xp, h, n, capacity, mask=mask)
+    # one batched scatter pass per (merge-op, dtype) for the header
+    # lanes + every aggregate (see _SegBatch)
+    mask_i = mask.astype(jnp.int64)
+    b = _SegBatch(inv, capacity)
+    i_cmin = b.add(xp.where(mask, h2, _I64_MAX), "min")
+    i_cmax = b.add(xp.where(mask, h2, _I64_MIN), "max")
+    i_live = b.add(mask_i, "max")
+    i_cnt = b.add(mask_i, "sum")
+    i_rep = b.add(xp.where(mask, xp.arange(n), n), "min")
+    assembles = [_agg_requests(xp, a, cols, n, mask, b) for a in aggs]
+    b.run()
+    # collision check: within each group, the check hash must agree
+    collided = jnp.any((b.get(i_live) > 0) &
+                       (b.get(i_cmin) != b.get(i_cmax)))
+    counts = b.get(i_cnt)
+    rep = b.get(i_rep)
+    lanes = [[l for l, _op in assemble(b.get)] for assemble in assembles]
+    return uniq, nuniq, collided, counts, rep, lanes
+
+
 class HashAggKernel:
     """Compiled filter+group+partial-agg over one chunk schema.
 
@@ -519,59 +573,34 @@ class HashAggKernel:
 
     def __init__(self, filter_expr: Expression | None,
                  group_exprs: Sequence[Expression],
-                 aggs: Sequence[AggDesc], capacity: int = 4096):
+                 aggs: Sequence[AggDesc], capacity: int = 4096,
+                 force_hash: bool = False, direct_limit: int | None = None):
+        """`force_hash` degrades the direct-indexed (code-indexed) group
+        table to the packed-sort hash path — set by kernel_for when a
+        capacity escalation crosses `tidb_tpu_direct_agg_slots`, so the
+        fixed-size direct table never balloons past its bound.
+        `direct_limit` caps the runtime-selected direct branch the same
+        way (both are construction-time values; kernel_for keys its
+        cache on them)."""
         self.filter_expr = filter_expr
         self.group_exprs = list(group_exprs)
         self.aggs = list(aggs)
         self.capacity = capacity
+        self.force_hash = force_hash
+        self.direct_limit = direct_limit
         _validate_device_exprs(filter_expr, self.group_exprs, self.aggs)
         self._jit = jax.jit(self._kernel)
         self._jitd = None   # donating variant, built on first dispatch
 
-    # lint: exempt[dtype-discipline] int64 slot init: group slots hold exact key codes and decimal sums
     def _kernel(self, cols, nrows):
         n = cols[0][0].shape[0]
         xp = jnp
         mask = runtime.filter_mask_xp(xp, self.filter_expr, cols, n)
         mask = mask & (xp.arange(n) < nrows)   # padding rows are dead
-        if _direct_group_mode(self.group_exprs):
-            uniq, inv, nuniq = _direct_group_table(
-                xp, self.group_exprs, cols, n, mask, self.capacity)
-            h2 = xp.zeros(n, dtype=jnp.int64)
-        elif _cond_direct_mode(self.group_exprs):
-            key_cols = [g.eval_xp(xp, cols, n) for g in self.group_exprs]
-            h = _hash_keys(xp, key_cols, n, seed=0x517CC1B727220A95)
-            h2 = _hash_keys(xp, key_cols, n, seed=0x2545F4914F6CDD1D)
-            uniq, inv, nuniq = _cond_group_table(
-                xp, self.group_exprs, cols, n, mask, h, self.capacity)
-        else:
-            key_cols = [g.eval_xp(xp, cols, n) for g in self.group_exprs]
-            h = _hash_keys(xp, key_cols, n, seed=0x517CC1B727220A95)
-            h2 = _hash_keys(xp, key_cols, n, seed=0x2545F4914F6CDD1D)
-            # one packed sort -> group table + inverse + true distinct
-            # count (incl. masked sentinel) for overflow detection
-            uniq, inv, nuniq = _group_table(xp, h, n, self.capacity,
-                                            mask=mask)
-        # one batched scatter pass per (merge-op, dtype) for the header
-        # lanes + every aggregate (see _SegBatch)
-        mask_i = mask.astype(jnp.int64)
-        b = _SegBatch(inv, self.capacity)
-        i_cmin = b.add(xp.where(mask, h2, _I64_MAX), "min")
-        i_cmax = b.add(xp.where(mask, h2, _I64_MIN), "max")
-        i_live = b.add(mask_i, "max")
-        i_cnt = b.add(mask_i, "sum")
-        i_rep = b.add(xp.where(mask, xp.arange(n), n), "min")
-        assembles = [_agg_requests(xp, a, cols, n, mask, b)
-                     for a in self.aggs]
-        b.run()
-        # collision check: within each group, the check hash must agree
-        collided = jnp.any((b.get(i_live) > 0) &
-                           (b.get(i_cmin) != b.get(i_cmax)))
-        counts = b.get(i_cnt)
-        rep = b.get(i_rep)
-        lanes = [[l for l, _op in assemble(b.get)]
-                 for assemble in assembles]
-        return uniq, nuniq, collided, counts, rep, lanes
+        return group_partial(xp, self.group_exprs, self.aggs, cols, n,
+                             mask, self.capacity,
+                             force_hash=self.force_hash,
+                             direct_limit=self.direct_limit)
 
     def scratch_nbytes(self, chunk: Chunk) -> int:
         """Device bytes a dispatch stages BEYOND the input columns: the
@@ -714,8 +743,14 @@ class ScalarAggKernel:
 # is identical — re-tracing and re-compiling it per plan instance is pure
 # waste (and through a chip tunnel, seconds of it). jit's own executable
 # cache inside each kernel then handles the bucket-shape axis: one traced
-# kernel serves every padded superchunk size.
-_KERNELS = runtime.FingerprintCache(64)
+# kernel serves every padded superchunk size. Sized for encoded filters
+# too (ops/encoded.py): a translated constant is a dictionary-specific
+# CODE baked into the fingerprint, so a query over R regions can occupy
+# R keys for one plan shape — the capacity keeps that from thrashing
+# genuinely-hot kernels, and the dictionaries themselves are stable
+# (memoized per cached column), so warm serving converges on a fixed
+# key set whose compiles the persistent XLA cache absorbs.
+_KERNELS = runtime.FingerprintCache(256)
 
 
 def kernel_for(filter_expr, group_exprs, aggs, capacity: int = 4096):
@@ -723,18 +758,33 @@ def kernel_for(filter_expr, group_exprs, aggs, capacity: int = 4096):
     structural plan fingerprint + capacity. Falls back to a fresh
     (uncached) kernel when the plan cannot be fingerprinted. Raises
     ValueError exactly like the constructors when the exprs are not
-    device-safe."""
+    device-safe.
+
+    Degrade-to-hash boundary (tidb_tpu_direct_agg_slots): a direct-mode
+    group-by whose capacity escalation crosses the bound is rebuilt on
+    the packed-sort hash path — the direct-indexed partial table stays
+    a FIXED-SIZE array (arxiv 2603.26698) instead of doubling with the
+    group domain; wide-span int keys clamp the runtime-selected direct
+    branch the same way."""
+    from tidb_tpu import config
+    direct_limit = config.direct_agg_slots()
+    force_hash = bool(group_exprs) and capacity > direct_limit and \
+        _direct_group_mode(group_exprs)
+
     def make():
         if group_exprs:
             return HashAggKernel(filter_expr, group_exprs, aggs,
-                                 capacity=capacity)
+                                 capacity=capacity,
+                                 force_hash=force_hash,
+                                 direct_limit=direct_limit)
         return ScalarAggKernel(filter_expr, aggs)
 
     fp = runtime.plan_fingerprint(filter_expr, group_exprs, aggs)
     if fp is None:
         return make()
-    return _KERNELS.get_or_create((fp, capacity if group_exprs else 0),
-                                  make)
+    key = (fp, capacity if group_exprs else 0, force_hash,
+           direct_limit if group_exprs else 0)
+    return _KERNELS.get_or_create(key, make)
 
 
 class HashAggregator:

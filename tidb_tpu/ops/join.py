@@ -54,15 +54,36 @@ class JoinKeyEncoder:
     Fitted once on the (materialized) build side; probe chunks stream
     through transform(). String values get int64 codes from one shared
     dictionary; probe values absent from it get unique negative codes so
-    they match nothing yet remain live rows (outer-join semantics)."""
+    they match nothing yet remain live rows (outer-join semantics).
+
+    Encoded fast path (ops/encoded.py, `tidb_tpu_encoded_exec`): when a
+    side arrives PRE-ENCODED — the memoized dict_encode of a bare varlen
+    ColumnRef — the per-row Python dict loop disappears. A probe side
+    sharing the build's dictionary OBJECT passes its codes straight
+    through; a mismatched dictionary re-keys with one vectorized gather
+    through a code-translation array (O(|dict|) to build, O(rows) to
+    apply)."""
 
     def __init__(self, num_keys: int):
         self._dicts: list[dict | None] = [None] * num_keys
+        self._bvalues: list[list | None] = [None] * num_keys
+        self._ci = [False] * num_keys
 
     # lint: exempt[memtrack-alloc] build-side key lanes: covered by the tracked build (prepare_build device billing)
-    def fit_build(self, cols):
+    def fit_build(self, cols, encoded=None, ci=None):
         out = []
         for j, (d, v) in enumerate(cols):
+            enc = encoded[j] if encoded is not None else None
+            if enc is not None:
+                # pre-encoded lane: the column's memoized dictionary IS
+                # the join dictionary (value map built lazily only if a
+                # raw probe side ever needs it)
+                codes, values = enc
+                self._bvalues[j] = values
+                if ci is not None:
+                    self._ci[j] = bool(ci[j])
+                out.append((codes, v))
+                continue
             if d.dtype != object:
                 out.append((d, v))
                 continue
@@ -75,11 +96,37 @@ class JoinKeyEncoder:
             out.append((codes, v))
         return out
 
+    def _mapping(self, j: int) -> dict | None:
+        """The build-side value->code map, built lazily from an encoded
+        build dictionary when a raw probe side needs per-value lookup."""
+        mapping = self._dicts[j]
+        if mapping is None and self._bvalues[j] is not None:
+            from tidb_tpu.ops import encoded as op_encoded
+            mapping = op_encoded._dict_map(self._bvalues[j], self._ci[j])
+            self._dicts[j] = mapping
+        return mapping
+
     # lint: exempt[memtrack-alloc] probe key lanes bounded by the probe chunk already billed upstream
-    def transform_probe(self, cols):
+    def transform_probe(self, cols, encoded=None):
         out = []
         for j, (d, v) in enumerate(cols):
-            mapping = self._dicts[j]
+            enc = encoded[j] if encoded is not None else None
+            bvals = self._bvalues[j]
+            if enc is not None and bvals is not None:
+                codes, values = enc
+                if values is bvals:
+                    # shared dictionary: codes are directly comparable
+                    out.append((codes, v))
+                else:
+                    from tidb_tpu.ops import encoded as op_encoded
+                    # the cached build map amortizes across probe
+                    # batches; only the O(|probe dict|) walk is per batch
+                    t = op_encoded.code_translation(
+                        values, bvals, self._ci[j],
+                        dst_map=self._mapping(j))
+                    out.append((t[codes], v))
+                continue
+            mapping = self._mapping(j)
             if mapping is None:
                 if d.dtype == object:
                     # build side had no string values at all: nothing can

@@ -31,7 +31,8 @@ import time
 __all__ = ["OpStats", "StatsCollector", "collecting", "current",
            "instrument", "device_call", "device_section", "fmt_ns",
            "fmt_bytes", "note_superchunk", "note_pipeline_stall",
-           "note_finalize_wait", "note_fallback", "device_watermark"]
+           "note_finalize_wait", "note_fallback", "note_encoding",
+           "note_bytes_touched", "device_watermark"]
 
 _tl = threading.local()
 
@@ -69,7 +70,7 @@ class OpStats:
                  "device_time_ns", "cop_tasks",
                  "superchunks", "coalesced_chunks", "superchunk_fill_rows",
                  "superchunk_bucket_rows", "pipeline_stall_ns",
-                 "fallbacks")
+                 "fallbacks", "encoding")
 
     def __init__(self, name: str):
         self.name = name
@@ -89,6 +90,10 @@ class OpStats:
         # device but executed on the host (capacity/collision miss that
         # survived the partition retry, or a non-device-safe plan)
         self.fallbacks = 0
+        # encoded-execution mode this operator last ran in (EXPLAIN
+        # ANALYZE pipeline column): "" = nothing noted, else one of
+        # encoded | decoded | direct-agg | fused:<fragment>
+        self.encoding = ""
 
     def fill_ratio(self) -> float:
         """Live rows over padded bucket rows (0.0 when no superchunks)."""
@@ -106,7 +111,8 @@ class OpStats:
                 "superchunk_fill_rows": self.superchunk_fill_rows,
                 "superchunk_bucket_rows": self.superchunk_bucket_rows,
                 "pipeline_stall_ns": self.pipeline_stall_ns,
-                "fallbacks": self.fallbacks}
+                "fallbacks": self.fallbacks,
+                "encoding": self.encoding}
 
 
 class StatsCollector:
@@ -185,6 +191,14 @@ class StatsCollector:
             st.fallbacks += 1
         return st
 
+    def note_encoding(self, plan, mode: str) -> None:
+        """Record the operator's encoded-execution mode (encoded /
+        decoded / direct-agg / fused:<fragment>) for the EXPLAIN
+        ANALYZE pipeline column. May arrive from cop pool workers."""
+        st = self.node(plan)
+        with self._lock:
+            st.encoding = mode
+
     def ops(self) -> list[OpStats]:
         """Distinct OpStats (aliases deduped), insertion order."""
         sealed = getattr(self, "_sealed_ops", None)
@@ -237,13 +251,33 @@ def note_pipeline_stall(plan, ns: int) -> None:
         coll.note_pipeline_stall(plan, ns)
 
 
+def note_encoding(plan, mode: str) -> None:
+    """Record the operator's encoded-execution mode against the active
+    collector (no-op without one): EXPLAIN ANALYZE's enc= note."""
+    coll = getattr(_tl, "coll", None)
+    if coll is not None and plan is not None:
+        coll.note_encoding(plan, mode)
+
+
+def note_bytes_touched(decoded_equiv: int, encoded: int) -> None:
+    """Account one device dispatch's input bytes on the two
+    bytes-touched counter families: `encoded` is what the dispatch
+    actually staged/read (dict codes + validity at the padded bucket),
+    `decoded_equiv` is what the same input would occupy decoded into
+    wide host vectors — the auditable compression win BENCH reports as
+    the per-query bytes_touched column."""
+    from tidb_tpu import metrics
+    metrics.counter(metrics.BYTES_DECODED_EQUIV, inc=decoded_equiv)
+    metrics.counter(metrics.BYTES_ENCODED, inc=encoded)
+
+
 def note_fallback(plan, reason: str) -> None:
     """Record one device->host fallback: counted on the operator's
     OpStats (EXPLAIN ANALYZE `pipeline` column) and on the
     tidb_tpu_device_fallback_total{op,reason} metric family. `reason`
-    is one of capacity|collision|unsupported (single-chip) or mesh
-    (a mesh stream batch served by the host) — the designed fallback
-    causes; anything else should RAISE, not fall back."""
+    is one of capacity|collision|unsupported|encoding (single-chip) or
+    mesh (a mesh stream batch served by the host) — the designed
+    fallback causes; anything else should RAISE, not fall back."""
     from tidb_tpu import metrics
     coll = getattr(_tl, "coll", None)
     name = None

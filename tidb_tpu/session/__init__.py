@@ -2214,11 +2214,16 @@ def _fmt_pipeline(st) -> str:
     an invisible device->host cliff visible in the plan)."""
     from tidb_tpu import runtime_stats as rs
     fb = f" fallback={st.fallbacks}" if st.fallbacks else ""
+    # encoded-execution mode (encoded / decoded / direct-agg /
+    # fused:<fragment>): how the operator consumed its dict columns —
+    # the note that makes an encoded->decoded regression diagnosable
+    # from the operator's chair
+    enc = f" enc={st.encoding}" if st.encoding else ""
     if not st.superchunks:
-        return f"-{fb}" if fb else "-"
+        return f"-{fb}{enc}" if fb or enc else "-"
     return (f"{st.superchunks}sc/{st.coalesced_chunks}ch "
             f"fill={st.fill_ratio():.2f} "
-            f"stall={rs.fmt_ns(st.pipeline_stall_ns)}{fb}")
+            f"stall={rs.fmt_ns(st.pipeline_stall_ns)}{fb}{enc}")
 
 
 @dataclass

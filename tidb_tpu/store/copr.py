@@ -91,6 +91,94 @@ def decode_cop_batch(plan: CopPlan, batch):
                            with_handle_col=plan.handle_col)
 
 
+def _resolve_block(plan: CopPlan, chunk, dev_ref):
+    """The HBM-resident DeviceBlock for this chunk, or None. Shared by
+    the decoded and the encoded-filter dispatch paths."""
+    if dev_ref is None or not config.fused_scan_enabled():
+        return None
+    dcache, dkey, dv, read_ts, fill_ts, pend_fn = dev_ref
+    block = dcache.get_or_fill(dkey, dv, read_ts, chunk, fill_ts,
+                               pend_fn=pend_fn)
+    if block is not None and block.nrows == chunk.num_rows:
+        return block
+    return None
+
+
+def _agg_mode(plan: CopPlan, k) -> str:
+    """The encoding-mode note for a successful device agg dispatch —
+    derived from the kernel ACTUALLY selected: one degraded past
+    tidb_tpu_direct_agg_slots (force_hash) must not keep reporting
+    direct-agg, or the note hides exactly the regression it exists to
+    diagnose."""
+    from tidb_tpu.ops.hashagg import _direct_group_mode
+    return "direct-agg" if plan.group_exprs and \
+        not getattr(k, "force_hash", False) and \
+        _direct_group_mode(plan.group_exprs) else "encoded"
+
+
+def _encoded_agg(plan: CopPlan, chunk, sources: int,
+                 dev_ref) -> CopResponse | None:
+    """Device partial agg with the host-only string filter translated
+    into CODE space (ops/encoded.py): the chunk's dict columns are
+    compared against pre-encoded constant codes inside the kernel, so
+    the fused HBM dispatch stays available and the host never rewrites
+    the chunk. Returns None to run the decoded path instead — counted
+    as tidb_tpu_device_fallback_total{reason="encoding"} when the
+    filter is not encodable (a capacity/collision miss returns None
+    silently: the decoded retry owns that bookkeeping, and the encoded
+    filter must never reach a host evaluator)."""
+    from tidb_tpu.expression.core import Op, func
+    from tidb_tpu.ops import encoded
+    from tidb_tpu.ops.hashagg import kernel_for
+    # translatability gate BEFORE touching the device cache: an
+    # untranslatable filter must not fill HBM with blocks this query
+    # can never consume (vocabulary support doesn't depend on which
+    # dictionary the constants encode against)
+    enc = encoded.translate_filter(plan.host_filter, chunk)
+    if enc is None:
+        runtime_stats.note_fallback(plan, "encoding")
+        return None
+    block = _resolve_block(plan, chunk, dev_ref)
+    if block is not None:
+        # re-encode the constants against the dictionaries the resident
+        # code lanes were actually built with — delta patches extend
+        # them past the chunk's own memoized encode
+        enc = encoded.translate_filter(
+            plan.host_filter, chunk,
+            dict_of=lambda j, _b=block: _b.dicts.get(j))
+        if enc is None:     # block lost a dictionary: decoded path
+            runtime_stats.note_fallback(plan, "encoding")
+            return None
+    eff = enc if plan.filter is None else func(Op.AND, plan.filter, enc)
+    try:
+        k = kernel_for(eff, plan.group_exprs or [], plan.aggs)
+    except (DeviceRejectError, NotImplementedError, ValueError):
+        runtime_stats.note_fallback(plan, "encoding")
+        return None
+    try:
+        if block is not None:
+            dev_cols, nbytes = block.cols, k.scratch_nbytes(chunk)
+            moved = block.nbytes
+        else:
+            dev_cols = None
+            moved = memtrack.device_put_bytes(chunk)
+            nbytes = k.dispatch_nbytes(chunk)
+        with sched.device_slot(), memtrack.device_scope(plan, nbytes):
+            res = runtime_stats.device_call(plan, k, chunk, dev_cols)
+    except (CapacityError, CollisionError, DeviceRejectError,
+            NotImplementedError):
+        # the decoded retry re-runs with the ORIGINAL filter tree (the
+        # code-space one is device-only) and records its own outcome
+        return None
+    runtime_stats.note_encoding(plan, _agg_mode(plan, k))
+    runtime_stats.note_bytes_touched(memtrack.chunk_bytes(chunk), moved)
+    if config.superchunk_rows():
+        runtime_stats.note_superchunk(
+            plan, chunk.num_rows, bucket_size(max(chunk.num_rows, 1)),
+            sources)
+    return CopResponse(chunk=res)
+
+
 def exec_cop_plan(plan: CopPlan, chunk, sources: int = 1,
                   dev_ref=None) -> CopResponse:
     """Run the pushed subplan over one region's decoded chunk.
@@ -106,12 +194,20 @@ def exec_cop_plan(plan: CopPlan, chunk, sources: int = 1,
     hold); pend_fn lets the HBM cache fold staged row deltas into the
     resident block in place (store/delta.py)."""
     if plan.host_filter is not None:
-        # the host filter rewrites the chunk, so the raw cached block no
-        # longer matches it — the fused path only covers device-complete
-        # predicates
+        if (plan.is_agg and config.encoded_exec_enabled() and
+                config.device_enabled() and
+                chunk.num_rows >= config.device_min_rows()):
+            resp = _encoded_agg(plan, chunk, sources, dev_ref)
+            if resp is not None:
+                return resp
+        # decoded path: the host filter rewrites the chunk, so the raw
+        # cached block no longer matches it — the fused path only
+        # covers device-complete (or code-translated) predicates
         dev_ref = None
         mask = eval_filter_host(plan.host_filter, chunk)
         chunk = chunk.filter(mask)
+        if plan.is_agg:
+            runtime_stats.note_encoding(plan, "decoded")
     if plan.is_agg:
         use_device = (config.device_enabled() and
                       chunk.num_rows >= config.device_min_rows())
@@ -119,18 +215,16 @@ def exec_cop_plan(plan: CopPlan, chunk, sources: int = 1,
             try:
                 k = _agg_kernels(plan)
                 dev_cols = None
-                nbytes = k.dispatch_nbytes(chunk)
-                if dev_ref is not None and config.fused_scan_enabled():
-                    dcache, dkey, dv, read_ts, fill_ts, pend_fn = \
-                        dev_ref
-                    block = dcache.get_or_fill(dkey, dv, read_ts, chunk,
-                                               fill_ts, pend_fn=pend_fn)
-                    if block is not None and \
-                            block.nrows == chunk.num_rows:
-                        # the input columns stay on the cache's own
-                        # ledger; the statement pays only kernel scratch
-                        dev_cols = block.cols
-                        nbytes = k.scratch_nbytes(chunk)
+                block = _resolve_block(plan, chunk, dev_ref)
+                if block is not None:
+                    # the input columns stay on the cache's own
+                    # ledger; the statement pays only kernel scratch
+                    dev_cols = block.cols
+                    nbytes = k.scratch_nbytes(chunk)
+                    moved = block.nbytes
+                else:
+                    moved = memtrack.device_put_bytes(chunk)
+                    nbytes = k.dispatch_nbytes(chunk)
                 # device ledger: padded upload + scratch, sized from
                 # shapes at dispatch; the pool worker's tracker routes
                 # the charge to the issuing reader's node. The dispatch
@@ -140,6 +234,10 @@ def exec_cop_plan(plan: CopPlan, chunk, sources: int = 1,
                         memtrack.device_scope(plan, nbytes):
                     res = runtime_stats.device_call(plan, k, chunk,
                                                     dev_cols)
+                if plan.host_filter is None:
+                    runtime_stats.note_encoding(plan, _agg_mode(plan, k))
+                runtime_stats.note_bytes_touched(
+                    memtrack.chunk_bytes(chunk), moved)
                 if config.superchunk_rows():
                     # attribution follows the feature switch: with
                     # coalescing off this is plain per-batch dispatch,
@@ -165,6 +263,7 @@ def exec_cop_plan(plan: CopPlan, chunk, sources: int = 1,
                 # ValueError is NOT caught here any more: a real kernel
                 # bug must surface, not masquerade as a capacity miss
                 runtime_stats.note_fallback(plan, "unsupported")
+        runtime_stats.note_encoding(plan, "decoded")
         if plan.group_exprs:
             return CopResponse(chunk=host_hash_agg(
                 chunk, plan.filter, plan.group_exprs, plan.aggs))
