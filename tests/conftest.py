@@ -26,3 +26,42 @@ jax.config.update("jax_platforms", "cpu")
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: heavy legs excluded from the tier-1 budget")
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def ledger_hygiene():
+    """Ledger/slot hygiene under faults (docs/ROBUSTNESS.md): after the
+    test, every armed failpoint is disarmed, the device scheduler holds
+    zero in-flight slots and zero waiters, and the SERVER memtrack
+    host+device ledgers drain to zero once dead storages are collected
+    and the shed chain (forced delta merges, HBM sheds) has run.
+    Applied module-wide by the failpoint/chaos suites via
+    `pytestmark = pytest.mark.usefixtures("ledger_hygiene")`."""
+    yield
+    import gc
+    import time as _time
+
+    from tidb_tpu import memtrack, sched
+    from tidb_tpu.util import failpoint
+
+    failpoint.disable_all()
+    snap = sched.device_scheduler().snapshot()
+    assert snap["inflight"] == 0, f"scheduler slots leaked: {snap}"
+    assert snap["waiting"] == 0, f"scheduler waiters leaked: {snap}"
+    # drain loop: a background delta merge may hold staged bytes for a
+    # moment (merge() is single-flight, so one shed can miss it)
+    deadline = _time.time() + 5.0
+    while True:
+        gc.collect()
+        sched.shed_server(0)
+        if memtrack.SERVER.host == 0 and memtrack.SERVER.device == 0:
+            break
+        if _time.time() >= deadline:
+            raise AssertionError(
+                f"SERVER ledgers not drained: host={memtrack.SERVER.host}"
+                f" device={memtrack.SERVER.device} "
+                f"children={[c.snapshot() for c in memtrack.SERVER.children.values()]}")
+        _time.sleep(0.05)

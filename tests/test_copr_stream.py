@@ -25,6 +25,7 @@ from tidb_tpu.kv import EpochNotMatchError
 from tidb_tpu.session import Session
 from tidb_tpu.store import stream as costream
 from tidb_tpu.store.storage import new_mock_storage
+from tidb_tpu.util import failpoint
 
 N_ROWS = 2000
 FRAME_BYTES = 1024       # each row is ~45 raw bytes: dozens of frames
@@ -186,11 +187,11 @@ class TestFailpointResume:
                 calls["fired"] += 1
                 raise EpochNotMatchError(ctx.region_id)
 
-        shim.inject = inject
+        failpoint.enable("rpc/request", inject)
         try:
             got = q(sess, "SELECT id FROM t ORDER BY id")
         finally:
-            shim.inject = None
+            failpoint.disable("rpc/request")
         assert calls["fired"] == 2
         assert [r[0] for r in got] == list(range(N_ROWS))
         assert costream.stream_stats()["resumes"] >= 2
@@ -210,11 +211,11 @@ class TestFailpointResume:
                 raise EpochNotMatchError(ctx.region_id)
 
         want = _materialized(sess, "SELECT COUNT(*), SUM(v) FROM t")
-        shim.inject = inject
+        failpoint.enable("rpc/request", inject)
         try:
             got = q(sess, "SELECT COUNT(*), SUM(v) FROM t")
         finally:
-            shim.inject = None
+            failpoint.disable("rpc/request")
         assert got == want
 
     def test_real_region_split_mid_stream(self, sess, streaming):
@@ -235,11 +236,11 @@ class TestFailpointResume:
                 st.cluster.split(
                     tablecodec.record_key(info.id, N_ROWS // 8))
 
-        st.shim.inject = inject
+        failpoint.enable("rpc/request", inject)
         try:
             got = q(sess, "SELECT id FROM t ORDER BY id")
         finally:
-            st.shim.inject = None
+            failpoint.disable("rpc/request")
         assert state["split"] == 1
         assert [r[0] for r in got] == list(range(N_ROWS))
 

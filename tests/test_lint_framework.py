@@ -545,3 +545,70 @@ def test_decode_tagged_site_suppressed():
            "    return [dict_values[c] for c in codes]\n")
     rep = lint({OPS_REL: src}, rules=["decode-discipline"])
     assert rep.findings == []
+
+
+# -- failpoint-discipline ----------------------------------------------------
+
+FP_REL = "tidb_tpu/util/failpoint.py"
+FP_DECL = 'REGISTRY = {"hbm/fill": "device cache upload"}\n'
+
+
+def test_failpoint_declared_eval_clean():
+    src = ("from tidb_tpu.util import failpoint\n"
+           "def fill():\n"
+           "    failpoint.eval('hbm/fill')\n")
+    rep = lint({FP_REL: FP_DECL, STORE_REL: src},
+               rules=["failpoint-discipline"])
+    assert rep.findings == []
+
+
+def test_failpoint_undeclared_eval_flagged():
+    src = ("from tidb_tpu.util import failpoint\n"
+           "def fill():\n"
+           "    failpoint.eval('hbm/fill')\n"
+           "    failpoint.eval('not/declared')\n")
+    rep = lint({FP_REL: FP_DECL, STORE_REL: src},
+               rules=["failpoint-discipline"])
+    assert len(rep.findings) == 1
+    assert "not/declared" in rep.findings[0].message
+
+
+def test_failpoint_declared_never_evaluated_flagged():
+    decl = ('REGISTRY = {"hbm/fill": "upload",\n'
+            '            "hbm/ghost": "nothing fires this"}\n')
+    src = ("from tidb_tpu.util import failpoint\n"
+           "def fill():\n"
+           "    failpoint.eval('hbm/fill')\n")
+    rep = lint({FP_REL: decl, STORE_REL: src},
+               rules=["failpoint-discipline"])
+    assert len(rep.findings) == 1
+    assert rep.findings[0].file == FP_REL
+    assert "hbm/ghost" in rep.findings[0].message
+
+
+def test_failpoint_computed_name_flagged():
+    src = ("from tidb_tpu.util import failpoint\n"
+           "def fill(name):\n"
+           "    failpoint.eval(name)\n")
+    rep = lint({FP_REL: FP_DECL + "def fill():\n"
+                "    eval_marker = None\n",
+                STORE_REL: src}, rules=["failpoint-discipline"])
+    assert any("string literal" in f.message for f in rep.findings)
+
+
+def test_failpoint_enable_checked_and_tag_suppresses():
+    src = ("from tidb_tpu.util import failpoint\n"
+           "def arm(name):\n"
+           "    failpoint.enable('typo/name', 'raise')\n"
+           "    # lint: exempt[failpoint-discipline] dynamic admin front end\n"
+           "    failpoint.enable(name, 'raise')\n")
+    decl = FP_DECL.replace("}", "}\n") + (
+        "def seam():\n    pass\n")
+    hbm = ("from tidb_tpu.util import failpoint\n"
+           "def fill():\n"
+           "    failpoint.eval('hbm/fill')\n")
+    rep = lint({FP_REL: decl, STORE_REL: src,
+                "tidb_tpu/ops/x.py": hbm},
+               rules=["failpoint-discipline"])
+    assert len(rep.findings) == 1
+    assert "typo/name" in rep.findings[0].message

@@ -80,6 +80,36 @@ class TestProtocol:
             c.use("no_such_db")
         c.close()
 
+    def test_chaos_surfaced_errors_carry_retryable_codes(self, cli):
+        """Device-plane faults that exhaust the in-process recovery
+        chain must reach the wire as RETRYABLE codes — the contract the
+        chaos harness (docs/ROBUSTNESS.md) holds clients to. The armed
+        DispatchTimeoutError flavor skips the retry/degrade chain, so
+        exactly one statement fails with ER_DEVICE_FAULT (9009)."""
+        from tidb_tpu import config, errcode, sched
+        from tidb_tpu.util import failpoint
+        cli.query("CREATE TABLE ft (a BIGINT PRIMARY KEY, v BIGINT)")
+        cli.query("INSERT INTO ft VALUES " +
+                  ",".join(f"({i},{i % 9})" for i in range(64)))
+        old = config.get_var("tidb_tpu_device_min_rows")
+        config.set_var("tidb_tpu_device_min_rows", 1)
+        failpoint.enable(
+            "device/dispatch",
+            "1*raise(DispatchTimeoutError:device fault: injected)")
+        try:
+            with pytest.raises(MySQLError) as ei:
+                cli.query("SELECT v, COUNT(*) FROM ft GROUP BY v")
+        finally:
+            failpoint.disable("device/dispatch")
+            config.set_var("tidb_tpu_device_min_rows", old)
+            sched.device_health().note_ok()
+        assert ei.value.code == errcode.ER_DEVICE_FAULT == 9009
+        assert errcode.is_retryable(ei.value.code)
+        # the retryable contract means a verbatim replay succeeds
+        _cols, rows = cli.query(
+            "SELECT v, COUNT(*) FROM ft GROUP BY v ORDER BY v")
+        assert len(rows) == 9
+
 
 class TestConcurrency:
     def test_two_connections_txn_isolation(self, srv):

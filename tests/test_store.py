@@ -14,6 +14,7 @@ from tidb_tpu.kv import (IsolationLevel, KeyLockedError, KVError, Mutation,
 from tidb_tpu.mockstore import MVCCStore, TimeoutError_
 from tidb_tpu.store import new_mock_storage
 from tidb_tpu.store.backoff import Backoffer
+from tidb_tpu.util import failpoint
 
 
 def fastbo(ms=5000):
@@ -326,10 +327,14 @@ class TestDistributed:
                 from tidb_tpu.kv import ServerBusyError
                 raise ServerBusyError("busy")
 
-        storage.shim.inject = inject
-        # patch sleeps out of the snapshot's backoffers via short budget
-        snap = storage.snapshot(storage.current_ts())
-        assert snap.get(b"k") == b"v"
+        failpoint.enable("rpc/request", inject)
+        try:
+            # patch sleeps out of the snapshot's backoffers via short
+            # budget
+            snap = storage.snapshot(storage.current_ts())
+            assert snap.get(b"k") == b"v"
+        finally:
+            failpoint.disable("rpc/request")
         assert calls["n"] == 2
 
     def test_commit_timeout_undetermined(self, storage):
@@ -340,9 +345,12 @@ class TestDistributed:
             if cmd == "Commit":
                 raise TimeoutError_("network timeout")
 
-        storage.shim.inject = inject
-        with pytest.raises(UndeterminedError):
-            t.commit()
+        failpoint.enable("rpc/request", inject)
+        try:
+            with pytest.raises(UndeterminedError):
+                t.commit()
+        finally:
+            failpoint.disable("rpc/request")
 
     def test_concurrent_writers_one_wins(self, storage):
         t0 = storage.begin()

@@ -35,6 +35,7 @@ __all__ = ["get_var", "set_var", "all_vars", "coerce", "session_overlay",
            "sched_inflight", "sched_inflight_bytes",
            "delta_store_enabled", "delta_merge_rows",
            "delta_merge_ratio_pct",
+           "dispatch_timeout_ms", "failpoints_spec", "on_change",
            "UnknownVariableError"]
 
 
@@ -42,9 +43,10 @@ class UnknownVariableError(Exception):
     pass
 
 
-_BOOL, _INT = "bool", "int"
+_BOOL, _INT, _STR = "bool", "int", "str"
 
-# name -> (type, default). Bool vars store 0/1 like MySQL switches.
+# name -> (type, default). Bool vars store 0/1 like MySQL switches;
+# the rare _STR vars (failpoint arming) store their string verbatim.
 _DEFS: dict[str, tuple[str, int]] = {
     # master switch for single-chip device kernels; 0 = pure host numpy
     # execution everywhere (the measured CPU baseline mode of bench.py)
@@ -217,13 +219,44 @@ _DEFS: dict[str, tuple[str, int]] = {
     # merge when staged delta rows exceed this percent of the table's
     # observed cached base rows (0 = ratio trigger off)
     "tidb_tpu_delta_merge_ratio_pct": (_INT, 25),
+    # dispatch watchdog (tidb_tpu/sched.py DispatchWatchdog): a kernel
+    # finalize (or device_slot-guarded sync dispatch) that exceeds this
+    # many milliseconds cancels its statement with the RETRYABLE
+    # ER_DEVICE_FAULT (9009), releasing its scheduler slots and
+    # device-ledger bytes on the existing finally paths — a wedged
+    # device degrades to a retryable error, never a stuck server.
+    # 0 = watchdog off (the default: CPU-XLA first compiles can
+    # legitimately take tens of seconds).
+    "tidb_tpu_dispatch_timeout_ms": (_INT, 0),
+    # failpoint arming (util/failpoint.py): "name=spec;name=spec" over
+    # the declared registry, e.g. 'hbm/fill=2*raise(DeviceFaultError)'.
+    # The value is DECLARATIVE for the SET surface: writing it arms the
+    # listed points and disarms whatever a previous SET armed (env and
+    # POST /failpoint arming is unaffected). Empty = none armed via
+    # SET. GLOBAL scope only — arming is a process-wide side effect.
+    "tidb_tpu_failpoints": (_STR, ""),
 }
 
 _lock = threading.Lock()
 _vals: dict[str, int] = {}
+# name -> [fn]: set_var notifies AFTER the write, with _lock dropped
+# (hooks may read the registry); util/failpoint.py uses this to make
+# `SET GLOBAL tidb_tpu_failpoints = ...` arm the registry
+_hooks: dict[str, list] = {}        # guarded-by: _lock
+
+
+def on_change(name: str, fn) -> None:
+    """Register fn(new_value) to run after every set_var(name)."""
+    key = name.lower()
+    if key not in _DEFS:
+        raise UnknownVariableError(name)
+    with _lock:
+        _hooks.setdefault(key, []).append(fn)
 
 
 def _coerce(name: str, tp: str, value) -> int:
+    if tp == _STR:
+        return "" if value is None else str(value)
     if isinstance(value, str):
         v = value.strip().lower()
         if tp == _BOOL and v in ("on", "true"):
@@ -296,6 +329,16 @@ class session_overlay:
         return False
 
 
+# vars whose write is a process-wide side effect routed through
+# on_change hooks: session-scope SET would shadow the value on one
+# thread while arming nothing — reject it (ER_GLOBAL_VARIABLE)
+_GLOBAL_ONLY = frozenset({"tidb_tpu_failpoints"})
+
+
+def is_global_only(name: str) -> bool:
+    return name.lower() in _GLOBAL_ONLY
+
+
 def is_known(name: str) -> bool:
     return name.lower() in _DEFS
 
@@ -322,8 +365,23 @@ def set_var(name: str, value) -> None:
     tp_dflt = _DEFS.get(key)
     if tp_dflt is None:
         raise UnknownVariableError(name)
+    new = _coerce(key, tp_dflt[0], value)
     with _lock:
-        _vals[key] = _coerce(key, tp_dflt[0], value)
+        prev = _vals.get(key)
+        _vals[key] = new
+        hooks = list(_hooks.get(key, ()))
+    try:
+        for fn in hooks:
+            fn(new)
+    except Exception:
+        # a hook that rejects the value (bad failpoint spec) must not
+        # leave the registry claiming a value that never took effect;
+        # compare-and-restore so a CONCURRENT successful set_var that
+        # interleaved before this rollback is not clobbered
+        with _lock:
+            if _vals.get(key) == new:
+                _vals[key] = prev
+        raise
 
 
 def all_vars() -> dict[str, int]:
@@ -449,3 +507,11 @@ def delta_merge_rows() -> int:
 
 def delta_merge_ratio_pct() -> int:
     return max(0, _read("tidb_tpu_delta_merge_ratio_pct"))
+
+
+def dispatch_timeout_ms() -> int:
+    return max(0, _read("tidb_tpu_dispatch_timeout_ms"))
+
+
+def failpoints_spec() -> str:
+    return str(_read("tidb_tpu_failpoints") or "")

@@ -132,6 +132,14 @@ ER_REGION_STREAM_INTERRUPTED = 9007
 # nothing ran, the session and its transaction are untouched, a
 # verbatim replay after backoff is always safe
 ER_SERVER_BUSY_ADMISSION = 9008
+# device-plane fault (tidb_tpu/util/failpoint.py DeviceFaultError): a
+# kernel dispatch/finalize, HBM cache fill/patch failed or tripped the
+# dispatch watchdog (tidb_tpu_dispatch_timeout_ms). RETRYABLE — the
+# statement was cancelled before producing anything partial, its
+# scheduler slots and device-ledger bytes were released, and the
+# recovery chain (host fallback, device quarantine + re-probe) means a
+# verbatim replay lands on a working path
+ER_DEVICE_FAULT = 9009
 # commit outcome unknown (network error on the primary commit,
 # 2pc.go:421-431): NOT retryable — the write may have landed, so a
 # verbatim replay risks applying it twice
@@ -149,6 +157,7 @@ RETRYABLE = frozenset({
     ER_PD_SERVER_TIMEOUT, ER_TIKV_SERVER_TIMEOUT, ER_TIKV_SERVER_BUSY,
     ER_RESOLVE_LOCK_TIMEOUT, ER_REGION_UNAVAILABLE,
     ER_REGION_STREAM_INTERRUPTED, ER_SERVER_BUSY_ADMISSION,
+    ER_DEVICE_FAULT,
 })
 
 
@@ -258,6 +267,7 @@ _SQLSTATE = {
     ER_GC_TOO_EARLY: "HY000",
     ER_REGION_STREAM_INTERRUPTED: "HY000",
     ER_SERVER_BUSY_ADMISSION: "HY000",
+    ER_DEVICE_FAULT: "HY000",
     ER_RESULT_UNDETERMINED: "HY000",
     ER_MEM_EXCEED_QUOTA: "HY000",
 }
@@ -278,6 +288,7 @@ _PATTERNS = [
     (re.compile(r"denied", re.I), ER_TABLEACCESS_DENIED_ERROR),
     (re.compile(r"Unknown system variable|unknown variable", re.I),
      ER_UNKNOWN_SYSTEM_VARIABLE),
+    (re.compile(r"is a GLOBAL variable", re.I), ER_GLOBAL_VARIABLE),
     (re.compile(r"No database selected", re.I), ER_NO_DB_ERROR),
     (re.compile(r"parameter count|column count", re.I),
      ER_WRONG_VALUE_COUNT),
@@ -285,6 +296,10 @@ _PATTERNS = [
     # memory quota before the generic "interrupted" net: the OOM cancel
     # rides the cooperative-kill path but must keep its own code
     (re.compile(r"Out Of Memory Quota", re.I), ER_MEM_EXCEED_QUOTA),
+    # device-fault/watchdog cancels ride the same cooperative-kill path
+    # and must keep their retryable 9009 — matched before "interrupted"
+    (re.compile(r"device fault|dispatch watchdog", re.I),
+     ER_DEVICE_FAULT),
     (re.compile(r"interrupted", re.I), ER_QUERY_INTERRUPTED),
     (re.compile(r"Unknown thread id", re.I), ER_NO_SUCH_THREAD),
     (re.compile(r"incorrect value", re.I), ER_TRUNCATED_WRONG_VALUE),
@@ -311,6 +326,11 @@ def _is_sql_layer(exc: BaseException) -> bool:
 def _is_admission_reject(exc: BaseException) -> bool:
     from tidb_tpu.sched import AdmissionRejectedError
     return isinstance(exc, AdmissionRejectedError)
+
+
+def _is_device_fault(exc: BaseException) -> bool:
+    from tidb_tpu.util.failpoint import DeviceFaultError
+    return isinstance(exc, DeviceFaultError)
 
 
 def classify(exc: BaseException) -> tuple[int, str, str]:
@@ -341,6 +361,11 @@ def classify(exc: BaseException) -> tuple[int, str, str]:
         # refused BEFORE anything ran (tidb_tpu/sched.py): retryable
         # server-busy class, same contract as ER_TIKV_SERVER_BUSY
         code = ER_SERVER_BUSY_ADMISSION
+    elif _is_device_fault(exc):
+        # device-plane fault past the in-process recovery chain
+        # (retry/fallback/quarantine, tidb_tpu/sched.py): retryable —
+        # a replay lands on the host path or a re-probed device
+        code = ER_DEVICE_FAULT
     elif isinstance(exc, kv.StreamInterruptedError):
         # streamed coprocessor reply died past its resume budget: the
         # retryable region-stream class (store/stream.py subsystem)

@@ -16,6 +16,7 @@ from tidb_tpu.lint.rules import (  # noqa: F401  (import == register)
     excepts,     # bare-except
     devcache,    # device-cache
     decode,      # decode-discipline (encoded execution stays encoded)
+    failpoints,  # failpoint-discipline (fault-injection registry)
     lockorder,   # lock-order        (flow: acquisition-order cycles)
     guardedby,   # guarded-by        (flow: annotated shared state)
     pairres,     # paired-resource   (flow: consume/release, dispatch/

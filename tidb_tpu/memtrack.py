@@ -61,7 +61,7 @@ class MemTracker:
     __slots__ = ("label", "parent", "quota", "on_cancel", "_mu",
                  "host", "device", "host_peak", "device_peak",
                  "_actions", "_firing", "_cancel_msg", "_nodes",
-                 "children")
+                 "children", "fault_degraded")
 
     def __init__(self, label: str, parent: "MemTracker | None" = None,
                  quota: int = 0, on_cancel=None):
@@ -77,6 +77,9 @@ class MemTracker:
         self._actions: list = []        # guarded-by: _mu  (OOM spills)
         self._firing = False            # guarded-by: _mu
         self._cancel_msg: str | None = None   # guarded-by: _mu
+        # statement roots only: sched.degrade_statement latched this
+        # statement onto the host path after a retried device fault
+        self.fault_degraded = False
         # id(plan) -> (plan, tracker)
         self._nodes: dict[int, tuple] = {}    # guarded-by: _mu
         self.children: dict[int, "MemTracker"] = {}   # guarded-by: _mu
@@ -202,6 +205,26 @@ class MemTracker:
         finally:
             with self._mu:
                 self._firing = False
+
+    def cancel(self, msg: str) -> bool:
+        """Latch a statement cancel from OUTSIDE the quota chain — the
+        dispatch watchdog's door (tidb_tpu/sched.py): the message
+        latches exactly like a quota cancel (stragglers that later trip
+        the quota re-raise it, never re-count), and the on_cancel hook
+        fires so the session's cooperative-kill flag flips. Unlike
+        _over_quota this never raises — the caller is a monitor thread,
+        not the consuming thread. -> False when a cancel was already
+        latched."""
+        with self._mu:
+            if self._cancel_msg is not None:
+                return False
+            self._cancel_msg = msg
+        if self.on_cancel is not None:
+            try:
+                self.on_cancel(msg)
+            except Exception:  # noqa: BLE001 - monitor must survive
+                pass
+        return True
 
     def run_spill_actions(self, target: int = 0,
                           recurse: bool = False) -> int:

@@ -28,7 +28,9 @@ __all__ = ["counter", "histogram", "gauge", "expose", "snapshot",
            "CONNECTIONS_CURRENT", "ADMISSIONS", "ADMISSION_WAITS",
            "ADMISSION_QUEUE_DEPTH", "SCHED_STALLS", "SCHED_BYPASSES",
            "DELTA_ROWS", "DELTA_MERGES", "CACHE_DELTA_SERVES",
-           "BYTES_ENCODED", "BYTES_DECODED_EQUIV"]
+           "BYTES_ENCODED", "BYTES_DECODED_EQUIV",
+           "FAILPOINT_FIRES", "WORKER_RESTARTS", "DISPATCH_TIMEOUTS",
+           "DEVICE_QUARANTINES"]
 
 _lock = threading.Lock()
 _counters: dict[tuple[str, tuple], float] = {}       # guarded-by: _lock
@@ -221,6 +223,16 @@ CACHE_DELTA_SERVES = "tidb_tpu_cache_served_with_delta_total"
 # win (ROADMAP item 4)
 BYTES_ENCODED = "tidb_tpu_device_bytes_encoded_total"
 BYTES_DECODED_EQUIV = "tidb_tpu_device_bytes_decoded_equiv_total"
+# fault injection + device-plane recovery (util/failpoint.py, sched.py,
+# util/supervisor.py): armed failpoint firings (labeled {name=...}),
+# supervised background workers restarted after a crash (labeled
+# {worker=...}), dispatch-watchdog cancellations past
+# tidb_tpu_dispatch_timeout_ms, and device quarantine transitions
+# (labeled {event=quarantine|readmit})
+FAILPOINT_FIRES = "tidb_tpu_failpoint_fires_total"
+WORKER_RESTARTS = "tidb_tpu_worker_restarts_total"
+DISPATCH_TIMEOUTS = "tidb_tpu_dispatch_timeout_total"
+DEVICE_QUARANTINES = "tidb_tpu_device_quarantine_total"
 
 _HELP = {
     QUERY_DURATIONS: "Statement wall time through Session.execute.",
@@ -290,4 +302,15 @@ _HELP = {
         "(dictionary codes + validity at the padded bucket).",
     BYTES_DECODED_EQUIV:
         "Decoded-equivalent footprint of the same dispatch inputs.",
+    FAILPOINT_FIRES:
+        "Armed failpoint firings, by declared point name.",
+    WORKER_RESTARTS:
+        "Supervised background workers restarted after a crash, "
+        "by worker.",
+    DISPATCH_TIMEOUTS:
+        "Statements cancelled by the dispatch watchdog past "
+        "tidb_tpu_dispatch_timeout_ms.",
+    DEVICE_QUARANTINES:
+        "Device quarantine transitions after repeated faults, "
+        "by event (quarantine|readmit).",
 }

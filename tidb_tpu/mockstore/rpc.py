@@ -4,22 +4,26 @@ Reference: /root/reference/store/tikv/mocktikv/rpc.go:112-464 — every request
 carries a region context (id, epoch); the handler re-checks it against the
 cluster so the client's region-error retry paths (NotLeader, EpochNotMatch,
 ServerBusy) actually execute in tests. Failpoints (ref: rpc.go:465-521
-gofail sites rpcServerBusy/rpcCommitResult/rpcCommitTimeout) become the
-`inject` hook: tests set `shim.inject = fn(cmd, ctx)` to raise errors or
-simulate timeouts for specific commands.
+gofail sites rpcServerBusy/rpcCommitResult/rpcCommitTimeout) are the
+central registry's `rpc/request` point (util/failpoint.py, the successor
+of the ad-hoc `inject` attribute this shim used to carry): tests arm
+`failpoint.enable("rpc/request", fn)` with a callable receiving
+(cmd, ctx) — or a declarative spec — to raise errors or simulate
+timeouts for specific commands; every command, including the per-frame
+CopStream re-check, evaluates it.
 """
 
 from __future__ import annotations
 
 import threading
 from dataclasses import dataclass
-from typing import Callable, Optional
 
 from tidb_tpu.kv import (EpochNotMatchError, IsolationLevel, KVError,
                          Mutation, NotLeaderError, RegionError,
                          ServerBusyError, StoreUnavailableError)
 from tidb_tpu.mockstore.cluster import Cluster, Region
 from tidb_tpu.mockstore.mvcc import MVCCStore
+from tidb_tpu.util import failpoint
 
 __all__ = ["RegionCtx", "RPCShim", "TimeoutError_"]
 
@@ -43,15 +47,12 @@ class RPCShim:
     def __init__(self, cluster: Cluster, store: MVCCStore):
         self.cluster = cluster
         self.store = store
-        # test hook: fn(cmd: str, ctx: RegionCtx) -> None, may raise
-        self.inject: Optional[Callable[[str, RegionCtx], None]] = None
         self._mu = threading.Lock()
 
     # -- region checks -------------------------------------------------------
 
     def _check(self, cmd: str, ctx: RegionCtx) -> Region:
-        if self.inject is not None:
-            self.inject(cmd, ctx)
+        failpoint.eval("rpc/request", cmd, ctx)
         if not self.cluster.store_is_up(ctx.store_id):
             # the address the client dialed is dead: connection-level
             # failure (ref: region_request.go onSendFail -> retry other
@@ -194,8 +195,9 @@ class RPCShim:
     def coprocessor_stream(self, ctx: RegionCtx, req, credit=None,
                            frame_bytes=None):
         """Streaming coprocessor (ref: CmdCopStream): lazy generator of
-        StreamFrames. The region epoch (and the `inject` failpoint, cmd
-        "CopStream") is re-checked before EVERY frame delivery, so a
+        StreamFrames. The region epoch (and the `rpc/request`
+        failpoint, cmd "CopStream") is re-checked before EVERY frame
+        delivery, so a
         region split/leader change mid-stream surfaces as a mid-stream
         RegionError — the client resumes from its last acked range
         boundary (store/copr.py). `credit` is unused in-process: the

@@ -155,6 +155,7 @@ def pipeline_map(items, dispatch, finalize, depth: int,
     proceeds unscheduled, so the global window can throttle but never
     hang a statement."""
     from tidb_tpu import sched
+    from tidb_tpu.util import failpoint
     scheduler = sched.device_scheduler()
     depth = max(int(depth), 1)
     pending: deque = deque()
@@ -163,7 +164,14 @@ def pipeline_map(items, dispatch, finalize, depth: int,
     def pop_finalize():
         prev, tok, held, slot = pending.popleft()
         try:
-            return finalize(prev, tok)
+            # the watchdog bounds the blocking readback: past
+            # tidb_tpu_dispatch_timeout_ms the statement cancels with
+            # the retryable device-fault error, and the finally below
+            # (plus each kernel's own finalize-path credit) drains the
+            # slot and the staged bytes exactly as on any error
+            with sched.finalize_watch("pipeline-finalize"):
+                failpoint.eval("device/finalize")
+                return finalize(prev, tok)
         finally:
             scheduler.release(slot)
             if held:
@@ -183,8 +191,18 @@ def pipeline_map(items, dispatch, finalize, depth: int,
             if held:
                 tracker.consume(host=held)
             try:
+                failpoint.eval("device/dispatch")
                 tok = dispatch(it)
-            except BaseException:
+            except BaseException as e:
+                # executor-plane device faults feed the same health
+                # tracker as the copr sites, so repeated pipeline
+                # faults still quarantine the device — the fault
+                # itself propagates (retryable 9009 at the client;
+                # the per-dispatch retry/degrade chain lives on the
+                # copr path)
+                if isinstance(e, failpoint.DeviceFaultError) and not \
+                        isinstance(e, failpoint.DispatchTimeoutError):
+                    sched.device_health().note_fault()
                 scheduler.release(slot)
                 if held:
                     tracker.release(host=held)

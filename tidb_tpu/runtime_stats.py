@@ -275,9 +275,11 @@ def note_fallback(plan, reason: str) -> None:
     """Record one device->host fallback: counted on the operator's
     OpStats (EXPLAIN ANALYZE `pipeline` column) and on the
     tidb_tpu_device_fallback_total{op,reason} metric family. `reason`
-    is one of capacity|collision|unsupported|encoding (single-chip) or
-    mesh (a mesh stream batch served by the host) — the designed
-    fallback causes; anything else should RAISE, not fall back."""
+    is one of capacity|collision|unsupported|encoding (single-chip),
+    mesh (a mesh stream batch served by the host), or the device-fault
+    recovery pair fault|quarantine (tidb_tpu/sched.py DeviceHealth) —
+    the designed fallback causes; anything else should RAISE, not
+    fall back."""
     from tidb_tpu import metrics
     coll = getattr(_tl, "coll", None)
     name = None

@@ -57,14 +57,19 @@ every release re-evaluates). See docs/CONCURRENCY.md.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 
 from tidb_tpu import config, memtrack, metrics
+from tidb_tpu.util import failpoint
 
 __all__ = ["DeviceScheduler", "AdmissionController",
-           "AdmissionRejectedError", "device_scheduler", "admission",
-           "device_slot", "shed_server", "stats", "reset_for_tests"]
+           "AdmissionRejectedError", "DispatchWatchdog", "DeviceHealth",
+           "device_scheduler", "admission", "dispatch_watchdog",
+           "device_health", "device_slot", "finalize_watch",
+           "degrade_statement", "statement_degraded",
+           "shed_server", "stats", "reset_for_tests"]
 
 
 class AdmissionRejectedError(Exception):
@@ -390,10 +395,236 @@ class AdmissionController:
             return out
 
 
+class DispatchWatchdog:
+    """Bounded finalize: a dispatch/finalize section that runs past
+    `tidb_tpu_dispatch_timeout_ms` cancels its statement with the
+    RETRYABLE ER_DEVICE_FAULT instead of wedging the scheduler.
+
+    Two halves cooperate. A monitor thread (started lazily on the first
+    watched section, exits when idle) scans registered sections; one
+    past its deadline is marked expired, counted in
+    `tidb_tpu_dispatch_timeout_total`, and its statement's memtrack
+    root is cancel()-latched — the cooperative-kill flag flips, so a
+    statement stuck in a Python-level wait unwinds at its next
+    interrupt check with the watchdog's message (classified 9009, not
+    ER_QUERY_INTERRUPTED). The watched section itself re-checks on
+    exit: when the blocking call eventually returns past the deadline,
+    DeviceFaultError raises THERE, so the existing finally chains
+    (pipeline_map's slot/ledger releases, memtrack.device_scope)
+    drain every scheduler slot and device-ledger byte exactly as on
+    any other error path. 0 = off (the default)."""
+
+    _SLICE_S = 0.05         # monitor scan period while sections exist
+    _IDLE_S = 5.0           # idle monitor lingers this long, then dies
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._entries: dict = {}    # guarded-by: _cv  tok -> entry
+        self._seq = 0               # guarded-by: _cv
+        self._thread = None         # guarded-by: _cv
+        self._fired = 0             # guarded-by: _cv
+
+    def begin(self, label: str):
+        """-> opaque token (None when the watchdog is off)."""
+        timeout_ms = config.dispatch_timeout_ms()
+        if timeout_ms <= 0:
+            return None
+        # [deadline, label, statement root, expired]
+        ent = [time.monotonic() + timeout_ms / 1e3, label,
+               memtrack.current(), False]
+        with self._cv:
+            self._seq += 1
+            tok = self._seq
+            self._entries[tok] = ent
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._monitor, name="dispatch-watchdog",
+                    daemon=True)
+                self._thread.start()
+            self._cv.notify()
+        return (tok, ent)
+
+    def end(self, token) -> bool:
+        """Unregister; -> True when the section expired (the caller
+        raises DeviceFaultError unless an error is already unwinding)."""
+        if token is None:
+            return False
+        tok, ent = token
+        with self._cv:
+            self._entries.pop(tok, None)
+            return ent[3]
+
+    @contextlib.contextmanager
+    def watch(self, label: str = "dispatch"):
+        token = self.begin(label)
+        try:
+            yield
+        except BaseException:
+            self.end(token)     # the in-flight error wins
+            raise
+        if self.end(token):
+            raise _timeout_error(label)
+
+    def _monitor(self) -> None:
+        while True:
+            fire = []
+            with self._cv:
+                if not self._entries:
+                    self._cv.wait(timeout=self._IDLE_S)
+                    if not self._entries:
+                        # idle: exit. The slot clears UNDER _cv before
+                        # the return, so a begin() racing our unwind
+                        # cannot see a still-alive thread that will
+                        # never scan its entry — it spawns a fresh one
+                        self._thread = None
+                        return
+                now = time.monotonic()
+                for ent in self._entries.values():
+                    if not ent[3] and now >= ent[0]:
+                        ent[3] = True
+                        self._fired += 1
+                        fire.append(ent)
+                if not fire:
+                    self._cv.wait(timeout=self._SLICE_S)
+            for ent in fire:    # cancels run with _cv dropped
+                metrics.counter(metrics.DISPATCH_TIMEOUTS)
+                root = ent[2]
+                if root is not None:
+                    root.cancel(_timeout_msg(ent[1]))
+
+    def snapshot(self) -> dict:
+        with self._cv:
+            return {"watching": len(self._entries),
+                    "fired": self._fired}
+
+
+def _timeout_msg(label: str) -> str:
+    return (f"device fault: dispatch watchdog — {label} exceeded "
+            f"tidb_tpu_dispatch_timeout_ms="
+            f"{config.dispatch_timeout_ms()}ms; statement cancelled "
+            f"(retryable)")
+
+
+def _timeout_error(label: str):
+    return failpoint.DispatchTimeoutError(_timeout_msg(label))
+
+
+# device-fault recovery policy: consecutive faults before the device is
+# quarantined, and how long quarantine lasts before ONE probe dispatch
+# is let through to re-test it
+_FAULT_QUARANTINE_AFTER = 3
+_QUARANTINE_S = 1.0
+
+
+class DeviceHealth:
+    """Device-plane fault accounting + quarantine. Fault reporters:
+    the copr agg dispatch sites (store/copr.py — which also run the
+    full retry-once/degrade chain and gate on available()) and
+    pipeline_map's dispatch wrapper (ops/runtime.py — faults feed the
+    counter and propagate retryable; executor paths do not consult
+    available(), so a quarantine routes the storage-side agg volume to
+    the host while executor-plane dispatches surface 9009 to retrying
+    clients). At `_FAULT_QUARANTINE_AFTER` consecutive faults the
+    device is quarantined — HBM residency is invalidated (blocks
+    uploaded through a faulting plane are not trustworthy, and nothing
+    could consume them anyway) — until the quarantine window passes,
+    after which exactly ONE probe dispatch is admitted: success
+    readmits the device, another fault re-arms the window. Transitions
+    count in `tidb_tpu_device_quarantine_total{event}`."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._consecutive = 0       # guarded-by: _mu
+        self._quarantined = False   # guarded-by: _mu
+        self._probe_at = 0.0        # guarded-by: _mu
+        self._probing = False       # guarded-by: _mu
+        self._probe_deadline = 0.0  # guarded-by: _mu
+        self._faults = 0            # guarded-by: _mu
+        self._quarantines = 0       # guarded-by: _mu
+
+    def available(self) -> bool:
+        """May this dispatch try the device? While quarantined, only
+        the single re-probe past the window is admitted. A probe that
+        never reports back — its dispatch exited via a designed
+        rejection (capacity, unsupported) rather than success or fault
+        — would otherwise pin `_probing` forever; past the probe's own
+        deadline it counts as abandoned and the next caller probes."""
+        with self._mu:
+            if not self._quarantined:
+                return True
+            now = time.monotonic()
+            if self._probing and now < self._probe_deadline:
+                return False
+            if not self._probing and now < self._probe_at:
+                return False
+            self._probing = True    # this caller IS the probe
+            self._probe_deadline = now + _QUARANTINE_S
+            return True
+
+    def note_ok(self) -> None:
+        with self._mu:
+            self._consecutive = 0
+            readmit = self._quarantined
+            self._quarantined = False
+            self._probing = False
+        if readmit:
+            metrics.counter(metrics.DEVICE_QUARANTINES,
+                            {"event": "readmit"})
+
+    def note_fault(self) -> None:
+        quarantined = False
+        with self._mu:
+            self._consecutive += 1
+            self._faults += 1
+            if self._quarantined:
+                if self._probing:   # the probe failed: re-arm
+                    self._probing = False
+                    self._probe_at = time.monotonic() + _QUARANTINE_S
+            elif self._consecutive >= _FAULT_QUARANTINE_AFTER:
+                self._quarantined = True
+                self._probing = False
+                self._probe_at = time.monotonic() + _QUARANTINE_S
+                self._quarantines += 1
+                quarantined = True
+        if quarantined:
+            metrics.counter(metrics.DEVICE_QUARANTINES,
+                            {"event": "quarantine"})
+            # invalidate HBM residency with every lock dropped: the
+            # shed walks the cache locks, and a re-probe refills from
+            # a (possibly recovered) clean slate
+            from tidb_tpu.store import device_cache
+            device_cache.shed_all()
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            return {"quarantined": self._quarantined,
+                    "consecutive_faults": self._consecutive,
+                    "faults": self._faults,
+                    "quarantines": self._quarantines}
+
+
+def degrade_statement() -> None:
+    """Latch THIS statement onto the host path after a retried device
+    fault (the flag lives on the statement's memtrack root and dies
+    with it): one faulting statement stops paying fault+retry per
+    dispatch, while the next statement — and the quarantine re-probe —
+    still exercises the device."""
+    root = memtrack.current()
+    if root is not None:
+        root.fault_degraded = True
+
+
+def statement_degraded() -> bool:
+    root = memtrack.current()
+    return root is not None and root.fault_degraded
+
+
 # -- process singletons ------------------------------------------------------
 
 _SCHEDULER = DeviceScheduler()
 _ADMISSION = AdmissionController()
+_WATCHDOG = DispatchWatchdog()
+_HEALTH = DeviceHealth()
 
 
 def device_scheduler() -> DeviceScheduler:
@@ -404,11 +635,29 @@ def admission() -> AdmissionController:
     return _ADMISSION
 
 
+def dispatch_watchdog() -> DispatchWatchdog:
+    return _WATCHDOG
+
+
+def device_health() -> DeviceHealth:
+    return _HEALTH
+
+
 def reset_for_tests() -> None:
     """Fresh singletons (test isolation: counters and rotation state)."""
-    global _SCHEDULER, _ADMISSION
+    global _SCHEDULER, _ADMISSION, _WATCHDOG, _HEALTH
     _SCHEDULER = DeviceScheduler()
     _ADMISSION = AdmissionController()
+    _WATCHDOG = DispatchWatchdog()
+    _HEALTH = DeviceHealth()
+
+
+def finalize_watch(label: str = "finalize"):
+    """Watchdog guard for a blocking finalize (ops/runtime.pipeline_map
+    uses it around each pop_finalize): past
+    `tidb_tpu_dispatch_timeout_ms` the statement is cancelled with the
+    retryable device-fault error — see DispatchWatchdog."""
+    return _WATCHDOG.watch(label)
 
 
 class device_slot:
@@ -416,20 +665,39 @@ class device_slot:
     call — the one-shot dispatch sites' (copr scalar aggs, escalated
     retries, mesh collectives) counterpart of pipeline_map's slot per
     in-flight token. Uses the bypass valve: a sync dispatch inside
-    another statement's finalize path must throttle, never deadlock."""
+    another statement's finalize path must throttle, never deadlock.
+    The whole guarded section runs under the dispatch watchdog: a sync
+    kernel call past `tidb_tpu_dispatch_timeout_ms` surfaces the
+    retryable device-fault error AFTER the slot (and, one context
+    inward, the memtrack.device_scope ledger bytes) released."""
 
-    __slots__ = ("_slot",)
+    __slots__ = ("_slot", "_wtok")
 
     def __init__(self):
         self._slot = None
+        self._wtok = None
 
     def __enter__(self):
-        self._slot = _SCHEDULER.acquire_or_bypass()
+        self._wtok = _WATCHDOG.begin("sync-dispatch")
+        try:
+            failpoint.eval("sched/slot")
+            self._slot = _SCHEDULER.acquire_or_bypass()
+        except BaseException:
+            _WATCHDOG.end(self._wtok)
+            self._wtok = None
+            raise
         return self
 
-    def __exit__(self, *exc):
+    def __exit__(self, exc_type, exc, tb):
         _SCHEDULER.release(self._slot)
         self._slot = None
+        expired = _WATCHDOG.end(self._wtok)
+        self._wtok = None
+        if expired and exc_type is None:
+            # the watchdog fired while the kernel call blocked; now
+            # that it returned (slot + ledger already released by the
+            # finally chain), surface the cancel to the statement
+            raise _timeout_error("sync-dispatch")
         return False
 
 
@@ -444,10 +712,13 @@ def shed_server(target: int = 0) -> int:
     MVCC delta stores (store/delta.py — a forced early merge folds and
     truncates the staged journal, whose re-fills of lagging HBM blocks
     take device_slot like any other dispatch)."""
+    failpoint.eval("admission/shed")
     return memtrack.SERVER.run_spill_actions(target, recurse=True)
 
 
 def stats() -> dict:
     """Scheduler + admission snapshot (status port, bench serve block)."""
     return {"scheduler": _SCHEDULER.snapshot(),
-            "admission": _ADMISSION.snapshot()}
+            "admission": _ADMISSION.snapshot(),
+            "watchdog": _WATCHDOG.snapshot(),
+            "device_health": _HEALTH.snapshot()}

@@ -513,13 +513,20 @@ class ClientConn:
         self.pkt.write_packet(pkt)
 
     def _write_resultset(self, rs: ResultSet) -> None:
+        from tidb_tpu.util import failpoint
         self.pkt.write_packet(lenenc_int(len(rs.columns)))
         fts = getattr(rs, "field_types", None)
         for i, name in enumerate(rs.columns):
             self.pkt.write_packet(self._column_def(
                 name, fts[i] if fts else None))
         self._write_eof()
-        for row in rs.rows:
+        for n, row in enumerate(rs.rows):
+            # injectable connection teardown MID-resultset (after the
+            # header, between rows): a callable action can close the
+            # socket / raise, proving a half-shipped resultset tears
+            # the connection down without wedging the session's slots
+            # or ledgers
+            failpoint.eval("wire/resultset", self, n)
             self.pkt.write_packet(self._encode_row(row))
         self._write_eof()
 

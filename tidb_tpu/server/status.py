@@ -111,6 +111,12 @@ class _Handler(BaseHTTPRequestHandler):
                     "metrics": metrics.snapshot(),
                 })
                 return
+            if self.path == "/failpoint":
+                # the failpoint registry + armed state (POST arms)
+                from tidb_tpu.util import failpoint
+                self._json({"registry": failpoint.REGISTRY,
+                            "armed": failpoint.armed()})
+                return
             if self.path == "/shed":
                 # administrative shed hook (the KILL-style escape hatch):
                 # drives the SERVER memtrack root's registered shed chain
@@ -148,6 +154,32 @@ class _Handler(BaseHTTPRequestHandler):
             self._json({"error": str(e)}, 500)
             return
         self.send_error(404)
+
+    def do_POST(self):  # noqa: N802 - stdlib API
+        """POST /failpoint {"name": ..., "spec": ...} arms one declared
+        failpoint (util/failpoint.py); spec null/"" disarms it. The
+        HTTP face of the same registry env/SET arming drives — the
+        gofail-endpoint analogue for chaos tooling."""
+        if self.path != "/failpoint":
+            self.send_error(404)
+            return
+        from tidb_tpu.util import failpoint
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(n) or b"{}")
+            name = body["name"]
+            spec = body.get("spec")
+            if spec:
+                # lint: exempt[failpoint-discipline] HTTP front end: the name arrives off the wire and enable() itself rejects undeclared ones
+                failpoint.enable(name, spec)
+            else:
+                # lint: exempt[failpoint-discipline] HTTP front end: dynamic name, validated by the registry at runtime
+                failpoint.disable(name)
+            self._json({"ok": True, "armed": failpoint.armed()})
+        except failpoint.UnknownFailpointError as e:
+            self._json({"error": f"unknown failpoint {e}"}, 404)
+        except Exception as e:  # noqa: BLE001 - admin API reports errors
+            self._json({"error": str(e)}, 400)
 
 
 class StatusServer:

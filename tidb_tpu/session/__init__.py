@@ -202,16 +202,12 @@ class Domain:
             stop = self._schema_stop
         tick = interval if interval is not None \
             else self.SCHEMA_LEASE_MS / 2000.0
-
-        def loop():
-            while not stop.wait(tick):
-                try:
-                    self.schema_worker_tick()
-                except Exception:  # noqa: BLE001 - keep the loop alive
-                    pass
-
-        threading.Thread(target=loop, daemon=True,
-                         name="schema-worker").start()
+        # supervised (util/supervisor.py): a crashing tick is counted
+        # in tidb_tpu_worker_restarts_total{worker="schema-worker"}
+        # and backed off instead of silently swallowed
+        from tidb_tpu.util import supervisor
+        supervisor.supervise("schema-worker", self.schema_worker_tick,
+                             stop, tick)
 
     def stop_schema_worker(self) -> None:
         with self._mu:
@@ -253,16 +249,9 @@ class Domain:
             self._stats_stop = threading.Event()
             stop = self._stats_stop
 
-        def loop():
-            while not stop.wait(interval):
-                try:
-                    self.auto_analyze_tick()
-                except Exception:  # noqa: BLE001 - keep ticking
-                    pass
-
-        t = threading.Thread(target=loop, daemon=True,
-                             name="stats-auto-analyze")
-        t.start()
+        from tidb_tpu.util import supervisor
+        supervisor.supervise("stats-auto-analyze",
+                             self.auto_analyze_tick, stop, interval)
 
     def stop_stats_worker(self) -> None:
         with self._mu:
@@ -1781,6 +1770,16 @@ class Session:
                             from None
                     if getattr(a, "is_global", False):
                         config.set_var(a.name, val)
+                    elif config.is_global_only(a.name):
+                        # session-scope SET would shadow the value on
+                        # this thread while the on_change side effect
+                        # (failpoint arming) never fires — a chaos
+                        # schedule that LOOKS armed but isn't. MySQL
+                        # semantics: GLOBAL-only variables reject
+                        # session writes
+                        raise SQLError(
+                            f"Variable '{a.name}' is a GLOBAL variable "
+                            f"and should be set with SET GLOBAL")
                 if getattr(a, "is_global", False):
                     # GLOBAL never touches the session scope (MySQL)
                     self._persist_global_var(a.name.lower(), val)

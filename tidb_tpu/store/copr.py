@@ -25,9 +25,13 @@ from tidb_tpu.ops.hashagg import (CapacityError, CollisionError,
 from tidb_tpu.ops.hostagg import host_hash_agg, host_scalar_agg
 from tidb_tpu.ops.runtime import bucket_size, eval_filter_host
 from tidb_tpu.plan.physical import CopPlan
-from tidb_tpu.store.backoff import (BO_REGION_MISS, BO_SERVER_BUSY,
-                                    BO_TXN_LOCK, Backoffer, COP_MAX_BACKOFF)
+from tidb_tpu.store.backoff import (BO_REGION_MISS, BO_RPC,
+                                    BO_SERVER_BUSY, BO_TXN_LOCK,
+                                    BackoffExhausted, Backoffer,
+                                    COP_MAX_BACKOFF)
 from tidb_tpu.table import index_kvrows_to_chunk, kvrows_to_chunk
+from tidb_tpu.util import failpoint
+from tidb_tpu.util.failpoint import DeviceFaultError
 
 __all__ = ["CopClient", "cop_handler", "decode_cop_batch",
            "exec_cop_plan", "exec_cached_cop", "use_cached_path"]
@@ -163,8 +167,18 @@ def _encoded_agg(plan: CopPlan, chunk, sources: int,
             dev_cols = None
             moved = memtrack.device_put_bytes(chunk)
             nbytes = k.dispatch_nbytes(chunk)
+        failpoint.eval("device/dispatch")
         with sched.device_slot(), memtrack.device_scope(plan, nbytes):
+            failpoint.eval("device/finalize")
             res = runtime_stats.device_call(plan, k, chunk, dev_cols)
+        sched.device_health().note_ok()
+    except failpoint.DispatchTimeoutError:
+        raise       # statement already cancel-latched by the watchdog
+    except DeviceFaultError:
+        # device-plane fault: the decoded retry below owns the
+        # retry/degrade bookkeeping — just record the fault here
+        sched.device_health().note_fault()
+        return None
     except (CapacityError, CollisionError, DeviceRejectError,
             NotImplementedError):
         # the decoded retry re-runs with the ORIGINAL filter tree (the
@@ -193,10 +207,34 @@ def exec_cop_plan(plan: CopPlan, chunk, sources: int = 1,
     None = consult only, never fill (the MVCC fill conditions did not
     hold); pend_fn lets the HBM cache fold staged row deltas into the
     resident block in place (store/delta.py)."""
+    # one health-gate evaluation per call, shared by the encoded and
+    # decoded device attempts: the quarantine probe admission is a
+    # consumable token, and the fault/quarantine fallback must count
+    # once per logical dispatch, not once per attempted path
+    health_ok = None
+
+    def _health_gate() -> bool:
+        nonlocal health_ok
+        if health_ok is None:
+            if sched.statement_degraded():
+                # a retried device fault already latched this
+                # statement onto the host path
+                runtime_stats.note_fallback(plan, "fault")
+                health_ok = False
+            elif not sched.device_health().available():
+                # device quarantined after repeated faults; the host
+                # path serves until the re-probe readmits it
+                runtime_stats.note_fallback(plan, "quarantine")
+                health_ok = False
+            else:
+                health_ok = True
+        return health_ok
+
     if plan.host_filter is not None:
         if (plan.is_agg and config.encoded_exec_enabled() and
                 config.device_enabled() and
-                chunk.num_rows >= config.device_min_rows()):
+                chunk.num_rows >= config.device_min_rows() and
+                _health_gate()):
             resp = _encoded_agg(plan, chunk, sources, dev_ref)
             if resp is not None:
                 return resp
@@ -210,8 +248,10 @@ def exec_cop_plan(plan: CopPlan, chunk, sources: int = 1,
             runtime_stats.note_encoding(plan, "decoded")
     if plan.is_agg:
         use_device = (config.device_enabled() and
-                      chunk.num_rows >= config.device_min_rows())
-        if use_device:
+                      chunk.num_rows >= config.device_min_rows() and
+                      _health_gate())
+        retried = False
+        while use_device:
             try:
                 k = _agg_kernels(plan)
                 dev_cols = None
@@ -230,10 +270,16 @@ def exec_cop_plan(plan: CopPlan, chunk, sources: int = 1,
                 # the charge to the issuing reader's node. The dispatch
                 # slot puts storage-side aggs under the same global
                 # round-robin window as executor-side kernels
+                failpoint.eval("device/dispatch")
                 with sched.device_slot(), \
                         memtrack.device_scope(plan, nbytes):
+                    # the sync path's "blocking readback" seam: inside
+                    # the watchdog-guarded slot, so an armed delay here
+                    # exercises the timeout -> retryable-cancel path
+                    failpoint.eval("device/finalize")
                     res = runtime_stats.device_call(plan, k, chunk,
                                                     dev_cols)
+                sched.device_health().note_ok()
                 if plan.host_filter is None:
                     runtime_stats.note_encoding(plan, _agg_mode(plan, k))
                 runtime_stats.note_bytes_touched(
@@ -246,6 +292,27 @@ def exec_cop_plan(plan: CopPlan, chunk, sources: int = 1,
                         plan, chunk.num_rows,
                         bucket_size(max(chunk.num_rows, 1)), sources)
                 return CopResponse(chunk=res)
+            except failpoint.DispatchTimeoutError:
+                # the watchdog already cancel-latched the statement:
+                # retrying is futile, the cancel must surface
+                raise
+            except DeviceFaultError as e:
+                # device-plane fault (injected or real — HBM fill,
+                # dispatch transport): retry ONCE through the store
+                # Backoffer, then degrade this statement to the host
+                # path and let the quarantine logic decide whether the
+                # device keeps taking other statements' work
+                sched.device_health().note_fault()
+                if not retried:
+                    retried = True
+                    try:
+                        Backoffer(2_000).backoff(BO_RPC, e)
+                    except BackoffExhausted:
+                        pass
+                    continue
+                sched.degrade_statement()
+                runtime_stats.note_fallback(plan, "fault")
+                break
             except (CapacityError, CollisionError) as e:
                 if plan.group_exprs:
                     # capacity/collision miss: escalate once, then retry
@@ -258,11 +325,13 @@ def exec_cop_plan(plan: CopPlan, chunk, sources: int = 1,
                 runtime_stats.note_fallback(
                     plan, "collision" if isinstance(e, CollisionError)
                     else "capacity")
+                break
             except (DeviceRejectError, NotImplementedError):
                 # designed rejection (not device-safe). A bare
                 # ValueError is NOT caught here any more: a real kernel
                 # bug must surface, not masquerade as a capacity miss
                 runtime_stats.note_fallback(plan, "unsupported")
+                break
         runtime_stats.note_encoding(plan, "decoded")
         if plan.group_exprs:
             return CopResponse(chunk=host_hash_agg(

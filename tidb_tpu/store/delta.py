@@ -54,6 +54,7 @@ from collections import OrderedDict
 import numpy as np
 
 from tidb_tpu import config, memtrack, metrics
+from tidb_tpu.util import failpoint
 
 __all__ = ["DeltaStore", "PendingDelta", "STALE", "tracker",
            "record_handles"]
@@ -434,8 +435,15 @@ class DeltaStore:
                     trigger = "ratio"
                     break
         if trigger is not None:
-            threading.Thread(target=self.merge, args=(trigger,),
-                             name="delta-merge", daemon=True).start()
+            # supervised one-shot (util/supervisor.py): a merge that
+            # crashes (device fault mid-refill, injected delta/merge
+            # failpoint) retries with counted backoff instead of
+            # leaving the journal to grow unmerged forever
+            from tidb_tpu.util import supervisor
+            threading.Thread(
+                target=supervisor.run_once, name="delta-merge",
+                args=("delta-merge", lambda: self.merge(trigger)),
+                daemon=True).start()
 
     def merge(self, trigger: str = "rows") -> int:
         """Fold staged deltas into new base blocks and truncate the
@@ -462,6 +470,10 @@ class DeltaStore:
         return freed_rows
 
     def _merge_table(self, tid: int) -> int:
+        # injectable merge-worker crash: fires before any cache is
+        # touched, so a raise leaves serving state intact and the
+        # supervisor's retry starts from scratch
+        failpoint.eval("delta/merge", tid)
         storage = self._storage
         with self._mu:
             td = self._tables.get(tid)

@@ -57,8 +57,10 @@ from collections import OrderedDict
 import numpy as np
 
 from tidb_tpu import config, memtrack, metrics
+from tidb_tpu.util import failpoint
 
-__all__ = ["DeviceBlock", "DeviceCache", "upload_block", "tracker"]
+__all__ = ["DeviceBlock", "DeviceCache", "upload_block", "tracker",
+           "shed_all"]
 
 
 _tracker_lock = threading.Lock()
@@ -91,6 +93,15 @@ def _shed_all() -> None:
         caches = list(_caches)
     for cache in caches:
         cache.shed()
+
+
+def shed_all() -> None:
+    """Invalidate every resident block in every live cache — the
+    memtrack OOM action, and the device-quarantine path
+    (sched.DeviceHealth): blocks uploaded through a faulting device
+    plane are not trustworthy, and nothing can consume them while the
+    device is quarantined anyway."""
+    _shed_all()
 
 
 def _release_resident(resident: list) -> None:
@@ -300,6 +311,10 @@ class DeviceCache:
         upload) when the block alone would exceed the budget. The caller
         owns the MVCC fill contract (see module docstring)."""
         from tidb_tpu.ops.runtime import bucket_size
+        # injectable upload fault: a raise here (chaos arms
+        # DeviceFaultError) is a device-plane fault the dispatch
+        # site's retry/degrade/quarantine chain absorbs
+        failpoint.eval("hbm/fill")
         budget = config.device_cache_bytes()
         size = bucket_size(max(chunk.num_rows, 1))
         nbytes = memtrack.device_put_bytes(chunk, size)
@@ -356,6 +371,12 @@ class DeviceCache:
         the caller then drops it and re-fills from the merged host
         chunk. Called under _mu; the scatters are async device
         dispatches, not syncs."""
+        # injectable patch fault, fired BEFORE any state mutates (an
+        # armed raise leaves the entry exactly as it was; _mu releases
+        # on unwind). A returned sentinel simulates "unpatchable":
+        # the caller drops the block and re-fills from the host chunk
+        if failpoint.eval("hbm/patch") is not None:
+            return None
         fill_version, _fill_ts, block = ent
         dchunk = pend.decoded
         if block.handles is None or dchunk is None or \

@@ -51,6 +51,7 @@ from dataclasses import dataclass, field
 
 from tidb_tpu import config, metrics
 from tidb_tpu.kv import CopRequest, KVRange
+from tidb_tpu.util import failpoint
 
 __all__ = ["StreamFrame", "region_stream", "cop_stream_handler",
            "BoundedFrameQueue", "stream_stats", "reset_stream_stats"]
@@ -260,6 +261,11 @@ def region_stream(storage, region, req: CopRequest, frame_bytes: int):
     def emit(boundary: bytes, last: bool) -> StreamFrame:
         nonlocal pend, pend_bytes, frame_start, remaining, \
             fill_parts, fill_handles, fill_bytes, fill_billed
+        # injectable frame fault BEFORE the frame materializes: an
+        # un-emitted frame was never acked, so the client resume from
+        # its last acked range boundary loses no rows (fires on both
+        # the in-process shim path and the remote transport)
+        failpoint.eval("copr/stream-frame", region.id)
         chunk = None
         if pend:
             dec = decode_cop_batch(plan, pend)

@@ -18,8 +18,9 @@ __all__ = ["snapshot", "check", "ALLOWED_PREFIXES"]
 # deliberate long-lived loops (started once, daemon, never joined)
 ALLOWED_PREFIXES = (
     "MainThread", "pytest", "schema-worker", "stats-worker",
-    "storage-accept", "storage-conn", "status-http", "server-accept",
-    "x-server", "gc-worker", "ThreadPoolExecutor",
+    "stats-auto-analyze", "storage-accept", "storage-conn",
+    "status-http", "server-accept", "x-server", "gc-worker",
+    "ThreadPoolExecutor", "delta-merge", "dispatch-watchdog",
 )
 
 
