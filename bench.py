@@ -795,6 +795,58 @@ def _lat_summary(lat: dict) -> dict:
             for cls, xs in lat.items() if xs}
 
 
+def _trace_mark() -> int:
+    """Highest retained trace id right now (ids are monotone), so a
+    later ring_records(mark) returns only traces from the leg between."""
+    from tidb_tpu import trace
+    return max((r["trace_id"] for r in trace.ring_records()), default=0)
+
+
+def _trace_attribution(mark: int, class_digests: dict) -> dict:
+    """Per-phase latency attribution from the statement traces retained
+    since `mark` (tidb_tpu/trace.py phases_of): for each query class,
+    p50/p99 per lifecycle phase — admission wait, scheduler stall,
+    device dispatch, finalize, host-fallback, parse/plan/commit and the
+    remainder — plus the traced statement total. The direct input
+    ROADMAP item 2 needs: WHERE a p99 regression's microseconds went.
+    `class_digests` maps normalized-SQL digest -> class name; traces
+    whose digest matches no class land under "other_sql"."""
+    from tidb_tpu import trace
+    by_cls: dict = {}
+    for rec in trace.ring_records(mark):
+        cls = class_digests.get(rec["digest"], "other_sql")
+        by_cls.setdefault(cls, []).append(trace.phases_of(rec["root"]))
+    out: dict = {}
+    for cls, phs in sorted(by_cls.items()):
+        block: dict = {"traces": len(phs)}
+        phase_keys = [k for k in phs[0] if k != "total"]
+        for key in phase_keys:
+            xs = [p[key] / 1e9 for p in phs]
+            block[key] = {
+                "p50_ms": round(_percentile(xs, 50) * 1e3, 3),
+                "p99_ms": round(_percentile(xs, 99) * 1e3, 3)}
+        totals = [p["total"] / 1e9 for p in phs]
+        block["statement"] = {
+            "p50_ms": round(_percentile(totals, 50) * 1e3, 3),
+            "p99_ms": round(_percentile(totals, 99) * 1e3, 3)}
+        # two consistency views of the tail. p99_coverage sums EVERY
+        # phase incl. the "other" remainder, so it reads ~1.0 whenever
+        # the trees are balanced (per-trace phases sum to the
+        # statement total; worker overlap pushes it above 1).
+        # p99_attributed excludes "other": it is the gap detector —
+        # how much of the tail the NAMED phases explain; a low value
+        # means the time went somewhere no span covers yet.
+        p99 = block["statement"]["p99_ms"]
+        if p99 > 0:
+            block["p99_coverage"] = round(
+                sum(block[k]["p99_ms"] for k in phase_keys) / p99, 3)
+            block["p99_attributed"] = round(
+                sum(block[k]["p99_ms"] for k in phase_keys
+                    if k != "other") / p99, 3)
+        out[cls] = block
+    return out
+
+
 def _serve_bench(progress) -> dict:
     """Multi-client wire-protocol load harness (ISSUE 10 / ROADMAP item
     1's second headline series): N real MySQL connections replay a mixed
@@ -910,7 +962,11 @@ def _serve_bench(progress) -> dict:
             "rows_per_sec": round(workload_rows / ser_secs, 1),
             "latency": _lat_summary(lat_ser)}
 
-        # concurrent replay: same multiset, N wire connections
+        # concurrent replay: same multiset, N wire connections. Trace
+        # EVERY statement through the leg (tidb_tpu_trace_sample=1) so
+        # the latency_attribution block below breaks the per-class
+        # p50/p99 into lifecycle phases — the tail-latency attribution
+        # ROADMAP item 2 runs on
         progress(f"serve: concurrent replay x{n_clients}")
         sched0 = sched.stats()
         lats = [dict() for _ in range(n_clients)]
@@ -925,18 +981,31 @@ def _serve_bench(progress) -> dict:
         threads = [threading.Thread(target=worker, args=(ci,),
                                     name=f"serve-client-{ci}")
                    for ci in range(n_clients)]
-        for t in threads:
-            t.start()
-        start.wait()
-        t0 = time.perf_counter()
-        for t in threads:
-            t.join()
-        conc_secs = time.perf_counter() - t0
+        trace_mark = _trace_mark()
+        sample_prev = config.get_var("tidb_tpu_trace_sample")
+        config.set_var("tidb_tpu_trace_sample", 1)
+        try:
+            for t in threads:
+                t.start()
+            start.wait()
+            t0 = time.perf_counter()
+            for t in threads:
+                t.join()
+            conc_secs = time.perf_counter() - t0
+        finally:
+            config.set_var("tidb_tpu_trace_sample", sample_prev)
         for c in clients:
             c.close()
         errs = [e for el in errlists for e in el]
         if errs:
             raise RuntimeError(f"concurrent replay errors: {errs[:3]}")
+        class_digests = {perfschema.sql_digest(tpch.QUERIES[q])[0]: q
+                         for q in classes}
+        for cls0, sql0, _r in all_ops[0]:
+            if cls0 == "point":     # literals normalize away, so ONE
+                class_digests[perfschema.sql_digest(sql0)[0]] = "point"
+                break               # digest covers every point lookup
+        attribution = _trace_attribution(trace_mark, class_digests)
         sched1 = sched.stats()
         lat_conc: dict = {}
         for d in lats:
@@ -949,6 +1018,7 @@ def _serve_bench(progress) -> dict:
             "speedup_vs_serialized": round(
                 conc_rps / (workload_rows / ser_secs), 3),
             "latency": _lat_summary(lat_conc),
+            "latency_attribution": attribution,
             "sched_stall_seconds": round(
                 sched1["scheduler"]["stall_seconds"] -
                 sched0["scheduler"]["stall_seconds"], 4),
@@ -1048,6 +1118,167 @@ def serve_main() -> None:
     }))
 
 
+def _validate_chrome(doc: dict) -> None:
+    """Chrome trace-event schema check (the contract Perfetto /
+    chrome://tracing loads): raises on violation."""
+    evs = doc.get("traceEvents")
+    if not isinstance(evs, list) or not evs:
+        raise RuntimeError("chrome export: traceEvents missing/empty")
+    if not any(e.get("ph") == "X" for e in evs):
+        raise RuntimeError("chrome export: no complete (X) span events")
+    for e in evs:
+        if e.get("ph") not in ("X", "i", "M"):
+            raise RuntimeError(f"chrome export: bad ph in {e!r}")
+        if not isinstance(e.get("name"), str) or not \
+                isinstance(e.get("pid"), int) or not \
+                isinstance(e.get("tid"), int):
+            raise RuntimeError(f"chrome export: bad name/pid/tid {e!r}")
+        if e["ph"] in ("X", "i") and not isinstance(
+                e.get("ts"), (int, float)):
+            raise RuntimeError(f"chrome export: bad ts in {e!r}")
+        if e["ph"] == "X" and (not isinstance(e.get("dur"), (int, float))
+                               or e["dur"] < 0):
+            raise RuntimeError(f"chrome export: bad dur in {e!r}")
+
+
+def _trace_bench(progress) -> dict:
+    """Traced warm Q1 + point-lookup mix (scripts/trace_bench.sh):
+    every statement retains its tree, then the leg FAILS unless the
+    latency_attribution block is populated, every retained span tree is
+    balanced (no begin-without-end), the `TRACE FORMAT='json'` tree
+    over warm Q1 carries admission / scheduler-slot / dispatch /
+    copr-worker spans, and the Chrome export passes schema validation.
+
+    Env knobs: BENCH_TRACE_SF (0.02), BENCH_TRACE_ITERS (3),
+    BENCH_TRACE_LOOKUPS (16)."""
+    import json as _json
+
+    from tidb_tpu import config, perfschema, trace
+    from tidb_tpu.benchmarks import tpch
+    from tidb_tpu.session import Session
+    from tidb_tpu.store.storage import new_mock_storage
+
+    sf = float(os.environ.get("BENCH_TRACE_SF", "0.02"))
+    iters = int(os.environ.get("BENCH_TRACE_ITERS", "3"))
+    lookups = int(os.environ.get("BENCH_TRACE_LOOKUPS", "16"))
+
+    data = tpch.ScaledTpch(sf=sf)
+    storage = new_mock_storage()
+    session = Session(storage)
+    session.execute("CREATE DATABASE tpch_trace")
+    session.execute("USE tpch_trace")
+    progress(f"trace: loading sf={sf}")
+    tpch.load(session, storage, data, regions_per_table=2)
+    q1 = tpch.QUERIES["q1"]
+    n_orders = data.counts["orders"]
+    progress("trace: warmup (compile + cache fill)")
+    session.query(q1)
+
+    saved = {k: config.get_var(k) for k in
+             ("tidb_tpu_trace_sample", "tidb_tpu_server_mem_quota")}
+    out: dict = {"sf": sf, "iters": iters, "lookups": lookups}
+    try:
+        config.set_var("tidb_tpu_trace_sample", 1)
+        # a (generous) server quota arms admission so the admission
+        # span covers a real controller pass, not a no-op
+        config.set_var("tidb_tpu_server_mem_quota", 8 << 30)
+        mark = _trace_mark()
+        progress(f"trace: {iters} warm Q1 + {lookups} point lookups")
+        for i in range(iters):
+            session.query(q1)
+            for j in range(lookups // iters + 1):
+                k = (i * 7919 + j * 131) % n_orders
+                session.query("SELECT o_custkey, o_orderpriority FROM "
+                              f"orders WHERE o_orderkey = {k}")
+        # every retained tree must be balanced
+        records = trace.ring_records(mark)
+        unbalanced = [(r["trace_id"], p) for r in records
+                      for p in trace.validate(r["root"])]
+        if unbalanced:
+            raise RuntimeError(f"unbalanced span trees: "
+                               f"{unbalanced[:5]}")
+        out["traces"] = len(records)
+
+        # attribution must be populated with a traced device phase
+        digests = {perfschema.sql_digest(q1)[0]: "q1",
+                   perfschema.sql_digest(
+                       "SELECT o_custkey, o_orderpriority FROM orders "
+                       "WHERE o_orderkey = 0")[0]: "point"}
+        attribution = _trace_attribution(mark, digests)
+        out["latency_attribution"] = attribution
+        q1a = attribution.get("q1")
+        if not q1a or q1a["traces"] < iters:
+            raise RuntimeError(
+                f"latency_attribution unpopulated: {attribution}")
+        if q1a["statement"]["p99_ms"] <= 0 or \
+                q1a["device_dispatch"]["p99_ms"] + \
+                q1a["finalize"]["p99_ms"] + \
+                q1a["host_fallback"]["p99_ms"] <= 0:
+            raise RuntimeError(
+                f"no device/host execution phase attributed: {q1a}")
+
+        # TRACE FORMAT='json' over warm Q1: one balanced tree with the
+        # lifecycle + device-plane spans on it
+        doc = _json.loads(session.query(
+            f"TRACE FORMAT='json' {q1}").rows[0][0])
+        names: set = set()
+
+        def walk(d):
+            names.add(d["name"])
+            for c in d.get("children", ()):
+                walk(c)
+
+        walk(doc["spans"])
+        need = {"statement", "parse", "plan", "admission", "execute",
+                "sched.slot", "dispatch", "finalize"}
+        missing = need - names
+        if missing:
+            raise RuntimeError(
+                f"TRACE tree missing spans {sorted(missing)} "
+                f"(got {sorted(names)})")
+        if not ({"copr.task", "copr.stream"} & names):
+            raise RuntimeError(
+                f"TRACE tree has no copr worker spans: {sorted(names)}")
+        out["trace_stmt_spans"] = sorted(names)
+
+        # Chrome export of the TRACE'd statement passes schema checks
+        rec = trace.ring_get(doc["trace_id"])
+        if rec is None:
+            raise RuntimeError("TRACE'd statement not in the ring")
+        chrome = trace.to_chrome(rec)
+        _validate_chrome(chrome)
+        out["chrome_events"] = len(chrome["traceEvents"])
+        out["passed"] = True
+    finally:
+        for k, v in saved.items():
+            config.set_var(k, v)
+        session.close()
+        storage.close()
+    progress(f"trace: {out.get('traces', 0)} traces, "
+             f"passed={out.get('passed', False)}")
+    return out
+
+
+def trace_main() -> None:
+    """`python bench.py trace`: ONLY the traced-mix leg — the CI entry
+    point (scripts/trace_bench.sh) with its own one-line JSON."""
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        _scope_cpu_compile_cache()
+    t_start = time.perf_counter()
+
+    def progress(msg: str) -> None:
+        print(f"[trace +{time.perf_counter() - t_start:7.1f}s] {msg}",
+              file=sys.stderr, flush=True)
+
+    detail = _trace_bench(progress)
+    print(json.dumps({
+        "metric": "trace_bench_traces_retained",
+        "value": detail.get("traces", 0),
+        "unit": "traces",
+        "detail": detail,
+    }))
+
+
 def _parse_cell(x):
     if isinstance(x, (bytes, bytearray)):
         x = x.decode()
@@ -1137,7 +1368,7 @@ def _chaos_bench(progress) -> dict:
     rng = random.Random(seed)
     saved = {k: config.get_var(k) for k in
              ("tidb_tpu_dispatch_timeout_ms", "tidb_tpu_delta_merge_rows",
-              "tidb_tpu_failpoints")}
+              "tidb_tpu_failpoints", "tidb_tpu_trace_sample")}
     sched.reset_for_tests()
     storage = new_mock_storage()
     session = Session(storage)
@@ -1329,6 +1560,11 @@ def _chaos_bench(progress) -> dict:
     oom_key = 'tidb_tpu_mem_quota_exceeded_total{action="cancel"}'
     config.set_var("tidb_tpu_dispatch_timeout_ms", timeout_ms)
     config.set_var("tidb_tpu_delta_merge_rows", 64)
+    # trace 1-in-2 statements through the chaos window so the
+    # latency_attribution block can say where the fault-retry /
+    # degraded-path microseconds went (the ring keeps the newest 256)
+    config.set_var("tidb_tpu_trace_sample", 2)
+    trace_mark = _trace_mark()
     progress(f"chaos: {n_clients} clients + writer + driver for "
              f"{window}s (watchdog {timeout_ms}ms)")
     threads = [threading.Thread(target=client_worker, args=(ci,),
@@ -1354,6 +1590,13 @@ def _chaos_bench(progress) -> dict:
                 stuck.append(f"thread {t.name} did not drain")
     secs = time.perf_counter() - t0
     config.set_var("tidb_tpu_dispatch_timeout_ms", 0)
+    # attribution over the traces sampled DURING the window (before the
+    # post-chaos health queries add fault-free ones)
+    from tidb_tpu import perfschema as _ps
+    chaos_digests = {_ps.sql_digest(sql2)[0]: cls
+                     for cls, sql2 in analytics.items()}
+    chaos_digests[_ps.sql_digest(point_sql.format(k=0))[0]] = "point"
+    attribution = _trace_attribution(trace_mark, chaos_digests)
 
     # post-chaos serving health: faults disarmed, every analytic must
     # answer correctly again through a fresh connection
@@ -1425,6 +1668,7 @@ def _chaos_bench(progress) -> dict:
         "stuck_statements": stuck[:10],
         "oom_cancels": int(snap1.get(oom_key, 0) -
                            snap0.get(oom_key, 0)),
+        "latency_attribution": attribution,
         "watchdog_fires": delta_of(metrics.DISPATCH_TIMEOUTS),
         "device_fallbacks": fallbacks,
         "quarantines": delta_of(metrics.DEVICE_QUARANTINES),
@@ -1822,5 +2066,7 @@ if __name__ == "__main__":
         encoded_main()
     elif len(sys.argv) > 1 and sys.argv[1] == "chaos":
         chaos_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "trace":
+        trace_main()
     else:
         main()

@@ -596,6 +596,86 @@ def test_failpoint_computed_name_flagged():
     assert any("string literal" in f.message for f in rep.findings)
 
 
+# -- trace-names -------------------------------------------------------------
+
+TR_REL = "tidb_tpu/trace.py"
+TR_DECL = 'SPAN_NAMES = {"plan": "planning", "dispatch": "enqueue"}\n'
+
+
+def test_trace_declared_span_clean():
+    src = ("from tidb_tpu import trace\n"
+           "def f():\n"
+           "    with trace.span('plan'):\n"
+           "        pass\n"
+           "    with trace.span('dispatch'):\n"
+           "        pass\n")
+    rep = lint({TR_REL: TR_DECL, STORE_REL: src}, rules=["trace-names"])
+    assert rep.findings == []
+
+
+def test_trace_undeclared_span_flagged():
+    src = ("from tidb_tpu import trace\n"
+           "def f():\n"
+           "    with trace.span('plan'):\n"
+           "        pass\n"
+           "    with trace.span('not/declared'):\n"
+           "        pass\n"
+           "    with trace.span('dispatch'):\n"
+           "        pass\n")
+    rep = lint({TR_REL: TR_DECL, STORE_REL: src}, rules=["trace-names"])
+    assert len(rep.findings) == 1
+    assert "not/declared" in rep.findings[0].message
+
+
+def test_trace_computed_name_flagged():
+    src = ("from tidb_tpu import trace\n"
+           "def f(method):\n"
+           "    trace.begin(f'storage:{method}')\n"
+           "    with trace.span('plan'):\n"
+           "        pass\n"
+           "    with trace.span('dispatch'):\n"
+           "        pass\n")
+    rep = lint({TR_REL: TR_DECL, STORE_REL: src}, rules=["trace-names"])
+    assert any("string literal" in f.message for f in rep.findings)
+
+
+def test_trace_declared_never_opened_flagged():
+    decl = ('SPAN_NAMES = {"plan": "planning",\n'
+            '              "ghost": "nothing opens this"}\n')
+    src = ("from tidb_tpu import trace\n"
+           "def f():\n"
+           "    with trace.span('plan'):\n"
+           "        pass\n")
+    rep = lint({TR_REL: decl, STORE_REL: src}, rules=["trace-names"])
+    assert len(rep.findings) == 1
+    assert rep.findings[0].file == TR_REL
+    assert "ghost" in rep.findings[0].message
+
+
+def test_trace_span_constructor_counts_as_use():
+    # session builds its pre-closed parse span via trace.Span(...): the
+    # constructor is a site (both for literal checking and liveness)
+    decl = 'SPAN_NAMES = {"parse": "batch parse share"}\n'
+    src = ("from tidb_tpu import trace\n"
+           "def f():\n"
+           "    s = trace.Span('parse')\n"
+           "    return s\n")
+    rep = lint({TR_REL: decl, STORE_REL: src}, rules=["trace-names"])
+    assert rep.findings == []
+
+
+def test_trace_alias_receiver_and_tag_suppresses():
+    src = ("from tidb_tpu import trace as _trace\n"
+           "def f(method):\n"
+           "    _trace.begin('dispatch')\n"
+           "    # lint: exempt[trace-names] wire-data method names\n"
+           "    _trace.begin(f'storage:{method}')\n"
+           "    with _trace.span('plan'):\n"
+           "        pass\n")
+    rep = lint({TR_REL: TR_DECL, STORE_REL: src}, rules=["trace-names"])
+    assert rep.findings == []
+
+
 def test_failpoint_enable_checked_and_tag_suppresses():
     src = ("from tidb_tpu.util import failpoint\n"
            "def arm(name):\n"

@@ -36,6 +36,7 @@ __all__ = ["get_var", "set_var", "all_vars", "coerce", "session_overlay",
            "delta_store_enabled", "delta_merge_rows",
            "delta_merge_ratio_pct",
            "dispatch_timeout_ms", "failpoints_spec", "on_change",
+           "trace_sample", "slow_trace_ms",
            "UnknownVariableError"]
 
 
@@ -178,6 +179,18 @@ _DEFS: dict[str, tuple[str, int]] = {
     # emit every statement's span tree to the tidb_tpu.trace logger
     # (ref: the OpenTracing spans of session.go:692 / compiler.go:34)
     "tidb_tpu_trace_log": (_BOOL, 0),
+    # always-on statement-trace sampling (trace.py): every N-th
+    # non-internal statement retains its full span tree in the bounded
+    # server trace ring (TRACE statement / statement_traces memtable /
+    # GET /trace / Chrome export). Deterministic counter, not random —
+    # 1 retains everything, 0 disables sampling (slow-trace capture and
+    # the TRACE statement still retain).
+    "tidb_tpu_trace_sample": (_INT, 64),
+    # slow-trace capture threshold in milliseconds: any statement at or
+    # over it retains its full span tree regardless of sampling, and
+    # its trace id rides the slow log + digest summary so a digest hot
+    # spot links to a concrete timeline. 0 = off.
+    "tidb_tpu_slow_trace_ms": (_INT, 300),
     # per-statement memory quota in bytes over BOTH tracker ledgers
     # (host + device, memtrack.py; ref: the reference's mem-quota-query).
     # 0 = unlimited. Crossing it fires the OOM-action chain: registered
@@ -515,3 +528,11 @@ def dispatch_timeout_ms() -> int:
 
 def failpoints_spec() -> str:
     return str(_read("tidb_tpu_failpoints") or "")
+
+
+def trace_sample() -> int:
+    return max(0, _read("tidb_tpu_trace_sample"))
+
+
+def slow_trace_ms() -> int:
+    return max(0, _read("tidb_tpu_slow_trace_ms"))

@@ -17,6 +17,7 @@ from tidb_tpu.lint.rules import (  # noqa: F401  (import == register)
     devcache,    # device-cache
     decode,      # decode-discipline (encoded execution stays encoded)
     failpoints,  # failpoint-discipline (fault-injection registry)
+    tracenames,  # trace-names       (statement-trace span vocabulary)
     lockorder,   # lock-order        (flow: acquisition-order cycles)
     guardedby,   # guarded-by        (flow: annotated shared state)
     pairres,     # paired-resource   (flow: consume/release, dispatch/

@@ -30,7 +30,7 @@ __all__ = ["counter", "histogram", "gauge", "expose", "snapshot",
            "DELTA_ROWS", "DELTA_MERGES", "CACHE_DELTA_SERVES",
            "BYTES_ENCODED", "BYTES_DECODED_EQUIV",
            "FAILPOINT_FIRES", "WORKER_RESTARTS", "DISPATCH_TIMEOUTS",
-           "DEVICE_QUARANTINES"]
+           "DEVICE_QUARANTINES", "TRACES"]
 
 _lock = threading.Lock()
 _counters: dict[tuple[str, tuple], float] = {}       # guarded-by: _lock
@@ -233,6 +233,10 @@ FAILPOINT_FIRES = "tidb_tpu_failpoint_fires_total"
 WORKER_RESTARTS = "tidb_tpu_worker_restarts_total"
 DISPATCH_TIMEOUTS = "tidb_tpu_dispatch_timeout_total"
 DEVICE_QUARANTINES = "tidb_tpu_device_quarantine_total"
+# statement tracing (trace.py): span trees retained into the bounded
+# server trace ring, labeled by what retained them
+# (sampled|slow|forced)
+TRACES = "tidb_tpu_statement_traces_total"
 
 _HELP = {
     QUERY_DURATIONS: "Statement wall time through Session.execute.",
@@ -313,4 +317,7 @@ _HELP = {
     DEVICE_QUARANTINES:
         "Device quarantine transitions after repeated faults, "
         "by event (quarantine|readmit).",
+    TRACES:
+        "Statement traces retained into the server trace ring, "
+        "by reason (sampled|slow|forced).",
 }

@@ -47,7 +47,8 @@ import threading
 
 import numpy as np
 
-from tidb_tpu import config, memtrack, metrics, runtime_stats, sched
+from tidb_tpu import config, memtrack, metrics, runtime_stats, sched, \
+    trace
 from tidb_tpu.ops import runtime
 from tidb_tpu.ops.hashagg import (CapacityError, CollisionError,
                                   DeviceRejectError, GroupResult,
@@ -321,7 +322,11 @@ class HybridJoinBuild:
             # lint: exempt[paired-resource] ownership transfer: resident-partition bytes release on evict/spill/close
             self._node.consume(device=nbytes)
         try:
-            dev = self.kernel.prepare_build(lanes, e - s)
+            # partition upload (first touch / post-spill re-upload) is
+            # a partition phase on the statement timeline
+            with trace.span("join.partition", partition=p, upload=1,
+                            rows=e - s):
+                dev = self.kernel.prepare_build(lanes, e - s)
         except BaseException:
             if self._node is not None:
                 self._node.release(device=nbytes)
@@ -589,26 +594,33 @@ def _one_partition_agg(sub, filter_expr, group_exprs, aggs, plan,
     device still cannot serve it."""
     from tidb_tpu.ops.hostagg import host_hash_agg
     cap = _BASE_AGG_CAPACITY
-    while True:
-        try:
-            k = kernel_for(filter_expr, group_exprs, aggs, capacity=cap)
-            with sched.device_slot(), \
-                    memtrack.device_scope(plan, k.dispatch_nbytes(sub)):
-                return runtime_stats.device_call(plan, k, sub)
-        except CapacityError as e:
-            nxt = escalated_capacity(getattr(e, "needed", 0))
-            if nxt is None or nxt <= cap:
-                reason = "capacity"
+    # one partition = one span: the per-partition escalation chain is a
+    # visible phase of the statement timeline (how long each radix
+    # partition held the device, and which ones fell to the host)
+    with trace.span("join.partition", rows=sub.num_rows):
+        while True:
+            try:
+                k = kernel_for(filter_expr, group_exprs, aggs,
+                               capacity=cap)
+                with sched.device_slot(), \
+                        memtrack.device_scope(plan,
+                                              k.dispatch_nbytes(sub)):
+                    return runtime_stats.device_call(plan, k, sub)
+            except CapacityError as e:
+                nxt = escalated_capacity(getattr(e, "needed", 0))
+                if nxt is None or nxt <= cap:
+                    reason = "capacity"
+                    break
+                cap = nxt
+            except CollisionError:
+                reason = "collision"
                 break
-            cap = nxt
-        except CollisionError:
-            reason = "collision"
-            break
-        except (DeviceRejectError, NotImplementedError):
-            reason = "unsupported"
-            break
-    runtime_stats.note_fallback(plan, reason)
-    return host_hash_agg(sub, filter_expr, group_exprs, aggs)
+            except (DeviceRejectError, NotImplementedError):
+                reason = "unsupported"
+                break
+        runtime_stats.note_fallback(plan, reason)
+        with trace.span("host.fallback", rows=sub.num_rows):
+            return host_hash_agg(sub, filter_expr, group_exprs, aggs)
 
 
 def partitioned_agg(chunk, filter_expr, group_exprs, aggs, plan,

@@ -154,7 +154,7 @@ def pipeline_map(items, dispatch, finalize, depth: int,
     its fair share — and past the scheduler's bypass valve the dispatch
     proceeds unscheduled, so the global window can throttle but never
     hang a statement."""
-    from tidb_tpu import sched
+    from tidb_tpu import sched, trace
     from tidb_tpu.util import failpoint
     scheduler = sched.device_scheduler()
     depth = max(int(depth), 1)
@@ -162,7 +162,7 @@ def pipeline_map(items, dispatch, finalize, depth: int,
     track = tracker is not None and cost is not None
 
     def pop_finalize():
-        prev, tok, held, slot = pending.popleft()
+        prev, seq, tok, held, slot = pending.popleft()
         try:
             # the watchdog bounds the blocking readback: past
             # tidb_tpu_dispatch_timeout_ms the statement cancels with
@@ -171,28 +171,43 @@ def pipeline_map(items, dispatch, finalize, depth: int,
             # slot and the staged bytes exactly as on any error
             with sched.finalize_watch("pipeline-finalize"):
                 failpoint.eval("device/finalize")
-                return finalize(prev, tok)
+                # the blocking readback at the output boundary — the
+                # per-superchunk finalize serialization the Chrome
+                # export makes visible next to the dispatch-ahead lanes
+                with trace.span("finalize", superchunk=seq,
+                                host=int(tok is None)):
+                    return finalize(prev, tok)
         finally:
             scheduler.release(slot)
             if held:
                 tracker.release(host=held)
 
+    def acquire_slot(bypass: bool):
+        # the global round-robin slot wait, traced per attempt so slot
+        # stalls attribute to THIS statement's timeline
+        with trace.span("sched.slot"):
+            return scheduler.acquire_or_bypass() if bypass \
+                else scheduler.acquire()
+
+    seq = -1
     try:
         for it in items:
+            seq += 1
             while len(pending) >= depth:
                 yield pop_finalize()
-            slot = scheduler.acquire()
+            slot = acquire_slot(False)
             while slot is None and pending:
                 yield pop_finalize()
-                slot = scheduler.acquire()
+                slot = acquire_slot(False)
             if slot is None:
-                slot = scheduler.acquire_or_bypass()
+                slot = acquire_slot(True)
             held = cost(it) if track else 0
             if held:
                 tracker.consume(host=held)
             try:
                 failpoint.eval("device/dispatch")
-                tok = dispatch(it)
+                with trace.span("dispatch", superchunk=seq):
+                    tok = dispatch(it)
             except BaseException as e:
                 # executor-plane device faults feed the same health
                 # tracker as the copr sites, so repeated pipeline
@@ -212,7 +227,7 @@ def pipeline_map(items, dispatch, finalize, depth: int,
                 # slot back now instead of across its (host) finalize
                 scheduler.release(slot)
                 slot = None
-            pending.append((it, tok, held, slot))
+            pending.append((it, seq, tok, held, slot))
         while pending:
             yield pop_finalize()
     finally:
@@ -224,7 +239,7 @@ def pipeline_map(items, dispatch, finalize, depth: int,
         # each abandoned token is finalized (result discarded); a slot
         # whose finalize fails still releases its host bytes
         while pending:
-            prev, tok, held, slot = pending.popleft()
+            prev, _seq, tok, held, slot = pending.popleft()
             try:
                 finalize(prev, tok)
             except Exception:

@@ -468,6 +468,15 @@ class ExplainStmt(StmtNode):
 
 
 @dataclass
+class TraceStmt(StmtNode):
+    """TRACE [FORMAT='row'|'json'] <stmt>: execute the inner statement
+    with forced trace retention and return its span tree (ref: the
+    reference's TRACE statement over its per-statement trace trees)."""
+    stmt: StmtNode = None
+    format: str = "row"      # 'row' (indented tree rows) or 'json'
+
+
+@dataclass
 class AnalyzeStmt(StmtNode):
     tables: list = field(default_factory=list)
     index_names: Optional[list] = None   # ANALYZE ... INDEX [names]

@@ -74,6 +74,9 @@ NON_RESERVED = {
     "DISTINCTROW", "CHARSET", "LOCK", "VIEW", "JOBS", "CANCEL",
     "REPLACE", "ALGORITHM", "DEFINER", "SQL", "SECURITY", "CASCADED",
     "OPTION", "STRAIGHT_JOIN", "USING",
+    # TRACE [FORMAT='row'|'json'] <stmt> (session._exec_trace): both
+    # words stay ordinary identifiers outside that statement head
+    "TRACE", "FORMAT",
 }
 
 

@@ -144,7 +144,7 @@ class Parser:
         t = self.peek()
         if t.tp == TokenType.IDENT and \
                 t.val.upper() in ("LOAD", "SPLIT", "KILL", "DO",
-                                  "FLUSH"):
+                                  "FLUSH", "TRACE"):
             # non-reserved statement heads (see lexer.NON_RESERVED)
             head = t.val.upper()
             if head == "LOAD":
@@ -153,6 +153,8 @@ class Parser:
                 return self.split_table()
             if head == "KILL":
                 return self.kill_stmt()
+            if head == "TRACE":
+                return self.trace_stmt()
             if head == "DO":
                 self.next()
                 exprs = [self.expr()]
@@ -387,6 +389,23 @@ class Parser:
         if tok.tp != TokenType.INT:
             raise ParseError("KILL requires a connection id", tok)
         return ast.KillStmt(conn_id=int(tok.val), query_only=query_only)
+
+    def trace_stmt(self) -> ast.TraceStmt:
+        """TRACE [FORMAT = 'row'|'json'] <stmt>."""
+        self.expect_word("TRACE")
+        fmt = "row"
+        if self.try_word("FORMAT"):
+            self.expect_op("=")
+            tok = self.next()
+            if tok.tp != TokenType.STRING:
+                raise ParseError(
+                    "TRACE FORMAT takes a string literal", tok)
+            fmt = tok.val.lower()
+            if fmt not in ("row", "json"):
+                raise ParseError(
+                    f"unsupported TRACE FORMAT {tok.val!r} "
+                    f"(use 'row' or 'json')", tok)
+        return ast.TraceStmt(stmt=self.statement(), format=fmt)
 
     def split_table(self) -> ast.SplitTableStmt:
         """SPLIT TABLE t AT (v)[,(v)...] | SPLIT TABLE t REGIONS n."""

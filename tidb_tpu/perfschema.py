@@ -149,7 +149,8 @@ def digest_record(sql: str, dur_ns: int, phases: dict | None = None,
                   rows: int = 0, error: str | None = None,
                   op_stats: list[dict] | None = None,
                   mem_bytes: int = 0,
-                  tag: str | None = None) -> tuple[str, str]:
+                  tag: str | None = None,
+                  trace_id: int | None = None) -> tuple[str, str]:
     """Fold one finished statement into its digest's summary row.
     -> (digest, normalized text) so callers (slow log) can reuse them.
     `tag` disambiguates statements inside a multi-statement batch (the
@@ -171,6 +172,7 @@ def digest_record(sql: str, dur_ns: int, phases: dict | None = None,
                 "sum_parse_ns": 0, "sum_plan_ns": 0, "sum_exec_ns": 0,
                 "sum_commit_ns": 0, "sum_rows": 0, "sum_errors": 0,
                 "max_mem_bytes": 0,   # peak tracked bytes (memtrack)
+                "last_trace_id": 0,   # latest retained trace (trace.py)
                 "first_seen": now, "last_seen": now,
                 "ops": {},      # op name -> {time_ns, act_rows, device}
             }
@@ -188,6 +190,10 @@ def digest_record(sql: str, dur_ns: int, phases: dict | None = None,
             rec["sum_errors"] += 1
         if mem_bytes > rec.get("max_mem_bytes", 0):
             rec["max_mem_bytes"] = mem_bytes
+        if trace_id is not None:
+            # a digest hot spot links to its latest concrete timeline
+            # (sampled or slow-captured — trace.py retention)
+            rec["last_trace_id"] = trace_id
         rec["last_seen"] = now
         for op in op_stats or ():
             agg = rec["ops"].setdefault(
@@ -251,6 +257,7 @@ def digest_summary() -> list[dict]:
             "sum_commit_ns": r["sum_commit_ns"],
             "sum_rows": r["sum_rows"], "sum_errors": r["sum_errors"],
             "max_mem_bytes": r.get("max_mem_bytes", 0),
+            "last_trace_id": r.get("last_trace_id", 0),
             "first_seen": r["first_seen"], "last_seen": r["last_seen"],
             "top_operators": _hot_ops(r),
         })

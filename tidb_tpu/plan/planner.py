@@ -194,7 +194,8 @@ class Planner:
     # -- INFORMATION_SCHEMA virtual tables (ref: infoschema/tables.go) -------
 
     _MEMTABLES = ("schemata", "tables", "columns", "statistics",
-                  "character_sets", "collations", "memory_usage")
+                  "character_sets", "collations", "memory_usage",
+                  "statement_traces")
 
     def _build_memtable(self, ts: ast.TableSource) -> ph.PhysValues:
         """Serve catalog metadata as constant rows computed from the
@@ -298,6 +299,26 @@ class Planner:
             # bump: a cached plan would serve a frozen snapshot forever
             pv.cacheable = False
             return pv
+        if name == "statement_traces":
+            # retained statement span trees (trace.py ring): one row
+            # per trace, joinable to perfschema digests via `digest`
+            # (events_statements_summary_by_digest.last_trace_id points
+            # back here); the full tree serves on GET /trace/<id>
+            from tidb_tpu import trace as _trace
+            rows = []
+            for r in _trace.ring_snapshot():
+                rows.append((r["trace_id"], r["digest"],
+                             r["sql"][:256], int(r["start_unix"] * 1e6),
+                             r["duration_ns"], r["span_count"],
+                             r["reason"], r["error"]))
+            pv = mk([("trace_id", intf), ("digest", sf),
+                     ("sql_text", new_string_field(256)),
+                     ("start_time_us", intf), ("duration_ns", intf),
+                     ("span_count", intf), ("reason", sf),
+                     ("error", sf)], rows)
+            # the ring moves per statement with no schema-version bump
+            pv.cacheable = False
+            return pv
         if name == "collations":
             rows = [("utf8mb4_bin", "utf8mb4", 46, "", "Yes", 1),
                     ("utf8mb4_general_ci", "utf8mb4", 45, "Yes", "Yes", 1),
@@ -369,7 +390,7 @@ class Planner:
                      ("sum_plan_ns", intf), ("sum_exec_ns", intf),
                      ("sum_commit_ns", intf), ("sum_rows", intf),
                      ("sum_errors", intf), ("max_mem_bytes", intf),
-                     ("first_seen", intf),
+                     ("last_trace_id", intf), ("first_seen", intf),
                      ("last_seen", intf), ("top_operators", sf)]
         schema = PlanSchema([SchemaCol(n, alias, ft)
                              for n, ft in cols_spec])
@@ -381,7 +402,7 @@ class Planner:
                     r["sum_parse_ns"], r["sum_plan_ns"],
                     r["sum_exec_ns"], r["sum_commit_ns"], r["sum_rows"],
                     r["sum_errors"], r["max_mem_bytes"],
-                    int(r["first_seen"]),
+                    r["last_trace_id"], int(r["first_seen"]),
                     int(r["last_seen"]), r["top_operators"])
             rows.append([Constant(v, ft)
                          for v, (_n, ft) in zip(vals, cols_spec)])

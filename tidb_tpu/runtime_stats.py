@@ -415,10 +415,14 @@ def device_call(plan, fn, *args):
 
 
 @contextlib.contextmanager
-def device_section(plan):
+def device_section(plan, errors: bool = True):
     """Time a whole device region (mesh pipelines overlap async launches,
     so per-launch timing is meaningless — the region's wall time, which
-    ends on the blocking readback, is the honest number)."""
+    ends on the blocking readback, is the honest number). With
+    errors=False the section records only on SUCCESS — device_call's
+    contract, for call sites whose failures retry through an escalated
+    kernel (the failed attempt's time would double against the
+    retry's)."""
     coll = getattr(_tl, "coll", None)
     if coll is None or not coll.device:
         yield
@@ -426,8 +430,11 @@ def device_section(plan):
     t0 = time.perf_counter_ns()
     try:
         yield
-    finally:
-        coll.note_device(plan, time.perf_counter_ns() - t0)
+    except BaseException:
+        if errors:
+            coll.note_device(plan, time.perf_counter_ns() - t0)
+        raise
+    coll.note_device(plan, time.perf_counter_ns() - t0)
 
 
 # -- rendering helpers ------------------------------------------------------

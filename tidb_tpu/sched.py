@@ -61,7 +61,7 @@ import contextlib
 import threading
 import time
 
-from tidb_tpu import config, memtrack, metrics
+from tidb_tpu import config, memtrack, metrics, trace
 from tidb_tpu.util import failpoint
 
 __all__ = ["DeviceScheduler", "AdmissionController",
@@ -463,6 +463,7 @@ class DispatchWatchdog:
             self.end(token)     # the in-flight error wins
             raise
         if self.end(token):
+            trace.event("watchdog.fired", label=label)
             raise _timeout_error(label)
 
     def _monitor(self) -> None:
@@ -570,8 +571,10 @@ class DeviceHealth:
         if readmit:
             metrics.counter(metrics.DEVICE_QUARANTINES,
                             {"event": "readmit"})
+            trace.event("device.readmit")
 
     def note_fault(self) -> None:
+        trace.event("device.fault")
         quarantined = False
         with self._mu:
             self._consecutive += 1
@@ -589,6 +592,7 @@ class DeviceHealth:
         if quarantined:
             metrics.counter(metrics.DEVICE_QUARANTINES,
                             {"event": "quarantine"})
+            trace.event("device.quarantine")
             # invalidate HBM residency with every lock dropped: the
             # shed walks the cache locks, and a re-probe refills from
             # a (possibly recovered) clean slate
@@ -612,6 +616,7 @@ def degrade_statement() -> None:
     root = memtrack.current()
     if root is not None:
         root.fault_degraded = True
+        trace.event("device.degrade")
 
 
 def statement_degraded() -> bool:
@@ -681,7 +686,10 @@ class device_slot:
         self._wtok = _WATCHDOG.begin("sync-dispatch")
         try:
             failpoint.eval("sched/slot")
-            self._slot = _SCHEDULER.acquire_or_bypass()
+            # the slot WAIT is a statement-trace phase of its own: the
+            # span covers only the acquire, not the guarded dispatch
+            with trace.span("sched.slot", sync=1):
+                self._slot = _SCHEDULER.acquire_or_bypass()
         except BaseException:
             _WATCHDOG.end(self._wtok)
             self._wtok = None
@@ -697,6 +705,7 @@ class device_slot:
             # the watchdog fired while the kernel call blocked; now
             # that it returned (slot + ledger already released by the
             # finally chain), surface the cancel to the statement
+            trace.event("watchdog.fired", label="sync-dispatch")
             raise _timeout_error("sync-dispatch")
         return False
 

@@ -117,6 +117,31 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json({"registry": failpoint.REGISTRY,
                             "armed": failpoint.armed()})
                 return
+            if parts and parts[0] == "trace":
+                # retained statement traces (tidb_tpu/trace.py ring):
+                # /trace lists summaries, /trace/<id> serves the full
+                # span tree, /trace/<id>/chrome the trace-event JSON
+                # for Perfetto / chrome://tracing
+                from tidb_tpu import trace
+                if len(parts) == 1:
+                    self._json({"ring": trace.ring_stats(),
+                                "traces": trace.ring_snapshot()})
+                    return
+                rec = trace.ring_get(int(parts[1]))
+                if rec is None:
+                    self._json({"error": f"no trace {parts[1]} "
+                                         f"(evicted or never retained)"},
+                               404)
+                    return
+                if len(parts) == 3 and parts[2] == "chrome":
+                    self._json(trace.to_chrome(rec))
+                    return
+                self._json({"trace_id": rec["trace_id"],
+                            "sql": rec["sql"], "digest": rec["digest"],
+                            "duration_ns": rec["duration_ns"],
+                            "reason": rec["reason"],
+                            "spans": trace.tree(rec["root"])})
+                return
             if self.path == "/shed":
                 # administrative shed hook (the KILL-style escape hatch):
                 # drives the SERVER memtrack root's registered shed chain

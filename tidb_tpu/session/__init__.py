@@ -46,7 +46,7 @@ _SESSIONS: "weakref.WeakSet[Session]" = weakref.WeakSet()
 _ADMISSION_STMTS = (ast.SelectStmt, ast.UnionStmt, ast.InsertStmt,
                     ast.UpdateStmt, ast.DeleteStmt, ast.LoadDataStmt,
                     ast.AnalyzeStmt, ast.ExplainStmt, ast.ExecuteStmt,
-                    ast.DoStmt)
+                    ast.DoStmt, ast.TraceStmt)
 
 
 def _needs_admission(stmt) -> bool:
@@ -425,9 +425,21 @@ class Session:
             self._warnings = []
         kind = type(stmt).__name__.removesuffix("Stmt").lower()
         ev = perfschema.stmt_begin(self.session_id, sql)
-        root = trace.begin("statement", type=kind)
         overlay = {k: v for k, v in self.sys_vars.items()
                    if config.is_known(k)}
+        # the sampling decision happens at begin: install the overlay
+        # around it so a session-scope SET tidb_tpu_trace_sample is
+        # honored (like every other session-shadowed knob). Only when
+        # the session actually shadows something — the common empty
+        # case must not pay a second overlay install per statement
+        if overlay:
+            with config.session_overlay(overlay):
+                root = trace.begin("statement", type=kind)
+        else:
+            root = trace.begin("statement", type=kind)
+        if isinstance(stmt, ast.TraceStmt):
+            # TRACE forces retention; _exec_trace reads the live tree
+            root.forced = True
         # parse happened batch-wide before dispatch: record this
         # statement's share as a pre-closed phase span, and back-date the
         # root so timer_wait covers it (phases must sum <= total)
@@ -468,9 +480,13 @@ class Session:
                 mt.quota = config.mem_quota_query()   # session-shadowed
                 try:
                     if _needs_admission(stmt):
-                        admission_ticket = adm.admit(
-                            projected=perfschema.digest_max_mem(sql),
-                            label=f"session-{self.session_id}")
+                        # the admission wait is the first thing tail
+                        # latency hides behind on a busy server: a span
+                        # makes it attributable per statement
+                        with trace.span("admission"):
+                            admission_ticket = adm.admit(
+                                projected=perfschema.digest_max_mem(sql),
+                                label=f"session-{self.session_id}")
                     with memtrack.tracking(mt):
                         res = self._run_stmt(stmt, sql_text=sql_text)
                 except memtrack.QuotaExceededError as e:
@@ -493,8 +509,11 @@ class Session:
                     raise
                 finally:
                     # effective (session-shadowed) slow-log/trace knobs
+                    # — captured INSIDE the overlay because the outer
+                    # finally below runs after it has exited
                     slow_ms = config.get_var("tidb_tpu_slow_query_ms")
                     trace_on = config.get_var("tidb_tpu_trace_log")
+                    slow_trace = config.get_var("tidb_tpu_slow_trace_ms")
         except Exception as e:
             metrics.counter(metrics.QUERY_ERRORS)
             err = str(e)
@@ -523,12 +542,18 @@ class Session:
                       "plan": trace.phase_ns(root, "plan"),
                       "exec": trace.phase_ns(root, "execute"),
                       "commit": trace.phase_ns(root, "commit")}
+            # sampled / slow / TRACE-forced trees retain into the
+            # server trace ring; the id links the digest summary and
+            # the slow log to the concrete timeline
+            trace_id = trace.finish_statement(root, sql, error=err,
+                                              slow_ms=slow_trace)
             digest, _norm = perfschema.digest_record(
                 sql, int(dur * 1e9), phases=phases, rows=nrows,
                 error=err, op_stats=[s.to_dict() for s in ops],
                 mem_bytes=mt.host_peak + mt.device_peak,
                 tag=None if batch_no is None
-                else f"stmt#{batch_no}:{kind}")
+                else f"stmt#{batch_no}:{kind}",
+                trace_id=trace_id)
             for s in ops:
                 if not s.loops:
                     continue   # operator never produced (cached sub-plan)
@@ -563,7 +588,8 @@ class Session:
                 metrics.counter(metrics.SLOW_QUERIES)
                 slow_log.warning(
                     "%s", self._slow_log_record(sql, dur, digest, ops,
-                                                err, mem=mt))
+                                                err, mem=mt,
+                                                trace_id=trace_id))
             # release the executed plan tree: an idle pooled session
             # must not pin a multi-MB INSERT's literal plan (the sealed
             # collector keeps only name+number OpStats for bench)
@@ -579,7 +605,8 @@ class Session:
         return res
 
     def _slow_log_record(self, sql: str, dur: float, digest: str,
-                         ops, err: str | None, mem=None) -> str:
+                         ops, err: str | None, mem=None,
+                         trace_id=None) -> str:
         """Structured slow-log record: digest, executed plan, and
         per-operator stats ride with the SQL (ref: the reference's
         multi-line slow log, executor/adapter.go:353 +
@@ -588,6 +615,10 @@ class Session:
         lines = [f"slow query: {dur:.3f}s user={self.user} "
                  f"db={self.current_db} digest={digest}"
                  + (" error=1" if err else "")]
+        if trace_id is not None:
+            # the captured slow trace: fetch the timeline via
+            # GET /trace/<id> or information_schema.statement_traces
+            lines.append(f"# Trace_id: {trace_id}")
         if mem is not None:
             lines.append(
                 f"# Mem: {rs.fmt_bytes(mem.host_peak + mem.device_peak)}"
@@ -836,6 +867,8 @@ class Session:
             return self._exec_dml(stmt)
         if isinstance(stmt, ast.SplitTableStmt):
             return self._exec_split_table(stmt)
+        if isinstance(stmt, ast.TraceStmt):
+            return self._exec_trace(stmt)
         if isinstance(stmt, ast.KillStmt):
             return self._exec_kill(stmt)
         if isinstance(stmt, ast.DoStmt):
@@ -1705,6 +1738,50 @@ class Session:
                 except Exception:     # noqa: BLE001
                     pass
         return None
+
+    # -- TRACE (ref: the reference's TRACE statement rendering its
+    # per-statement span tree, executor/trace.go) ----------------------------
+
+    def _exec_trace(self, stmt: ast.TraceStmt) -> ResultSet:
+        """Execute the inner statement under THIS statement's (forced)
+        trace root — admission, scheduler-slot, dispatch/finalize and
+        worker spans all land on one tree — then render that tree: row
+        form is the operator-facing indented table, json form one
+        document (also retained in the ring under the returned
+        trace_id, so GET /trace/<id> serves the same tree)."""
+        from tidb_tpu import trace
+        inner = stmt.stmt
+        if isinstance(inner, ast.TraceStmt):
+            raise SQLError("TRACE statements cannot nest")
+        self._run_stmt(inner)    # result discarded: the tree IS the output
+        root = trace.current_root()
+        if root is None:
+            raise SQLError("TRACE: no statement trace is active")
+        tid = trace.ensure_id(root)
+        snap = trace.tree(root)
+        if stmt.format == "json":
+            import json as _json
+            return ResultSet(
+                ["trace"],
+                [(_json.dumps({"trace_id": tid, "spans": snap}),)])
+        rows: list[tuple] = []
+
+        def walk(d: dict, depth: int) -> None:
+            op = "  " * depth + d["name"]
+            tags = d.get("tags")
+            if tags:
+                op += " " + " ".join(f"{k}={v}" for k, v in
+                                     sorted(tags.items()))
+            rows.append((op, f"{d['start_us'] / 1e3:.3f}ms",
+                         f"{d['duration_us'] / 1e3:.3f}ms"))
+            for ev in d.get("events", ()):
+                rows.append(("  " * (depth + 1) + "! " + ev["name"],
+                             f"{ev['at_us'] / 1e3:.3f}ms", "-"))
+            for c in d.get("children", ()):
+                walk(c, depth + 1)
+
+        walk(snap, 0)
+        return ResultSet(["operation", "start", "duration"], rows)
 
     # -- SPLIT TABLE (ref: store/tikv/split_region.go:29; mocktikv
     # cluster.go:276 Split/SplitTable) ---------------------------------------

@@ -15,7 +15,8 @@ import threading
 from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 
-from tidb_tpu import config, kv, memtrack, runtime_stats, sched, tablecodec
+from tidb_tpu import (config, kv, memtrack, runtime_stats, sched,
+                      tablecodec, trace)
 from tidb_tpu.kv import (CopRequest, CopResponse, KVRange, NotLeaderError,
                          RegionError, ReqType, ServerBusyError,
                          KeyLockedError)
@@ -169,8 +170,17 @@ def _encoded_agg(plan: CopPlan, chunk, sources: int,
             nbytes = k.dispatch_nbytes(chunk)
         failpoint.eval("device/dispatch")
         with sched.device_slot(), memtrack.device_scope(plan, nbytes):
-            failpoint.eval("device/finalize")
-            res = runtime_stats.device_call(plan, k, chunk, dev_cols)
+            # split spans on the sync path too: the async enqueue
+            # (pad/transfer/jit dispatch) vs the blocking readback —
+            # the same per-superchunk pair the pipelined paths record.
+            # Device timing covers BOTH halves, success-only — exactly
+            # the interval device_call used to measure here
+            with runtime_stats.device_section(plan, errors=False):
+                with trace.span("dispatch", rows=chunk.num_rows):
+                    pending = k.dispatch(chunk, dev_cols=dev_cols)
+                failpoint.eval("device/finalize")
+                with trace.span("finalize"):
+                    res = k.finalize(chunk, pending)
         sched.device_health().note_ok()
     except failpoint.DispatchTimeoutError:
         raise       # statement already cancel-latched by the watchdog
@@ -272,13 +282,17 @@ def exec_cop_plan(plan: CopPlan, chunk, sources: int = 1,
                 # round-robin window as executor-side kernels
                 failpoint.eval("device/dispatch")
                 with sched.device_slot(), \
-                        memtrack.device_scope(plan, nbytes):
+                        memtrack.device_scope(plan, nbytes), \
+                        runtime_stats.device_section(plan,
+                                                     errors=False):
+                    with trace.span("dispatch", rows=chunk.num_rows):
+                        pending = k.dispatch(chunk, dev_cols=dev_cols)
                     # the sync path's "blocking readback" seam: inside
                     # the watchdog-guarded slot, so an armed delay here
                     # exercises the timeout -> retryable-cancel path
                     failpoint.eval("device/finalize")
-                    res = runtime_stats.device_call(plan, k, chunk,
-                                                    dev_cols)
+                    with trace.span("finalize"):
+                        res = k.finalize(chunk, pending)
                 sched.device_health().note_ok()
                 if plan.host_filter is None:
                     runtime_stats.note_encoding(plan, _agg_mode(plan, k))
@@ -305,6 +319,7 @@ def exec_cop_plan(plan: CopPlan, chunk, sources: int = 1,
                 sched.device_health().note_fault()
                 if not retried:
                     retried = True
+                    trace.event("device.retry")
                     try:
                         Backoffer(2_000).backoff(BO_RPC, e)
                     except BackoffExhausted:
@@ -333,11 +348,15 @@ def exec_cop_plan(plan: CopPlan, chunk, sources: int = 1,
                 runtime_stats.note_fallback(plan, "unsupported")
                 break
         runtime_stats.note_encoding(plan, "decoded")
-        if plan.group_exprs:
-            return CopResponse(chunk=host_hash_agg(
-                chunk, plan.filter, plan.group_exprs, plan.aggs))
-        return CopResponse(chunk=host_scalar_agg(
-            chunk, plan.filter, plan.aggs))
+        # host-path agg time is its own attribution phase: with the
+        # device degraded/quarantined (or plain host mode) THIS is
+        # where the statement's microseconds go
+        with trace.span("host.fallback", rows=chunk.num_rows):
+            if plan.group_exprs:
+                return CopResponse(chunk=host_hash_agg(
+                    chunk, plan.filter, plan.group_exprs, plan.aggs))
+            return CopResponse(chunk=host_scalar_agg(
+                chunk, plan.filter, plan.aggs))
     if plan.filter is not None:
         mask = eval_filter_host(plan.filter, chunk)
         chunk = chunk.filter(mask)
@@ -428,8 +447,9 @@ def _cached_range_chunk(storage, region: Region, plan: CopPlan, s: bytes,
                 cache.drop(key, if_chunk=hit[1])
                 hit = None
             elif pend is not None:
-                merged = dstore.patch_chunk(cache, key, plan, hit[1],
-                                            pend)
+                with trace.span("delta.fold", rows=hit[1].num_rows):
+                    merged = dstore.patch_chunk(cache, key, plan,
+                                                hit[1], pend)
                 if merged is None:
                     cache.drop(key, if_chunk=hit[1])
                     hit = None
@@ -676,20 +696,26 @@ class CopClient(kv.Client):
         # the session's sysvar overlay is thread-local: capture it here
         # and re-install inside every pool worker so per-session knobs
         # (device on/off, cache) apply uniformly across the fan-out —
-        # the runtime-stats collector AND the memory tracker ride along
-        # the same way, so storage-side device kernels attribute their
-        # time and bytes to the reader node that issued them
+        # the runtime-stats collector, the memory tracker AND the
+        # statement trace ride along the same way, so storage-side
+        # device kernels attribute their time, bytes and spans to the
+        # reader node that issued them
         overlay = config.current_overlay()
         mem_root = memtrack.current()
+        tspan = trace.propagate()
 
         def run_task(rq, rng):
             with config.session_overlay(overlay), \
                     runtime_stats.collecting(coll), \
-                    memtrack.tracking(mem_root):
-                return list(self._run_task(rq, rng))
+                    memtrack.tracking(mem_root), \
+                    trace.attached(tspan):
+                with trace.span("copr.task"):
+                    return list(self._run_task(rq, rng))
         if concurrency <= 1 or len(tasks) == 1:
             for loc, rng in tasks:
-                yield from self._run_task(req, rng)
+                with trace.span("copr.task"):
+                    out = self._run_task(req, rng)
+                yield from out
             return
         results: "queue.Queue" = queue.Queue()
         done = object()
@@ -698,9 +724,12 @@ class CopClient(kv.Client):
             try:
                 with config.session_overlay(overlay), \
                         runtime_stats.collecting(coll), \
-                        memtrack.tracking(mem_root):
+                        memtrack.tracking(mem_root), \
+                        trace.attached(tspan):
                     for _loc, rng in task_list:
-                        for resp in self._run_task(req, rng):
+                        with trace.span("copr.task"):
+                            out = self._run_task(req, rng)
+                        for resp in out:
                             results.put(resp)
                 results.put(done)
             except Exception as exc:  # noqa: BLE001
@@ -796,7 +825,6 @@ class CopClient(kv.Client):
         BoundedFrameQueue sized to the credit window, so producers
         block (credit stall) instead of buffering when the consumer is
         slow."""
-        from tidb_tpu import trace
         from tidb_tpu.store.stream import BoundedFrameQueue
 
         credit = config.copr_stream_credit()
@@ -830,13 +858,16 @@ class CopClient(kv.Client):
         overlay = config.current_overlay()
         coll = runtime_stats.current()
         mem_root = memtrack.current()
+        tspan = trace.propagate()
         buckets = [tasks[i::concurrency] for i in range(concurrency)]
 
         def worker(task_list):
             try:
                 with config.session_overlay(overlay), \
                         runtime_stats.collecting(coll), \
-                        memtrack.tracking(mem_root):
+                        memtrack.tracking(mem_root), \
+                        trace.attached(tspan), \
+                        trace.span("copr.stream", tasks=len(task_list)):
                     for _loc, rng in task_list:
                         for resp in self._run_task_stream(
                                 req, rng, new_counter()):
@@ -877,6 +908,7 @@ class CopClient(kv.Client):
         overlay = config.current_overlay()
         coll = runtime_stats.current()
         mem_root = memtrack.current()
+        tspan = trace.propagate()
         pool = ThreadPoolExecutor(max_workers=concurrency,
                                   thread_name_prefix="cop-stream-ord")
 
@@ -887,7 +919,9 @@ class CopClient(kv.Client):
                 try:
                     with config.session_overlay(overlay), \
                             runtime_stats.collecting(coll), \
-                            memtrack.tracking(mem_root):
+                            memtrack.tracking(mem_root), \
+                            trace.attached(tspan), \
+                            trace.span("copr.stream"):
                         for resp in self._run_task_stream(
                                 req, rng, new_counter()):
                             if not q.put(resp):

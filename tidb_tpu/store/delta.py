@@ -452,6 +452,7 @@ class DeltaStore:
         memoized base⋈delta results, HBM refills take a scheduler
         dispatch slot each, and readers racing the truncation get
         STALE -> re-scan."""
+        from tidb_tpu import trace
         with self._mu:
             if self._merging:
                 return 0
@@ -459,8 +460,12 @@ class DeltaStore:
             tids = list(self._tables)
         freed_rows = 0
         try:
-            for tid in tids:
-                freed_rows += self._merge_table(tid)
+            # background merges run untraced; a SHED-forced merge fires
+            # on the admitting statement's thread, where this span puts
+            # the fold cost on that statement's timeline
+            with trace.span("delta.merge", trigger=trigger):
+                for tid in tids:
+                    freed_rows += self._merge_table(tid)
         finally:
             with self._mu:
                 self._merging = False

@@ -56,7 +56,7 @@ from collections import OrderedDict
 
 import numpy as np
 
-from tidb_tpu import config, memtrack, metrics
+from tidb_tpu import config, memtrack, metrics, trace
 from tidb_tpu.util import failpoint
 
 __all__ = ["DeviceBlock", "DeviceCache", "upload_block", "tracker",
@@ -286,7 +286,9 @@ class DeviceCache:
                 if ent2 is None or ent2[2] is not block or \
                         ent2[1] != fill_ts:
                     continue    # raced with another patch: re-evaluate
-                patched = self._patch_locked(key, ent2, pend)
+                with trace.span("hbm.patch",
+                                rows=len(pend.upsert_handles)):
+                    patched = self._patch_locked(key, ent2, pend)
             if patched is not None:
                 metrics.counter(metrics.HBM_CACHE_HITS)
                 self._settle()
@@ -320,7 +322,8 @@ class DeviceCache:
         nbytes = memtrack.device_put_bytes(chunk, size)
         if nbytes > budget:
             return None
-        cols, dicts = upload_block(chunk, size)
+        with trace.span("hbm.fill", rows=chunk.num_rows, bytes=nbytes):
+            cols, dicts = upload_block(chunk, size)
         block = DeviceBlock(cols, dicts, chunk.num_rows, size, nbytes,
                             handles=getattr(chunk, "_scan_handles",
                                             None))

@@ -501,6 +501,7 @@ class StorageServer:
                         # local root and ship the finished tree back for
                         # the client to graft into its statement trace
                         from tidb_tpu import trace
+                        # lint: exempt[trace-names] cross-process storage root: the method name is wire data; these roots graft via attach_remote, never into the statement ring
                         root = trace.begin(f"storage:{method}")
                         try:
                             result = self._serve_call(method, args,

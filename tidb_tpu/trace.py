@@ -1,17 +1,43 @@
-"""Lightweight statement tracing: span trees per statement.
+"""Statement tracing: lifecycle span trees, sampling, slow-trace
+capture, and the device-timeline export.
 
 Reference: the OpenTracing spans threaded through the reference stack —
 dispatch (server/conn.go:559), session.Execute (session.go:692), Compile
 (executor/compiler.go:34), runStmt (tidb.go:156), TSO wait
-(session.go:1198-1206). Here spans are in-process structures: each
-non-internal statement runs under a root span, phases annotate
-themselves via the `span()` context manager, and the finished tree feeds
-PERFORMANCE_SCHEMA statement events (perfschema.py) and, when
-tidb_tpu_trace_log is on, the log.
+(session.go:1198-1206) — and the F1/Spanner practice of making the
+per-request trace tree the primary tool for debugging a distributed SQL
+path. Here spans are in-process structures: each non-internal statement
+runs under a root span, every subsystem annotates itself via the
+`span()` context manager (session phases, admission wait, scheduler
+slot waits, per-superchunk dispatch/finalize, coprocessor pool and
+stream workers, HBM fill/patch, delta fold/merge, hybrid-join partition
+chains), and device-plane recovery transitions (fault retry, degrade,
+quarantine, watchdog) land as point EVENTS on the span they interrupted.
 
-Thread-local: spans opened on worker threads attach to nothing rather
-than corrupting another statement's tree (the coprocessor fan-out's
-per-task work is aggregated by its dispatching span instead)."""
+Retention: every statement gets a tree (perfschema's phase breakdown
+reads it), but only some trees are RETAINED into the bounded server
+ring (`tidb_tpu/trace.py:_Ring`) that the `TRACE` statement,
+`information_schema.statement_traces`, `GET /trace` and the Chrome
+trace-event export serve:
+
+  * 1-in-N deterministic sampling (`tidb_tpu_trace_sample`, always on);
+  * threshold capture (`tidb_tpu_slow_trace_ms`: any statement over the
+    threshold keeps its full tree — the slow log and the digest summary
+    carry the trace id, so a digest hot spot links to a timeline);
+  * the `TRACE <stmt>` statement forces retention.
+
+The ring is billed to a `trace-ring` memtrack SERVER node with a
+registered shed action, so admission shedding and `GET /shed` reclaim
+retained trees like any other server-scope residency.
+
+Cross-thread propagation follows the house pattern (the runtime_stats
+collector and the memtrack tracker): the coprocessor fan-out captures
+the dispatching span with `propagate()` and re-installs it inside every
+pool/stream worker with `attached()`, so storage-side spans hang off
+the reader that issued them. Span names at `trace.begin`/`trace.span`
+call sites are literals declared in SPAN_NAMES (lint rule
+`trace-names`), the same registry discipline metric names and
+failpoints already follow."""
 
 from __future__ import annotations
 
@@ -20,15 +46,63 @@ import logging
 import threading
 import time
 
-__all__ = ["begin", "end", "span", "annotate", "current_root", "phase_ns"]
+__all__ = ["Span", "SPAN_NAMES", "begin", "end", "span", "event",
+           "annotate", "current_root", "active", "detach", "restore",
+           "attached", "propagate", "attach_remote", "phase_ns",
+           "log_tree", "ensure_id", "finish_statement", "tree",
+           "validate", "phases_of", "ring_snapshot", "ring_records",
+           "ring_get", "to_chrome", "reset_for_tests"]
 
 log = logging.getLogger("tidb_tpu.trace")
 
 _tl = threading.local()
 
+# declared span vocabulary: every trace.begin / trace.span call site in
+# the package names one of these, as a string literal (lint rule
+# trace-names — tidb_tpu/lint/rules/tracenames.py). One table so the
+# docs (docs/OBSERVABILITY.md), the Chrome export and the bench
+# attribution all read the same names.
+SPAN_NAMES = {
+    # statement lifecycle (session/__init__.py)
+    "statement": "root of one non-internal statement execution",
+    "parse": "this statement's share of the batch parse",
+    "plan": "logical+physical planning (plan-cache miss)",
+    "execute": "executor tree drive, operator output boundary to rows",
+    "commit": "2PC commit incl. optimistic replay retries",
+    "admission": "wait in the server admission controller",
+    # device plane (sched.py, ops/runtime.py, store/copr.py)
+    "sched.slot": "wait for a global device dispatch slot",
+    "dispatch": "kernel dispatch: pad/transfer/async enqueue",
+    "finalize": "blocking device readback at the output boundary",
+    "host.fallback": "host-path aggregation of device-planned work",
+    # coprocessor fan-out (store/copr.py)
+    "copr.task": "one region task on a coprocessor pool worker",
+    "copr.stream": "one streaming fan-out worker's frame production",
+    # storage-side caches and deltas (store/device_cache.py, delta.py)
+    "hbm.fill": "HBM region-block cache upload",
+    "hbm.patch": "in-place delta patch of a resident HBM block",
+    "delta.fold": "base-chunk ⋈ delta-journal merge on the read path",
+    "delta.merge": "delta-store merge into new base blocks",
+    # hybrid join/agg partition phases (ops/hybrid.py)
+    "join.partition": "one radix partition's device chain",
+    # cross-process storage roots (store/remote.py)
+    "storage:coprocessor_stream": "storage-side root of one COP stream",
+}
+
+# retention bounds of the server-scope trace ring: records and an
+# estimated-bytes budget, billed to the trace-ring memtrack node
+_RING_CAP = 256
+_RING_BYTES_CAP = 16 << 20
+_SPAN_EST_BYTES = 256          # rough per-span record cost estimate
+
 
 class Span:
-    __slots__ = ("name", "tags", "start_ns", "end_ns", "children")
+    # the last three slots are ROOT-ONLY retention state (sampling
+    # decided at begin(), TRACE forces, ids assigned on first need):
+    # begin() writes them; child spans leave them unset — the hot
+    # constructor must not pay three dead writes per span
+    __slots__ = ("name", "tags", "start_ns", "end_ns", "children",
+                 "events", "tid", "sampled", "forced", "trace_id")
 
     def __init__(self, name: str, tags: dict | None = None):
         self.name = name
@@ -36,23 +110,45 @@ class Span:
         self.start_ns = time.perf_counter_ns()
         self.end_ns = 0
         self.children: list[Span] = []
+        self.events: list | None = None   # (name, t_ns, tags), lazy
+        self.tid = threading.get_ident()
 
     @property
     def duration_ns(self) -> int:
         return (self.end_ns or time.perf_counter_ns()) - self.start_ns
 
+    def event(self, name: str, **tags) -> None:
+        """Point event on THIS span (fault retries, degrade/quarantine
+        transitions, watchdog fires — the PR-13 state machine on the
+        statement timeline)."""
+        ev = (name, time.perf_counter_ns(), tags or None)
+        if self.events is None:
+            self.events = [ev]
+        else:
+            self.events.append(ev)
+
     def to_dict(self) -> dict:
         d = {"name": self.name, "duration_ns": self.duration_ns}
         if self.tags:
             d["tags"] = dict(self.tags)
+        if self.events:
+            d["events"] = [{"name": n, "tags": t} if t else {"name": n}
+                           for n, _t_ns, t in self.events]
         if self.children:
             d["children"] = [c.to_dict() for c in self.children]
         return d
 
 
 def begin(name: str, **tags) -> Span:
-    """Open a root span for the current thread's statement."""
+    """Open a root span for the current thread's statement. Statement
+    roots (`name == "statement"`) take the deterministic 1-in-N
+    sampling decision here — `tidb_tpu_trace_sample` — so the whole
+    tree below either records for retention or is a pure phase-
+    breakdown skeleton."""
     root = Span(name, tags)
+    root.sampled = _sample_next() if name == "statement" else False
+    root.forced = False
+    root.trace_id = None
     _tl.cur = root
     return root
 
@@ -81,22 +177,57 @@ def restore(token) -> None:
     _tl.cur = token
 
 
+def propagate():
+    """The current span, for re-installation inside worker threads with
+    `attached()` — the trace twin of runtime_stats.current() /
+    memtrack.current() riding into the coprocessor fan-out."""
+    return getattr(_tl, "cur", None)
+
+
 @contextlib.contextmanager
-def span(name: str, **tags):
+def attached(parent):
+    """Install `parent` (from propagate(), possibly None) as this
+    thread's current span: spans the worker opens hang off the
+    dispatching statement's tree. Child appends are GIL-atomic list
+    ops, so concurrent workers may attach under one parent."""
+    prev = getattr(_tl, "cur", None)
+    _tl.cur = parent if parent is not None else prev
+    try:
+        yield
+    finally:
+        _tl.cur = prev
+
+
+class span:
     """Child span under the thread's current span; a no-op (still timed,
     but unattached) when no trace is active — internal sessions and
-    worker threads pay one thread-local read."""
-    parent = getattr(_tl, "cur", None)
-    s = Span(name, tags)
-    if parent is not None:
-        parent.children.append(s)
-        _tl.cur = s
-    try:
-        yield s
-    finally:
-        s.end_ns = time.perf_counter_ns()
+    worker threads pay one thread-local read. A plain slotted context
+    manager, not @contextmanager: this sits on the per-statement and
+    per-dispatch hot paths, and the generator machinery would double
+    the disarmed cost (pinned <5us/statement by TestOverhead). The
+    span opens in __init__ — legal because a `with` statement calls
+    __enter__ immediately after evaluating the expression, with no
+    user code in between; use only as `with trace.span(...)`."""
+
+    __slots__ = ("_span", "_parent")
+
+    def __init__(self, name: str, **tags):
+        parent = getattr(_tl, "cur", None)
+        s = Span(name, tags)
+        self._span = s
+        self._parent = parent
         if parent is not None:
-            _tl.cur = parent
+            parent.children.append(s)
+            _tl.cur = s
+
+    def __enter__(self) -> Span:
+        return self._span
+
+    def __exit__(self, exc_type, exc, tb):
+        self._span.end_ns = time.perf_counter_ns()
+        if self._parent is not None:
+            _tl.cur = self._parent
+        return False
 
 
 def active() -> bool:
@@ -113,6 +244,14 @@ def annotate(**tags) -> None:
     cur = getattr(_tl, "cur", None)
     if cur is not None:
         cur.tags.update(tags)
+
+
+def event(name: str, **tags) -> None:
+    """Point event on the thread's current span (no-op untraced): the
+    call-site form for the device-plane recovery transitions."""
+    cur = getattr(_tl, "cur", None)
+    if cur is not None:
+        cur.event(name, **tags)
 
 
 def attach_remote(d: dict) -> None:
@@ -158,3 +297,321 @@ def log_tree(root: Span, sql: str) -> None:
 
     walk(root, 0)
     log.info("trace for %r:\n%s", sql[:256], "\n".join(parts))
+
+
+# -- sampling ----------------------------------------------------------------
+
+_seq_lock = threading.Lock()
+_stmt_seq = 0
+_id_seq = 0
+
+# lazy config binding: trace.py keeps zero package imports at module
+# level (it loads before most of the package), and a per-statement
+# `from tidb_tpu import config` would dominate the disarmed cost
+_config = None
+
+
+def _cfg():
+    global _config
+    if _config is None:
+        from tidb_tpu import config
+        _config = config
+    return _config
+
+
+def _sample_next() -> bool:
+    """Deterministic 1-in-N: the N-th, 2N-th, ... statement since
+    process start (or reset) is sampled. One lock'd int increment per
+    statement — the whole disarmed cost besides the skeleton spans the
+    phase breakdown needs anyway."""
+    n = _cfg().trace_sample()
+    if n <= 0:
+        return False
+    global _stmt_seq
+    with _seq_lock:
+        _stmt_seq += 1
+        return _stmt_seq % n == 0
+
+
+def ensure_id(root: Span) -> int:
+    """The root's trace id, assigned on first need (the TRACE statement
+    reads it before retention runs)."""
+    if root.trace_id is None:
+        global _id_seq
+        with _seq_lock:
+            _id_seq += 1
+            root.trace_id = _id_seq
+    return root.trace_id
+
+
+# -- the bounded, memtrack-billed trace ring ---------------------------------
+
+
+class _Ring:
+    """Finished trace records, newest last, bounded by count AND an
+    estimated-bytes budget billed to a `trace-ring` memtrack SERVER
+    node. The registered shed action clears the ring, so admission
+    shedding / GET /shed reclaim retained trees."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._records: list[dict] = []    # guarded-by: _mu
+        self._bytes = 0                   # guarded-by: _mu
+        self._node = None                 # guarded-by: _mu (memtrack)
+
+    def _tracker(self):
+        """Lazy node creation (imports memtrack on first retention)."""
+        from tidb_tpu import memtrack
+        with self._mu:
+            if self._node is None:
+                self._node = memtrack.server_node("trace-ring")
+                self._node.add_spill_action(self.shed)
+            return self._node
+
+    def append(self, rec: dict) -> None:
+        node = self._tracker()
+        # lint: exempt[paired-resource] ownership transfer: ring bytes release on evict (below) / shed / reset
+        node.consume(host=rec["cost"])
+        evicted = 0
+        with self._mu:
+            self._records.append(rec)
+            self._bytes += rec["cost"]
+            while len(self._records) > _RING_CAP or \
+                    self._bytes > _RING_BYTES_CAP:
+                old = self._records.pop(0)
+                self._bytes -= old["cost"]
+                evicted += old["cost"]
+        if evicted:
+            node.release(host=evicted)
+
+    def shed(self) -> int:
+        """Drop every retained record (the memtrack shed action).
+        -> bytes freed."""
+        with self._mu:
+            freed = self._bytes
+            self._records.clear()
+            self._bytes = 0
+            node = self._node
+        if node is not None and freed:
+            node.release(host=freed)
+        return freed
+
+    def get(self, trace_id: int) -> dict | None:
+        with self._mu:
+            for rec in self._records:
+                if rec["trace_id"] == trace_id:
+                    return rec
+        return None
+
+    def records(self, min_id: int = 0) -> list[dict]:
+        with self._mu:
+            return [r for r in self._records if r["trace_id"] > min_id]
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            return {"records": len(self._records), "bytes": self._bytes}
+
+
+_RING = _Ring()
+
+
+def _span_count(root: Span) -> int:
+    n = 1
+    for c in root.children:
+        n += _span_count(c)
+    return n
+
+
+def finish_statement(root: Span, sql: str, error: str | None = None,
+                     slow_ms: int | None = None) -> int | None:
+    """Retention decision for one ENDED statement root: keep the full
+    tree in the ring when the statement was sampled, forced (TRACE), or
+    ran past `tidb_tpu_slow_trace_ms`. -> trace id when retained, else
+    None. The untraced path is one flag test + one sysvar read.
+    `slow_ms` overrides the registry read — the session passes its
+    shadowed (session-SET) value, captured while its overlay was still
+    installed."""
+    if root.forced:
+        reason = "forced"
+    elif root.sampled:
+        reason = "sampled"
+    else:
+        if slow_ms is None:
+            slow_ms = _cfg().slow_trace_ms()
+        if slow_ms <= 0 or root.duration_ns < slow_ms * 1_000_000:
+            return None
+        reason = "slow"
+    dur_ns = root.duration_ns
+    from tidb_tpu import metrics, perfschema
+    tid = ensure_id(root)
+    rec = {
+        "trace_id": tid,
+        "sql": sql[:512],
+        "digest": perfschema.sql_digest(sql)[0],
+        "start_unix": time.time() - dur_ns / 1e9,
+        "duration_ns": dur_ns,
+        "reason": reason,
+        "error": error and error[:256],
+        "span_count": _span_count(root),
+        "root": root,
+    }
+    rec["cost"] = rec["span_count"] * _SPAN_EST_BYTES + len(rec["sql"])
+    _RING.append(rec)
+    metrics.counter(metrics.TRACES, {"reason": reason})
+    return tid
+
+
+def ring_snapshot() -> list[dict]:
+    """Summaries of retained traces, oldest first (the
+    information_schema.statement_traces rows and GET /trace list)."""
+    out = []
+    for rec in _RING.records():
+        out.append({k: rec[k] for k in
+                    ("trace_id", "digest", "sql", "start_unix",
+                     "duration_ns", "span_count", "reason", "error")})
+    return out
+
+
+def ring_records(min_id: int = 0) -> list[dict]:
+    """Full retained records (bench attribution walks their trees)."""
+    return _RING.records(min_id)
+
+
+def ring_get(trace_id: int) -> dict | None:
+    return _RING.get(trace_id)
+
+
+def ring_stats() -> dict:
+    return _RING.snapshot()
+
+
+def reset_for_tests() -> None:
+    """Clear the ring and the sampling counters (test isolation)."""
+    global _stmt_seq, _id_seq
+    _RING.shed()
+    with _seq_lock:
+        _stmt_seq = 0
+        _id_seq = 0
+
+
+# -- exports -----------------------------------------------------------------
+
+
+def tree(root: Span, base_ns: int | None = None) -> dict:
+    """Nested export of one span tree with start offsets: start_us is
+    relative to the ROOT's start, so the JSON is self-contained and a
+    still-open span (the TRACE statement snapshots its own live root)
+    reads as closed at "now"."""
+    base = root.start_ns if base_ns is None else base_ns
+
+    def walk(s: Span) -> dict:
+        d = {"name": s.name,
+             "start_us": round((s.start_ns - base) / 1e3, 3),
+             "duration_us": round(s.duration_ns / 1e3, 3)}
+        if s.tags:
+            d["tags"] = {k: v for k, v in s.tags.items()}
+        if s.events:
+            d["events"] = [
+                {"name": n, "at_us": round((t - base) / 1e3, 3),
+                 **({"tags": tg} if tg else {})}
+                for n, t, tg in s.events]
+        if s.children:
+            d["children"] = [walk(c) for c in s.children]
+        return d
+
+    return walk(root)
+
+
+def validate(root: Span) -> list[str]:
+    """Structural problems of a FINISHED tree: begin-without-end spans
+    and negative durations (the balance check the trace bench and the
+    TRACE tests assert empty)."""
+    problems: list[str] = []
+
+    def walk(s: Span) -> None:
+        if not s.end_ns:
+            problems.append(f"span {s.name!r} has no end (begin "
+                            f"without end)")
+        elif s.end_ns < s.start_ns:
+            problems.append(f"span {s.name!r} ends before it starts")
+        for c in s.children:
+            walk(c)
+
+    walk(root)
+    return problems
+
+
+# the bench attribution's phase buckets: span names summed per trace.
+# "other" is the statement remainder — with no cross-thread overlap the
+# per-trace phase sum equals the statement duration exactly.
+_PHASE_SPANS = {
+    "parse": ("parse",),
+    "plan": ("plan",),
+    "admission_wait": ("admission",),
+    "sched_stall": ("sched.slot",),
+    "device_dispatch": ("dispatch",),
+    "finalize": ("finalize",),
+    "host_fallback": ("host.fallback",),
+    "commit": ("commit",),
+}
+
+
+def phases_of(root: Span) -> dict:
+    """Per-phase nanosecond sums for one finished statement tree — the
+    latency-attribution input (bench serve/chaos blocks, ROADMAP item
+    2's p99 breakdown). Spans sum BY NAME across the whole tree (pool
+    workers included), so concurrent workers can push a phase past the
+    wall-clock statement time; "other" floors at zero."""
+    sums: dict[str, int] = {}
+
+    def walk(s: Span) -> None:
+        sums[s.name] = sums.get(s.name, 0) + s.duration_ns
+        for c in s.children:
+            walk(c)
+
+    for c in root.children:
+        walk(c)
+    out = {phase: sum(sums.get(n, 0) for n in names)
+           for phase, names in _PHASE_SPANS.items()}
+    total = root.duration_ns
+    out["total"] = total
+    out["other"] = max(0, total - sum(
+        v for k, v in out.items() if k != "total"))
+    return out
+
+
+def to_chrome(rec: dict) -> dict:
+    """Chrome trace-event JSON for one retained record: complete ("X")
+    events per span in µs relative to the root, instant ("i") events
+    for the recovery transitions, one lane per OS thread — load it in
+    Perfetto / chrome://tracing to SEE dispatch-ahead depth, slot waits
+    and finalize serialization across the statement's threads."""
+    root: Span = rec["root"]
+    base = root.start_ns
+    events = [{"ph": "M", "pid": 1, "tid": 0, "name": "process_name",
+               "args": {"name": f"tidb-tpu trace {rec['trace_id']}"}}]
+
+    def walk(s: Span) -> None:
+        ev = {"ph": "X", "pid": 1, "tid": s.tid, "name": s.name,
+              "cat": "statement",
+              "ts": round((s.start_ns - base) / 1e3, 3),
+              "dur": round(s.duration_ns / 1e3, 3)}
+        if s.tags:
+            ev["args"] = {k: str(v) for k, v in s.tags.items()}
+        events.append(ev)
+        for n, t, tg in s.events or ():
+            ie = {"ph": "i", "pid": 1, "tid": s.tid, "name": n,
+                  "cat": "fault", "s": "t",
+                  "ts": round((t - base) / 1e3, 3)}
+            if tg:
+                ie["args"] = {k: str(v) for k, v in tg.items()}
+            events.append(ie)
+        for c in s.children:
+            walk(c)
+
+    walk(root)
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": {"trace_id": rec["trace_id"],
+                          "sql": rec["sql"],
+                          "digest": rec["digest"],
+                          "reason": rec["reason"]}}
