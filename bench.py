@@ -519,6 +519,17 @@ def _htap_bench(progress) -> dict:
                  "rates": {}}
     seq_commit: dict = {}            # write seq -> commit wall time
     baseline_rps = None
+    from tidb_tpu import perfschema as _ps
+    htap_digests = {
+        _ps.sql_digest(analytic)[0]: "analytic",
+        _ps.sql_digest("UPDATE stock SET s_qty = s_qty - 1, "
+                       "s_cnt = 1 WHERE s_id = 1")[0]: "write",
+        _ps.sql_digest("INSERT INTO orders VALUES (1, 1, 9.99)")[0]:
+            "write",
+        _ps.sql_digest("UPDATE stock SET s_ytd = s_ytd + 1.5, "
+                       "s_cnt = 1 WHERE s_id = 1")[0]: "write",
+    }
+    util_mark = _meter_mark()
     try:
         for rate in rates:
             stop = threading.Event()
@@ -621,6 +632,10 @@ def _htap_bench(progress) -> dict:
                 v["vs_read_only"] for v in nz)
         out["delta_rows_staged_end"] = \
             storage.delta_store.rows_current()
+        # device utilization across the whole sweep: how much of the
+        # wall the analytics plane kept the device busy under writes,
+        # split analytic-vs-write by digest
+        out["utilization"] = _utilization_block(util_mark, htap_digests)
     finally:
         session.close()
         storage.close()
@@ -847,6 +862,69 @@ def _trace_attribution(mark: int, class_digests: dict) -> dict:
     return out
 
 
+def _meter_mark() -> dict:
+    """Snapshot of the resource meter before a bench leg: SERVER
+    totals, per-session and per-digest device time (meter.py) — the
+    baseline _utilization_block diffs against."""
+    from tidb_tpu import meter
+    return {
+        "t": time.perf_counter(),
+        "server": meter.server_snapshot(),
+        "sessions": {s["session_id"]: s["device_ns"]
+                     for s in meter.sessions_snapshot()},
+        "digests": {d["digest"]: d["device_ns"]
+                    for d in meter.digests_snapshot()},
+    }
+
+
+def _utilization_block(mark: dict, class_digests: dict | None = None,
+                       wall_secs: float | None = None) -> dict:
+    """The BENCH `utilization` sub-block (serve/htap/chaos legs):
+    device busy fraction over the leg's wall time, per-class
+    device-seconds (digest meter deltas mapped through
+    `class_digests`), and attribution coverage — the sum of
+    per-session device-time over the SERVER total, which must sit in
+    [0.9, 1.1] or attribution is leaking (scripts/serve_bench.sh
+    enforces the bound)."""
+    from tidb_tpu import meter, metrics_history
+    # one explicit sample so the device-utilization series exists even
+    # when the leg finished inside a single sampler cadence
+    metrics_history.sample_now()
+    wall = wall_secs if wall_secs is not None \
+        else time.perf_counter() - mark["t"]
+    server = meter.server_snapshot()
+    busy_ns = server["device_ns"] - mark["server"]["device_ns"]
+    host_ns = server["host_fallback_ns"] - \
+        mark["server"]["host_fallback_ns"]
+    prev_sessions = mark["sessions"]
+    attributed_ns = 0
+    for s in meter.sessions_snapshot():
+        attributed_ns += s["device_ns"] - \
+            prev_sessions.get(s["session_id"], 0)
+    out = {
+        "wall_secs": round(wall, 3),
+        "device_busy_secs": round(busy_ns / 1e9, 4),
+        "device_busy_fraction": round(busy_ns / (wall * 1e9), 4)
+        if wall > 0 else 0.0,
+        "host_fallback_secs": round(host_ns / 1e9, 4),
+        "attributed_device_secs": round(attributed_ns / 1e9, 4),
+        "attribution_coverage": round(attributed_ns / busy_ns, 4)
+        if busy_ns > 0 else 1.0,
+    }
+    if class_digests:
+        prev_digests = mark["digests"]
+        per_class: dict = {}
+        for d in meter.digests_snapshot():
+            cls = class_digests.get(d["digest"])
+            if cls is None:
+                continue
+            delta = d["device_ns"] - prev_digests.get(d["digest"], 0)
+            per_class[cls] = round(
+                per_class.get(cls, 0.0) + delta / 1e9, 4)
+        out["per_class_device_secs"] = dict(sorted(per_class.items()))
+    return out
+
+
 def _serve_bench(progress) -> dict:
     """Multi-client wire-protocol load harness (ISSUE 10 / ROADMAP item
     1's second headline series): N real MySQL connections replay a mixed
@@ -943,6 +1021,10 @@ def _serve_bench(progress) -> dict:
                  "rows_loaded": total_loaded,
                  "ops": sum(len(ops) for ops in all_ops),
                  "workload_rows": workload_rows}
+    # resource-meter baseline for the utilization block: everything
+    # from here (serialized + concurrent + pinched legs) is serving
+    # work whose device time must attribute to wire sessions
+    util_mark = _meter_mark()
     try:
         # serialized baseline: ONE connection replays every client's op
         # list back to back — the number concurrency must beat
@@ -1086,6 +1168,15 @@ def _serve_bench(progress) -> dict:
             out["pinched"]["completed"] = False
         else:
             out["pinched"]["completed"] = True
+        # resource-meter utilization over all three legs: busy
+        # fraction, per-class device-seconds, and the attribution
+        # coverage bar scripts/serve_bench.sh pins to [0.9, 1.1]
+        out["utilization"] = _utilization_block(util_mark,
+                                                class_digests)
+        progress(f"serve: utilization busy="
+                 f"{out['utilization']['device_busy_fraction']} "
+                 f"coverage="
+                 f"{out['utilization']['attribution_coverage']}")
     finally:
         server.close()
         session.close()
@@ -1565,6 +1656,7 @@ def _chaos_bench(progress) -> dict:
     # degraded-path microseconds went (the ring keeps the newest 256)
     config.set_var("tidb_tpu_trace_sample", 2)
     trace_mark = _trace_mark()
+    util_mark = _meter_mark()
     progress(f"chaos: {n_clients} clients + writer + driver for "
              f"{window}s (watchdog {timeout_ms}ms)")
     threads = [threading.Thread(target=client_worker, args=(ci,),
@@ -1597,6 +1689,10 @@ def _chaos_bench(progress) -> dict:
                      for cls, sql2 in analytics.items()}
     chaos_digests[_ps.sql_digest(point_sql.format(k=0))[0]] = "point"
     attribution = _trace_attribution(trace_mark, chaos_digests)
+    # utilization over the chaos window itself (before the post-chaos
+    # health queries add fault-free device time)
+    utilization = _utilization_block(util_mark, chaos_digests,
+                                     wall_secs=secs)
 
     # post-chaos serving health: faults disarmed, every analytic must
     # answer correctly again through a fresh connection
@@ -1669,6 +1765,7 @@ def _chaos_bench(progress) -> dict:
         "oom_cancels": int(snap1.get(oom_key, 0) -
                            snap0.get(oom_key, 0)),
         "latency_attribution": attribution,
+        "utilization": utilization,
         "watchdog_fires": delta_of(metrics.DISPATCH_TIMEOUTS),
         "device_fallbacks": fallbacks,
         "quarantines": delta_of(metrics.DEVICE_QUARANTINES),

@@ -34,7 +34,20 @@ pinched = d["pinched"]
 assert pinched["completed"], f"pinched leg failed: {pinched['errors']}"
 assert pinched["oom_cancels"] == 0, \
     f"pinched leg paid {pinched['oom_cancels']} mid-query OOM cancels"
+util = d.get("utilization")
+assert util, "utilization block missing from the serve detail"
+for key in ("device_busy_fraction", "device_busy_secs",
+            "attributed_device_secs", "attribution_coverage",
+            "per_class_device_secs"):
+    assert key in util, f"utilization block unpopulated: missing {key}"
+assert util["device_busy_secs"] > 0, \
+    f"utilization block unpopulated: zero device busy time ({util})"
+assert 0.9 <= util["attribution_coverage"] <= 1.1, \
+    f"attribution coverage {util['attribution_coverage']} outside " \
+    f"[0.9, 1.1]: per-session metering is leaking ({util})"
 print(f"serve bench OK: {rep['value']} rows/s concurrent "
       f"({conc['speedup_vs_serialized']}x vs serialized), "
-      f"admission_shed={pinched['admission_shed']}, oom_cancels=0")
+      f"admission_shed={pinched['admission_shed']}, oom_cancels=0, "
+      f"busy={util['device_busy_fraction']}, "
+      f"coverage={util['attribution_coverage']}")
 PY

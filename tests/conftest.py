@@ -33,18 +33,21 @@ import pytest  # noqa: E402
 
 @pytest.fixture
 def ledger_hygiene():
-    """Ledger/slot hygiene under faults (docs/ROBUSTNESS.md): after the
-    test, every armed failpoint is disarmed, the device scheduler holds
-    zero in-flight slots and zero waiters, and the SERVER memtrack
-    host+device ledgers drain to zero once dead storages are collected
-    and the shed chain (forced delta merges, HBM sheds) has run.
-    Applied module-wide by the failpoint/chaos suites via
+    """Ledger/slot/gauge hygiene under faults (docs/ROBUSTNESS.md):
+    after the test, every armed failpoint is disarmed, the device
+    scheduler holds zero in-flight slots and zero waiters, the SERVER
+    memtrack host+device ledgers drain to zero once dead storages are
+    collected and the shed chain (forced delta merges, HBM sheds) has
+    run, and every *_current/*_depth gauge series returns to zero — a
+    leaked decrement on an abnormal disconnect/error path shows up as a
+    gauge stuck above zero forever. Applied module-wide by the
+    failpoint/chaos suites via
     `pytestmark = pytest.mark.usefixtures("ledger_hygiene")`."""
     yield
     import gc
     import time as _time
 
-    from tidb_tpu import memtrack, sched
+    from tidb_tpu import memtrack, metrics, sched
     from tidb_tpu.util import failpoint
 
     failpoint.disable_all()
@@ -64,4 +67,28 @@ def ledger_hygiene():
                 f"SERVER ledgers not drained: host={memtrack.SERVER.host}"
                 f" device={memtrack.SERVER.device} "
                 f"children={[c.snapshot() for c in memtrack.SERVER.children.values()]}")
+        _time.sleep(0.05)
+
+    def _leaked_gauges() -> dict:
+        """Instantaneous-count gauge series still above zero. The
+        series name precedes any {label} suffix; only the unit-less
+        level families (_current/_depth) must return to zero — ratio
+        and last-statement-peak gauges legitimately hold values."""
+        out = {}
+        for key, v in metrics.gauges_snapshot().items():
+            name = key.split("{", 1)[0]
+            if name.endswith(("_current", "_depth")) and v != 0:
+                out[key] = v
+        return out
+
+    # gauges drain asynchronously (a disconnecting client's server
+    # thread decrements the connection gauge after the socket drops)
+    deadline = _time.time() + 5.0
+    while True:
+        leaked = _leaked_gauges()
+        if not leaked:
+            break
+        if _time.time() >= deadline:
+            raise AssertionError(
+                f"level gauges not drained to zero: {leaked}")
         _time.sleep(0.05)

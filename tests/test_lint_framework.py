@@ -388,6 +388,36 @@ def test_sysvar_registry_docs_drift():
     assert any("appears nowhere" in f.message for f in rep.findings)
 
 
+def test_metric_cardinality_negative_and_positive():
+    """Bounded-enum labels pass; per-tenant keys, per-session value
+    identifiers, computed values, and non-literal label dicts are each
+    findings (the label-cardinality bound: high-cardinality attribution
+    belongs in the resource meter, not Prometheus series)."""
+    ok = ("from tidb_tpu import metrics\n"
+          "def f(outcome, s):\n"
+          "    metrics.counter(metrics.Q, {'outcome': outcome})\n"
+          "    metrics.histogram(metrics.Q, 1.0, {'op': s.name})\n"
+          "    metrics.gauge(metrics.Q, 2.0)\n"
+          "    metrics.counter(metrics.Q, None, inc=3)\n")
+    support = {"tidb_tpu/metrics.py": 'Q = "tidb_tpu_queries_total"\n'}
+    rep = lint({STORE_REL: ok, **support},
+               rules=["metric-cardinality"])
+    assert rep.findings == []
+    bad = ("from tidb_tpu import metrics\n"
+           "def f(session_id, labels, q):\n"
+           "    metrics.counter(metrics.Q, {'session': 1})\n"
+           "    metrics.counter(metrics.Q, {'op': session_id})\n"
+           "    metrics.counter(metrics.Q, {'op': f'x-{q}'})\n"
+           "    metrics.counter(metrics.Q, labels)\n")
+    rep = lint({STORE_REL: bad, **support},
+               rules=["metric-cardinality"])
+    assert len(rep.findings) == 4
+    msgs = " | ".join(f.message for f in rep.findings)
+    assert "per-tenant" in msgs and "per-session" in msgs
+    assert "computed label value" in msgs
+    assert "inline dict literal" in msgs
+
+
 def test_errcode_discipline_negative():
     src = ("from tidb_tpu import errcode\n"
            "def f(sess, SQLError):\n"

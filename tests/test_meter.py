@@ -1,0 +1,387 @@
+"""Continuous resource metering (tidb_tpu/meter.py): per-tenant
+device-time/bytes/rows attribution rolled up statement→session→user→
+SERVER, cross-thread attribution through the coprocessor pool AND
+stream fan-outs (the no-bleed mirror of test_memtrack's isolation
+tests, sequential + threaded), the metrics-history ring + sampler
+(tidb_tpu/metrics_history.py), and the surfaces:
+information_schema.resource_usage, SHOW [FULL] PROCESSLIST
+DeviceTime/RowsSent, and the derived utilization gauges."""
+
+import threading
+
+import pytest
+
+from tidb_tpu import config, memtrack, meter, metrics, metrics_history
+from tidb_tpu.session import Session
+from tidb_tpu.store.storage import new_mock_storage
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _quiet_sampler():
+    """Idle the background history sampler for this module: the
+    interval-roll assertions below must not race a 1 Hz background
+    roll_interval() from a sampler an earlier suite's server started."""
+    prev = config.get_var("tidb_tpu_metrics_history_interval_ms")
+    config.set_var("tidb_tpu_metrics_history_interval_ms", 0)
+    yield
+    config.set_var("tidb_tpu_metrics_history_interval_ms", prev)
+
+
+# -- unit: the meter tree ---------------------------------------------------
+
+
+class TestMeterTree:
+    def test_rollup_walks_the_parent_chain(self):
+        meter.reset_for_tests()
+        sm = meter.session_meter(7001, "alice")
+        stmt = meter.statement_meter(sm)
+        stmt.add(device_ns=1000, rows_sent=5)
+        stmt.add(host_fallback_ns=300, slot_wait_ns=20)
+        assert stmt.totals()["device_ns"] == 1000
+        assert sm.totals()["device_ns"] == 1000
+        assert sm.totals()["rows_sent"] == 5
+        user = [u for u in meter.users_snapshot()
+                if u["user"] == "alice"][0]
+        assert user["device_ns"] == 1000
+        assert user["host_fallback_ns"] == 300
+        assert meter.SERVER.totals()["device_ns"] == 1000
+        assert meter.SERVER.totals()["slot_wait_ns"] == 20
+
+    def test_unattributed_work_lands_on_server_only(self):
+        meter.reset_for_tests()
+        meter.session_meter(7002, "bob")
+        meter.note_device(500)         # no meter installed on thread
+        assert meter.SERVER.totals()["device_ns"] == 500
+        assert meter.attributed_device_ns() == 0
+
+    def test_metering_installs_and_suspends(self):
+        meter.reset_for_tests()
+        sm = meter.session_meter(7003, "carol")
+        with meter.metering(sm):
+            meter.note_device(100)
+            with meter.suspended():
+                meter.note_device(40)   # internal: SERVER only
+            with meter.metering(None):  # None nests transparently
+                meter.note_device(60)
+        assert sm.totals()["device_ns"] == 160
+        assert meter.SERVER.totals()["device_ns"] == 200
+
+    def test_busy_sections_never_double_count(self):
+        """Nested busy intervals (a finalize whose escalation re-enters
+        device_slot, or degrades work to a host region) bill each
+        nanosecond once, with the inner classification winning: the
+        billed total can never exceed the outer wall interval."""
+        import time as _t
+        meter.reset_for_tests()
+        sm = meter.session_meter(7005, "erin")
+        t0 = _t.perf_counter_ns()
+        with meter.metering(sm):
+            with meter.busy_section("device"):
+                _t.sleep(0.002)
+                with meter.busy_section("device"):   # nested retry
+                    _t.sleep(0.002)
+                meter.note_host_fallback(1_000_000)  # degraded slice
+        wall = _t.perf_counter_ns() - t0
+        tot = sm.totals()
+        assert tot["host_fallback_ns"] == 1_000_000
+        assert tot["device_ns"] > 0
+        assert tot["device_ns"] + tot["host_fallback_ns"] <= wall
+
+    def test_pipeline_map_classifies_host_tokens(self):
+        """pipeline_map's work split: None and ('host', ...) tokens are
+        host-path (the fused probe-agg's small-batch convention), any
+        other token is device work."""
+        from tidb_tpu.ops import runtime as rt
+        meter.reset_for_tests()
+        sm = meter.session_meter(7006, "frank")
+
+        def dispatch(it):
+            return ("host", it, 0) if it % 2 else object()
+
+        with meter.metering(sm):
+            out = list(rt.pipeline_map(
+                [0, 1, 2, 3], dispatch, lambda it, tok: it, depth=2))
+        assert out == [0, 1, 2, 3]
+        tot = sm.totals()
+        assert tot["device_ns"] > 0
+        assert tot["host_fallback_ns"] > 0
+
+    def test_interval_roll_and_digest_fold(self):
+        meter.reset_for_tests()
+        sm = meter.session_meter(7004, "dave")
+        stmt = meter.statement_meter(sm)
+        stmt.add(device_ns=900, statements=1)
+        meter.finish_statement(stmt, "digest-x", "SELECT ?")
+        meter.roll_interval()
+        snap = [s for s in meter.sessions_snapshot()
+                if s["session_id"] == 7004][0]
+        assert snap["interval"]["device_ns"] == 900
+        stmt2 = meter.statement_meter(sm)
+        stmt2.add(device_ns=100, statements=1)
+        meter.finish_statement(stmt2, "digest-x", "SELECT ?")
+        meter.roll_interval()
+        snap = [s for s in meter.sessions_snapshot()
+                if s["session_id"] == 7004][0]
+        # second window: only the second statement's work
+        assert snap["interval"]["device_ns"] == 100
+        assert snap["device_ns"] == 1000
+        top = meter.top_digests()
+        assert top[0]["digest"] == "digest-x"
+        assert top[0]["device_ns"] == 1000
+        assert top[0]["statements"] == 2
+
+
+# -- session fixtures -------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def store():
+    st = new_mock_storage()
+    s = Session(st)
+    s.execute("CREATE DATABASE m; USE m")
+    s.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, a BIGINT, "
+              "v BIGINT)")
+    vals = ",".join(f"({i},{i * 3 % 97},{i % 7})" for i in range(6000))
+    s.execute("INSERT INTO t VALUES " + vals)
+    # several regions so the fan-out really runs pool/stream WORKERS
+    info = s.domain.info_schema().table("m", "t")
+    st.cluster.split_table(info.id, 4, max_handle=6000)
+    s.query("SELECT a, COUNT(*), SUM(v) FROM t GROUP BY a")  # warm
+    yield st
+    s.close()
+    st.close()
+
+
+AGG = "SELECT a, COUNT(*), SUM(v) FROM t GROUP BY a"
+
+
+def _session_meter_of(s: Session):
+    return [m for m in meter.sessions_snapshot()
+            if m["session_id"] == s.session_id][0]
+
+
+# -- cross-thread attribution (the memtrack no-bleed mirror) ----------------
+
+
+class TestCrossThreadAttribution:
+    def test_pool_workers_credit_the_issuing_session(self, store):
+        """Sequential: the copr pool fan-out re-installs the issuing
+        session's meter inside its workers, so storage-side device
+        work lands on that session — and on nobody else's."""
+        busy = Session(store, db="m")
+        idle = Session(store, db="m")
+        try:
+            # force the POOL fan-out (streaming covers the other path)
+            busy.execute("SET tidb_tpu_copr_stream = 0")
+            busy.query(AGG)
+            b = _session_meter_of(busy)
+            i = _session_meter_of(idle)
+            assert b["statements"] >= 1
+            assert b["device_ns"] + b["host_fallback_ns"] > 0
+            # the idle session ran nothing: zero work of any kind
+            assert i["device_ns"] == 0
+            assert i["host_fallback_ns"] == 0
+            assert i["rows_sent"] == 0
+        finally:
+            busy.close()
+            idle.close()
+
+    def test_stream_workers_credit_the_issuing_session(self, store):
+        """The streaming fan-out path (tidb_tpu_copr_stream=1 is the
+        default) attributes the same way; force a fresh pass through
+        the stream workers and assert the work landed."""
+        s = Session(store, db="m")
+        try:
+            before = _session_meter_of(s)["device_ns"] + \
+                _session_meter_of(s)["host_fallback_ns"]
+            s.execute("SET tidb_tpu_copr_stream = 1")
+            s.query("SELECT a, COUNT(*), SUM(v) FROM t "
+                    "WHERE id > 17 GROUP BY a")
+            after = _session_meter_of(s)
+            assert after["device_ns"] + after["host_fallback_ns"] \
+                > before
+            assert after["rows_sent"] > 0
+        finally:
+            s.close()
+
+    def test_threaded_no_bleed(self, store):
+        """Two sessions running CONCURRENTLY keep their ledgers apart:
+        each session's rows_sent is exactly its own result rows, and
+        the busy session's execution work never credits the light one."""
+        heavy = Session(store, db="m")
+        light = Session(store, db="m")
+        rounds = 3
+        errs: list = []
+        barrier = threading.Barrier(2)
+
+        def run(s, sql, n):
+            try:
+                barrier.wait(timeout=30)
+                for _ in range(n):
+                    s.query(sql)
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                errs.append(e)
+
+        t1 = threading.Thread(
+            target=run, args=(heavy, AGG, rounds), name="meter-heavy")
+        t2 = threading.Thread(
+            target=run, args=(light, "SELECT v FROM t WHERE id = 3",
+                              rounds), name="meter-light")
+        try:
+            t1.start()
+            t2.start()
+            t1.join(60)
+            t2.join(60)
+            assert not errs, errs
+            h = _session_meter_of(heavy)
+            li = _session_meter_of(light)
+            n_groups = 97
+            assert h["rows_sent"] == rounds * n_groups
+            assert li["rows_sent"] == rounds
+            # the heavy session did real execution work; the light
+            # session's point lookups stay orders of magnitude below
+            h_work = h["device_ns"] + h["host_fallback_ns"]
+            l_work = li["device_ns"] + li["host_fallback_ns"]
+            assert h_work > 0
+            assert l_work < h_work
+            # rollup consistency: the server total carries at least
+            # the attributed sum (plus any unattributed work)
+            assert meter.SERVER.totals()["device_ns"] >= \
+                meter.attributed_device_ns()
+        finally:
+            heavy.close()
+            light.close()
+
+
+# -- surfaces ---------------------------------------------------------------
+
+
+class TestSurfaces:
+    def test_resource_usage_memtable(self, store):
+        s = Session(store, db="m")
+        try:
+            s.query(AGG)
+            rs = s.query(
+                "SELECT scope, session_id, user, statements, "
+                "device_time_ns, host_fallback_ns, rows_sent "
+                "FROM information_schema.resource_usage")
+            scopes = {r[0] for r in rs.rows}
+            assert {"server", "user", "session"} <= scopes
+            mine = [r for r in rs.rows
+                    if r[0] == "session" and r[1] == s.session_id]
+            assert mine and mine[0][3] >= 1          # statements
+            assert mine[0][6] > 0                    # rows_sent
+            srv = [r for r in rs.rows if r[0] == "server"][0]
+            # per-session work is a slice of the server total
+            assert srv[4] >= mine[0][4]
+            assert srv[6] >= mine[0][6]
+        finally:
+            s.close()
+
+    def test_processlist_device_time_and_rows(self, store):
+        s = Session(store, db="m")
+        try:
+            s.query(AGG)
+            rs = s.query("SHOW PROCESSLIST")
+            assert rs.columns[-2:] == ["DeviceTime", "RowsSent"]
+            me = [r for r in rs.rows if r[0] == s.session_id][0]
+            assert isinstance(me[-2], int)
+            assert me[-1] > 0                        # rows served
+
+            # SHOW FULL PROCESSLIST: same columns, untruncated Info.
+            # A multi-statement batch pins the truncation contract:
+            # current_sql is the whole batch text (>100 chars), so the
+            # plain SHOW truncates it and FULL serves it verbatim
+            longsel = ("SELECT COUNT(*) FROM t WHERE id IN (" +
+                       ",".join(str(i) for i in range(40)) + ")")
+            full_batch = longsel + "; SHOW FULL PROCESSLIST"
+            rs_full = s.execute(full_batch)[1]
+            assert rs_full.columns[-2:] == ["DeviceTime", "RowsSent"]
+            info_idx = rs_full.columns.index("Info")
+            me_full = [r for r in rs_full.rows
+                       if r[0] == s.session_id][0]
+            assert me_full[info_idx] == full_batch
+            assert len(me_full[info_idx]) > 100
+            plain_batch = longsel + "; SHOW PROCESSLIST"
+            rs_plain = s.execute(plain_batch)[1]
+            me_plain = [r for r in rs_plain.rows
+                        if r[0] == s.session_id][0]
+            assert len(me_plain[info_idx] or "") == 100
+            assert plain_batch.startswith(me_plain[info_idx])
+        finally:
+            s.close()
+
+    def test_statement_folds_into_digest_top(self, store):
+        from tidb_tpu import perfschema
+        s = Session(store, db="m")
+        try:
+            sql = "SELECT COUNT(*) FROM t WHERE a = 11"
+            s.query(sql)
+            dg = perfschema.sql_digest(sql)[0]
+            recs = {r["digest"]: r for r in meter.digests_snapshot()}
+            assert dg in recs
+            assert recs[dg]["statements"] >= 1
+            assert recs[dg]["rows_sent"] >= 1
+        finally:
+            s.close()
+
+
+# -- metrics history (tidb_tpu/metrics_history.py) --------------------------
+
+
+class TestMetricsHistory:
+    def test_sample_now_records_derived_series(self, store):
+        metrics_history.reset_for_tests()
+        s = Session(store, db="m")
+        try:
+            metrics_history.sample_now()     # baseline tick
+            s.query(AGG)
+            point = metrics_history.sample_now()
+            assert "tidb_tpu_device_utilization_ratio" in point
+            assert "tidb_tpu_hbm_occupancy_ratio" in point
+            assert "server_host_bytes" in point
+            ser = metrics_history.series()
+            assert "tidb_tpu_device_utilization_ratio" in ser
+            ts = ser["tidb_tpu_device_utilization_ratio"]
+            assert len(ts) >= 1
+            assert all(len(pair) == 2 for pair in ts)
+            # the derived gauge publishes live too
+            assert metrics.DEVICE_UTILIZATION in metrics.snapshot()
+        finally:
+            s.close()
+
+    def test_ring_is_bounded_and_billed_and_sheds(self):
+        metrics_history.reset_for_tests()
+        prev = config.get_var("tidb_tpu_metrics_history_points")
+        config.set_var("tidb_tpu_metrics_history_points", 16)
+        try:
+            for _ in range(40):
+                metrics_history.sample_now()
+            assert metrics_history.stats()["points"] == 16
+            billed = metrics_history.stats()["bytes"]
+            assert billed > 0
+            # billed to a memtrack SERVER node...
+            node = [c for c in memtrack.SERVER.children.values()
+                    if c.label == "metrics-history"]
+            assert node and node[0].host == billed
+            # ...with a registered shed action the server chain drives
+            from tidb_tpu import sched
+            sched.shed_server(0)
+            assert metrics_history.stats()["points"] == 0
+            assert node[0].host == 0
+        finally:
+            config.set_var("tidb_tpu_metrics_history_points", prev)
+
+    def test_interval_sysvar_gates_the_beat(self):
+        metrics_history.reset_for_tests()
+        prev = config.get_var("tidb_tpu_metrics_history_interval_ms")
+        config.set_var("tidb_tpu_metrics_history_interval_ms", 0)
+        try:
+            before = metrics_history.stats()["points"]
+            metrics_history._beat()
+            assert metrics_history.stats()["points"] == before
+            config.set_var("tidb_tpu_metrics_history_interval_ms", 1)
+            metrics_history._beat()
+            assert metrics_history.stats()["points"] >= before
+        finally:
+            config.set_var("tidb_tpu_metrics_history_interval_ms", prev)

@@ -37,6 +37,7 @@ __all__ = ["get_var", "set_var", "all_vars", "coerce", "session_overlay",
            "delta_merge_ratio_pct",
            "dispatch_timeout_ms", "failpoints_spec", "on_change",
            "trace_sample", "slow_trace_ms",
+           "metrics_history_interval_ms", "metrics_history_points",
            "UnknownVariableError"]
 
 
@@ -241,6 +242,18 @@ _DEFS: dict[str, tuple[str, int]] = {
     # 0 = watchdog off (the default: CPU-XLA first compiles can
     # legitimately take tens of seconds).
     "tidb_tpu_dispatch_timeout_ms": (_INT, 0),
+    # metrics-history sampler cadence (tidb_tpu/metrics_history.py): a
+    # supervised background sampler snapshots registered gauges plus
+    # derived device-utilization / HBM occupancy / hit-rate series into
+    # a bounded in-process ring (billed to a memtrack SERVER node with
+    # a registered shed action) every this-many milliseconds, and rolls
+    # the resource meter's per-tenant interval baselines. Served on
+    # GET /metrics/history. 0 = sampler idle (manual sample_now() — the
+    # bench/test door — still records).
+    "tidb_tpu_metrics_history_interval_ms": (_INT, 1000),
+    # metrics-history ring capacity in points (one point per sampler
+    # tick); the oldest points evict past it
+    "tidb_tpu_metrics_history_points": (_INT, 512),
     # failpoint arming (util/failpoint.py): "name=spec;name=spec" over
     # the declared registry, e.g. 'hbm/fill=2*raise(DeviceFaultError)'.
     # The value is DECLARATIVE for the SET surface: writing it arms the
@@ -528,6 +541,14 @@ def dispatch_timeout_ms() -> int:
 
 def failpoints_spec() -> str:
     return str(_read("tidb_tpu_failpoints") or "")
+
+
+def metrics_history_interval_ms() -> int:
+    return max(0, _read("tidb_tpu_metrics_history_interval_ms"))
+
+
+def metrics_history_points() -> int:
+    return min(max(16, _read("tidb_tpu_metrics_history_points")), 1 << 16)
 
 
 def trace_sample() -> int:

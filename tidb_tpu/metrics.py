@@ -15,6 +15,7 @@ from __future__ import annotations
 import threading
 
 __all__ = ["counter", "histogram", "gauge", "expose", "snapshot",
+           "gauges_snapshot",
            "QUERY_DURATIONS", "QUERIES_TOTAL", "SLOW_QUERIES",
            "CONNECTIONS", "COP_TASKS", "QUERY_ERRORS",
            "COP_STREAM_FRAMES", "COP_STREAM_BYTES",
@@ -30,7 +31,8 @@ __all__ = ["counter", "histogram", "gauge", "expose", "snapshot",
            "DELTA_ROWS", "DELTA_MERGES", "CACHE_DELTA_SERVES",
            "BYTES_ENCODED", "BYTES_DECODED_EQUIV",
            "FAILPOINT_FIRES", "WORKER_RESTARTS", "DISPATCH_TIMEOUTS",
-           "DEVICE_QUARANTINES", "TRACES"]
+           "DEVICE_QUARANTINES", "TRACES",
+           "DEVICE_UTILIZATION", "HBM_OCCUPANCY"]
 
 _lock = threading.Lock()
 _counters: dict[tuple[str, tuple], float] = {}       # guarded-by: _lock
@@ -92,6 +94,15 @@ def gauge(name: str, value: float, labels: dict | None = None) -> None:
     key = (name, _label_key(labels))
     with _lock:
         _gauges[key] = float(value)
+
+
+def gauges_snapshot() -> dict:
+    """Gauge series only (flattened name{labels} keys) — the history
+    sampler copies these per tick, and the conftest gauge-hygiene check
+    asserts the *_current/*_depth families drain to zero."""
+    with _lock:
+        return {name + _label_str(labels): v
+                for (name, labels), v in _gauges.items()}
 
 
 def snapshot() -> dict:
@@ -237,6 +248,13 @@ DEVICE_QUARANTINES = "tidb_tpu_device_quarantine_total"
 # server trace ring, labeled by what retained them
 # (sampled|slow|forced)
 TRACES = "tidb_tpu_statement_traces_total"
+# continuous resource metering (meter.py + metrics_history.py): the
+# history sampler derives these each tick — device busy-ns per wall
+# interval (can exceed 1.0 under dispatch overlap; that overlap IS the
+# pipeline working) and the HBM region-block cache's resident bytes
+# over its tidb_tpu_device_cache_bytes budget
+DEVICE_UTILIZATION = "tidb_tpu_device_utilization_ratio"
+HBM_OCCUPANCY = "tidb_tpu_hbm_occupancy_ratio"
 
 _HELP = {
     QUERY_DURATIONS: "Statement wall time through Session.execute.",
@@ -320,4 +338,9 @@ _HELP = {
     TRACES:
         "Statement traces retained into the server trace ring, "
         "by reason (sampled|slow|forced).",
+    DEVICE_UTILIZATION:
+        "Device busy-time per wall second over the last history "
+        "sampler interval (dispatch overlap can push it past 1.0).",
+    HBM_OCCUPANCY:
+        "HBM region-block cache resident bytes over its budget.",
 }

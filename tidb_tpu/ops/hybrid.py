@@ -47,8 +47,8 @@ import threading
 
 import numpy as np
 
-from tidb_tpu import config, memtrack, metrics, runtime_stats, sched, \
-    trace
+from tidb_tpu import config, memtrack, meter, metrics, runtime_stats, \
+    sched, trace
 from tidb_tpu.ops import runtime
 from tidb_tpu.ops.hashagg import (CapacityError, CollisionError,
                                   DeviceRejectError, GroupResult,
@@ -619,7 +619,8 @@ def _one_partition_agg(sub, filter_expr, group_exprs, aggs, plan,
                 reason = "unsupported"
                 break
         runtime_stats.note_fallback(plan, reason)
-        with trace.span("host.fallback", rows=sub.num_rows):
+        with meter.busy_section("host"), \
+                trace.span("host.fallback", rows=sub.num_rows):
             return host_hash_agg(sub, filter_expr, group_exprs, aggs)
 
 

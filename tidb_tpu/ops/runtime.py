@@ -154,15 +154,27 @@ def pipeline_map(items, dispatch, finalize, depth: int,
     its fair share — and past the scheduler's bypass valve the dispatch
     proceeds unscheduled, so the global window can throttle but never
     hang a statement."""
-    from tidb_tpu import sched, trace
+    import time as _time
+
+    from tidb_tpu import meter, sched, trace
     from tidb_tpu.util import failpoint
     scheduler = sched.device_scheduler()
     depth = max(int(depth), 1)
     pending: deque = deque()
     track = tracker is not None and cost is not None
 
+    def _token_kind(tok) -> str:
+        # host-path items: None (the common convention) or the fused
+        # probe-agg's explicit ("host", ...) token — everything else
+        # really enqueued device work
+        if tok is None or (isinstance(tok, tuple) and tok
+                           and tok[0] == "host"):
+            return "host"
+        return "device"
+
     def pop_finalize():
         prev, seq, tok, held, slot = pending.popleft()
+        kind = _token_kind(tok)
         try:
             # the watchdog bounds the blocking readback: past
             # tidb_tpu_dispatch_timeout_ms the statement cancels with
@@ -173,9 +185,14 @@ def pipeline_map(items, dispatch, finalize, depth: int,
                 failpoint.eval("device/finalize")
                 # the blocking readback at the output boundary — the
                 # per-superchunk finalize serialization the Chrome
-                # export makes visible next to the dispatch-ahead lanes
-                with trace.span("finalize", superchunk=seq,
-                                host=int(tok is None)):
+                # export makes visible next to the dispatch-ahead
+                # lanes. The interval bills to the tenant's work
+                # ledger (meter.py) as a SECTION: escalation retries
+                # and degraded partitions inside the finalize meter
+                # themselves, and the section charges the remainder
+                with meter.busy_section(kind), \
+                        trace.span("finalize", superchunk=seq,
+                                   host=int(kind == "host")):
                     return finalize(prev, tok)
         finally:
             scheduler.release(slot)
@@ -184,10 +201,15 @@ def pipeline_map(items, dispatch, finalize, depth: int,
 
     def acquire_slot(bypass: bool):
         # the global round-robin slot wait, traced per attempt so slot
-        # stalls attribute to THIS statement's timeline
-        with trace.span("sched.slot"):
-            return scheduler.acquire_or_bypass() if bypass \
-                else scheduler.acquire()
+        # stalls attribute to THIS statement's timeline (and to the
+        # tenant's slot-wait ledger)
+        t0 = _time.perf_counter_ns()
+        try:
+            with trace.span("sched.slot"):
+                return scheduler.acquire_or_bypass() if bypass \
+                    else scheduler.acquire()
+        finally:
+            meter.note_slot_wait(_time.perf_counter_ns() - t0)
 
     seq = -1
     try:
@@ -206,8 +228,14 @@ def pipeline_map(items, dispatch, finalize, depth: int,
                 tracker.consume(host=held)
             try:
                 failpoint.eval("device/dispatch")
-                with trace.span("dispatch", superchunk=seq):
+                # the enqueue interval (pad/transfer/launch) meters as
+                # device time for device tokens, host-fallback time for
+                # host-path items — the kind is only known once
+                # dispatch() returns, so it is assigned on the section
+                busy = meter.busy_section()
+                with busy, trace.span("dispatch", superchunk=seq):
                     tok = dispatch(it)
+                    busy.kind = _token_kind(tok)
             except BaseException as e:
                 # executor-plane device faults feed the same health
                 # tracker as the copr sites, so repeated pipeline
@@ -241,7 +269,10 @@ def pipeline_map(items, dispatch, finalize, depth: int,
         while pending:
             prev, _seq, tok, held, slot = pending.popleft()
             try:
-                finalize(prev, tok)
+                # abandoned tokens still occupied the device until this
+                # drain — their finalize interval meters like any other
+                with meter.busy_section(_token_kind(tok)):
+                    finalize(prev, tok)
             except Exception:
                 pass    # the slot is dead either way; ledger cleanup
                 #         continues with the remaining slots

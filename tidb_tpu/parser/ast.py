@@ -459,6 +459,7 @@ class ShowStmt(StmtNode):
     pattern: Optional[str] = None    # LIKE '...'
     where: Optional[ExprNode] = None
     is_global: bool = False
+    full: bool = False       # SHOW FULL PROCESSLIST: untruncated Info
 
 
 @dataclass

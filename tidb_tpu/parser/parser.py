@@ -1608,7 +1608,7 @@ class Parser:
             s.is_global = True
         else:
             self.try_kw("SESSION")
-        self.try_kw("FULL")
+        s.full = self.try_kw("FULL")
         if self.try_kw("DATABASES") or self.try_kw("SCHEMA"):
             s.tp = "databases"
         elif self.try_kw("TABLES"):

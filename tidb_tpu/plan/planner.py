@@ -195,7 +195,7 @@ class Planner:
 
     _MEMTABLES = ("schemata", "tables", "columns", "statistics",
                   "character_sets", "collations", "memory_usage",
-                  "statement_traces")
+                  "statement_traces", "resource_usage")
 
     def _build_memtable(self, ts: ast.TableSource) -> ph.PhysValues:
         """Serve catalog metadata as constant rows computed from the
@@ -296,6 +296,44 @@ class Planner:
                      ("peak_host_bytes", intf),
                      ("peak_device_bytes", intf)], rows)
             # tracker state moves per statement with no schema-version
+            # bump: a cached plan would serve a frozen snapshot forever
+            pv.cacheable = False
+            return pv
+        if name == "resource_usage":
+            # the continuous resource meter (meter.py): cumulative AND
+            # current-interval work per tenant — device busy-time,
+            # host-fallback time, sched slot / admission waits, bytes
+            # dispatched, rows served — one row per user and per
+            # session (live or retained-closed), plus the SERVER total
+            # row the per-session sum reconciles against
+            from tidb_tpu import meter
+            rows = []
+
+            def row(scope, snap):
+                iv = snap["interval"]
+                rows.append((scope, snap["session_id"],
+                             snap["user"] or None, snap["statements"],
+                             snap["device_ns"], iv["device_ns"],
+                             snap["host_fallback_ns"],
+                             snap["slot_wait_ns"],
+                             snap["admission_wait_ns"],
+                             snap["rows_sent"], snap["bytes_encoded"],
+                             snap["bytes_decoded_equiv"]))
+
+            row("server", meter.server_snapshot())
+            for snap in meter.users_snapshot():
+                row("user", snap)
+            for snap in meter.sessions_snapshot():
+                row("session", snap)
+            pv = mk([("scope", sf), ("session_id", intf), ("user", sf),
+                     ("statements", intf), ("device_time_ns", intf),
+                     ("device_time_interval_ns", intf),
+                     ("host_fallback_ns", intf),
+                     ("slot_wait_ns", intf),
+                     ("admission_wait_ns", intf),
+                     ("rows_sent", intf), ("bytes_encoded", intf),
+                     ("bytes_decoded_equiv", intf)], rows)
+            # meter state moves per statement with no schema-version
             # bump: a cached plan would serve a frozen snapshot forever
             pv.cacheable = False
             return pv

@@ -265,10 +265,12 @@ def note_bytes_touched(decoded_equiv: int, encoded: int) -> None:
     actually staged/read (dict codes + validity at the padded bucket),
     `decoded_equiv` is what the same input would occupy decoded into
     wide host vectors — the auditable compression win BENCH reports as
-    the per-query bytes_touched column."""
-    from tidb_tpu import metrics
+    the per-query bytes_touched column. Also the per-tenant bytes
+    ledger's single chokepoint (meter.py)."""
+    from tidb_tpu import meter, metrics
     metrics.counter(metrics.BYTES_DECODED_EQUIV, inc=decoded_equiv)
     metrics.counter(metrics.BYTES_ENCODED, inc=encoded)
+    meter.note_bytes(encoded, decoded_equiv)
 
 
 def note_fallback(plan, reason: str) -> None:

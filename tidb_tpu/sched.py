@@ -61,7 +61,7 @@ import contextlib
 import threading
 import time
 
-from tidb_tpu import config, memtrack, metrics, trace
+from tidb_tpu import config, memtrack, meter, metrics, trace
 from tidb_tpu.util import failpoint
 
 __all__ = ["DeviceScheduler", "AdmissionController",
@@ -371,8 +371,12 @@ class AdmissionController:
                 self._waiting -= 1
                 metrics.gauge(metrics.ADMISSION_QUEUE_DEPTH,
                               self._waiting)
-            metrics.histogram(metrics.ADMISSION_WAITS,
-                              (time.perf_counter_ns() - t0) / 1e9)
+            waited_ns = time.perf_counter_ns() - t0
+            metrics.histogram(metrics.ADMISSION_WAITS, waited_ns / 1e9)
+            # the per-tenant admission-wait ledger (meter.py): the
+            # session thread runs admit() with its statement meter
+            # installed, so the wait attributes to the right tenant
+            meter.note_admission_wait(waited_ns)
         metrics.counter(metrics.ADMISSIONS, {"outcome": outcome})
         return projected
 
@@ -676,11 +680,12 @@ class device_slot:
     retryable device-fault error AFTER the slot (and, one context
     inward, the memtrack.device_scope ledger bytes) released."""
 
-    __slots__ = ("_slot", "_wtok")
+    __slots__ = ("_slot", "_wtok", "_busy")
 
     def __init__(self):
         self._slot = None
         self._wtok = None
+        self._busy = None
 
     def __enter__(self):
         self._wtok = _WATCHDOG.begin("sync-dispatch")
@@ -688,9 +693,22 @@ class device_slot:
             failpoint.eval("sched/slot")
             # the slot WAIT is a statement-trace phase of its own: the
             # span covers only the acquire, not the guarded dispatch
+            t0 = time.perf_counter_ns()
             with trace.span("sched.slot", sync=1):
                 self._slot = _SCHEDULER.acquire_or_bypass()
+            # per-tenant attribution (meter.py): the acquire is slot
+            # wait; everything from here to __exit__ is the dispatch/
+            # finalize interval this slot guards — device busy-time,
+            # billed as a section so a nested retry's own device_slot
+            # cannot double-count the same wall time
+            meter.note_slot_wait(time.perf_counter_ns() - t0)
+            self._busy = meter.busy_section().__enter__()
         except BaseException:
+            # anything that raises after a successful acquire (the
+            # meter bookkeeping above is new code in this window) must
+            # hand the slot back — __exit__ will never run
+            _SCHEDULER.release(self._slot)
+            self._slot = None
             _WATCHDOG.end(self._wtok)
             self._wtok = None
             raise
@@ -699,6 +717,11 @@ class device_slot:
     def __exit__(self, exc_type, exc, tb):
         _SCHEDULER.release(self._slot)
         self._slot = None
+        if self._busy is not None:
+            # busy even on an error path: the device (attempt) really
+            # occupied this interval
+            self._busy.__exit__(exc_type, exc, tb)
+            self._busy = None
         expired = _WATCHDOG.end(self._wtok)
         self._wtok = None
         if expired and exc_type is None:

@@ -93,6 +93,10 @@ class Server:
         return self.addr[1]
 
     def start(self) -> None:
+        # a serving process keeps its metrics history recording (the
+        # supervised sampler in tidb_tpu/metrics_history.py; idempotent)
+        from tidb_tpu import metrics_history
+        metrics_history.ensure_started()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="mysql-accept")
         self._thread.start()

@@ -142,6 +142,29 @@ class _Handler(BaseHTTPRequestHandler):
                             "reason": rec["reason"],
                             "spans": trace.tree(rec["root"])})
                 return
+            if self.path.startswith("/metrics/history"):
+                # the in-process time-series ring (metrics_history.py):
+                # registered gauges + derived device-utilization / HBM
+                # occupancy / hit-rate series sampled on the
+                # tidb_tpu_metrics_history_interval_ms cadence
+                from tidb_tpu import metrics_history
+                self._json({"history": metrics_history.stats(),
+                            "series": metrics_history.series()})
+                return
+            if self.path.startswith("/top"):
+                # live utilization: top sessions and statement digests
+                # by device busy-time (meter.py) — ranked by the last
+                # sampler interval, cumulative as the tiebreak
+                from tidb_tpu import meter
+                self._json({
+                    "server": meter.server_snapshot(),
+                    "attributed_device_ns":
+                        meter.attributed_device_ns(),
+                    "sessions": meter.top_sessions(),
+                    "users": meter.users_snapshot(),
+                    "digests": meter.top_digests(),
+                })
+                return
             if self.path == "/shed":
                 # administrative shed hook (the KILL-style escape hatch):
                 # drives the SERVER memtrack root's registered shed chain
@@ -217,6 +240,10 @@ class StatusServer:
         self._thread: threading.Thread | None = None
 
     def start(self) -> None:
+        # a status port implies an operator watching: make sure the
+        # history sampler is recording for /metrics/history
+        from tidb_tpu import metrics_history
+        metrics_history.ensure_started()
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True, name="status-http")
         self._thread.start()

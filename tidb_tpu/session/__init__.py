@@ -350,10 +350,22 @@ class Session:
             self.killed = False           # KILL QUERY flag (cooperative)
             self.kill_hook = None         # server sets: closes the conn
             self.mem_tracker = None       # session memory root (memtrack)
+            self.res_meter = None         # resource meter (meter.py)
             if not internal:
                 _SESSIONS.add(self)
-                from tidb_tpu import memtrack
+                from tidb_tpu import memtrack, meter
                 self.mem_tracker = memtrack.session_root(self.session_id)
+                # the per-tenant work ledger: retained (bounded) after
+                # the session closes, so device-seconds done by a
+                # finished connection still reconcile in resource_usage
+                self.res_meter = meter.session_meter(self.session_id,
+                                                     self.user or "")
+                # mark the meter evictable once the session dies —
+                # eviction past the registry cap prefers closed
+                # sessions, so a live tenant never drops off the
+                # attribution surfaces
+                self._meter_finalizer = weakref.finalize(
+                    self, meter.session_closed, self.session_id)
                 # sessions are not reliably close()d (pools, tests): the
                 # finalizer detaches the tracker from the server root so
                 # information_schema.memory_usage never lists the dead
@@ -399,17 +411,19 @@ class Session:
         slow-log emit at :353). Internal bookkeeping sessions skip the
         instrumentation entirely — their catalog lookups are not client
         queries and would pollute the metrics."""
-        from tidb_tpu import (config, memtrack, metrics, perfschema, sched,
-                              trace)
+        from tidb_tpu import (config, memtrack, meter, metrics, perfschema,
+                              sched, trace)
         from tidb_tpu import runtime_stats as rs
         if self.internal:
             # internal catalog work must neither appear in perfschema nor
             # attach spans to the enclosing client statement's trace —
-            # nor record its scans into that statement's operator stats
-            # or bill its buffers to that statement's memory quota
+            # nor record its scans into that statement's operator stats,
+            # bill its buffers to that statement's memory quota, or
+            # credit its device work to that statement's tenant meter
             token = trace.detach()
             try:
-                with rs.suspended(), memtrack.suspended():
+                with rs.suspended(), memtrack.suspended(), \
+                        meter.suspended():
                     return self._run_stmt(stmt, sql_text=sql_text)
             finally:
                 trace.restore(token)
@@ -475,8 +489,12 @@ class Session:
         # operator must be able to work a busy server out of trouble.
         adm = sched.admission()
         admission_ticket = None
+        # per-statement resource meter (meter.py): rolls up live into
+        # the session/user/SERVER ledgers; installed around admission
+        # too so the admission wait attributes to this tenant
+        sm = meter.statement_meter(self.res_meter)
         try:
-            with config.session_overlay(overlay):
+            with config.session_overlay(overlay), meter.metering(sm):
                 mt.quota = config.mem_quota_query()   # session-shadowed
                 try:
                     if _needs_admission(stmt):
@@ -554,6 +572,11 @@ class Session:
                 tag=None if batch_no is None
                 else f"stmt#{batch_no}:{kind}",
                 trace_id=trace_id)
+            # rows served + statement count land on the meter here (the
+            # one place the row count is known), then the statement's
+            # metered totals fold into the per-digest rollup /top ranks
+            sm.add(rows_sent=nrows, statements=1)
+            meter.finish_statement(sm, digest, _norm)
             for s in ops:
                 if not s.loops:
                     continue   # operator never produced (cached sub-plan)
@@ -755,6 +778,10 @@ class Session:
             perfschema.session_closed(self.session_id)
             if self.mem_tracker is not None:
                 self._mem_finalizer()   # detach from the server root
+            if self.res_meter is not None:
+                # an explicit close must not wait for GC to mark the
+                # meter evictable (registry eviction prefers closed)
+                self._meter_finalizer()
         if self.txn is not None:
             self.txn.rollback()
             self.txn = None
@@ -2032,16 +2059,25 @@ class Session:
             for s in sorted(live, key=lambda x: x.session_id):
                 sql = s.current_sql
                 tracker = getattr(s, "mem_tracker", None)
+                rm = getattr(s, "res_meter", None)
+                mtot = rm.totals() if rm is not None else {}
                 rows.append((s.session_id, s.user, s.host,
                              s.current_db or None,
                              "Query" if sql else "Sleep",
                              int(now - s.created_at),
                              "" if sql else None,
-                             (sql or "")[:100] or None,
+                             # SHOW FULL PROCESSLIST: untruncated SQL
+                             ((sql or "") if stmt.full
+                              else (sql or "")[:100]) or None,
                              tracker.total() if tracker is not None
-                             else 0))
+                             else 0,
+                             # cumulative metered work (meter.py):
+                             # device busy-time in ms + rows served
+                             mtot.get("device_ns", 0) // 1_000_000,
+                             mtot.get("rows_sent", 0)))
             return ResultSet(["Id", "User", "Host", "db", "Command",
-                              "Time", "State", "Info", "Mem"], rows)
+                              "Time", "State", "Info", "Mem",
+                              "DeviceTime", "RowsSent"], rows)
         if stmt.tp == "create_table":
             db = stmt.table.db or self.current_db
             t = ischema.table(db, stmt.table.name)

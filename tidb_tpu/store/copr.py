@@ -15,7 +15,7 @@ import threading
 from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 
-from tidb_tpu import (config, kv, memtrack, runtime_stats, sched,
+from tidb_tpu import (config, kv, memtrack, meter, runtime_stats, sched,
                       tablecodec, trace)
 from tidb_tpu.kv import (CopRequest, CopResponse, KVRange, NotLeaderError,
                          RegionError, ReqType, ServerBusyError,
@@ -350,8 +350,10 @@ def exec_cop_plan(plan: CopPlan, chunk, sources: int = 1,
         runtime_stats.note_encoding(plan, "decoded")
         # host-path agg time is its own attribution phase: with the
         # device degraded/quarantined (or plain host mode) THIS is
-        # where the statement's microseconds go
-        with trace.span("host.fallback", rows=chunk.num_rows):
+        # where the statement's microseconds go — on the trace AND on
+        # the tenant's host-fallback ledger (meter.py)
+        with meter.busy_section("host"), \
+                trace.span("host.fallback", rows=chunk.num_rows):
             if plan.group_exprs:
                 return CopResponse(chunk=host_hash_agg(
                     chunk, plan.filter, plan.group_exprs, plan.aggs))
@@ -696,18 +698,20 @@ class CopClient(kv.Client):
         # the session's sysvar overlay is thread-local: capture it here
         # and re-install inside every pool worker so per-session knobs
         # (device on/off, cache) apply uniformly across the fan-out —
-        # the runtime-stats collector, the memory tracker AND the
-        # statement trace ride along the same way, so storage-side
-        # device kernels attribute their time, bytes and spans to the
-        # reader node that issued them
+        # the runtime-stats collector, the memory tracker, the resource
+        # meter AND the statement trace ride along the same way, so
+        # storage-side device kernels attribute their time, bytes and
+        # spans to the reader node (and tenant) that issued them
         overlay = config.current_overlay()
         mem_root = memtrack.current()
+        res_meter = meter.current()
         tspan = trace.propagate()
 
         def run_task(rq, rng):
             with config.session_overlay(overlay), \
                     runtime_stats.collecting(coll), \
                     memtrack.tracking(mem_root), \
+                    meter.metering(res_meter), \
                     trace.attached(tspan):
                 with trace.span("copr.task"):
                     return list(self._run_task(rq, rng))
@@ -725,6 +729,7 @@ class CopClient(kv.Client):
                 with config.session_overlay(overlay), \
                         runtime_stats.collecting(coll), \
                         memtrack.tracking(mem_root), \
+                        meter.metering(res_meter), \
                         trace.attached(tspan):
                     for _loc, rng in task_list:
                         with trace.span("copr.task"):
@@ -858,6 +863,7 @@ class CopClient(kv.Client):
         overlay = config.current_overlay()
         coll = runtime_stats.current()
         mem_root = memtrack.current()
+        res_meter = meter.current()
         tspan = trace.propagate()
         buckets = [tasks[i::concurrency] for i in range(concurrency)]
 
@@ -866,6 +872,7 @@ class CopClient(kv.Client):
                 with config.session_overlay(overlay), \
                         runtime_stats.collecting(coll), \
                         memtrack.tracking(mem_root), \
+                        meter.metering(res_meter), \
                         trace.attached(tspan), \
                         trace.span("copr.stream", tasks=len(task_list)):
                     for _loc, rng in task_list:
@@ -908,6 +915,7 @@ class CopClient(kv.Client):
         overlay = config.current_overlay()
         coll = runtime_stats.current()
         mem_root = memtrack.current()
+        res_meter = meter.current()
         tspan = trace.propagate()
         pool = ThreadPoolExecutor(max_workers=concurrency,
                                   thread_name_prefix="cop-stream-ord")
@@ -920,6 +928,7 @@ class CopClient(kv.Client):
                     with config.session_overlay(overlay), \
                             runtime_stats.collecting(coll), \
                             memtrack.tracking(mem_root), \
+                            meter.metering(res_meter), \
                             trace.attached(tspan), \
                             trace.span("copr.stream"):
                         for resp in self._run_task_stream(

@@ -21,6 +21,7 @@ ALLOWED_PREFIXES = (
     "stats-auto-analyze", "storage-accept", "storage-conn",
     "status-http", "server-accept", "x-server", "gc-worker",
     "ThreadPoolExecutor", "delta-merge", "dispatch-watchdog",
+    "metrics-history",
 )
 
 
