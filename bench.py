@@ -1209,6 +1209,256 @@ def serve_main() -> None:
     }))
 
 
+def _fleet_http(host: str, port: int, path: str, timeout: float = 15.0):
+    """GET a JSON document from one fleet member's status server."""
+    import urllib.request
+    with urllib.request.urlopen(f"http://{host}:{port}{path}",
+                                timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def _metric_total(snap: dict, name: str):
+    """Sum one counter family over every label combination in a flat
+    metrics.snapshot() dict (keys look like 'name{label="v"}')."""
+    return sum(v for k, v in snap.items()
+               if k == name or k.startswith(name + "{"))
+
+
+def _fleet_bench(progress) -> dict:
+    """Fleet scale-out harness (ISSUE 16 / ROADMAP item 4): one
+    store-plane process + BENCH_FLEET_SERVERS stateless SQL-server
+    processes, each with its own journal-coherent chunk/HBM caches
+    (store/fleetcop.py). The same open-loop mixed workload (TPC-H
+    Q1/Q3/Q5 + point lookups, BENCH_FLEET_CLIENTS wire connections)
+    replays against the first 1, 2, ... N servers; reports aggregate
+    statements/sec per leg, per-class p50/p99, and per-server meter
+    utilization scraped from each member's /top endpoint — the
+    scaling series scripts/fleet_bench.sh pins (N-server aggregate
+    must be >= 2x single-server at N=4).
+
+    Env knobs: BENCH_FLEET_SERVERS (4), BENCH_FLEET_CLIENTS (8),
+    BENCH_FLEET_ROUNDS (2), BENCH_FLEET_LOOKUPS (8),
+    BENCH_FLEET_SF (0.02)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tests.mysql_client import MiniClient, MySQLError
+    from tidb_tpu import errcode
+    from tidb_tpu.benchmarks import tpch
+    from tidb_tpu.fleet import Fleet
+    from tidb_tpu.session import Session
+    from tidb_tpu.store.remote import connect
+
+    n_servers = int(os.environ.get("BENCH_FLEET_SERVERS", "4"))
+    n_clients = int(os.environ.get("BENCH_FLEET_CLIENTS", "8"))
+    rounds = int(os.environ.get("BENCH_FLEET_ROUNDS", "2"))
+    lookups = int(os.environ.get("BENCH_FLEET_LOOKUPS", "8"))
+    sf = float(os.environ.get("BENCH_FLEET_SF", "0.02"))
+    leg_counts = [n for n in (1, 2, 4) if n <= n_servers]
+    if leg_counts[-1] != n_servers:
+        leg_counts.append(n_servers)
+
+    data = tpch.ScaledTpch(sf=sf)
+    classes = list(tpch.QUERIES)
+    n_orders = data.counts["orders"]
+
+    def client_ops(ci: int) -> list:
+        ops = []
+        for r in range(rounds):
+            q = classes[(ci + r) % len(classes)]
+            ops.append((q, tpch.QUERIES[q]))
+            for j in range(lookups):
+                k = (ci * 7919 + r * 104729 + j * 131) % n_orders
+                ops.append(("point", "SELECT o_custkey, o_orderpriority "
+                            f"FROM orders WHERE o_orderkey = {k}"))
+        return ops
+
+    all_ops = [client_ops(ci) for ci in range(n_clients)]
+    total_stmts = sum(len(ops) for ops in all_ops)
+
+    progress(f"fleet: starting store plane + {n_servers} SQL servers")
+    fleet = Fleet(n_sql=n_servers)
+    fleet.start()
+    out: dict = {"servers": n_servers, "clients": n_clients,
+                 "rounds": rounds, "lookups_per_round": lookups,
+                 "sf": sf, "stmts_per_leg": total_stmts}
+    try:
+        fleet.wait_healthy(timeout=120)
+
+        # load through a direct store-plane session (bulk import over
+        # the wire); the DDL lands in the shared store, so every SQL
+        # member converges within its schema lease
+        progress(f"fleet: loading sf={sf} via the store plane")
+        storage = connect(fleet.host, fleet.store_port)
+        session = Session(storage)
+        session.execute("CREATE DATABASE tpch_fleet")
+        session.execute("USE tpch_fleet")
+        out["rows_loaded"] = tpch.load(session, storage, data,
+                                       regions_per_table=2)
+        session.close()
+        storage.close()
+
+        def member_client(mi: int) -> MiniClient:
+            c = MiniClient(fleet.host, fleet.members[mi].port,
+                           db="tpch_fleet")
+            c.sock.settimeout(600)
+            return c
+
+        def wait_schema(mi: int, timeout: float = 90.0) -> None:
+            deadline = time.monotonic() + timeout
+            while True:
+                try:
+                    c = member_client(mi)
+                    try:
+                        c.query("SELECT COUNT(*) FROM orders")
+                        return
+                    finally:
+                        c.close()
+                except (MySQLError, OSError):
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.25)
+
+        # warm every member: schema convergence + first-compile + the
+        # journal-coherent cache fill, so no leg pays cold-start costs
+        progress("fleet: warmup (schema convergence + cache fill)")
+        for mi in range(n_servers):
+            wait_schema(mi)
+            c = member_client(mi)
+            for q in classes:
+                c.query(tpch.QUERIES[q])
+            c.query("SELECT o_custkey FROM orders WHERE o_orderkey = 1")
+            c.close()
+
+        def run_ops(cli, ops, lat, errors) -> None:
+            for cls, sql2 in ops:
+                t0 = time.perf_counter()
+                tries = 0
+                while True:
+                    try:
+                        cli.query(sql2)
+                        break
+                    except MySQLError as e:
+                        if e.code in errcode.RETRYABLE and tries < 200:
+                            tries += 1
+                            time.sleep(0.05)
+                            continue
+                        errors.append(f"{cls}: ({e.code}) {e}")
+                        break
+                lat.setdefault(cls, []).append(time.perf_counter() - t0)
+
+        def member_mark(mi: int) -> dict:
+            m = fleet.members[mi]
+            top = _fleet_http(fleet.host, m.status_port, "/top")
+            status = fleet.health(mi)
+            return {"device_ns": top["server"]["device_ns"],
+                    "host_ns": top["server"]["host_fallback_ns"],
+                    "stmts": _metric_total(status["metrics"],
+                                           "tidb_tpu_queries_total")}
+
+        legs = []
+        for n in leg_counts:
+            progress(f"fleet: leg x{n} server(s), "
+                     f"{n_clients} clients, {total_stmts} stmts")
+            marks = [member_mark(mi) for mi in range(n)]
+            lats = [dict() for _ in range(n_clients)]
+            errlists = [list() for _ in range(n_clients)]
+            clients = [member_client(ci % n) for ci in range(n_clients)]
+            start = threading.Barrier(n_clients + 1)
+
+            def worker(ci: int) -> None:
+                start.wait()
+                run_ops(clients[ci], all_ops[ci], lats[ci],
+                        errlists[ci])
+
+            threads = [threading.Thread(target=worker, args=(ci,),
+                                        name=f"fleet-client-{ci}")
+                       for ci in range(n_clients)]
+            for t in threads:
+                t.start()
+            start.wait()
+            t0 = time.perf_counter()
+            for t in threads:
+                t.join()
+            secs = time.perf_counter() - t0
+            for c in clients:
+                c.close()
+            errs = [e for el in errlists for e in el]
+            if errs:
+                raise RuntimeError(f"fleet leg x{n} errors: {errs[:3]}")
+            lat_all: dict = {}
+            for d in lats:
+                for cls, xs in d.items():
+                    lat_all.setdefault(cls, []).extend(xs)
+            per_server = {}
+            for mi in range(n):
+                after = member_mark(mi)
+                busy = (after["device_ns"] -
+                        marks[mi]["device_ns"]) / 1e9
+                per_server[str(mi)] = {
+                    "stmts": int(after["stmts"] - marks[mi]["stmts"]),
+                    "device_busy_secs": round(busy, 4),
+                    "device_busy_fraction": round(busy / secs, 4)
+                    if secs > 0 else 0.0,
+                    "host_fallback_secs": round(
+                        (after["host_ns"] - marks[mi]["host_ns"]) / 1e9,
+                        4)}
+            legs.append({"servers": n, "secs": round(secs, 3),
+                         "stmts_per_sec": round(total_stmts / secs, 1),
+                         "latency": _lat_summary(lat_all),
+                         "per_server": per_server})
+        out["legs"] = legs
+        out["scaling_max_vs_1"] = round(
+            legs[-1]["stmts_per_sec"] / legs[0]["stmts_per_sec"], 3)
+
+        # coherence counters per member: journal-window pulls by
+        # outcome, rows patched into resident blocks, and the local
+        # (cached) vs store-delegated coprocessor split
+        coherence = {}
+        for mi in range(n_servers):
+            snap = fleet.health(mi)["metrics"]
+            coherence[str(mi)] = {
+                "journal_pulls": int(_metric_total(
+                    snap, "tidb_tpu_fleet_journal_pulls_total")),
+                "patched_rows": int(_metric_total(
+                    snap, "tidb_tpu_fleet_journal_patched_rows_total")),
+                "local_cop": int(snap.get(
+                    'tidb_tpu_fleet_local_cop_total{path="cached"}',
+                    0)),
+                "store_cop": int(snap.get(
+                    'tidb_tpu_fleet_local_cop_total{path="store"}',
+                    0)),
+                "delta_serves": int(_metric_total(
+                    snap, "tidb_tpu_cache_served_with_delta_total"))}
+        out["coherence"] = coherence
+        progress(f"fleet: scaling x{leg_counts[-1]} vs x1 = "
+                 f"{out['scaling_max_vs_1']}")
+    finally:
+        fleet.stop()
+    return out
+
+
+def fleet_main() -> None:
+    """`python bench.py fleet`: ONLY the fleet scale-out harness — the
+    CI entry point (scripts/fleet_bench.sh) with its own one-line
+    JSON."""
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        _scope_cpu_compile_cache()
+    t_start = time.perf_counter()
+
+    def progress(msg: str) -> None:
+        print(f"[fleet +{time.perf_counter() - t_start:7.1f}s] {msg}",
+              file=sys.stderr, flush=True)
+
+    fl = _fleet_bench(progress)
+    legs = fl.get("legs", [])
+    print(json.dumps({
+        "metric": "fleet_stmts_per_sec",
+        "value": legs[-1]["stmts_per_sec"] if legs else 0.0,
+        "unit": "stmts/s",
+        "vs_baseline": fl.get("scaling_max_vs_1", 0.0),
+        "detail": fl,
+    }))
+
+
 def _validate_chrome(doc: dict) -> None:
     """Chrome trace-event schema check (the contract Perfetto /
     chrome://tracing loads): raises on violation."""
@@ -2161,6 +2411,8 @@ if __name__ == "__main__":
         htap_main()
     elif len(sys.argv) > 1 and sys.argv[1] == "encoded":
         encoded_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "fleet":
+        fleet_main()
     elif len(sys.argv) > 1 and sys.argv[1] == "chaos":
         chaos_main()
     elif len(sys.argv) > 1 and sys.argv[1] == "trace":

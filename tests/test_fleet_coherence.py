@@ -1,0 +1,140 @@
+"""Fleet cache coherence (store/fleetcop.py): a stateless SQL server's
+OWN chunk/HBM caches stay hot across another writer's commits by
+pulling the store plane's delta-journal window over the wire and
+patching resident blocks in place — never a full re-fill, never a
+violation of snapshot isolation (a reader at T applies only deltas
+with commit_ts <= T). The acceptance pins for ISSUE 16's tentpole
+part 3."""
+
+import pytest
+
+from tidb_tpu import config, metrics
+from tidb_tpu.session import Session
+from tidb_tpu.store.remote import StorageServer, connect
+
+
+def _counter(name: str, **labels) -> float:
+    snap = metrics.snapshot()
+    if labels:
+        lab = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+        return snap.get(f"{name}{{{lab}}}", 0)
+    return sum(v for k, v in snap.items()
+               if k == name or k.startswith(name + "{"))
+
+
+@pytest.fixture
+def fleet_env():
+    """One store-plane process-equivalent (StorageServer socket) plus a
+    fleet-mode client storage (local caches + journal coherence) and a
+    plain remote writer — two 'SQL servers' sharing one store plane."""
+    srv = StorageServer()
+    srv.start()
+    st = connect("127.0.0.1", srv.port, local_cache=True)
+    wst = connect("127.0.0.1", srv.port)
+    s = Session(st)
+    w = Session(wst)
+    s.execute("CREATE DATABASE d")
+    s.execute("USE d")
+    w.execute("USE d")
+    yield srv, st, s, w
+    w.close()
+    s.close()
+    wst.close()
+    st.close()
+    srv.close()
+
+
+def _load(s, n=64):
+    s.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, v BIGINT)")
+    s.execute("INSERT INTO t VALUES " +
+              ", ".join(f"({i}, {i % 7})" for i in range(n)))
+    return sum(i % 7 for i in range(n))
+
+
+class TestJournalCoherence:
+    def test_remote_commit_served_by_patched_resident_block(
+            self, fleet_env):
+        """THE coherence acceptance test: after the local fill, a write
+        committed by ANOTHER server is served from the resident block
+        via a shipped journal window — cache hit + patch, no re-fill."""
+        srv, st, s, w = fleet_env
+        total = _load(s)
+        assert s.query("SELECT SUM(v) FROM t").rows[0][0] == total
+        hits0, miss0 = st.chunk_cache.hits, st.chunk_cache.misses
+        d0 = _counter(metrics.CACHE_DELTA_SERVES)
+        p0 = _counter(metrics.FLEET_PATCHED_ROWS)
+        w0 = _counter(metrics.FLEET_JOURNAL_PULLS, outcome="window")
+        w.execute("INSERT INTO t VALUES (1000, 3)")
+        w.execute("DELETE FROM t WHERE id = 0")
+        w.execute("UPDATE t SET v = v + 1 WHERE id = 1")
+        want = total + 3 - 0 + 1
+        assert s.query("SELECT SUM(v) FROM t").rows[0][0] == want
+        assert st.chunk_cache.misses == miss0, "block was re-filled"
+        assert st.chunk_cache.hits > hits0
+        assert _counter(metrics.CACHE_DELTA_SERVES) > d0
+        assert _counter(metrics.FLEET_PATCHED_ROWS) >= p0 + 3
+        assert _counter(metrics.FLEET_JOURNAL_PULLS,
+                        outcome="window") > w0
+
+    def test_reader_at_t_never_sees_later_delta(self, fleet_env):
+        """Snapshot isolation across the wire: a transaction reading at
+        T must not observe a delta committed after T, even though the
+        resident block could be patched to the newer state."""
+        srv, st, s, w = fleet_env
+        total = _load(s)
+        s.execute("BEGIN")
+        assert s.query("SELECT SUM(v) FROM t").rows[0][0] == total
+        w.execute("INSERT INTO t VALUES (2000, 6)")
+        # repeatable: the (fill_ts, T] window excludes the new commit
+        assert s.query("SELECT SUM(v) FROM t").rows[0][0] == total
+        s.execute("COMMIT")
+        assert s.query("SELECT SUM(v) FROM t").rows[0][0] == total + 6
+
+    def test_truncated_journal_falls_back_to_refill(self, fleet_env):
+        """STALE handling: a store-plane merge that truncates the
+        journal under the local fill snapshot (retention 0) forces a
+        drop-and-refill — slower, never wrong."""
+        srv, st, s, w = fleet_env
+        total = _load(s)
+        assert s.query("SELECT SUM(v) FROM t").rows[0][0] == total
+        w.execute("INSERT INTO t VALUES (3000, 2)")
+        assert srv.storage.delta_store.merge(trigger="rows") >= 1
+        s0 = _counter(metrics.FLEET_JOURNAL_PULLS, outcome="stale")
+        d0 = _counter(metrics.CACHE_DELTA_SERVES)
+        assert s.query("SELECT SUM(v) FROM t").rows[0][0] == total + 2
+        assert _counter(metrics.FLEET_JOURNAL_PULLS,
+                        outcome="stale") > s0
+        assert _counter(metrics.CACHE_DELTA_SERVES) == d0, \
+            "a truncated window must re-scan, never patch"
+
+    def test_local_cache_sysvar_delegates_to_store_plane(
+            self, fleet_env):
+        srv, st, s, w = fleet_env
+        total = _load(s)
+        prev = config.get_var("tidb_tpu_fleet_local_cache")
+        config.set_var("tidb_tpu_fleet_local_cache", 0)
+        try:
+            r0 = _counter(metrics.FLEET_LOCAL_COP, path="store")
+            assert s.query("SELECT SUM(v) FROM t").rows[0][0] == total
+            assert _counter(metrics.FLEET_LOCAL_COP, path="store") > r0
+        finally:
+            config.set_var("tidb_tpu_fleet_local_cache", prev)
+        c0 = _counter(metrics.FLEET_LOCAL_COP, path="cached")
+        assert s.query("SELECT SUM(v) FROM t").rows[0][0] == total
+        assert _counter(metrics.FLEET_LOCAL_COP, path="cached") > c0
+
+    def test_disconnect_invalidates_region_epochs(self, fleet_env):
+        """ISSUE 16 satellite fix: a dropped store-plane connection
+        must flush every cached region epoch (and learned leader) so
+        the reconnecting server re-resolves instead of looping on
+        stream-interrupt retries with stale routing."""
+        srv, st, s, w = fleet_env
+        _load(s)
+        s.query("SELECT SUM(v) FROM t")
+        assert len(st.region_cache._by_start) > 0
+        st.rpc._notify_disconnect()
+        assert len(st.region_cache._by_start) == 0
+        assert len(st.region_cache._start_by_id) == 0
+        assert len(st.region_cache._leaders) == 0
+        # routing recovers by re-resolving through the region map
+        assert s.query("SELECT COUNT(*) FROM t").rows[0][0] == 64

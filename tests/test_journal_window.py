@@ -1,0 +1,164 @@
+"""Journal-window RPC (mockstore/rpc.py `journal_window`, Cmd 80): the
+store-plane primitive fleet cache coherence rides on. A remote SQL
+server asks for the engine freshness meta plus the delta-journal window
+(fill_ts, read_ts] over one region range; the reply must ship committed
+row deltas, degrade to the STALE sentinel when the journal was
+truncated above the fill (retention clamp: store/delta.py `_merge_table`
+honors `tidb_tpu_delta_retain_ms`), and arbitrate region epochs exactly
+like every other region RPC."""
+
+import numpy as np
+import pytest
+
+from tidb_tpu import config, kv
+from tidb_tpu.codec import prefix_next
+from tidb_tpu.mockstore.rpc import RegionCtx
+from tidb_tpu.session import Session
+from tidb_tpu.store import fleetcop
+from tidb_tpu.store.delta import STALE, PendingDelta
+from tidb_tpu.store.remote import StorageServer, connect
+from tidb_tpu.store.storage import new_mock_storage
+from tidb_tpu.tablecodec import record_prefix
+
+
+@pytest.fixture
+def env():
+    st = new_mock_storage()
+    s = Session(st)
+    s.execute("CREATE DATABASE d")
+    s.execute("USE d")
+    s.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, v BIGINT)")
+    s.execute("INSERT INTO t VALUES " +
+              ", ".join(f"({i}, {i})" for i in range(8)))
+    tid = s.domain.info_schema().table("d", "t").id
+    yield st, s, tid
+    s.close()
+    st.close()
+
+
+def _window(st, tid, fill_ts, read_ts, index_id=None):
+    start = record_prefix(tid)
+    loc = st.region_cache.locate(start)
+    return st.shim.journal_window(loc.ctx, tid, start,
+                                  prefix_next(start), fill_ts, read_ts,
+                                  index_id=index_id)
+
+
+class TestJournalWindowRPC:
+    def test_meta_only_when_no_fill_snapshot(self, env):
+        st, s, tid = env
+        meta = _window(st, tid, None, st.current_ts())
+        assert meta["delta"] is None
+        assert meta["delta_enabled"] is True
+        assert meta["data_version"] == st.engine.data_version
+        assert meta["max_commit_ts"] == st.engine.max_commit_ts
+        assert meta["locked"] is False
+
+    def test_empty_window_between_writes(self, env):
+        st, s, tid = env
+        ts = st.current_ts()
+        meta = _window(st, tid, ts, st.current_ts())
+        assert meta["delta"] is None
+        assert meta["delta_enabled"] is True
+
+    def test_window_ships_committed_rows_and_deletes(self, env):
+        st, s, tid = env
+        fill = st.current_ts()
+        s.execute("INSERT INTO t VALUES (100, 1), (101, 2)")
+        s.execute("DELETE FROM t WHERE id = 0")
+        meta = _window(st, tid, fill, st.current_ts())
+        tag, watermark, rows, upserts, deletes = meta["delta"]
+        assert tag == "win"
+        assert fill < watermark <= st.current_ts()
+        assert set(np.asarray(upserts).tolist()) == {100, 101}
+        assert np.asarray(deletes).tolist() == [0]
+        assert len(rows) == 2
+
+    def test_truncated_journal_reports_stale(self, env):
+        st, s, tid = env
+        s.query("SELECT SUM(v) FROM t")          # cache fill
+        fill = st.current_ts()
+        s.execute("UPDATE t SET v = 9 WHERE id = 1")
+        # retain 0 (the default): the merge truncates the whole journal
+        assert st.delta_store.merge(trigger="rows") >= 1
+        meta = _window(st, tid, fill, st.current_ts())
+        assert meta["delta"] == "stale"
+
+    def test_retention_keeps_window_across_merge(self, env):
+        """The fleet coherence prerequisite: with a retention window
+        configured, a merge may not truncate deltas younger than
+        `tidb_tpu_delta_retain_ms` even when no LOCAL cache entry pins
+        them — a remote server's fill snapshot is invisible here."""
+        st, s, tid = env
+        prev = config.get_var("tidb_tpu_delta_retain_ms")
+        config.set_var("tidb_tpu_delta_retain_ms", 60_000)
+        try:
+            fill = st.current_ts()
+            s.execute("INSERT INTO t VALUES (200, 5)")
+            st.delta_store.merge(trigger="rows")
+            meta = _window(st, tid, fill, st.current_ts())
+            assert meta["delta"] is not None and \
+                meta["delta"] != "stale", \
+                "retained journal must still serve the window"
+            assert set(np.asarray(meta["delta"][3]).tolist()) == {200}
+        finally:
+            config.set_var("tidb_tpu_delta_retain_ms", prev)
+
+    def test_epoch_mismatch_raises_region_error(self, env):
+        st, s, tid = env
+        start = record_prefix(tid)
+        loc = st.region_cache.locate(start)
+        stale_ctx = RegionCtx(loc.ctx.region_id, loc.ctx.version + 1,
+                              loc.ctx.conf_ver, loc.ctx.store_id)
+        with pytest.raises(kv.RegionError):
+            st.shim.journal_window(stale_ctx, tid, start,
+                                   prefix_next(start), None,
+                                   st.current_ts())
+
+    def test_index_window_reports_staleness_flag(self, env):
+        st, s, tid = env
+        s.execute("CREATE INDEX iv ON t (v)")
+        info = s.domain.info_schema().table("d", "t")
+        idx = info.indexes[0].id
+        fill = st.current_ts()
+        meta = _window(st, tid, fill, st.current_ts(), index_id=idx)
+        assert meta["index_stale"] is False and meta["delta"] is None
+        s.execute("INSERT INTO t VALUES (300, 7)")   # index keys commit
+        meta = _window(st, tid, fill, st.current_ts(), index_id=idx)
+        assert meta["index_stale"] is True
+
+
+class TestJournalWindowWire:
+    def test_round_trip_decodes_to_pending_delta(self):
+        """Over a real socket the window must arrive decodable into
+        delta.py's vocabulary (tuples may become lists in transit; the
+        STALE sentinel travels as the string "stale")."""
+        srv = StorageServer()
+        srv.start()
+        st = connect("127.0.0.1", srv.port)
+        s = Session(st)
+        try:
+            s.execute("CREATE DATABASE d")
+            s.execute("USE d")
+            s.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, "
+                      "v BIGINT)")
+            s.execute("INSERT INTO t VALUES (1, 1)")
+            tid = s.domain.info_schema().table("d", "t").id
+            fill = st.current_ts()
+            s.execute("INSERT INTO t VALUES (2, 2)")
+            start = record_prefix(tid)
+            loc = st.region_cache.locate(start)
+            meta = st.shim.journal_window(loc.ctx, tid, start,
+                                          prefix_next(start), fill,
+                                          st.current_ts())
+            pend = fleetcop._decode_wire_delta(meta["delta"])
+            assert isinstance(pend, PendingDelta)
+            assert list(pend.upsert_handles) == [2]
+            assert list(pend.delete_handles) == []
+            assert pend.watermark > fill
+            assert fleetcop._decode_wire_delta("stale") is STALE
+            assert fleetcop._decode_wire_delta(None) is None
+        finally:
+            s.close()
+            st.close()
+            srv.close()

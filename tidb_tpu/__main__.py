@@ -44,10 +44,22 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--slow-threshold-ms", type=int, default=None)
     p.add_argument("--set", action="append", default=[], metavar="VAR=V",
                    help="set a tidb_tpu_* sysvar (repeatable)")
+    p.add_argument("--store", default=None, metavar="HOST:PORT",
+                   help="connect to a store-plane server (fleet mode: "
+                        "this process is a stateless SQL server with "
+                        "its own coherent caches) instead of hosting an "
+                        "in-process store")
     return p
 
 
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "storeserve":
+        # store-plane server: one MVCCStore + TSO + region map behind
+        # the wire protocol, shared by N stateless SQL servers
+        from tidb_tpu.store.remote import serve_main
+        return serve_main(argv[1:])
     args = build_parser().parse_args(argv)
     logging.basicConfig(
         level=getattr(logging, args.log_level.upper(), logging.INFO),
@@ -103,9 +115,15 @@ def main(argv=None) -> int:
 
     from tidb_tpu.server import Server
     from tidb_tpu.server.status import StatusServer
-    from tidb_tpu.store.storage import new_mock_storage
 
-    storage = new_mock_storage()
+    if args.store:
+        from tidb_tpu.store.remote import connect
+        h, _, pt = args.store.rpartition(":")
+        storage = connect(h or "127.0.0.1", int(pt), local_cache=True)
+        log.info("fleet mode: store plane at %s", args.store)
+    else:
+        from tidb_tpu.store.storage import new_mock_storage
+        storage = new_mock_storage()
     server = Server(storage, host=args.host, port=args.port,
                     token_limit=args.token_limit)
     server.start()

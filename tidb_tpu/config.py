@@ -34,7 +34,8 @@ __all__ = ["get_var", "set_var", "all_vars", "coerce", "session_overlay",
            "server_mem_quota", "admission_timeout_ms",
            "sched_inflight", "sched_inflight_bytes",
            "delta_store_enabled", "delta_merge_rows",
-           "delta_merge_ratio_pct",
+           "delta_merge_ratio_pct", "delta_retain_ms",
+           "fleet_local_cache",
            "dispatch_timeout_ms", "failpoints_spec", "on_change",
            "trace_sample", "slow_trace_ms",
            "metrics_history_interval_ms", "metrics_history_points",
@@ -230,6 +231,16 @@ _DEFS: dict[str, tuple[str, int]] = {
     # staged delta rows per table that trigger a background merge
     # (fold deltas into new base blocks + truncate the journal)
     "tidb_tpu_delta_merge_rows": (_INT, 8192),
+    # store-plane journal retention window in wall-clock ms: merges keep
+    # at least this much journal behind now so remote fleet caches
+    # (pulling (fill_ts, read_ts] windows over the journal-window RPC)
+    # can patch in place instead of going STALE. 0 = truncate to the
+    # local floor only (single-process behavior)
+    "tidb_tpu_delta_retain_ms": (_INT, 0),
+    # fleet SQL servers serve coprocessor reads from their own chunk +
+    # HBM caches, kept coherent by journal-window pulls from the store
+    # plane; 0 = every remote read executes on the store plane
+    "tidb_tpu_fleet_local_cache": (_BOOL, 1),
     # merge when staged delta rows exceed this percent of the table's
     # observed cached base rows (0 = ratio trigger off)
     "tidb_tpu_delta_merge_ratio_pct": (_INT, 25),
@@ -533,6 +544,14 @@ def delta_merge_rows() -> int:
 
 def delta_merge_ratio_pct() -> int:
     return max(0, _read("tidb_tpu_delta_merge_ratio_pct"))
+
+
+def delta_retain_ms() -> int:
+    return max(0, _read("tidb_tpu_delta_retain_ms"))
+
+
+def fleet_local_cache() -> bool:
+    return bool(_read("tidb_tpu_fleet_local_cache"))
 
 
 def dispatch_timeout_ms() -> int:

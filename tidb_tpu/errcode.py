@@ -140,6 +140,11 @@ ER_SERVER_BUSY_ADMISSION = 9008
 # recovery chain (host fallback, device quarantine + re-probe) means a
 # verbatim replay lands on a working path
 ER_DEVICE_FAULT = 9009
+# store-plane member unreachable (kv.StoreUnavailableError: the node a
+# fleet SQL server dialed is down/partitioned). RETRYABLE — nothing of
+# the statement's effect is ambiguous (connection-level failure before
+# a response); a verbatim replay after the client re-routes is safe
+ER_STORE_UNAVAILABLE = 9010
 # commit outcome unknown (network error on the primary commit,
 # 2pc.go:421-431): NOT retryable — the write may have landed, so a
 # verbatim replay risks applying it twice
@@ -157,7 +162,7 @@ RETRYABLE = frozenset({
     ER_PD_SERVER_TIMEOUT, ER_TIKV_SERVER_TIMEOUT, ER_TIKV_SERVER_BUSY,
     ER_RESOLVE_LOCK_TIMEOUT, ER_REGION_UNAVAILABLE,
     ER_REGION_STREAM_INTERRUPTED, ER_SERVER_BUSY_ADMISSION,
-    ER_DEVICE_FAULT,
+    ER_DEVICE_FAULT, ER_STORE_UNAVAILABLE,
 })
 
 
@@ -268,6 +273,7 @@ _SQLSTATE = {
     ER_REGION_STREAM_INTERRUPTED: "HY000",
     ER_SERVER_BUSY_ADMISSION: "HY000",
     ER_DEVICE_FAULT: "HY000",
+    ER_STORE_UNAVAILABLE: "HY000",
     ER_RESULT_UNDETERMINED: "HY000",
     ER_MEM_EXCEED_QUOTA: "HY000",
 }
@@ -370,6 +376,11 @@ def classify(exc: BaseException) -> tuple[int, str, str]:
         # streamed coprocessor reply died past its resume budget: the
         # retryable region-stream class (store/stream.py subsystem)
         code = ER_REGION_STREAM_INTERRUPTED
+    elif isinstance(exc, kv.StoreUnavailableError):
+        # before the generic RegionError arm: StoreUnavailableError IS
+        # a RegionError, but a dead store-plane member deserves its own
+        # retryable code (fleet clients re-route on it)
+        code = ER_STORE_UNAVAILABLE
     elif isinstance(exc, kv.RegionError):
         code = ER_REGION_UNAVAILABLE
     elif isinstance(exc, kv.ServerBusyError):

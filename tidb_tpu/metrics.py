@@ -29,6 +29,8 @@ __all__ = ["counter", "histogram", "gauge", "expose", "snapshot",
            "CONNECTIONS_CURRENT", "ADMISSIONS", "ADMISSION_WAITS",
            "ADMISSION_QUEUE_DEPTH", "SCHED_STALLS", "SCHED_BYPASSES",
            "DELTA_ROWS", "DELTA_MERGES", "CACHE_DELTA_SERVES",
+           "FLEET_JOURNAL_PULLS", "FLEET_PATCHED_ROWS",
+           "FLEET_RPC_SECONDS", "FLEET_LOCAL_COP",
            "BYTES_ENCODED", "BYTES_DECODED_EQUIV",
            "FAILPOINT_FIRES", "WORKER_RESTARTS", "DISPATCH_TIMEOUTS",
            "DEVICE_QUARANTINES", "TRACES",
@@ -227,6 +229,13 @@ SCHED_BYPASSES = "tidb_tpu_sched_bypass_total"
 DELTA_ROWS = "tidb_tpu_delta_rows_current"
 DELTA_MERGES = "tidb_tpu_delta_merge_total"
 CACHE_DELTA_SERVES = "tidb_tpu_cache_served_with_delta_total"
+# fleet serving (store/fleetcop.py, store/remote.py): N SQL-server
+# processes share one store plane; each keeps its own chunk + HBM
+# caches coherent by pulling delta-journal windows over the wire
+FLEET_JOURNAL_PULLS = "tidb_tpu_fleet_journal_pulls_total"
+FLEET_PATCHED_ROWS = "tidb_tpu_fleet_journal_patched_rows_total"
+FLEET_RPC_SECONDS = "tidb_tpu_fleet_remote_rpc_seconds"
+FLEET_LOCAL_COP = "tidb_tpu_fleet_local_cop_total"
 # encoded execution (ops/encoded.py): input bytes device dispatches
 # actually staged/read (dict codes + validity at the padded bucket) vs
 # the decoded-equivalent footprint of the same inputs — BENCH's
@@ -319,6 +328,16 @@ _HELP = {
         "(rows|ratio|shed|close).",
     CACHE_DELTA_SERVES:
         "Cache reads served as base + delta instead of re-scanning.",
+    FLEET_JOURNAL_PULLS:
+        "Journal-window pulls from the store plane, by outcome "
+        "(window|empty|stale|meta).",
+    FLEET_PATCHED_ROWS:
+        "Rows patched into resident fleet cache blocks from shipped "
+        "journal windows.",
+    FLEET_RPC_SECONDS:
+        "Remote store RPC latency by method.",
+    FLEET_LOCAL_COP:
+        "Fleet coprocessor reads, by serving path (cached|store).",
     BYTES_ENCODED:
         "Input bytes device dispatches actually staged or read "
         "(dictionary codes + validity at the padded bucket).",

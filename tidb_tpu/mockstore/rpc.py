@@ -48,6 +48,13 @@ class RPCShim:
         self.cluster = cluster
         self.store = store
         self._mu = threading.Lock()
+        # storage facade back-ref (set by MockStorage.__init__): the
+        # journal-window command needs the node-local DeltaStore, which
+        # lives on the facade, not the MVCC engine
+        self._storage = None
+
+    def bind_storage(self, storage) -> None:
+        self._storage = storage
 
     # -- region checks -------------------------------------------------------
 
@@ -182,6 +189,48 @@ class RPCShim:
 
     def mvcc_by_start_ts(self, start_ts: int, **kw):
         return self.store.mvcc_by_start_ts(start_ts, **kw)
+
+    def journal_window(self, ctx: RegionCtx, table_id: int, start: bytes,
+                       end: bytes, fill_ts, read_ts: int, index_id=None):
+        """Fleet cache coherence: one round trip returning the engine's
+        freshness meta plus the delta-journal window (fill_ts, read_ts]
+        for one region range, so a remote SQL server can decide whether
+        its resident chunk/HBM block is patchable in place (store/delta.py
+        semantics) without re-colding. Region epoch is checked like any
+        data command, so truncation races on split/merge surface as
+        RegionError and the client re-resolves. The reply is wire-native
+        (dicts/tuples/ndarrays only — the STALE sentinel travels as the
+        string "stale")."""
+        r = self._check("JournalWindow", ctx)
+        s = max(start, r.start)
+        e = r.end if not end else (min(end, r.end) if r.end else end)
+        storage = self._storage
+        dstore = getattr(storage, "delta_store", None)
+        enabled = dstore is not None and dstore.enabled()
+        eng = self.store
+        meta = {
+            "data_version": eng.data_version,
+            "max_commit_ts": eng.max_commit_ts,
+            "any_locks": bool(eng._locked_keys),
+            "delta_enabled": enabled,
+            "locked": enabled and eng.locked_in_range(s, e, read_ts),
+            "index_stale": False,
+            "delta": None,
+        }
+        if not enabled or fill_ts is None:
+            return meta
+        if index_id is not None:
+            meta["index_stale"] = dstore.index_stale(table_id, fill_ts,
+                                                     read_ts)
+            return meta
+        pend = dstore.pending(table_id, s, e, fill_ts, read_ts)
+        from tidb_tpu.store.delta import STALE
+        if pend is STALE:
+            meta["delta"] = "stale"
+        elif pend is not None:
+            meta["delta"] = ("win", pend.watermark, pend.upsert_rows,
+                             pend.upsert_handles, pend.delete_handles)
+        return meta
 
     def coprocessor(self, ctx: RegionCtx, req):
         """Executes a pushed-down subplan against this region's data.

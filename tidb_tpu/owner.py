@@ -17,6 +17,7 @@ import time
 import uuid
 
 from tidb_tpu import kv
+from tidb_tpu.mockstore.rpc import TimeoutError_
 
 __all__ = ["OwnerManager", "DDL_OWNER_KEY"]
 
@@ -57,8 +58,10 @@ class OwnerManager:
                 return True
             txn.rollback()
             return False
-        except kv.RetryableError:
-            # lost the race to another campaigner
+        except (kv.RetryableError, TimeoutError_):
+            # lost the race to another campaigner, or the commit RPC
+            # timed out (fleet mode: store plane over the wire) —
+            # either way this round is lost; the next campaign retries
             return False
         except Exception:
             if getattr(txn, "valid", False):
@@ -94,7 +97,7 @@ class OwnerManager:
                 txn.commit()
             else:
                 txn.rollback()
-        except kv.RetryableError:
+        except (kv.RetryableError, TimeoutError_):
             pass
         except Exception:
             if getattr(txn, "valid", False):

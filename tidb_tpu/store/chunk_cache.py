@@ -111,6 +111,16 @@ class ChunkCache:
                 return None
             return ent[3]
 
+    def entry_state(self, key):
+        """(fill_version, fill_ts) of the resident entry, or None —
+        freshness is NOT checked and no stats/LRU effects apply. The
+        fleet read path (store/fleetcop.py) uses this to prime one
+        journal-window RPC with the entry's own fill snapshot before
+        deciding whether the block is patchable in place."""
+        with self._mu:
+            ent = self._entries.get(key)
+            return None if ent is None else (ent[0], ent[1])
+
     def lookup(self, key, data_version: int, read_ts: int):
         """Like get() but returns (fill_ts, chunk): the entry's fill
         snapshot rides along so derived caches (the HBM device cache)

@@ -1024,6 +1024,11 @@ class CopClient(kv.Client):
                     resumed()
                     continue
                 elif isinstance(e, _kv.StreamInterruptedError):
+                    # the stream died with the connection: the region
+                    # epoch we hold may be from before the store plane
+                    # restarted — re-resolve instead of re-issuing the
+                    # same stale ctx forever
+                    self.cache.invalidate(loc.region.id)
                     bo.backoff(BO_REGION_MISS, e)
                     resumed()
                     continue

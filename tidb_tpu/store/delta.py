@@ -54,6 +54,7 @@ from collections import OrderedDict
 import numpy as np
 
 from tidb_tpu import config, memtrack, metrics
+from tidb_tpu.store import oracle
 from tidb_tpu.util import failpoint
 
 __all__ = ["DeltaStore", "PendingDelta", "STALE", "tracker",
@@ -536,6 +537,14 @@ class DeltaStore:
                     dc.fill(dkey, dv, w, merged)
                 floors.append(w)
         floor = min(floors, default=target)
+        retain = config.delta_retain_ms()
+        if retain > 0:
+            # store-plane mode: this node's own caches say nothing about
+            # remote fleet caches, whose fill snapshots only reach us as
+            # journal-window pulls. Keep a wall-clock window of journal
+            # so a remote fill at most `retain` ms old still patches
+            # instead of going STALE -> full re-fill
+            floor = min(floor, oracle.retention_ts(retain))
         freed_bytes = 0
         freed_rows = 0
         with self._mu:

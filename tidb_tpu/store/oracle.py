@@ -13,7 +13,8 @@ import threading
 import time
 from concurrent.futures import Future, ThreadPoolExecutor
 
-__all__ = ["Oracle", "PDOracle", "LocalOracle", "physical_ms", "compose_ts"]
+__all__ = ["Oracle", "PDOracle", "LocalOracle", "physical_ms", "compose_ts",
+           "retention_ts"]
 
 
 def physical_ms(ts: int) -> int:
@@ -23,6 +24,14 @@ def physical_ms(ts: int) -> int:
 
 def compose_ts(ms: int, logical: int = 0) -> int:
     return (ms << 18) | logical
+
+
+def retention_ts(retain_ms: int) -> int:
+    """Hybrid timestamp `retain_ms` behind the wall clock. The TSO is
+    wall-clock-ms based, so a store-plane merge clamping its journal
+    floor to this keeps a pull window open for remote fleet caches
+    whose fill snapshots are at most `retain_ms` old."""
+    return compose_ts(max(0, int(time.time() * 1000) - retain_ms))
 
 
 class Oracle:

@@ -100,6 +100,17 @@ class RegionCache:
                 del self._by_start[start]
             self._leaders.pop(region_id, None)
 
+    def invalidate_all(self) -> None:
+        """Drop every cached epoch and learned leader. Fired when a
+        store-plane connection is lost (store/remote.py disconnect
+        listener): the plane we reconnect to may have split/moved
+        regions while we were gone, and resuming with stale epochs
+        loops on ER_REGION_STREAM_INTERRUPTED instead of re-resolving."""
+        with self._mu:
+            self._by_start.clear()
+            self._start_by_id.clear()
+            self._leaders.clear()
+
     def on_not_leader(self, err: NotLeaderError) -> None:
         """Switch leader in place when the error names one, else invalidate.
         Ref: region_cache.go UpdateLeader."""

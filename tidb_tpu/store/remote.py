@@ -57,7 +57,7 @@ _STATUS_OK_TRACED = wire.STATUS_OK_TRACED   # payload = (result, spans)
 
 # commands safe to re-send after an indeterminate failure
 _IDEMPOTENT = {"kv_get", "kv_batch_get", "kv_scan", "kv_scan_lock",
-               "coprocessor", "coprocessor_stream",
+               "coprocessor", "coprocessor_stream", "journal_window",
                "region_by_key", "tso", "kv_cleanup",
                "snapshot_batch_get", "ping", "regions_snapshot",
                # raw ops are idempotent by definition (no MVCC, repeat
@@ -638,6 +638,21 @@ class RemoteClient:
         self._pools: dict = {}             # addr -> list[_Conn]
         self._sema = threading.Semaphore(max_conns)
         self._mu = threading.Lock()
+        # fired once per observed connection-level failure (dial or
+        # mid-request I/O): fleet-mode storages drop stale region
+        # epochs here so a reconnect re-resolves routing instead of
+        # looping on interrupted streams
+        self._disconnect_listeners: list = []
+
+    def add_disconnect_listener(self, fn) -> None:
+        self._disconnect_listeners.append(fn)
+
+    def _notify_disconnect(self) -> None:
+        for fn in list(self._disconnect_listeners):
+            try:
+                fn()
+            except Exception:   # noqa: BLE001 — listeners are best-effort
+                pass
 
     @property
     def addr(self):
@@ -703,12 +718,14 @@ class RemoteClient:
             try:
                 addr, conn = self._checkout()
             except OSError as e:
+                self._notify_disconnect()
                 self._rotate(self.addrs[self._cur])
                 if time.monotonic() < deadline:
                     time.sleep(0.1)
                     continue    # storage may be restarting: keep dialing
                 raise kv.ServerBusyError(
                     f"storage unreachable at {self.addr}: {e}") from None
+            t0 = time.monotonic()
             try:
                 result = conn.call(method, args, kwargs)
             except kv.NotLeaderError as e:
@@ -729,6 +746,7 @@ class RemoteClient:
             except (ConnectionError, OSError, wire.WireError,
                     EOFError) as e:
                 conn.close()
+                self._notify_disconnect()
                 self._rotate(addr)
                 if idempotent and time.monotonic() < deadline:
                     time.sleep(0.05)
@@ -739,6 +757,9 @@ class RemoteClient:
                 # a mutating command may or may not have executed
                 raise TimeoutError_(
                     f"storage i/o failure mid-request: {e}") from None
+            from tidb_tpu import metrics
+            metrics.histogram(metrics.FLEET_RPC_SECONDS,
+                              time.monotonic() - t0, {"method": method})
             self._checkin(addr, conn)
             return result
 
@@ -761,6 +782,7 @@ class RemoteClient:
             try:
                 addr, conn = self._checkout()
             except OSError as e:
+                self._notify_disconnect()
                 self._rotate(self.addrs[self._cur])
                 raise kv.StreamInterruptedError(
                     f"storage unreachable at {self.addr}: {e}") from None
@@ -795,6 +817,7 @@ class RemoteClient:
                 raise
             except (ConnectionError, OSError, wire.WireError,
                     EOFError) as e:
+                self._notify_disconnect()
                 self._rotate(addr)
                 raise kv.StreamInterruptedError(
                     f"stream i/o failure: {e}") from None
@@ -848,7 +871,7 @@ class _RemoteShim:
 
     def __getattr__(self, name: str):
         if name.startswith(("kv_", "raw_", "mvcc_")) or \
-                name in ("coprocessor", "split_region"):
+                name in ("coprocessor", "split_region", "journal_window"):
             def call(*args, **kwargs):
                 return self.client.call(name, *args, **kwargs)
             return call
@@ -867,6 +890,58 @@ class _RemoteShim:
                                        credit=credit or 4, **kwargs)
 
 
+class _FleetShim(_RemoteShim):
+    """Fleet-mode shim: coprocessor tasks are first offered to this
+    SQL-server process's OWN cache hierarchy (store/fleetcop.py — a
+    journal-window pull primes the serve), and fall through to the
+    store plane when not locally servable. Everything else rides the
+    wire unchanged."""
+
+    def __init__(self, client: RemoteClient, storage):
+        super().__init__(client)
+        self._storage = storage
+
+    def coprocessor(self, ctx, req):
+        from tidb_tpu import metrics
+        from tidb_tpu.store import fleetcop
+        res = fleetcop.exec_local(self._storage, self, ctx, req)
+        if res is not None:
+            return res[0]
+        metrics.counter(metrics.FLEET_LOCAL_COP, {"path": "store"})
+        return self.client.call("coprocessor", ctx, req)
+
+    def coprocessor_stream(self, ctx, req, credit=None, frame_bytes=None):
+        """Streamed flavor of the local-first offer: a locally served
+        task ships as ONE synthesized final frame (the cached block is
+        already resident — framing it would only re-buffer it), with
+        `range` covering the clamped task range so the client's cursor
+        and cross-region continuation work unchanged. The offer runs
+        lazily on first next(), inside the cop client's per-frame retry
+        scope, so region errors from the journal-window pull re-locate
+        exactly like mid-stream region errors."""
+        def frames():
+            from tidb_tpu import metrics
+            from tidb_tpu.store import fleetcop
+            from tidb_tpu.store.stream import StreamFrame
+            res = fleetcop.exec_local(self._storage, self, ctx, req)
+            if res is None:
+                metrics.counter(metrics.FLEET_LOCAL_COP,
+                                {"path": "store"})
+                yield from _RemoteShim.coprocessor_stream(
+                    self, ctx, req, credit=credit,
+                    frame_bytes=frame_bytes)
+                return
+            out, s, e = res
+            rng = kv.KVRange(s, e)
+            if not out:
+                yield StreamFrame(chunk=None, range=rng, last=True)
+                return
+            for i, resp in enumerate(out):
+                yield StreamFrame(chunk=resp.chunk, range=rng,
+                                  last=i == len(out) - 1)
+        return frames()
+
+
 class _RemoteEngine:
     """Offline-import surface of the remote engine (bulkload)."""
 
@@ -883,7 +958,7 @@ class RemoteStorage(kv.Storage):
     MockStorage at the session layer: txns, snapshots, coprocessor
     fan-out, GC all run their existing client logic over the wire."""
 
-    def __init__(self, addr):
+    def __init__(self, addr, local_cache: bool = False):
         from tidb_tpu.store.oracle import PDOracle
         from tidb_tpu.store.region_cache import RegionCache
         from tidb_tpu.store.txn import KVTxn, LockResolver, TxnSnapshot
@@ -892,9 +967,25 @@ class RemoteStorage(kv.Storage):
         self.rpc = RemoteClient(addr)
         self.pd = _RemotePD(self.rpc)
         self.cluster = self.pd              # topology ops for tests/bench
-        self.shim = _RemoteShim(self.rpc)
         self.engine = _RemoteEngine(self.rpc)
         self.region_cache = RegionCache(self.pd)
+        if local_cache:
+            # fleet mode: this SQL server keeps its own columnar chunk
+            # cache + HBM device cache, kept coherent with the store
+            # plane by journal-window pulls (store/fleetcop.py)
+            from tidb_tpu.store.chunk_cache import ChunkCache
+            from tidb_tpu.store.device_cache import DeviceCache
+            self.chunk_cache = ChunkCache()
+            self.device_cache = DeviceCache()
+            self.shim = _FleetShim(self.rpc, self)
+            # a dropped store connection invalidates every cached
+            # region epoch: the reconnected plane may have split/moved
+            # regions while we were gone, and resuming with stale
+            # routing loops on interrupted streams
+            self.rpc.add_disconnect_listener(
+                self.region_cache.invalidate_all)
+        else:
+            self.shim = _RemoteShim(self.rpc)
         self.oracle = PDOracle(self.pd)
         self.resolver = LockResolver(self.shim, self.region_cache,
                                      self.oracle)
@@ -932,13 +1023,19 @@ class RemoteStorage(kv.Storage):
 
     def close(self) -> None:
         self.oracle.close()
+        dc = getattr(self, "device_cache", None)
+        if dc is not None:
+            dc.shed()   # return the HBM ledger share eagerly
         self.rpc.close()
 
 
-def connect(host: str, port: int, *backups) -> RemoteStorage:
-    """backups: extra (host, port) pairs forming the replica set."""
+def connect(host: str, port: int, *backups,
+            local_cache: bool = False) -> RemoteStorage:
+    """backups: extra (host, port) pairs forming the replica set.
+    local_cache=True enables fleet mode (per-process coherent caches)."""
     addrs = [(host, port)] + [tuple(b) for b in backups]
-    return RemoteStorage(addrs if len(addrs) > 1 else addrs[0])
+    return RemoteStorage(addrs if len(addrs) > 1 else addrs[0],
+                         local_cache=local_cache)
 
 
 # ---------------------------------------------------------------------------
@@ -958,12 +1055,20 @@ def serve_main(argv=None) -> int:
                    help="(primary) ship every mutation here synchronously")
     p.add_argument("--primary", default=None, metavar="HOST:PORT",
                    help="(backup) pull initial state from this primary")
+    p.add_argument("--retain-ms", type=int, default=None,
+                   help="delta-journal retention window in ms "
+                        "(tidb_tpu_delta_retain_ms): keep this much "
+                        "journal behind now so fleet SQL servers can "
+                        "pull coherence windows")
 
     def _addr(s):
         h, _, pt = s.rpartition(":")
         return (h or "127.0.0.1", int(pt))
 
     args = p.parse_args(argv)
+    if args.retain_ms is not None:
+        from tidb_tpu import config
+        config.set_var("tidb_tpu_delta_retain_ms", args.retain_ms)
     server = StorageServer(
         args.host, args.port, snapshot_path=args.snapshot,
         role=args.role,

@@ -45,6 +45,9 @@ class MockStorage(kv.Storage):
         from tidb_tpu.store.delta import DeltaStore
         self.delta_store = DeltaStore(self)
         engine.set_delta_sink(self.delta_store)
+        # the journal-window command serves remote fleet caches from
+        # this node's delta store; the shim only holds cluster+engine
+        self.shim.bind_storage(self)
 
     def begin(self, start_ts: int | None = None) -> KVTxn:
         return KVTxn(self, start_ts if start_ts is not None

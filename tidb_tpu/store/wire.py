@@ -92,6 +92,12 @@ class Cmd(IntEnum):
     REPL_SNAPSHOT = 72
     REPL_PROMOTE = 73
     REPL_INSTALL = 74
+    # fleet cache coherence: one round trip returns the engine's
+    # freshness meta (data_version / max_commit_ts / lock state) plus
+    # the delta-journal window (fill_ts, read_ts] for one region range,
+    # so a remote SQL server patches its resident chunk/HBM blocks in
+    # place instead of re-colding on every remote read (store/delta.py)
+    JOURNAL_WINDOW = 80
 
 
 # method-name <-> Cmd mapping used by the RPC layer (the shim's python
@@ -122,6 +128,7 @@ CMD_BY_METHOD = {
     "repl_snapshot": Cmd.REPL_SNAPSHOT,
     "repl_promote": Cmd.REPL_PROMOTE,
     "repl_install": Cmd.REPL_INSTALL,
+    "journal_window": Cmd.JOURNAL_WINDOW,
 }
 METHOD_BY_CMD = {v: k for k, v in CMD_BY_METHOD.items()}
 
