@@ -1209,14 +1209,6 @@ def serve_main() -> None:
     }))
 
 
-def _fleet_http(host: str, port: int, path: str, timeout: float = 15.0):
-    """GET a JSON document from one fleet member's status server."""
-    import urllib.request
-    with urllib.request.urlopen(f"http://{host}:{port}{path}",
-                                timeout=timeout) as r:
-        return json.loads(r.read().decode())
-
-
 def _metric_total(snap: dict, name: str):
     """Sum one counter family over every label combination in a flat
     metrics.snapshot() dict (keys look like 'name{label="v"}')."""
@@ -1246,6 +1238,7 @@ def _fleet_bench(progress) -> dict:
     from tidb_tpu.fleet import Fleet
     from tidb_tpu.session import Session
     from tidb_tpu.store.remote import connect
+    from tidb_tpu.util import statusclient
 
     n_servers = int(os.environ.get("BENCH_FLEET_SERVERS", "4"))
     n_clients = int(os.environ.get("BENCH_FLEET_CLIENTS", "8"))
@@ -1347,7 +1340,8 @@ def _fleet_bench(progress) -> dict:
 
         def member_mark(mi: int) -> dict:
             m = fleet.members[mi]
-            top = _fleet_http(fleet.host, m.status_port, "/top")
+            top = statusclient.get_json(fleet.host, m.status_port,
+                                        "/top", timeout=15.0)
             status = fleet.health(mi)
             return {"device_ns": top["server"]["device_ns"],
                     "host_ns": top["server"]["host_fallback_ns"],
@@ -1429,6 +1423,60 @@ def _fleet_bench(progress) -> dict:
                 "delta_serves": int(_metric_total(
                     snap, "tidb_tpu_cache_served_with_delta_total"))}
         out["coherence"] = coherence
+
+        # fleet attribution: the cluster observability plane end to
+        # end — per-member utilization via the cluster_resource_usage
+        # fan-out, then ONE traced statement on member 0 whose fleet
+        # trace id provably stitches a store-plane span record when
+        # looked up from a DIFFERENT member (cluster_statement_traces
+        # joined on origin_trace_id). scripts/fleet_bench.sh pins both.
+        progress("fleet: attribution via cluster_* tables")
+        c0 = member_client(0)
+        c1 = member_client(1 % n_servers)
+        try:
+            _cols, mrows = c0.query(
+                "SELECT member_id, role FROM "
+                "information_schema.cluster_members")
+            store_ids = {r[0] for r in mrows if r[1] == "store"}
+            _cols, urows = c0.query(
+                "SELECT member, device_time_ns, statements, rows_sent "
+                "FROM information_schema.cluster_resource_usage "
+                "WHERE scope = 'server'")
+            members_util = {r[0]: {"device_time_ns": int(r[1]),
+                                   "statements": int(r[2]),
+                                   "rows_sent": int(r[3])}
+                            for r in urows}
+            _cols, trows = c0.query(
+                "TRACE FORMAT='json' SELECT o_custkey FROM orders "
+                "WHERE o_orderkey = 1")
+            tid = int(json.loads(trows[0][0])["trace_id"])
+            deadline = time.monotonic() + 30
+            stitched: list = []
+            while True:
+                _cols, srows = c1.query(
+                    "SELECT member, origin_member, trace_id FROM "
+                    "information_schema.cluster_statement_traces "
+                    f"WHERE origin_trace_id = {tid}")
+                stitched = [{"member": r[0], "origin_member": r[1],
+                             "trace_id": int(r[2])} for r in srows]
+                if any(r["member"] in store_ids for r in stitched):
+                    break
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"fleet attribution: no store-plane trace "
+                        f"record with origin_trace_id={tid} "
+                        f"(got {stitched!r})")
+                time.sleep(0.25)
+            out["fleet_attribution"] = {
+                "live_members": {r[0]: r[1] for r in mrows},
+                "members": members_util,
+                "trace_id": tid,
+                "stitched_records": stitched,
+                "stitched_store": True,
+            }
+        finally:
+            c0.close()
+            c1.close()
         progress(f"fleet: scaling x{leg_counts[-1]} vs x1 = "
                  f"{out['scaling_max_vs_1']}")
     finally:

@@ -64,8 +64,29 @@ coh = d.get("coherence")
 assert coh, "coherence counter block missing from the fleet detail"
 assert sum(c["journal_pulls"] for c in coh.values()) > 0, \
     f"no journal-window pulls recorded: caches are not coherent ({coh})"
+# cluster observability plane (ISSUE 17): the fleet_attribution block
+# must be populated for every live member, and the traced statement's
+# store-plane ring record must carry its origin_trace_id (bench.py
+# raises — never a degraded-but-silent pass — if the cluster-table
+# query errors instead of degrading, this block is simply absent)
+fa = d.get("fleet_attribution")
+assert fa, "fleet_attribution block missing from the fleet detail"
+live = fa.get("live_members") or {}
+util = fa.get("members") or {}
+assert live and set(util) >= set(live), \
+    f"per-member utilization unpopulated: live={sorted(live)} " \
+    f"attributed={sorted(util)}"
+assert any(m["statements"] > 0 for m in util.values()), \
+    f"no member shows attributed statements: {util}"
+assert fa.get("trace_id", 0) > 0xFFFFFF, \
+    f"trace id {fa.get('trace_id')} is not fleet-unique (no nonce)"
+assert fa.get("stitched_store"), \
+    "store-plane ring record missing origin_trace_id for the traced " \
+    "statement"
 print(f"fleet bench OK: {rep['value']} stmts/s at "
       f"x{legs[-1]['servers']} ({d['scaling_max_vs_1']}x vs x1), "
       f"journal_pulls="
-      f"{sum(c['journal_pulls'] for c in coh.values())}")
+      f"{sum(c['journal_pulls'] for c in coh.values())}, "
+      f"fleet trace {fa['trace_id']} stitched across "
+      f"{len(fa['stitched_records'])} member(s)")
 PY

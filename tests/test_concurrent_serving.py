@@ -14,12 +14,12 @@ rides behind the `slow` marker."""
 import json
 import threading
 import time
-import urllib.request
 
 import pytest
 
 from tests.mysql_client import MiniClient, MySQLError
 from tidb_tpu import config, errcode, memtrack, metrics, sched
+from tidb_tpu.util import statusclient
 from tidb_tpu.server import Server
 from tidb_tpu.server.status import StatusServer
 from tidb_tpu.store import new_mock_storage
@@ -284,10 +284,8 @@ class TestStatusPort:
                 admin.query("SELECT v, COUNT(*) FROM sh GROUP BY v")
 
             def get(path):
-                with urllib.request.urlopen(
-                        f"http://127.0.0.1:{status.port}{path}",
-                        timeout=10) as r:
-                    return json.loads(r.read())
+                return statusclient.get_json("127.0.0.1", status.port,
+                                             path, timeout=10)
 
             st = get("/status")
             assert "serving" in st
@@ -356,10 +354,8 @@ class TestResourceMetering:
             assert sess_sum <= int(srv_row[2])
 
             def get(path):
-                with urllib.request.urlopen(
-                        f"http://127.0.0.1:{status.port}{path}",
-                        timeout=10) as r:
-                    return json.loads(r.read())
+                return statusclient.get_json("127.0.0.1", status.port,
+                                             path, timeout=10)
 
             top = get("/top")
             assert top["server"]["device_ns"] > 0

@@ -2,11 +2,12 @@
 server/region_handler.go:73-91; store/tikv/rawkv.go)."""
 
 import json
-import urllib.request
+import urllib.error
 
 import pytest
 
 from tidb_tpu.server.status import StatusServer
+from tidb_tpu.util import statusclient
 from tidb_tpu.session import Session
 from tidb_tpu.store.rawkv import RawKVClient
 from tidb_tpu.store.storage import new_mock_storage
@@ -30,9 +31,8 @@ def env():
 
 def _get(port, path):
     try:
-        with urllib.request.urlopen(
-                f"http://127.0.0.1:{port}{path}", timeout=5) as r:
-            return r.status, json.loads(r.read())
+        return 200, statusclient.get_json("127.0.0.1", port, path,
+                                          timeout=5)
     except urllib.error.HTTPError as e:
         body = e.read()
         try:

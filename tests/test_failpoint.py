@@ -198,29 +198,25 @@ class TestRegistry:
 class TestStatusEndpoint:
     def test_post_arms_get_lists_disarm(self, sess):
         import json
-        import urllib.request
+        import urllib.error
 
         from tidb_tpu.server.status import StatusServer
+        from tidb_tpu.util import statusclient
         _s, st = sess
         srv = StatusServer(st)
         srv.start()
         try:
-            base = f"http://127.0.0.1:{srv.port}/failpoint"
-
             def post(body):
-                req = urllib.request.Request(
-                    base, data=json.dumps(body).encode(),
-                    method="POST")
                 try:
-                    with urllib.request.urlopen(req) as r:
-                        return r.status, json.loads(r.read())
+                    return 200, statusclient.post_json(
+                        "127.0.0.1", srv.port, "/failpoint", body)
                 except urllib.error.HTTPError as e:
                     return e.code, json.loads(e.read())
 
             code, out = post({"name": "hbm/fill", "spec": "2*raise"})
             assert code == 200 and "hbm/fill" in out["armed"]
-            with urllib.request.urlopen(base) as r:
-                listing = json.loads(r.read())
+            listing = statusclient.get_json("127.0.0.1", srv.port,
+                                            "/failpoint")
             assert listing["registry"] == failpoint.REGISTRY
             assert "hbm/fill" in listing["armed"]
             code, out = post({"name": "hbm/fill", "spec": None})

@@ -11,12 +11,12 @@ while survivors keep serving with drained gauges."""
 import json
 import threading
 import time
-import urllib.request
 
 import pytest
 
 from tidb_tpu import errcode
 from tidb_tpu.fleet import Fleet
+from tidb_tpu.util import statusclient
 
 from tests.mysql_client import MiniClient, MySQLError
 
@@ -64,12 +64,10 @@ def _query_until(fleet, index, sql, db="", timeout=CONVERGE_S):
 
 def _arm_failpoint(fleet, index, name, spec):
     m = fleet.members[index]
-    req = urllib.request.Request(
-        f"http://{fleet.host}:{m.status_port}/failpoint",
-        data=json.dumps({"name": name, "spec": spec}).encode(),
-        headers={"Content-Type": "application/json"})
-    with urllib.request.urlopen(req, timeout=10) as r:
-        doc = json.loads(r.read().decode())
+    doc = statusclient.post_json(fleet.host, m.status_port,
+                                 "/failpoint",
+                                 {"name": name, "spec": spec},
+                                 timeout=10)
     assert doc.get("ok"), doc
 
 
@@ -125,6 +123,64 @@ class TestCrossProcessSchema:
         finally:
             fleet.restart(0)
             fleet.wait_healthy(timeout=120)
+
+
+class TestClusterObservability:
+    def test_cluster_members_lists_every_process(self, fleet):
+        """The membership registry seen from ANY member: both SQL
+        servers and the store plane itself, each with its status port
+        and lease."""
+        rows, _ = _query_until(
+            fleet, 1, "SELECT member_id, role, status_port FROM "
+                      "information_schema.cluster_members")
+        roles = [r[1] for r in rows]
+        assert roles.count("sql") >= 2, rows
+        assert "store" in roles, rows
+        ports = {int(r[2]) for r in rows}
+        assert {m.status_port for m in fleet.members} <= ports
+        assert fleet.store_status_port in ports
+
+    def test_cross_member_trace_correlation(self, fleet):
+        """The ISSUE 17 acceptance bar: a statement TRACEd on member 0
+        mints a fleet-unique trace id; one SELECT over
+        cluster_statement_traces on a DIFFERENT member locates the
+        store-plane-retained record whose origin_trace_id equals it
+        (the origin stamp shipped inside the traced store RPCs)."""
+        a = _client(fleet, 0)
+        try:
+            a.query("CREATE DATABASE obs_corr")
+            a.query("CREATE TABLE obs_corr.t (id BIGINT PRIMARY KEY, "
+                    "v BIGINT)")
+            a.query("INSERT INTO obs_corr.t VALUES (1, 7)")
+            res = a.query("TRACE FORMAT='json' SELECT v FROM "
+                          "obs_corr.t WHERE id = 1")
+            tid = json.loads(res[1][0][0])["trace_id"]
+        finally:
+            a.close()
+        assert tid > 0xFFFFFF   # fleet-unique: member nonce folded in
+        mrows, _ = _query_until(
+            fleet, 1, "SELECT member_id, role FROM "
+                      "information_schema.cluster_members")
+        store_ids = {r[0] for r in mrows if r[1] == "store"}
+        assert store_ids, mrows
+        deadline = time.monotonic() + 20
+        while True:
+            srows, _ = _query_until(
+                fleet, 1,
+                "SELECT member, origin_member, origin_trace_id FROM "
+                "information_schema.cluster_statement_traces "
+                f"WHERE origin_trace_id = {tid}")
+            hit = [r for r in srows if r[0] in store_ids]
+            if hit:
+                break
+            if time.monotonic() > deadline:
+                raise AssertionError(
+                    f"no store-plane record for trace {tid}: {srows}")
+            time.sleep(0.25)
+        # the store-plane record names the ISSUING member (member 0),
+        # not the store member that served the RPC
+        issuer = f"{fleet.host}:{fleet.members[0].status_port}:"
+        assert hit[0][1].startswith(issuer), hit
 
 
 class TestFleetChaos:
@@ -195,6 +251,44 @@ class TestFleetChaos:
                                db="chaos")
         assert rows == [("3",)]
         assert fleet.health(1)["version"]
+
+        # membership churn: while the dead member's lease is still
+        # live, a cluster fan-out from the survivor returns partial
+        # rows within the bounded timeout plus a warning — never a
+        # stall, never a statement error; then the member ages out of
+        # cluster_members within one TTL (it stopped heartbeating; no
+        # deregistration path exists to miss)
+        dead_pfx = f"{fleet.host}:{fleet.members[0].status_port}:"
+        c = _client(fleet, 1)
+        try:
+            _cols, mrows = c.query(
+                "SELECT member_id FROM "
+                "information_schema.cluster_members")
+            dead_listed = any(r[0].startswith(dead_pfx) for r in mrows)
+            t0 = time.monotonic()
+            _cols, prows = c.query(
+                "SELECT member, id FROM "
+                "information_schema.cluster_processlist")
+            assert time.monotonic() - t0 < 10   # bounded degradation
+            # the survivor itself answered (partial rows, not empty)
+            assert any(not r[0].startswith(dead_pfx) for r in prows), \
+                prows
+            if dead_listed:
+                _cols, wrows = c.query("SHOW WARNINGS")
+                assert any("unreachable" in r[2] for r in wrows), wrows
+        finally:
+            c.close()
+        deadline = time.monotonic() + 20        # TTL (3s) + CI slack
+        while True:
+            mrows, _ = _query_until(
+                fleet, 1, "SELECT member_id FROM "
+                          "information_schema.cluster_members")
+            if not any(r[0].startswith(dead_pfx) for r in mrows):
+                break
+            if time.monotonic() > deadline:
+                raise AssertionError(
+                    f"dead member never aged out: {mrows}")
+            time.sleep(0.25)
 
         # survivor gauge hygiene: every *_current/_depth level family
         # returns to zero once its clients are gone (no ledger leaks

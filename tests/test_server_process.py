@@ -6,11 +6,11 @@ import json
 import logging
 import subprocess
 import sys
-import urllib.request
 
 import pytest
 
 from tidb_tpu import config, metrics
+from tidb_tpu.util import statusclient
 from tidb_tpu.server import Server
 from tidb_tpu.server.status import StatusServer
 from tidb_tpu.session import Session
@@ -58,16 +58,17 @@ def test_status_endpoint_and_metrics():
     try:
         c = MiniClient("127.0.0.1", srv.port, user="root")
         c.query("SELECT 1")
-        with urllib.request.urlopen(
-                f"http://127.0.0.1:{status.port}/status") as r:
-            body = json.load(r)
+        body = statusclient.get_json("127.0.0.1", status.port,
+                                     "/status")
         assert body["version"]
         assert body["regions"] >= 1
-        with urllib.request.urlopen(
-                f"http://127.0.0.1:{status.port}/metrics") as r:
-            text = r.read().decode()
+        # every member stamps its identity on /status and /metrics
+        assert body["member"]["id"]
+        text = statusclient.get_text("127.0.0.1", status.port,
+                                     "/metrics")
         assert "tidb_tpu_queries_total" in text
         assert "tidb_tpu_query_duration_seconds_bucket" in text
+        assert metrics.MEMBER_START_TIME in text
         c.close()
     finally:
         status.close()

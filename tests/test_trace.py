@@ -10,7 +10,8 @@ import json
 import logging
 import time
 import urllib.error
-import urllib.request
+
+from tidb_tpu.util import statusclient
 
 import pytest
 
@@ -320,9 +321,7 @@ class TestTraceStatement:
 
 
 def _get_json(port: int, path: str):
-    with urllib.request.urlopen(
-            f"http://127.0.0.1:{port}{path}", timeout=10) as r:
-        return json.loads(r.read())
+    return statusclient.get_json("127.0.0.1", port, path, timeout=10)
 
 
 class TestTraceEndpoints:

@@ -134,6 +134,15 @@ def main(argv=None) -> int:
                               port=args.status_port)
         status.start()
         log.info("status API on %s:%d", args.host, status.port)
+        # fleet membership (tidb_tpu/member.py): identity = the status
+        # port peers fan cluster_* queries out to, so registration is
+        # tied to the status server being up. The heartbeat publishes
+        # through whichever storage this process uses — the shared
+        # store plane in fleet mode, the in-process store standalone
+        # (where this member is then the whole visible fleet).
+        from tidb_tpu import member
+        member.set_identity(args.host, status.port, "sql")
+        member.start_heartbeat(storage)
 
     stop = threading.Event()
 
@@ -145,6 +154,8 @@ def main(argv=None) -> int:
     stop.wait()
     log.info("shutting down")
     if status is not None:
+        from tidb_tpu import member
+        member.stop_heartbeat()
         status.close()
     server.close()
     storage.close()

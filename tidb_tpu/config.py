@@ -39,6 +39,8 @@ __all__ = ["get_var", "set_var", "all_vars", "coerce", "session_overlay",
            "dispatch_timeout_ms", "failpoints_spec", "on_change",
            "trace_sample", "slow_trace_ms",
            "metrics_history_interval_ms", "metrics_history_points",
+           "member_heartbeat_ms", "member_ttl_ms",
+           "cluster_fetch_timeout_ms",
            "UnknownVariableError"]
 
 
@@ -265,6 +267,19 @@ _DEFS: dict[str, tuple[str, int]] = {
     # metrics-history ring capacity in points (one point per sampler
     # tick); the oldest points evict past it
     "tidb_tpu_metrics_history_points": (_INT, 512),
+    # fleet membership registry (tidb_tpu/member.py): every server
+    # process republishes its ephemeral heartbeat record this often...
+    "tidb_tpu_member_heartbeat_ms": (_INT, 1000),
+    # ...and a record not rebeaten within this window is dead — peers
+    # stop fanning cluster_* queries out to it and it drops from
+    # information_schema.cluster_members. TTL should be >= 2-3x the
+    # heartbeat so one delayed beat doesn't flap membership.
+    "tidb_tpu_member_ttl_ms": (_INT, 3000),
+    # per-member HTTP budget of the cluster_* / /fleet/* fan-out
+    # (util/statusclient.fetch_all): an unreachable member costs at
+    # most this long and degrades that member's rows to a warning,
+    # never a hang or a statement error
+    "tidb_tpu_cluster_fetch_timeout_ms": (_INT, 2000),
     # failpoint arming (util/failpoint.py): "name=spec;name=spec" over
     # the declared registry, e.g. 'hbm/fill=2*raise(DeviceFaultError)'.
     # The value is DECLARATIVE for the SET surface: writing it arms the
@@ -568,6 +583,18 @@ def metrics_history_interval_ms() -> int:
 
 def metrics_history_points() -> int:
     return min(max(16, _read("tidb_tpu_metrics_history_points")), 1 << 16)
+
+
+def member_heartbeat_ms() -> int:
+    return max(100, _read("tidb_tpu_member_heartbeat_ms"))
+
+
+def member_ttl_ms() -> int:
+    return max(200, _read("tidb_tpu_member_ttl_ms"))
+
+
+def cluster_fetch_timeout_ms() -> int:
+    return max(100, _read("tidb_tpu_cluster_fetch_timeout_ms"))
 
 
 def trace_sample() -> int:

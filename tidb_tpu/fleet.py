@@ -21,13 +21,13 @@ within one lease interval (owner.py over the shared store).
 
 from __future__ import annotations
 
-import json
 import os
 import signal
 import subprocess
 import sys
 import time
-import urllib.request
+
+from tidb_tpu.util import statusclient
 
 __all__ = ["Fleet", "SQLMember"]
 
@@ -110,6 +110,7 @@ class Fleet:
         self.env = dict(env or {})
         self.store_proc: subprocess.Popen | None = None
         self.store_port: int | None = None
+        self.store_status_port: int | None = None
         self.members: list[SQLMember] = []
         self._rr = 0
 
@@ -122,6 +123,10 @@ class Fleet:
              "--retain-ms", str(self.retain_ms)], self.env)
         line = _await_line(self.store_proc, "storage listening on")
         self.store_port = _port_of(line)
+        # the store plane is a fleet member too: its status port serves
+        # /cluster/state so cluster_* queries see store-side traces
+        self.store_status_port = _port_of(
+            _await_line(self.store_proc, "status API on"))
         for i in range(self.n_sql):
             self.members.append(self._spawn_sql(i))
         return self
@@ -190,9 +195,8 @@ class Fleet:
     def health(self, index: int, timeout: float = 5.0) -> dict:
         """GET /status of one SQL member (the liveness probe)."""
         m = self.members[index]
-        url = f"http://{self.host}:{m.status_port}/status"
-        with urllib.request.urlopen(url, timeout=timeout) as r:
-            return json.loads(r.read().decode())
+        return statusclient.get_json(self.host, m.status_port,
+                                     "/status", timeout=timeout)
 
     def wait_healthy(self, timeout: float = 30.0) -> None:
         deadline = time.monotonic() + timeout

@@ -34,6 +34,7 @@ __all__ = ["counter", "histogram", "gauge", "expose", "snapshot",
            "BYTES_ENCODED", "BYTES_DECODED_EQUIV",
            "FAILPOINT_FIRES", "WORKER_RESTARTS", "DISPATCH_TIMEOUTS",
            "DEVICE_QUARANTINES", "TRACES",
+           "CLUSTER_SCRAPES", "MEMBER_START_TIME",
            "DEVICE_UTILIZATION", "HBM_OCCUPANCY"]
 
 _lock = threading.Lock()
@@ -257,6 +258,15 @@ DEVICE_QUARANTINES = "tidb_tpu_device_quarantine_total"
 # server trace ring, labeled by what retained them
 # (sampled|slow|forced)
 TRACES = "tidb_tpu_statement_traces_total"
+# cluster fan-out (util/statusclient.fetch_all): per-member fetch
+# outcomes of the cluster_* / /fleet/* surfaces. Labeled by outcome
+# only — NEVER by member (the metric-cardinality rule: members churn,
+# and the per-member attribution lives in cluster_members itself)
+CLUSTER_SCRAPES = "tidb_tpu_cluster_scrape_total"
+# member identity stamp on the /metrics exposition (server/status.py
+# renders it with the member id + role as labels — hand-rendered
+# there, not a registry series, because the id is per-process)
+MEMBER_START_TIME = "tidb_tpu_member_start_time_seconds"
 # continuous resource metering (meter.py + metrics_history.py): the
 # history sampler derives these each tick — device busy-ns per wall
 # interval (can exceed 1.0 under dispatch overlap; that overlap IS the
@@ -357,6 +367,12 @@ _HELP = {
     TRACES:
         "Statement traces retained into the server trace ring, "
         "by reason (sampled|slow|forced).",
+    CLUSTER_SCRAPES:
+        "Cluster fan-out fetches against member status ports, "
+        "by outcome (ok|timeout|error).",
+    MEMBER_START_TIME:
+        "This member's process start time (unix seconds), labeled "
+        "with its fleet member id and role.",
     DEVICE_UTILIZATION:
         "Device busy-time per wall second over the last history "
         "sampler interval (dispatch overlap can push it past 1.0).",

@@ -32,7 +32,8 @@ __all__ = ["MVCCStore", "WriteType", "physical_ms",
 
 # Ephemeral cluster-bookkeeping namespaces: DDL owner leases
 # (owner.py DDL_OWNER_KEY), schema-sync heartbeats (session Domain
-# SCHEMA_SYNC_PREFIX), and auto-increment batch allocations (meta
+# SCHEMA_SYNC_PREFIX), fleet membership heartbeats (member.py
+# MEMBER_PREFIX), and auto-increment batch allocations (meta
 # AutoID counters — id handout changes no committed row and no schema,
 # but every 4000th INSERT refills a batch through a meta txn). A live
 # server's background workers commit the leases every half-lease
@@ -42,7 +43,8 @@ __all__ = ["MVCCStore", "WriteType", "physical_ms",
 # entry, keeping both caches permanently cold exactly when the server
 # is serving. max_commit_ts and the lock set still advance/track for
 # these keys, so the MVCC fill contract is untouched.
-EPHEMERAL_PREFIXES = (b"m_owner_", b"m_schema_sync_", b"msAutoID:")
+EPHEMERAL_PREFIXES = (b"m_owner_", b"m_schema_sync_", b"m_member_",
+                      b"msAutoID:")
 
 
 # key classes for the delta-capture path (store/delta.py): committed

@@ -87,12 +87,34 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- dispatch ------------------------------------------------------------
 
+    def _fleet_members(self) -> list[dict]:
+        """Live fleet membership, degraded to just this process when
+        the registry is empty (standalone in-process servers are a
+        one-member fleet)."""
+        from tidb_tpu import member
+        members = member.live_members(self.server.ctx_storage)
+        return members or [member.identity()]
+
     def do_GET(self):  # noqa: N802 - stdlib API
         st = self.server.ctx_storage
         parts = [p for p in self.path.split("/") if p]
         try:
             if self.path == "/metrics":
-                body = metrics.expose().encode()
+                from tidb_tpu import member
+                ident = member.identity()
+                # member identity stamp, hand-rendered: the id is
+                # per-process (exactly what the cardinality rule keeps
+                # out of the registry), but ONE series per exposition
+                # makes multi-member scrapes joinable
+                stamp = (
+                    f"# HELP {metrics.MEMBER_START_TIME} This member's "
+                    f"process start time (unix seconds).\n"
+                    f"# TYPE {metrics.MEMBER_START_TIME} gauge\n"
+                    f"{metrics.MEMBER_START_TIME}"
+                    f"{{member=\"{ident['id']}\","
+                    f"role=\"{ident['role']}\"}} "
+                    f"{ident['start_unix']:.3f}\n")
+                body = (stamp + metrics.expose()).encode()
                 self.send_response(200)
                 self.send_header("Content-Type",
                                  "text/plain; version=0.0.4")
@@ -101,9 +123,10 @@ class _Handler(BaseHTTPRequestHandler):
                 self.wfile.write(body)
                 return
             if self.path in ("/", "/status"):
-                from tidb_tpu import sched
+                from tidb_tpu import member, sched
                 self._json({
                     "version": __version__,
+                    "member": member.identity(),
                     "connections": len(getattr(self.server.ctx_server,
                                                "_conns", ())),
                     "regions": len(_all_regions(st)),
@@ -111,6 +134,41 @@ class _Handler(BaseHTTPRequestHandler):
                     "metrics": metrics.snapshot(),
                 })
                 return
+            if self.path == "/cluster/state":
+                # this member's cluster-state document — the one fetch
+                # peers' cluster_* memtables and /fleet/* fan-outs make
+                from tidb_tpu import member
+                self._json(member.local_state())
+                return
+            if parts and parts[0] == "fleet":
+                # fleet-wide views from ANY member: fan out over the
+                # live membership with the shared bounded-timeout
+                # client; unreachable members land in "errors"
+                from tidb_tpu.util import statusclient
+                members = self._fleet_members()
+                if parts[1:] == ["top"]:
+                    docs, errors = statusclient.fetch_all(members,
+                                                          "/top")
+                    self._json({"members": docs, "errors": errors})
+                    return
+                if len(parts) == 3 and parts[1] == "trace":
+                    tid = int(parts[2])
+                    docs, errors = statusclient.fetch_all(
+                        members, "/cluster/state")
+                    hits = []
+                    for mid, doc in sorted(docs.items()):
+                        for rec in doc.get("traces", ()):
+                            if rec.get("trace_id") == tid or \
+                                    rec.get("origin_trace_id") == tid:
+                                hits.append(dict(rec, member=mid))
+                    from tidb_tpu import trace
+                    local = trace.ring_get(tid)
+                    code = 200 if hits else 404
+                    self._json({"trace_id": tid, "found": hits,
+                                "spans": trace.tree(local["root"])
+                                if local is not None else None,
+                                "errors": errors}, code)
+                    return
             if self.path == "/failpoint":
                 # the failpoint registry + armed state (POST arms)
                 from tidb_tpu.util import failpoint

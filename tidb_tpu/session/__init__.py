@@ -59,6 +59,34 @@ _session_seq = 0
 _session_seq_lock = threading.Lock()
 
 
+def processlist_snapshot() -> list[dict]:
+    """Live sessions as plain dicts — the /cluster/state export the
+    cluster_processlist memtable fans out over (the JSON-able twin of
+    SHOW PROCESSLIST's rows)."""
+    out = []
+    now = time.time()
+    with _session_seq_lock:   # adds are serialized with snapshot
+        live = list(_SESSIONS)
+    for s in sorted(live, key=lambda x: x.session_id):
+        sql = s.current_sql
+        tracker = getattr(s, "mem_tracker", None)
+        rm = getattr(s, "res_meter", None)
+        mtot = rm.totals() if rm is not None else {}
+        out.append({
+            "id": s.session_id,
+            "user": s.user,
+            "host": s.host,
+            "db": s.current_db or None,
+            "command": "Query" if sql else "Sleep",
+            "time_s": int(now - s.created_at),
+            "info": (sql or "")[:100] or None,
+            "mem_bytes": tracker.total() if tracker is not None else 0,
+            "device_ms": mtot.get("device_ns", 0) // 1_000_000,
+            "rows_sent": mtot.get("rows_sent", 0),
+        })
+    return out
+
+
 class SQLError(Exception):
     pass
 
@@ -1381,8 +1409,12 @@ class Session:
     # -- queries -------------------------------------------------------------
 
     def _planner(self) -> Planner:
+        # storage hands the planner the membership registry: the
+        # information_schema.cluster_* memtables enumerate live members
+        # from it and fan their /cluster/state fetches out at plan time
         return Planner(self.domain.info_schema(), self.current_db,
-                       stats_handle=self.domain.stats_handle())
+                       stats_handle=self.domain.stats_handle(),
+                       storage=self.storage)
 
     def _stats_collector(self):
         """Active (or fresh) per-statement runtime-stats collector, None
@@ -1420,10 +1452,17 @@ class Session:
             plan = self.domain.plan_cache().get(cache_key)
         if plan is None:
             with trace.span("plan", cached=False):
+                planner = self._planner()
                 try:
-                    plan = self._planner().plan(stmt)
+                    plan = planner.plan(stmt)
                 except (PlanError, ResolveError) as e:
                     raise SQLError(str(e)) from None
+                # degraded-but-answered notes (cluster_* fan-out with
+                # an unreachable member) surface via SHOW WARNINGS; the
+                # cluster memtables are cacheable=False, so a cache hit
+                # can never skip a fan-out that would have warned
+                for w in planner.warnings:
+                    self.add_warning(*w)
             if cache_key is not None and _plan_cacheable(plan):
                 self.domain.plan_cache().put(cache_key, plan)
         ctx = ExecContext(self.storage, self._read_ts(), self.txn,

@@ -706,8 +706,17 @@ class CopClient(kv.Client):
         mem_root = memtrack.current()
         res_meter = meter.current()
         tspan = trace.propagate()
+        # consumer-gone signal, checked between tasks: teardown signals
+        # it and then JOINS the pool (the copIterator.Close
+        # finished-channel + wg.Wait() discipline) — a statement never
+        # leaves detached workers holding scheduler slots or ledger
+        # bytes past its own unwind, which is exactly what the
+        # ledger_hygiene drain checks assert right after an error
+        stop = threading.Event()
 
         def run_task(rq, rng):
+            if stop.is_set():
+                return []
             with config.session_overlay(overlay), \
                     runtime_stats.collecting(coll), \
                     memtrack.tracking(mem_root), \
@@ -732,6 +741,8 @@ class CopClient(kv.Client):
                         meter.metering(res_meter), \
                         trace.attached(tspan):
                     for _loc, rng in task_list:
+                        if stop.is_set():   # consumer gone: stop at the
+                            break           # next task boundary
                         with trace.span("copr.task"):
                             out = self._run_task(req, rng)
                         for resp in out:
@@ -765,7 +776,11 @@ class CopClient(kv.Client):
                                                   nxt[1]))
                     yield from f.result()
             finally:
-                pool.shutdown(wait=False, cancel_futures=True)
+                # signal, drop queued tasks, then WAIT: in-flight tasks
+                # finish their current dispatch and release their slots
+                # before the statement's unwind completes
+                stop.set()
+                pool.shutdown(wait=True, cancel_futures=True)
             return
         buckets = [tasks[i::concurrency] for i in range(concurrency)]
         pool = ThreadPoolExecutor(max_workers=concurrency,
@@ -783,7 +798,11 @@ class CopClient(kv.Client):
                 else:
                     yield item
         finally:
-            pool.shutdown(wait=False)
+            # `results` is unbounded so no producer can block on a put;
+            # the stop flag bounds the join at one in-flight task per
+            # worker
+            stop.set()
+            pool.shutdown(wait=True)
 
     def _run_task(self, req: CopRequest, rng: KVRange):
         """One region task with retry (handleTask, coprocessor.go:507):
@@ -876,6 +895,8 @@ class CopClient(kv.Client):
                         trace.attached(tspan), \
                         trace.span("copr.stream", tasks=len(task_list)):
                     for _loc, rng in task_list:
+                        if stop.is_set():
+                            return           # consumer gone
                         for resp in self._run_task_stream(
                                 req, rng, new_counter()):
                             if not q.put(resp):
@@ -893,8 +914,13 @@ class CopClient(kv.Client):
             yield from q.drain(len(buckets))
             annotate_totals()
         finally:
+            # stop, then JOIN: q.put polls the stop event every 50ms so
+            # blocked producers exit promptly, and a producer mid-frame
+            # finishes its current device step and releases its slot
+            # before the statement's unwind completes — no detached
+            # worker outlives the statement (ledger/slot hygiene)
             stop.set()
-            pool.shutdown(wait=False)
+            pool.shutdown(wait=True)
 
     def _send_streaming_ordered(self, req: CopRequest, tasks,
                                 concurrency: int, credit: int,
@@ -958,8 +984,8 @@ class CopClient(kv.Client):
                     window.append(launch(nxt[1]))
                 yield from q0.drain(1)
         finally:
-            stop.set()
-            pool.shutdown(wait=False)
+            stop.set()               # producers poll it inside put()
+            pool.shutdown(wait=True)
 
     def _run_task_stream(self, req: CopRequest, rng: KVRange,
                          counter: dict | None = None):

@@ -101,6 +101,26 @@ def _recv_frame(sock: socket.socket) -> tuple[int, bytes]:
 # ---------------------------------------------------------------------------
 # server side
 
+def _adopt_origin(root, flags: dict) -> dict | None:
+    """Map a request's forward-propagated trace context (wire.FLAG_ORIGIN)
+    onto the local handler root: the originating statement's sampled/
+    forced retention decision applies to the storage-side tree too, and
+    the origin id/member land as tags so the retained record — and the
+    span tree shipped back — carry the fleet-wide join key.
+    -> the validated origin dict (None when absent/malformed)."""
+    origin = flags.get(wire.FLAG_ORIGIN)
+    if not isinstance(origin, dict) or "trace_id" not in origin:
+        return None
+    try:
+        root.tags["origin_trace_id"] = int(origin["trace_id"])
+    except (TypeError, ValueError):
+        return None
+    root.sampled = bool(origin.get("sampled"))
+    root.forced = bool(origin.get("forced"))
+    root.tags["origin_member"] = str(origin.get("member", ""))
+    return origin
+
+
 class StorageServer:
     """Hosts a full storage node (cluster topology + MVCC engine + RPC
     shim + coprocessor with its device kernels + columnar chunk cache)
@@ -397,9 +417,11 @@ class StorageServer:
         kwargs = dict(kwargs)
         credit = kwargs.pop("credit", None)
         root = None
-        if flags and flags.get("trace"):
+        origin = None
+        if flags and flags.get(wire.FLAG_TRACE):
             from tidb_tpu import trace as _trace
             root = _trace.begin("storage:coprocessor_stream")
+            origin = _adopt_origin(root, flags)
         gen = None
         try:
             gate = wire.CreditGate(credit if credit is not None else 4)
@@ -408,6 +430,9 @@ class StorageServer:
             if root is not None:
                 from tidb_tpu import trace as _trace
                 _trace.end(root)    # unpin the thread-local trace root
+                _trace.finish_statement(root, "storage:coprocessor_stream",
+                                        origin=origin)
+                root = None
             return self._stream_abort(sock, e)
         try:
             it = iter(gen)
@@ -436,6 +461,8 @@ class StorageServer:
             if root is not None:
                 from tidb_tpu import trace as _trace
                 _trace.end(root)
+                _trace.finish_statement(root, "storage:coprocessor_stream",
+                                        origin=origin)
                 end_payload = wire.encode(root.to_dict())
                 root = None
             else:
@@ -463,7 +490,9 @@ class StorageServer:
         finally:
             if root is not None:
                 from tidb_tpu import trace as _trace
-                _trace.end(root)    # error/disconnect path: just unpin
+                _trace.end(root)    # error/disconnect path: unpin, and
+                _trace.finish_statement(root, "storage:coprocessor_stream",
+                                        origin=origin)  # still joinable
             if gen is not None and hasattr(gen, "close"):
                 gen.close()
 
@@ -496,18 +525,28 @@ class StorageServer:
                         if self._serve_stream(sock, args, kwargs, flags):
                             continue
                         return
-                    if flags.get("trace"):
+                    if flags.get(wire.FLAG_TRACE):
                         # cross-process span propagation: run under a
                         # local root and ship the finished tree back for
                         # the client to graft into its statement trace
                         from tidb_tpu import trace
-                        # lint: exempt[trace-names] cross-process storage root: the method name is wire data; these roots graft via attach_remote, never into the statement ring
+                        # lint: exempt[trace-names] cross-process storage root: the method name is wire data; these roots graft via attach_remote and retain only origin-stamped
                         root = trace.begin(f"storage:{method}")
+                        origin = _adopt_origin(root, flags)
                         try:
                             result = self._serve_call(method, args,
                                                       kwargs)
                         finally:
                             trace.end(root)
+                            # store-plane retention: a sampled/forced/
+                            # slow handler root keeps its tree in THIS
+                            # process's ring, stamped with the
+                            # originating statement's fleet trace id —
+                            # the record cluster_statement_traces and
+                            # /fleet/trace join on
+                            trace.finish_statement(
+                                root, f"storage:{method}",
+                                origin=origin)
                         out = wire.encode((result, root.to_dict()))
                         status = _STATUS_OK_TRACED
                     else:
@@ -560,6 +599,18 @@ class _Conn:
         self.sock = socket.create_connection(addr, timeout=timeout)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 
+    @staticmethod
+    def _trace_flags(trace) -> dict:
+        """Request flags of a traced call: the trace bit plus the
+        originating statement's forward context (fleet-unique trace id
+        + retention flags + member id) so the store plane can stamp
+        whatever it retains with the statement that caused it."""
+        flags = {wire.FLAG_TRACE: True}
+        o = trace.origin()
+        if o is not None:
+            flags[wire.FLAG_ORIGIN] = o
+        return flags
+
     def call(self, method: str, args: tuple, kwargs: dict):
         from tidb_tpu import trace
         cmd = wire.CMD_BY_METHOD.get(method)
@@ -567,7 +618,7 @@ class _Conn:
             raise kv.KVError(f"method {method!r} has no wire command")
         req = (int(cmd), tuple(args), dict(kwargs))
         if trace.active():
-            req = req + ({"trace": True},)
+            req = req + (self._trace_flags(trace),)
         payload = wire.encode(req)
         _send_frame(self.sock, _STATUS_OK, payload)
         status, body = _recv_frame(self.sock)
@@ -592,7 +643,7 @@ class _Conn:
             raise kv.KVError(f"method {method!r} has no wire command")
         req = (int(cmd), tuple(args), dict(kwargs, credit=credit))
         if trace.active():
-            req = req + ({"trace": True},)
+            req = req + (self._trace_flags(trace),)
         _send_frame(self.sock, wire.STATUS_OK, wire.encode(req))
         reader = wire.StreamReader(credit)
         while True:
@@ -1046,6 +1097,10 @@ def serve_main(argv=None) -> int:
                                 description="storage node process")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=0)
+    p.add_argument("--status-port", type=int, default=0)
+    p.add_argument("--no-status", action="store_true",
+                   help="disable the HTTP status server (and with it "
+                        "this node's fleet membership registration)")
     p.add_argument("--snapshot", default=None,
                    help="state snapshot file (loaded at start, saved on "
                         "graceful shutdown)")
@@ -1076,10 +1131,29 @@ def serve_main(argv=None) -> int:
         primary_addr=_addr(args.primary) if args.primary else None)
     server.start()
     print(f"storage listening on {args.host}:{server.port}", flush=True)
+    status = None
+    if not args.no_status:
+        # the store plane is a first-class fleet member: it serves the
+        # same status surface (metrics, traces, /cluster/state) and
+        # registers in the membership registry it hosts, so any SQL
+        # member's cluster_* queries include store-plane rows — and the
+        # store-retained traces become reachable fleet-wide
+        from tidb_tpu import member
+        from tidb_tpu.server.status import StatusServer
+        status = StatusServer(server.storage, None, host=args.host,
+                              port=args.status_port)
+        status.start()
+        member.set_identity(args.host, status.port, "store")
+        member.start_heartbeat(server.storage)
+        print(f"status API on {args.host}:{status.port}", flush=True)
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     stop.wait()
+    if status is not None:
+        from tidb_tpu import member
+        member.stop_heartbeat()
+        status.close()
     server.close()
     return 0
 

@@ -39,6 +39,7 @@ __all__ = ["Cmd", "WireError", "encode", "decode",
            "encode_frame", "decode_frame_payload",
            "STATUS_OK", "STATUS_ERR", "STATUS_OK_TRACED",
            "STATUS_STREAM_FRAME", "STATUS_STREAM_END", "STATUS_CREDIT",
+           "FLAG_TRACE", "FLAG_ORIGIN",
            "MAX_STREAM_CREDIT", "StreamReader", "CreditGate"]
 
 
@@ -639,6 +640,20 @@ STATUS_OK_TRACED = 2   # payload = (result, span-tree dict)
 STATUS_STREAM_FRAME = 3
 STATUS_STREAM_END = 4
 STATUS_CREDIT = 5
+
+# request-flags vocabulary (the optional 4th element of the request
+# envelope — cross-process metadata, never command arguments):
+#   FLAG_TRACE:  bool — the caller is traced; run the handler under a
+#       local "storage:<method>" root and ship the finished tree back
+#       (STATUS_OK_TRACED / the stream END frame).
+#   FLAG_ORIGIN: dict — trace.origin() of the calling STATEMENT:
+#       {"trace_id": fleet-unique id, "sampled": bool, "forced": bool,
+#        "member": originating member id}. The server maps sampled/
+#       forced onto its local root and stamps anything it retains with
+#       origin_trace_id/origin_member, so store-plane ring records
+#       join back to the SQL statement that caused them.
+FLAG_TRACE = "trace"
+FLAG_ORIGIN = "origin"
 
 MAX_STREAM_CREDIT = 1024
 

@@ -48,10 +48,10 @@ import time
 
 __all__ = ["Span", "SPAN_NAMES", "begin", "end", "span", "event",
            "annotate", "current_root", "active", "detach", "restore",
-           "attached", "propagate", "attach_remote", "phase_ns",
-           "log_tree", "ensure_id", "finish_statement", "tree",
-           "validate", "phases_of", "ring_snapshot", "ring_records",
-           "ring_get", "to_chrome", "reset_for_tests"]
+           "attached", "propagate", "attach_remote", "origin",
+           "phase_ns", "log_tree", "ensure_id", "finish_statement",
+           "tree", "validate", "phases_of", "ring_snapshot",
+           "ring_records", "ring_get", "to_chrome", "reset_for_tests"]
 
 log = logging.getLogger("tidb_tpu.trace")
 
@@ -87,6 +87,10 @@ SPAN_NAMES = {
     "join.partition": "one radix partition's device chain",
     # cross-process storage roots (store/remote.py)
     "storage:coprocessor_stream": "storage-side root of one COP stream",
+    # cluster observability fan-out (util/statusclient.fetch_all): one
+    # bounded-timeout sweep over live members' status ports serving a
+    # cluster_* memtable or a /fleet/* endpoint
+    "cluster.fetch": "fan-out fetch over live members' status ports",
 }
 
 # retention bounds of the server-scope trace ring: records and an
@@ -150,6 +154,11 @@ def begin(name: str, **tags) -> Span:
     root.forced = False
     root.trace_id = None
     _tl.cur = root
+    # the ROOT is tracked separately from the current span: origin()
+    # must name the enclosing statement from arbitrarily deep inside
+    # its tree (spans carry no parent pointers), and the store-RPC
+    # client fires from exactly there
+    _tl.root = root
     return root
 
 
@@ -157,6 +166,8 @@ def end(root: Span) -> Span:
     root.end_ns = time.perf_counter_ns()
     if getattr(_tl, "cur", None) is root:
         _tl.cur = None
+    if getattr(_tl, "root", None) is root:
+        _tl.root = None
     return root
 
 
@@ -167,35 +178,43 @@ def current_root():
 def detach():
     """Suspend the thread's trace (internal bookkeeping sessions run
     inside a client statement but must not pollute its phase breakdown).
-    -> token for restore()."""
-    cur = getattr(_tl, "cur", None)
+    -> opaque token for restore()."""
+    token = (getattr(_tl, "cur", None), getattr(_tl, "root", None))
     _tl.cur = None
-    return cur
+    _tl.root = None
+    return token
 
 
 def restore(token) -> None:
-    _tl.cur = token
+    _tl.cur, _tl.root = token
 
 
 def propagate():
-    """The current span, for re-installation inside worker threads with
-    `attached()` — the trace twin of runtime_stats.current() /
-    memtrack.current() riding into the coprocessor fan-out."""
-    return getattr(_tl, "cur", None)
+    """Opaque token naming the current span AND its statement root, for
+    re-installation inside worker threads with `attached()` — the trace
+    twin of runtime_stats.current() / memtrack.current() riding into
+    the coprocessor fan-out. The root rides along so store RPCs issued
+    from pool/stream workers still know which statement they originate
+    from (origin())."""
+    return (getattr(_tl, "cur", None), getattr(_tl, "root", None))
 
 
 @contextlib.contextmanager
-def attached(parent):
-    """Install `parent` (from propagate(), possibly None) as this
-    thread's current span: spans the worker opens hang off the
+def attached(token):
+    """Install a propagate() token (possibly None) as this thread's
+    current span + root: spans the worker opens hang off the
     dispatching statement's tree. Child appends are GIL-atomic list
     ops, so concurrent workers may attach under one parent."""
-    prev = getattr(_tl, "cur", None)
-    _tl.cur = parent if parent is not None else prev
+    prev_cur = getattr(_tl, "cur", None)
+    prev_root = getattr(_tl, "root", None)
+    cur, root = token if token is not None else (None, None)
+    _tl.cur = cur if cur is not None else prev_cur
+    _tl.root = root if root is not None else prev_root
     try:
         yield
     finally:
-        _tl.cur = prev
+        _tl.cur = prev_cur
+        _tl.root = prev_root
 
 
 class span:
@@ -252,6 +271,22 @@ def event(name: str, **tags) -> None:
     cur = getattr(_tl, "cur", None)
     if cur is not None:
         cur.event(name, **tags)
+
+
+def origin() -> dict | None:
+    """Forward propagation context of the statement enclosing this
+    thread: the fleet-unique trace id of its ROOT plus the retention
+    flags, shipped inside traced store RPCs (store/remote.py request
+    flags) so anything the store plane retains on its own — slow
+    handler roots, forced traces — carries the originating statement's
+    id and member instead of being unjoinable. None when untraced."""
+    root = getattr(_tl, "root", None)
+    if root is None:
+        return None
+    return {"trace_id": ensure_id(root),
+            "sampled": bool(root.sampled),
+            "forced": bool(root.forced),
+            "member": _member().member_id()}
 
 
 def attach_remote(d: dict) -> None:
@@ -319,6 +354,17 @@ def _cfg():
     return _config
 
 
+_member_mod = None
+
+
+def _member():
+    global _member_mod
+    if _member_mod is None:
+        from tidb_tpu import member
+        _member_mod = member
+    return _member_mod
+
+
 def _sample_next() -> bool:
     """Deterministic 1-in-N: the N-th, 2N-th, ... statement since
     process start (or reset) is sampled. One lock'd int increment per
@@ -334,13 +380,19 @@ def _sample_next() -> bool:
 
 
 def ensure_id(root: Span) -> int:
-    """The root's trace id, assigned on first need (the TRACE statement
-    reads it before retention runs)."""
+    """The root's FLEET-UNIQUE trace id, assigned on first need (the
+    TRACE statement reads it before retention runs). The process's
+    32-bit member start nonce (member.py) occupies the high bits over
+    a 24-bit per-process sequence: two members minting concurrently
+    never collide, a restarted member never reuses its predecessor's
+    id space, and ids stay monotonic within one process — min_id
+    filtering (ring_records) keeps working."""
     if root.trace_id is None:
         global _id_seq
         with _seq_lock:
             _id_seq += 1
-            root.trace_id = _id_seq
+            seq = _id_seq
+        root.trace_id = (_member().nonce() << 24) | (seq & 0xFFFFFF)
     return root.trace_id
 
 
@@ -423,14 +475,20 @@ def _span_count(root: Span) -> int:
 
 
 def finish_statement(root: Span, sql: str, error: str | None = None,
-                     slow_ms: int | None = None) -> int | None:
+                     slow_ms: int | None = None,
+                     origin: dict | None = None) -> int | None:
     """Retention decision for one ENDED statement root: keep the full
     tree in the ring when the statement was sampled, forced (TRACE), or
     ran past `tidb_tpu_slow_trace_ms`. -> trace id when retained, else
     None. The untraced path is one flag test + one sysvar read.
     `slow_ms` overrides the registry read — the session passes its
     shadowed (session-SET) value, captured while its overlay was still
-    installed."""
+    installed. `origin` is the forward-propagated context of a
+    CROSS-PROCESS caller (trace.origin() shipped in store-RPC flags):
+    the record's origin_trace_id/origin_member then name the SQL
+    statement that caused this store-plane root, instead of defaulting
+    to the local identity — the join key cluster_statement_traces and
+    /fleet/trace search on."""
     if root.forced:
         reason = "forced"
     elif root.sampled:
@@ -453,6 +511,9 @@ def finish_statement(root: Span, sql: str, error: str | None = None,
         "reason": reason,
         "error": error and error[:256],
         "span_count": _span_count(root),
+        "origin_trace_id": int(origin["trace_id"]) if origin else tid,
+        "origin_member": (origin.get("member") or "") if origin
+        else _member().member_id(),
         "root": root,
     }
     rec["cost"] = rec["span_count"] * _SPAN_EST_BYTES + len(rec["sql"])
@@ -468,7 +529,8 @@ def ring_snapshot() -> list[dict]:
     for rec in _RING.records():
         out.append({k: rec[k] for k in
                     ("trace_id", "digest", "sql", "start_unix",
-                     "duration_ns", "span_count", "reason", "error")})
+                     "duration_ns", "span_count", "reason", "error",
+                     "origin_trace_id", "origin_member")})
     return out
 
 

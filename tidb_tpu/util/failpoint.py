@@ -98,6 +98,13 @@ REGISTRY: dict[str, str] = {
     "worker/tick": "util/supervisor.py — each supervised background-"
                    "worker beat (schema worker, delta merge); args "
                    "(worker_name,)",
+    # cluster observability fan-out, before each per-member status-port
+    # fetch: args (member_id, path). Arming it simulates a wedged or
+    # partitioned member — cluster_* queries must degrade to partial
+    # rows + a warning, never hang or error.
+    "cluster/fetch": "util/statusclient.py _fetch_one — before each "
+                     "per-member fetch of the cluster_* / /fleet/* "
+                     "fan-out",
 }
 
 
