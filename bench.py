@@ -2108,6 +2108,217 @@ def chaos_main() -> None:
     }))
 
 
+def _multichip_child_main() -> None:
+    """`python bench.py multichip-child` (internal): ONE leg of the
+    multichip series, in a fresh process whose XLA host-platform device
+    count the parent pinned via XLA_FLAGS — the device count is fixed
+    at backend init and cannot change inside a process.
+
+    Reporting model (1-core CI host): the n shard executions of a
+    sharded kernel SERIALIZE on one core, so the measured wall at n
+    devices approximates n × the per-chip device time a real n-chip
+    plane would overlap. Per-chip rows/sec is therefore input_rows /
+    measured_wall at EVERY n — each chip processes rows/n in wall/n.
+    What the series actually measures is per-chip EFFICIENCY: padding,
+    collective merges, and dispatch overhead show up as a per-chip
+    rows/sec drop from n=1 to n=8.
+
+    The serve leg issues point-shaped statements (selective no-group
+    aggregations — never mesh-routed, served fused from replicated HBM
+    region blocks) and reads the per-chip busy-time the scheduler
+    attributed to its least-loaded slot placement. Aggregate serving
+    rows/sec = rows scanned / BUSIEST chip's busy time: statements on
+    different chips overlap on real hardware, so the makespan is the
+    most-loaded chip — the number that must grow with the mesh."""
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        _scope_cpu_compile_cache()
+    ndev = int(os.environ["MULTICHIP_NDEV"])
+    sf = float(os.environ.get("BENCH_MULTICHIP_SF", "0.05"))
+    iters = int(os.environ.get("BENCH_MULTICHIP_ITERS", "3"))
+    serve_rounds = int(os.environ.get("BENCH_MULTICHIP_SERVE_ROUNDS",
+                                      "32"))
+
+    import jax
+
+    from tidb_tpu import config, devplane, metrics, sched
+    from tidb_tpu.benchmarks import tpch
+    from tidb_tpu.session import Session
+    from tidb_tpu.store.storage import new_mock_storage
+
+    avail = len(jax.devices())
+    if avail < ndev:
+        print(json.dumps({"n_devices": ndev, "ok": False,
+                          "error": f"only {avail} XLA devices visible"}))
+        return
+
+    def progress(msg: str) -> None:
+        print(f"[multichip n={ndev}] {msg}", file=sys.stderr, flush=True)
+
+    data = tpch.ScaledTpch(sf=sf)
+    storage = new_mock_storage()
+    session = Session(storage)
+    session.execute("CREATE DATABASE tpch")
+    session.execute("USE tpch")
+    total_rows = tpch.load(session, storage, data, regions_per_table=4)
+    progress(f"loaded {total_rows} rows (sf={sf})")
+
+    config.set_var("tidb_tpu_device", 1)
+    if ndev > 1:
+        devplane.enable_mesh(ndev)
+
+    queries = {}
+    for qname in ("q1", "q3"):
+        sql = tpch.QUERIES[qname]
+        in_rows = sum(data.counts[t] for t in tpch.QUERY_TABLES[qname])
+        session.query(sql)          # compile + chunk/HBM cache fill
+        secs, _rows = _time_query(session, sql, iters)
+        queries[qname] = {
+            "input_rows": in_rows,
+            "best_secs": round(secs, 4),
+            "per_chip_rows_per_sec": round(in_rows / secs, 1),
+        }
+        progress(f"{qname}: {queries[qname]['per_chip_rows_per_sec']} "
+                 f"rows/s/chip")
+
+    # -- serve leg: point statements spread over per-chip slot streams
+    serve_sql = ("SELECT COUNT(*), SUM(o_orderdate) FROM orders "
+                 "WHERE o_custkey = {k}")
+    n_cust = data.counts["customer"]
+    session.query(serve_sql.format(k=0))        # compile + HBM fill
+    busy0 = sched.device_scheduler().chip_busy_ns()
+    grants0 = sched.device_scheduler().snapshot()["grants"]
+    t0 = time.perf_counter()
+    for i in range(serve_rounds):
+        session.query(serve_sql.format(k=(i * 131) % n_cust))
+    serve_wall = time.perf_counter() - t0
+    busy1 = sched.device_scheduler().chip_busy_ns()
+    grants = sched.device_scheduler().snapshot()["grants"] - grants0
+    busy = {c: (busy1.get(c, 0) - busy0.get(c, 0)) / 1e9
+            for c in busy1 if busy1.get(c, 0) > busy0.get(c, 0)}
+    max_busy = max(busy.values(), default=0.0)
+    served_rows = data.counts["orders"] * serve_rounds
+    serve = {
+        "statements": serve_rounds,
+        "slot_grants": grants,
+        "rows_scanned": served_rows,
+        "wall_secs": round(serve_wall, 3),
+        "chips_used": len(busy),
+        "per_chip_busy_secs": {str(c): round(s, 4)
+                               for c, s in sorted(busy.items())},
+        "max_chip_busy_secs": round(max_busy, 4),
+        "aggregate_rows_per_sec": round(served_rows / max_busy, 1)
+        if max_busy else 0.0,
+    }
+    progress(f"serve: {serve['aggregate_rows_per_sec']} rows/s over "
+             f"{serve['chips_used']} chip(s)")
+
+    # the unified plane has no mesh-specific fallback class left; any
+    # reason="mesh" count is a regression the parent fails on
+    snap = metrics.snapshot()
+    mesh_fallbacks = int(sum(
+        v for k, v in snap.items()
+        if k.startswith(metrics.DEVICE_FALLBACKS)
+        and 'reason="mesh"' in k))
+
+    print(json.dumps({
+        "n_devices": ndev,
+        "platform": jax.devices()[0].platform,
+        "sf": sf,
+        "queries": queries,
+        "serve": serve,
+        "mesh_fallbacks": mesh_fallbacks,
+        "ok": True,
+    }))
+
+
+def multichip_main() -> None:
+    """`python bench.py multichip`: the MULTICHIP perf series — per-chip
+    rows/sec and serving aggregate at 1/2/4/8 virtual devices, one
+    subprocess per device count (XLA fixes the host-platform device
+    count at backend init). Fails (vs_baseline=0, ok=false) on per-chip
+    collapse (>25% drop 1→8), a serving aggregate that does not grow
+    with the mesh, or any reason="mesh" fallback."""
+    import re
+    import subprocess
+
+    dev_counts = [int(x) for x in
+                  os.environ.get("BENCH_MULTICHIP_DEVS",
+                                 "1,2,4,8").split(",")]
+    t_start = time.perf_counter()
+
+    def progress(msg: str) -> None:
+        print(f"[multichip +{time.perf_counter() - t_start:7.1f}s] {msg}",
+              file=sys.stderr, flush=True)
+
+    legs = []
+    for n in dev_counts:
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+",
+                       "", env.get("XLA_FLAGS", "")).strip()
+        env["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={n}".strip())
+        env["MULTICHIP_NDEV"] = str(n)
+        progress(f"leg n={n}: spawning child")
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "multichip-child"],
+            env=env, capture_output=True, text=True)
+        sys.stderr.write(proc.stderr)
+        line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() \
+            else ""
+        try:
+            leg = json.loads(line)
+        except (ValueError, IndexError):
+            leg = {"n_devices": n, "ok": False,
+                   "error": f"rc={proc.returncode}: {line[:200]!r}"}
+        legs.append(leg)
+
+    by_n = {leg["n_devices"]: leg for leg in legs if leg.get("ok")}
+    checks = {"per_chip_held": False, "serve_scales": False,
+              "no_mesh_fallbacks": False}
+    ratios = {}
+    lo, hi = min(dev_counts), max(dev_counts)
+    if lo in by_n and hi in by_n:
+        for qname in by_n[lo]["queries"]:
+            r1 = by_n[lo]["queries"][qname]["per_chip_rows_per_sec"]
+            rn = by_n[hi]["queries"][qname]["per_chip_rows_per_sec"]
+            ratios[qname] = round(rn / r1, 3) if r1 else 0.0
+        checks["per_chip_held"] = bool(ratios) and \
+            min(ratios.values()) >= 0.75
+        s1 = by_n[lo]["serve"]["aggregate_rows_per_sec"]
+        sn = by_n[hi]["serve"]["aggregate_rows_per_sec"]
+        checks["serve_scales"] = sn > s1 > 0
+        checks["no_mesh_fallbacks"] = all(
+            leg.get("mesh_fallbacks", 1) == 0 for leg in legs)
+    ok = all(checks.values()) and len(by_n) == len(dev_counts)
+
+    print(json.dumps({
+        "metric": "multichip_per_chip_rows_per_sec_ratio_1_to_n",
+        "value": round(min(ratios.values()), 3) if ratios else 0.0,
+        "unit": "ratio",
+        "vs_baseline": 1.0 if ok else 0.0,
+        "detail": {
+            "device_counts": dev_counts,
+            "legs": legs,
+            "per_chip_ratio_1_to_n": ratios,
+            "serve_aggregate_by_n": {
+                str(n): by_n[n]["serve"]["aggregate_rows_per_sec"]
+                for n in sorted(by_n)},
+            "checks": checks,
+            "ok": ok,
+            "host_cpus": os.cpu_count(),
+            "wall_model": "1-core host: sharded kernels serialize, so "
+                          "per-chip rows/sec = input_rows / wall at "
+                          "every n; serving makespan = busiest chip's "
+                          "attributed busy time (see "
+                          "_multichip_child_main)",
+        },
+    }))
+    if not ok:
+        raise SystemExit(1)
+
+
 def main() -> None:
     sf = float(os.environ.get("BENCH_SF", "1.0"))
     iters = int(os.environ.get("BENCH_ITERS", "5"))
@@ -2465,5 +2676,9 @@ if __name__ == "__main__":
         chaos_main()
     elif len(sys.argv) > 1 and sys.argv[1] == "trace":
         trace_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "multichip":
+        multichip_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "multichip-child":
+        _multichip_child_main()
     else:
         main()

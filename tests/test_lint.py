@@ -48,8 +48,8 @@ def report():
 
 
 def test_catalog_is_complete():
-    """4 ported + 11 project-specific + 3 whole-program flow rules."""
-    assert len(RULE_NAMES) == 18, RULE_NAMES
+    """4 ported + 12 project-specific + 3 whole-program flow rules."""
+    assert len(RULE_NAMES) == 19, RULE_NAMES
     for ported in ("wire-discipline", "hot-path-sync", "metric-names",
                    "memtrack-alloc"):
         assert ported in RULE_NAMES
@@ -57,7 +57,7 @@ def test_catalog_is_complete():
                 "errcode-discipline", "device-sync", "dtype-discipline",
                 "bare-except", "device-cache", "decode-discipline",
                 "failpoint-discipline", "trace-names",
-                "metric-cardinality"):
+                "no-parallel-import", "metric-cardinality"):
         assert new in RULE_NAMES
     for flow in ("lock-order", "guarded-by", "paired-resource"):
         assert flow in RULE_NAMES

@@ -60,7 +60,7 @@ def mesh():
 
 
 def test_mesh_shape(mesh):
-    assert mesh.shape == {"dp": 4, "tp": 2}
+    assert mesh.shape == {"batch": 8}
 
 
 def test_dist_agg_matches_host(mesh):

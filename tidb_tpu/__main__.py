@@ -101,7 +101,7 @@ def main(argv=None) -> int:
              "on" if config.server_mem_quota() else "off",
              config.admission_timeout_ms())
 
-    from tidb_tpu.parallel import config as mesh_config
+    from tidb_tpu import devplane as mesh_config
     if args.no_mesh:
         mesh_config.disable_mesh()
     else:

@@ -45,7 +45,7 @@ QGRP = ("SELECT lo_discount, COUNT(*), SUM(lo_revenue) FROM lineorder "
 def run(sf: float = 0.1, regions: int = 16,
         stream_rows: int | None = None) -> dict:
     from tidb_tpu import config
-    from tidb_tpu.parallel import config as mesh_config
+    from tidb_tpu import devplane as mesh_config
     from tidb_tpu.schema.model import TableInfo  # noqa: F401 (import check)
     from tidb_tpu.session import Session
     from tidb_tpu.store.storage import new_mock_storage

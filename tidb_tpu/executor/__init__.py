@@ -48,7 +48,7 @@ _SHUFFLE_KERNELS_LOCK = threading.Lock()
 
 
 def _evict_stale_shuffle_kernels() -> None:
-    from tidb_tpu.parallel import config as mesh_config
+    from tidb_tpu import devplane as mesh_config
     gen = mesh_config.mesh_generation()
     with _SHUFFLE_KERNELS_LOCK:
         for k in [k for k in _SHUFFLE_KERNELS if k[0] != gen]:
@@ -58,7 +58,7 @@ def _evict_stale_shuffle_kernels() -> None:
 def _register_mesh_listener() -> None:
     # release compiled shard_map executables when the topology changes
     # (incl. disable_mesh — no later join would otherwise evict them)
-    from tidb_tpu.parallel import config as mesh_config
+    from tidb_tpu import devplane as mesh_config
     mesh_config.on_topology_change(_evict_stale_shuffle_kernels)
 
 
@@ -516,7 +516,7 @@ class HashAggExec(Executor):
         if jplan.join_type != "inner" or jplan.other_cond is not None \
                 or not jplan.left_keys:
             return None
-        from tidb_tpu.parallel import config as mesh_config
+        from tidb_tpu import devplane as mesh_config
         mesh = mesh_config.active_mesh()
         if mesh is not None and mesh.devices.size > 1:
             return None     # the mesh shuffle plane owns multi-chip joins
@@ -1235,12 +1235,12 @@ class HashJoinExec(Executor):
         scaled-out form of executor/join.go's partitioned build). Cached
         per (mesh generation, key arity) — the shard_map program costs
         seconds of XLA compile and is shape-polymorphic across queries."""
-        from tidb_tpu.parallel import config as mesh_config
+        from tidb_tpu import devplane as mesh_config
         mesh = mesh_config.active_mesh()
         if mesh is None or mesh.devices.size <= 1 or \
                 nb < self._DEVICE_MIN_BUILD or not config.device_enabled():
             return None
-        from tidb_tpu.parallel.shuffle_join import MeshShuffleJoinKernel
+        from tidb_tpu.ops.meshshuffle import MeshShuffleJoinKernel
         key = (mesh_config.mesh_generation(), len(self.plan.left_keys))
         with _SHUFFLE_KERNELS_LOCK:
             kernel = _SHUFFLE_KERNELS.get(key)
@@ -1357,7 +1357,7 @@ class HashJoinExec(Executor):
                     continue
                 pk = self._probe_keys(enc, chunk)
                 if mesh_kernel is not None:
-                    from tidb_tpu.parallel.shuffle_join import \
+                    from tidb_tpu.ops.meshshuffle import \
                         ShuffleOverflowError
                     try:
                         li, ri = runtime_stats.device_call(

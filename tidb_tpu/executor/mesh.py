@@ -2,9 +2,19 @@
 
 The reference's distributed aggregation pulls per-region partials onto one
 root goroutine (/root/reference/distsql/distsql.go:92 fan-in feeding
-executor/aggregate.go); here the heavy reduction happens ON the mesh
-(parallel/dist_agg.py, dist_join.py) and the host only merges the already
-tiny per-statement group tables and formats rows.
+executor/aggregate.go); here the heavy reduction happens ON the device
+plane (ops/meshagg.py, ops/meshjoin.py) and the host only merges the
+already tiny per-statement group tables and formats rows.
+
+One pipeline: the streaming path is the SAME superchunk_batches +
+pipeline_map machinery as the single-chip executors (executor/__init__.py
+_superchunk_partials) — pipeline_map owns the dispatch slots, meter
+sections, trace spans, failpoint seams and the abandoned-token drain;
+this module only supplies the dispatch/finalize closures and their
+device-ledger charges. Per-batch recovery: capacity overflow re-plans
+the kernel and re-runs only that batch (group merging is associative —
+already-merged batches stay valid); collisions or non-device
+expressions aggregate that batch on the host.
 
 Fallback contract: every mesh plan carries the original subtree; we
 delegate to it when no process mesh is active, when expressions fail
@@ -15,27 +25,26 @@ from __future__ import annotations
 
 import itertools
 import time
-from collections import OrderedDict, deque
-
-import numpy as np
+from collections import OrderedDict
 
 from tidb_tpu import config as sysconf
-from tidb_tpu import memtrack, runtime_stats, sched
+from tidb_tpu import devplane, memtrack, runtime_stats, sched, trace
 from tidb_tpu.chunk import Chunk, Column
+from tidb_tpu.ops import runtime as op_runtime
 from tidb_tpu.ops.hashagg import CapacityError, CollisionError, HashAggregator
 from tidb_tpu.ops.hostagg import host_hash_agg
+from tidb_tpu.ops.meshagg import MeshAggKernel
+from tidb_tpu.ops.meshjoin import (BuildError, LookupSpec,
+                                   MeshLookupAggKernel, _BuildTable,
+                                   host_lookup_agg)
 from tidb_tpu.ops.runtime import bucket_size, superchunk_batches
-from tidb_tpu.parallel import config
-from tidb_tpu.parallel.dist_agg import MeshAggKernel
-from tidb_tpu.parallel.dist_join import (BuildError, LookupSpec,
-                                         MeshLookupAggKernel,
-                                         host_lookup_agg)
+from tidb_tpu.util import failpoint
 
 __all__ = ["MeshAggExec", "MeshLookupAggExec", "stream_stats",
            "reset_stream_stats"]
 
 # Streaming telemetry (tests + metrics assert bounded buffering and that
-# the double-buffered overlap actually happened).
+# the dispatch-ahead overlap actually happened).
 _STREAM_STATS = {"streams": 0, "batches": 0, "host_batches": 0,
                  "max_batch_rows": 0, "overlapped_launches": 0}
 
@@ -56,13 +65,18 @@ MAX_CAPACITY = 1 << 20
 
 # kernel reuse across executions of cached plans: jit programs are per
 # (structure, capacity); keyed by plan object identity (the entry pins
-# the plan so its id cannot be recycled)
+# the plan so its id cannot be recycled) PLUS the plane identity — the
+# mesh generation and its structural fingerprint (axis, device count,
+# platform), so a 1-chip and an 8-chip executable for the same plan can
+# never collide (plan_fingerprint-keyed caches fold the same identity in
+# via ops/hashagg.kernel_for).
 _KERNELS: OrderedDict = OrderedDict()
 _KERNELS_CAP = 64
 
 
 def _kernel_cache_get(plan, capacity):
-    key = (config.mesh_generation(), id(plan), capacity)
+    key = (devplane.mesh_generation(),
+           devplane.mesh_fingerprint(process=True), id(plan), capacity)
     hit = _KERNELS.get(key)
     if hit is not None and hit[0] is plan:
         _KERNELS.move_to_end(key)
@@ -71,12 +85,12 @@ def _kernel_cache_get(plan, capacity):
 
 
 def _kernel_cache_put(plan, capacity, kernel) -> None:
-    gen = config.mesh_generation()
+    gen = devplane.mesh_generation()
     # kernels from older mesh generations can never be hit again; drop
     # them now rather than pinning their replicated build tables
     for k in [k for k in _KERNELS if k[0] != gen]:
         del _KERNELS[k]
-    key = (gen, id(plan), capacity)
+    key = (gen, devplane.mesh_fingerprint(process=True), id(plan), capacity)
     _KERNELS[key] = (plan, kernel)
     _KERNELS.move_to_end(key)
     while len(_KERNELS) > _KERNELS_CAP:
@@ -141,6 +155,17 @@ def _emit_results(plan, gr_or_none, executor_mod):
     return _emit_agg(plan, agg, executor_mod)
 
 
+def _fallback_reason(e) -> str:
+    """Metric label for a per-batch host fallback — the REAL cause, not
+    a blanket reason="mesh" (that label is gone: the plane shares the
+    single-chip pipeline, so its fallbacks are the same taxonomy)."""
+    if isinstance(e, CollisionError):
+        return "collision"
+    if isinstance(e, CapacityError):
+        return "capacity"
+    return "unsupported"
+
+
 class _MeshExecBase:
     def __init__(self, plan):
         self.plan = plan
@@ -149,6 +174,47 @@ class _MeshExecBase:
     def _fallback(self, ctx):
         from tidb_tpu.executor import build_executor
         return build_executor(self.plan.fallback).chunks(ctx)
+
+    @staticmethod
+    def _cached_scan(reader, ctx):
+        """Pull a mesh operand scan through the NON-streaming copr path.
+
+        Framed copr streaming re-encodes and re-decodes the table on
+        every execution — resumable framing buys nothing for a
+        plane-local scan feeding a sharded kernel, and it bypasses the
+        columnar chunk cache entirely (measured: a warm TPC-H Q1 on the
+        8-device plane spent ~14s of a ~14.5s statement re-draining
+        stream frames). The whole-region decoded chunks served here are
+        cache-hits on re-execution, which also keeps their object
+        identities stable — the concat and device-transfer memos key on
+        them. With the chunk cache OFF there is nothing to serve from,
+        so framed streaming keeps its memory-bounded cold-scan role
+        unchanged. The overlay is thread-local, so it shadows the
+        session's tidb_tpu_copr_stream only while this generator is
+        being pulled."""
+        if not sysconf.chunk_cache_enabled():
+            yield from reader.chunks(ctx)
+            return
+        it = reader.chunks(ctx)
+        while True:
+            with sysconf.session_overlay({"tidb_tpu_copr_stream": 0}):
+                try:
+                    c = next(it)
+                except StopIteration:
+                    return
+            yield c
+
+    @staticmethod
+    def _whole_table_run(kernel, chunk, chip):
+        """One whole-table kernel execution under the SAME trace-span
+        pair and failpoint seams as the copr sync sites and the
+        pipelined dispatch wrapper — a statement's span vocabulary must
+        not depend on the mesh size that executed it."""
+        with trace.span("dispatch", rows=chunk.num_rows, chip=chip):
+            outs = kernel.launch(chunk, bucket=True)
+        failpoint.eval("device/finalize")
+        with trace.span("finalize"):
+            return kernel.finish(outs, chunk)
 
     def _run_with_escalation(self, make_kernel, run):
         """Kernel-build + run with one capacity re-plan on overflow.
@@ -178,136 +244,117 @@ class _MeshExecBase:
 
     def _stream_groups(self, superchunks, get_kernel, host_batch,
                        agg: HashAggregator) -> int:
-        """Streaming aggregation with dispatch-ahead: up to
-        tidb_tpu_pipeline_depth superchunks' host→HBM transfers and
-        kernel dispatches are issued (asynchronously) BEFORE the oldest
-        one's blocking readback, so transfer/compute/readback overlap
-        (BASELINE config 5; depth 2 = the classic double buffer).
-        Per-batch recovery: capacity overflow re-plans the kernel and
-        re-runs only that batch (group merging is associative —
-        already-merged batches stay valid); collisions or non-device
-        expressions aggregate that batch on the host.
-
-        Memory: each in-flight launch holds its padded upload on the
-        plan node's DEVICE ledger until its readback, and the merged agg
-        state is tracked to the host ledger as it grows — so the mesh
-        path answers to tidb_tpu_mem_quota_query and EXPLAIN ANALYZE
-        `mem` like the single-chip pipeline. Returns the tracked state
-        bytes for the caller to release once the results are emitted."""
+        """Streaming aggregation on the shared pipeline: pipeline_map
+        keeps tidb_tpu_pipeline_depth launches in flight (host→HBM
+        transfer + async dispatch of superchunk k+1 overlap k's blocking
+        readback) and owns the dispatch slots, meter sections, trace
+        spans, failpoint seams, and the abandoned-token drain — exactly
+        the machinery the single-chip executors ride. This method only
+        supplies the dispatch/finalize closures: each in-flight launch
+        holds its padded upload on the plan node's DEVICE ledger until
+        its readback, and the merged agg state is tracked to the host
+        ledger as it grows — so the mesh path answers to
+        tidb_tpu_mem_quota_query and EXPLAIN ANALYZE `mem` like the
+        single-chip pipeline. Returns the tracked state bytes for the
+        caller to release once the results are emitted."""
         _STREAM_STATS["streams"] += 1
-        capacity = getattr(self.plan, "_mesh_capacity", DEFAULT_CAPACITY)
-        depth = sysconf.pipeline_depth()
-        tracked = 0
+        plan = self.plan
+        mt_node = memtrack.op_node(plan)
+        state = {"kernel": None, "inflight": 0}
         try:
-            kernel = get_kernel(capacity)
+            state["kernel"] = get_kernel(
+                getattr(plan, "_mesh_capacity", DEFAULT_CAPACITY))
         except (ValueError, BuildError):
-            kernel = None
+            state["kernel"] = None      # every batch goes host
 
-        def merge(gr) -> None:
-            nonlocal tracked
-            agg.update(gr)
-            tracked = memtrack.track_to(self.plan, agg.approx_bytes(),
-                                        tracked)
-
-        def finish(pkernel, outs, batch, db, slot=None):
-            nonlocal kernel, capacity
-            t0 = time.perf_counter_ns()
+        def dispatch(sc):
+            batch = sc.chunk
+            _STREAM_STATS["batches"] += 1
+            _STREAM_STATS["max_batch_rows"] = max(
+                _STREAM_STATS["max_batch_rows"], batch.num_rows)
+            k = state["kernel"]
+            if k is None:
+                # no device kernel for this plan (failed validation /
+                # build): every batch aggregates on the host
+                runtime_stats.note_fallback(plan, "unsupported")
+                return None              # host path at finalize
+            # device ledger: the sharded padded upload, sized from
+            # shapes at dispatch; credited back at finalize
+            db = memtrack.device_put_bytes(batch)
+            memtrack.consume(plan, device=db)
             try:
-                return pkernel.finish(outs, batch)
+                outs = k.launch(batch, bucket=True)
+            except (ValueError, CollisionError, BuildError) as e:
+                memtrack.release(plan, device=db)
+                runtime_stats.note_fallback(plan, _fallback_reason(e))
+                return None
+            except BaseException:        # quota cancel / device fault
+                memtrack.release(plan, device=db)
+                raise
+            if state["inflight"]:
+                _STREAM_STATS["overlapped_launches"] += 1
+            state["inflight"] += 1
+            runtime_stats.note_superchunk(
+                plan, batch.num_rows, bucket_size(max(batch.num_rows, 1)),
+                sc.sources)
+            return (k, outs, db)
+
+        def finalize(sc, tok):
+            batch = sc.chunk
+            if tok is None:
+                _STREAM_STATS["host_batches"] += 1
+                return host_batch(batch)
+            k, outs, db = tok
+            state["inflight"] -= 1
+            t0 = time.perf_counter_ns()
+            reason = "capacity"
+            try:
+                return k.finish(outs, batch)
             except CapacityError as e:
+                # per-batch capacity re-plan: re-run only THIS batch at
+                # 2x the observed distinct count; later batches dispatch
+                # with the escalated kernel
                 needed = getattr(e, "needed", None)
                 while needed is not None:
                     cap2 = 1 << max(needed * 2 - 1, 1).bit_length()
                     if cap2 > MAX_CAPACITY:
                         break
-                    capacity = cap2
                     try:
-                        kernel = get_kernel(capacity)
-                        gr = kernel.finish(
-                            kernel.launch(batch, bucket=True), batch)
-                        self.plan._mesh_capacity = capacity
+                        k2 = get_kernel(cap2)
+                        gr = k2.finish(k2.launch(batch, bucket=True),
+                                       batch)
+                        state["kernel"] = k2
+                        plan._mesh_capacity = cap2
                         return gr
                     except CapacityError as e2:
                         needed = getattr(e2, "needed", None)
-                    except (CollisionError, BuildError, ValueError):
+                    except (CollisionError, BuildError, ValueError) as e2:
+                        reason = _fallback_reason(e2)
                         break
-            except (CollisionError, BuildError, ValueError):
-                pass
+            except (CollisionError, BuildError, ValueError) as e:
+                reason = _fallback_reason(e)
             finally:
-                sched.device_scheduler().release(slot)
-                if db:
-                    memtrack.release(self.plan, device=db)
-                # stall only (the enclosing device_section owns device
-                # time — adding it here too would double-count)
-                runtime_stats.note_pipeline_stall(
-                    self.plan, time.perf_counter_ns() - t0)
+                memtrack.release(plan, device=db)
+                runtime_stats.note_finalize_wait(
+                    plan, time.perf_counter_ns() - t0)
             _STREAM_STATS["host_batches"] += 1
-            runtime_stats.note_fallback(self.plan, "mesh")
+            runtime_stats.note_fallback(plan, reason)
             return host_batch(batch)
 
-        pending: deque = deque()  # (kernel, outs, batch, bytes, slot)
+        tracked = 0
         try:
-            for sc in superchunks:
-                batch = sc.chunk
-                _STREAM_STATS["batches"] += 1
-                _STREAM_STATS["max_batch_rows"] = max(
-                    _STREAM_STATS["max_batch_rows"], batch.num_rows)
-                outs = None
-                db = 0
-                slot = None
-                launch_kernel = kernel   # finish() may rebind `kernel` on
-                if launch_kernel is not None:   # a capacity re-plan; outs
-                    # each in-flight mesh launch holds a global dispatch
-                    # slot exactly like the single-chip pipeline — the
-                    # mesh must not dodge the round-robin window
-                    slot = sched.device_scheduler().acquire_or_bypass()
-                    db = memtrack.device_put_bytes(batch)
-                    try:
-                        memtrack.consume(self.plan, device=db)
-                    except BaseException:    # quota cancel mid-charge
-                        sched.device_scheduler().release(slot)
-                        raise
-                    try:                 # read back by their own kernel
-                        outs = launch_kernel.launch(batch, bucket=True)
-                        if pending:
-                            _STREAM_STATS["overlapped_launches"] += 1
-                        runtime_stats.note_superchunk(
-                            self.plan, batch.num_rows,
-                            bucket_size(max(batch.num_rows, 1)),
-                            sc.sources)
-                    except (ValueError, CollisionError, BuildError):
-                        outs = None
-                    if outs is None:
-                        memtrack.release(self.plan, device=db)
-                        db = 0
-                        sched.device_scheduler().release(slot)
-                        slot = None
-                if outs is not None:
-                    pending.append((launch_kernel, outs, batch, db, slot))
-                    while len(pending) > depth:
-                        merge(finish(*pending.popleft()))
-                else:
-                    # host batches are synchronous: drain in-flight work
-                    # first so results keep arriving in input order
-                    while pending:
-                        merge(finish(*pending.popleft()))
-                    _STREAM_STATS["host_batches"] += 1
-                    runtime_stats.note_fallback(self.plan, "mesh")
-                    merge(host_batch(batch))
-            while pending:
-                merge(finish(*pending.popleft()))
-        finally:
-            # an exception unwinding past the drains (quota cancel in
-            # merge, KILL interrupt) abandons launched batches: their
-            # dispatch slots and device bytes must not leak for the
-            # life of the process — mirror of pipeline_map's finally
-            while pending:
-                _k, _outs, _b, p_db, p_slot = pending.popleft()
-                sched.device_scheduler().release(p_slot)
-                if p_db:
-                    memtrack.release(self.plan, device=p_db)
-        if kernel is not None:
-            self.plan._mesh_capacity = capacity
+            for gr in op_runtime.pipeline_map(
+                    superchunks, dispatch, finalize,
+                    sysconf.pipeline_depth(), tracker=mt_node,
+                    cost=lambda sc: memtrack.chunk_bytes(sc.chunk)):
+                agg.update(gr)
+                tracked = memtrack.track_to(plan, agg.approx_bytes(),
+                                            tracked)
+        except BaseException:
+            # the caller's finally releases only what we report; on an
+            # unwinding cancel nothing is reported, so credit here
+            memtrack.release(plan, host=tracked)
+            raise
         return tracked
 
     def _buffer_probe(self, it, limit):
@@ -324,19 +371,19 @@ class _MeshExecBase:
 
 
 class MeshAggExec(_MeshExecBase):
-    """Group-by aggregation on the device mesh (Q1 shape)."""
+    """Group-by aggregation on the device plane (Q1 shape)."""
 
     def chunks(self, ctx):
         import tidb_tpu.executor as ex
 
-        mesh = config.active_mesh()
+        mesh = devplane.active_mesh()
         if mesh is None:
             yield from self._fallback(ctx)
             return
         plan = self.plan
         schema = plan.children[0].schema
         reader = ex.build_executor(plan.children[0])
-        it = reader.chunks(ctx)
+        it = self._cached_scan(reader, ctx)
         limit = sysconf.stream_rows()
         parts, total, exhausted = self._buffer_probe(it, limit)
 
@@ -346,7 +393,7 @@ class MeshAggExec(_MeshExecBase):
 
         if not exhausted:
             # probe larger than the streaming threshold: never materialize
-            # it — feed the kernel ≤limit-row super-batches, double-buffered
+            # it — feed the kernel ≤limit-row super-batches, dispatch-ahead
             def get_kernel(capacity):
                 k = _kernel_cache_get(plan, capacity)
                 if k is None:
@@ -357,7 +404,7 @@ class MeshAggExec(_MeshExecBase):
             agg = HashAggregator(plan.aggs, plan.group_exprs)
             tracked = 0
             try:
-                # mesh pipelines overlap async launches, so the device
+                # plane pipelines overlap async launches, so the device
                 # time is the whole streaming region's wall (readback)
                 with runtime_stats.device_section(plan):
                     tracked = self._stream_groups(
@@ -380,10 +427,23 @@ class MeshAggExec(_MeshExecBase):
         big = _concat_chunks_cached(plan, "_probe_cache", parts, schema)
         gr = None
         if big.num_rows:
-            with sched.device_slot(), runtime_stats.device_section(plan), \
-                    memtrack.device_scope(plan,
-                                          memtrack.device_put_bytes(big)):
-                gr = self._run_with_escalation(make, lambda k: k(big))
+            try:
+                failpoint.eval("device/dispatch")
+                with sched.device_slot() as slot, \
+                        runtime_stats.device_section(plan,
+                                                     errors=False), \
+                        memtrack.device_scope(
+                            plan, memtrack.device_put_bytes(big)):
+                    gr = self._run_with_escalation(
+                        make,
+                        lambda k: self._whole_table_run(k, big, slot.chip))
+            except failpoint.DispatchTimeoutError:
+                raise   # statement already cancel-latched by the watchdog
+            except failpoint.DeviceFaultError:
+                sched.device_health().note_fault()
+                runtime_stats.note_fallback(plan, "fault")
+                yield from self._fallback(ctx)
+                return
             if gr is None:
                 yield from self._fallback(ctx)
                 return
@@ -395,12 +455,12 @@ class MeshAggExec(_MeshExecBase):
 
 
 class MeshLookupAggExec(_MeshExecBase):
-    """Star join + aggregation on the device mesh (Q3/Q5 shape)."""
+    """Star join + aggregation on the device plane (Q3/Q5 shape)."""
 
     def chunks(self, ctx):
         import tidb_tpu.executor as ex
 
-        mesh = config.active_mesh()
+        mesh = devplane.active_mesh()
         if mesh is None:
             yield from self._fallback(ctx)
             return
@@ -410,7 +470,8 @@ class MeshLookupAggExec(_MeshExecBase):
             for lk in plan.lookups:
                 bexec = ex.build_executor(lk.build_plan)
                 bchunk = _concat_chunks_cached(lk, "_chunk_cache",
-                                               list(bexec.chunks(ctx)),
+                                               list(self._cached_scan(
+                                                   bexec, ctx)),
                                                lk.build_plan.schema)
                 specs.append(LookupSpec(
                     key_exprs=lk.key_exprs, build_chunk=bchunk,
@@ -439,7 +500,7 @@ class MeshLookupAggExec(_MeshExecBase):
             return kernel
 
         reader = ex.build_executor(plan.children[0])
-        it = reader.chunks(ctx)
+        it = self._cached_scan(reader, ctx)
         limit = sysconf.stream_rows()
         parts, total, exhausted = self._buffer_probe(it, limit)
 
@@ -477,11 +538,24 @@ class MeshLookupAggExec(_MeshExecBase):
                                       plan.children[0].schema)
         gr = None
         if probe.num_rows:
-            with sched.device_slot(), runtime_stats.device_section(plan), \
-                    memtrack.device_scope(plan,
-                                          memtrack.device_put_bytes(probe)):
-                gr = self._run_with_escalation(
-                    make, lambda kernel: refresh(kernel)(probe))
+            try:
+                failpoint.eval("device/dispatch")
+                with sched.device_slot() as slot, \
+                        runtime_stats.device_section(plan,
+                                                     errors=False), \
+                        memtrack.device_scope(
+                            plan, memtrack.device_put_bytes(probe)):
+                    gr = self._run_with_escalation(
+                        make,
+                        lambda kernel: self._whole_table_run(
+                            refresh(kernel), probe, slot.chip))
+            except failpoint.DispatchTimeoutError:
+                raise   # statement already cancel-latched by the watchdog
+            except failpoint.DeviceFaultError:
+                sched.device_health().note_fault()
+                runtime_stats.note_fallback(plan, "fault")
+                yield from self._fallback(ctx)
+                return
             if gr is None:
                 yield from self._fallback(ctx)
                 return
@@ -496,7 +570,6 @@ class MeshLookupAggExec(_MeshExecBase):
         memoized on the plan's lookup descriptor: when the storage chunk
         cache serves the same dimension chunk object again, the prepared
         table (and its device copy) is reused as-is."""
-        from tidb_tpu.parallel.dist_join import _BuildTable
         cached = getattr(desc, "_build_cache", None)
         if cached is not None and cached[0] is spec.build_chunk:
             return cached[1]

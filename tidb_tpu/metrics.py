@@ -35,7 +35,7 @@ __all__ = ["counter", "histogram", "gauge", "expose", "snapshot",
            "FAILPOINT_FIRES", "WORKER_RESTARTS", "DISPATCH_TIMEOUTS",
            "DEVICE_QUARANTINES", "TRACES",
            "CLUSTER_SCRAPES", "MEMBER_START_TIME",
-           "DEVICE_UTILIZATION", "HBM_OCCUPANCY"]
+           "DEVICE_UTILIZATION", "HBM_OCCUPANCY", "CHIP_UTILIZATION"]
 
 _lock = threading.Lock()
 _counters: dict[tuple[str, tuple], float] = {}       # guarded-by: _lock
@@ -274,6 +274,10 @@ MEMBER_START_TIME = "tidb_tpu_member_start_time_seconds"
 # over its tidb_tpu_device_cache_bytes budget
 DEVICE_UTILIZATION = "tidb_tpu_device_utilization_ratio"
 HBM_OCCUPANCY = "tidb_tpu_hbm_occupancy_ratio"
+# per-chip slot busy-time over the sampler interval, labeled {chip}
+# (bounded by the plane's device count): the scheduler's placement
+# signal surfaced as a series, and the serve bench's balance figure
+CHIP_UTILIZATION = "tidb_tpu_chip_utilization_ratio"
 
 _HELP = {
     QUERY_DURATIONS: "Statement wall time through Session.execute.",
@@ -378,4 +382,7 @@ _HELP = {
         "sampler interval (dispatch overlap can push it past 1.0).",
     HBM_OCCUPANCY:
         "HBM region-block cache resident bytes over its budget.",
+    CHIP_UTILIZATION:
+        "Per-chip scheduler-slot busy time per wall second over the "
+        "last history sampler interval, labeled by plane chip index.",
 }

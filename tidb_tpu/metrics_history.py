@@ -133,10 +133,12 @@ def sample_now() -> dict:
     now_ns = time.perf_counter_ns()
     server_device_ns = meter.SERVER.totals()["device_ns"]
     hits, misses = _hbm_counter_totals()
+    chip_busy = _chip_busy_ns()
     with _prev_mu:
         prev = dict(_prev)
         _prev.update(t_ns=now_ns, device_ns=server_device_ns,
-                     hbm_hits=hits, hbm_misses=misses)
+                     hbm_hits=hits, hbm_misses=misses,
+                     chip_ns=chip_busy)
     point: dict = {}
     wall_ns = now_ns - prev.get("t_ns", now_ns)
     if wall_ns > 0:
@@ -149,6 +151,14 @@ def sample_now() -> dict:
             if lookups > 0 else 0.0
         metrics.gauge(metrics.DEVICE_UTILIZATION,
                       point["tidb_tpu_device_utilization_ratio"])
+        # per-chip slot busy-time ratios (the scheduler's placement
+        # signal as a series; label cardinality = the plane's device
+        # count). The gauges ride into the point via gauges_snapshot.
+        prev_chip = prev.get("chip_ns", {})
+        for c, ns in sorted(chip_busy.items()):
+            ratio = max(ns - prev_chip.get(c, 0), 0) / wall_ns
+            metrics.gauge(metrics.CHIP_UTILIZATION, round(ratio, 6),
+                          {"chip": c})
     budget = config.device_cache_bytes()
     resident = _hbm_resident_bytes()
     point["tidb_tpu_hbm_occupancy_ratio"] = \
@@ -168,6 +178,11 @@ def sample_now() -> dict:
 def _hbm_resident_bytes() -> int:
     from tidb_tpu.store import device_cache
     return device_cache.tracker().device
+
+
+def _chip_busy_ns() -> dict:
+    from tidb_tpu import sched
+    return sched.device_scheduler().chip_busy_ns()
 
 
 _last_sample_ns = 0.0

@@ -301,6 +301,7 @@ def fragment_kernel_for(num_keys: int, probe_width: int, width: int,
     fp = runtime.plan_fingerprint(None, group_exprs, aggs)
     if fp is None:
         return make()
+    from tidb_tpu import devplane
     key = (fp, num_keys, probe_width, width, capacity, force_hash,
-           direct_limit)
+           direct_limit, devplane.mesh_fingerprint(process=True))
     return _FRAGMENTS.get_or_create(key, make)

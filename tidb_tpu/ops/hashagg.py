@@ -391,7 +391,7 @@ def _agg_requests(xp, agg: AggDesc, cols, n, mask, batch: _SegBatch,
     (a shard's global row offset) FIRST_ROW indices are globalized for
     cross-shard merging; without it they stay chunk-local. row_ids
     overrides the per-row identity entirely (compacted stages carry the
-    ORIGINAL probe row index as a column — dist_join two-phase path)."""
+    ORIGINAL probe row index as a column — ops/meshjoin two-phase path)."""
     fn = agg.fn
     if agg.arg is not None:
         d, v = agg.arg.eval_xp(xp, cols, n)
@@ -782,8 +782,12 @@ def kernel_for(filter_expr, group_exprs, aggs, capacity: int = 4096):
     fp = runtime.plan_fingerprint(filter_expr, group_exprs, aggs)
     if fp is None:
         return make()
+    from tidb_tpu import devplane
     key = (fp, capacity if group_exprs else 0, force_hash,
-           direct_limit if group_exprs else 0)
+           direct_limit if group_exprs else 0,
+           # plane identity: a 1-chip and an 8-chip mesh executable for
+           # the same plan shape must never alias one cache slot
+           devplane.mesh_fingerprint(process=True))
     return _KERNELS.get_or_create(key, make)
 
 

@@ -149,7 +149,7 @@ def match_pairs(xp, hb, hp, bd_lanes, pd_lanes, out_cap):
     expanded into a static-capacity pair list with exact-key verification
     over the raw data lanes. Shared by the single-chip kernel and the
     per-partition stage of the mesh shuffle join
-    (parallel/shuffle_join.py). -> (li, ri, ok, total)."""
+    (ops/meshshuffle.py). -> (li, ri, ok, total)."""
     b_n = hb.shape[0]
     p_n = hp.shape[0]
     perm = xp.argsort(hb)

@@ -133,8 +133,10 @@ _SEG_KERNELS = runtime.FingerprintCache(64)
 
 
 def segment_kernel_for(group_exprs, aggs) -> SegmentAggKernel:
+    from tidb_tpu import devplane
     fp = runtime.plan_fingerprint(None, group_exprs, aggs)
     if fp is None:
         return SegmentAggKernel(group_exprs, aggs)
+    key = (fp, devplane.mesh_fingerprint(process=True))
     return _SEG_KERNELS.get_or_create(
-        fp, lambda: SegmentAggKernel(group_exprs, aggs))
+        key, lambda: SegmentAggKernel(group_exprs, aggs))

@@ -1,25 +1,16 @@
-"""Multi-chip parallelism: device meshes + distributed operators.
+"""Compatibility shims over the one device plane.
 
-The reference scales reads by splitting key ranges into regions and fanning
-out goroutine workers (/root/reference/store/tikv/coprocessor.go:263,342).
-On TPU the same two axes become mesh axes (SURVEY.md §2.7, §5.7-5.8):
+Everything that used to live here — mesh construction, process mesh
+configuration, the distributed agg/join/shuffle kernels — is now the
+unified ``("batch",)`` device plane: tidb_tpu/devplane.py owns the mesh
+and layout language, tidb_tpu/ops/meshagg.py / meshjoin.py /
+meshshuffle.py own the kernels. These re-exports keep historical import
+paths (tests, external callers) working; package code imports the real
+modules directly (lint: no-parallel-import)."""
 
-* ``dp`` — data parallel over rows: each chip aggregates its shard of the
-  scan, the moral equivalent of per-region coprocessor workers.
-* ``tp`` — state parallel over the group-hash-table: the merged aggregate
-  state is reduce-scattered so each chip owns a slice of the buckets, the
-  analogue of sharding a hash join/agg build side across nodes.
+from tidb_tpu.devplane import (active_mesh, build_mesh, configure_mesh,
+                               disable_mesh, enable_mesh, mesh_generation)
+from tidb_tpu.ops.meshagg import MeshAggKernel
 
-All cross-chip traffic is XLA collectives (psum / pmin / pmax /
-psum_scatter) riding ICI — never host RPC.
-"""
-
-from tidb_tpu.parallel.mesh import build_mesh, default_axes
-from tidb_tpu.parallel.dist_agg import MeshAggKernel
-from tidb_tpu.parallel.config import (active_mesh, configure_mesh,
-                                      disable_mesh, enable_mesh,
-                                      mesh_generation)
-
-__all__ = ["build_mesh", "default_axes", "MeshAggKernel",
-           "active_mesh", "configure_mesh", "disable_mesh", "enable_mesh",
-           "mesh_generation"]
+__all__ = ["build_mesh", "MeshAggKernel", "active_mesh", "configure_mesh",
+           "disable_mesh", "enable_mesh", "mesh_generation"]

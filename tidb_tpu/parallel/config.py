@@ -1,54 +1,13 @@
-"""Process-level mesh configuration.
-
-The reference wires its distributed execution through per-session
-concurrency knobs + the store's region topology (store/tikv/coprocessor.go
-fan-out); chip topology is the TPU analogue and is a process property:
-one device mesh serves every session in the process. The planner consults
-``active_mesh()`` when deciding to route qualifying plans to the mesh
-executors, and bumps ``mesh_generation()`` into the plan-cache key so
-cached plans never outlive a topology change.
-"""
+"""Compatibility shim: process mesh configuration lives in
+tidb_tpu/devplane.py (one device plane). State is shared — these ARE the
+devplane functions, so a mesh enabled through either path is visible to
+both."""
 
 from __future__ import annotations
 
-from tidb_tpu.parallel.mesh import build_mesh
+from tidb_tpu.devplane import (active_mesh, configure_mesh, disable_mesh,
+                               enable_mesh, mesh_generation,
+                               on_topology_change)
 
 __all__ = ["configure_mesh", "enable_mesh", "disable_mesh", "active_mesh",
            "mesh_generation", "on_topology_change"]
-
-_mesh = None
-_generation = 0
-_listeners: list = []
-
-
-def on_topology_change(fn) -> None:
-    """Register fn() to run after every mesh (re)configuration — kernel
-    caches keyed on the generation use this to release compiled programs
-    that can never be hit again (e.g. after disable_mesh)."""
-    _listeners.append(fn)
-
-
-def configure_mesh(mesh) -> None:
-    """Install `mesh` (a jax.sharding.Mesh or None) as the process mesh."""
-    global _mesh, _generation
-    _mesh = mesh
-    _generation += 1
-    for fn in _listeners:
-        fn()
-
-
-def enable_mesh(n_devices: int | None = None) -> None:
-    """Build a ('dp','tp') mesh over the first n jax devices and install it."""
-    configure_mesh(build_mesh(n_devices))
-
-
-def disable_mesh() -> None:
-    configure_mesh(None)
-
-
-def active_mesh():
-    return _mesh
-
-
-def mesh_generation() -> int:
-    return _generation

@@ -1,32 +1,8 @@
-"""Mesh construction helpers."""
+"""Compatibility shim: mesh construction lives in tidb_tpu/devplane.py.
+The plane is 1-D ``("batch",)`` — the old ('dp','tp') factoring is gone."""
 
 from __future__ import annotations
 
-import jax
-import numpy as np
-from jax.sharding import Mesh
+from tidb_tpu.devplane import build_mesh
 
-__all__ = ["build_mesh", "default_axes"]
-
-
-def default_axes(n_devices: int) -> tuple[int, int]:
-    """Factor n_devices into (dp, tp). tp gets the smallest prime factor >1
-    so both mesh axes are exercised whenever possible."""
-    if n_devices <= 1:
-        return (1, 1)
-    for p in (2, 3, 5, 7):
-        if n_devices % p == 0:
-            return (n_devices // p, p)
-    return (n_devices, 1)
-
-
-def build_mesh(n_devices: int | None = None,
-               devices=None) -> Mesh:
-    """A 2-D ('dp', 'tp') mesh over the first n_devices jax devices."""
-    if devices is None:
-        devices = jax.devices()
-    if n_devices is not None:
-        devices = devices[:n_devices]
-    dp, tp = default_axes(len(devices))
-    arr = np.array(devices[: dp * tp]).reshape(dp, tp)
-    return Mesh(arr, axis_names=("dp", "tp"))
+__all__ = ["build_mesh"]

@@ -5,15 +5,15 @@ copTask/rootTask costing (/root/reference/plan/task.go:116-499): work that
 can run next to the data is serialized into the storage request. Here the
 "storage" for analytical work is the device mesh — this post-pass walks a
 finished physical plan and, when a process mesh is configured
-(tidb_tpu.parallel.config), replaces qualifying subtrees with mesh
+(tidb_tpu.devplane), replaces qualifying subtrees with mesh
 operators:
 
 * PhysMeshAgg — a pushed-down group-by aggregation over one table scan
-  (TPC-H Q1 shape) runs as parallel/dist_agg.MeshAggKernel: rows sharded
-  over the ('dp','tp') mesh, all_gather merge over ICI.
+  (TPC-H Q1 shape) runs as ops/meshagg.MeshAggKernel: rows sharded
+  over the ("batch",) device plane, all_gather merge over ICI.
 * PhysMeshLookupAgg — an inner-join star over one fact table plus
   unique-keyed dimension tables feeding a group-by (Q3/Q5 shape) runs as
-  parallel/dist_join.MeshLookupAggKernel: fused filter -> lookup chain ->
+  ops/meshjoin.MeshLookupAggKernel: fused filter -> lookup chain ->
   aggregate, dimensions replicated per chip.
 
 Every mesh node keeps the original subtree as `fallback`; the executor
@@ -91,7 +91,7 @@ def route_mesh(plan: ph.PhysPlan) -> ph.PhysPlan:
     faster warm on TPC-H Q1/Q3/Q5 than the 1-device mesh kernels). The
     decision depends only on the mesh itself, so plans stay coherent
     with the mesh_generation() plan-cache key."""
-    from tidb_tpu.parallel import config
+    from tidb_tpu import devplane as config
 
     mesh = config.active_mesh()
     if mesh is None or mesh.devices.size <= 1:

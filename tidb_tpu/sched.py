@@ -61,7 +61,7 @@ import contextlib
 import threading
 import time
 
-from tidb_tpu import config, memtrack, meter, metrics, trace
+from tidb_tpu import config, devplane, memtrack, meter, metrics, trace
 from tidb_tpu.util import failpoint
 
 __all__ = ["DeviceScheduler", "AdmissionController",
@@ -93,18 +93,24 @@ _MIN_PROJECTION = 1 << 20
 
 
 class _Slot:
-    """One granted (or bypassed) dispatch slot."""
+    """One granted (or bypassed) dispatch slot. `chip` is the plane
+    chip index the grant placed this dispatch on (0 when the plane has
+    one device, or for bypass/no-op slots); `t_grant` is the grant
+    timestamp, so the release can attribute the slot's hold interval —
+    dispatch through finalize — to the chip's busy ledger."""
 
-    __slots__ = ("stream", "granted", "_event")
+    __slots__ = ("stream", "granted", "chip", "t_grant", "_event")
 
     def __init__(self, stream):
         self.stream = stream
         self.granted = False          # guarded-by the scheduler's _cv
+        self.chip = 0                 # guarded-by the scheduler's _cv
+        self.t_grant = 0              # guarded-by the scheduler's _cv
         self._event = threading.Event()
 
 
 class DeviceScheduler:
-    """Round-robin dispatch-slot allocator over one device.
+    """Round-robin dispatch-slot allocator over the device plane.
 
     Streams are statements (keyed by their memtrack statement root, so
     every operator and pool worker of one statement shares one fairness
@@ -112,7 +118,19 @@ class DeviceScheduler:
     Grants hand off: a release picks the next stream in rotation with a
     waiting head and wakes exactly that waiter, so a statement that
     just ran yields to every other waiting statement before it runs
-    again."""
+    again.
+
+    Per-chip slot streams: on an N-chip ``("batch",)`` plane
+    (devplane.ndev() > 1) `tidb_tpu_sched_inflight` is a PER-CHIP
+    depth — total capacity scales to inflight × ndev — and every grant
+    places its dispatch on the least-loaded chip (fewest slots held,
+    then least accumulated busy time). Releases attribute the slot's
+    hold interval to the chip's busy ledger, so placement is
+    utilization-driven: replicated HBM point lookups land on whichever
+    chip is idlest while a sharded analytic scan occupies one slot per
+    in-flight superchunk across the rotation. On a 1-device plane every
+    counter collapses to chip 0 and behavior is exactly the
+    single-device scheduler."""
 
     def __init__(self):
         self._cv = threading.Condition()
@@ -122,6 +140,9 @@ class DeviceScheduler:
         self._stall_ns = 0                 # guarded-by: _cv
         self._bypasses = 0                 # guarded-by: _cv
         self._grants = 0                   # guarded-by: _cv
+        self._chip_granted: dict = {}      # guarded-by: _cv  chip -> held
+        self._chip_grants: dict = {}       # guarded-by: _cv  chip -> total
+        self._chip_busy_ns: dict = {}      # guarded-by: _cv  chip -> ns
 
     # -- capacity ------------------------------------------------------------
 
@@ -136,12 +157,23 @@ class DeviceScheduler:
         nothing granted, one dispatch always fits — resident HBM (cache
         blocks, pinned builds) above the cap must throttle, not
         starve."""
-        if self._granted >= config.sched_inflight():
+        if self._granted >= config.sched_inflight() * devplane.ndev():
             return False
         if self._granted == 0:
             return True
         cap = config.sched_inflight_bytes()
         return cap <= 0 or memtrack.SERVER.device < cap
+
+    def _pick_chip_locked(self) -> int:
+        """Least-loaded chip of the plane: fewest held slots, then
+        least accumulated busy time (ties break to the lowest index).
+        Called under _cv at grant time."""
+        n = devplane.ndev()
+        if n <= 1:
+            return 0
+        return min(range(n),
+                   key=lambda c: (self._chip_granted.get(c, 0),
+                                  self._chip_busy_ns.get(c, 0), c))
 
     # -- acquire / release ---------------------------------------------------
 
@@ -203,6 +235,7 @@ class DeviceScheduler:
         return _Slot(self._stream_key())    # never granted: release no-ops
 
     def release(self, slot: "_Slot | None") -> None:
+        now = time.perf_counter_ns()
         if slot is None or slot is _NOOP_SLOT:
             return
         with self._cv:
@@ -210,6 +243,14 @@ class DeviceScheduler:
                 return               # checked under _cv, so two racing
             slot.granted = False     # releasers cannot both decrement
             self._granted -= 1
+            held = self._chip_granted.get(slot.chip, 0)
+            self._chip_granted[slot.chip] = max(held - 1, 0)
+            # the hold interval (dispatch through finalize) IS the
+            # chip's attributed busy time — the placement signal and
+            # the serve bench's per-chip balance figure
+            self._chip_busy_ns[slot.chip] = \
+                self._chip_busy_ns.get(slot.chip, 0) + \
+                max(now - slot.t_grant, 0)
             self._grant_locked()
 
     # -- grant machinery (all under _cv) -------------------------------------
@@ -231,8 +272,14 @@ class DeviceScheduler:
                 else:
                     self._rr.append(stream)   # stays in rotation, at back
                 slot.granted = True
+                slot.chip = self._pick_chip_locked()
+                slot.t_grant = time.perf_counter_ns()
                 self._granted += 1
                 self._grants += 1
+                self._chip_granted[slot.chip] = \
+                    self._chip_granted.get(slot.chip, 0) + 1
+                self._chip_grants[slot.chip] = \
+                    self._chip_grants.get(slot.chip, 0) + 1
                 slot._event.set()
                 progressed = True
                 break
@@ -266,7 +313,37 @@ class DeviceScheduler:
                     "waiting": sum(len(q) for q in self._waiters.values()),
                     "grants": self._grants,
                     "bypasses": self._bypasses,
-                    "stall_seconds": round(self._stall_ns / 1e9, 6)}
+                    "stall_seconds": round(self._stall_ns / 1e9, 6),
+                    "chips": self._chip_snapshot_locked()}
+
+    def _chip_snapshot_locked(self) -> dict:
+        chips = {}
+        for c in range(devplane.ndev()):
+            chips[c] = {"inflight": self._chip_granted.get(c, 0),
+                        "grants": self._chip_grants.get(c, 0),
+                        "busy_seconds": round(
+                            self._chip_busy_ns.get(c, 0) / 1e9, 6)}
+        # chips that held slots under a since-shrunk plane keep their
+        # history visible (the busy figures still explain past samples)
+        for c in self._chip_grants:
+            if c not in chips:
+                chips[c] = {"inflight": self._chip_granted.get(c, 0),
+                            "grants": self._chip_grants.get(c, 0),
+                            "busy_seconds": round(
+                                self._chip_busy_ns.get(c, 0) / 1e9, 6)}
+        return chips
+
+    def chip_busy_ns(self) -> dict:
+        """{chip: cumulative attributed busy ns} — the metrics-history
+        sampler derives per-chip utilization ratios from deltas of
+        this, and the serve bench reads it for the mesh-balance
+        aggregate (total rows over the busiest chip's time)."""
+        with self._cv:
+            out = {c: self._chip_busy_ns.get(c, 0)
+                   for c in range(devplane.ndev())}
+            for c, ns in self._chip_busy_ns.items():
+                out.setdefault(c, ns)
+            return out
 
 
 _NOOP_SLOT = _Slot(None)
@@ -686,6 +763,13 @@ class device_slot:
         self._slot = None
         self._wtok = None
         self._busy = None
+
+    @property
+    def chip(self) -> int:
+        """The plane chip the grant placed this dispatch on (0 for
+        bypass slots or a 1-device plane) — dispatch sites pass it to
+        devplane.chip_scope and tag their trace spans with it."""
+        return self._slot.chip if self._slot is not None else 0
 
     def __enter__(self):
         self._wtok = _WATCHDOG.begin("sync-dispatch")

@@ -1444,7 +1444,7 @@ class Session:
         cache_key = None
         if sql_text is not None and isinstance(stmt, (ast.SelectStmt,
                                                       ast.UnionStmt)):
-            from tidb_tpu.parallel import config as mesh_config
+            from tidb_tpu import devplane as mesh_config
             cache_key = (sql_text, self.current_db,
                          self.domain.info_schema().version,
                          self.domain.stats_handle().version,

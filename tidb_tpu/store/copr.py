@@ -15,8 +15,8 @@ import threading
 from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 
-from tidb_tpu import (config, kv, memtrack, meter, runtime_stats, sched,
-                      tablecodec, trace)
+from tidb_tpu import (config, devplane, kv, memtrack, meter,
+                      runtime_stats, sched, tablecodec, trace)
 from tidb_tpu.kv import (CopRequest, CopResponse, KVRange, NotLeaderError,
                          RegionError, ReqType, ServerBusyError,
                          KeyLockedError)
@@ -169,14 +169,17 @@ def _encoded_agg(plan: CopPlan, chunk, sources: int,
             moved = memtrack.device_put_bytes(chunk)
             nbytes = k.dispatch_nbytes(chunk)
         failpoint.eval("device/dispatch")
-        with sched.device_slot(), memtrack.device_scope(plan, nbytes):
+        with sched.device_slot() as slot, \
+                devplane.chip_scope(slot.chip), \
+                memtrack.device_scope(plan, nbytes):
             # split spans on the sync path too: the async enqueue
             # (pad/transfer/jit dispatch) vs the blocking readback —
             # the same per-superchunk pair the pipelined paths record.
             # Device timing covers BOTH halves, success-only — exactly
             # the interval device_call used to measure here
             with runtime_stats.device_section(plan, errors=False):
-                with trace.span("dispatch", rows=chunk.num_rows):
+                with trace.span("dispatch", rows=chunk.num_rows,
+                                chip=slot.chip):
                     pending = k.dispatch(chunk, dev_cols=dev_cols)
                 failpoint.eval("device/finalize")
                 with trace.span("finalize"):
@@ -281,11 +284,13 @@ def exec_cop_plan(plan: CopPlan, chunk, sources: int = 1,
                 # slot puts storage-side aggs under the same global
                 # round-robin window as executor-side kernels
                 failpoint.eval("device/dispatch")
-                with sched.device_slot(), \
+                with sched.device_slot() as slot, \
+                        devplane.chip_scope(slot.chip), \
                         memtrack.device_scope(plan, nbytes), \
                         runtime_stats.device_section(plan,
                                                      errors=False):
-                    with trace.span("dispatch", rows=chunk.num_rows):
+                    with trace.span("dispatch", rows=chunk.num_rows,
+                                    chip=slot.chip):
                         pending = k.dispatch(chunk, dev_cols=dev_cols)
                     # the sync path's "blocking readback" seam: inside
                     # the watchdog-guarded slot, so an armed delay here

@@ -60,7 +60,7 @@ from tidb_tpu import config, memtrack, metrics, trace
 from tidb_tpu.util import failpoint
 
 __all__ = ["DeviceBlock", "DeviceCache", "upload_block", "tracker",
-           "shed_all"]
+           "block_replicas", "shed_all"]
 
 
 _tracker_lock = threading.Lock()
@@ -124,9 +124,31 @@ def upload_block(chunk, size: int | None = None):
     """The ONE audited upload site for region columns (lint rule
     `device-cache`): pad + dict-encode + device_put without the
     per-chunk memo (the cache owns residency; a second resident copy
-    memoized on the chunk would double HBM). -> (cols, dicts)."""
+    memoized on the chunk would double HBM). -> (cols, dicts).
+
+    On a multi-chip ``("batch",)`` plane blocks upload REPLICATED
+    (``NamedSharding(mesh, P())``): a point lookup then runs on
+    whichever chip the scheduler grant places it, no cross-chip fetch.
+    The N× HBM cost is billed honestly by fill() (nbytes × replicas),
+    so the budget/eviction math sees the real footprint."""
+    import jax
+
+    from tidb_tpu import devplane
     from tidb_tpu.ops import runtime
-    return runtime.device_put_chunk(chunk, size, memo=False)
+    if devplane.ndev() <= 1:
+        return runtime.device_put_chunk(chunk, size, memo=False)
+    cols, dicts = runtime.device_put_chunk(chunk, size,
+                                           to_device=False, memo=False)
+    cols = jax.device_put(cols, devplane.replicated())
+    return cols, dicts
+
+
+def block_replicas() -> int:
+    """Replication factor of a block uploaded NOW (the plane's device
+    count): fill() bills nbytes × this so the hbm-cache ledger carries
+    the true multi-chip footprint."""
+    from tidb_tpu import devplane
+    return devplane.ndev()
 
 
 class DeviceBlock:
@@ -319,7 +341,9 @@ class DeviceCache:
         failpoint.eval("hbm/fill")
         budget = config.device_cache_bytes()
         size = bucket_size(max(chunk.num_rows, 1))
-        nbytes = memtrack.device_put_bytes(chunk, size)
+        # a multi-chip plane replicates the block to every chip (any
+        # chip serves it): the budget sees the full N× footprint
+        nbytes = memtrack.device_put_bytes(chunk, size) * block_replicas()
         if nbytes > budget:
             return None
         with trace.span("hbm.fill", rows=chunk.num_rows, bytes=nbytes):

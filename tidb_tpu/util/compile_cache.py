@@ -17,12 +17,14 @@ import os
 import threading
 
 __all__ = ["enable", "default_dir", "stats", "reset_counters",
-           "cpu_feature_tag", "scoped_cpu_dir"]
+           "cpu_feature_tag", "scoped_cpu_dir", "plane_tag",
+           "scoped_plane_dir"]
 
 _lock = threading.Lock()
 _counts = {"hits": 0, "misses": 0}
 _listener_installed = False
 _enabled_dir: str | None = None
+_plane_listener_installed = False
 
 
 def default_dir() -> str:
@@ -67,6 +69,50 @@ def scoped_cpu_dir(base: str) -> str:
     first-compile stall of BENCH r05) instead of disabling it to avoid
     cross-feature-set poisoning."""
     return os.path.join(base, "cpu-" + cpu_feature_tag())
+
+
+def plane_tag() -> str:
+    """Device-plane subdirectory name from `devplane.mesh_fingerprint`
+    (e.g. ``plane-batch-8-cpu``). Executables traced against an N-chip
+    ``("batch",)`` mesh bake the partitioned program into the cache
+    entry; loading one into a process with a different topology is the
+    same poisoning failure the CPU feature scoping exists for."""
+    from tidb_tpu import devplane
+    fp = devplane.mesh_fingerprint(process=True)
+    return "plane-" + "-".join(str(p) for p in fp)
+
+
+def scoped_plane_dir(base: str) -> str:
+    """The per-device-plane subdirectory of a cache `base` for the
+    CURRENT process mesh. A no-mesh process uses `base` itself (the
+    historical layout: single-chip entries stay warm across upgrades)."""
+    from tidb_tpu import devplane
+    if devplane.active_mesh() is None:
+        return base
+    return os.path.join(base, plane_tag())
+
+
+def _repoint_for_plane() -> None:
+    """Topology-change hook: re-point jax at the plane-scoped
+    subdirectory of the enabled base so a later `enable_mesh(8)` cannot
+    keep writing into (or loading from) the 1-chip entry pool."""
+    if _enabled_dir is None:
+        return
+    try:
+        import jax
+        jax.config.update("jax_compilation_cache_dir",
+                          scoped_plane_dir(_enabled_dir))
+    except Exception:  # noqa: BLE001 - older jax without the knob
+        pass
+
+
+def _install_plane_listener() -> None:
+    global _plane_listener_installed
+    if _plane_listener_installed:
+        return
+    from tidb_tpu import devplane
+    devplane.on_topology_change(_repoint_for_plane)
+    _plane_listener_installed = True
 
 
 def _install_listener() -> None:
@@ -114,6 +160,10 @@ def enable(path: str | None = None,
         return None
     _install_listener()
     _enabled_dir = path
+    _install_plane_listener()
+    # plane-scope the active directory from the start (a mesh may
+    # already be installed when enable() is called explicitly)
+    _repoint_for_plane()
     return path
 
 
