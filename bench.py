@@ -218,33 +218,13 @@ class _DeviceProber:
         self._stop.set()
 
 
-# HBM peak per chip family (public figures, GB/s) for the roofline
-# fraction; the CPU fallback measures its own memcpy bandwidth instead.
-_HBM_PEAK_GBPS = {"TPU v2": 700.0, "TPU v3": 900.0, "TPU v4": 1228.0,
-                  "TPU v5 lite": 819.0, "TPU v5e": 819.0,
-                  "TPU v5p": 2765.0, "TPU v6 lite": 1640.0,
-                  "TPU v6e": 1640.0}
-
-
 def _memory_roofline_gbps() -> tuple[float, str]:
-    """-> (peak GB/s, how it was obtained). On a chip: table lookup by
-    device kind. On CPU: measured big-buffer memcpy bandwidth."""
-    import jax
-    kind = jax.devices()[0].device_kind
-    if kind in _HBM_PEAK_GBPS:
-        return _HBM_PEAK_GBPS[kind], f"datasheet({kind})"
-    for k, v in _HBM_PEAK_GBPS.items():
-        if k.lower() in kind.lower():
-            return v, f"datasheet({kind})"
-    import numpy as _np
-    buf = _np.empty(1 << 27, dtype=_np.uint8)   # 128 MB
-    t0 = time.perf_counter()
-    for _ in range(3):
-        buf2 = buf.copy()
-    dt = time.perf_counter() - t0
-    del buf2
-    # copy reads + writes: 2 bytes moved per byte copied
-    return (3 * 2 * buf.nbytes / dt) / 1e9, f"measured-memcpy({kind})"
+    """-> (peak GB/s, how it was obtained). Thin delegate: the estimator
+    (datasheet table by device kind, measured memcpy on CPU) lives in
+    tidb_tpu.profiler now, where the continuous per-kernel roofline
+    fractions use the same peak the bench normalizes against."""
+    from tidb_tpu import profiler
+    return profiler.platform_peak_gbps()
 
 
 def _hbm_counters() -> dict:
@@ -1668,6 +1648,128 @@ def trace_main() -> None:
     }))
 
 
+def _profile_bench(progress) -> dict:
+    """Kernel-profiling leg (scripts/profile_bench.sh): warm Q1/Q3/Q5
+    under the continuous profiler, then FAIL unless the plane actually
+    observed the run — information_schema.kernel_profile populated with
+    dispatch counts, roofline_fraction present on every row that moved
+    bytes, compile counts FLAT across the warm iterations (a warm
+    iteration that recompiles is the regression this leg exists to
+    catch), and every statement_profile memo row carrying the mode that
+    ran.
+
+    Env knobs: BENCH_PROFILE_SF (0.02), BENCH_PROFILE_ITERS (3)."""
+    from tidb_tpu import config, profiler
+    from tidb_tpu.benchmarks import tpch
+    from tidb_tpu.parallel import config as mesh_config
+    from tidb_tpu.session import Session
+    from tidb_tpu.store.storage import new_mock_storage
+
+    sf = float(os.environ.get("BENCH_PROFILE_SF", "0.02"))
+    iters = int(os.environ.get("BENCH_PROFILE_ITERS", "3"))
+
+    data = tpch.ScaledTpch(sf=sf)
+    storage = new_mock_storage()
+    session = Session(storage)
+    session.execute("CREATE DATABASE tpch_profile")
+    session.execute("USE tpch_profile")
+    progress(f"profile: loading sf={sf}")
+    tpch.load(session, storage, data, regions_per_table=2)
+    queries = {q: tpch.QUERIES[q] for q in ("q1", "q3", "q5")}
+
+    saved = config.get_var("tidb_tpu_device")
+    out: dict = {"sf": sf, "iters": iters}
+    failures: list[str] = []
+    try:
+        config.set_var("tidb_tpu_device", 1)
+        mesh_config.enable_mesh()
+        profiler.reset_for_tests()
+        progress("profile: cold runs (compile + cache fill)")
+        for sql in queries.values():
+            session.query(sql)
+
+        def total_compiles() -> int:
+            return sum(p["compiles"] for p in profiler.snapshot())
+
+        compiles_after_cold = total_compiles()
+        progress(f"profile: {iters} warm iterations per query")
+        compile_track = []
+        for _i in range(iters):
+            for sql in queries.values():
+                session.query(sql)
+            compile_track.append(total_compiles())
+        out["compiles_after_cold"] = compiles_after_cold
+        out["compiles_per_warm_iter"] = compile_track
+        if compile_track and compile_track[-1] > compile_track[0]:
+            failures.append(
+                f"compile counts grew across warm iterations: "
+                f"{compile_track} (warm runs must ride the caches)")
+
+        rows = session.query(
+            "SELECT family, compiles, dispatches, busy_ns, bytes_in, "
+            "roofline_fraction FROM information_schema.kernel_profile"
+        ).rows
+        out["kernel_profile_rows"] = len(rows)
+        out["kernel_profile_families"] = sorted({r[0] for r in rows})
+        if not rows or not any(r[2] for r in rows):
+            failures.append(
+                f"kernel_profile unpopulated after {iters} warm "
+                f"iterations: {rows!r}")
+        missing_roof = [r[0] for r in rows
+                        if r[2] and r[4] and r[5] is None]
+        if missing_roof:
+            failures.append(
+                f"rows with dispatches+bytes but no roofline_fraction: "
+                f"{missing_roof}")
+
+        memo = session.query(
+            "SELECT digest, op, mode, runs, device_ns FROM "
+            "information_schema.statement_profile").rows
+        out["statement_profile_rows"] = len(memo)
+        out["statement_profile_modes"] = sorted({m[2] for m in memo})
+        if not memo:
+            failures.append("statement_profile memo is empty after a "
+                            "warm TPC-H sweep")
+        bad_mode = [(m[0][:8], m[1]) for m in memo if not m[2]]
+        if bad_mode:
+            failures.append(f"memo rows missing mode: {bad_mode}")
+
+        gbps, src = profiler.platform_peak_gbps()
+        out["roofline"] = {"peak_gbps": round(gbps, 1), "source": src}
+        out["profiler_stats"] = profiler.stats()
+    finally:
+        config.set_var("tidb_tpu_device", saved)
+        session.close()
+    out["failures"] = failures
+    out["passed"] = not failures
+    return out
+
+
+def profile_main() -> None:
+    """`python bench.py profile`: ONLY the kernel-profiling leg — the
+    CI entry point (scripts/profile_bench.sh) with its own one-line
+    JSON; exits non-zero when the plane failed to observe the run."""
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        _scope_cpu_compile_cache()
+    t_start = time.perf_counter()
+
+    def progress(msg: str) -> None:
+        print(f"[profile +{time.perf_counter() - t_start:7.1f}s] {msg}",
+              file=sys.stderr, flush=True)
+
+    detail = _profile_bench(progress)
+    print(json.dumps({
+        "metric": "profile_bench_kernel_profiles",
+        "value": detail.get("kernel_profile_rows", 0),
+        "unit": "profiles",
+        "detail": detail,
+    }))
+    if not detail["passed"]:
+        for f in detail["failures"]:
+            print(f"[profile] FAIL: {f}", file=sys.stderr)
+        sys.exit(1)
+
+
 def _parse_cell(x):
     if isinstance(x, (bytes, bytearray)):
         x = x.decode()
@@ -2676,6 +2778,8 @@ if __name__ == "__main__":
         chaos_main()
     elif len(sys.argv) > 1 and sys.argv[1] == "trace":
         trace_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "profile":
+        profile_main()
     elif len(sys.argv) > 1 and sys.argv[1] == "multichip":
         multichip_main()
     elif len(sys.argv) > 1 and sys.argv[1] == "multichip-child":

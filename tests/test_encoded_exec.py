@@ -329,7 +329,8 @@ class TestExplainEncodingMode:
         s = enc_sess
         r = s.query("EXPLAIN ANALYZE SELECT g, COUNT(*) FROM t "
                     "WHERE f = 'hot0' GROUP BY g")
-        cell = next(row[-1] for row in r.rows
+        pc = r.columns.index("pipeline")
+        cell = next(row[pc] for row in r.rows
                     if "TableReader" in row[0])
         assert "enc=" in cell and ("direct-agg" in cell or
                                    "encoded" in cell)
@@ -338,7 +339,8 @@ class TestExplainEncodingMode:
         s = enc_sess
         r = s.query("EXPLAIN ANALYZE SELECT g, COUNT(*) FROM t "
                     "WHERE f LIKE 'hot%' GROUP BY g")
-        cell = next(row[-1] for row in r.rows
+        pc = r.columns.index("pipeline")
+        cell = next(row[pc] for row in r.rows
                     if "TableReader" in row[0])
         assert "enc=decoded" in cell
 

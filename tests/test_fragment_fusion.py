@@ -98,7 +98,8 @@ class TestFusedEqualsUnfused:
         r = frag_sess.query(
             "EXPLAIN ANALYZE SELECT dim.grp, COUNT(*) FROM fact "
             "JOIN dim ON fact.k = dim.id GROUP BY dim.grp")
-        cell = next(row[-1] for row in r.rows if "HashAgg" in row[0])
+        pc = r.columns.index("pipeline")
+        cell = next(row[pc] for row in r.rows if "HashAgg" in row[0])
         assert "enc=fused:probe-agg" in cell
 
 

@@ -48,7 +48,8 @@ class TestExplainAnalyze:
     def test_columns_and_basic_stats(self, sess):
         cols, rows = _analyze(sess, "SELECT * FROM t WHERE v >= 30")
         assert cols == ["id", "est_rows", "act_rows", "loops", "time",
-                        "device_time", "mem", "cop_tasks", "pipeline"]
+                        "device_time", "mem", "cop_tasks", "pipeline",
+                        "kernel"]
         assert rows, "no plan rows"
         # root operator produced exactly the result cardinality
         root = rows[0]

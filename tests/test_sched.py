@@ -16,7 +16,7 @@ import time
 import numpy as np
 import pytest
 
-from tidb_tpu import config, errcode, memtrack, sched
+from tidb_tpu import config, devplane, errcode, memtrack, sched
 
 
 @pytest.fixture
@@ -141,6 +141,72 @@ class TestDeviceScheduler:
         out = list(rt.pipeline_map(range(20), lambda i: i * 3,
                                    lambda i, t: (i, t), depth=4))
         assert out == [(i, i * 3) for i in range(20)]
+
+
+class TestEwmaPlacement:
+    """Least-loaded chip placement consults the DECAYED busy signal
+    (busy-ns EWMA, 30s halflife), not the cumulative ledger: a chip
+    that absorbed a heavy scan an hour ago must not be penalized
+    forever, and one that JUST did must shed load until it drains."""
+
+    def test_placement_avoids_recently_busy_chip(self, fresh):
+        config.set_var("tidb_tpu_sched_inflight", 4)
+        devplane.enable_mesh(8)
+        try:
+            s = sched.DeviceScheduler()
+            with s._cv:
+                s._chip_busy_ewma[0] = 5e9   # chip 0: 5s of recent work
+            slot = s.acquire()
+            assert slot is not None and slot.granted
+            # equal held-slot counts: the lowest-EWMA chip wins
+            assert slot.chip == 1
+            s.release(slot)
+        finally:
+            devplane.disable_mesh()
+
+    def test_recent_signal_beats_cumulative_ledger(self, fresh):
+        config.set_var("tidb_tpu_sched_inflight", 4)
+        devplane.enable_mesh(8)
+        try:
+            s = sched.DeviceScheduler()
+            with s._cv:
+                # chip 0 was hammered long ago (huge cumulative, EWMA
+                # fully drained); every other chip is busy RIGHT NOW
+                s._chip_busy_ns[0] = int(3600e9)
+                for c in range(1, 8):
+                    s._chip_busy_ewma[c] = 1e9
+            slot = s.acquire()
+            assert slot is not None and slot.chip == 0
+            s.release(slot)
+        finally:
+            devplane.disable_mesh()
+
+    def test_decay_drains_ewma_not_cumulative(self, fresh):
+        s = sched.DeviceScheduler()
+        with s._cv:
+            s._chip_busy_ewma[0] = 1e9
+            s._chip_busy_ns[0] = int(1e9)
+            # 10 halflives elapse: the placement signal is ~0.1% of
+            # the original; the sampler's cumulative ledger is intact
+            s._decay_ewma_locked(
+                now=s._ewma_t + 10 * s.EWMA_HALFLIFE_S)
+            assert s._chip_busy_ewma[0] < 1e9 * 2e-3
+            assert s._chip_busy_ns[0] == int(1e9)
+
+    def test_release_feeds_both_ledgers(self, fresh):
+        config.set_var("tidb_tpu_sched_inflight", 4)
+        devplane.enable_mesh(8)
+        try:
+            s = sched.DeviceScheduler()
+            slot = s.acquire()
+            assert slot is not None
+            time.sleep(0.002)
+            s.release(slot)
+            chips = s.snapshot()["chips"]
+            assert chips[slot.chip]["busy_seconds"] > 0
+            assert chips[slot.chip]["busy_ewma_seconds"] > 0
+        finally:
+            devplane.disable_mesh()
 
 
 class TestAdmission:

@@ -94,6 +94,15 @@ def main(argv=None) -> int:
     cc = compile_cache.stats()
     log.info("XLA compile cache: %s (%s entries)",
              cc["dir"] or "disabled", cc["entries"])
+    # the kernel profiling plane rides every dispatch; say up front
+    # whether it is armed and how much history it may keep
+    from tidb_tpu import profiler
+    ks = profiler.stats()
+    log.info("kernel profiler: %s (cap %d profiles, compile-cache "
+             "hits=%d misses=%d)",
+             "on" if ks["enabled"] else "off", ks["cap"],
+             compile_cache.counters()["hits"],
+             compile_cache.counters()["misses"])
     log.info("serving: scheduler inflight=%d (bytes gate %d), "
              "server mem quota=%d (admission %s, timeout %dms)",
              config.sched_inflight(), config.sched_inflight_bytes(),

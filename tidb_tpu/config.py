@@ -38,6 +38,7 @@ __all__ = ["get_var", "set_var", "all_vars", "coerce", "session_overlay",
            "fleet_local_cache",
            "dispatch_timeout_ms", "failpoints_spec", "on_change",
            "trace_sample", "slow_trace_ms",
+           "kernel_profile", "kernel_profile_cap", "stmt_profile_cap",
            "metrics_history_interval_ms", "metrics_history_points",
            "member_heartbeat_ms", "member_ttl_ms",
            "cluster_fetch_timeout_ms",
@@ -255,6 +256,27 @@ _DEFS: dict[str, tuple[str, int]] = {
     # 0 = watchdog off (the default: CPU-XLA first compiles can
     # legitimately take tens of seconds).
     "tidb_tpu_dispatch_timeout_ms": (_INT, 0),
+    # kernel profiling plane (tidb_tpu/profiler.py): continuous per-
+    # kernel compile/dispatch/roofline accounting keyed (family, plan
+    # fingerprint, mesh fingerprint), surfaced in EXPLAIN ANALYZE's
+    # `kernel` column, information_schema.kernel_profile and
+    # GET /profile. On by default: the armed per-dispatch cost is one
+    # perf_counter pair + a dict fold under one lock, amortized over
+    # superchunk-sized dispatches; disarmed cost is pinned <5us per
+    # statement (tests/test_profiler.py, same discipline as trace).
+    "tidb_tpu_kernel_profile": (_BOOL, 1),
+    # bounded size of the kernel-profile registry (distinct
+    # family/fingerprint/mesh keys; true LRU beyond). Entries bill a
+    # fixed per-entry cost to the `kernel-profile` memtrack SERVER
+    # node, with a registered shed action — GET /shed (and admission
+    # shedding) drops the profile history before it cancels work.
+    "tidb_tpu_kernel_profile_cap": (_INT, 512),
+    # bounded size of the per-digest per-operator mode-history memo
+    # (perfschema.py): which agg/join mode actually ran per statement
+    # digest, observed group cardinality and per-mode device-ns — the
+    # read side the future adaptive mode chooser (ROADMAP item 3)
+    # consults. Served as information_schema.statement_profile.
+    "tidb_tpu_stmt_profile_cap": (_INT, 1024),
     # metrics-history sampler cadence (tidb_tpu/metrics_history.py): a
     # supervised background sampler snapshots registered gauges plus
     # derived device-utilization / HBM occupancy / hit-rate series into
@@ -603,3 +625,15 @@ def trace_sample() -> int:
 
 def slow_trace_ms() -> int:
     return max(0, _read("tidb_tpu_slow_trace_ms"))
+
+
+def kernel_profile() -> bool:
+    return bool(_read("tidb_tpu_kernel_profile"))
+
+
+def kernel_profile_cap() -> int:
+    return min(max(16, _read("tidb_tpu_kernel_profile_cap")), 1 << 16)
+
+
+def stmt_profile_cap() -> int:
+    return min(max(16, _read("tidb_tpu_stmt_profile_cap")), 1 << 16)

@@ -190,5 +190,11 @@ def plane_jit(fn, **kwargs):
     virtual-device mesh lowers the same way — so this is a plain jit
     today; it exists as the single seam to grow per-backend dispatch
     options (donation policies, compiler flags) without touching every
-    kernel."""
+    kernel. Each wrap registers one `plane`-family compile unit with
+    the kernel-profile registry (keyed by the staged function's name +
+    the process mesh): plane-stage re-jitting that the executable
+    caches should have absorbed shows up as compile churn on one row."""
+    from tidb_tpu import profiler
+    prof = profiler.profile("plane", getattr(fn, "__name__", "shard"))
+    profiler.note_construct(prof, reuse=False)
     return jax.jit(fn, **kwargs)

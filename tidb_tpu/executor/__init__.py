@@ -14,7 +14,8 @@ import time
 
 import numpy as np
 
-from tidb_tpu import config, kv, memtrack, runtime_stats, sched, tablecodec
+from tidb_tpu import (config, kv, memtrack, profiler, runtime_stats,
+                      sched, tablecodec)
 from tidb_tpu.chunk import Chunk, Column
 from tidb_tpu.expression import AggDesc, AggFunc, Expression
 from tidb_tpu.kv import CopRequest, KVRange, ReqType
@@ -597,6 +598,7 @@ class HashAggExec(Executor):
             except BaseException:
                 memtrack.release(plan, device=db)
                 raise
+            profiler.note_bytes(profiler.profile_of(k), nbytes=db)
             runtime_stats.note_superchunk(plan, n, sc.bucket, sc.sources)
             runtime_stats.note_bytes_touched(
                 memtrack.chunk_bytes(sc.chunk), k.input_nbytes(sc.chunk))
@@ -611,8 +613,10 @@ class HashAggExec(Executor):
             try:
                 gr = k.finalize(sc.chunk, build, nb, pend)
                 runtime_stats.note_encoding(plan, "fused:probe-agg")
+                runtime_stats.note_mode(plan, "fused")
                 return gr
             except CapacityError as e:
+                profiler.note_escalation(profiler.profile_of(k))
                 k2 = self._escalated_fragment(e, nl, width)
                 if k2 is not None:
                     state["fk"] = k2    # later batches dispatch with it
@@ -627,13 +631,18 @@ class HashAggExec(Executor):
                                             sc.chunk, n))
                             runtime_stats.note_encoding(
                                 plan, "fused:probe-agg")
+                            runtime_stats.note_mode(plan, "fused")
                             return gr
                         except (CapacityError, CollisionError):
                             pass
                 runtime_stats.note_fallback(plan, "capacity")
+                profiler.note_kernel_fallback(profiler.profile_of(k),
+                                              "capacity")
                 return decoded_batch(pk, sc.chunk)
             except CollisionError:
                 runtime_stats.note_fallback(plan, "collision")
+                profiler.note_kernel_fallback(profiler.profile_of(k),
+                                              "collision")
                 return decoded_batch(pk, sc.chunk)
             finally:
                 memtrack.release(plan, device=db)
@@ -647,7 +656,8 @@ class HashAggExec(Executor):
             yield from op_runtime.pipeline_map(
                 sc_iter, dispatch, finalize, config.pipeline_depth(),
                 tracker=mt_node,
-                cost=lambda sc: memtrack.chunk_bytes(sc.chunk))
+                cost=lambda sc: memtrack.chunk_bytes(sc.chunk),
+                profile=profiler.profile_of(fk))
         finally:
             if state["build_db"]:
                 memtrack.release(plan, device=state["build_db"])
@@ -696,34 +706,48 @@ class HashAggExec(Executor):
             if self._kernel is None:
                 self._set_kernel(kernel_for(
                     None, self.plan.group_exprs, self.plan.aggs))
+            nb = self._kernel.dispatch_nbytes(chunk)
             with sched.device_slot(), memtrack.device_scope(
-                    self.plan, self._kernel.dispatch_nbytes(chunk)):
-                return runtime_stats.device_call(
+                    self.plan, nb), \
+                    profiler.dispatch_section(
+                        profiler.profile_of(self._kernel), nbytes=nb,
+                        plan=self.plan):
+                gr = runtime_stats.device_call(
                     self.plan, self._kernel, chunk)
+            runtime_stats.note_mode(self.plan, "hash")
+            return gr
         except CapacityError as e:
             reason = "capacity"
+            profiler.note_escalation(profiler.profile_of(self._kernel))
             k = self._escalated_kernel(e)
             if k is not None:
                 # the retry kernel's (>=2x) scratch is the statement's
                 # LARGEST device allocation — it must not dodge the quota
-                with sched.device_slot(), \
-                        memtrack.device_scope(self.plan,
-                                              k.dispatch_nbytes(chunk)):
-                    try:
-                        return runtime_stats.device_call(
+                nb = k.dispatch_nbytes(chunk)
+                try:
+                    with sched.device_slot(), \
+                            memtrack.device_scope(self.plan, nb), \
+                            profiler.dispatch_section(
+                                profiler.profile_of(k), nbytes=nb,
+                                plan=self.plan):
+                        gr = runtime_stats.device_call(
                             self.plan, k, chunk)
-                    except CapacityError:
-                        pass
-                    except CollisionError:
-                        reason = "collision"
-                    except (DeviceRejectError, NotImplementedError):
-                        runtime_stats.note_fallback(self.plan,
-                                                    "unsupported")
-                        return None
+                    runtime_stats.note_mode(self.plan, "hash")
+                    return gr
+                except CapacityError:
+                    pass
+                except CollisionError:
+                    reason = "collision"
+                except (DeviceRejectError, NotImplementedError):
+                    runtime_stats.note_fallback(self.plan,
+                                                "unsupported")
+                    return None
+            runtime_stats.note_mode(self.plan, "hybrid")
             return op_hybrid.partitioned_agg(
                 chunk, None, self.plan.group_exprs, self.plan.aggs,
                 self.plan, reason=reason)
         except CollisionError:
+            runtime_stats.note_mode(self.plan, "hybrid")
             return op_hybrid.partitioned_agg(
                 chunk, None, self.plan.group_exprs, self.plan.aggs,
                 self.plan, reason="collision")
@@ -771,6 +795,7 @@ class HashAggExec(Executor):
             except BaseException:
                 memtrack.release(plan, device=db)
                 raise
+            profiler.note_bytes(profiler.profile_of(k), nbytes=db)
             runtime_stats.note_superchunk(plan, sc.num_rows, sc.bucket,
                                           sc.sources)
             runtime_stats.note_bytes_touched(
@@ -783,15 +808,20 @@ class HashAggExec(Executor):
                 k, fut, db = tok
                 t0 = time.perf_counter_ns()
                 try:
-                    return k.finalize(sc.chunk, fut)
+                    gr = k.finalize(sc.chunk, fut)
+                    runtime_stats.note_mode(plan, "hash")
+                    return gr
                 except CapacityError as e:
                     reason = "capacity"
+                    profiler.note_escalation(profiler.profile_of(k))
                     k2 = self._escalated_kernel(e)
                     if k2 is not None:
                         with sched.device_slot(), memtrack.device_scope(
                                 plan, k2.dispatch_nbytes(sc.chunk)):
                             try:
-                                return k2(sc.chunk)
+                                gr = k2(sc.chunk)
+                                runtime_stats.note_mode(plan, "hash")
+                                return gr
                             except CapacityError:
                                 pass
                             except CollisionError:
@@ -805,10 +835,12 @@ class HashAggExec(Executor):
                                     plan.aggs)
                     # a miss that survived escalation retries per
                     # radix partition instead of abandoning the device
+                    runtime_stats.note_mode(plan, "hybrid")
                     return op_hybrid.partitioned_agg(
                         sc.chunk, None, plan.group_exprs, plan.aggs,
                         plan, reason=reason)
                 except CollisionError:
+                    runtime_stats.note_mode(plan, "hybrid")
                     return op_hybrid.partitioned_agg(
                         sc.chunk, None, plan.group_exprs, plan.aggs,
                         plan, reason="collision")
@@ -825,7 +857,8 @@ class HashAggExec(Executor):
             op_runtime.superchunk_batches(chunks, config.superchunk_rows(),
                                           tracker=mt_node),
             dispatch, finalize, config.pipeline_depth(),
-            tracker=mt_node, cost=lambda sc: memtrack.chunk_bytes(sc.chunk))
+            tracker=mt_node, cost=lambda sc: memtrack.chunk_bytes(sc.chunk),
+            profile=profiler.profile_of(self._kernel))
 
 
 class StreamAggExec(Executor):
@@ -892,11 +925,15 @@ class StreamAggExec(Executor):
                         self._kernel = segment_kernel_for(
                             self.plan.group_exprs, self.plan.aggs)
                         self.plan._root_kernel = self._kernel
+                    nb = self._kernel.dispatch_nbytes(part)
                     with sched.device_slot(), memtrack.device_scope(
-                            self.plan,
-                            self._kernel.dispatch_nbytes(part)):
+                            self.plan, nb), \
+                            profiler.dispatch_section(
+                                profiler.profile_of(self._kernel),
+                                nbytes=nb, plan=self.plan):
                         gr = runtime_stats.device_call(
                             self.plan, self._kernel, part)
+                    runtime_stats.note_mode(self.plan, "sort")
                 except (DeviceRejectError, NotImplementedError):
                     runtime_stats.note_fallback(self.plan, "unsupported")
                     use_device = False
@@ -963,6 +1000,7 @@ class StreamAggExec(Executor):
             except BaseException:
                 memtrack.release(plan, device=db)
                 raise
+            profiler.note_bytes(profiler.profile_of(k), nbytes=db)
             runtime_stats.note_superchunk(plan, sc.num_rows, sc.bucket,
                                           sc.sources)
             runtime_stats.note_bytes_touched(
@@ -975,7 +1013,9 @@ class StreamAggExec(Executor):
                 k, fut, db = tok
                 t0 = time.perf_counter_ns()
                 try:
-                    return k.finalize(sc.chunk, fut)
+                    gr = k.finalize(sc.chunk, fut)
+                    runtime_stats.note_mode(plan, "sort")
+                    return gr
                 except (DeviceRejectError, NotImplementedError):
                     self._kernel = None
                     runtime_stats.note_fallback(plan, "unsupported")
@@ -988,7 +1028,8 @@ class StreamAggExec(Executor):
 
         yield from op_runtime.pipeline_map(
             parts, dispatch, finalize, config.pipeline_depth(),
-            tracker=mt_node, cost=lambda sc: memtrack.chunk_bytes(sc.chunk))
+            tracker=mt_node, cost=lambda sc: memtrack.chunk_bytes(sc.chunk),
+            profile=profiler.profile_of(self._kernel))
 
 
 # ---------------------------------------------------------------------------

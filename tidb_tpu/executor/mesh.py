@@ -28,7 +28,7 @@ import time
 from collections import OrderedDict
 
 from tidb_tpu import config as sysconf
-from tidb_tpu import devplane, memtrack, runtime_stats, sched, trace
+from tidb_tpu import devplane, memtrack, profiler, runtime_stats, sched, trace
 from tidb_tpu.chunk import Chunk, Column
 from tidb_tpu.ops import runtime as op_runtime
 from tidb_tpu.ops.hashagg import CapacityError, CollisionError, HashAggregator
@@ -80,6 +80,7 @@ def _kernel_cache_get(plan, capacity):
     hit = _KERNELS.get(key)
     if hit is not None and hit[0] is plan:
         _KERNELS.move_to_end(key)
+        profiler.note_construct(profiler.profile_of(hit[1]), reuse=True)
         return hit[1]
     return None
 
@@ -91,6 +92,11 @@ def _kernel_cache_put(plan, capacity, kernel) -> None:
     for k in [k for k in _KERNELS if k[0] != gen]:
         del _KERNELS[k]
     key = (gen, devplane.mesh_fingerprint(process=True), id(plan), capacity)
+    # one mesh-family compile unit per cache fill; the profile row keys
+    # on the same plan identity + capacity the executable slot does
+    prof = profiler.profile("mesh", f"plan{id(plan)}|{capacity}")
+    profiler.note_construct(prof, reuse=False)
+    kernel._profile = prof
     _KERNELS[key] = (plan, kernel)
     _KERNELS.move_to_end(key)
     while len(_KERNELS) > _KERNELS_CAP:
@@ -204,17 +210,19 @@ class _MeshExecBase:
                     return
             yield c
 
-    @staticmethod
-    def _whole_table_run(kernel, chunk, chip):
+    def _whole_table_run(self, kernel, chunk, chip):
         """One whole-table kernel execution under the SAME trace-span
         pair and failpoint seams as the copr sync sites and the
         pipelined dispatch wrapper — a statement's span vocabulary must
         not depend on the mesh size that executed it."""
-        with trace.span("dispatch", rows=chunk.num_rows, chip=chip):
-            outs = kernel.launch(chunk, bucket=True)
-        failpoint.eval("device/finalize")
-        with trace.span("finalize"):
-            return kernel.finish(outs, chunk)
+        prof = profiler.profile_of(kernel)
+        nb = memtrack.device_put_bytes(chunk) if prof is not None else 0
+        with profiler.dispatch_section(prof, nbytes=nb, plan=self.plan):
+            with trace.span("dispatch", rows=chunk.num_rows, chip=chip):
+                outs = kernel.launch(chunk, bucket=True)
+            failpoint.eval("device/finalize")
+            with trace.span("finalize"):
+                return kernel.finish(outs, chunk)
 
     def _run_with_escalation(self, make_kernel, run):
         """Kernel-build + run with one capacity re-plan on overflow.
@@ -232,13 +240,16 @@ class _MeshExecBase:
                 self.plan._mesh_capacity = capacity
                 return out
             except CapacityError as e:
+                profiler.note_escalation(profiler.profile_of(kernel))
                 needed = getattr(e, "needed", None)
                 if needed is None:
                     return None
                 capacity = 1 << max(needed * 2 - 1, 1).bit_length()
                 if capacity > MAX_CAPACITY:
                     return None
-            except (CollisionError, BuildError, ValueError):
+            except (CollisionError, BuildError, ValueError) as e:
+                profiler.note_kernel_fallback(profiler.profile_of(kernel),
+                                              _fallback_reason(e))
                 return None
         return None
 
@@ -294,6 +305,7 @@ class _MeshExecBase:
             if state["inflight"]:
                 _STREAM_STATS["overlapped_launches"] += 1
             state["inflight"] += 1
+            profiler.note_bytes(profiler.profile_of(k), nbytes=db)
             runtime_stats.note_superchunk(
                 plan, batch.num_rows, bucket_size(max(batch.num_rows, 1)),
                 sc.sources)
@@ -314,6 +326,7 @@ class _MeshExecBase:
                 # per-batch capacity re-plan: re-run only THIS batch at
                 # 2x the observed distinct count; later batches dispatch
                 # with the escalated kernel
+                profiler.note_escalation(profiler.profile_of(k))
                 needed = getattr(e, "needed", None)
                 while needed is not None:
                     cap2 = 1 << max(needed * 2 - 1, 1).bit_length()
@@ -346,7 +359,8 @@ class _MeshExecBase:
             for gr in op_runtime.pipeline_map(
                     superchunks, dispatch, finalize,
                     sysconf.pipeline_depth(), tracker=mt_node,
-                    cost=lambda sc: memtrack.chunk_bytes(sc.chunk)):
+                    cost=lambda sc: memtrack.chunk_bytes(sc.chunk),
+                    profile=profiler.profile_of(state["kernel"])):
                 agg.update(gr)
                 tracked = memtrack.track_to(plan, agg.approx_bytes(),
                                             tracked)

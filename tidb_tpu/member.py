@@ -156,7 +156,7 @@ def local_state() -> dict:
     store-plane member's records join back to SQL statements). Also
     the degraded local-only document when no registry exists
     (in-process sessions, unit tests)."""
-    from tidb_tpu import meter, trace
+    from tidb_tpu import meter, profiler, trace
     from tidb_tpu.session import processlist_snapshot
     return {
         "member": identity(),
@@ -167,6 +167,7 @@ def local_state() -> dict:
             "sessions": meter.sessions_snapshot(),
         },
         "traces": trace.ring_snapshot(),
+        "kernel_profile": profiler.snapshot(),
     }
 
 

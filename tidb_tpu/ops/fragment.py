@@ -292,7 +292,11 @@ def fragment_kernel_for(num_keys: int, probe_width: int, width: int,
     force_hash = capacity > direct_limit and \
         _direct_group_mode(group_exprs)
 
+    from tidb_tpu import profiler
+    made = []
+
     def make():
+        made.append(1)
         return ProbeAggKernel(num_keys, probe_width, width, group_exprs,
                               aggs, capacity=capacity,
                               force_hash=force_hash,
@@ -300,8 +304,18 @@ def fragment_kernel_for(num_keys: int, probe_width: int, width: int,
 
     fp = runtime.plan_fingerprint(None, group_exprs, aggs)
     if fp is None:
-        return make()
+        k = make()
+        prof = profiler.profile("fragment", None)
+        profiler.note_construct(prof, reuse=False)
+        k._profile = prof
+        return k
     from tidb_tpu import devplane
     key = (fp, num_keys, probe_width, width, capacity, force_hash,
            direct_limit, devplane.mesh_fingerprint(process=True))
-    return _FRAGMENTS.get_or_create(key, make)
+    k = _FRAGMENTS.get_or_create(key, make)
+    prof = profiler.profile(
+        "fragment", f"{fp}|{num_keys}|{probe_width}|{width}|{capacity}"
+                    f"|{force_hash}|{direct_limit}")
+    profiler.note_construct(prof, reuse=not made)
+    k._profile = prof
+    return k

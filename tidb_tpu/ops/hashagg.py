@@ -771,7 +771,12 @@ def kernel_for(filter_expr, group_exprs, aggs, capacity: int = 4096):
     force_hash = bool(group_exprs) and capacity > direct_limit and \
         _direct_group_mode(group_exprs)
 
+    from tidb_tpu import profiler
+    family = "hashagg" if group_exprs else "scalaragg"
+    made = []
+
     def make():
+        made.append(1)
         if group_exprs:
             return HashAggKernel(filter_expr, group_exprs, aggs,
                                  capacity=capacity,
@@ -781,14 +786,24 @@ def kernel_for(filter_expr, group_exprs, aggs, capacity: int = 4096):
 
     fp = runtime.plan_fingerprint(filter_expr, group_exprs, aggs)
     if fp is None:
-        return make()
+        k = make()
+        prof = profiler.profile(family, None)
+        profiler.note_construct(prof, reuse=False)
+        k._profile = prof
+        return k
     from tidb_tpu import devplane
     key = (fp, capacity if group_exprs else 0, force_hash,
            direct_limit if group_exprs else 0,
            # plane identity: a 1-chip and an 8-chip mesh executable for
            # the same plan shape must never alias one cache slot
            devplane.mesh_fingerprint(process=True))
-    return _KERNELS.get_or_create(key, make)
+    k = _KERNELS.get_or_create(key, make)
+    # profile rows key on the same (family, fingerprint, mesh) identity
+    # as the cache slot; an LRU miss (`made` fired) is one compile unit
+    prof = profiler.profile(family, f"{fp}|{key[1]}|{key[2]}|{key[3]}")
+    profiler.note_construct(prof, reuse=not made)
+    k._profile = prof
+    return k
 
 
 class HashAggregator:

@@ -128,7 +128,7 @@ def super_batches(first_parts, rest, limit: int):
 
 
 def pipeline_map(items, dispatch, finalize, depth: int,
-                 tracker=None, cost=None):
+                 tracker=None, cost=None, profile=None):
     """Depth-N dispatch-ahead map over an item stream: up to `depth`
     dispatched items are in flight before the oldest is finalized, so
     item k+1's host-side prep (padding, dict-encode, device_put) and its
@@ -153,10 +153,16 @@ def pipeline_map(items, dispatch, finalize, depth: int,
     in-flight token before asking again — shrinking its local window to
     its fair share — and past the scheduler's bypass valve the dispatch
     proceeds unscheduled, so the global window can throttle but never
-    hang a statement."""
+    hang a statement.
+
+    With `profile` set (a profiler.KernelProfile), each device token's
+    enqueue interval records as one dispatch and its blocking readback
+    as busy-ns on that profile row — the pipelined seam of the kernel
+    profiling plane (the sync seams use profiler.dispatch_section);
+    bytes are billed by the dispatch closures, which know them."""
     import time as _time
 
-    from tidb_tpu import meter, sched, trace
+    from tidb_tpu import meter, profiler, sched, trace
     from tidb_tpu.util import failpoint
     scheduler = sched.device_scheduler()
     depth = max(int(depth), 1)
@@ -193,7 +199,12 @@ def pipeline_map(items, dispatch, finalize, depth: int,
                 with meter.busy_section(kind), \
                         trace.span("finalize", superchunk=seq,
                                    host=int(kind == "host")):
-                    return finalize(prev, tok)
+                    t0p = _time.perf_counter_ns()
+                    out = finalize(prev, tok)
+                    if profile is not None and kind == "device":
+                        profiler.note_busy(
+                            profile, _time.perf_counter_ns() - t0p)
+                    return out
         finally:
             scheduler.release(slot)
             if held:
@@ -233,9 +244,15 @@ def pipeline_map(items, dispatch, finalize, depth: int,
                 # host-path items — the kind is only known once
                 # dispatch() returns, so it is assigned on the section
                 busy = meter.busy_section()
+                cc = profiler.cc_probe(profile)
+                t0p = _time.perf_counter_ns()
                 with busy, trace.span("dispatch", superchunk=seq):
                     tok = dispatch(it)
                     busy.kind = _token_kind(tok)
+                if profile is not None and busy.kind == "device":
+                    profiler.note_dispatch(
+                        profile, _time.perf_counter_ns() - t0p,
+                        cc_before=cc)
             except BaseException as e:
                 # executor-plane device faults feed the same health
                 # tracker as the copr sites, so repeated pipeline

@@ -133,10 +133,23 @@ _SEG_KERNELS = runtime.FingerprintCache(64)
 
 
 def segment_kernel_for(group_exprs, aggs) -> SegmentAggKernel:
-    from tidb_tpu import devplane
+    from tidb_tpu import devplane, profiler
+    made = []
+
+    def make():
+        made.append(1)
+        return SegmentAggKernel(group_exprs, aggs)
+
     fp = runtime.plan_fingerprint(None, group_exprs, aggs)
     if fp is None:
-        return SegmentAggKernel(group_exprs, aggs)
+        k = make()
+        prof = profiler.profile("streamagg", None)
+        profiler.note_construct(prof, reuse=False)
+        k._profile = prof
+        return k
     key = (fp, devplane.mesh_fingerprint(process=True))
-    return _SEG_KERNELS.get_or_create(
-        key, lambda: SegmentAggKernel(group_exprs, aggs))
+    k = _SEG_KERNELS.get_or_create(key, make)
+    prof = profiler.profile("streamagg", fp)
+    profiler.note_construct(prof, reuse=not made)
+    k._profile = prof
+    return k

@@ -25,7 +25,8 @@ from collections import OrderedDict, deque
 
 __all__ = ["stmt_begin", "stmt_end", "current_events", "history_events",
            "normalize_sql", "sql_digest", "digest_record",
-           "digest_summary", "HISTORY_CAP", "SUMMARY_CAP"]
+           "digest_summary", "memo_record", "memo_snapshot", "memo_reset",
+           "HISTORY_CAP", "SUMMARY_CAP"]
 
 HISTORY_CAP = 1024
 SUMMARY_CAP = 512          # distinct digests kept (LRU beyond)
@@ -264,6 +265,91 @@ def digest_summary() -> list[dict]:
     return out
 
 
+# -- per-digest mode-history memo ------------------------------------------
+#
+# The optimizer's mode choices (direct vs hash group table, fused vs
+# unfused, hybrid engaged, host fallback) are made from *estimates*; this
+# memo records what actually ran, per digest and per operator, with the
+# observed group cardinality and per-mode device time. It is the read
+# side for feedback-driven mode selection (ROADMAP item 3): a planner
+# that consults `memo_lookup`-style reads can learn "this digest's
+# hashagg always escalates — start at the bigger capacity" without
+# re-discovering it per statement. Same LRU discipline as _summary.
+
+_memo_lock = threading.Lock()
+# (digest, op name) -> record                    guarded-by: _memo_lock
+_memo: "OrderedDict[tuple[str, str], dict]" = OrderedDict()
+
+
+def memo_record(digest: str, op_stats: list[dict] | None) -> None:
+    """Fold one statement's per-operator runtime stats into the memo.
+    Only operators that reported a `mode` (i.e. actually chose between
+    execution strategies) take a row — scans/sorts without a mode field
+    stay out so the table holds decisions, not the whole plan."""
+    if not op_stats:
+        return
+    from tidb_tpu import config
+    cap = config.stmt_profile_cap()
+    now = time.time()
+    with _memo_lock:
+        for op in op_stats:
+            mode = op.get("mode")
+            if not mode:
+                continue
+            key = (digest, op.get("name", "?"))
+            rec = _memo.get(key)
+            if rec is None:
+                rec = _memo[key] = {
+                    "digest": digest, "op": key[1],
+                    "runs": 0, "last_mode": "", "last_groups": 0,
+                    "max_groups": 0, "first_seen": now, "last_seen": now,
+                    "modes": {},   # mode -> {runs, device_ns, rows}
+                }
+            _memo.move_to_end(key)
+            groups = op.get("act_rows", 0)
+            rec["runs"] += 1
+            rec["last_mode"] = mode
+            rec["last_groups"] = groups
+            rec["max_groups"] = max(rec["max_groups"], groups)
+            rec["last_seen"] = now
+            m = rec["modes"].setdefault(
+                mode, {"runs": 0, "device_ns": 0, "rows": 0})
+            m["runs"] += 1
+            m["device_ns"] += op.get("device_time_ns", 0)
+            m["rows"] += groups
+        while len(_memo) > cap:
+            _memo.popitem(last=False)
+
+
+def memo_snapshot() -> list[dict]:
+    """Rows for information_schema.statement_profile, one per
+    (digest, operator, mode) — flattened so SQL can filter on mode."""
+    with _memo_lock:
+        recs = []
+        for r in _memo.values():
+            recs.append((dict(r), {k: dict(v) for k, v in
+                                   r["modes"].items()}))
+    out = []
+    for rec, modes in recs:
+        for mode, m in modes.items():
+            out.append({
+                "digest": rec["digest"], "op": rec["op"], "mode": mode,
+                "runs": m["runs"], "device_ns": m["device_ns"],
+                "rows": m["rows"],
+                "last_mode": rec["last_mode"],
+                "last_groups": rec["last_groups"],
+                "max_groups": rec["max_groups"],
+                "last_seen": rec["last_seen"],
+            })
+    out.sort(key=lambda r: (-r["device_ns"], r["digest"], r["op"]))
+    return out
+
+
+def memo_reset() -> None:
+    with _memo_lock:
+        _memo.clear()
+
+
 def reset() -> None:
     """Test hook."""
     global _event_seq
@@ -272,3 +358,4 @@ def reset() -> None:
         _current.clear()
         _summary.clear()
         _event_seq = 0
+    memo_reset()

@@ -201,8 +201,10 @@ class Planner:
     _MEMTABLES = ("schemata", "tables", "columns", "statistics",
                   "character_sets", "collations", "memory_usage",
                   "statement_traces", "resource_usage",
+                  "kernel_profile", "statement_profile",
                   "cluster_members", "cluster_processlist",
-                  "cluster_resource_usage", "cluster_statement_traces")
+                  "cluster_resource_usage", "cluster_statement_traces",
+                  "cluster_kernel_profile")
 
     def _build_memtable(self, ts: ast.TableSource) -> ph.PhysValues:
         """Serve catalog metadata as constant rows computed from the
@@ -366,6 +368,61 @@ class Planner:
             # the ring moves per statement with no schema-version bump
             pv.cacheable = False
             return pv
+        if name == "kernel_profile":
+            # the kernel profiling plane (profiler.py): one row per
+            # (kernel family, plan fingerprint, mesh) — compile cost and
+            # cache attribution, dispatch/byte totals, and where the
+            # kernel sits against the platform's memory roofline
+            from tidb_tpu import profiler
+            from tidb_tpu.sqltypes import new_double_field
+            df = new_double_field()
+            rows = []
+            for p in profiler.snapshot():
+                rows.append((p["family"], p["fingerprint"], p["mesh"],
+                             p["generation"], p["compiles"],
+                             p["compile_ns"], p["compile_cache"],
+                             p["pcache_hits"], p["pcache_misses"],
+                             p["reuses"], p["dispatches"], p["busy_ns"],
+                             p["bytes_in"], p["bytes_out"],
+                             p["bytes_encoded"],
+                             p["bytes_decoded_equiv"],
+                             p["escalations"], p["fallbacks"],
+                             p["achieved_gbps"],
+                             p["roofline_fraction"]))
+            pv = mk([("family", sf), ("fingerprint", sf), ("mesh", sf),
+                     ("generation", intf), ("compiles", intf),
+                     ("compile_ns", intf), ("compile_cache", sf),
+                     ("pcache_hits", intf), ("pcache_misses", intf),
+                     ("reuses", intf), ("dispatches", intf),
+                     ("busy_ns", intf), ("bytes_in", intf),
+                     ("bytes_out", intf), ("bytes_encoded", intf),
+                     ("bytes_decoded_equiv", intf),
+                     ("escalations", intf), ("fallbacks", intf),
+                     ("achieved_gbps", df),
+                     ("roofline_fraction", df)], rows)
+            # profile rows move per dispatch with no schema-version
+            # bump: a cached plan would serve a frozen snapshot forever
+            pv.cacheable = False
+            return pv
+        if name == "statement_profile":
+            # the per-digest mode-history memo (perfschema.py): which
+            # execution mode each operator of each digest actually ran,
+            # with observed group cardinality and per-mode device time —
+            # the read side for feedback-driven mode selection
+            from tidb_tpu import perfschema
+            rows = []
+            for r in perfschema.memo_snapshot():
+                rows.append((r["digest"], r["op"], r["mode"], r["runs"],
+                             r["device_ns"], r["rows"], r["last_mode"],
+                             r["last_groups"], r["max_groups"],
+                             int(r["last_seen"] * 1e6)))
+            pv = mk([("digest", sf), ("op", sf), ("mode", sf),
+                     ("runs", intf), ("device_ns", intf),
+                     ("rows", intf), ("last_mode", sf),
+                     ("last_groups", intf), ("max_groups", intf),
+                     ("last_seen_us", intf)], rows)
+            pv.cacheable = False
+            return pv
         if name == "collations":
             rows = [("utf8mb4_bin", "utf8mb4", 46, "", "Yes", 1),
                     ("utf8mb4_general_ci", "utf8mb4", 45, "Yes", "Yes", 1),
@@ -498,6 +555,34 @@ class Planner:
                        ("admission_wait_ns", intf),
                        ("rows_sent", intf), ("bytes_encoded", intf),
                        ("bytes_decoded_equiv", intf)], rows)
+        if name == "cluster_kernel_profile":
+            # fleet-wide kernel profiles: every member's registry rows
+            # with the member id prefixed — the per-mesh keying makes a
+            # 1-chip member and an 8-chip member distinguishable even
+            # for the same plan fingerprint
+            from tidb_tpu.sqltypes import new_double_field
+            df = new_double_field()
+            rows = []
+            for mid, doc in sorted(docs.items()):
+                for p in doc.get("kernel_profile", ()):
+                    rows.append((mid, p["family"], p["fingerprint"],
+                                 p["mesh"], p["generation"],
+                                 p["compiles"], p["compile_ns"],
+                                 p["compile_cache"], p["reuses"],
+                                 p["dispatches"], p["busy_ns"],
+                                 p["bytes_in"], p["bytes_out"],
+                                 p["escalations"], p["fallbacks"],
+                                 p["achieved_gbps"],
+                                 p["roofline_fraction"]))
+            return mk([("member", sf), ("family", sf),
+                       ("fingerprint", sf), ("mesh", sf),
+                       ("generation", intf), ("compiles", intf),
+                       ("compile_ns", intf), ("compile_cache", sf),
+                       ("reuses", intf), ("dispatches", intf),
+                       ("busy_ns", intf), ("bytes_in", intf),
+                       ("bytes_out", intf), ("escalations", intf),
+                       ("fallbacks", intf), ("achieved_gbps", df),
+                       ("roofline_fraction", df)], rows)
         # cluster_statement_traces: every member's retained trace ring,
         # with the origin stamps that stitch a store-plane record back
         # to the fleet trace id of the SQL member that issued it

@@ -32,7 +32,8 @@ __all__ = ["OpStats", "StatsCollector", "collecting", "current",
            "instrument", "device_call", "device_section", "fmt_ns",
            "fmt_bytes", "note_superchunk", "note_pipeline_stall",
            "note_finalize_wait", "note_fallback", "note_encoding",
-           "note_bytes_touched", "device_watermark"]
+           "note_bytes_touched", "note_kernel", "note_mode",
+           "device_watermark"]
 
 _tl = threading.local()
 
@@ -70,7 +71,9 @@ class OpStats:
                  "device_time_ns", "cop_tasks",
                  "superchunks", "coalesced_chunks", "superchunk_fill_rows",
                  "superchunk_bucket_rows", "pipeline_stall_ns",
-                 "fallbacks", "encoding")
+                 "fallbacks", "encoding", "kernel_family",
+                 "kernel_compile", "kernel_bytes", "kernel_busy_ns",
+                 "kernel_dispatches", "mode")
 
     def __init__(self, name: str):
         self.name = name
@@ -94,6 +97,22 @@ class OpStats:
         # ANALYZE pipeline column): "" = nothing noted, else one of
         # encoded | decoded | direct-agg | fused:<fragment>
         self.encoding = ""
+        # kernel-profile feed (tidb_tpu/profiler.py, EXPLAIN ANALYZE
+        # `kernel` column): which kernel family served this operator's
+        # dispatches this statement, how its compile was satisfied
+        # (hit|miss|cached, the persistent-cache attribution) and the
+        # bytes/busy-ns this statement's dispatches contributed — the
+        # per-statement slice of the process-wide profile row, from
+        # which the online roofline_fraction is rendered
+        self.kernel_family = ""
+        self.kernel_compile = ""
+        self.kernel_bytes = 0
+        self.kernel_busy_ns = 0
+        self.kernel_dispatches = 0
+        # execution mode that actually ran (the perfschema mode-history
+        # memo's vocabulary): "" = nothing noted, else one of
+        # direct | hash | sort | fused | hybrid | host
+        self.mode = ""
 
     def fill_ratio(self) -> float:
         """Live rows over padded bucket rows (0.0 when no superchunks)."""
@@ -112,7 +131,13 @@ class OpStats:
                 "superchunk_bucket_rows": self.superchunk_bucket_rows,
                 "pipeline_stall_ns": self.pipeline_stall_ns,
                 "fallbacks": self.fallbacks,
-                "encoding": self.encoding}
+                "encoding": self.encoding,
+                "kernel_family": self.kernel_family,
+                "kernel_compile": self.kernel_compile,
+                "kernel_bytes": self.kernel_bytes,
+                "kernel_busy_ns": self.kernel_busy_ns,
+                "kernel_dispatches": self.kernel_dispatches,
+                "mode": self.mode}
 
 
 class StatsCollector:
@@ -199,6 +224,28 @@ class StatsCollector:
         with self._lock:
             st.encoding = mode
 
+    def note_kernel(self, plan, family: str, compile_src: str,
+                    nbytes: int, busy_ns: int) -> None:
+        """Fold one kernel dispatch's profile slice onto the operator
+        (EXPLAIN ANALYZE `kernel` column + the slow log's roofline
+        line). May arrive from cop pool workers, hence the lock."""
+        st = self.node(plan)
+        with self._lock:
+            st.kernel_family = family
+            if compile_src:
+                st.kernel_compile = compile_src
+            st.kernel_bytes += nbytes
+            st.kernel_busy_ns += busy_ns
+            st.kernel_dispatches += 1
+
+    def note_mode(self, plan, mode: str) -> None:
+        """Record the execution mode that actually ran (direct / hash /
+        sort / fused / hybrid / host) — the perfschema mode-history
+        memo's per-operator feed."""
+        st = self.node(plan)
+        with self._lock:
+            st.mode = mode
+
     def ops(self) -> list[OpStats]:
         """Distinct OpStats (aliases deduped), insertion order."""
         sealed = getattr(self, "_sealed_ops", None)
@@ -257,6 +304,26 @@ def note_encoding(plan, mode: str) -> None:
     coll = getattr(_tl, "coll", None)
     if coll is not None and plan is not None:
         coll.note_encoding(plan, mode)
+
+
+def note_kernel(plan, family: str, compile_src: str, nbytes: int,
+                busy_ns: int) -> None:
+    """Record a kernel dispatch's profile slice against the active
+    collector (no-op without one) — called from profiler.note_dispatch
+    so every instrumented seam feeds both the process-wide registry row
+    and the statement's per-operator view with one call."""
+    coll = getattr(_tl, "coll", None)
+    if coll is not None and plan is not None:
+        coll.note_kernel(plan, family, compile_src, nbytes, busy_ns)
+
+
+def note_mode(plan, mode: str) -> None:
+    """Record the operator's actually-run execution mode against the
+    active collector (no-op without one): the memo's vocabulary
+    (direct | hash | sort | fused | hybrid | host)."""
+    coll = getattr(_tl, "coll", None)
+    if coll is not None and plan is not None:
+        coll.note_mode(plan, mode)
 
 
 def note_bytes_touched(decoded_equiv: int, encoded: int) -> None:

@@ -124,13 +124,22 @@ class DeviceScheduler:
     (devplane.ndev() > 1) `tidb_tpu_sched_inflight` is a PER-CHIP
     depth — total capacity scales to inflight × ndev — and every grant
     places its dispatch on the least-loaded chip (fewest slots held,
-    then least accumulated busy time). Releases attribute the slot's
-    hold interval to the chip's busy ledger, so placement is
-    utilization-driven: replicated HBM point lookups land on whichever
-    chip is idlest while a sharded analytic scan occupies one slot per
-    in-flight superchunk across the rotation. On a 1-device plane every
+    then least RECENT busy time: a half-life-decayed EWMA of the
+    attributed hold intervals, so a chip that absorbed a heavy scan an
+    hour ago competes as an equal once the work drains instead of
+    being penalized by its cumulative ledger forever). Releases
+    attribute the slot's hold interval to both the cumulative busy
+    ledger (the metrics-history sampler and serve bench derive
+    utilization from its deltas — those must stay monotone) and the
+    decayed one (the placement signal). On a 1-device plane every
     counter collapses to chip 0 and behavior is exactly the
     single-device scheduler."""
+
+    # placement half-life: busy time stops mattering once it is a few
+    # multiples of this old. 30s spans many statements (so placement
+    # is not noise-driven) while forgetting last-minute history fast
+    # enough that a drained chip rejoins the rotation promptly.
+    EWMA_HALFLIFE_S = 30.0
 
     def __init__(self):
         self._cv = threading.Condition()
@@ -143,6 +152,10 @@ class DeviceScheduler:
         self._chip_granted: dict = {}      # guarded-by: _cv  chip -> held
         self._chip_grants: dict = {}       # guarded-by: _cv  chip -> total
         self._chip_busy_ns: dict = {}      # guarded-by: _cv  chip -> ns
+        # chip -> decayed busy ns (the placement signal); decayed in
+        # place against _ewma_t whenever placement or release reads it
+        self._chip_busy_ewma: dict = {}    # guarded-by: _cv
+        self._ewma_t = time.monotonic()    # guarded-by: _cv
 
     # -- capacity ------------------------------------------------------------
 
@@ -164,16 +177,32 @@ class DeviceScheduler:
         cap = config.sched_inflight_bytes()
         return cap <= 0 or memtrack.SERVER.device < cap
 
+    def _decay_ewma_locked(self, now: float | None = None) -> None:
+        """Fold elapsed time into the decayed busy ledgers (under _cv).
+        Exponential decay is time-composable, so decaying lazily at
+        read/update points is exact — no background timer needed."""
+        if now is None:
+            now = time.monotonic()
+        dt = now - self._ewma_t
+        if dt <= 0:
+            return
+        self._ewma_t = now
+        f = 0.5 ** (dt / self.EWMA_HALFLIFE_S)
+        for c in self._chip_busy_ewma:
+            self._chip_busy_ewma[c] *= f
+
     def _pick_chip_locked(self) -> int:
         """Least-loaded chip of the plane: fewest held slots, then
-        least accumulated busy time (ties break to the lowest index).
-        Called under _cv at grant time."""
+        least RECENT busy time — the decayed EWMA, not the cumulative
+        ledger (ties break to the lowest index). Called under _cv at
+        grant time."""
         n = devplane.ndev()
         if n <= 1:
             return 0
+        self._decay_ewma_locked()
         return min(range(n),
                    key=lambda c: (self._chip_granted.get(c, 0),
-                                  self._chip_busy_ns.get(c, 0), c))
+                                  self._chip_busy_ewma.get(c, 0.0), c))
 
     # -- acquire / release ---------------------------------------------------
 
@@ -246,11 +275,14 @@ class DeviceScheduler:
             held = self._chip_granted.get(slot.chip, 0)
             self._chip_granted[slot.chip] = max(held - 1, 0)
             # the hold interval (dispatch through finalize) IS the
-            # chip's attributed busy time — the placement signal and
-            # the serve bench's per-chip balance figure
+            # chip's attributed busy time — cumulative for the sampler
+            # and serve bench (monotone deltas), decayed for placement
+            held_ns = max(now - slot.t_grant, 0)
             self._chip_busy_ns[slot.chip] = \
-                self._chip_busy_ns.get(slot.chip, 0) + \
-                max(now - slot.t_grant, 0)
+                self._chip_busy_ns.get(slot.chip, 0) + held_ns
+            self._decay_ewma_locked()
+            self._chip_busy_ewma[slot.chip] = \
+                self._chip_busy_ewma.get(slot.chip, 0.0) + held_ns
             self._grant_locked()
 
     # -- grant machinery (all under _cv) -------------------------------------
@@ -317,20 +349,22 @@ class DeviceScheduler:
                     "chips": self._chip_snapshot_locked()}
 
     def _chip_snapshot_locked(self) -> dict:
-        chips = {}
-        for c in range(devplane.ndev()):
-            chips[c] = {"inflight": self._chip_granted.get(c, 0),
-                        "grants": self._chip_grants.get(c, 0),
-                        "busy_seconds": round(
-                            self._chip_busy_ns.get(c, 0) / 1e9, 6)}
+        self._decay_ewma_locked()
+
+        def one(c: int) -> dict:
+            return {"inflight": self._chip_granted.get(c, 0),
+                    "grants": self._chip_grants.get(c, 0),
+                    "busy_seconds": round(
+                        self._chip_busy_ns.get(c, 0) / 1e9, 6),
+                    "busy_ewma_seconds": round(
+                        self._chip_busy_ewma.get(c, 0.0) / 1e9, 6)}
+
+        chips = {c: one(c) for c in range(devplane.ndev())}
         # chips that held slots under a since-shrunk plane keep their
         # history visible (the busy figures still explain past samples)
         for c in self._chip_grants:
             if c not in chips:
-                chips[c] = {"inflight": self._chip_granted.get(c, 0),
-                            "grants": self._chip_grants.get(c, 0),
-                            "busy_seconds": round(
-                                self._chip_busy_ns.get(c, 0) / 1e9, 6)}
+                chips[c] = one(c)
         return chips
 
     def chip_busy_ns(self) -> dict:
@@ -755,14 +789,21 @@ class device_slot:
     The whole guarded section runs under the dispatch watchdog: a sync
     kernel call past `tidb_tpu_dispatch_timeout_ms` surfaces the
     retryable device-fault error AFTER the slot (and, one context
-    inward, the memtrack.device_scope ledger bytes) released."""
+    inward, the memtrack.device_scope ledger bytes) released.
 
-    __slots__ = ("_slot", "_wtok", "_busy")
+    With `profile` set (a profiler.KernelProfile), the guarded hold
+    interval records as one dispatch on that profile row on SUCCESS —
+    the device_slot seam of the kernel profiling plane, for sync sites
+    that are not already inside a profiler.dispatch_section."""
 
-    def __init__(self):
+    __slots__ = ("_slot", "_wtok", "_busy", "_prof", "_t0")
+
+    def __init__(self, profile=None):
         self._slot = None
         self._wtok = None
         self._busy = None
+        self._prof = profile
+        self._t0 = 0
 
     @property
     def chip(self) -> int:
@@ -787,6 +828,7 @@ class device_slot:
             # cannot double-count the same wall time
             meter.note_slot_wait(time.perf_counter_ns() - t0)
             self._busy = meter.busy_section().__enter__()
+            self._t0 = time.perf_counter_ns()
         except BaseException:
             # anything that raises after a successful acquire (the
             # meter bookkeeping above is new code in this window) must
@@ -801,6 +843,10 @@ class device_slot:
     def __exit__(self, exc_type, exc, tb):
         _SCHEDULER.release(self._slot)
         self._slot = None
+        if self._prof is not None and exc_type is None:
+            from tidb_tpu import profiler
+            profiler.note_dispatch(
+                self._prof, time.perf_counter_ns() - self._t0)
         if self._busy is not None:
             # busy even on an error path: the device (attempt) really
             # occupied this interval

@@ -123,7 +123,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self.wfile.write(body)
                 return
             if self.path in ("/", "/status"):
-                from tidb_tpu import member, sched
+                from tidb_tpu import member, profiler, sched
+                from tidb_tpu.util import compile_cache
                 self._json({
                     "version": __version__,
                     "member": member.identity(),
@@ -131,6 +132,8 @@ class _Handler(BaseHTTPRequestHandler):
                                                "_conns", ())),
                     "regions": len(_all_regions(st)),
                     "serving": sched.stats(),
+                    "compile_cache": compile_cache.counters(),
+                    "kernel_profile": profiler.stats(),
                     "metrics": metrics.snapshot(),
                 })
                 return
@@ -169,6 +172,23 @@ class _Handler(BaseHTTPRequestHandler):
                                 if local is not None else None,
                                 "errors": errors}, code)
                     return
+            if self.path == "/profile":
+                # the kernel profiling plane (profiler.py): per-kernel
+                # compile/dispatch/roofline rows, the compile-cache
+                # counters they attribute against, the per-digest
+                # mode-history memo, and the platform roofline estimate
+                # the fractions are normalized by
+                from tidb_tpu import perfschema, profiler
+                from tidb_tpu.util import compile_cache
+                gbps, src = profiler.platform_peak_gbps()
+                self._json({
+                    "stats": profiler.stats(),
+                    "kernel_profile": profiler.snapshot(),
+                    "compile_cache": compile_cache.counters(),
+                    "statement_profile": perfschema.memo_snapshot(),
+                    "roofline": {"peak_gbps": gbps, "source": src},
+                })
+                return
             if self.path == "/failpoint":
                 # the failpoint registry + armed state (POST arms)
                 from tidb_tpu.util import failpoint

@@ -600,6 +600,12 @@ class Session:
                 tag=None if batch_no is None
                 else f"stmt#{batch_no}:{kind}",
                 trace_id=trace_id)
+            # mode-history memo: record what each operator *actually*
+            # ran (direct/hash/sort/fused/hybrid/host) keyed by digest —
+            # the read side for feedback-driven mode selection
+            if config.kernel_profile():
+                perfschema.memo_record(
+                    digest, [s.to_dict() for s in ops if s.mode])
             # rows served + statement count land on the meter here (the
             # one place the row count is known), then the statement's
             # metered totals fold into the per-digest rollup /top ranks
@@ -682,6 +688,7 @@ class Session:
                     lines.append("# Plan: " + ln)
             except Exception:  # noqa: BLE001 - logging must not fail stmts
                 pass
+        kb = kns = 0
         for s in ops:
             if not s.loops and not s.time_ns:
                 continue
@@ -695,7 +702,27 @@ class Session:
                 ln += (f" superchunks={s.superchunks}"
                        f" fill={s.fill_ratio():.2f}"
                        f" stall={rs.fmt_ns(s.pipeline_stall_ns)}")
+            if s.kernel_family:
+                ln += f" kernel={s.kernel_family}"
+                if s.kernel_compile:
+                    ln += f" compile={s.kernel_compile}"
+                if s.mode:
+                    ln += f" mode={s.mode}"
+                kb += s.kernel_bytes
+                kns += s.kernel_busy_ns
             lines.append(ln)
+        if kns:
+            # statement-level roofline: all kernel bytes over all kernel
+            # busy time vs the platform's memory-bandwidth peak
+            from tidb_tpu import profiler
+            g = profiler.achieved_gbps(kb, kns)
+            if g is not None:
+                frac = profiler.roofline_fraction(kb, kns)
+                ln = f"# Kernel: bytes={rs.fmt_bytes(kb)} " \
+                     f"busy={rs.fmt_ns(kns)} achieved={g:.2f}GB/s"
+                if frac is not None:
+                    ln += f" roofline={frac:.3f}"
+                lines.append(ln)
         lines.append("# SQL: " + sql[:2048])
         return "\n".join(lines)
 
@@ -2345,15 +2372,16 @@ class Session:
             est = "" if node.est_rows is None else f"{node.est_rows:.0f}"
             if st is None:
                 rows.append(("  " * depth + node.explain_line(), est,
-                             0, 0, "-", "-", mem, 0, "-"))
+                             0, 0, "-", "-", mem, 0, "-", "-"))
                 continue
             rows.append((
                 "  " * depth + node.explain_line(), est,
                 st.act_rows, st.loops, rs.fmt_ns(st.time_ns),
                 rs.fmt_ns(st.device_time_ns) if device else "-",
-                mem, st.cop_tasks, _fmt_pipeline(st)))
+                mem, st.cop_tasks, _fmt_pipeline(st), _fmt_kernel(st)))
         return ResultSet(["id", "est_rows", "act_rows", "loops", "time",
-                          "device_time", "mem", "cop_tasks", "pipeline"],
+                          "device_time", "mem", "cop_tasks", "pipeline",
+                          "kernel"],
                          rows)
 
 
@@ -2375,6 +2403,30 @@ def _fmt_pipeline(st) -> str:
     return (f"{st.superchunks}sc/{st.coalesced_chunks}ch "
             f"fill={st.fill_ratio():.2f} "
             f"stall={rs.fmt_ns(st.pipeline_stall_ns)}{fb}{enc}")
+
+
+def _fmt_kernel(st) -> str:
+    """EXPLAIN ANALYZE `kernel` cell: which kernel family served the
+    operator, whether this statement paid a compile (miss) or rode the
+    in-process (cached) / persistent (hit) compile cache, the achieved
+    memory bandwidth, and where that sits against the platform's memory
+    roofline — e.g. `hashagg compile=cached 12.3GB/s roof=0.18`."""
+    if not st.kernel_family or not st.kernel_dispatches:
+        return "-"
+    from tidb_tpu import profiler
+    s = st.kernel_family
+    if st.kernel_compile:
+        s += f" compile={st.kernel_compile}"
+    if st.mode:
+        s += f" mode={st.mode}"
+    g = profiler.achieved_gbps(st.kernel_bytes, st.kernel_busy_ns)
+    if g is not None:
+        s += f" {g:.1f}GB/s"
+        frac = profiler.roofline_fraction(st.kernel_bytes,
+                                          st.kernel_busy_ns)
+        if frac is not None:
+            s += f" roof={frac:.2f}"
+    return s
 
 
 @dataclass

@@ -15,7 +15,7 @@ import threading
 from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 
-from tidb_tpu import (config, devplane, kv, memtrack, meter,
+from tidb_tpu import (config, devplane, kv, memtrack, meter, profiler,
                       runtime_stats, sched, tablecodec, trace)
 from tidb_tpu.kv import (CopRequest, CopResponse, KVRange, NotLeaderError,
                          RegionError, ReqType, ServerBusyError,
@@ -176,8 +176,15 @@ def _encoded_agg(plan: CopPlan, chunk, sources: int,
             # (pad/transfer/jit dispatch) vs the blocking readback —
             # the same per-superchunk pair the pipelined paths record.
             # Device timing covers BOTH halves, success-only — exactly
-            # the interval device_call used to measure here
-            with runtime_stats.device_section(plan, errors=False):
+            # the interval device_call used to measure here (the
+            # kernel-profile section shares the success-only contract:
+            # a capacity miss's wall must not bill the profile row the
+            # decoded retry will bill again)
+            with runtime_stats.device_section(plan, errors=False), \
+                    profiler.dispatch_section(
+                        profiler.profile_of(k), nbytes=nbytes,
+                        encoded=moved,
+                        decoded=memtrack.chunk_bytes(chunk), plan=plan):
                 with trace.span("dispatch", rows=chunk.num_rows,
                                 chip=slot.chip):
                     pending = k.dispatch(chunk, dev_cols=dev_cols)
@@ -197,7 +204,10 @@ def _encoded_agg(plan: CopPlan, chunk, sources: int,
         # the decoded retry re-runs with the ORIGINAL filter tree (the
         # code-space one is device-only) and records its own outcome
         return None
-    runtime_stats.note_encoding(plan, _agg_mode(plan, k))
+    mode = _agg_mode(plan, k)
+    runtime_stats.note_encoding(plan, mode)
+    runtime_stats.note_mode(
+        plan, "direct" if mode == "direct-agg" else "hash")
     runtime_stats.note_bytes_touched(memtrack.chunk_bytes(chunk), moved)
     if config.superchunk_rows():
         runtime_stats.note_superchunk(
@@ -288,7 +298,12 @@ def exec_cop_plan(plan: CopPlan, chunk, sources: int = 1,
                         devplane.chip_scope(slot.chip), \
                         memtrack.device_scope(plan, nbytes), \
                         runtime_stats.device_section(plan,
-                                                     errors=False):
+                                                     errors=False), \
+                        profiler.dispatch_section(
+                            profiler.profile_of(k), nbytes=nbytes,
+                            encoded=moved,
+                            decoded=memtrack.chunk_bytes(chunk),
+                            plan=plan):
                     with trace.span("dispatch", rows=chunk.num_rows,
                                     chip=slot.chip):
                         pending = k.dispatch(chunk, dev_cols=dev_cols)
@@ -301,6 +316,9 @@ def exec_cop_plan(plan: CopPlan, chunk, sources: int = 1,
                 sched.device_health().note_ok()
                 if plan.host_filter is None:
                     runtime_stats.note_encoding(plan, _agg_mode(plan, k))
+                runtime_stats.note_mode(
+                    plan, "direct" if _agg_mode(plan, k) == "direct-agg"
+                    else "hash")
                 runtime_stats.note_bytes_touched(
                     memtrack.chunk_bytes(chunk), moved)
                 if config.superchunk_rows():
@@ -332,6 +350,8 @@ def exec_cop_plan(plan: CopPlan, chunk, sources: int = 1,
                     continue
                 sched.degrade_statement()
                 runtime_stats.note_fallback(plan, "fault")
+                profiler.note_kernel_fallback(profiler.profile_of(k),
+                                              "fault")
                 break
             except (CapacityError, CollisionError) as e:
                 if plan.group_exprs:
@@ -339,12 +359,16 @@ def exec_cop_plan(plan: CopPlan, chunk, sources: int = 1,
                     # per radix partition (ops/hybrid.py) — the device
                     # is abandoned per PARTITION, never per operator
                     from tidb_tpu.ops.hybrid import agg_retry
+                    profiler.note_escalation(profiler.profile_of(k))
+                    runtime_stats.note_mode(plan, "hybrid")
                     return CopResponse(chunk=agg_retry(
                         chunk, plan.filter, plan.group_exprs, plan.aggs,
                         plan, e))
-                runtime_stats.note_fallback(
-                    plan, "collision" if isinstance(e, CollisionError)
-                    else "capacity")
+                reason = "collision" if isinstance(e, CollisionError) \
+                    else "capacity"
+                runtime_stats.note_fallback(plan, reason)
+                profiler.note_kernel_fallback(profiler.profile_of(k),
+                                              reason)
                 break
             except (DeviceRejectError, NotImplementedError):
                 # designed rejection (not device-safe). A bare
@@ -353,6 +377,7 @@ def exec_cop_plan(plan: CopPlan, chunk, sources: int = 1,
                 runtime_stats.note_fallback(plan, "unsupported")
                 break
         runtime_stats.note_encoding(plan, "decoded")
+        runtime_stats.note_mode(plan, "host")
         # host-path agg time is its own attribution phase: with the
         # device degraded/quarantined (or plain host mode) THIS is
         # where the statement's microseconds go — on the trace AND on

@@ -16,9 +16,9 @@ from __future__ import annotations
 import os
 import threading
 
-__all__ = ["enable", "default_dir", "stats", "reset_counters",
-           "cpu_feature_tag", "scoped_cpu_dir", "plane_tag",
-           "scoped_plane_dir"]
+__all__ = ["enable", "default_dir", "stats", "counters",
+           "reset_counters", "cpu_feature_tag", "scoped_cpu_dir",
+           "plane_tag", "scoped_plane_dir"]
 
 _lock = threading.Lock()
 _counts = {"hits": 0, "misses": 0}
@@ -130,11 +130,26 @@ def _install_listener() -> None:
     def _on_event(event: str, **_kw) -> None:
         if not event.startswith("/jax/compilation_cache/"):
             return
+        hit = event.endswith("cache_hits")
+        miss = event.endswith("cache_misses")
+        if not (hit or miss):
+            return
         with _lock:
-            if event.endswith("cache_hits"):
+            if hit:
                 _counts["hits"] += 1
-            elif event.endswith("cache_misses"):
+            else:
                 _counts["misses"] += 1
+        # promote to first-class /metrics families (BENCH-json-only
+        # before): lazy import — this module must load without the
+        # package (bench.py imports it before configuring jax)
+        try:
+            from tidb_tpu import metrics
+            if hit:
+                metrics.counter(metrics.COMPILE_CACHE_HITS)
+            else:
+                metrics.counter(metrics.COMPILE_CACHE_MISSES)
+        except Exception:  # noqa: BLE001 - counters must never raise
+            pass
 
     try:
         monitoring.register_event_listener(_on_event)
@@ -187,6 +202,14 @@ def stats() -> dict:
     with _lock:
         return {"dir": cur, "entries": entries,
                 "hits": _counts["hits"], "misses": _counts["misses"]}
+
+
+def counters() -> dict:
+    """Just the hit/miss counts — no directory listing. The profiler
+    diffs these around a kernel's compile dispatch to attribute it
+    hit|miss|cached; stats() costs a listdir and stays off hot paths."""
+    with _lock:
+        return {"hits": _counts["hits"], "misses": _counts["misses"]}
 
 
 def reset_counters() -> None:

@@ -105,6 +105,13 @@ REGISTRY: dict[str, str] = {
     "cluster/fetch": "util/statusclient.py _fetch_one — before each "
                      "per-member fetch of the cluster_* / /fleet/* "
                      "fan-out",
+    # kernel-profile registry record fold, before each completed
+    # dispatch is folded into its profile row: args (family,). Lets
+    # tests fault/delay exactly the profiler's own bookkeeping without
+    # touching the kernel dispatch it shadows.
+    "profiler/record": "profiler.KernelProfileRegistry.record_dispatch "
+                       "— before a completed dispatch folds into its "
+                       "profile row",
 }
 
 
