@@ -1770,6 +1770,170 @@ def profile_main() -> None:
         sys.exit(1)
 
 
+def _lintcheck_bench(progress) -> dict:
+    """Static-vs-runtime cross-check (scripts/lint_device_bench.sh):
+    the device dataflow pass (tidb_tpu/lint/flow/device.py) predicts
+    per-family compile behavior from source alone; this leg runs warm
+    Q1/Q3 under kernel profiling and FAILS on drift in either
+    direction — a family the static model does not know (analysis
+    fell behind the runtime), a fingerprinted row compiling more than
+    the predicted bound or any family compiling on warm iterations
+    (runtime fell behind the contract the lint rules enforce), or a
+    non-clean `python -m tidb_tpu.lint --json` run.
+
+    Env knobs: BENCH_LINTCHECK_SF (0.02), BENCH_LINTCHECK_ITERS (2)."""
+    import subprocess
+
+    from tidb_tpu import config, profiler
+    from tidb_tpu.benchmarks import tpch
+    from tidb_tpu.lint.engine import Forest
+    from tidb_tpu.lint.flow.device import device_flow_of
+    from tidb_tpu.parallel import config as mesh_config
+    from tidb_tpu.session import Session
+    from tidb_tpu.store.storage import new_mock_storage
+
+    sf = float(os.environ.get("BENCH_LINTCHECK_SF", "0.02"))
+    iters = int(os.environ.get("BENCH_LINTCHECK_ITERS", "2"))
+    out: dict = {"sf": sf, "iters": iters}
+    failures: list[str] = []
+
+    progress("lintcheck: python -m tidb_tpu.lint --json")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tidb_tpu.lint", "--json"],
+        capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    try:
+        lint = json.loads(proc.stdout)
+    except json.JSONDecodeError:
+        lint = None
+    if lint is None or proc.returncode not in (0, 1):
+        failures.append(f"lint --json did not produce a report "
+                        f"(rc={proc.returncode}): {proc.stderr[-500:]}")
+        lint = {"clean": False, "rules": [], "findings": [],
+                "timing": {}}
+    out["lint_clean"] = lint["clean"]
+    out["lint_rules"] = len(lint["rules"])
+    out["lint_rule_ms"] = lint.get("timing", {}).get("rule_ms", {})
+    if not lint["clean"]:
+        failures.append(
+            f"lint is not clean: {len(lint['findings'])} finding(s), "
+            f"first: {lint['findings'][:3]}")
+
+    progress("lintcheck: static compile predictions")
+    df = device_flow_of(Forest.load())
+    preds = df.compile_predictions()
+    out["predictions"] = preds
+    out["traced_sites"] = len(df.sites)
+    missing_model = sorted(set(profiler.FAMILIES) - set(preds))
+    if missing_model:
+        failures.append(
+            f"static model predicts nothing for profiler families "
+            f"{missing_model} — the device pass fell behind the "
+            f"profiler plane")
+
+    data = tpch.ScaledTpch(sf=sf)
+    storage = new_mock_storage()
+    session = Session(storage)
+    session.execute("CREATE DATABASE tpch_lintcheck")
+    session.execute("USE tpch_lintcheck")
+    progress(f"lintcheck: loading sf={sf}")
+    tpch.load(session, storage, data, regions_per_table=2)
+    queries = {q: tpch.QUERIES[q] for q in ("q1", "q3")}
+
+    saved = config.get_var("tidb_tpu_device")
+    try:
+        config.set_var("tidb_tpu_device", 1)
+        mesh_config.enable_mesh()
+        profiler.reset_for_tests()
+        progress("lintcheck: cold runs (compile + cache fill)")
+        for sql in queries.values():
+            session.query(sql)
+
+        def fam_compiles() -> dict:
+            fams: dict = {}
+            for p in profiler.snapshot():
+                fams[p["family"]] = fams.get(p["family"], 0) + \
+                    p["compiles"]
+            return fams
+
+        cold = fam_compiles()
+        progress(f"lintcheck: {iters} warm iterations per query")
+        for _i in range(iters):
+            for sql in queries.values():
+                session.query(sql)
+        warm = fam_compiles()
+        out["compiles_after_cold"] = cold
+        out["compiles_after_warm"] = warm
+
+        checked = 0
+        for fam, n in sorted(warm.items()):
+            pred = preds.get(fam)
+            if pred is None:
+                failures.append(
+                    f"family {fam!r} compiled {n} unit(s) but the "
+                    f"static model has no prediction for it")
+                continue
+            checked += 1
+            growth = n - cold.get(fam, 0)
+            if growth > pred["warm_growth"]:
+                failures.append(
+                    f"family {fam!r} compiled {growth} unit(s) during "
+                    f"warm iterations (predicted {pred['warm_growth']})")
+        out["families_checked"] = checked
+        if not checked:
+            failures.append("no family compiled anything — the "
+                            "cross-check exercised nothing")
+
+        # per-fingerprint bound: a fingerprint-cached family builds at
+        # most one executable per profile row ("~" rows are explicitly
+        # unfingerprinted and exempt from the bound)
+        over = []
+        for p in profiler.snapshot():
+            bound = (preds.get(p["family"]) or {}).get("per_row_bound")
+            if bound is None or p["fingerprint"].startswith("~"):
+                continue
+            if p["compiles"] > bound:
+                over.append((p["family"], p["fingerprint"][:16],
+                             p["compiles"]))
+        out["rows_over_bound"] = over
+        if over:
+            failures.append(
+                f"fingerprinted rows compiled past the static "
+                f"per-row bound: {over}")
+    finally:
+        config.set_var("tidb_tpu_device", saved)
+        session.close()
+    out["failures"] = failures
+    out["passed"] = not failures
+    return out
+
+
+def lintcheck_main() -> None:
+    """`python bench.py lintcheck`: the static-analysis cross-check
+    leg — CI entry point (scripts/lint_device_bench.sh) with its own
+    one-line JSON; exits non-zero when the static model and the
+    profiler plane disagree (either direction) or lint is not clean."""
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        _scope_cpu_compile_cache()
+    t_start = time.perf_counter()
+
+    def progress(msg: str) -> None:
+        print(f"[lintcheck +{time.perf_counter() - t_start:7.1f}s] "
+              f"{msg}", file=sys.stderr, flush=True)
+
+    detail = _lintcheck_bench(progress)
+    print(json.dumps({
+        "metric": "lintcheck_families_verified",
+        "value": detail.get("families_checked", 0),
+        "unit": "families",
+        "detail": detail,
+    }))
+    if not detail["passed"]:
+        for f in detail["failures"]:
+            print(f"[lintcheck] FAIL: {f}", file=sys.stderr)
+        sys.exit(1)
+
+
 def _parse_cell(x):
     if isinstance(x, (bytes, bytearray)):
         x = x.decode()
@@ -2780,6 +2944,8 @@ if __name__ == "__main__":
         trace_main()
     elif len(sys.argv) > 1 and sys.argv[1] == "profile":
         profile_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "lintcheck":
+        lintcheck_main()
     elif len(sys.argv) > 1 and sys.argv[1] == "multichip":
         multichip_main()
     elif len(sys.argv) > 1 and sys.argv[1] == "multichip-child":

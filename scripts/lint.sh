@@ -2,23 +2,25 @@
 # CI / pre-commit lint gate: the exact rule set tests/test_lint.py runs
 # in-process, invocable standalone (no pytest).
 #
-#   scripts/lint.sh             # human-readable findings + timing
+#   scripts/lint.sh             # findings + per-rule wall time table
 #   scripts/lint.sh --json      # machine-readable (stable schema:
 #                               #   file/line/rule/message findings,
 #                               #   parse-count instrumentation)
-#   scripts/lint.sh --rule lock-order   # any CLI flag passes through
+#   scripts/lint.sh --rule lock-order --rule cache-key
+#                               # any CLI flag passes through; --rule
+#                               # scopes the run (repeatable)
 #
 # Exit codes (the CLI's contract, forwarded verbatim):
 #   0  every rule ran clean
 #   1  findings
 #   2  usage error
 #
-# The report's timing block records wall time for the record, but the
-# single-parse guarantee is asserted on parse COUNTS (timing.parse_calls
-# == files: the engine parsed each package module exactly once, and the
-# rule walks — the flow rules' call graph and lock registry included —
-# added zero parses). Wall time under concurrent CI load is noise; the
-# count is the invariant.
+# Human mode drives the CLI through --json and renders the timing
+# block's per-rule wall times, so the cost of the flow passes (call
+# graph, lock registry, device dataflow) is visible in CI logs. Wall
+# time under concurrent CI load is noise for gating — the single-parse
+# guarantee is asserted on parse COUNTS (timing.parse_calls == files);
+# the table is for the record.
 
 set -u
 
@@ -28,10 +30,36 @@ cd "$(dirname "$0")/.."
 # accelerator so the gate runs identically on CI runners and dev boxes
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
+for arg in "$@"; do
+    case "$arg" in
+        --json|--list-rules|-h|--help)
+            # raw CLI modes: forward verbatim, no reformatting
+            exec python -m tidb_tpu.lint "$@"
+            ;;
+    esac
+done
+
 start_ms=$(python -c 'import time; print(int(time.time() * 1000))')
-python -m tidb_tpu.lint "$@"
+out="$(python -m tidb_tpu.lint --json "$@")"
 code=$?
 end_ms=$(python -c 'import time; print(int(time.time() * 1000))')
+
+LINT_JSON="$out" python - <<'PY'
+import json, os
+
+rep = json.loads(os.environ["LINT_JSON"])
+for f in rep["findings"]:
+    print(f"{f['file']}:{f['line']}: [{f['rule']}] {f['message']}")
+timing = rep["timing"]
+rule_ms = sorted(timing.get("rule_ms", {}).items(), key=lambda kv: -kv[1])
+width = max((len(n) for n, _ in rule_ms), default=0)
+for name, ms in rule_ms:
+    print(f"  {name:<{width}}  {ms:8.1f} ms")
+print(f"{len(rep['rules'])} rule(s) over {rep['files']} files: "
+      f"{len(rep['findings'])} finding(s) in {timing['total_ms']:.0f} ms "
+      f"(parse {timing['parse_ms']:.0f} ms, "
+      f"{timing['parse_calls']} parse calls)")
+PY
 
 echo "lint.sh: exit ${code} in $((end_ms - start_ms)) ms (interpreter + jax import included; the in-engine number above excludes it)" >&2
 exit "${code}"

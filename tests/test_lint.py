@@ -5,10 +5,11 @@ test_lint_sync.py, test_lint_metrics.py, test_lint_memtrack.py), each
 of which re-parsed the whole ~100-module package with its own ad-hoc
 suppression convention. The engine (tidb_tpu/lint) parses the package
 ONCE into a shared forest; every registered rule — the four ported
-invariants, the seven project-specific additions, and the three
-whole-program flow rules (tidb_tpu/lint/flow) — runs over it, and
-each gets its own test id here so a regression names the rule that
-caught it.
+invariants, the twelve project-specific additions, the three
+whole-program flow rules (tidb_tpu/lint/flow), and the three
+device-plane dataflow rules (tidb_tpu/lint/flow/device) — runs over
+it, and each gets its own test id here so a regression names the rule
+that caught it.
 
 The single-parse guarantee is pinned by PARSE COUNTS, not wall time:
 the engine counts every `ast.parse` it performs
@@ -48,8 +49,9 @@ def report():
 
 
 def test_catalog_is_complete():
-    """4 ported + 12 project-specific + 3 whole-program flow rules."""
-    assert len(RULE_NAMES) == 19, RULE_NAMES
+    """4 ported + 12 project-specific + 3 whole-program flow rules
+    + 3 device-plane dataflow rules."""
+    assert len(RULE_NAMES) == 22, RULE_NAMES
     for ported in ("wire-discipline", "hot-path-sync", "metric-names",
                    "memtrack-alloc"):
         assert ported in RULE_NAMES
@@ -61,6 +63,8 @@ def test_catalog_is_complete():
         assert new in RULE_NAMES
     for flow in ("lock-order", "guarded-by", "paired-resource"):
         assert flow in RULE_NAMES
+    for dev in ("donation-safety", "cache-key", "retrace-hazard"):
+        assert dev in RULE_NAMES
 
 
 @pytest.mark.parametrize("rule", RULE_NAMES)

@@ -722,3 +722,243 @@ def test_failpoint_enable_checked_and_tag_suppresses():
                rules=["failpoint-discipline"])
     assert len(rep.findings) == 1
     assert "typo/name" in rep.findings[0].message
+
+
+# -- device-plane dataflow rules (tidb_tpu/lint/flow/device) ----------------
+
+def test_donated_then_read_is_flagged():
+    """A read of the donated buffer after a non-returning dispatch is
+    a read-after-free on hardware that honors donation."""
+    src = ("import jax\n"
+           "from tidb_tpu.ops import runtime\n"
+           "class K:\n"
+           "    def __init__(self):\n"
+           "        self._jitd = None\n"
+           "    def _kernel(self, cols, n):\n"
+           "        return cols\n"
+           "    def dispatch(self, chunk):\n"
+           "        cols, _d = runtime.device_put_chunk(chunk,\n"
+           "                                            memo=False)\n"
+           "        if self._jitd is None:\n"
+           "            self._jitd = jax.jit(self._kernel,\n"
+           "                                 donate_argnums=(0,))\n"
+           "        pending = self._jitd(cols, 4)\n"
+           "        total = cols[0].sum()\n"
+           "        return pending, total\n")
+    rep = lint({OPS_REL: src}, rules=["donation-safety"])
+    assert "donation-safety" in rules_of(rep)
+    assert any("read after" in f.message for f in rep.findings)
+
+
+def test_return_dispatch_with_nondonating_twin_is_sanctioned():
+    """The in-tree ops/hashagg dispatch shape: the donating branch
+    RETURNS at the dispatch, so the non-donating twin on the line
+    after can never see the donated buffer."""
+    src = ("import jax\n"
+           "from tidb_tpu.ops import runtime\n"
+           "class K:\n"
+           "    def __init__(self):\n"
+           "        self._jit = jax.jit(self._kernel)\n"
+           "        self._jitd = None\n"
+           "    def _kernel(self, cols, n):\n"
+           "        return cols\n"
+           "    def dispatch(self, chunk, donate=False):\n"
+           "        cols, _d = runtime.device_put_chunk(\n"
+           "            chunk, memo=not donate)\n"
+           "        if donate:\n"
+           "            if self._jitd is None:\n"
+           "                self._jitd = jax.jit(self._kernel,\n"
+           "                                     donate_argnums=(0,))\n"
+           "            return self._jitd(cols, chunk.num_rows)\n"
+           "        return self._jit(cols, chunk.num_rows)\n")
+    rep = lint({OPS_REL: src}, rules=["donation-safety"])
+    assert rep.findings == []
+
+
+def test_donating_retry_loop_is_flagged():
+    """Re-dispatching a buffer bound OUTSIDE the loop donates freed
+    memory on the second iteration."""
+    src = ("import jax\n"
+           "class K:\n"
+           "    def __init__(self):\n"
+           "        self._jitd = jax.jit(self._kernel,\n"
+           "                             donate_argnums=(0,))\n"
+           "    def _kernel(self, cols, n):\n"
+           "        return cols\n"
+           "    def run(self, cols):\n"
+           "        out = None\n"
+           "        for _ in range(3):\n"
+           "            out = self._jitd(cols, 4)\n"
+           "        return out\n")
+    rep = lint({OPS_REL: src}, rules=["donation-safety"])
+    assert any("retry loop" in f.message for f in rep.findings)
+
+
+def test_nondonating_retry_reuse_is_sanctioned():
+    """The PR 8 overflow-retry shape (ops/join.py): lanes carried on a
+    pending token and re-dispatched through a NON-donating program are
+    not donation hazards, and the program-memo key rides .cap."""
+    src = ("import jax\n"
+           "_PROGRAMS = {}\n"
+           "def _matcher_program(cap):\n"
+           "    prog = _PROGRAMS.get(cap)\n"
+           "    if prog is None:\n"
+           "        def kernel(bk, pk):\n"
+           "            return bk\n"
+           "        prog = jax.jit(kernel)\n"
+           "        _PROGRAMS[cap] = prog\n"
+           "    return prog\n"
+           "def finalize(p):\n"
+           "    res = None\n"
+           "    while res is None:\n"
+           "        res = _matcher_program(p.cap)(p.bk, p.pk)\n"
+           "    return res\n")
+    rep = lint({OPS_REL: src},
+               rules=["donation-safety", "retrace-hazard"])
+    assert rep.findings == []
+
+
+def test_donating_transfer_with_default_memo_is_flagged():
+    """memo=not donate is the contract: a memoized donated buffer is a
+    dangling cache entry."""
+    src = ("import jax\n"
+           "from tidb_tpu.ops import runtime\n"
+           "class K:\n"
+           "    def __init__(self):\n"
+           "        self._jitd = jax.jit(self._kernel,\n"
+           "                             donate_argnums=(0,))\n"
+           "    def _kernel(self, cols, n):\n"
+           "        return cols\n"
+           "    def dispatch(self, chunk):\n"
+           "        cols, _d = runtime.device_put_chunk(chunk)\n"
+           "        return self._jitd(cols, chunk.num_rows)\n")
+    rep = lint({OPS_REL: src}, rules=["donation-safety"])
+    assert any("memo" in f.message for f in rep.findings)
+
+
+def test_config_read_not_in_fingerprint_is_flagged():
+    """A config read inside a traced body and a ctor arg missing from
+    the cache key are both stale-executable bugs."""
+    src = ("import jax\n"
+           "from tidb_tpu.ops import runtime\n"
+           "from tidb_tpu import config, devplane\n"
+           "class K:\n"
+           "    def __init__(self, exprs, width):\n"
+           "        self.exprs = exprs\n"
+           "        self.width = width\n"
+           "        self._jit = jax.jit(self._kernel)\n"
+           "    def _kernel(self, cols, n):\n"
+           "        lim = config.direct_agg_slots()\n"
+           "        return (cols, self.width, lim)\n"
+           "_KERNELS = runtime.FingerprintCache(8)\n"
+           "def kernel_for(exprs, width):\n"
+           "    fp = runtime.plan_fingerprint(None, exprs, [])\n"
+           "    key = (fp, devplane.mesh_fingerprint(process=True))\n"
+           "    def make():\n"
+           "        return K(exprs, width)\n"
+           "    return _KERNELS.get_or_create(key, make)\n")
+    rep = lint({OPS_REL: src}, rules=["cache-key"])
+    msgs = [f.message for f in rep.findings]
+    assert any("config.direct_agg_slots" in m for m in msgs)
+    assert any("width" in m and "not folded" in m for m in msgs)
+
+
+def test_complete_cache_key_is_clean():
+    """Folding every ctor arg and the mesh fingerprint into the key
+    satisfies the completeness check."""
+    src = ("import jax\n"
+           "from tidb_tpu.ops import runtime\n"
+           "from tidb_tpu import devplane\n"
+           "class K:\n"
+           "    def __init__(self, exprs, width):\n"
+           "        self.exprs = exprs\n"
+           "        self.width = width\n"
+           "        self._jit = jax.jit(self._kernel)\n"
+           "    def _kernel(self, cols, n):\n"
+           "        return (cols, self.width)\n"
+           "_KERNELS = runtime.FingerprintCache(8)\n"
+           "def kernel_for(exprs, width):\n"
+           "    fp = runtime.plan_fingerprint(None, exprs, [])\n"
+           "    key = (fp, width,\n"
+           "           devplane.mesh_fingerprint(process=True))\n"
+           "    def make():\n"
+           "        return K(exprs, width)\n"
+           "    return _KERNELS.get_or_create(key, make)\n")
+    rep = lint({OPS_REL: src}, rules=["cache-key"])
+    assert rep.findings == []
+
+
+def test_cache_key_without_mesh_fingerprint_is_flagged():
+    src = ("import jax\n"
+           "from tidb_tpu.ops import runtime\n"
+           "class K:\n"
+           "    def __init__(self, exprs):\n"
+           "        self.exprs = exprs\n"
+           "        self._jit = jax.jit(self._kernel)\n"
+           "    def _kernel(self, cols, n):\n"
+           "        return cols\n"
+           "_KERNELS = runtime.FingerprintCache(8)\n"
+           "def kernel_for(exprs):\n"
+           "    fp = runtime.plan_fingerprint(None, exprs, [])\n"
+           "    def make():\n"
+           "        return K(exprs)\n"
+           "    return _KERNELS.get_or_create((fp,), make)\n")
+    rep = lint({OPS_REL: src}, rules=["cache-key"])
+    assert any("mesh_fingerprint" in f.message for f in rep.findings)
+
+
+def test_bucketed_jit_dict_is_sanctioned():
+    """The meshjoin._stage2_jits[bucket] shape: a program memo keyed by
+    a pow2 bucket is bounded, and the dispatch function's shaper call
+    sanctions its operands."""
+    src = ("import jax\n"
+           "from tidb_tpu.ops import runtime\n"
+           "class K:\n"
+           "    def __init__(self):\n"
+           "        self._jits = {}\n"
+           "    def _kernel(self, cols, n):\n"
+           "        return cols\n"
+           "    def _get(self, bucket):\n"
+           "        j = self._jits.get(bucket)\n"
+           "        if j is None:\n"
+           "            j = self._jits[bucket] = jax.jit(self._kernel)\n"
+           "        return j\n"
+           "    def launch(self, probe):\n"
+           "        cols, _d = runtime.device_put_chunk(probe)\n"
+           "        bkt = runtime.bucket_size(probe.num_rows)\n"
+           "        return self._get(bkt)(cols, probe.num_rows)\n")
+    rep = lint({OPS_REL: src}, rules=["retrace-hazard"])
+    assert rep.findings == []
+
+
+def test_raw_shape_dispatch_is_flagged():
+    """The old ops/stats.py bug: a module-level jit dispatched on a raw
+    parameter compiles one executable per input shape."""
+    src = ("import jax\n"
+           "import jax.numpy as jnp\n"
+           "_sort = jax.jit(jnp.sort)\n"
+           "def device_sort(data):\n"
+           "    return _sort(data)\n")
+    rep = lint({OPS_REL: src}, rules=["retrace-hazard"])
+    assert any("raw size" in f.message for f in rep.findings)
+
+
+def test_traced_bool_coercion_is_flagged():
+    src = ("import jax\n"
+           "def kernel_body(cols, n):\n"
+           "    return bool(cols.sum())\n"
+           "_K = jax.jit(kernel_body)\n")
+    rep = lint({OPS_REL: src}, rules=["retrace-hazard"])
+    assert any("bool()" in f.message for f in rep.findings)
+
+
+def test_device_rule_tags_suppress_and_stale_tags_report():
+    """The standard suppression machinery applies to the device rules:
+    a tagged coercion is sanctioned, an unused tag is stale."""
+    src = ("import jax\n"
+           "def kernel_body(cols, n):\n"
+           "    # lint: exempt[retrace-hazard] shape-derived static\n"
+           "    return bool(cols.sum())\n"
+           "_K = jax.jit(kernel_body)\n")
+    rep = lint({OPS_REL: src}, rules=["retrace-hazard"])
+    assert rep.findings == []

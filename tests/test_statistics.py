@@ -92,6 +92,31 @@ class TestCMSketch:
         assert cm2.count == cm.count
 
 
+class TestDeviceSort:
+    """ops/stats.device_sort: the ANALYZE sort goes through the pow2
+    shape discipline — padded to runtime.bucket_size, pad values sort
+    last, sliced back — so histogram builds over growing tables reuse
+    one compiled program per bucket instead of retracing per row
+    count (the repo-wide retrace-hazard lint contract)."""
+
+    def test_pads_sort_correctly(self):
+        from tidb_tpu.ops.stats import device_sort
+        ints = np.arange(1000, 0, -1).astype(np.int64)   # non-pow2 n
+        np.testing.assert_array_equal(device_sort(ints), np.sort(ints))
+        fl = np.array([3.5, -1.0, 2.0, 7.0, 0.5])        # NaN pad path
+        np.testing.assert_array_equal(device_sort(fl), np.sort(fl))
+        maxed = np.array([np.iinfo(np.int64).max, 1, 5], dtype=np.int64)
+        np.testing.assert_array_equal(device_sort(maxed), np.sort(maxed))
+
+    def test_same_bucket_reuses_one_program(self):
+        from tidb_tpu.ops.stats import _jit_sort, device_sort
+        device_sort(np.arange(900).astype(np.int64))     # warm 1024
+        before = _jit_sort._cache_size()
+        device_sort(np.arange(1000).astype(np.int64))    # same bucket
+        device_sort(np.arange(513).astype(np.int64))
+        assert _jit_sort._cache_size() == before
+
+
 class TestAnalyze:
     def _load(self, tk, n=2000):
         tk.execute("CREATE TABLE t (a BIGINT PRIMARY KEY, b INT, c INT, "

@@ -1,8 +1,10 @@
 """Rule catalog: importing this package registers every rule, in the
 order CI reports them. Four ported from the original standalone test
-walkers, ten project-specific additions, and three whole-program
-flow rules built on tidb_tpu/lint/flow (call graph + lock registry
-over the same shared parse)."""
+walkers, ten project-specific additions, three whole-program flow
+rules built on tidb_tpu/lint/flow (call graph + lock registry over
+the same shared parse), and three device-plane dataflow rules built
+on tidb_tpu/lint/flow/device (traced-program discovery over that
+same parse)."""
 
 from tidb_tpu.lint.rules import (  # noqa: F401  (import == register)
     wire,        # wire-discipline   (ported: tests/test_lint_wire.py)
@@ -22,4 +24,6 @@ from tidb_tpu.lint.rules import (  # noqa: F401  (import == register)
     lockorder,   # lock-order        (flow: acquisition-order cycles)
     guardedby,   # guarded-by        (flow: annotated shared state)
     pairres,     # paired-resource   (flow: consume/release, dispatch/
-)                #                    finalize balance)
+    #              finalize balance)
+    device,      # donation-safety / cache-key / retrace-hazard
+)                #                    (flow: device-plane dataflow)

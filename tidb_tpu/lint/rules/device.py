@@ -1,0 +1,884 @@
+"""Device-plane discipline rules: donation-safety, cache-key
+completeness, and retrace-hazard — the three checks of the
+tidb_tpu/lint/flow/device.py dataflow pass (see that module's
+docstring for the hazard classes; docs/PERF.md "Device-plane
+discipline" for the contracts they enforce)."""
+
+from __future__ import annotations
+
+import ast
+
+from tidb_tpu.lint.engine import Finding, Rule, register_rule
+from tidb_tpu.lint.flow.device import (COERCIONS, SHAPERS, _MESH_ROOT,
+                                       _call_name, _is_const,
+                                       _is_mesh_fp, _root_names,
+                                       device_flow_of)
+
+_BUILTINS = frozenset({
+    "self", "len", "max", "min", "sorted", "sum", "range", "zip",
+    "enumerate", "list", "tuple", "dict", "set", "frozenset", "id",
+    "getattr", "setattr", "hasattr", "isinstance", "print", "abs",
+    "any", "all", "repr", "str", "type", "iter", "next", "map",
+    "filter", "reversed", "slice", "None", "True", "False",
+    "int", "float", "bool", "bytes", "object", "Exception",
+    "ValueError", "RuntimeError", "KeyError",
+})
+
+
+def _mod_info(df, rel: str) -> tuple:
+    """(scope_names, mutable_globals) for a module. scope_names are
+    code references — imports (external ones included; the callgraph
+    only indexes in-forest targets), function/class defs, builtins.
+    mutable_globals are lowercase module-level assignment targets:
+    reads of THOSE from a traced body are trace-time state."""
+    cache = getattr(df, "_mod_info_cache", None)
+    if cache is None:
+        cache = df._mod_info_cache = {}
+    hit = cache.get(rel)
+    if hit is not None:
+        return hit
+    g = df.graph
+    scope = set(g._imports.get(rel, {}))
+    scope |= {n for (r, n) in g._top if r == rel}
+    scope |= {c for (r, c) in g._classes if r == rel}
+    scope |= _BUILTINS
+    mutable: set = set()
+    pf = next((p for p in df.forest if p.rel == rel), None)
+    if pf is not None:
+        for node in pf.nodes:
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                for alias in node.names:
+                    scope.add(alias.asname or
+                              alias.name.split(".")[0])
+        for stmt in pf.tree.body:
+            targets = []
+            if isinstance(stmt, ast.Assign):
+                targets = stmt.targets
+            elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+                targets = [stmt.target]
+            for t in targets:
+                if isinstance(t, ast.Name) and not _is_const(t.id):
+                    mutable.add(t.id)
+        mutable -= scope
+    out = (scope, mutable)
+    cache[rel] = out
+    return out
+
+
+def _bound_names(fi) -> set:
+    """Names bound in `fi` or its lexical closure chain: params,
+    assignment targets, loop/with/comprehension targets."""
+    out: set = set()
+    cur = fi
+    while cur is not None:
+        a = cur.node.args
+        for arg in (a.posonlyargs + a.args + a.kwonlyargs):
+            out.add(arg.arg)
+        if a.vararg:
+            out.add(a.vararg.arg)
+        if a.kwarg:
+            out.add(a.kwarg.arg)
+        for node in ast.walk(cur.node):
+            if isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, (ast.Store, ast.Del)):
+                out.add(node.id)
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef, ast.ClassDef)):
+                out.add(node.name)
+        cur = cur.parent
+    return out
+
+
+# ---------------------------------------------------------------------------
+# donation-safety
+# ---------------------------------------------------------------------------
+
+@register_rule("donation-safety")
+class DonationSafetyRule(Rule):
+    """A buffer donated to a traced program must have no live use after
+    the dispatch on any path.
+
+    `donate_argnums` hands the operand's device memory to XLA for
+    reuse as program scratch/output: any later read — directly,
+    through an alias, through a closure capture, or by an enclosing
+    retry loop re-dispatching the same binding — is a read-after-free
+    that silently corrupts on TPU while passing every CPU test. The
+    donated operand must also skip the per-chunk device memo
+    (`device_put_chunk(..., memo=False)`): a memoized donated buffer
+    is a dangling cache entry. The PR 8 overflow-retry shape (re-
+    dispatching *non-donated* device-resident lanes off the pending
+    token) is recognized as sanctioned — donation tracking applies
+    only to donating programs."""
+
+    min_sites = 3
+    fixture = (
+        "import jax\n"
+        "from tidb_tpu.ops import runtime\n"
+        "\n"
+        "class K:\n"
+        "    def __init__(self):\n"
+        "        self._jitd = None\n"
+        "\n"
+        "    def _kernel(self, cols, n):\n"
+        "        return cols\n"
+        "\n"
+        "    def dispatch(self, chunk):\n"
+        "        cols, _d = runtime.device_put_chunk(chunk)\n"
+        "        if self._jitd is None:\n"
+        "            self._jitd = jax.jit(self._kernel,\n"
+        "                                 donate_argnums=(0,))\n"
+        "        pending = self._jitd(cols, 4)\n"
+        "        total = cols[0].sum()\n"
+        "        return pending, total\n"
+    )
+
+    def check(self, forest):
+        df = device_flow_of(forest)
+        for d in df.dispatches:
+            if not d.site.donating:
+                continue
+            for pos in d.site.donate:
+                if pos >= len(d.call.args):
+                    continue
+                self.sites += 1
+                yield from self._check_donated(df, d, d.call.args[pos])
+
+    def _check_donated(self, df, d, arg):
+        if not isinstance(arg, ast.Name):
+            yield Finding(
+                d.rel, d.line, self.name,
+                f"donated operand `{ast.unparse(arg)}` is not a "
+                f"locally-owned name — donation requires exclusive "
+                f"ownership the analysis can see")
+            return
+        fi = d.func
+        if fi is None:
+            return
+        names = {arg.id} | self._aliases(fi, arg.id)
+        yield from self._check_memo(d, fi, arg.id)
+        if self._returns_dispatch(df, d, fi):
+            # `return jitd(cols, ...)`: the function exits at the
+            # dispatch, so reads on sibling branches (the non-donating
+            # twin the line after) can never see the donated buffer —
+            # the sanctioned ops/hashagg dispatch shape.
+            return
+        end = d.call.end_lineno or d.line
+        call_nodes = {id(n) for n in ast.walk(d.call)}
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Name) and node.id in names and \
+                    isinstance(node.ctx, ast.Load) and \
+                    id(node) not in call_nodes and node.lineno > end:
+                yield Finding(
+                    d.rel, node.lineno, self.name,
+                    f"`{node.id}` read after its buffer was donated to "
+                    f"`{d.site.fn_name}` at line {d.line} — "
+                    f"read-after-free on hardware that honors donation")
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)) and \
+                    node is not fi.node:
+                if any(isinstance(n, ast.Name) and n.id in names and
+                       isinstance(n.ctx, ast.Load)
+                       for n in ast.walk(node)):
+                    yield Finding(
+                        d.rel, node.lineno, self.name,
+                        f"closure `{node.name}` captures donated "
+                        f"buffer `{arg.id}` — it may outlive the "
+                        f"dispatch at line {d.line}")
+        yield from self._check_loop(df, d, fi, names)
+
+    def _returns_dispatch(self, df, d, fi) -> bool:
+        pm = df._parent_map(d.rel)
+        cur = pm.get(id(d.call))
+        while cur is not None and cur is not fi.node:
+            if isinstance(cur, ast.Return):
+                return True
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return False
+            cur = pm.get(id(cur))
+        return False
+
+    def _aliases(self, fi, name: str) -> set:
+        out: set = set()
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id == name:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+        return out
+
+    def _check_loop(self, df, d, fi, names: set):
+        """An enclosing loop whose next iteration re-dispatches a
+        binding created OUTSIDE the loop re-reads freed memory."""
+        pm = df._parent_map(d.rel)
+        cur = pm.get(id(d.call))
+        loop = None
+        while cur is not None and cur is not fi.node:
+            if isinstance(cur, (ast.While, ast.For)):
+                loop = cur
+                break
+            cur = pm.get(id(cur))
+        if loop is None:
+            return
+        for node in ast.walk(loop):
+            if isinstance(node, ast.Name) and node.id in names and \
+                    isinstance(node.ctx, ast.Store):
+                return      # rebound every iteration: each trip owns
+                #             a fresh buffer
+            if isinstance(node, ast.For) and \
+                    isinstance(node.target, ast.Name) and \
+                    node.target.id in names:
+                return
+        yield Finding(
+            d.rel, d.line, self.name,
+            f"retry loop re-dispatches donated buffer bound outside "
+            f"the loop — the second iteration reads memory freed by "
+            f"the first (donate only per-iteration bindings, or reuse "
+            f"non-donated lanes like ops/join.py's pending token)")
+
+    def _check_memo(self, d, fi, name: str):
+        """The donated transfer must opt out of the chunk device memo."""
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Assign) or \
+                    not isinstance(node.value, ast.Call):
+                continue
+            if _call_name(node.value) != "device_put_chunk":
+                continue
+            binds = any(
+                (isinstance(t, ast.Name) and t.id == name) or
+                (isinstance(t, ast.Tuple) and
+                 any(isinstance(e, ast.Name) and e.id == name
+                     for e in t.elts))
+                for t in node.targets)
+            if binds and not any(kw.arg == "memo"
+                                 for kw in node.value.keywords):
+                yield Finding(
+                    d.rel, node.value.lineno, self.name,
+                    "donated transfer uses the default memoizing "
+                    "device_put_chunk — a memoized donated buffer is "
+                    "read-after-free; pass memo=not donate")
+
+
+# ---------------------------------------------------------------------------
+# cache-key
+# ---------------------------------------------------------------------------
+
+@register_rule("cache-key")
+class CacheKeyRule(Rule):
+    """Everything a traced kernel body reads must be an operand or be
+    folded into the executable's cache key.
+
+    A kernel object whose traced body closes over `self` state, a
+    config/sysvar, or a mutable module global is specialized on that
+    value at trace time; if the value is not part of the
+    `FingerprintCache`/program-memo key, a later call with different
+    state silently reuses the stale executable. Checks: (a) every ctor
+    argument feeding traced-read `self` attributes appears in the
+    cache key (via `plan_fingerprint` args, the key tuple, or the
+    executor/mesh cache-put helper); (b) every key includes
+    `devplane.mesh_fingerprint` (the PR 18 plane-identity contract);
+    (c) traced bodies read no config vars or mutable module globals;
+    (d) kernel classes owning instance-bound programs are constructed
+    only under a kernel cache; (e) profiler registrations
+    distinguish the same components the cache key does."""
+
+    min_sites = 15
+    fixture = (
+        "import jax\n"
+        "from tidb_tpu.ops import runtime\n"
+        "from tidb_tpu import config, devplane\n"
+        "\n"
+        "class K:\n"
+        "    def __init__(self, exprs, width):\n"
+        "        self.exprs = exprs\n"
+        "        self.width = width\n"
+        "        self._jit = jax.jit(self._kernel)\n"
+        "\n"
+        "    def _kernel(self, cols, n):\n"
+        "        lim = config.direct_agg_slots()\n"
+        "        return (cols, self.width, lim)\n"
+        "\n"
+        "_KERNELS = runtime.FingerprintCache(8)\n"
+        "\n"
+        "def kernel_for(exprs, width):\n"
+        "    fp = runtime.plan_fingerprint(None, exprs, [])\n"
+        "    key = (fp, devplane.mesh_fingerprint(process=True))\n"
+        "    def make():\n"
+        "        return K(exprs, width)\n"
+        "    return _KERNELS.get_or_create(key, make)\n"
+    )
+
+    def check(self, forest):
+        df = device_flow_of(forest)
+        classes = self._kernel_classes(df)
+        caching = self._caching_functions(df, classes)
+        cached_ctors = set()
+        for F, info in caching.values():
+            yield from self._check_caching_fn(df, F, info, classes,
+                                              cached_ctors)
+        yield from self._check_uncached_ctors(df, classes, cached_ctors,
+                                              caching)
+        yield from self._check_traced_state(df, classes)
+
+    # -- kernel classes ------------------------------------------------------
+
+    def _kernel_classes(self, df) -> dict:
+        """(rel, cls) -> {"attrs": {attr: read line}, "init": {attr:
+        set of ctor param roots}, "params": [ctor params],
+        "instance_bound": bool}."""
+        out: dict = {}
+        for site in df.sites:
+            for fn in site.fns:
+                if fn.cls is None:
+                    continue
+                key = (fn.rel, fn.cls)
+                info = out.setdefault(
+                    key, {"attrs": {}, "init": {}, "params": [],
+                          "instance_bound": False, "fns": {}})
+                info["fns"][fn.key] = fn
+                if site.cls == fn.cls and site.store[0] in (
+                        "attr", "dict", "return"):
+                    info["instance_bound"] = True
+        for (rel, cls), info in out.items():
+            seen_bodies = set()
+            for fn in list(info["fns"].values()):
+                for body in df.reachable(fn):
+                    if body.cls == cls and body.rel == rel and \
+                            body.key not in seen_bodies:
+                        seen_bodies.add(body.key)
+                        self._attr_reads(body, info["attrs"])
+            init = df.graph._method.get((rel, cls, "__init__"))
+            if init is not None:
+                a = init.node.args
+                params = [p.arg for p in a.args[1:]]
+                info["params"] = params
+                env = {p: {p} for p in params}
+                self._init_closure(df, init, env, info["init"], 0)
+        return out
+
+    def _attr_reads(self, fi, attrs: dict) -> None:
+        callee_ids = set()
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute):
+                callee_ids.add(id(node.func))
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Attribute) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id == "self" and \
+                    isinstance(node.ctx, ast.Load) and \
+                    id(node) not in callee_ids and \
+                    not node.attr.startswith("__"):
+                attrs.setdefault(node.attr, node.lineno)
+
+    def _init_closure(self, df, fi, env: dict, out: dict,
+                      depth: int) -> None:
+        """self.X assignments of __init__ (helpers inlined to depth 3):
+        X -> ctor-param roots of its value."""
+        if depth > 3:
+            return
+
+        def roots(expr) -> set:
+            r: set = set()
+            for n in _root_names(expr):
+                r |= env.get(n, set())
+            for node in ast.walk(expr):
+                if isinstance(node, ast.Call):
+                    cn = _call_name(node)
+                    if _is_mesh_fp(node) or (
+                            isinstance(node.func, ast.Attribute) and
+                            isinstance(node.func.value, ast.Name) and
+                            node.func.value.id == "devplane"):
+                        r.add(_MESH_ROOT)
+                    _ = cn
+            return r
+
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Assign):
+                val_roots = roots(node.value)
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == "self":
+                        out.setdefault(t.attr, set()).update(val_roots)
+                    elif isinstance(t, ast.Name):
+                        env.setdefault(t.id, set()).update(val_roots)
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id == "self":
+                helper = df.graph._method.get(
+                    (fi.rel, fi.cls, node.func.attr))
+                if helper is not None and \
+                        node.func.attr != "__init__":
+                    a = helper.node.args
+                    hparams = [p.arg for p in a.args[1:]]
+                    henv = {}
+                    for i, arg in enumerate(node.args):
+                        if i < len(hparams):
+                            henv[hparams[i]] = roots(arg)
+                    for kw in node.keywords:
+                        if kw.arg in hparams:
+                            henv[kw.arg] = roots(kw.value)
+                    self._init_closure(df, helper, henv, out, depth + 1)
+
+    # -- caching functions ---------------------------------------------------
+
+    def _caching_functions(self, df, classes) -> dict:
+        """Functions that own a kernel cache: they call
+        `.get_or_create(key, ...)`, a cache helper whose body both
+        fingerprints the mesh and stores into a module dict, or — when
+        they construct a kernel class themselves — memoize inline into
+        a keyed dict (the executor shuffle-kernel shape). Scoped to
+        modules that hold traced sites or functions that construct a
+        kernel class; unrelated registries (the profiler's own row
+        cache) are not kernel caches."""
+        site_rels = {s.rel for s in df.sites}
+        out: dict = {}
+        for fi in df.graph.funcs.values():
+            if fi.parent is not None:
+                continue
+            makes_kernel = any(
+                isinstance(n, ast.Call) and
+                self._ctor_class(df, fi, n, classes) is not None
+                for n in ast.walk(fi.node))
+            if fi.rel not in site_rels and not makes_kernel:
+                continue
+            entries = []        # (kind, node)
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                if isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "get_or_create" and node.args:
+                    entries.append(("get_or_create", node))
+                else:
+                    hit = df.graph.resolve_call(node, fi.rel, fi)
+                    if hit is not None and hit.rel == fi.rel and \
+                            hit is not fi and \
+                            self._is_cache_helper(hit):
+                        entries.append(("helper", node))
+            if makes_kernel and not entries:
+                for node in ast.walk(fi.node):
+                    if isinstance(node, ast.Assign):
+                        for t in node.targets:
+                            if isinstance(t, ast.Subscript):
+                                entries.append(("inline", t))
+            if entries:
+                out[fi.key] = (fi, entries)
+        return out
+
+    def _is_cache_helper(self, fi) -> bool:
+        has_mesh = any(isinstance(n, ast.Call) and _is_mesh_fp(n)
+                       for n in ast.walk(fi.node))
+        if not has_mesh:
+            return False
+        for node in ast.walk(fi.node):
+            if isinstance(node, (ast.Assign,)):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript):
+                        return True
+            if isinstance(node, ast.Subscript) and \
+                    isinstance(node.ctx, ast.Store):
+                return True
+        return False
+
+    def _resolve_roots(self, fi, expr, memo=None, depth=0) -> set:
+        """Transitive bare-name roots of `expr` within `fi`'s body
+        (locals resolved through their bindings; mesh-fingerprint
+        calls contribute the mesh pseudo-root)."""
+        if memo is None:
+            memo = {}
+        out: set = set()
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                if _is_mesh_fp(node) or (
+                        isinstance(node.func, ast.Attribute) and
+                        node.func.attr == "active_mesh"):
+                    out.add(_MESH_ROOT)
+        if depth > 4:
+            return out | _root_names(expr)
+        for name in _root_names(expr):
+            if name in memo:
+                out |= memo[name]
+                continue
+            memo[name] = {name}
+            binding = None
+            for node in ast.walk(fi.node):
+                if isinstance(node, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == name
+                        for t in node.targets):
+                    binding = node.value
+                    break
+            if binding is not None:
+                # a local is an alias: its roots are the underlying
+                # sources, not the alias name itself
+                resolved = self._resolve_roots(fi, binding, memo,
+                                               depth + 1)
+                memo[name] = resolved or {name}
+            out |= memo[name]
+        return out
+
+    def _check_caching_fn(self, df, F, entries, classes, cached_ctors):
+        covered: set = set()
+        mesh_ok = False
+        for kind, call in entries:
+            self.sites += 1
+            if kind == "get_or_create":
+                key_roots = self._resolve_roots(F, call.args[0])
+                covered |= key_roots
+                if _MESH_ROOT in key_roots:
+                    mesh_ok = True
+            elif kind == "inline":
+                key_roots = self._resolve_roots(F, call.slice)
+                covered |= key_roots
+                if _MESH_ROOT in key_roots:
+                    mesh_ok = True
+            else:
+                for arg in call.args:
+                    covered |= self._resolve_roots(F, arg)
+                mesh_ok = True      # helper bodies fingerprint the mesh
+        if not mesh_ok:
+            yield Finding(
+                F.rel, entries[0][1].lineno, self.name,
+                f"kernel cache in {F.qualname}() does not fold "
+                f"devplane.mesh_fingerprint into its key — a mesh "
+                f"reshape would reuse executables compiled for another "
+                f"plane")
+        mod_names, _ = _mod_info(df, F.rel)
+        # constructions of kernel classes inside F (and its closures)
+        for node in ast.walk(F.node):
+            if not isinstance(node, ast.Call):
+                continue
+            cls_key = self._ctor_class(df, F, node, classes)
+            if cls_key is None:
+                continue
+            cached_ctors.add(id(node))
+            info = classes[cls_key]
+            bindings = self._ctor_bindings(info["params"], node)
+            for attr, line in sorted(info["attrs"].items()):
+                self.sites += 1
+                roots = info["init"].get(attr)
+                if roots is None:
+                    continue        # attr not ctor-derived: flagged by
+                    #                 _check_traced_state if stateful
+                for param in sorted(roots):
+                    if param == _MESH_ROOT:
+                        if not mesh_ok:
+                            yield Finding(
+                                F.rel, node.lineno, self.name,
+                                f"{cls_key[1]}.{attr} derives from the "
+                                f"device plane but the cache key has "
+                                f"no mesh fingerprint")
+                        continue
+                    arg_expr = bindings.get(param)
+                    if arg_expr is None:
+                        continue    # default value: constant
+                    need = {n for n in self._resolve_roots(F, arg_expr)
+                            if n not in mod_names and not _is_const(n)
+                            and n != _MESH_ROOT}
+                    missing = need - covered
+                    if missing:
+                        yield Finding(
+                            F.rel, node.lineno, self.name,
+                            f"traced body of {cls_key[1]} reads "
+                            f"self.{attr} (line {line}) but ctor arg "
+                            f"{param!r} <- {', '.join(sorted(missing))} "
+                            f"is not folded into the cache key")
+        # profiler registrations must key on covered components
+        var_covered = {n for n in covered
+                       if n != _MESH_ROOT and not _is_const(n)}
+        for node in ast.walk(F.node):
+            if isinstance(node, ast.Call) and \
+                    _call_name(node) == "profile" and \
+                    len(node.args) >= 2 and \
+                    isinstance(node.args[0], ast.Constant):
+                if isinstance(node.args[1], ast.Constant):
+                    continue    # explicit unfingerprinted row ("~")
+                self.sites += 1
+                fp_roots = self._resolve_roots(F, node.args[1])
+                if var_covered and not (fp_roots & (
+                        var_covered | {_MESH_ROOT})):
+                    yield Finding(
+                        F.rel, node.lineno, self.name,
+                        "profiler registration does not distinguish "
+                        "the cache key's components — profile rows "
+                        "from different executables would merge")
+
+    def _ctor_class(self, df, F, call, classes):
+        if isinstance(call.func, ast.Name):
+            rel = df.graph._classes.get((F.rel, call.func.id))
+            if rel is not None and (rel, call.func.id) in classes:
+                return (rel, call.func.id)
+        hit = df.graph.resolve_call(call, F.rel, F)
+        if hit is not None and hit.cls is not None and \
+                hit.node.name == "__init__" and \
+                (hit.rel, hit.cls) in classes:
+            return (hit.rel, hit.cls)
+        return None
+
+    def _ctor_bindings(self, params, call) -> dict:
+        out = {}
+        for i, arg in enumerate(call.args):
+            if i < len(params):
+                out[params[i]] = arg
+        for kw in call.keywords:
+            if kw.arg in params:
+                out[kw.arg] = kw.value
+        return out
+
+    def _check_uncached_ctors(self, df, classes, cached_ctors, caching):
+        """Instance-bound traced programs must be built under a kernel
+        cache — a per-statement construction recompiles per query."""
+        bound = {k for k, v in classes.items() if v["instance_bound"]}
+        if not bound:
+            return
+        names = {cls: key for key, v in classes.items()
+                 for (rel, cls) in [key] if key in bound}
+        for pf in df.forest:
+            for node in pf.nodes:
+                if not isinstance(node, ast.Call) or \
+                        id(node) in cached_ctors:
+                    continue
+                if not isinstance(node.func, ast.Name) or \
+                        node.func.id not in names:
+                    continue
+                key = names[node.func.id]
+                if df.graph._classes.get(
+                        (pf.rel, node.func.id)) != key[0] and \
+                        pf.rel != key[0]:
+                    # name does not resolve to the kernel class here
+                    if node.func.id not in df.graph._imports.get(
+                            pf.rel, {}):
+                        continue
+                self.sites += 1
+                fi = df.enclosing_function(pf.rel, node)
+                if fi is not None and (fi.key in caching or (
+                        fi.parent is not None and
+                        fi.parent.key in caching)):
+                    continue
+                if fi is not None and fi.cls == key[1]:
+                    continue        # class's own plumbing
+                yield Finding(
+                    pf.rel, node.lineno, self.name,
+                    f"{node.func.id} owns instance-bound traced "
+                    f"programs but is constructed outside a kernel "
+                    f"cache — every construction recompiles")
+
+    def _check_traced_state(self, df, classes):
+        """Config reads and mutable module globals inside traced
+        bodies."""
+        seen: set = set()
+        scanned: set = set()
+        for site in df.sites:
+            for fn in site.fns:
+                if fn.key in scanned:
+                    continue
+                scanned.add(fn.key)
+                self.sites += 1
+                _, mod_mutable = _mod_info(df, fn.rel)
+                bound = _bound_names(fn)
+                for node in ast.walk(fn.node):
+                    if isinstance(node, ast.Call) and \
+                            isinstance(node.func, ast.Attribute) and \
+                            isinstance(node.func.value, ast.Name) and \
+                            node.func.value.id == "config":
+                        k = (fn.rel, node.lineno)
+                        if k not in seen:
+                            seen.add(k)
+                            yield Finding(
+                                fn.rel, node.lineno, self.name,
+                                f"config.{node.func.attr}() read "
+                                f"inside traced body "
+                                f"{fn.qualname} — the executable "
+                                f"snapshots the value at trace time; "
+                                f"pass it as a ctor arg folded into "
+                                f"the cache key")
+                    elif isinstance(node, ast.Name) and \
+                            isinstance(node.ctx, ast.Load) and \
+                            node.id in mod_mutable and \
+                            node.id not in bound:
+                        k = (fn.rel, node.lineno, node.id)
+                        if k not in seen:
+                            seen.add(k)
+                            yield Finding(
+                                fn.rel, node.lineno, self.name,
+                                f"traced body {fn.qualname} reads "
+                                f"module global `{node.id}` — trace-"
+                                f"time state the cache key cannot "
+                                f"see")
+
+
+# ---------------------------------------------------------------------------
+# retrace-hazard
+# ---------------------------------------------------------------------------
+
+@register_rule("retrace-hazard")
+class RetraceHazardRule(Rule):
+    """Dispatch shapes and static arguments must be bounded, and traced
+    bodies must not coerce traced values to Python.
+
+    jit caches one executable per (shapes, dtypes, static args): raw
+    data-sized operands compile per input length (~300ms stalls the
+    profiler plane measures after the fact), so operands must flow
+    through the pow2 superchunk bucketing (`runtime.bucket_size` /
+    `pad_column` / `device_put_chunk`) and program-memo keys must be
+    bucketed (the `meshjoin._stage2_jits[bucket]` bounded-dict shape
+    is sanctioned). Static arguments must be hashable. Inside a traced
+    body, `float()`/`int()`/`bool()`/`.item()`/`np.asarray` force a
+    trace-time sync or constant-fold — host coercions belong in
+    finalize, after `jax.device_get`."""
+
+    min_sites = 10
+    fixture = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "\n"
+        "_sort = jax.jit(jnp.sort)\n"
+        "\n"
+        "def device_sort(data):\n"
+        "    return np.asarray(_sort(data))\n"
+        "\n"
+        "def kernel_body(cols, n):\n"
+        "    return bool(cols[0].sum())\n"
+        "\n"
+        "_K = jax.jit(kernel_body)\n"
+    )
+
+    def check(self, forest):
+        df = device_flow_of(forest)
+        inline = set()          # fns reachable from traced bodies:
+        for site in df.sites:   # calls there are inlined traces, not
+            for fn in site.fns:  # dispatch boundaries
+                inline |= {f.key for f in df.reachable(fn)}
+        for d in df.dispatches:
+            self.sites += 1
+            if d.func is not None and d.func.key in inline:
+                continue
+            yield from self._check_shapes(d)
+            yield from self._check_memo_key(df, d)
+            yield from self._check_static_args(d)
+        yield from self._check_coercions(df)
+
+    def _check_shapes(self, d):
+        fi = d.func
+        if fi is None:
+            return
+        if any(isinstance(n, ast.Call) and _call_name(n) in SHAPERS
+               for n in ast.walk(fi.node)):
+            return
+        a = fi.node.args
+        params = {p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)}
+        for arg in d.call.args:
+            if isinstance(arg, ast.Name) and arg.id in params:
+                yield Finding(
+                    d.rel, d.line, self.name,
+                    f"`{arg.id}` dispatched to `{d.site.fn_name}` at "
+                    f"its raw size — one executable per input shape; "
+                    f"route it through runtime.bucket_size pow2 "
+                    f"padding")
+
+    def _check_memo_key(self, df, d):
+        if d.via_factory is None:
+            return
+        fi = d.func
+        for arg in d.via_factory.args:
+            self.sites += 1
+            if not self._bounded_key(fi, arg, 0):
+                yield Finding(
+                    d.rel, d.line, self.name,
+                    f"program-memo key `{ast.unparse(arg)}` is not "
+                    f"bucketed — an unbounded key set compiles (and "
+                    f"pins) one program per distinct value")
+
+    def _bounded_key(self, fi, expr, depth: int) -> bool:
+        if isinstance(expr, ast.Starred):
+            expr = expr.value
+        if isinstance(expr, ast.Constant):
+            return True
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                cn = _call_name(node) or ""
+                if cn in SHAPERS or "bucket" in cn:
+                    return True
+        if isinstance(expr, ast.Attribute):
+            return "cap" in expr.attr or "bucket" in expr.attr
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return all(self._bounded_key(fi, e, depth + 1)
+                       for e in expr.elts)
+        if isinstance(expr, ast.Name) and fi is not None:
+            a = fi.node.args
+            if expr.id in {p.arg for p in
+                           (a.posonlyargs + a.args + a.kwonlyargs)}:
+                return True     # caller's discipline, checked there
+            if depth > 3:
+                return False
+            for node in ast.walk(fi.node):
+                if isinstance(node, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == expr.id
+                        for t in node.targets):
+                    return self._bounded_key(fi, node.value, depth + 1)
+        return False
+
+    def _check_static_args(self, d):
+        names = d.site.static_names
+        nums = d.site.static_nums
+        if not names and not nums:
+            return
+        exprs = [a for i, a in enumerate(d.call.args) if i in nums]
+        exprs += [kw.value for kw in d.call.keywords if kw.arg in names]
+        for e in exprs:
+            self.sites += 1
+            if isinstance(e, (ast.List, ast.Set, ast.Dict)):
+                yield Finding(
+                    d.rel, d.line, self.name,
+                    f"unhashable {type(e).__name__.lower()} literal "
+                    f"passed at a static position of "
+                    f"`{d.site.fn_name}` — jit's cache key requires "
+                    f"hashable statics")
+
+    def _check_coercions(self, df):
+        seen: set = set()
+        for site in df.sites:
+            for body in df.traced_bodies(site):
+                if body.key in seen:
+                    continue
+                seen.add(body.key)
+                self.sites += 1
+                yield from self._scan_body(body)
+
+    def _scan_body(self, fi):
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if isinstance(fn, ast.Name) and fn.id in COERCIONS and \
+                    node.args and not all(
+                        isinstance(a, ast.Constant) or
+                        all(_is_const(r) for r in _root_names(a))
+                        for a in node.args):
+                yield Finding(
+                    fi.rel, node.lineno, self.name,
+                    f"{fn.id}() on a traced value inside "
+                    f"{fi.qualname} forces a trace-time sync — host "
+                    f"coercions belong in finalize")
+            elif isinstance(fn, ast.Attribute) and fn.attr == "item" \
+                    and not node.args:
+                yield Finding(
+                    fi.rel, node.lineno, self.name,
+                    f".item() inside traced body {fi.qualname} — "
+                    f"device sync per element")
+            elif isinstance(fn, ast.Attribute) and \
+                    isinstance(fn.value, ast.Name) and \
+                    (fn.value.id, fn.attr) in (
+                        ("np", "asarray"), ("np", "array"),
+                        ("numpy", "asarray"), ("numpy", "array"),
+                        ("jax", "device_get")) and \
+                    not (node.args and
+                         isinstance(node.args[0], ast.Constant)):
+                yield Finding(
+                    fi.rel, node.lineno, self.name,
+                    f"{fn.value.id}.{fn.attr}() inside traced body "
+                    f"{fi.qualname} materializes on host mid-trace")

@@ -273,7 +273,7 @@ def _group_table(xp, x, m, C, mask=None):
     gathered tables).
 
     -> (uniq[C] ascending with _FILL padding, inv[m] int32, tot)."""
-    bits = max(1, int(m - 1).bit_length()) if m > 1 else 1
+    bits = max(1, int(m - 1).bit_length()) if m > 1 else 1  # lint: exempt[retrace-hazard] m is the padded length (shape-derived, static at trace time), not a traced value
     B = np.int64(bits)
     Q = np.int64(1) << B
     low = Q - np.int64(1)
